@@ -1,0 +1,24 @@
+"""Test bootstrap: force host-only JAX with an 8-device virtual CPU mesh so
+multi-chip sharding (TP/DP) is exercised without TPU hardware — the same
+"multi-node behavior without the hardware" strategy the reference uses with
+fake OpenAI backends + envtest (SURVEY §4)."""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The ambient environment registers the real-TPU PJRT plugin at interpreter
+# start (sitecustomize) and pins the platform; override via jax.config too so
+# unit tests always run on the 8-device virtual CPU mesh (TPU matmuls default
+# to bf16 precision, which would sink f32 parity tests).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

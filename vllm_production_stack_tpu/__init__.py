@@ -1,0 +1,21 @@
+"""TPU-native production LLM-serving stack.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of the vLLM
+Production Stack (reference: /root/reference): an OpenAI-compatible request
+router with pluggable routing algorithms, a TPU serving engine with paged KV
+cache in HBM and continuous batching, a KV offload fabric, and the
+deployment/observability assets around them.
+
+Subpackages
+-----------
+- ``engine``   — the TPU serving engine (the part the reference outsources to
+  vLLM images): scheduler, paged KV pool, model runner, OpenAI HTTP server.
+- ``models``   — model definitions (pure-functional JAX) + weight loading.
+- ``ops``      — attention and other core ops (XLA reference + Pallas kernels).
+- ``parallel`` — device mesh construction and sharding specs (TP/PP/DP).
+- ``router``   — the OpenAI-compatible request router (reference:
+  src/vllm_router/).
+- ``utils``    — logging, singletons, misc.
+"""
+
+__version__ = "0.1.0"

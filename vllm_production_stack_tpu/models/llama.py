@@ -1,0 +1,165 @@
+"""Llama-family decoder (Llama 2/3, Mistral, Qwen2, TinyLlama) in pure JAX.
+
+The reference stack never implements a model — it deploys vLLM images. The
+TPU engine needs its own: a functional, scan-over-layers decoder whose
+per-layer params are stacked along a leading axis so jit traces ONE layer
+body (fast compiles, fixed shapes — XLA-friendly control flow instead of a
+Python loop over 32 layers).
+
+Every weight is an (in, out)-oriented matrix so the forward pass is plain
+`x @ w` feeding the MXU; tensor parallelism is expressed entirely by the
+PartitionSpecs in parallel/sharding.py — no collective appears in this file
+(XLA/GSPMD inserts them).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.config import ModelConfig
+from ..ops.attention import (
+    apply_rope,
+    causal_page_mask,
+    paged_attention_xla,
+    write_kv_pages,
+)
+
+
+def _dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array) -> dict:
+    """Random-init a stacked param tree (tests + benchmarks without weights)."""
+    L = cfg.num_layers
+    h, hd = cfg.hidden_size, cfg.head_dim
+    nh, nkv, it = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+    dt = _dtype(cfg)
+    keys = iter(jax.random.split(rng, 16))
+
+    def w(key, *shape, scale=None):
+        scale = scale if scale is not None else shape[-2] ** -0.5
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    params: dict[str, Any] = {
+        "embed": w(next(keys), cfg.vocab_size, h, scale=0.02),
+        "layers": {
+            "attn": {
+                "wq": w(next(keys), L, h, nh * hd),
+                "wk": w(next(keys), L, h, nkv * hd),
+                "wv": w(next(keys), L, h, nkv * hd),
+                "wo": w(next(keys), L, nh * hd, h),
+            },
+            "mlp": {
+                "gate": w(next(keys), L, h, it),
+                "up": w(next(keys), L, h, it),
+                "down": w(next(keys), L, it, h),
+            },
+            "input_norm": jnp.ones((L, h), dt),
+            "post_attn_norm": jnp.ones((L, h), dt),
+        },
+        "final_norm": jnp.ones((h,), dt),
+    }
+    if cfg.attention_bias:
+        params["layers"]["attn"]["bq"] = jnp.zeros((L, nh * hd), dt)
+        params["layers"]["attn"]["bk"] = jnp.zeros((L, nkv * hd), dt)
+        params["layers"]["attn"]["bv"] = jnp.zeros((L, nkv * hd), dt)
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), h, cfg.vocab_size, scale=0.02)
+    return params
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(dt) * weight
+
+
+def init_kv_cache(
+    cfg: ModelConfig, num_blocks: int, block_size: int, dtype: Any | None = None
+) -> jax.Array:
+    """Stacked paged pool: (L, 2, num_blocks, block_size, kvH, head_dim)."""
+    dt = jnp.dtype(dtype) if dtype is not None else _dtype(cfg)
+    return jnp.zeros(
+        (cfg.num_layers, 2, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim),
+        dt,
+    )
+
+
+def _layer(
+    cfg: ModelConfig,
+    lp: dict,
+    kv_layer: jax.Array,
+    x: jax.Array,
+    positions: jax.Array,
+    block_tables: jax.Array,
+    slot_mapping: jax.Array,
+    mask: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    b, t, h = x.shape
+    hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+
+    res = x
+    x = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    ap = lp["attn"]
+    q = x @ ap["wq"]
+    k = x @ ap["wk"]
+    v = x @ ap["wv"]
+    if cfg.attention_bias:
+        q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
+    q = q.reshape(b, t, nh, hd)
+    k = k.reshape(b, t, nkv, hd)
+    v = v.reshape(b, t, nkv, hd)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+
+    kv_layer = write_kv_pages(
+        kv_layer, k.reshape(b * t, nkv, hd), v.reshape(b * t, nkv, hd), slot_mapping
+    )
+    attn = paged_attention_xla(q, kv_layer, block_tables, mask, scale=hd**-0.5)
+    x = res + attn.reshape(b, t, nh * hd) @ ap["wo"]
+
+    res = x
+    x = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+    mp = lp["mlp"]
+    x = (jax.nn.silu(x @ mp["gate"]) * (x @ mp["up"])) @ mp["down"]
+    return res + x, kv_layer
+
+
+def forward(
+    cfg: ModelConfig,
+    params: dict,
+    token_ids: jax.Array,  # (B, T) int32
+    positions: jax.Array,  # (B, T) int32 logical positions
+    kv_caches: jax.Array,  # (L, 2, num_blocks, block_size, kvH, D)
+    block_tables: jax.Array,  # (B, max_blocks) int32
+    slot_mapping: jax.Array,  # (B*T,) flat slots (padding -> block 0 slots)
+    context_lens: jax.Array,  # (B,) tokens resident after this step
+) -> tuple[jax.Array, jax.Array]:
+    """One model step over a token batch. Prefill is (B=1, T=chunk); decode is
+    (B=batch, T=1). Returns (hidden (B,T,h), updated kv_caches)."""
+    x = params["embed"][token_ids].astype(_dtype(cfg))
+    # layer-invariant attention mask, built once and reused across the scan
+    s_ctx = block_tables.shape[1] * kv_caches.shape[3]
+    mask = causal_page_mask(positions, context_lens, s_ctx)
+
+    def body(carry, xs):
+        lp, kv_layer = xs
+        y, new_kv = _layer(
+            cfg, lp, kv_layer, carry, positions, block_tables, slot_mapping, mask
+        )
+        return y, new_kv
+
+    x, new_kv = jax.lax.scan(body, x, (params["layers"], kv_caches))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return x, new_kv
+
+
+def compute_logits(cfg: ModelConfig, params: dict, hidden: jax.Array) -> jax.Array:
+    """hidden: (N, h) -> logits (N, vocab) in float32."""
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return (hidden @ head).astype(jnp.float32)

@@ -1,0 +1,128 @@
+"""Paged-KV attention ops — XLA reference implementation.
+
+The reference stack delegates attention to vLLM's CUDA paged-attention
+kernels inside the engine image (external to the repo). Here the KV cache is
+a preallocated paged pool in HBM and attention is expressed so XLA can fuse
+and tile it onto the MXU; `ops/paged_attention_pallas.py` provides the
+hand-written TPU kernel for the decode hot path, with this module as the
+always-available fallback (and the CPU-test path).
+
+Conventions
+-----------
+- A KV page pool for ONE layer is `kv` with shape
+  ``(2, num_blocks, block_size, num_kv_heads, head_dim)`` (index 0 = K, 1 = V).
+- Block 0 is the reserved *null* page: padding tokens write there and
+  page-table padding points there; masks keep it out of every softmax.
+- A "slot" is ``block_id * block_size + offset`` — the flat position of a
+  token's KV in the pool.
+- Logical cache position j of a sequence lives at slot
+  ``block_table[j // block_size] * block_size + j % block_size``, so a gather
+  of `block_table` pages yields the sequence's KV ordered by token position.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def apply_rope(
+    x: jax.Array, positions: jax.Array, theta: float = 10000.0
+) -> jax.Array:
+    """Rotary position embedding, non-interleaved (HF Llama convention).
+
+    x: (..., T, heads, head_dim), positions: (..., T) int32.
+    """
+    head_dim = x.shape[-1]
+    half = head_dim // 2
+    freqs = jnp.arange(half, dtype=jnp.float32) / half
+    inv_freq = theta**-freqs  # (half,)
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., T, half)
+    cos = jnp.cos(angles)[..., None, :]  # (..., T, 1, half)
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x2 * cos + x1 * sin
+    return jnp.concatenate([rx1, rx2], axis=-1).astype(x.dtype)
+
+
+def write_kv_pages(
+    kv: jax.Array, k: jax.Array, v: jax.Array, slot_mapping: jax.Array
+) -> jax.Array:
+    """Scatter new K/V rows into the paged pool.
+
+    kv: (2, num_blocks, block_size, kvH, D); k, v: (N, kvH, D);
+    slot_mapping: (N,) flat slot per token (padding rows point at block 0).
+    """
+    num_blocks, block_size = kv.shape[1], kv.shape[2]
+    flat = kv.reshape(2, num_blocks * block_size, *kv.shape[3:])
+    flat = flat.at[0, slot_mapping].set(k.astype(kv.dtype))
+    flat = flat.at[1, slot_mapping].set(v.astype(kv.dtype))
+    return flat.reshape(kv.shape)
+
+
+def gather_pages(kv: jax.Array, block_tables: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Gather each sequence's pages into contiguous (B, S_ctx, kvH, D) K and V.
+
+    block_tables: (B, max_blocks) int32 (padding entries = 0, the null page).
+    """
+    b, max_blocks = block_tables.shape
+    block_size, kvh, d = kv.shape[2], kv.shape[3], kv.shape[4]
+    pages = kv[:, block_tables]  # (2, B, max_blocks, block_size, kvH, D)
+    seq = pages.reshape(2, b, max_blocks * block_size, kvh, d)
+    return seq[0], seq[1]
+
+
+def causal_page_mask(
+    q_positions: jax.Array, context_lens: jax.Array, s: int
+) -> jax.Array:
+    """(B, T, S) mask: gathered-context position j is attendable by the query
+    at logical position p iff j < context_len and j <= p. Layer-invariant —
+    build it once per step and reuse across the layer scan.
+
+    q_positions: (B, T); context_lens: (B,); s: gathered context length.
+    """
+    ctx_pos = jnp.arange(s, dtype=jnp.int32)[None, :]  # (1, S)
+    valid = ctx_pos < context_lens[:, None]  # (B, S)
+    causal = ctx_pos[:, None, :] <= q_positions[..., None]  # (B, T, S)
+    return valid[:, None, :] & causal
+
+
+def paged_attention_xla(
+    q: jax.Array,
+    kv: jax.Array,
+    block_tables: jax.Array,
+    mask: jax.Array,
+    *,
+    scale: float,
+) -> jax.Array:
+    """Causal attention of queries against the paged KV cache.
+
+    Covers prefill, chunked prefill, and decode with one einsum-shaped
+    program (decode is T=1): the chunk's own K/V are written to the pool
+    *before* calling this, so causality is purely positional masking.
+
+    q: (B, T, num_heads, D)
+    kv: (2, num_blocks, block_size, kvH, D) for this layer
+    block_tables: (B, max_blocks)
+    mask: (B, T, S) from causal_page_mask
+    returns: (B, T, num_heads, D)
+    """
+    b, t, num_heads, d = q.shape
+    kvh = kv.shape[3]
+    qpk = num_heads // kvh
+    keys, values = gather_pages(kv, block_tables)  # (B, S, kvH, D)
+
+    qg = q.reshape(b, t, kvh, qpk, d)
+    # scores: (B, kvH, qpk, T, S)
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg.astype(jnp.float32), keys.astype(jnp.float32)
+    )
+    scores *= scale
+    scores = jnp.where(mask[:, None, None], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, values.astype(jnp.float32))
+    return out.reshape(b, t, num_heads, d).astype(q.dtype)

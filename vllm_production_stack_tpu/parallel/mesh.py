@@ -1,0 +1,56 @@
+"""Device mesh construction.
+
+TPU-first replacement for the reference's process-group plumbing: instead of
+NCCL groups (vLLM) / Ray placement groups (ray-cluster.yaml), parallelism is a
+`jax.sharding.Mesh` with named axes; XLA inserts the collectives (psum /
+all-gather / reduce-scatter) over ICI within a slice and DCN across slices.
+
+Axis convention (used by every PartitionSpec in this package):
+  - "dp": data parallel (request batch replicas)
+  - "tp": tensor parallel (megatron-style weight sharding; rides ICI)
+  - "pp": pipeline stages (multi-slice / DCN)  [stage meshes, later rounds]
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+PP_AXIS = "pp"
+
+
+def make_mesh(
+    tensor_parallel_size: int = 1,
+    data_parallel_size: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a (dp, tp) mesh over the available devices.
+
+    TP is the innermost axis so that its collectives map onto
+    nearest-neighbour ICI links (the same reason the reference pins TP within
+    a node via /dev/shm + NVLink, deployment-vllm-multi.yaml:424-431).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    want = tensor_parallel_size * data_parallel_size
+    if want > len(devices):
+        raise ValueError(
+            f"mesh needs {want} devices (tp={tensor_parallel_size} x "
+            f"dp={data_parallel_size}) but only {len(devices)} available"
+        )
+    grid = np.array(devices[:want]).reshape(data_parallel_size, tensor_parallel_size)
+    return Mesh(grid, (DP_AXIS, TP_AXIS))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(1, 1)
+
+
+def shard(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

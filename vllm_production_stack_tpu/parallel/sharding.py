@@ -1,0 +1,70 @@
+"""PartitionSpecs for model params, KV cache, and activations.
+
+Megatron-style tensor parallelism expressed as named shardings — the TPU
+equivalent of the reference's `--tensor-parallel-size` (SURVEY §2.4):
+
+  - attention q/k/v projections: column-parallel (shard the head axis)
+  - attention output projection: row-parallel (XLA inserts the psum)
+  - MLP gate/up: column-parallel; down: row-parallel
+  - embedding + lm_head: vocab-sharded (logits psum/all-gathered by XLA)
+  - KV cache pages: sharded over kv-heads on the tp axis, so each chip only
+    ever touches its own heads' pages (no cross-chip KV traffic in decode)
+
+Param trees are "stacked": every per-layer leaf carries a leading num_layers
+dimension and the model scans over it, so specs below include that axis first.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ..engine.config import ModelConfig
+from .mesh import DP_AXIS, TP_AXIS
+
+
+def llama_param_specs(cfg: ModelConfig) -> dict:
+    """PartitionSpec tree structurally matching init_params(cfg)'s tree
+    (optional leaves — attention biases, untied lm_head — included only when
+    the config produces them)."""
+    attn = {
+        # [L, hidden, heads*head_dim] — shard output (head) axis
+        "wq": P(None, None, TP_AXIS),
+        "wk": P(None, None, TP_AXIS),
+        "wv": P(None, None, TP_AXIS),
+        # [L, heads*head_dim, hidden] — shard input (head) axis; psum after
+        "wo": P(None, TP_AXIS, None),
+    }
+    if cfg.attention_bias:
+        attn |= {"bq": P(None, TP_AXIS), "bk": P(None, TP_AXIS), "bv": P(None, TP_AXIS)}
+    specs = {
+        "embed": P(TP_AXIS, None),  # [vocab, hidden] vocab-sharded
+        "layers": {
+            "attn": attn,
+            "mlp": {
+                "gate": P(None, None, TP_AXIS),  # [L, hidden, inter]
+                "up": P(None, None, TP_AXIS),
+                "down": P(None, TP_AXIS, None),  # [L, inter, hidden]
+            },
+            "input_norm": P(None, None),
+            "post_attn_norm": P(None, None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, TP_AXIS)  # [hidden, vocab] vocab-sharded
+    return specs
+
+
+def kv_cache_spec() -> P:
+    """[L, 2, num_blocks, block_size, kv_heads, head_dim] — shard kv heads."""
+    return P(None, None, None, None, TP_AXIS, None)
+
+
+def decode_tokens_spec() -> P:
+    """[B] token ids — shard batch over dp."""
+    return P(DP_AXIS)
+
+
+def prefill_tokens_spec() -> P:
+    """[T] a single sequence's chunk — replicated (prefill batches one seq)."""
+    return P()
