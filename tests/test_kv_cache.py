@@ -1,0 +1,76 @@
+"""Strict allocator tests — OOB paged indices fail silently on TPU (XLA
+clamps), so host-side accounting must be airtight."""
+
+import pytest
+
+from vllm_production_stack_tpu.engine.kv_cache import KVBlockPool
+
+
+def test_block_zero_reserved():
+    pool = KVBlockPool(num_blocks=4, block_size=8)
+    got = {pool.allocate() for _ in range(3)}
+    assert got == {1, 2, 3}
+    assert pool.allocate() is None
+
+
+def test_free_and_reuse():
+    pool = KVBlockPool(num_blocks=3, block_size=8)
+    a = pool.allocate()
+    b = pool.allocate()
+    pool.free_block(a)
+    c = pool.allocate()
+    assert c == a
+    pool.free_block(c)
+    with pytest.raises(KeyError):
+        pool.free_block(c)  # double free
+    pool.free_block(b)
+    with pytest.raises(KeyError):
+        pool.free_block(b)
+
+
+def test_prefix_match_and_refcount():
+    pool = KVBlockPool(num_blocks=8, block_size=4)
+    tokens = list(range(10))  # blocks: [0..3], [4..7], partial [8,9]
+    b1, b2 = pool.allocate(), pool.allocate()
+    h1 = pool.register_full_block(b1, pool.root_hash(), tuple(tokens[:4]))
+    pool.register_full_block(b2, h1, tuple(tokens[4:8]))
+
+    matched = pool.match_prefix(tokens)
+    assert matched == [b1, b2]
+    assert pool.stats.queries == 2 and pool.stats.hits == 2
+
+    # divergent second block -> only first matches
+    other = tokens[:4] + [99, 98, 97, 96]
+    assert pool.match_prefix(other) == [b1]
+    assert pool.stats.hits == 3 and pool.stats.queries == 4
+
+
+def test_evictable_blocks_are_reusable_and_lru():
+    pool = KVBlockPool(num_blocks=4, block_size=2)
+    a, b, c = pool.allocate(), pool.allocate(), pool.allocate()
+    ha = pool.register_full_block(a, pool.root_hash(), (1, 2))
+    pool.register_full_block(b, ha, (3, 4))
+    # park a then b (refcount 0, content cached)
+    pool.free_block(a)
+    pool.free_block(b)
+    assert pool.num_free == 2
+    # cached prefix still matchable while parked
+    assert pool.match_prefix([1, 2, 3, 4]) == [a, b]
+    pool.free_block(a)
+    pool.free_block(b)
+    # exhaust the free list; next allocs evict LRU (a first, then b)
+    pool.free_block(c)
+    d = pool.allocate()  # from free list (c)
+    assert d == c
+    e = pool.allocate()
+    assert e == a  # evicted oldest
+    # a's content no longer addressable
+    assert pool.match_prefix([1, 2]) == []
+
+
+def test_usage_perc():
+    pool = KVBlockPool(num_blocks=5, block_size=2)  # 4 usable
+    assert pool.usage_perc == 0.0
+    pool.allocate()
+    pool.allocate()
+    assert pool.usage_perc == 0.5

@@ -1,0 +1,140 @@
+"""Scheduler behavior: chunked prefill, decode batching, prefix-cache
+admission, preemption + recompute-resume."""
+
+from vllm_production_stack_tpu.engine.config import (
+    CacheConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from vllm_production_stack_tpu.engine.request import Request, SamplingParams
+from vllm_production_stack_tpu.engine.scheduler import (
+    DecodeWork,
+    PrefillWork,
+    Scheduler,
+)
+
+
+def make_scheduler(num_blocks=16, block_size=4, max_batched=8, max_seqs=4):
+    return Scheduler(
+        ModelConfig.tiny(max_model_len=128),
+        CacheConfig(
+            block_size=block_size, num_blocks=num_blocks, enable_prefix_caching=True
+        ),
+        SchedulerConfig(
+            max_num_seqs=max_seqs,
+            max_num_batched_tokens=max_batched,
+            decode_buckets=(max_seqs,),
+            prefill_buckets=(max_batched,),
+        ),
+    )
+
+
+def req(rid, n_prompt, **kw):
+    return Request(
+        request_id=rid,
+        prompt_token_ids=list(range(100, 100 + n_prompt)),
+        sampling=SamplingParams(**kw),
+    )
+
+
+def drive(sched, work, start_token=1000):
+    """Apply a fake sampled token for every sample slot in the work."""
+    n = (
+        (1 if work.sample else 0)
+        if isinstance(work, PrefillWork)
+        else len(work.requests)
+    )
+    return sched.postprocess(work, list(range(start_token, start_token + n)))
+
+
+def test_chunked_prefill_then_decode():
+    s = make_scheduler(max_batched=8)
+    r = req("a", 19, max_tokens=4)
+    s.add_request(r)
+
+    sizes = []
+    while not r.prefill_done:
+        w = s.schedule()
+        assert isinstance(w, PrefillWork)
+        sizes.append(len(w.token_ids))
+        drive(s, w)
+    assert sizes == [8, 8, 3]
+    assert len(r.output_token_ids) == 1  # sampled at prompt end
+
+    w = s.schedule()
+    assert isinstance(w, DecodeWork) and w.requests == [r]
+    assert w.positions == [19]
+    assert w.token_ids == [r.output_token_ids[-1]]
+    drive(s, w)
+    assert len(r.output_token_ids) == 2
+
+
+def test_decode_prefill_alternation():
+    s = make_scheduler(num_blocks=32)
+    a, b = req("a", 4, max_tokens=16), req("b", 12, max_tokens=16)
+    s.add_request(a)
+    w = s.schedule()
+    assert isinstance(w, PrefillWork) and w.request is a
+    drive(s, w)
+    s.add_request(b)
+    kinds = []
+    for _ in range(4):
+        w = s.schedule()
+        kinds.append(type(w).__name__)
+        drive(s, w)
+    # decode for a interleaves with b's prefill chunks
+    assert "DecodeWork" in kinds and "PrefillWork" in kinds
+    assert kinds[0] != kinds[1]
+
+
+def test_prefix_cache_hit_on_second_request():
+    s = make_scheduler(block_size=4, max_batched=16)
+    a = req("a", 10, max_tokens=1)
+    s.add_request(a)
+    drive(s, s.schedule())  # full prefill + sample -> finished (max_tokens=1)
+    assert a.status.finished
+
+    b = req("b", 10, max_tokens=1)  # same prompt tokens
+    s.add_request(b)
+    w = s.schedule()
+    assert isinstance(w, PrefillWork)
+    # two full blocks (8 tokens) served from cache; only the tail computed
+    assert b.num_cached_prompt_tokens == 8
+    assert w.positions == [8, 9]
+
+
+def test_preemption_and_resume():
+    # pool with 7 usable blocks of 4 tokens; two seqs needing 4+ blocks each
+    s = make_scheduler(num_blocks=8, block_size=4, max_batched=8, max_seqs=2)
+    s.pool.enable_prefix_caching = False
+    a, b = req("a", 8, max_tokens=20), req("b", 8, max_tokens=20)
+    s.add_request(a)
+    s.add_request(b)
+    seen_preempt = False
+    for _ in range(60):
+        w = s.schedule()
+        if w is None:
+            break
+        drive(s, w)
+        if a.num_preemptions or b.num_preemptions:
+            seen_preempt = True
+        if a.status.finished and b.status.finished:
+            break
+    assert seen_preempt
+    assert a.status.finished and b.status.finished
+    # both produced the full 20 tokens despite recompute
+    assert len(a.output_token_ids) == 20
+    assert len(b.output_token_ids) == 20
+    # all blocks released at the end
+    assert s.pool.num_free == 7
+
+
+def test_finish_frees_blocks_and_eos():
+    s = make_scheduler()
+    r = req("a", 4, max_tokens=10)
+    r.eos_token_id = 1001  # second drive token
+    s.add_request(r)
+    drive(s, s.schedule())  # prefill, samples 1000
+    drive(s, s.schedule(), start_token=1001)  # decode -> eos
+    assert r.status.finished and r.status.name == "FINISHED_STOPPED"
+    assert s.pool.usage_perc == 0.0 or s.pool.num_free == s.pool.num_usable

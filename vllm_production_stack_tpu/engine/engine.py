@@ -1,0 +1,270 @@
+"""LLMEngine: the synchronous serving core (scheduler + runner + detokenize).
+
+The OpenAI HTTP layer (engine/server.py) drives `step()` from a background
+loop; offline use (bench.py, tests) drives it directly. This composes the
+pieces the reference gets from vLLM images, exporting the stats the router's
+scraper contract expects (SURVEY §5 metrics contract).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+from dataclasses import dataclass
+
+from ..utils.tokenizer import IncrementalDetokenizer, TokenizerWrapper
+from .config import EngineConfig
+from .model_runner import ModelRunner
+from .request import Request, RequestOutput, RequestStatus, SamplingParams
+from .scheduler import Scheduler
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class EngineStatsSnapshot:
+    """Mirrors the metric contract the router scrapes from engines
+    (reference: src/vllm_router/stats/engine_stats.py:42-85)."""
+
+    num_requests_running: int = 0
+    num_requests_waiting: int = 0
+    kv_usage_perc: float = 0.0
+    prefix_cache_hit_rate: float = 0.0
+    prefix_cache_hits: int = 0
+    prefix_cache_queries: int = 0
+    num_preemptions: int = 0
+    generation_tokens: int = 0
+    prompt_tokens: int = 0
+
+
+@dataclass
+class _RequestState:
+    request: Request
+    detok: IncrementalDetokenizer | None
+    text: str = ""
+    pending_text: str = ""
+
+
+class LLMEngine:
+    def __init__(
+        self,
+        config: EngineConfig,
+        params=None,
+        mesh=None,
+        tokenizer: TokenizerWrapper | None = None,
+    ):
+        self.config = config
+        self.tokenizer = tokenizer or TokenizerWrapper(
+            config.model.tokenizer or config.model.checkpoint
+        )
+        self.scheduler = Scheduler(config.model, config.cache, config.scheduler)
+        self.runner = ModelRunner(config, params=params, mesh=mesh)
+        self._states: dict[str, _RequestState] = {}
+        self._req_counter = itertools.count()
+        self._prompt_tokens = 0
+        self._generation_tokens = 0
+
+    # -- request lifecycle -------------------------------------------------
+
+    def add_request(
+        self,
+        request_id: str | None = None,
+        prompt: str | None = None,
+        prompt_token_ids: list[int] | None = None,
+        sampling: SamplingParams | None = None,
+    ) -> str:
+        request_id = request_id or f"req-{next(self._req_counter)}"
+        if prompt_token_ids is None:
+            if prompt is None:
+                raise ValueError("need prompt or prompt_token_ids")
+            prompt_token_ids = self.tokenizer.encode(prompt)
+        req = Request(
+            request_id=request_id,
+            prompt_token_ids=list(prompt_token_ids),
+            sampling=sampling or SamplingParams(),
+            eos_token_id=self.tokenizer.eos_token_id,
+        )
+        self.scheduler.add_request(req)
+        self._states[request_id] = _RequestState(
+            request=req, detok=IncrementalDetokenizer(self.tokenizer)
+        )
+        self._prompt_tokens += len(prompt_token_ids)
+        return request_id
+
+    def abort_request(self, request_id: str) -> bool:
+        req = self.scheduler.abort_request(request_id)
+        self._states.pop(request_id, None)
+        return req is not None
+
+    def has_unfinished(self) -> bool:
+        return self.scheduler.has_unfinished()
+
+    # -- stepping ----------------------------------------------------------
+
+    def step(self) -> list[RequestOutput]:
+        """Schedule + execute one device step; returns per-request deltas."""
+        work = self.scheduler.schedule()
+        outputs: list[RequestOutput] = []
+        # requests the scheduler terminated outside a step still need a
+        # terminal output or streaming clients would hang forever
+        for req in self.scheduler.take_finished_externally():
+            outputs.append(self._make_output(req, [], "", "abort"))
+        if work is None:
+            self._drop_finished(outputs)
+            return outputs
+        sampled = self.runner.execute(work)
+        results = self.scheduler.postprocess(work, sampled)
+
+        for req, tok in results:
+            if tok is None:  # mid-prompt prefill chunk: progress, no tokens
+                continue
+            self._generation_tokens += 1
+            if req.first_token_time is None:
+                req.first_token_time = time.monotonic()
+            state = self._states.get(req.request_id)
+            new_text = state.detok.push([tok]) if state and state.detok else ""
+
+            if state is not None and req.sampling.stop:
+                state.pending_text += new_text
+                hit = self._find_stop(state.pending_text, req.sampling.stop)
+                if hit is not None:
+                    emit = state.pending_text[:hit]
+                    state.text += emit
+                    state.pending_text = ""
+                    if not req.status.finished:
+                        self.scheduler.finish_request(
+                            req, RequestStatus.FINISHED_STOPPED
+                        )
+                    outputs.append(self._make_output(req, [tok], emit, "stop"))
+                    continue
+                if req.status.finished:  # eos/length: flush held-back text
+                    emit = state.pending_text
+                    state.text += emit
+                    state.pending_text = ""
+                else:  # hold back text that could be a stop-string prefix
+                    emit = self._emittable(state, req.sampling.stop)
+                outputs.append(
+                    self._make_output(req, [tok], emit, self._finish_reason(req))
+                )
+                continue
+
+            if state is not None:
+                state.text += new_text
+            outputs.append(
+                self._make_output(req, [tok], new_text, self._finish_reason(req))
+            )
+
+        self._drop_finished(outputs)
+        return outputs
+
+    def _drop_finished(self, outputs: list[RequestOutput]) -> None:
+        for out in outputs:
+            if out.finished:
+                self._states.pop(out.request_id, None)
+
+    def _make_output(
+        self, req: Request, toks: list[int], text: str, finish_reason: str | None
+    ) -> RequestOutput:
+        out = RequestOutput(
+            request_id=req.request_id,
+            new_token_ids=toks,
+            finished=req.status.finished,
+            finish_reason=finish_reason,
+            num_prompt_tokens=req.num_prompt_tokens,
+            num_output_tokens=len(req.output_token_ids),
+            num_cached_prompt_tokens=req.num_cached_prompt_tokens,
+        )
+        out.text_delta = text
+        return out
+
+    @staticmethod
+    def _finish_reason(req: Request) -> str | None:
+        return {
+            RequestStatus.FINISHED_STOPPED: "stop",
+            RequestStatus.FINISHED_LENGTH: "length",
+            RequestStatus.FINISHED_ABORTED: "abort",
+        }.get(req.status)
+
+    @staticmethod
+    def _find_stop(text: str, stops: tuple[str, ...]) -> int | None:
+        """Earliest match position across ALL stop strings (not first-in-tuple:
+        a later-listed stop can occur earlier in the stream)."""
+        best: int | None = None
+        for s in stops:
+            idx = text.find(s)
+            if idx != -1 and (best is None or idx < best):
+                best = idx
+        return best
+
+    @staticmethod
+    def _emittable(state: _RequestState, stops: tuple[str, ...]) -> str:
+        """Emit pending text minus the longest tail that prefixes a stop."""
+        pending = state.pending_text
+        hold = 0
+        for s in stops:
+            for k in range(min(len(s) - 1, len(pending)), 0, -1):
+                if s.startswith(pending[-k:]):
+                    hold = max(hold, k)
+                    break
+        emit = pending[: len(pending) - hold] if hold else pending
+        state.pending_text = pending[len(pending) - hold :] if hold else ""
+        state.text += emit
+        return emit
+
+    # -- convenience (offline / bench) ------------------------------------
+
+    def generate(
+        self, prompts: list[str] | list[list[int]], sampling: SamplingParams
+    ) -> list[dict]:
+        """Blocking batch generation; returns [{request_id, token_ids, text}]."""
+        ids = []
+        for p in prompts:
+            if isinstance(p, str):
+                ids.append(self.add_request(prompt=p, sampling=sampling))
+            else:
+                ids.append(self.add_request(prompt_token_ids=p, sampling=sampling))
+        done: dict[str, dict] = {
+            i: {"request_id": i, "token_ids": [], "text": ""} for i in ids
+        }
+        while self.has_unfinished():
+            for out in self.step():
+                d = done.get(out.request_id)
+                if d is None:
+                    continue
+                d["token_ids"].extend(out.new_token_ids)
+                d["text"] += out.text_delta
+                if out.finished:
+                    d["finish_reason"] = out.finish_reason
+        return [done[i] for i in ids]
+
+    # -- stats / control ---------------------------------------------------
+
+    def stats(self) -> EngineStatsSnapshot:
+        pool = self.scheduler.pool
+        return EngineStatsSnapshot(
+            num_requests_running=self.scheduler.num_running,
+            num_requests_waiting=self.scheduler.num_waiting,
+            kv_usage_perc=pool.usage_perc,
+            prefix_cache_hit_rate=pool.stats.hit_rate,
+            prefix_cache_hits=pool.stats.hits,
+            prefix_cache_queries=pool.stats.queries,
+            num_preemptions=self.scheduler.total_preemptions,
+            generation_tokens=self._generation_tokens,
+            prompt_tokens=self._prompt_tokens,
+        )
+
+    @property
+    def is_sleeping(self) -> bool:
+        return self.runner.is_sleeping
+
+    def sleep(self, level: int = 1) -> None:
+        if self.scheduler.has_unfinished():
+            raise RuntimeError("cannot sleep with unfinished requests")
+        self.runner.sleep(level)
+        # the device pool is dropped; its content-addressed hashes would
+        # otherwise match new requests against zeroed pages after wake
+        self.scheduler.pool.clear_prefix_cache()
+
+    def wake(self) -> None:
+        self.runner.wake()

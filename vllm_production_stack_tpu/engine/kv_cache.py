@@ -1,0 +1,174 @@
+"""HBM KV page pool: allocator + content-addressed prefix cache.
+
+The reference delegates paged-KV management to vLLM and exposes only its
+metrics (`vllm:gpu_cache_usage_perc`, `vllm:gpu_prefix_cache_*` — scraped by
+the router, src/vllm_router/stats/engine_stats.py:63-76). This module is the
+TPU engine's equivalent: host-side bookkeeping for the device-side paged pool
+(the actual pages live in one stacked jax.Array, models/llama.py
+init_kv_cache). Block 0 is the reserved null page (ops/attention.py).
+
+Prefix caching is content-addressed like vLLM's: a *full* block's identity is
+the rolling hash of (parent block hash, its tokens). Blocks whose refcount
+drops to zero are not returned to the free list immediately — they park in an
+LRU of evictable cached blocks, so a new request with a shared prefix can
+re-reference their KV without recompute. The hit/query counters back the
+`prefix_cache_hit_rate` metric contract.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+
+_ROOT_HASH = 0x9E3779B97F4A7C15
+
+
+def chain_hash(parent: int, tokens: tuple[int, ...]) -> int:
+    """Collision-resistant rolling block hash. Python's hash() would make
+    wrong-KV collisions constructible (even adversarially, in a multi-tenant
+    server); a truncated sha256 over parent||tokens removes that."""
+    h = hashlib.sha256(int(parent).to_bytes(16, "little", signed=False))
+    h.update(b"".join(int(t).to_bytes(8, "little", signed=True) for t in tokens))
+    return int.from_bytes(h.digest()[:16], "little")
+
+
+@dataclass
+class CacheStats:
+    queries: int = 0  # full prompt blocks looked up
+    hits: int = 0  # full prompt blocks served from cache
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hits / self.queries if self.queries else 0.0
+
+
+class KVBlockPool:
+    """Host-side accounting for the device page pool of ONE engine."""
+
+    def __init__(
+        self, num_blocks: int, block_size: int, enable_prefix_caching: bool = True
+    ):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is the null page)")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+        # block 0 reserved as the null page
+        self._free: deque[int] = deque(range(1, num_blocks))
+        self._ref: dict[int, int] = {}
+        # content-addressing maps (full, computed blocks only)
+        self._hash_to_block: dict[int, int] = {}
+        self._block_to_hash: dict[int, int] = {}
+        # refcount-0 cached blocks, LRU order (oldest first -> evicted first)
+        self._evictable: OrderedDict[int, None] = OrderedDict()
+        self.stats = CacheStats()
+
+    # -- capacity ----------------------------------------------------------
+
+    @property
+    def num_usable(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        """Blocks allocatable right now (free list + evictable cached)."""
+        return len(self._free) + len(self._evictable)
+
+    @property
+    def usage_perc(self) -> float:
+        """Fraction of pool actively referenced — the TPU analogue of
+        vllm:gpu_cache_usage_perc."""
+        return 1.0 - self.num_free / self.num_usable
+
+    # -- allocation --------------------------------------------------------
+
+    def allocate(self) -> int | None:
+        if self._free:
+            blk = self._free.popleft()
+        elif self._evictable:
+            blk, _ = self._evictable.popitem(last=False)
+            h = self._block_to_hash.pop(blk)
+            self._hash_to_block.pop(h, None)
+        else:
+            return None
+        self._ref[blk] = 1
+        return blk
+
+    def free_block(self, blk: int) -> None:
+        ref = self._ref.get(blk)
+        if ref is None:
+            raise KeyError(f"double free of block {blk}")
+        if ref > 1:
+            self._ref[blk] = ref - 1
+            return
+        del self._ref[blk]
+        if blk in self._block_to_hash:
+            self._evictable[blk] = None  # parked, content still addressable
+        else:
+            self._free.append(blk)
+
+    # -- prefix caching ----------------------------------------------------
+
+    def match_prefix(self, token_ids: list[int]) -> list[int]:
+        """Longest run of cached full blocks matching the prompt's head.
+        Acquires a reference on every matched block."""
+        matched: list[int] = []
+        if not self.enable_prefix_caching:
+            return matched
+        parent = _ROOT_HASH
+        n_full = len(token_ids) // self.block_size
+        for i in range(n_full):
+            self.stats.queries += 1
+            chunk = tuple(token_ids[i * self.block_size : (i + 1) * self.block_size])
+            h = chain_hash(parent, chunk)
+            blk = self._hash_to_block.get(h)
+            if blk is None:
+                break
+            self.stats.hits += 1
+            self._acquire(blk)
+            matched.append(blk)
+            parent = h
+        return matched
+
+    def _acquire(self, blk: int) -> None:
+        if blk in self._ref:
+            self._ref[blk] += 1
+        else:
+            self._ref[blk] = 1
+            self._evictable.pop(blk, None)
+
+    def register_full_block(
+        self, blk: int, parent_hash: int, tokens: tuple[int, ...]
+    ) -> int:
+        """Make a freshly computed full block content-addressable. Returns the
+        chain hash to use as the next block's parent."""
+        h = chain_hash(parent_hash, tokens)
+        if not self.enable_prefix_caching:
+            return h
+        if h not in self._hash_to_block:
+            self._hash_to_block[h] = blk
+            self._block_to_hash[blk] = h
+        return h
+
+    @staticmethod
+    def root_hash() -> int:
+        return _ROOT_HASH
+
+    def reset_stats(self) -> None:
+        self.stats = CacheStats()
+
+    def clear_prefix_cache(self) -> None:
+        """Drop all content-addressing state. MUST be called whenever the
+        device-side pool is reinitialized (sleep/wake, weight reload): the
+        hashes describe KV bytes that no longer exist, and serving a match
+        against a zeroed page would silently corrupt attention."""
+        if self._ref:
+            raise RuntimeError(
+                "cannot clear prefix cache while blocks are referenced"
+            )
+        self._hash_to_block.clear()
+        self._block_to_hash.clear()
+        for blk in self._evictable:
+            self._free.append(blk)
+        self._evictable.clear()

@@ -1,0 +1,261 @@
+"""Model runner: jitted, bucketed device steps over the paged pool.
+
+This is the layer the reference never has to build (its engines are external
+vLLM images). Responsibilities:
+
+- hold params + the device KV pool, sharded over the (dp, tp) mesh;
+- compile ONE prefill step per (chunk-bucket) and ONE decode step per
+  (batch-bucket) — static shapes so XLA compiles a handful of programs total
+  (SURVEY §7.3 hard part 1: shape bucketing vs recompilation);
+- fuse forward + logits + sampling into a single jit so the only per-step
+  host transfer is the sampled token ids;
+- donate the KV pool into each step so updates are in-place in HBM.
+
+Work items arrive as logical (unpadded) batches from the scheduler; padding
+rows write to the reserved null page (block 0) and their samples are dropped.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models import llama
+from ..parallel import mesh as mesh_lib
+from ..parallel.sharding import kv_cache_spec, llama_param_specs
+from .config import EngineConfig
+from .sampling import sample
+from .scheduler import DecodeWork, PrefillWork, ScheduleOutput
+
+logger = logging.getLogger(__name__)
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        config: EngineConfig,
+        params: Any | None = None,
+        mesh: jax.sharding.Mesh | None = None,
+    ):
+        self.config = config
+        cfg = config.model
+        self.mesh = mesh or mesh_lib.make_mesh(
+            config.parallel.tensor_parallel_size, config.parallel.data_parallel_size
+        )
+        self.max_blocks = config.cache.max_blocks_per_seq(cfg.max_model_len)
+
+        param_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), llama_param_specs(cfg)
+        )
+        if params is None:
+            logger.info("initializing random weights for %s", cfg.model)
+            # one compiled program materializing the whole tree directly into
+            # its sharded HBM layout (eager per-weight RNG dispatches are
+            # painfully slow through remote-device tunnels)
+            init_fn = jax.jit(
+                llama.init_params, static_argnums=0, out_shardings=param_shardings
+            )
+            self.params = init_fn(cfg, jax.random.PRNGKey(config.seed))
+        else:
+            self.params = jax.tree.map(jax.device_put, params, param_shardings)
+        kv_sharding = NamedSharding(self.mesh, kv_cache_spec())
+        self.kv_caches = jax.jit(
+            lambda: llama.init_kv_cache(
+                cfg, config.cache.num_blocks, config.cache.block_size
+            ),
+            out_shardings=kv_sharding,
+        )()
+        self._rng = jax.random.PRNGKey(config.seed ^ 0x5EED)
+        self._rep = NamedSharding(self.mesh, P())
+        self._step_fn = self._build_step_fn()
+        self._sleeping_params_host: Any | None = None
+
+    # -- compiled step -----------------------------------------------------
+
+    def _build_step_fn(self):
+        cfg = self.config.model
+
+        @functools.partial(jax.jit, donate_argnames=("kv_caches",))
+        def step_fn(
+            params,
+            kv_caches,
+            token_ids,  # (B, T)
+            positions,  # (B, T)
+            block_tables,  # (B, max_blocks)
+            slot_mapping,  # (B*T,)
+            context_lens,  # (B,)
+            sample_rows,  # (num_samples,) row index into (B*T) flat hidden
+            temperature,  # (num_samples,)
+            top_p,  # (num_samples,)
+            top_k,  # (num_samples,)
+            rng,
+            seeds,  # (num_samples,) int32
+            has_seed,  # (num_samples,) bool
+            counts,  # (num_samples,) int32 output tokens so far
+        ):
+            hidden, kv_caches = llama.forward(
+                cfg, params, token_ids, positions, kv_caches,
+                block_tables, slot_mapping, context_lens,
+            )
+            flat = hidden.reshape(-1, hidden.shape[-1])
+            picked = flat[sample_rows]  # (num_samples, h)
+            logits = llama.compute_logits(cfg, params, picked)
+            tokens = sample(
+                logits, temperature, top_p, top_k, rng, seeds, has_seed, counts
+            )
+            return kv_caches, tokens
+
+        return step_fn
+
+    # -- public API --------------------------------------------------------
+
+    def execute(self, work: ScheduleOutput) -> list[int]:
+        """Run one scheduled step; returns sampled tokens aligned with the
+        work item (prefill: [tok] if work.sample else []; decode: one per
+        request)."""
+        if isinstance(work, PrefillWork):
+            return self._execute_prefill(work)
+        return self._execute_decode(work)
+
+    def _execute_prefill(self, work: PrefillWork) -> list[int]:
+        sched = self.config.scheduler
+        t = len(work.token_ids)
+        t_pad = sched.bucket_for(t, sched.prefill_buckets)
+
+        token_ids = np.zeros((1, t_pad), np.int32)
+        token_ids[0, :t] = work.token_ids
+        positions = np.zeros((1, t_pad), np.int32)
+        positions[0, :t] = work.positions
+        slots = np.zeros(t_pad, np.int32)  # padding -> null page slots
+        slots[:t] = work.slot_mapping
+        block_tables = self._block_table_array([work.request.block_table])
+        context_lens = np.asarray([work.context_len], np.int32)
+        sample_rows = np.asarray([t - 1], np.int32)
+        s = work.request.sampling
+        tokens = self._run(
+            token_ids, positions, block_tables, slots, context_lens,
+            sample_rows, [s.temperature], [s.top_p], [s.top_k],
+            seeds=[s.seed], counts=[len(work.request.output_token_ids)],
+        )
+        return [int(tokens[0])] if work.sample else []
+
+    def _execute_decode(self, work: DecodeWork) -> list[int]:
+        sched = self.config.scheduler
+        b = len(work.requests)
+        b_pad = sched.bucket_for(b, sched.decode_buckets)
+
+        token_ids = np.zeros((b_pad, 1), np.int32)
+        token_ids[:b, 0] = work.token_ids
+        positions = np.zeros((b_pad, 1), np.int32)
+        positions[:b, 0] = work.positions
+        slots = np.zeros(b_pad, np.int32)
+        slots[:b] = work.slot_mapping
+        block_tables = self._block_table_array(
+            [r.block_table for r in work.requests], pad_to=b_pad
+        )
+        context_lens = np.zeros(b_pad, np.int32)
+        context_lens[:b] = work.context_lens
+        sample_rows = np.arange(b_pad, dtype=np.int32)  # row b*1+0 == b
+        temps = [r.sampling.temperature for r in work.requests] + [0.0] * (b_pad - b)
+        top_ps = [r.sampling.top_p for r in work.requests] + [1.0] * (b_pad - b)
+        top_ks = [r.sampling.top_k for r in work.requests] + [0] * (b_pad - b)
+        tokens = self._run(
+            token_ids, positions, block_tables, slots, context_lens,
+            sample_rows, temps, top_ps, top_ks,
+            seeds=[r.sampling.seed for r in work.requests] + [None] * (b_pad - b),
+            counts=[len(r.output_token_ids) for r in work.requests]
+            + [0] * (b_pad - b),
+        )
+        return [int(tokens[i]) for i in range(b)]
+
+    # -- helpers -----------------------------------------------------------
+
+    def _run(
+        self, token_ids, positions, block_tables, slots, context_lens,
+        sample_rows, temps, top_ps, top_ks, seeds, counts,
+    ):
+        if self._sleeping_params_host is not None:
+            raise RuntimeError("engine is sleeping; wake it before running")
+        self._rng, step_key = jax.random.split(self._rng)
+        has_seed = np.asarray([s is not None for s in seeds], bool)
+        # 64-bit user seeds (legal per the OpenAI API) fold down to uint32
+        seed_vals = np.asarray(
+            [(s or 0) & 0xFFFFFFFF for s in seeds], np.uint32
+        )
+        self.kv_caches, tokens = self._step_fn(
+            self.params,
+            self.kv_caches,
+            jnp.asarray(token_ids),
+            jnp.asarray(positions),
+            jnp.asarray(block_tables),
+            jnp.asarray(slots),
+            jnp.asarray(context_lens),
+            jnp.asarray(sample_rows),
+            jnp.asarray(np.asarray(temps, np.float32)),
+            jnp.asarray(np.asarray(top_ps, np.float32)),
+            jnp.asarray(np.asarray(top_ks, np.int32)),
+            step_key,
+            jnp.asarray(seed_vals),
+            jnp.asarray(has_seed),
+            jnp.asarray(np.asarray(counts, np.int32)),
+        )
+        return np.asarray(jax.device_get(tokens))
+
+    def _block_table_array(
+        self, tables: list[list[int]], pad_to: int | None = None
+    ) -> np.ndarray:
+        b = pad_to or len(tables)
+        arr = np.zeros((b, self.max_blocks), np.int32)  # 0 = null page
+        for i, tbl in enumerate(tables):
+            arr[i, : len(tbl)] = tbl
+        return arr
+
+    # -- sleep / wake (reference: router /sleep proxying, request.py:434-510;
+    #    vLLM sleep levels; SURVEY §7.3 hard part 3) ------------------------
+
+    @property
+    def is_sleeping(self) -> bool:
+        return self._sleeping_params_host is not None
+
+    def sleep(self, level: int = 1) -> None:
+        """Park the engine: move weights to host RAM (level 1) or drop them
+        (level 2 — wake() re-inits from config), freeing HBM."""
+        if self.is_sleeping:
+            return
+        if level >= 2:
+            self._sleeping_params_host = "discarded"
+        else:
+            self._sleeping_params_host = jax.device_get(self.params)
+        self.params = None
+        # drop the KV pool too; sleeping engines are drained by the router
+        self.kv_caches = None
+
+    def wake(self) -> None:
+        if not self.is_sleeping:
+            return
+        cfg = self.config
+        param_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), llama_param_specs(cfg.model)
+        )
+        if isinstance(self._sleeping_params_host, str):  # discarded
+            init_fn = jax.jit(
+                llama.init_params, static_argnums=0, out_shardings=param_shardings
+            )
+            self.params = init_fn(cfg.model, jax.random.PRNGKey(cfg.seed))
+        else:
+            self.params = jax.tree.map(
+                jax.device_put, self._sleeping_params_host, param_shardings
+            )
+        self.kv_caches = jax.jit(
+            lambda: llama.init_kv_cache(
+                cfg.model, cfg.cache.num_blocks, cfg.cache.block_size
+            ),
+            out_shardings=NamedSharding(self.mesh, kv_cache_spec()),
+        )()
+        self._sleeping_params_host = None

@@ -1,0 +1,116 @@
+"""Request/sequence state for the serving engine.
+
+The reference stack's requests live as OpenAI JSON bodies proxied to vLLM
+(src/vllm_router/services/request_service/request.py); inside our TPU engine
+each becomes a `Request` tracked by the scheduler through the continuous-
+batching lifecycle.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    max_tokens: int = 128
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    stop: tuple[str, ...] = ()
+    stop_token_ids: tuple[int, ...] = ()
+    ignore_eos: bool = False
+    seed: int | None = None
+    logprobs: int | None = None
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+class RequestStatus(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    PREEMPTED = "preempted"
+    FINISHED_STOPPED = "finished_stopped"  # eos / stop string
+    FINISHED_LENGTH = "finished_length"  # max_tokens / max_model_len
+    FINISHED_ABORTED = "finished_aborted"
+
+    @property
+    def finished(self) -> bool:
+        return self in (
+            RequestStatus.FINISHED_STOPPED,
+            RequestStatus.FINISHED_LENGTH,
+            RequestStatus.FINISHED_ABORTED,
+        )
+
+
+@dataclass(eq=False)  # identity semantics: requests live in sets/queues
+class Request:
+    request_id: str
+    prompt_token_ids: list[int]
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    eos_token_id: int | None = None
+    arrival_time: float = field(default_factory=time.monotonic)
+
+    status: RequestStatus = RequestStatus.WAITING
+    output_token_ids: list[int] = field(default_factory=list)
+    # blocks owned by this request, logical order (block_table[i] = page of
+    # tokens [i*block_size, (i+1)*block_size))
+    block_table: list[int] = field(default_factory=list)
+    # tokens whose KV is resident (prefix-cache hits + computed prefill/decode)
+    num_computed_tokens: int = 0
+    num_cached_prompt_tokens: int = 0  # prefix-cache hits at admission
+    first_token_time: float | None = None
+    finish_time: float | None = None
+    num_preemptions: int = 0
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_token_ids) + len(self.output_token_ids)
+
+    @property
+    def all_token_ids(self) -> list[int]:
+        return self.prompt_token_ids + self.output_token_ids
+
+    @property
+    def prefill_target(self) -> int:
+        """Tokens whose KV must be resident before decode can run. For a fresh
+        request that's the whole prompt; for a preempted-then-resumed request
+        (which already has outputs to recompute) it's everything except the
+        last token — that one is the next decode step's input."""
+        if self.output_token_ids:
+            return self.num_tokens - 1
+        return self.num_prompt_tokens
+
+    @property
+    def prefill_done(self) -> bool:
+        return self.num_computed_tokens >= self.prefill_target
+
+    def token_at(self, idx: int) -> int:
+        np_ = len(self.prompt_token_ids)
+        return (
+            self.prompt_token_ids[idx]
+            if idx < np_
+            else self.output_token_ids[idx - np_]
+        )
+
+
+@dataclass
+class RequestOutput:
+    """Per-step incremental output handed to the API layer."""
+
+    request_id: str
+    new_token_ids: list[int]
+    finished: bool
+    finish_reason: str | None = None  # "stop" | "length" | "abort"
+    num_prompt_tokens: int = 0
+    num_output_tokens: int = 0
+    num_cached_prompt_tokens: int = 0
+    text_delta: str = ""

@@ -1,0 +1,71 @@
+"""Batched on-device sampling: greedy / temperature / top-k / top-p.
+
+Everything is fixed-shape and branch-free (where-masks instead of Python
+control flow) so it fuses into the jitted decode step — the sampled token ids
+are the only per-step device→host transfer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _row_keys(
+    base_key: jax.Array,
+    seeds: jax.Array,  # (B,) uint32, meaningful where has_seed
+    has_seed: jax.Array,  # (B,) bool
+    counts: jax.Array,  # (B,) int32 tokens generated so far by that request
+) -> jax.Array:
+    """Per-row PRNG keys. Seeded rows depend ONLY on (seed, count) so a
+    request with an explicit seed reproduces its sample stream regardless of
+    batching, preemption, or engine uptime; unseeded rows derive from the
+    advancing step key."""
+
+    def one(seed, has, count, row):
+        seeded = jax.random.fold_in(jax.random.PRNGKey(seed), count)
+        unseeded = jax.random.fold_in(base_key, row)
+        return jnp.where(has, seeded, unseeded)
+
+    b = seeds.shape[0]
+    return jax.vmap(one)(seeds, has_seed, counts, jnp.arange(b, dtype=jnp.int32))
+
+
+def sample(
+    logits: jax.Array,  # (B, V) float32
+    temperature: jax.Array,  # (B,) 0.0 = greedy
+    top_p: jax.Array,  # (B,) 1.0 = disabled
+    top_k: jax.Array,  # (B,) int32, 0 = disabled
+    base_key: jax.Array,
+    seeds: jax.Array,  # (B,) int32
+    has_seed: jax.Array,  # (B,) bool
+    counts: jax.Array,  # (B,) int32
+) -> jax.Array:
+    """Returns sampled token ids (B,) int32."""
+    b, v = logits.shape
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    sorted_desc = -jnp.sort(-scaled, axis=-1)  # (B, V) descending
+
+    # top-k threshold: the k-th largest logit (k=0 -> keep all)
+    k = jnp.where(top_k > 0, top_k, v).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # (B,1)
+
+    # top-p threshold: smallest logit whose *exclusive* cumulative prob < p
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum_excl = jnp.cumsum(probs, axis=-1) - probs
+    keep = cum_excl < top_p[:, None]
+    num_keep = jnp.maximum(jnp.sum(keep, axis=-1), 1)
+    pth = jnp.take_along_axis(sorted_desc, (num_keep - 1)[:, None], axis=-1)
+
+    thresh = jnp.maximum(kth, pth)
+    masked = jnp.where(scaled >= thresh, scaled, NEG_INF)
+
+    keys = _row_keys(base_key, seeds, has_seed, counts)
+    gumbel = jax.vmap(lambda k: jax.random.gumbel(k, (v,), jnp.float32))(keys)
+    sampled_tok = jnp.argmax(masked + gumbel, axis=-1).astype(jnp.int32)
+
+    return jnp.where(temperature == 0.0, greedy_tok, sampled_tok)

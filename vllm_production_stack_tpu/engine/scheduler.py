@@ -1,0 +1,345 @@
+"""Continuous-batching scheduler with chunked prefill and preemption.
+
+The reference's engines run vLLM's scheduler (external, controlled via flags
+like --enable-chunked-prefill, helm deployment-vllm-multi.yaml:140-146); this
+is the TPU engine's own: it emits fixed-*logical* work items (one prefill
+chunk, or one decode batch) which the model runner pads into bucketed device
+shapes. Policy: decode and prefill alternate when both are pending, so a long
+prompt can't stall token generation (the point of chunked prefill) and decode
+can't starve admissions.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from .config import CacheConfig, ModelConfig, SchedulerConfig
+from .kv_cache import KVBlockPool, chain_hash
+from .request import Request, RequestStatus
+
+
+@dataclass
+class PrefillWork:
+    """One chunk of one request's prompt. `sample` is set when the chunk
+    reaches the end of the prompt (its last-token logits produce the first
+    output token)."""
+
+    request: Request
+    token_ids: list[int]
+    positions: list[int]
+    slot_mapping: list[int]
+    context_len: int
+    sample: bool
+
+
+@dataclass
+class DecodeWork:
+    """One decode token for each request in the batch."""
+
+    requests: list[Request]
+    token_ids: list[int] = field(default_factory=list)  # token fed per request
+    positions: list[int] = field(default_factory=list)
+    slot_mapping: list[int] = field(default_factory=list)
+    context_lens: list[int] = field(default_factory=list)
+
+
+ScheduleOutput = PrefillWork | DecodeWork
+
+
+class Scheduler:
+    def __init__(
+        self,
+        model_config: ModelConfig,
+        cache_config: CacheConfig,
+        scheduler_config: SchedulerConfig,
+    ):
+        self.model_config = model_config
+        self.cache_config = cache_config
+        self.config = scheduler_config
+        self.block_size = cache_config.block_size
+        self.pool = KVBlockPool(
+            cache_config.num_blocks,
+            cache_config.block_size,
+            cache_config.enable_prefix_caching,
+        )
+        self.waiting: deque[Request] = deque()
+        self.running: list[Request] = []
+        self._hash_chains: dict[str, list[int]] = {}  # req id -> per-block hashes
+        self._last_was_prefill = False
+        self.total_preemptions = 0
+        # requests finished outside a step (e.g. resumed request that outgrew
+        # the pool) — the engine drains these to emit terminal outputs
+        self._finished_externally: list[Request] = []
+
+    # -- admission ---------------------------------------------------------
+
+    def add_request(self, req: Request) -> None:
+        if req.num_prompt_tokens >= self.model_config.max_model_len:
+            raise ValueError(
+                f"prompt of {req.num_prompt_tokens} tokens exceeds "
+                f"max_model_len={self.model_config.max_model_len}"
+            )
+        if self._blocks_needed(req.num_prompt_tokens + 1) > self.pool.num_usable:
+            raise ValueError(
+                f"prompt of {req.num_prompt_tokens} tokens cannot fit the KV "
+                f"pool ({self.pool.num_usable} blocks of {self.block_size})"
+            )
+        req.status = RequestStatus.WAITING
+        self.waiting.append(req)
+
+    def abort_request(self, request_id: str) -> Request | None:
+        for q in (self.running, self.waiting):
+            for req in q:
+                if req.request_id == request_id:
+                    q.remove(req)
+                    self._finish(req, RequestStatus.FINISHED_ABORTED)
+                    return req
+        return None
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting) + sum(
+            1 for r in self.running if not r.prefill_done
+        )
+
+    @property
+    def num_running(self) -> int:
+        return sum(1 for r in self.running if r.prefill_done)
+
+    def has_unfinished(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- scheduling --------------------------------------------------------
+
+    def schedule(self) -> ScheduleOutput | None:
+        decode_ready = [r for r in self.running if r.prefill_done]
+        prefilling = [r for r in self.running if not r.prefill_done]
+        can_admit = bool(self.waiting) and len(self.running) < self.config.max_num_seqs
+
+        want_prefill = bool(prefilling) or can_admit
+        if want_prefill and (not decode_ready or not self._last_was_prefill):
+            work = self._schedule_prefill(prefilling)
+            if work is not None:
+                self._last_was_prefill = True
+                return work
+        if decode_ready:
+            work = self._schedule_decode(decode_ready)
+            if work is not None:
+                self._last_was_prefill = False
+                return work
+        return None
+
+    def _schedule_prefill(self, prefilling: list[Request]) -> PrefillWork | None:
+        req = None
+        if prefilling:
+            req = prefilling[0]
+        elif self.waiting:
+            req = self.waiting[0]
+            if not self._can_admit(req):
+                return None
+            self.waiting.popleft()
+            self._admit(req)
+            req.status = RequestStatus.RUNNING
+            self.running.append(req)
+        if req is None:
+            return None
+
+        target = req.prefill_target
+        chunk = min(
+            self.config.max_num_batched_tokens, target - req.num_computed_tokens
+        )
+        if not self._ensure_blocks(req, req.num_computed_tokens + chunk):
+            return None
+        start = req.num_computed_tokens
+        idxs = range(start, start + chunk)
+        work = PrefillWork(
+            request=req,
+            token_ids=[req.token_at(i) for i in idxs],
+            positions=list(idxs),
+            slot_mapping=[self._slot(req, i) for i in idxs],
+            context_len=start + chunk,
+            # sample only when this chunk completes a *fresh* prompt; resumed
+            # requests already know their next token
+            sample=start + chunk == target and not req.output_token_ids,
+        )
+        return work
+
+    def _schedule_decode(self, ready: list[Request]) -> DecodeWork | None:
+        picked: list[Request] = []
+        for req in ready[: self.config.max_num_seqs]:
+            if req not in self.running:
+                continue  # preempted while building this batch
+            if not self._ensure_blocks(req, req.num_computed_tokens + 1):
+                continue  # req preempted itself; others may still decode
+            picked.append(req)
+        # a later _ensure_blocks may have preempted an earlier pick
+        picked = [r for r in picked if r in self.running]
+        if not picked:
+            return None
+        batch = DecodeWork(requests=picked)
+        for req in picked:
+            pos = req.num_computed_tokens
+            batch.token_ids.append(req.token_at(pos))
+            batch.positions.append(pos)
+            batch.slot_mapping.append(self._slot(req, pos))
+            batch.context_lens.append(pos + 1)
+        return batch
+
+    # -- memory ------------------------------------------------------------
+
+    def _blocks_needed(self, num_tokens: int) -> int:
+        return (num_tokens + self.block_size - 1) // self.block_size
+
+    def _can_admit(self, req: Request) -> bool:
+        """Admission watermark: only admit when the pool can hold the whole
+        recompute target plus one decode token — prevents admission/preemption
+        thrash (the oldest running request must always be able to finish)."""
+        need = self._blocks_needed(req.prefill_target + 1)
+        if need > self.pool.num_usable:
+            # can never fit (e.g. resumed request outgrew the pool)
+            self.waiting.remove(req)
+            self._finish(req, RequestStatus.FINISHED_ABORTED)
+            self._finished_externally.append(req)
+            return False
+        return self.pool.num_free >= need
+
+    def take_finished_externally(self) -> list[Request]:
+        out, self._finished_externally = self._finished_externally, []
+        return out
+
+    def _admit(self, req: Request) -> None:
+        """Prefix-cache lookup for a waiting (possibly resumed) request.
+        The matchable sequence is everything that will be recomputed."""
+        seq = req.all_token_ids
+        matched = self.pool.match_prefix(seq)
+        # keep at least one token to actually compute (its logits / its KV
+        # write are what the next step needs)
+        while matched and len(matched) * self.block_size >= req.prefill_target:
+            self.pool.free_block(matched.pop())
+        req.block_table = matched
+        req.num_computed_tokens = len(matched) * self.block_size
+        req.num_cached_prompt_tokens = min(
+            req.num_computed_tokens, req.num_prompt_tokens
+        )
+        chain = [self.pool.root_hash()]
+        for i in range(len(matched)):
+            chunk = tuple(seq[i * self.block_size : (i + 1) * self.block_size])
+            chain.append(chain_hash(chain[-1], chunk))
+        self._hash_chains[req.request_id] = chain
+
+    def _ensure_blocks(self, req: Request, num_tokens: int) -> bool:
+        """Grow req's block table to cover num_tokens. On pool exhaustion the
+        NEWEST running request is preempted — possibly req itself (returns
+        False, req is back in waiting) — so the oldest request always makes
+        forward progress and the system can't livelock."""
+        need = self._blocks_needed(num_tokens)
+        while len(req.block_table) < need:
+            blk = self.pool.allocate()
+            if blk is None:
+                if not self.running:
+                    return False
+                victim = self.running[-1]  # newest admission loses
+                self._preempt(victim)
+                if victim is req:
+                    return False
+                continue
+            req.block_table.append(blk)
+        return True
+
+    def _preempt(self, req: Request) -> None:
+        self.running.remove(req)
+        self._release_blocks(req)
+        req.num_computed_tokens = 0
+        req.num_preemptions += 1
+        self.total_preemptions += 1
+        req.status = RequestStatus.PREEMPTED
+        self.waiting.appendleft(req)
+
+    def _release_blocks(self, req: Request) -> None:
+        # tail-first so deep prefix blocks stay hottest in the LRU
+        for blk in reversed(req.block_table):
+            self.pool.free_block(blk)
+        req.block_table = []
+        self._hash_chains.pop(req.request_id, None)
+
+    def _slot(self, req: Request, token_idx: int) -> int:
+        blk = req.block_table[token_idx // self.block_size]
+        return blk * self.block_size + token_idx % self.block_size
+
+    # -- post-step ---------------------------------------------------------
+
+    def postprocess(
+        self, work: ScheduleOutput, sampled: list[int]
+    ) -> list[tuple[Request, int | None]]:
+        """Apply one step's results. Returns [(request, new_token or None)]
+        for every request the step advanced (token None = prefill chunk that
+        didn't finish the prompt)."""
+        results: list[tuple[Request, int | None]] = []
+        if isinstance(work, PrefillWork):
+            req = work.request
+            start = req.num_computed_tokens
+            req.num_computed_tokens = work.context_len
+            self._register_full_blocks(req, start, work.context_len)
+            if work.sample:
+                tok = sampled[0]
+                req.output_token_ids.append(tok)
+                self._maybe_finish(req)
+                results.append((req, tok))
+            else:
+                results.append((req, None))
+        else:
+            for req, tok in zip(work.requests, sampled):
+                start = req.num_computed_tokens
+                req.num_computed_tokens += 1
+                self._register_full_blocks(req, start, req.num_computed_tokens)
+                req.output_token_ids.append(tok)
+                self._maybe_finish(req)
+                results.append((req, tok))
+        return results
+
+    def _register_full_blocks(self, req: Request, start: int, end: int) -> None:
+        chain = self._hash_chains.setdefault(
+            req.request_id, [self.pool.root_hash()]
+        )
+        first_new = start // self.block_size
+        last_full = end // self.block_size  # blocks [0, last_full) are full
+        for i in range(first_new, last_full):
+            if i + 1 < len(chain):
+                continue  # already registered (cached prefix)
+            tokens = tuple(
+                req.token_at(j)
+                for j in range(i * self.block_size, (i + 1) * self.block_size)
+            )
+            h = self.pool.register_full_block(req.block_table[i], chain[i], tokens)
+            chain.append(h)
+
+    def _maybe_finish(self, req: Request) -> None:
+        s = req.sampling
+        last = req.output_token_ids[-1]
+        if not s.ignore_eos and req.eos_token_id is not None and last == req.eos_token_id:
+            status = RequestStatus.FINISHED_STOPPED
+        elif last in s.stop_token_ids:
+            status = RequestStatus.FINISHED_STOPPED
+        elif len(req.output_token_ids) >= s.max_tokens:
+            status = RequestStatus.FINISHED_LENGTH
+        elif req.num_tokens >= self.model_config.max_model_len:
+            status = RequestStatus.FINISHED_LENGTH
+        else:
+            return
+        self.running.remove(req)
+        self._finish(req, status)
+
+    def finish_request(self, req: Request, status: RequestStatus) -> None:
+        """Externally finish a running request (e.g. stop-string hit found by
+        the engine's detokenizer)."""
+        if req in self.running:
+            self.running.remove(req)
+        self._finish(req, status)
+
+    def _finish(self, req: Request, status: RequestStatus) -> None:
+        import time
+
+        req.status = status
+        req.finish_time = time.monotonic()
+        self._release_blocks(req)

@@ -1,0 +1,111 @@
+"""Tokenizer wrapper: HF AutoTokenizer when a local checkpoint/tokenizer path
+is configured, byte-level fallback otherwise (zero-egress environments and
+tests can't download vocabularies)."""
+
+from __future__ import annotations
+
+
+class ByteTokenizer:
+    """256 byte tokens + BOS/EOS/PAD. Deterministic, dependency-free."""
+
+    BOS = 256
+    EOS = 257
+    PAD = 258
+    vocab_size = 259
+
+    bos_token_id = BOS
+    eos_token_id = EOS
+    pad_token_id = PAD
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return [self.BOS] + ids if add_special_tokens else ids
+
+    def decode(self, ids: list[int], skip_special_tokens: bool = True) -> str:
+        data = bytes(i for i in ids if i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True, **_
+    ) -> str:
+        parts = [f"<|{m['role']}|>\n{_content_text(m)}\n" for m in messages]
+        if add_generation_prompt:
+            parts.append("<|assistant|>\n")
+        return "".join(parts)
+
+
+def _content_text(message: dict) -> str:
+    content = message.get("content", "")
+    if isinstance(content, list):  # multimodal parts; keep the text ones
+        return "".join(
+            p.get("text", "") for p in content if isinstance(p, dict)
+        )
+    return content or ""
+
+
+class TokenizerWrapper:
+    """Uniform interface over HF tokenizers and the byte fallback, with
+    incremental detokenization for streaming."""
+
+    def __init__(self, tokenizer_path: str | None = None):
+        if tokenizer_path:
+            from transformers import AutoTokenizer
+
+            self._tok = AutoTokenizer.from_pretrained(tokenizer_path)
+        else:
+            self._tok = ByteTokenizer()
+
+    @property
+    def eos_token_id(self) -> int | None:
+        return getattr(self._tok, "eos_token_id", None)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def chat_prompt(self, messages: list[dict]) -> str:
+        try:
+            out = self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True
+            )
+            if isinstance(out, str):
+                return out
+        except Exception:
+            pass
+        return ByteTokenizer().apply_chat_template(messages)
+
+
+class IncrementalDetokenizer:
+    """Streams text deltas from a growing token-id list, holding back bytes
+    that may be a partial multi-byte character / merged token.
+
+    Offset-window scheme (per-push cost bounded by the held-back tail, not the
+    full output): only ids[prefix_offset:] are ever re-decoded; once a stable
+    delta is emitted the window advances."""
+
+    def __init__(self, tokenizer: TokenizerWrapper):
+        self._tok = tokenizer
+        self._ids: list[int] = []
+        self._prefix_offset = 0  # start of the re-decode window
+        self._read_offset = 0  # ids before this are already emitted
+        self._emitted = ""
+
+    def push(self, token_ids: list[int]) -> str:
+        self._ids.extend(token_ids)
+        prefix = self._tok.decode(self._ids[self._prefix_offset : self._read_offset])
+        full = self._tok.decode(self._ids[self._prefix_offset :])
+        if full.endswith("�"):  # partial utf-8 tail; wait for more tokens
+            return ""
+        if len(full) <= len(prefix):
+            return ""
+        delta = full[len(prefix) :]
+        self._prefix_offset = self._read_offset
+        self._read_offset = len(self._ids)
+        self._emitted += delta
+        return delta
+
+    @property
+    def text(self) -> str:
+        return self._emitted
