@@ -1,0 +1,304 @@
+"""Engine OpenAI server tests (aiohttp TestClient over a tiny CPU engine)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vllm_production_stack_tpu.engine.config import EngineConfig
+from vllm_production_stack_tpu.engine.engine import LLMEngine
+from vllm_production_stack_tpu.engine.server import EngineServer
+
+
+@pytest.fixture(scope="module")
+def srv():
+    engine = LLMEngine(EngineConfig.tiny())
+    return EngineServer(engine, served_model_name="tiny-llama")
+
+
+def run_with_client(srv, coro_fn):
+    async def runner():
+        client = TestClient(TestServer(srv.build_app()))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def test_models_and_health_and_version(srv):
+    async def go(client):
+        r = await client.get("/v1/models")
+        models = await r.json()
+        h = await (await client.get("/health")).json()
+        v = await (await client.get("/version")).json()
+        return r.status, models, h, v
+
+    status, models, health, version = run_with_client(srv, go)
+    assert status == 200
+    assert models["data"][0]["id"] == "tiny-llama"
+    assert health["status"] == "ok"
+    assert "version" in version
+
+
+def test_chat_completion(srv):
+    async def go(client):
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny-llama",
+                "messages": [{"role": "user", "content": "hi there"}],
+                "max_tokens": 5,
+                "temperature": 0.0,
+            },
+        )
+        return r.status, await r.json()
+
+    status, body = run_with_client(srv, go)
+    assert status == 200
+    assert body["object"] == "chat.completion"
+    assert body["choices"][0]["finish_reason"] == "length"
+    assert body["usage"]["completion_tokens"] == 5
+    assert body["usage"]["prompt_tokens"] > 0
+
+
+def test_chat_completion_streaming(srv):
+    async def go(client):
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny-llama",
+                "messages": [{"role": "user", "content": "stream me"}],
+                "max_tokens": 4,
+                "temperature": 0.0,
+                "stream": True,
+                "stream_options": {"include_usage": True},
+            },
+        )
+        raw = await r.text()
+        return r.status, r.headers, raw
+
+    status, headers, raw = run_with_client(srv, go)
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/event-stream")
+    lines = [l for l in raw.split("\n\n") if l.startswith("data: ")]
+    assert lines[-1] == "data: [DONE]"
+    chunks = [json.loads(l[len("data: "):]) for l in lines[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    finishes = [
+        c["choices"][0].get("finish_reason") for c in chunks if c["choices"]
+    ]
+    assert "length" in finishes
+    assert chunks[-1]["usage"]["completion_tokens"] == 4
+
+
+def test_completions_with_token_ids(srv):
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={
+                "model": "tiny-llama",
+                "prompt": [5, 6, 7, 8],
+                "max_tokens": 3,
+                "temperature": 0.0,
+            },
+        )
+        return r.status, await r.json()
+
+    status, body = run_with_client(srv, go)
+    assert status == 200
+    assert body["object"] == "text_completion"
+    assert body["usage"]["prompt_tokens"] == 4
+    assert body["usage"]["completion_tokens"] == 3
+
+
+def test_metrics_contract(srv):
+    from vllm_production_stack_tpu import metrics_contract as mc
+
+    async def go(client):
+        # generate something first so counters move
+        await client.post(
+            "/v1/completions",
+            json={"model": "m", "prompt": [1, 2, 3], "max_tokens": 2},
+        )
+        return await (await client.get("/metrics")).text()
+
+    text = run_with_client(srv, go)
+    for name in (
+        mc.NUM_REQUESTS_RUNNING,
+        mc.HBM_KV_USAGE_PERC,
+        mc.PREFIX_CACHE_HIT_RATE,
+        mc.GENERATION_TOKENS,
+    ):
+        assert name in text, f"metric {name} missing from /metrics"
+    assert 'model_name="tiny-llama"' in text
+
+
+def test_sleep_wake_cycle(srv):
+    async def go(client):
+        s1 = await (await client.post("/sleep?level=1")).json()
+        asleep = await (await client.get("/is_sleeping")).json()
+        s2 = await (await client.post("/wake_up")).json()
+        awake = await (await client.get("/is_sleeping")).json()
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "m", "prompt": [1, 2, 3], "max_tokens": 2},
+        )
+        return s1, asleep, s2, awake, r.status
+
+    s1, asleep, s2, awake, status = run_with_client(srv, go)
+    assert s1["status"] == "sleeping" and asleep["is_sleeping"] is True
+    assert s2["status"] == "awake" and awake["is_sleeping"] is False
+    assert status == 200
+
+
+def test_lora_endpoints(srv):
+    async def go(client):
+        r1 = await client.post(
+            "/v1/load_lora_adapter",
+            json={"lora_name": "my-adapter", "lora_path": "/tmp/adapter"},
+        )
+        models = await (await client.get("/v1/models")).json()
+        r2 = await client.post(
+            "/v1/unload_lora_adapter", json={"lora_name": "my-adapter"}
+        )
+        r3 = await client.post(
+            "/v1/unload_lora_adapter", json={"lora_name": "my-adapter"}
+        )
+        return r1.status, models, r2.status, r3.status
+
+    s1, models, s2, s3 = run_with_client(srv, go)
+    assert s1 == 200 and s2 == 200 and s3 == 404
+    ids = [m["id"] for m in models["data"]]
+    assert "my-adapter" in ids
+
+
+def test_tokenize_detokenize(srv):
+    async def go(client):
+        t = await (
+            await client.post("/tokenize", json={"prompt": "hello"})
+        ).json()
+        d = await (
+            await client.post("/detokenize", json={"tokens": t["tokens"]})
+        ).json()
+        return t, d
+
+    t, d = run_with_client(srv, go)
+    assert t["count"] == len(t["tokens"]) > 0
+    assert "hello" in d["prompt"]
+
+
+def test_request_while_sleeping_rejected_and_engine_survives(srv):
+    async def go(client):
+        await client.post("/sleep?level=1")
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "m", "prompt": [1, 2, 3], "max_tokens": 2},
+        )
+        rejected = r.status
+        h1 = (await client.get("/health")).status
+        await client.post("/wake_up")
+        r2 = await client.post(
+            "/v1/completions",
+            json={"model": "m", "prompt": [1, 2, 3], "max_tokens": 2},
+        )
+        return rejected, h1, r2.status
+
+    rejected, health_status, after_wake = run_with_client(srv, go)
+    assert rejected == 503
+    assert health_status == 200  # step thread must NOT die
+    assert after_wake == 200
+
+
+def test_bad_requests(srv):
+    async def go(client):
+        r1 = await client.post("/v1/chat/completions", json={"model": "m"})
+        r2 = await client.post(
+            "/v1/chat/completions",
+            json={"model": "m", "messages": [{"role": "user", "content": "x"}],
+                  "n": 3},
+        )
+        return r1.status, r2.status
+
+    s1, s2 = run_with_client(srv, go)
+    assert s1 == 400 and s2 == 400
+
+
+def test_streaming_too_long_prompt_gets_error_event(srv):
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={
+                "model": "m",
+                "prompt": list(range(1, 400)),  # > tiny max_model_len (256)
+                "max_tokens": 2,
+                "stream": True,
+            },
+        )
+        return r.status, await r.text()
+
+    status, raw = run_with_client(srv, go)
+    assert status == 200  # headers already sent; error travels as an event
+    assert '"error"' in raw and raw.rstrip().endswith("data: [DONE]")
+
+
+def test_duplicate_request_id_no_collision(srv):
+    async def go(client):
+        payload = {
+            "model": "m", "prompt": [1, 2, 3, 4], "max_tokens": 12,
+            "temperature": 0.0,
+        }
+        h = {"X-Request-Id": "same-id"}
+        r1, r2 = await asyncio.gather(
+            client.post("/v1/completions", json=payload, headers=h),
+            client.post("/v1/completions", json=payload, headers=h),
+        )
+        b1, b2 = await r1.json(), await r2.json()
+        return r1.status, r2.status, b1, b2
+
+    s1, s2, b1, b2 = run_with_client(srv, go)
+    assert s1 == 200 and s2 == 200
+    assert b1["usage"]["completion_tokens"] == 12
+    assert b2["usage"]["completion_tokens"] == 12
+
+
+def test_disconnect_aborts_engine_request(srv):
+    engine = srv.engine
+
+    async def go(client):
+        resp = await client.post(
+            "/v1/completions",
+            json={
+                "model": "m", "prompt": [9, 8, 7], "max_tokens": 5000,
+                "stream": True,
+            },
+        )
+        await resp.content.readline()  # ensure generation started
+        resp.close()  # client walks away
+        for _ in range(200):
+            await asyncio.sleep(0.05)
+            if not engine.has_unfinished():
+                return True
+        return False
+
+    assert run_with_client(srv, go) is True
+
+
+def test_lora_model_request_501(srv):
+    async def go(client):
+        await client.post(
+            "/v1/load_lora_adapter",
+            json={"lora_name": "ad1", "lora_path": "/tmp/x"},
+        )
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "ad1",
+                  "messages": [{"role": "user", "content": "x"}]},
+        )
+        await client.post("/v1/unload_lora_adapter", json={"lora_name": "ad1"})
+        return r.status
+
+    assert run_with_client(srv, go) == 501

@@ -1,0 +1,209 @@
+"""Async bridge over the synchronous LLMEngine for the HTTP server.
+
+A dedicated step thread drives the device (JAX dispatch must not block the
+event loop — a single TPU step is milliseconds-to-tens-of-ms of host work);
+per-request asyncio queues carry outputs back to handler coroutines. This is
+the TPU stack's analogue of vLLM's AsyncLLMEngine, which the reference stack
+always talks to over HTTP (request.py:99-105).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from collections.abc import AsyncIterator
+
+from .engine import LLMEngine
+from .request import RequestOutput, SamplingParams
+
+logger = logging.getLogger(__name__)
+
+
+class EngineSleepingError(RuntimeError):
+    """Request submitted while the engine is parked (router should have
+    filtered this endpoint out via the sleeping label — discovery contract,
+    reference service_discovery.py:414-496)."""
+
+
+class AsyncEngine:
+    def __init__(self, engine: LLMEngine):
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._queues: dict[str, asyncio.Queue[RequestOutput]] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        self._step_error: Exception | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, loop: asyncio.AbstractEventLoop) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            self.shutdown()  # restartable (server rebuilt around one engine)
+        self._loop = loop
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._step_loop, name="engine-step", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def is_healthy(self) -> bool:
+        return (
+            self._thread is not None
+            and self._thread.is_alive()
+            and self._step_error is None
+        )
+
+    def _step_loop(self) -> None:
+        while not self._stop:
+            try:
+                with self._lock:
+                    has_work = (
+                        not self.engine.is_sleeping and self.engine.has_unfinished()
+                    )
+                    outputs = self.engine.step() if has_work else []
+            except Exception as e:  # surface to /health, fail queued requests
+                logger.exception("engine step failed")
+                self._step_error = e
+                self._fail_all(e)
+                return
+            for out in outputs:
+                self._dispatch(out)
+            if not has_work:
+                self._wake.wait(timeout=0.02)
+                self._wake.clear()
+
+    def _dispatch(self, out: RequestOutput) -> None:
+        q = self._queues.get(out.request_id)
+        if q is None or self._loop is None:
+            return
+        self._loop.call_soon_threadsafe(q.put_nowait, out)
+
+    def _fail_all(self, exc: Exception) -> None:
+        if self._loop is None:
+            return
+        for rid, q in list(self._queues.items()):
+            out = RequestOutput(
+                request_id=rid, new_token_ids=[], finished=True,
+                finish_reason="error",
+            )
+            out.text_delta = f"engine error: {exc}"
+            self._loop.call_soon_threadsafe(q.put_nowait, out)
+
+    # -- serving API -------------------------------------------------------
+
+    def _submit(
+        self, request_id, prompt, prompt_token_ids, sampling, q
+    ) -> str:
+        """Runs in an executor: the step thread may hold the lock for a full
+        device step (or a 10-40s first compile) — never block the event loop
+        on it."""
+        with self._lock:
+            if self.engine.is_sleeping:
+                raise EngineSleepingError(
+                    "engine is sleeping; wake it before sending requests"
+                )
+            if request_id is not None and (
+                request_id in self._queues or self.engine.has_request(request_id)
+            ):
+                # client-supplied ids (X-Request-Id) must not collide with an
+                # in-flight request: colliding ids would cross-wire output
+                # queues and prefix-cache hash chains
+                request_id = f"{request_id}-{id(q) & 0xFFFFFF:x}"
+            rid = self.engine.add_request(
+                request_id=request_id,
+                prompt=prompt,
+                prompt_token_ids=prompt_token_ids,
+                sampling=sampling,
+            )
+            self._queues[rid] = q
+        self._wake.set()
+        return rid
+
+    async def generate(
+        self,
+        prompt: str | None = None,
+        prompt_token_ids: list[int] | None = None,
+        sampling: SamplingParams | None = None,
+        request_id: str | None = None,
+    ) -> AsyncIterator[RequestOutput]:
+        """Submit a request and yield its incremental outputs."""
+        if self._step_error is not None:
+            raise RuntimeError(f"engine is dead: {self._step_error}")
+        q: asyncio.Queue[RequestOutput] = asyncio.Queue()
+        loop = asyncio.get_running_loop()
+        rid = await loop.run_in_executor(
+            None, self._submit, request_id, prompt, prompt_token_ids, sampling, q
+        )
+        finished = False
+        try:
+            while True:
+                out = await q.get()
+                yield out
+                if out.finished:
+                    finished = True
+                    return
+        finally:
+            self._queues.pop(rid, None)
+            if not finished:
+                # consumer went away (disconnect/cancel): reap the engine-side
+                # request or it would decode to max_tokens holding KV blocks
+                loop.run_in_executor(None, self._abort_sync, rid)
+
+    def _abort_sync(self, request_id: str) -> bool:
+        with self._lock:
+            return self.engine.abort_request(request_id)
+
+    async def abort(self, request_id: str) -> bool:
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self._abort_sync, request_id
+        )
+        self._queues.pop(request_id, None)
+        return ok
+
+    # -- control -----------------------------------------------------------
+
+    async def stats_async(self):
+        return await asyncio.get_running_loop().run_in_executor(None, self.stats)
+
+    def stats(self):
+        with self._lock:
+            return self.engine.stats()
+
+    def tokenize(self, text: str) -> list[int]:
+        return self.engine.tokenizer.encode(text)
+
+    def detokenize(self, ids: list[int]) -> str:
+        return self.engine.tokenizer.decode(ids)
+
+    def chat_prompt(self, messages: list[dict]) -> str:
+        return self.engine.tokenizer.chat_prompt(messages)
+
+    @property
+    def is_sleeping(self) -> bool:
+        return self.engine.is_sleeping
+
+    def sleep(self, level: int = 1) -> None:
+        deadline = time.monotonic() + 30.0
+        while True:
+            with self._lock:
+                if not self.engine.scheduler.has_unfinished():
+                    self.engine.sleep(level)
+                    return
+            if time.monotonic() > deadline:
+                raise RuntimeError("engine busy; cannot sleep")
+            time.sleep(0.05)
+
+    def wake(self) -> None:
+        with self._lock:
+            self.engine.wake()

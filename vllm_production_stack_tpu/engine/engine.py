@@ -97,6 +97,9 @@ class LLMEngine:
         self._states.pop(request_id, None)
         return req is not None
 
+    def has_request(self, request_id: str) -> bool:
+        return request_id in self._states
+
     def has_unfinished(self) -> bool:
         return self.scheduler.has_unfinished()
 

@@ -1,0 +1,72 @@
+"""Engine /metrics exporter (Prometheus text format).
+
+Exports the TPU metric contract (metrics_contract.py) the router scraper and
+the observability stack consume — the HBM equivalent of the vLLM names the
+reference scrapes (engine_stats.py:63-76). Names keep the `tpu:` prefix
+(colons are valid Prometheus metric name characters, same convention as
+vLLM's `vllm:` metrics)."""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, generate_latest
+
+from .. import metrics_contract as mc
+from .engine import EngineStatsSnapshot
+
+
+class EngineMetrics:
+    def __init__(self, model_name: str):
+        self.registry = CollectorRegistry()
+        self._labels = {"model_name": model_name}
+        names = list(self._labels)
+
+        def gauge(name: str, doc: str) -> Gauge:
+            return Gauge(name, doc, names, registry=self.registry)
+
+        def counter(name: str, doc: str) -> Counter:
+            # prometheus_client re-appends _total to counter names
+            base = name[: -len("_total")] if name.endswith("_total") else name
+            return Counter(base, doc, names, registry=self.registry)
+
+        self.num_running = gauge(
+            mc.NUM_REQUESTS_RUNNING, "Requests currently decoding"
+        )
+        self.num_waiting = gauge(
+            mc.NUM_REQUESTS_WAITING, "Requests queued or prefilling"
+        )
+        self.kv_usage = gauge(
+            mc.HBM_KV_USAGE_PERC, "Fraction of HBM KV pages in active use"
+        )
+        self.prefix_hit_rate = gauge(
+            mc.PREFIX_CACHE_HIT_RATE, "Prefix cache block hit rate"
+        )
+        self.prefix_hits = counter(mc.PREFIX_CACHE_HITS, "Prefix cache block hits")
+        self.prefix_queries = counter(
+            mc.PREFIX_CACHE_QUERIES, "Prefix cache block queries"
+        )
+        self.preemptions = counter(mc.NUM_PREEMPTIONS, "Scheduler preemptions")
+        self.prompt_tokens = counter(mc.PROMPT_TOKENS, "Prompt tokens processed")
+        self.generation_tokens = counter(mc.GENERATION_TOKENS, "Tokens generated")
+        self._counter_values: dict[str, int] = {}
+
+    def update(self, s: EngineStatsSnapshot) -> None:
+        lb = self._labels
+        self.num_running.labels(**lb).set(s.num_requests_running)
+        self.num_waiting.labels(**lb).set(s.num_requests_waiting)
+        self.kv_usage.labels(**lb).set(s.kv_usage_perc)
+        self.prefix_hit_rate.labels(**lb).set(s.prefix_cache_hit_rate)
+        self._bump(self.prefix_hits, "hits", s.prefix_cache_hits)
+        self._bump(self.prefix_queries, "queries", s.prefix_cache_queries)
+        self._bump(self.preemptions, "preempt", s.num_preemptions)
+        self._bump(self.prompt_tokens, "prompt", s.prompt_tokens)
+        self._bump(self.generation_tokens, "gen", s.generation_tokens)
+
+    def _bump(self, counter: Counter, key: str, total: int) -> None:
+        prev = self._counter_values.get(key, 0)
+        if total > prev:
+            counter.labels(**self._labels).inc(total - prev)
+            self._counter_values[key] = total
+
+    def render(self, s: EngineStatsSnapshot) -> bytes:
+        self.update(s)
+        return generate_latest(self.registry)

@@ -53,6 +53,7 @@ class ModelRunner:
         param_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), llama_param_specs(cfg)
         )
+        self._random_weights = params is None
         if params is None:
             logger.info("initializing random weights for %s", cfg.model)
             # one compiled program materializing the whole tree directly into
@@ -228,6 +229,13 @@ class ModelRunner:
         (level 2 — wake() re-inits from config), freeing HBM."""
         if self.is_sleeping:
             return
+        if level >= 2 and not self._random_weights:
+            # level 2 re-inits on wake; with loaded checkpoints that would
+            # silently swap trained weights for random ones
+            raise RuntimeError(
+                "sleep level 2 requires re-initializable weights; use level 1 "
+                "for checkpoint-loaded models"
+            )
         if level >= 2:
             self._sleeping_params_host = "discarded"
         else:

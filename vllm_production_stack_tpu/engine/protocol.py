@@ -1,0 +1,131 @@
+"""OpenAI-compatible API schema (pydantic) for the engine server.
+
+Mirrors the surface the reference stack proxies to its vLLM engines
+(src/vllm_router/routers/main_router.py:50-246): chat completions,
+completions, models. Extra fields are tolerated and ignored (the reference's
+protocols.py logs-and-allows extras too)."""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from .request import SamplingParams
+
+
+class OpenAIModel(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+
+class ChatMessage(OpenAIModel):
+    role: str
+    content: str | list | None = None
+
+
+class StreamOptions(OpenAIModel):
+    include_usage: bool = False
+
+
+class ChatCompletionRequest(OpenAIModel):
+    model: str
+    messages: list[ChatMessage]
+    max_tokens: int | None = None
+    max_completion_tokens: int | None = None
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # extension (vLLM-compatible)
+    n: int = 1
+    stream: bool = False
+    stream_options: StreamOptions | None = None
+    stop: str | list[str] | None = None
+    seed: int | None = None
+    user: str | None = None
+    ignore_eos: bool = False  # extension (benchmark harnesses rely on it)
+
+    def sampling(self, default_max_tokens: int) -> SamplingParams:
+        stop = self.stop if self.stop is not None else []
+        if isinstance(stop, str):
+            stop = [stop]
+        return SamplingParams(
+            max_tokens=self.max_completion_tokens
+            or self.max_tokens
+            or default_max_tokens,
+            temperature=self.temperature,
+            top_p=self.top_p,
+            top_k=self.top_k,
+            stop=tuple(stop),
+            seed=self.seed,
+            ignore_eos=self.ignore_eos,
+        )
+
+
+class CompletionRequest(OpenAIModel):
+    model: str
+    prompt: str | list[str] | list[int] | list[list[int]]
+    max_tokens: int | None = None
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+    n: int = 1
+    stream: bool = False
+    stream_options: StreamOptions | None = None
+    stop: str | list[str] | None = None
+    seed: int | None = None
+    echo: bool = False
+    user: str | None = None
+    ignore_eos: bool = False
+
+    def sampling(self, default_max_tokens: int) -> SamplingParams:
+        stop = self.stop if self.stop is not None else []
+        if isinstance(stop, str):
+            stop = [stop]
+        return SamplingParams(
+            max_tokens=self.max_tokens or default_max_tokens,
+            temperature=self.temperature,
+            top_p=self.top_p,
+            top_k=self.top_k,
+            stop=tuple(stop),
+            seed=self.seed,
+            ignore_eos=self.ignore_eos,
+        )
+
+
+class UsageInfo(OpenAIModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+def usage(prompt_tokens: int, completion_tokens: int) -> dict:
+    return UsageInfo(
+        prompt_tokens=prompt_tokens,
+        completion_tokens=completion_tokens,
+        total_tokens=prompt_tokens + completion_tokens,
+    ).model_dump()
+
+
+class ModelCard(OpenAIModel):
+    id: str
+    object: str = "model"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    owned_by: str = "tpu-serving-stack"
+    root: str | None = None
+    parent: str | None = None
+
+
+class ModelList(OpenAIModel):
+    object: str = "list"
+    data: list[ModelCard] = Field(default_factory=list)
+
+
+class ErrorResponse(OpenAIModel):
+    object: str = "error"
+    message: str
+    type: str = "invalid_request_error"
+    code: int = 400
+
+
+def random_id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex[:24]}"

@@ -1,0 +1,427 @@
+"""OpenAI-compatible HTTP server for the TPU engine (aiohttp.web).
+
+Implements the exact surface the reference stack's router and operator expect
+from an engine pod (SURVEY §7.1): /v1/chat/completions, /v1/completions,
+/v1/models, /metrics, /health, /sleep, /wake_up, /is_sleeping,
+/v1/load_lora_adapter, /v1/unload_lora_adapter, /tokenize, /detokenize,
+/version (main_router.py:50-246; service_discovery.py model scrape;
+loraadapter_controller.go:582-611).
+
+Run: python -m vllm_production_stack_tpu.engine.server --model tiny-llama
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import time
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from .. import __version__
+from ..models.registry import resolve_model_config
+from ..utils.logging import init_logger
+from .async_engine import AsyncEngine, EngineSleepingError
+from .config import CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig
+from .engine import LLMEngine
+from .metrics import EngineMetrics
+from .protocol import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    ErrorResponse,
+    ModelCard,
+    ModelList,
+    random_id,
+    usage,
+)
+
+logger = init_logger(__name__)
+
+DEFAULT_MAX_TOKENS = 256
+
+
+def error(status: int, message: str, type_: str = "invalid_request_error"):
+    return web.json_response(
+        ErrorResponse(message=message, type=type_, code=status).model_dump(),
+        status=status,
+    )
+
+
+class EngineServer:
+    def __init__(self, engine: LLMEngine, served_model_name: str | None = None):
+        self.engine = engine
+        self.async_engine = AsyncEngine(engine)
+        self.model_name = served_model_name or engine.config.model.model
+        self.metrics = EngineMetrics(self.model_name)
+        # adapter name -> source path; surfaced in /v1/models like vLLM does
+        self.lora_adapters: dict[str, str] = {}
+        self._start_time = time.time()
+
+    # -- app wiring --------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        r = app.router
+        r.add_post("/v1/chat/completions", self.chat_completions)
+        r.add_post("/v1/completions", self.completions)
+        r.add_get("/v1/models", self.list_models)
+        r.add_get("/health", self.health)
+        r.add_get("/metrics", self.metrics_endpoint)
+        r.add_post("/sleep", self.sleep)
+        r.add_post("/wake_up", self.wake_up)
+        r.add_get("/is_sleeping", self.is_sleeping)
+        r.add_post("/v1/load_lora_adapter", self.load_lora_adapter)
+        r.add_post("/v1/unload_lora_adapter", self.unload_lora_adapter)
+        r.add_post("/tokenize", self.tokenize)
+        r.add_post("/detokenize", self.detokenize)
+        r.add_get("/version", self.version)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app: web.Application) -> None:
+        self.async_engine.start(asyncio.get_running_loop())
+
+    async def _on_cleanup(self, app: web.Application) -> None:
+        self.async_engine.shutdown()
+
+    # -- inference routes --------------------------------------------------
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = ChatCompletionRequest.model_validate(await request.json())
+        except (ValidationError, json.JSONDecodeError) as e:
+            return error(400, f"invalid request: {e}")
+        if body.n != 1:
+            return error(400, "n>1 is not supported")
+        if body.model in self.lora_adapters:
+            return error(
+                501,
+                f"adapter '{body.model}' is registered but adapter inference "
+                "is not implemented yet",
+                "not_implemented",
+            )
+        prompt = self.async_engine.chat_prompt(
+            [m.model_dump() for m in body.messages]
+        )
+        sampling = body.sampling(DEFAULT_MAX_TOKENS)
+        rid = request.headers.get("X-Request-Id") or random_id("chatcmpl")
+        if body.stream:
+            return await self._stream(
+                request, rid, prompt, sampling, body, chat=True
+            )
+        return await self._complete(rid, prompt, sampling, chat=True)
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = CompletionRequest.model_validate(await request.json())
+        except (ValidationError, json.JSONDecodeError) as e:
+            return error(400, f"invalid request: {e}")
+        if body.n != 1:
+            return error(400, "n>1 is not supported")
+        if body.model in self.lora_adapters:
+            return error(
+                501,
+                f"adapter '{body.model}' is registered but adapter inference "
+                "is not implemented yet",
+                "not_implemented",
+            )
+        prompt, prompt_ids = self._resolve_prompt(body.prompt)
+        if prompt is None and prompt_ids is None:
+            return error(400, "batched prompts are not supported yet")
+        sampling = body.sampling(DEFAULT_MAX_TOKENS)
+        rid = request.headers.get("X-Request-Id") or random_id("cmpl")
+        if body.stream:
+            return await self._stream(
+                request, rid, prompt, sampling, body, chat=False,
+                prompt_ids=prompt_ids,
+            )
+        return await self._complete(
+            rid, prompt, sampling, chat=False, prompt_ids=prompt_ids
+        )
+
+    @staticmethod
+    def _resolve_prompt(prompt) -> tuple[str | None, list[int] | None]:
+        if isinstance(prompt, str):
+            return prompt, None
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            return None, prompt
+        if (
+            isinstance(prompt, list)
+            and len(prompt) == 1
+            and isinstance(prompt[0], str)
+        ):
+            return prompt[0], None
+        return None, None
+
+    async def _complete(
+        self, rid, prompt, sampling, *, chat: bool, prompt_ids=None
+    ) -> web.Response:
+        text = ""
+        token_ids: list[int] = []
+        finish_reason = None
+        n_prompt = 0
+        try:
+            async for out in self.async_engine.generate(
+                prompt=prompt, prompt_token_ids=prompt_ids,
+                sampling=sampling, request_id=rid,
+            ):
+                text += out.text_delta
+                token_ids.extend(out.new_token_ids)
+                finish_reason = out.finish_reason
+                n_prompt = out.num_prompt_tokens
+        except ValueError as e:
+            return error(400, str(e))
+        except EngineSleepingError as e:
+            return error(503, str(e), "service_unavailable")
+        except RuntimeError as e:
+            return error(500, str(e), "internal_error")
+        if finish_reason == "error":
+            return error(500, text, "internal_error")
+        created = int(time.time())
+        if chat:
+            choice = {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": finish_reason,
+            }
+            obj = "chat.completion"
+        else:
+            choice = {"index": 0, "text": text, "finish_reason": finish_reason}
+            obj = "text_completion"
+        return web.json_response(
+            {
+                "id": rid,
+                "object": obj,
+                "created": created,
+                "model": self.model_name,
+                "choices": [choice],
+                "usage": usage(n_prompt, len(token_ids)),
+            }
+        )
+
+    async def _stream(
+        self, request, rid, prompt, sampling, body, *, chat: bool, prompt_ids=None
+    ) -> web.StreamResponse:
+        if self.async_engine.is_sleeping:
+            return error(503, "engine is sleeping", "service_unavailable")
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Request-Id": rid,
+            },
+        )
+        await resp.prepare(request)
+        created = int(time.time())
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        include_usage = bool(body.stream_options and body.stream_options.include_usage)
+        n_prompt = n_out = 0
+
+        async def send(payload: dict) -> None:
+            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+
+        if chat:  # role preamble chunk
+            await send(self._chunk(rid, obj, created, {"role": "assistant"}, None))
+        try:
+            async for out in self.async_engine.generate(
+                prompt=prompt, prompt_token_ids=prompt_ids,
+                sampling=sampling, request_id=rid,
+            ):
+                n_prompt = out.num_prompt_tokens
+                n_out = out.num_output_tokens
+                if out.finish_reason == "error":
+                    await send({"error": {"message": out.text_delta}})
+                    break
+                if out.text_delta or out.finished:
+                    delta = (
+                        {"content": out.text_delta}
+                        if chat
+                        else out.text_delta
+                    )
+                    await send(
+                        self._chunk(
+                            rid, obj, created, delta,
+                            out.finish_reason if out.finished else None,
+                        )
+                    )
+        except ConnectionResetError:
+            await self.async_engine.abort(rid)
+            return resp
+        except (ValueError, RuntimeError) as e:
+            # invalid prompt (too long) or raced into sleep/death after the
+            # SSE headers went out: deliver the error as an event, then DONE
+            await send({"error": {"message": str(e)}})
+        if include_usage:
+            final = self._chunk(rid, obj, created, None, None)
+            final["choices"] = []
+            final["usage"] = usage(n_prompt, n_out)
+            await send(final)
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    def _chunk(self, rid, obj, created, delta, finish_reason) -> dict:
+        if obj == "chat.completion.chunk":
+            choice = {
+                "index": 0,
+                "delta": delta if delta is not None else {},
+                "finish_reason": finish_reason,
+            }
+        else:
+            choice = {
+                "index": 0,
+                "text": delta if isinstance(delta, str) else "",
+                "finish_reason": finish_reason,
+            }
+        return {
+            "id": rid,
+            "object": obj,
+            "created": created,
+            "model": self.model_name,
+            "choices": [choice],
+        }
+
+    # -- discovery / control routes ---------------------------------------
+
+    async def list_models(self, request: web.Request) -> web.Response:
+        cards = [ModelCard(id=self.model_name)]
+        cards += [
+            ModelCard(id=name, parent=self.model_name, root=path)
+            for name, path in self.lora_adapters.items()
+        ]
+        return web.json_response(ModelList(data=cards).model_dump())
+
+    async def health(self, request: web.Request) -> web.Response:
+        if not self.async_engine.is_healthy:
+            return web.json_response({"status": "dead"}, status=503)
+        return web.json_response({"status": "ok"})
+
+    async def metrics_endpoint(self, request: web.Request) -> web.Response:
+        payload = self.metrics.render(await self.async_engine.stats_async())
+        return web.Response(body=payload, content_type="text/plain")
+
+    async def sleep(self, request: web.Request) -> web.Response:
+        level = int(request.query.get("level", "1"))
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.async_engine.sleep, level
+            )
+        except RuntimeError as e:
+            return error(409, str(e), "conflict")
+        return web.json_response({"status": "sleeping", "level": level})
+
+    async def wake_up(self, request: web.Request) -> web.Response:
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.async_engine.wake
+        )
+        return web.json_response({"status": "awake"})
+
+    async def is_sleeping(self, request: web.Request) -> web.Response:
+        return web.json_response({"is_sleeping": self.async_engine.is_sleeping})
+
+    async def load_lora_adapter(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        path = body.get("lora_path")
+        if not name or not path:
+            return error(400, "lora_name and lora_path are required")
+        self.lora_adapters[name] = path
+        logger.info("registered LoRA adapter %s from %s", name, path)
+        return web.json_response({"status": "ok"})
+
+    async def unload_lora_adapter(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        if name not in self.lora_adapters:
+            return error(404, f"adapter {name} not loaded", "not_found_error")
+        del self.lora_adapters[name]
+        return web.json_response({"status": "ok"})
+
+    async def tokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        ids = self.async_engine.tokenize(body.get("prompt", ""))
+        return web.json_response({"tokens": ids, "count": len(ids)})
+
+    async def detokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        text = self.async_engine.detokenize(body.get("tokens", []))
+        return web.json_response({"prompt": text})
+
+    async def version(self, request: web.Request) -> web.Response:
+        return web.json_response({"version": __version__})
+
+
+# -- CLI -------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU LLM serving engine")
+    p.add_argument("--model", default="tiny-llama",
+                   help="preset name or local HF checkpoint dir")
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--max-model-len", type=int, default=None)
+    p.add_argument("--dtype", default=None, choices=[None, "bfloat16", "float32"])
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-blocks", type=int, default=512,
+                   help="HBM KV pages in the pool")
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--max-num-batched-tokens", type=int, default=512)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--enable-prefix-caching", action="store_true", default=True)
+    p.add_argument("--no-enable-prefix-caching", dest="enable_prefix_caching",
+                   action="store_false")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
+    model_cfg = resolve_model_config(args.model, args.max_model_len, args.dtype)
+    decode_buckets = tuple(
+        b for b in (8, 16, 32, 64, 128, 256) if b <= args.max_num_seqs
+    ) or (args.max_num_seqs,)
+    if decode_buckets[-1] < args.max_num_seqs:
+        decode_buckets += (args.max_num_seqs,)
+    prefill_buckets = tuple(
+        b for b in (64, 128, 256, 512, 1024, 2048)
+        if b <= args.max_num_batched_tokens
+    ) or (args.max_num_batched_tokens,)
+    if prefill_buckets[-1] < args.max_num_batched_tokens:
+        prefill_buckets += (args.max_num_batched_tokens,)
+    return EngineConfig(
+        model=model_cfg,
+        cache=CacheConfig(
+            block_size=args.block_size,
+            num_blocks=args.num_blocks,
+            enable_prefix_caching=args.enable_prefix_caching,
+        ),
+        scheduler=SchedulerConfig(
+            max_num_seqs=args.max_num_seqs,
+            max_num_batched_tokens=args.max_num_batched_tokens,
+            decode_buckets=decode_buckets,
+            prefill_buckets=prefill_buckets,
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=args.tensor_parallel_size),
+        seed=args.seed,
+    )
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_parser().parse_args(argv)
+    config = engine_config_from_args(args)
+    logger.info("starting engine for model=%s on %s:%d",
+                args.model, args.host, args.port)
+    engine = LLMEngine(config)
+    server = EngineServer(engine, served_model_name=args.served_model_name)
+    web.run_app(server.build_app(), host=args.host, port=args.port,
+                access_log=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""The engine↔router metrics contract.
+
+The reference router scrapes vLLM metric names from each engine's /metrics
+(`vllm:num_requests_running`, `vllm:gpu_cache_usage_perc`,
+`vllm:gpu_prefix_cache_hit_rate`, ... — src/vllm_router/stats/
+engine_stats.py:63-76) and the observability stack / KEDA autoscaling key off
+them (observability/prom-adapter.yaml:19-31). This module is the single
+source of truth for the TPU equivalents: HBM paged-KV metrics instead of GPU
+KV metrics. Both the engine exporter (engine/metrics.py) and the router
+scraper (router/stats/engine_stats.py) import these names.
+"""
+
+# gauges
+NUM_REQUESTS_RUNNING = "tpu:num_requests_running"
+NUM_REQUESTS_WAITING = "tpu:num_requests_waiting"
+HBM_KV_USAGE_PERC = "tpu:hbm_kv_usage_perc"
+PREFIX_CACHE_HIT_RATE = "tpu:hbm_prefix_cache_hit_rate"
+
+# counters
+PREFIX_CACHE_HITS = "tpu:hbm_prefix_cache_hits_total"
+PREFIX_CACHE_QUERIES = "tpu:hbm_prefix_cache_queries_total"
+NUM_PREEMPTIONS = "tpu:num_preemptions_total"
+PROMPT_TOKENS = "tpu:prompt_tokens_total"
+GENERATION_TOKENS = "tpu:generation_tokens_total"
+
+ALL_GAUGES = (
+    NUM_REQUESTS_RUNNING,
+    NUM_REQUESTS_WAITING,
+    HBM_KV_USAGE_PERC,
+    PREFIX_CACHE_HIT_RATE,
+)
+ALL_COUNTERS = (
+    PREFIX_CACHE_HITS,
+    PREFIX_CACHE_QUERIES,
+    NUM_PREEMPTIONS,
+    PROMPT_TOKENS,
+    GENERATION_TOKENS,
+)

@@ -1,0 +1,108 @@
+"""Model name/path resolution to ModelConfig.
+
+Named presets cover the baseline configs (BASELINE.md: opt-125m-class tiny
+models for CI, Llama-3-8B for the headline benchmark, Llama-3-70B for
+pipeline parallel); a local directory with an HF config.json is parsed
+directly (zero-egress environments can't download)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..engine.config import ModelConfig
+
+# Architecture hyperparameters follow the public model cards.
+PRESETS: dict[str, dict] = {
+    "tiny-llama": dict(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, max_model_len=256,
+        dtype="float32",
+    ),
+    # CI-class small model (stands in for facebook/opt-125m in the reference's
+    # minikube tests, tests/e2e/run-k8s-routing-test.sh)
+    "debug-125m": dict(
+        vocab_size=32000, hidden_size=768, intermediate_size=2048,
+        num_layers=12, num_heads=12, num_kv_heads=12, head_dim=64,
+        max_model_len=2048, rope_theta=10000.0,
+    ),
+    "llama-1b": dict(
+        vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+        num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+        max_model_len=8192, rope_theta=500000.0,
+    ),
+    "llama-3-8b": dict(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        max_model_len=8192, rope_theta=500000.0,
+    ),
+    "llama-3-70b": dict(
+        vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+        num_layers=80, num_heads=64, num_kv_heads=8, head_dim=128,
+        max_model_len=8192, rope_theta=500000.0,
+    ),
+    "qwen2-7b": dict(
+        vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+        num_layers=28, num_heads=28, num_kv_heads=4, head_dim=128,
+        max_model_len=8192, rope_theta=1000000.0, attention_bias=True,
+        architecture="qwen2",
+    ),
+}
+
+_ARCH_MAP = {
+    "LlamaForCausalLM": "llama",
+    "MistralForCausalLM": "llama",
+    "Qwen2ForCausalLM": "qwen2",
+}
+
+
+def resolve_model_config(
+    model: str,
+    max_model_len: int | None = None,
+    dtype: str | None = None,
+) -> ModelConfig:
+    """model: a preset name, or a local HF checkpoint dir (config.json)."""
+    if model in PRESETS:
+        kw = dict(PRESETS[model])
+        kw["model"] = model
+    elif os.path.isdir(model) and os.path.exists(os.path.join(model, "config.json")):
+        kw = _from_hf_config(model)
+    else:
+        raise ValueError(
+            f"unknown model '{model}': not a preset "
+            f"({', '.join(PRESETS)}) and not a local checkpoint dir"
+        )
+    if max_model_len is not None:
+        kw["max_model_len"] = max_model_len
+    if dtype is not None:
+        kw["dtype"] = dtype
+    kw.setdefault("dtype", "bfloat16")
+    return ModelConfig(**kw)
+
+
+def _from_hf_config(path: str) -> dict:
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    archs = hf.get("architectures", [])
+    arch = next((_ARCH_MAP[a] for a in archs if a in _ARCH_MAP), None)
+    if arch is None:
+        raise ValueError(f"unsupported architecture(s) {archs} in {path}")
+    heads = hf["num_attention_heads"]
+    return dict(
+        model=path,
+        architecture=arch,
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=heads,
+        num_kv_heads=hf.get("num_key_value_heads", heads),
+        head_dim=hf.get("head_dim", hf["hidden_size"] // heads),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        max_model_len=hf.get("max_position_embeddings", 4096),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        attention_bias=hf.get("attention_bias", arch == "qwen2"),
+        checkpoint=path,
+        tokenizer=path,
+    )
