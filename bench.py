@@ -1,0 +1,89 @@
+"""Headline benchmark: offline serving throughput of the TPU engine.
+
+Runs the flagship Llama-class engine (llama-1b preset, bf16, random weights —
+zero-egress container) on the real chip: 16 concurrent requests, 128-token
+prompts, 128 greedy output tokens each, continuous batching with chunked
+prefill over the paged HBM KV pool.
+
+Prints ONE JSON line: generation throughput in tok/s. vs_baseline is measured
+against 500 tok/s — the per-engine emission rate the reference stack uses in
+its router perf rig (src/tests/perftest/fake-openai-server.py; the repo
+publishes no absolute engine numbers, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_TOK_S = 500.0
+
+
+def main() -> None:
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+    from vllm_production_stack_tpu.models.registry import resolve_model_config
+
+    n_seqs, prompt_len, gen_len = 16, 128, 128
+    model_cfg = resolve_model_config("llama-1b", max_model_len=1024,
+                                     dtype="bfloat16")
+    config = EngineConfig(
+        model=model_cfg,
+        cache=CacheConfig(block_size=16, num_blocks=400),
+        scheduler=SchedulerConfig(
+            max_num_seqs=n_seqs,
+            max_num_batched_tokens=prompt_len,
+            decode_buckets=(n_seqs,),
+            prefill_buckets=(prompt_len,),
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=1),
+    )
+    engine = LLMEngine(config)
+    sampling = SamplingParams(max_tokens=gen_len, temperature=0.0)
+
+    def make_prompts(seed0: int) -> list[list[int]]:
+        return [
+            list(
+                np.random.RandomState(seed0 + i).randint(
+                    1, model_cfg.vocab_size, size=prompt_len
+                )
+            )
+            for i in range(n_seqs)
+        ]
+
+    # warmup: compile the prefill/decode buckets
+    engine.generate(
+        make_prompts(10_000),
+        SamplingParams(max_tokens=4, temperature=0.0),
+    )
+
+    t0 = time.perf_counter()
+    outs = engine.generate(make_prompts(0), sampling)
+    elapsed = time.perf_counter() - t0
+
+    gen_tokens = sum(len(o["token_ids"]) for o in outs)
+    assert gen_tokens == n_seqs * gen_len, (gen_tokens, n_seqs * gen_len)
+    tok_s = gen_tokens / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": "engine_generation_throughput",
+                "value": round(tok_s, 1),
+                "unit": "tok/s",
+                "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

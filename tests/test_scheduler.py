@@ -14,7 +14,9 @@ from vllm_production_stack_tpu.engine.scheduler import (
 )
 
 
-def make_scheduler(num_blocks=16, block_size=4, max_batched=8, max_seqs=4):
+def make_scheduler(
+    num_blocks=16, block_size=4, max_batched=8, max_seqs=4, window=1
+):
     return Scheduler(
         ModelConfig.tiny(max_model_len=128),
         CacheConfig(
@@ -25,6 +27,7 @@ def make_scheduler(num_blocks=16, block_size=4, max_batched=8, max_seqs=4):
             max_num_batched_tokens=max_batched,
             decode_buckets=(max_seqs,),
             prefill_buckets=(max_batched,),
+            decode_window=window,
         ),
     )
 
@@ -38,13 +41,15 @@ def req(rid, n_prompt, **kw):
 
 
 def drive(sched, work, start_token=1000):
-    """Apply a fake sampled token for every sample slot in the work."""
-    n = (
-        (1 if work.sample else 0)
-        if isinstance(work, PrefillWork)
-        else len(work.requests)
-    )
-    return sched.postprocess(work, list(range(start_token, start_token + n)))
+    """Apply fake sampled tokens for every sample slot in the work."""
+    if isinstance(work, PrefillWork):
+        rows = [[start_token]] if work.sample else [[]]
+    else:
+        rows = [
+            [start_token + i * 100 + k for k in range(work.window)]
+            for i in range(len(work.requests))
+        ]
+    return sched.postprocess(work, rows)
 
 
 def test_chunked_prefill_then_decode():
@@ -127,6 +132,40 @@ def test_preemption_and_resume():
     assert len(b.output_token_ids) == 20
     # all blocks released at the end
     assert s.pool.num_free == 7
+
+
+def test_windowed_decode_accept_and_discard():
+    s = make_scheduler(num_blocks=32, max_batched=16, window=4)
+    a = req("a", 6, max_tokens=3)  # finishes mid-window
+    b = req("b", 6, max_tokens=10)
+    s.add_request(a)
+    s.add_request(b)
+    drive(s, s.schedule())  # prefill a (+1 output)
+    drive(s, s.schedule())  # prefill b (+1 output)
+    w = s.schedule()
+    assert isinstance(w, DecodeWork)
+    assert w.window == 4 and len(w.requests) == 2
+    results = s.postprocess(w, [[11, 12, 13, 14], [21, 22, 23, 24]])
+    by_id = {r.request_id: toks for r, toks in results}
+    # a had 1 output + window 4, max_tokens=3 -> accepts 2, discards 2
+    assert by_id["a"] == [11, 12]
+    assert a.status.finished and a.status.name == "FINISHED_LENGTH"
+    assert by_id["b"] == [21, 22, 23, 24]
+    assert len(b.output_token_ids) == 5
+    # b's computed tokens advanced by the full window
+    assert b.num_computed_tokens == 6 + 4
+
+
+def test_windowed_decode_eos_discards_tail():
+    s = make_scheduler(num_blocks=32, max_batched=16, window=4)
+    r = req("a", 6, max_tokens=10)
+    r.eos_token_id = 777
+    s.add_request(r)
+    drive(s, s.schedule())
+    w = s.schedule()
+    results = s.postprocess(w, [[31, 777, 33, 34]])
+    assert results[0][1] == [31, 777]
+    assert r.status.name == "FINISHED_STOPPED"
 
 
 def test_finish_frees_blocks_and_eos():

@@ -119,14 +119,14 @@ class LLMEngine:
         sampled = self.runner.execute(work)
         results = self.scheduler.postprocess(work, sampled)
 
-        for req, tok in results:
-            if tok is None:  # mid-prompt prefill chunk: progress, no tokens
+        for req, toks in results:
+            if not toks:  # mid-prompt prefill chunk: progress, no tokens
                 continue
-            self._generation_tokens += 1
+            self._generation_tokens += len(toks)
             if req.first_token_time is None:
                 req.first_token_time = time.monotonic()
             state = self._states.get(req.request_id)
-            new_text = state.detok.push([tok]) if state and state.detok else ""
+            new_text = state.detok.push(toks) if state and state.detok else ""
 
             if state is not None and req.sampling.stop:
                 state.pending_text += new_text
@@ -139,7 +139,7 @@ class LLMEngine:
                         self.scheduler.finish_request(
                             req, RequestStatus.FINISHED_STOPPED
                         )
-                    outputs.append(self._make_output(req, [tok], emit, "stop"))
+                    outputs.append(self._make_output(req, toks, emit, "stop"))
                     continue
                 if req.status.finished:  # eos/length: flush held-back text
                     emit = state.pending_text
@@ -148,14 +148,14 @@ class LLMEngine:
                 else:  # hold back text that could be a stop-string prefix
                     emit = self._emittable(state, req.sampling.stop)
                 outputs.append(
-                    self._make_output(req, [tok], emit, self._finish_reason(req))
+                    self._make_output(req, toks, emit, self._finish_reason(req))
                 )
                 continue
 
             if state is not None:
                 state.text += new_text
             outputs.append(
-                self._make_output(req, [tok], new_text, self._finish_reason(req))
+                self._make_output(req, toks, new_text, self._finish_reason(req))
             )
 
         self._drop_finished(outputs)

@@ -75,6 +75,7 @@ class ModelRunner:
         self._rng = jax.random.PRNGKey(config.seed ^ 0x5EED)
         self._rep = NamedSharding(self.mesh, P())
         self._step_fn = self._build_step_fn()
+        self._decode_window_fn = self._build_decode_window_fn()
         self._sleeping_params_host: Any | None = None
 
     # -- compiled step -----------------------------------------------------
@@ -114,12 +115,76 @@ class ModelRunner:
 
         return step_fn
 
+    def _build_decode_window_fn(self):
+        """K decode iterations fused into one dispatch: a lax.fori_loop feeds
+        each iteration's sampled tokens into the next ON DEVICE, computes KV
+        slots from the block tables in-device, and returns the (B, K) token
+        matrix in a single fetch. Host↔device round-trip latency — the
+        dominant per-step cost, especially through remote-device tunnels —
+        amortizes over B*K tokens instead of B."""
+        cfg = self.config.model
+        block_size = self.config.cache.block_size
+
+        @functools.partial(
+            jax.jit,
+            static_argnames=("window",),
+            donate_argnames=("kv_caches",),
+        )
+        def decode_window_fn(
+            params,
+            kv_caches,
+            first_tokens,  # (B,) input token per request
+            positions0,  # (B,) first decode position per request
+            block_tables,  # (B, max_blocks) covering the whole window
+            context0,  # (B,) context length at the first step
+            temperature,  # (B,)
+            top_p,  # (B,)
+            top_k,  # (B,)
+            base_key,
+            seeds,  # (B,) uint32
+            has_seed,  # (B,) bool
+            counts0,  # (B,) output tokens generated before this window
+            window: int,
+        ):
+            b = first_tokens.shape[0]
+            out = jnp.zeros((b, window), jnp.int32)
+
+            def body(k, carry):
+                kv, cur, out = carry
+                pos = positions0 + k
+                ctx = context0 + k
+                slot = (
+                    jnp.take_along_axis(
+                        block_tables, (pos // block_size)[:, None], axis=1
+                    )[:, 0]
+                    * block_size
+                    + pos % block_size
+                )
+                hidden, kv = llama.forward(
+                    cfg, params, cur[:, None], pos[:, None], kv,
+                    block_tables, slot, ctx,
+                )
+                logits = llama.compute_logits(cfg, params, hidden[:, 0])
+                toks = sample(
+                    logits, temperature, top_p, top_k,
+                    jax.random.fold_in(base_key, k),
+                    seeds, has_seed, counts0 + k,
+                )
+                return kv, toks, out.at[:, k].set(toks)
+
+            kv_caches, _, out = jax.lax.fori_loop(
+                0, window, body, (kv_caches, first_tokens, out)
+            )
+            return kv_caches, out
+
+        return decode_window_fn
+
     # -- public API --------------------------------------------------------
 
-    def execute(self, work: ScheduleOutput) -> list[int]:
-        """Run one scheduled step; returns sampled tokens aligned with the
-        work item (prefill: [tok] if work.sample else []; decode: one per
-        request)."""
+    def execute(self, work: ScheduleOutput) -> list[list[int]]:
+        """Run one scheduled step; returns one token row per request
+        (prefill: [[tok]] if work.sample else [[]]; decode: up to `window`
+        candidate tokens per request)."""
         if isinstance(work, PrefillWork):
             return self._execute_prefill(work)
         return self._execute_decode(work)
@@ -144,36 +209,51 @@ class ModelRunner:
             sample_rows, [s.temperature], [s.top_p], [s.top_k],
             seeds=[s.seed], counts=[len(work.request.output_token_ids)],
         )
-        return [int(tokens[0])] if work.sample else []
+        return [[int(tokens[0])]] if work.sample else [[]]
 
-    def _execute_decode(self, work: DecodeWork) -> list[int]:
+    def _execute_decode(self, work: DecodeWork) -> list[list[int]]:
+        if self._sleeping_params_host is not None:
+            raise RuntimeError("engine is sleeping; wake it before running")
         sched = self.config.scheduler
         b = len(work.requests)
         b_pad = sched.bucket_for(b, sched.decode_buckets)
 
-        token_ids = np.zeros((b_pad, 1), np.int32)
-        token_ids[:b, 0] = work.token_ids
-        positions = np.zeros((b_pad, 1), np.int32)
-        positions[:b, 0] = work.positions
-        slots = np.zeros(b_pad, np.int32)
-        slots[:b] = work.slot_mapping
+        first_tokens = np.zeros(b_pad, np.int32)
+        first_tokens[:b] = work.token_ids
+        positions0 = np.zeros(b_pad, np.int32)
+        positions0[:b] = work.positions
         block_tables = self._block_table_array(
             [r.block_table for r in work.requests], pad_to=b_pad
         )
-        context_lens = np.zeros(b_pad, np.int32)
-        context_lens[:b] = work.context_lens
-        sample_rows = np.arange(b_pad, dtype=np.int32)  # row b*1+0 == b
+        context0 = np.zeros(b_pad, np.int32)
+        context0[:b] = work.context_lens
         temps = [r.sampling.temperature for r in work.requests] + [0.0] * (b_pad - b)
         top_ps = [r.sampling.top_p for r in work.requests] + [1.0] * (b_pad - b)
         top_ks = [r.sampling.top_k for r in work.requests] + [0] * (b_pad - b)
-        tokens = self._run(
-            token_ids, positions, block_tables, slots, context_lens,
-            sample_rows, temps, top_ps, top_ks,
-            seeds=[r.sampling.seed for r in work.requests] + [None] * (b_pad - b),
-            counts=[len(r.output_token_ids) for r in work.requests]
-            + [0] * (b_pad - b),
+        seeds = [r.sampling.seed for r in work.requests] + [None] * (b_pad - b)
+        counts = [len(r.output_token_ids) for r in work.requests] + [0] * (b_pad - b)
+
+        self._rng, step_key = jax.random.split(self._rng)
+        has_seed = np.asarray([s is not None for s in seeds], bool)
+        seed_vals = np.asarray([(s or 0) & 0xFFFFFFFF for s in seeds], np.uint32)
+        self.kv_caches, tokens = self._decode_window_fn(
+            self.params,
+            self.kv_caches,
+            first_tokens,
+            positions0,
+            block_tables,
+            context0,
+            np.asarray(temps, np.float32),
+            np.asarray(top_ps, np.float32),
+            np.asarray(top_ks, np.int32),
+            step_key,
+            seed_vals,
+            has_seed,
+            np.asarray(counts, np.int32),
+            window=work.window,
         )
-        return [int(tokens[i]) for i in range(b)]
+        mat = np.asarray(jax.device_get(tokens))
+        return [list(map(int, mat[i])) for i in range(b)]
 
     # -- helpers -----------------------------------------------------------
 

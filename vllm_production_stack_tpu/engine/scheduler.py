@@ -35,13 +35,16 @@ class PrefillWork:
 
 @dataclass
 class DecodeWork:
-    """One decode token for each request in the batch."""
+    """A window of `window` decode steps for each request in the batch, fused
+    into one device dispatch (engine/model_runner.py decode-window program).
+    Blocks are pre-allocated to cover the whole window; tokens sampled past a
+    per-request stop condition are discarded in postprocess."""
 
     requests: list[Request]
-    token_ids: list[int] = field(default_factory=list)  # token fed per request
-    positions: list[int] = field(default_factory=list)
-    slot_mapping: list[int] = field(default_factory=list)
-    context_lens: list[int] = field(default_factory=list)
+    window: int = 1
+    token_ids: list[int] = field(default_factory=list)  # first token per req
+    positions: list[int] = field(default_factory=list)  # first position per req
+    context_lens: list[int] = field(default_factory=list)  # at first step
 
 
 ScheduleOutput = PrefillWork | DecodeWork
@@ -166,23 +169,32 @@ class Scheduler:
         return work
 
     def _schedule_decode(self, ready: list[Request]) -> DecodeWork | None:
+        cand = ready[: self.config.max_num_seqs]
+        # window bounded by model length per seq and by the largest remaining
+        # output budget (beyond that every token would be discarded)
+        window = max(1, self.config.decode_window)
+        window = min(
+            window,
+            min(self.model_config.max_model_len - r.num_computed_tokens
+                for r in cand),
+            max(r.sampling.max_tokens - len(r.output_token_ids) for r in cand),
+        )
         picked: list[Request] = []
-        for req in ready[: self.config.max_num_seqs]:
+        for req in cand:
             if req not in self.running:
                 continue  # preempted while building this batch
-            if not self._ensure_blocks(req, req.num_computed_tokens + 1):
+            if not self._ensure_blocks(req, req.num_computed_tokens + window):
                 continue  # req preempted itself; others may still decode
             picked.append(req)
         # a later _ensure_blocks may have preempted an earlier pick
         picked = [r for r in picked if r in self.running]
         if not picked:
             return None
-        batch = DecodeWork(requests=picked)
+        batch = DecodeWork(requests=picked, window=window)
         for req in picked:
             pos = req.num_computed_tokens
             batch.token_ids.append(req.token_at(pos))
             batch.positions.append(pos)
-            batch.slot_mapping.append(self._slot(req, pos))
             batch.context_lens.append(pos + 1)
         return batch
 
@@ -270,32 +282,39 @@ class Scheduler:
     # -- post-step ---------------------------------------------------------
 
     def postprocess(
-        self, work: ScheduleOutput, sampled: list[int]
-    ) -> list[tuple[Request, int | None]]:
-        """Apply one step's results. Returns [(request, new_token or None)]
-        for every request the step advanced (token None = prefill chunk that
-        didn't finish the prompt)."""
-        results: list[tuple[Request, int | None]] = []
+        self, work: ScheduleOutput, sampled: list[list[int]]
+    ) -> list[tuple[Request, list[int]]]:
+        """Apply one step's results. `sampled` carries one row per request
+        (prefill: 0 or 1 tokens; decode: up to `window` candidates). Returns
+        [(request, accepted_new_tokens)] — an empty list marks a prefill chunk
+        that didn't finish the prompt. Decode candidates past a stop condition
+        are discarded."""
+        results: list[tuple[Request, list[int]]] = []
         if isinstance(work, PrefillWork):
             req = work.request
             start = req.num_computed_tokens
             req.num_computed_tokens = work.context_len
             self._register_full_blocks(req, start, work.context_len)
             if work.sample:
-                tok = sampled[0]
+                tok = sampled[0][0]
                 req.output_token_ids.append(tok)
                 self._maybe_finish(req)
-                results.append((req, tok))
+                results.append((req, [tok]))
             else:
-                results.append((req, None))
+                results.append((req, []))
         else:
-            for req, tok in zip(work.requests, sampled):
-                start = req.num_computed_tokens
-                req.num_computed_tokens += 1
-                self._register_full_blocks(req, start, req.num_computed_tokens)
-                req.output_token_ids.append(tok)
-                self._maybe_finish(req)
-                results.append((req, tok))
+            for req, row in zip(work.requests, sampled):
+                accepted: list[int] = []
+                for tok in row:
+                    start = req.num_computed_tokens
+                    req.num_computed_tokens += 1
+                    self._register_full_blocks(req, start, req.num_computed_tokens)
+                    req.output_token_ids.append(tok)
+                    accepted.append(tok)
+                    self._maybe_finish(req)
+                    if req.status.finished:
+                        break
+                results.append((req, accepted))
         return results
 
     def _register_full_blocks(self, req: Request, start: int, end: int) -> None:
