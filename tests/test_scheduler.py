@@ -136,24 +136,47 @@ def test_preemption_and_resume():
 
 def test_windowed_decode_accept_and_discard():
     s = make_scheduler(num_blocks=32, max_batched=16, window=4)
-    a = req("a", 6, max_tokens=3)  # finishes mid-window
+    a = req("a", 6, max_tokens=7)  # finishes mid-way through the joint window
     b = req("b", 6, max_tokens=10)
     s.add_request(a)
     s.add_request(b)
     drive(s, s.schedule())  # prefill a (+1 output)
+    # alternation policy: a decode-only window for a runs before b's prefill
+    w = s.schedule()
+    assert isinstance(w, DecodeWork) and w.requests == [a] and w.window == 4
+    drive(s, w)  # a now has 5 outputs
     drive(s, s.schedule())  # prefill b (+1 output)
     w = s.schedule()
     assert isinstance(w, DecodeWork)
     assert w.window == 4 and len(w.requests) == 2
     results = s.postprocess(w, [[11, 12, 13, 14], [21, 22, 23, 24]])
     by_id = {r.request_id: toks for r, toks in results}
-    # a had 1 output + window 4, max_tokens=3 -> accepts 2, discards 2
+    # a had 5 outputs + window 4, max_tokens=7 -> accepts 2, discards 2
     assert by_id["a"] == [11, 12]
     assert a.status.finished and a.status.name == "FINISHED_LENGTH"
     assert by_id["b"] == [21, 22, 23, 24]
     assert len(b.output_token_ids) == 5
     # b's computed tokens advanced by the full window
     assert b.num_computed_tokens == 6 + 4
+
+
+def test_windowed_decode_no_self_preempt_livelock():
+    """A request near pool exhaustion must not preempt itself to grow a decode
+    window (round-1 livelock: 8-block pool, 8-token prompt, max_tokens=40)."""
+    s = make_scheduler(num_blocks=8, block_size=4, max_batched=8, max_seqs=2, window=8)
+    r = req("a", 8, max_tokens=40)
+    s.add_request(r)
+    for _ in range(400):
+        w = s.schedule()
+        if w is not None:
+            drive(s, w)
+        if s.take_finished_externally() or r.status.finished:
+            break
+        if w is None and not s.has_unfinished():
+            break
+    assert r.status.finished
+    # either ran to a capacity abort or a length finish — never a livelock
+    assert r.num_preemptions <= 2
 
 
 def test_windowed_decode_eos_discards_tail():

@@ -179,6 +179,18 @@ class Scheduler:
                 for r in cand),
             max(r.sampling.max_tokens - len(r.output_token_ids) for r in cand),
         )
+        # clamp to pool headroom: the batch's total new-block demand at this
+        # window must fit in currently-free blocks, so _ensure_blocks below
+        # never preempts a candidate to grow another candidate's window (a
+        # sole running request preempting *itself* is a livelock: it re-admits,
+        # recomputes, and hits the same wall forever)
+        while window > 1 and self._extra_blocks(cand, window) > self.pool.num_free:
+            window -= 1
+        # snap down to a power of two: `window` is a static jit arg of the
+        # fused decode program, so every distinct value costs a compile —
+        # bound the set to {1, 2, 4, ...} instead of walking through every
+        # integer as free-block headroom fluctuates
+        window = 1 << (window.bit_length() - 1)
         picked: list[Request] = []
         for req in cand:
             if req not in self.running:
@@ -202,6 +214,17 @@ class Scheduler:
 
     def _blocks_needed(self, num_tokens: int) -> int:
         return (num_tokens + self.block_size - 1) // self.block_size
+
+    def _extra_blocks(self, reqs: list[Request], window: int) -> int:
+        """New blocks the batch needs to decode `window` tokens per request."""
+        return sum(
+            max(
+                0,
+                self._blocks_needed(r.num_computed_tokens + window)
+                - len(r.block_table),
+            )
+            for r in reqs
+        )
 
     def _can_admit(self, req: Request) -> bool:
         """Admission watermark: only admit when the pool can hold the whole
@@ -244,7 +267,10 @@ class Scheduler:
         """Grow req's block table to cover num_tokens. On pool exhaustion the
         NEWEST running request is preempted — possibly req itself (returns
         False, req is back in waiting) — so the oldest request always makes
-        forward progress and the system can't livelock."""
+        forward progress. A sole running request that can never fit its own
+        next token is aborted at re-admission by `_can_admit`'s usable-pool
+        check; the decode-window headroom clamp in `_schedule_decode` keeps
+        windowed decode from self-preempting before that point."""
         need = self._blocks_needed(num_tokens)
         while len(req.block_table) < need:
             blk = self.pool.allocate()
