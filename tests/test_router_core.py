@@ -1,0 +1,331 @@
+"""Router unit tests: hash ring, hash trie, routing policies, stats, parser.
+
+Shapes follow the reference's unit suite (src/tests/test_session_router.py,
+test_roundrobin_router.py, test_parser.py): tiny local stand-in objects, no
+cluster, no engines."""
+
+import asyncio
+import collections
+
+import pytest
+
+from vllm_production_stack_tpu.router.args import parse_args
+from vllm_production_stack_tpu.router.discovery import Endpoint
+from vllm_production_stack_tpu.router.engine_stats import EngineStats
+from vllm_production_stack_tpu.router.feature_gates import FeatureGates
+from vllm_production_stack_tpu.router.hashring import HashRing
+from vllm_production_stack_tpu.router.hashtrie import HashTrie
+from vllm_production_stack_tpu.router.request_stats import RequestStatsMonitor
+from vllm_production_stack_tpu.router.routing import (
+    RoutingContext,
+    make_policy,
+)
+
+
+def eps(*urls, labels=None):
+    labels = labels or [""] * len(urls)
+    return [Endpoint(url=u, model_label=l) for u, l in zip(urls, labels)]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- hash ring --------------------------------------------------------------
+
+
+def test_hashring_sticky_and_balanced():
+    ring = HashRing()
+    ring.sync(["e1", "e2", "e3"])
+    keys = [f"session-{i}" for i in range(600)]
+    owner = {k: ring.get_node(k) for k in keys}
+    # deterministic: same key always lands on the same node
+    for k in keys:
+        assert ring.get_node(k) == owner[k]
+    counts = collections.Counter(owner.values())
+    assert set(counts) == {"e1", "e2", "e3"}
+    assert min(counts.values()) > 600 / 3 * 0.5  # roughly balanced
+
+
+def test_hashring_minimal_migration_on_removal():
+    ring = HashRing()
+    ring.sync(["e1", "e2", "e3"])
+    keys = [f"k{i}" for i in range(500)]
+    before = {k: ring.get_node(k) for k in keys}
+    ring.remove_node("e2")
+    for k in keys:
+        now = ring.get_node(k)
+        if before[k] != "e2":
+            assert now == before[k]  # only e2's keys moved
+        else:
+            assert now in ("e1", "e3")
+
+
+def test_hashring_add_node_only_steals():
+    ring = HashRing()
+    ring.sync(["e1", "e2"])
+    keys = [f"k{i}" for i in range(500)]
+    before = {k: ring.get_node(k) for k in keys}
+    ring.add_node("e3")
+    moved = sum(1 for k in keys if ring.get_node(k) != before[k])
+    for k in keys:
+        if ring.get_node(k) != before[k]:
+            assert ring.get_node(k) == "e3"
+    assert 0 < moved < 500
+
+
+# -- hash trie --------------------------------------------------------------
+
+
+def test_hashtrie_longest_prefix():
+    async def go():
+        trie = HashTrie(chunk_chars=4)
+        await trie.insert("aaaabbbbcccc", "e1")
+        await trie.insert("aaaabbbbdddd", "e2")
+        n, match = await trie.longest_prefix_match("aaaabbbbcccc", {"e1", "e2"})
+        assert n == 3 and match == {"e1"}
+        n, match = await trie.longest_prefix_match("aaaabbbb", {"e1", "e2"})
+        assert n == 2 and match == {"e1", "e2"}
+        n, match = await trie.longest_prefix_match("zzzz", {"e1", "e2"})
+        assert n == 0 and match == {"e1", "e2"}  # no match -> all available
+
+    run(go())
+
+
+def test_hashtrie_respects_availability():
+    async def go():
+        trie = HashTrie(chunk_chars=4)
+        await trie.insert("aaaabbbb", "e1")
+        n, match = await trie.longest_prefix_match("aaaabbbb", {"e2"})
+        assert match == {"e2"}  # e1 matched but is unavailable
+        await trie.remove_endpoint("e1")
+        n, match = await trie.longest_prefix_match("aaaabbbb", {"e1", "e2"})
+        assert n == 0
+
+    run(go())
+
+
+# -- routing policies -------------------------------------------------------
+
+
+def test_roundrobin_uniform():
+    policy = make_policy("roundrobin")
+    endpoints = eps("http://b", "http://a", "http://c")
+    picks = run(_route_n(policy, endpoints, 30))
+    counts = collections.Counter(picks)
+    assert all(v == 10 for v in counts.values())
+    # deterministic URL-sorted order
+    assert picks[:3] == ["http://a", "http://b", "http://c"]
+
+
+async def _route_n(policy, endpoints, n, headers=None, body=None):
+    out = []
+    for _ in range(n):
+        ctx = RoutingContext(
+            endpoints=endpoints, headers=headers or {}, body=body or {}
+        )
+        out.append(await policy.route(ctx))
+    return out
+
+
+def test_session_sticky_100_percent():
+    policy = make_policy("session", session_key="x-user-id")
+    endpoints = eps("http://a", "http://b", "http://c")
+
+    async def go():
+        seen = {}
+        for i in range(50):
+            sid = f"user-{i % 7}"
+            url = await policy.route(
+                RoutingContext(endpoints=endpoints, headers={"x-user-id": sid})
+            )
+            assert seen.setdefault(sid, url) == url  # 100% sticky
+
+    run(go())
+
+
+def test_session_fallback_qps_min():
+    from vllm_production_stack_tpu.router.request_stats import RequestStats
+
+    policy = make_policy("session", session_key="x-user-id")
+    endpoints = eps("http://a", "http://b")
+    stats = {"http://a": RequestStats(qps=5.0), "http://b": RequestStats(qps=1.0)}
+
+    async def go():
+        url = await policy.route(
+            RoutingContext(endpoints=endpoints, request_stats=stats, headers={})
+        )
+        assert url == "http://b"
+
+    run(go())
+
+
+def test_prefixaware_consistent_per_prefix():
+    policy = make_policy("prefixaware")
+    endpoints = eps("http://a", "http://b", "http://c")
+    prefix = "x" * 300
+
+    async def go():
+        first = await policy.route(
+            RoutingContext(endpoints=endpoints, body={"prompt": prefix + "1"})
+        )
+        for i in range(10):
+            url = await policy.route(
+                RoutingContext(
+                    endpoints=endpoints, body={"prompt": prefix + str(i)}
+                )
+            )
+            assert url == first  # shared 2-chunk prefix -> same engine
+
+    run(go())
+
+
+def test_prefixaware_chat_message_extraction():
+    ctx = RoutingContext(
+        endpoints=[],
+        body={
+            "messages": [
+                {"role": "system", "content": "be nice"},
+                {
+                    "role": "user",
+                    "content": [
+                        {"type": "text", "text": "hello"},
+                        {"type": "image_url", "image_url": {"url": "x"}},
+                    ],
+                },
+            ]
+        },
+    )
+    assert ctx.prompt_text() == "be nice\nhello"
+
+
+def test_disaggregated_prefill_pools():
+    policy = make_policy(
+        "disaggregated_prefill",
+        prefill_model_labels=["prefill"],
+        decode_model_labels=["decode"],
+    )
+    endpoints = eps(
+        "http://p1", "http://d1", labels=["prefill", "decode"]
+    )
+
+    async def go():
+        url = await policy.route(
+            RoutingContext(endpoints=endpoints, body={"max_tokens": 1})
+        )
+        assert url == "http://p1"
+        url = await policy.route(
+            RoutingContext(endpoints=endpoints, body={"max_tokens": 100})
+        )
+        assert url == "http://d1"
+
+    run(go())
+
+
+def test_kvaware_falls_back_without_controller():
+    policy = make_policy(
+        "kvaware", kv_controller_url="http://127.0.0.1:1", kv_aware_threshold=8
+    )
+    endpoints = eps("http://a")
+    assert run(_route_n(policy, endpoints, 1, body={"prompt": "hi"})) == ["http://a"]
+
+
+# -- stats ------------------------------------------------------------------
+
+
+def test_request_stats_lifecycle():
+    mon = RequestStatsMonitor(sliding_window=60)
+    mon.on_new_request("http://a", "r1", 100.0)
+    st = mon.get_request_stats(now=101.0)["http://a"]
+    assert st.in_prefill_requests == 1 and st.in_decoding_requests == 0
+    mon.on_first_token("http://a", "r1", 100.5)
+    st = mon.get_request_stats(now=101.0)["http://a"]
+    assert st.in_prefill_requests == 0 and st.in_decoding_requests == 1
+    assert st.ttft == pytest.approx(0.5)
+    mon.on_request_complete("http://a", "r1", 102.0)
+    st = mon.get_request_stats(now=102.0)["http://a"]
+    assert st.finished_requests == 1 and st.in_decoding_requests == 0
+    assert st.latency == pytest.approx(2.0)
+    assert st.qps == pytest.approx(1 / 60)
+
+
+def test_request_stats_sliding_window_expiry():
+    mon = RequestStatsMonitor(sliding_window=10)
+    mon.on_new_request("http://a", "r1", 0.0)
+    mon.on_request_complete("http://a", "r1", 1.0)
+    assert mon.get_request_stats(now=5.0)["http://a"].qps > 0
+    assert mon.get_request_stats(now=50.0)["http://a"].qps == 0.0
+
+
+def test_engine_stats_parse_tpu_contract():
+    text = (
+        'tpu:num_requests_running{model_name="m"} 3\n'
+        'tpu:num_requests_waiting{model_name="m"} 2\n'
+        'tpu:hbm_kv_usage_perc{model_name="m"} 0.42\n'
+        'tpu:hbm_prefix_cache_hit_rate{model_name="m"} 0.8\n'
+        'tpu:hbm_prefix_cache_hits_total{model_name="m"} 40\n'
+        'tpu:hbm_prefix_cache_queries_total{model_name="m"} 50\n'
+    )
+    st = EngineStats.from_scrape(text)
+    assert st.num_running_requests == 3
+    assert st.num_queuing_requests == 2
+    assert st.hbm_kv_usage_perc == pytest.approx(0.42)
+    assert st.prefix_cache_hit_rate == pytest.approx(0.8)
+    assert st.prefix_cache_hits_total == 40
+    assert st.prefix_cache_queries_total == 50
+
+
+# -- feature gates ----------------------------------------------------------
+
+
+def test_feature_gates():
+    fg = FeatureGates("SemanticCache=true")
+    assert fg.enabled("SemanticCache")
+    assert not fg.enabled("PIIDetection")
+    with pytest.raises(ValueError):
+        FeatureGates("NoSuchGate=true")
+
+
+# -- parser -----------------------------------------------------------------
+
+
+def test_parser_requires_static_backends():
+    with pytest.raises(SystemExit):
+        parse_args(["--service-discovery", "static"])
+
+
+def test_parser_requires_session_key():
+    with pytest.raises(SystemExit):
+        parse_args(
+            [
+                "--static-backends", "http://a",
+                "--routing-logic", "session",
+            ]
+        )
+
+
+def test_parser_config_file_merge(tmp_path):
+    cfg = tmp_path / "router.yaml"
+    cfg.write_text(
+        "static-backends: http://a,http://b\nrouting-logic: roundrobin\nport: 9999\n"
+    )
+    args = parse_args(["--config", str(cfg), "--port", "8888"])
+    assert args.static_backends == "http://a,http://b"
+    assert args.port == 8888  # CLI wins over file
+
+
+def test_parser_rejects_unknown_config_keys(tmp_path):
+    cfg = tmp_path / "router.yaml"
+    cfg.write_text("static-backends: http://a\nnot-a-flag: 1\n")
+    with pytest.raises(SystemExit):
+        parse_args(["--config", str(cfg)])
+
+
+def test_parser_model_count_mismatch():
+    with pytest.raises(SystemExit):
+        parse_args(
+            [
+                "--static-backends", "http://a,http://b",
+                "--static-models", "m1",
+            ]
+        )

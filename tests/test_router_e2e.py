@@ -1,0 +1,489 @@
+"""Router e2e: real aiohttp servers (fake TPU engines) behind the router app.
+
+The reference proves routing correctness by driving a deployed router and
+checking behavior per algorithm (tests/e2e/test-routing.py: roundrobin ≈
+uniform, session 100% sticky, prefix consistent); its CI uses fake OpenAI
+servers as backends (router-e2e-test.yml). Same approach: every test spins
+fake engines + the router in-process on ephemeral ports."""
+
+import asyncio
+import collections
+import contextlib
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from vllm_production_stack_tpu.router.app import build_app
+from vllm_production_stack_tpu.router.args import parse_args
+from vllm_production_stack_tpu.testing.fake_engine import FakeEngine
+
+
+@contextlib.asynccontextmanager
+async def router_rig(
+    n_engines=2,
+    models=None,
+    labels=None,
+    router_args=(),
+    tokens_per_sec=5000.0,
+):
+    """N fake engines + a router pointed at them (static discovery)."""
+    models = models or ["fake-model"] * n_engines
+    labels = labels or [""] * n_engines
+    engines, servers = [], []
+    try:
+        for i in range(n_engines):
+            eng = FakeEngine(
+                model=models[i], tokens_per_sec=tokens_per_sec, model_label=labels[i]
+            )
+            srv = TestServer(eng.build_app())
+            await srv.start_server()
+            engines.append(eng)
+            servers.append(srv)
+        urls = ",".join(f"http://127.0.0.1:{s.port}" for s in servers)
+        argv = [
+            "--static-backends", urls,
+            "--static-models", ";".join(models),
+            "--static-model-labels", ",".join(labels),
+            *router_args,
+        ]
+        app = build_app(parse_args(argv))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            yield client, engines, servers
+        finally:
+            await client.close()
+    finally:
+        for srv in servers:
+            await srv.close()
+
+
+def chat_body(content="hello", model="fake-model", **kw):
+    return {
+        "model": model,
+        "messages": [{"role": "user", "content": content}],
+        "max_tokens": 4,
+        **kw,
+    }
+
+
+def test_proxy_completion_roundtrip():
+    async def go():
+        async with router_rig(n_engines=2) as (client, engines, _):
+            resp = await client.post("/v1/chat/completions", json=chat_body())
+            assert resp.status == 200
+            assert resp.headers["X-Request-Id"]
+            data = await resp.json()
+            assert data["choices"][0]["message"]["content"].startswith("tok0")
+            assert sum(e.total_requests for e in engines) == 1
+
+    asyncio.run(go())
+
+
+def test_proxy_streaming_sse():
+    async def go():
+        async with router_rig(n_engines=1) as (client, engines, _):
+            resp = await client.post(
+                "/v1/chat/completions", json=chat_body(stream=True)
+            )
+            assert resp.status == 200
+            chunks = []
+            async for line in resp.content:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    chunks.append(json.loads(line[6:]))
+            assert len(chunks) == 4
+            assert chunks[0]["choices"][0]["delta"]["content"] == "tok0 "
+
+    asyncio.run(go())
+
+
+def test_roundrobin_distribution():
+    async def go():
+        async with router_rig(n_engines=3) as (client, engines, _):
+            for _ in range(12):
+                resp = await client.post("/v1/chat/completions", json=chat_body())
+                assert resp.status == 200
+            counts = [e.total_requests for e in engines]
+            assert counts == [4, 4, 4]  # perfectly uniform
+
+    asyncio.run(go())
+
+
+def test_session_stickiness_e2e():
+    async def go():
+        args = ["--routing-logic", "session", "--session-key", "x-user-id"]
+        async with router_rig(n_engines=3, router_args=args) as (
+            client, engines, _,
+        ):
+            for i in range(20):
+                resp = await client.post(
+                    "/v1/chat/completions",
+                    json=chat_body(),
+                    headers={"x-user-id": "user-42"},
+                )
+                assert resp.status == 200
+            # all 20 requests landed on exactly one engine
+            assert sorted(e.total_requests for e in engines) == [0, 0, 20]
+
+    asyncio.run(go())
+
+
+def test_prefixaware_consistency_e2e():
+    async def go():
+        args = ["--routing-logic", "prefixaware"]
+        async with router_rig(n_engines=3, router_args=args) as (
+            client, engines, _,
+        ):
+            prefix = "shared system prompt " * 20  # > 2 chunks of 128 chars
+            for i in range(10):
+                resp = await client.post(
+                    "/v1/chat/completions", json=chat_body(prefix + str(i))
+                )
+                assert resp.status == 200
+            assert sorted(e.total_requests for e in engines) == [0, 0, 10]
+
+    asyncio.run(go())
+
+
+def test_model_filtering_and_503():
+    async def go():
+        async with router_rig(
+            n_engines=2, models=["model-a", "model-b"]
+        ) as (client, engines, _):
+            for _ in range(3):
+                resp = await client.post(
+                    "/v1/chat/completions", json=chat_body(model="model-b")
+                )
+                assert resp.status == 200
+            assert engines[0].total_requests == 0
+            assert engines[1].total_requests == 3
+            resp = await client.post(
+                "/v1/chat/completions", json=chat_body(model="no-such-model")
+            )
+            assert resp.status == 503
+
+    asyncio.run(go())
+
+
+def test_model_alias_resolution():
+    async def go():
+        args = ["--model-aliases", '{"prod": "fake-model"}']
+        async with router_rig(n_engines=1, router_args=args) as (client, engines, _):
+            resp = await client.post(
+                "/v1/chat/completions", json=chat_body(model="prod")
+            )
+            assert resp.status == 200
+            # engine saw the resolved name, not the alias
+            assert engines[0].seen_request_log[0]["body"]["model"] == "fake-model"
+            models = await (await client.get("/v1/models")).json()
+            ids = {m["id"] for m in models["data"]}
+            assert {"prod", "fake-model"} <= ids
+
+    asyncio.run(go())
+
+
+def test_sleep_wake_filtering():
+    async def go():
+        async with router_rig(n_engines=2) as (client, engines, servers):
+            url0 = f"http://127.0.0.1:{servers[0].port}"
+            resp = await client.post("/sleep", params={"url": url0})
+            assert resp.status == 200
+            assert engines[0].sleeping
+            for _ in range(4):
+                assert (
+                    await client.post("/v1/chat/completions", json=chat_body())
+                ).status == 200
+            assert engines[0].total_requests == 0  # sleeping engine skipped
+            assert engines[1].total_requests == 4
+            resp = await client.get("/is_sleeping", params={"url": url0})
+            assert (await resp.json())["is_sleeping"] is True
+            resp = await client.post("/wake_up", params={"url": url0})
+            assert resp.status == 200
+            for _ in range(2):
+                await client.post("/v1/chat/completions", json=chat_body())
+            assert engines[0].total_requests > 0
+
+    asyncio.run(go())
+
+
+def test_disaggregated_prefill_two_phase():
+    async def go():
+        args = [
+            "--routing-logic", "disaggregated_prefill",
+            "--prefill-model-labels", "prefill",
+            "--decode-model-labels", "decode",
+        ]
+        async with router_rig(
+            n_engines=2, labels=["prefill", "decode"], router_args=args
+        ) as (client, engines, _):
+            resp = await client.post(
+                "/v1/chat/completions", json=chat_body(max_tokens=8)
+            )
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["usage"]["completion_tokens"] == 8
+            # phase 1 hit the prefill engine with max_tokens=1
+            assert engines[0].total_requests == 1
+            assert engines[0].seen_request_log[0]["body"]["max_tokens"] == 1
+            # phase 2 streamed the real request from the decode engine
+            assert engines[1].total_requests == 1
+            assert engines[1].seen_request_log[0]["body"]["max_tokens"] == 8
+
+    asyncio.run(go())
+
+
+def test_engines_health_metrics_endpoints():
+    async def go():
+        async with router_rig(n_engines=2) as (client, engines, _):
+            await client.post("/v1/chat/completions", json=chat_body())
+            # force one scrape so /engines has engine stats
+            await client.app["state"].engine_scraper.scrape_once()
+            health = await (await client.get("/health")).json()
+            assert health["status"] == "ok"
+            eng = await (await client.get("/engines")).json()
+            assert len(eng["engines"]) == 2
+            assert any(
+                e["engine_stats"] is not None
+                and e["engine_stats"]["prefix_cache_hit_rate"] == 0.5
+                for e in eng["engines"]
+            )
+            metrics = await (await client.get("/metrics")).text()
+            assert "router_current_qps" in metrics
+            assert "router_healthy_engines_total 2.0" in metrics
+            version = await (await client.get("/version")).json()
+            assert "version" in version
+
+    asyncio.run(go())
+
+
+def test_api_key_auth():
+    async def go():
+        args = ["--api-key", "sekrit"]
+        async with router_rig(n_engines=1, router_args=args) as (client, _, __):
+            resp = await client.post("/v1/chat/completions", json=chat_body())
+            assert resp.status == 401
+            resp = await client.post(
+                "/v1/chat/completions",
+                json=chat_body(),
+                headers={"Authorization": "Bearer sekrit"},
+            )
+            assert resp.status == 200
+            # non-/v1 endpoints stay open for probes
+            assert (await client.get("/health")).status == 200
+
+    asyncio.run(go())
+
+
+def test_api_key_covers_control_surface():
+    async def go():
+        args = ["--api-key", "sekrit"]
+        async with router_rig(n_engines=1, router_args=args) as (client, _, srv):
+            # capacity levers and tokenize proxies must not be open
+            assert (await client.post("/sleep")).status == 401
+            assert (await client.post("/tokenize", json={})).status == 401
+            assert (await client.get("/engines")).status == 401
+
+    asyncio.run(go())
+
+
+def test_files_path_traversal_blocked(tmp_path):
+    async def go():
+        args = [
+            "--enable-batch-api",
+            "--files-dir", str(tmp_path / "files"),
+            "--batch-db", str(tmp_path / "batch.sqlite"),
+        ]
+        async with router_rig(n_engines=1, router_args=args) as (client, _, __):
+            resp = await client.get(
+                "/v1/files/passwd/content", headers={"X-User-Id": "/etc"}
+            )
+            assert resp.status == 400
+            resp = await client.get(
+                "/v1/files/..%2F..%2Fetc%2Fpasswd/content",
+                headers={"X-User-Id": "u"},
+            )
+            assert resp.status in (400, 404)
+
+    asyncio.run(go())
+
+
+def test_batch_malformed_line_still_completes(tmp_path):
+    async def go():
+        args = [
+            "--enable-batch-api",
+            "--files-dir", str(tmp_path / "files"),
+            "--batch-db", str(tmp_path / "batch.sqlite"),
+        ]
+        async with router_rig(n_engines=1, router_args=args) as (client, _, __):
+            import aiohttp
+
+            lines = "this is not json\n" + json.dumps(
+                {"custom_id": "ok-1", "body": chat_body("hi")}
+            )
+            form = aiohttp.FormData()
+            form.add_field("purpose", "batch")
+            form.add_field("file", lines, filename="bad.jsonl")
+            file_id = (await (await client.post("/v1/files", data=form)).json())["id"]
+            batch_id = (
+                await (
+                    await client.post(
+                        "/v1/batches",
+                        json={
+                            "input_file_id": file_id,
+                            "endpoint": "/v1/chat/completions",
+                        },
+                    )
+                ).json()
+            )["id"]
+            for _ in range(100):
+                data = await (await client.get(f"/v1/batches/{batch_id}")).json()
+                if data["status"] in ("completed", "failed"):
+                    break
+                await asyncio.sleep(0.1)
+            assert data["status"] == "completed"
+            assert data["request_counts"] == {
+                "total": 2, "completed": 1, "failed": 1,
+            }
+
+    asyncio.run(go())
+
+
+def test_disaggregated_prefill_client_max_tokens_1(tmp_path):
+    """A legitimate client request with max_tokens=1 must not 500 in PD mode."""
+
+    async def go():
+        args = [
+            "--routing-logic", "disaggregated_prefill",
+            "--prefill-model-labels", "prefill",
+            "--decode-model-labels", "decode",
+        ]
+        async with router_rig(
+            n_engines=2, labels=["prefill", "decode"], router_args=args
+        ) as (client, engines, _):
+            resp = await client.post(
+                "/v1/chat/completions", json=chat_body(max_tokens=1)
+            )
+            assert resp.status == 200
+            assert engines[0].total_requests == 1  # prefill phase
+            assert engines[1].total_requests == 1  # decode phase
+
+    asyncio.run(go())
+
+
+def test_dynamic_config_hot_reload(tmp_path):
+    async def go():
+        cfg = tmp_path / "dyn.yaml"
+        cfg.write_text("model_aliases:\n  latest: fake-model\n")
+        args = [
+            "--dynamic-config-file", str(cfg),
+            "--dynamic-config-interval", "3600",  # manual ticks only
+        ]
+        async with router_rig(n_engines=1, router_args=args) as (client, engines, _):
+            state = client.app["state"]
+            await state.dynamic_config.check_once()
+            assert state.model_aliases == {"latest": "fake-model"}
+            resp = await client.post(
+                "/v1/chat/completions", json=chat_body(model="latest")
+            )
+            assert resp.status == 200
+            cfg.write_text("routing_logic: roundrobin\nmodel_aliases: {}\n")
+            assert await state.dynamic_config.check_once()
+            assert state.model_aliases == {}
+            health = await (await client.get("/health")).json()
+            assert health["dynamic_config"]["reloads"] == 2
+
+    asyncio.run(go())
+
+
+def test_pii_blocking_e2e():
+    async def go():
+        args = ["--feature-gates", "PIIDetection=true"]
+        async with router_rig(n_engines=1, router_args=args) as (client, engines, _):
+            resp = await client.post(
+                "/v1/chat/completions",
+                json=chat_body("my ssn is 123-45-6789"),
+            )
+            assert resp.status == 400
+            assert (await resp.json())["error"]["type"] == "pii_detected"
+            assert engines[0].total_requests == 0
+            resp = await client.post(
+                "/v1/chat/completions", json=chat_body("clean text")
+            )
+            assert resp.status == 200
+
+    asyncio.run(go())
+
+
+def test_semantic_cache_hit():
+    async def go():
+        args = [
+            "--feature-gates", "SemanticCache=true",
+            "--semantic-cache-dir", "hashing",
+            "--semantic-cache-threshold", "0.99",
+        ]
+        async with router_rig(n_engines=1, router_args=args) as (client, engines, _):
+            body = chat_body("what is the capital of france")
+            r1 = await (await client.post("/v1/chat/completions", json=body)).json()
+            assert engines[0].total_requests == 1
+            r2 = await (await client.post("/v1/chat/completions", json=body)).json()
+            assert engines[0].total_requests == 1  # served from cache
+            assert r2["cached"] is True
+            assert r2["choices"] == r1["choices"]
+
+    asyncio.run(go())
+
+
+def test_files_and_batch_api(tmp_path):
+    async def go():
+        args = [
+            "--enable-batch-api",
+            "--files-dir", str(tmp_path / "files"),
+            "--batch-db", str(tmp_path / "batch.sqlite"),
+        ]
+        async with router_rig(n_engines=1, router_args=args) as (client, engines, _):
+            lines = [
+                json.dumps(
+                    {
+                        "custom_id": f"req-{i}",
+                        "method": "POST",
+                        "url": "/v1/chat/completions",
+                        "body": chat_body(f"question {i}"),
+                    }
+                )
+                for i in range(3)
+            ]
+            import aiohttp
+
+            form = aiohttp.FormData()
+            form.add_field("purpose", "batch")
+            form.add_field("file", "\n".join(lines), filename="input.jsonl")
+            resp = await client.post("/v1/files", data=form)
+            assert resp.status == 200
+            file_id = (await resp.json())["id"]
+
+            resp = await client.post(
+                "/v1/batches",
+                json={"input_file_id": file_id, "endpoint": "/v1/chat/completions"},
+            )
+            assert resp.status == 200
+            batch_id = (await resp.json())["id"]
+
+            for _ in range(100):
+                data = await (await client.get(f"/v1/batches/{batch_id}")).json()
+                if data["status"] == "completed":
+                    break
+                await asyncio.sleep(0.1)
+            assert data["status"] == "completed"
+            assert data["request_counts"] == {
+                "total": 3, "completed": 3, "failed": 0,
+            }
+            out = await (
+                await client.get(f"/v1/files/{data['output_file_id']}/content")
+            ).read()
+            rows = [json.loads(x) for x in out.decode().splitlines()]
+            assert {r["custom_id"] for r in rows} == {"req-0", "req-1", "req-2"}
+            assert all(r["response"]["status_code"] == 200 for r in rows)
+            assert engines[0].total_requests == 3
+
+    asyncio.run(go())

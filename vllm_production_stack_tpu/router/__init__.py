@@ -1,0 +1,24 @@
+"""OpenAI-compatible request router for the TPU serving stack.
+
+The reference stack's core artifact is its router (src/vllm_router/): an
+OpenAI-compatible proxy that discovers serving-engine pods, tracks their load,
+and routes each request with pluggable algorithms (app.py:83-300,
+routers/routing_logic.py:50-527). This package is the TPU stack's router:
+same capabilities, rebuilt on aiohttp with explicit state wiring (one
+`RouterState` object owned by the app) instead of singleton registries, and
+speaking the `tpu:*` engine metrics contract (metrics_contract.py) instead of
+`vllm:*`.
+"""
+
+from .discovery import Endpoint, ModelInfo, ServiceDiscovery, StaticDiscovery
+from .routing import RoutingContext, RoutingPolicy, make_policy
+
+__all__ = [
+    "Endpoint",
+    "ModelInfo",
+    "ServiceDiscovery",
+    "StaticDiscovery",
+    "RoutingContext",
+    "RoutingPolicy",
+    "make_policy",
+]

@@ -1,0 +1,387 @@
+"""Router application: state wiring + HTTP surface.
+
+The aiohttp equivalent of the reference's FastAPI assembly (app.py:83-300 +
+routers/main_router.py:50-246). All components hang off one `RouterState`
+object owned by the app — construction order and reconfiguration are explicit
+functions, not singleton side effects.
+
+HTTP surface (reference parity):
+  POST /v1/chat/completions /v1/completions /v1/embeddings /v1/rerank
+       /v1/score /tokenize /detokenize      — routed proxy
+  GET  /v1/models                           — aggregated engine models
+  GET  /v1/files/... POST /v1/files         — files service (files.py)
+  POST /v1/batches ...                      — batch API (batch.py)
+  GET  /health /metrics /engines /version
+  POST /sleep /wake_up   GET /is_sleeping   — engine capacity levers
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from aiohttp import web
+
+from ..utils.logging import init_logger
+from .callbacks import load_callbacks
+from .discovery import make_discovery
+from .dynamic_config import DynamicConfigWatcher
+from .engine_stats import EngineStatsScraper
+from .feature_gates import FeatureGates
+from .metrics import RouterMetrics
+from .request_service import RequestService
+from .request_stats import RequestStatsMonitor
+from .rewriter import make_rewriter
+from .routing import make_policy
+from .args import parse_args
+
+logger = init_logger(__name__)
+VERSION = "0.2.0"
+
+
+class RouterState:
+    """Everything the handlers touch. Swappable members (discovery, policy)
+    are replaced atomically by apply_dynamic_config."""
+
+    def __init__(self, args):
+        self.args = args
+        self.discovery = make_discovery(**_discovery_kwargs(args))
+        self.policy = make_policy(args.routing_logic, **_policy_kwargs(vars(args)))
+        self.request_monitor = RequestStatsMonitor(args.request_stats_window)
+        self.engine_scraper = EngineStatsScraper(
+            # lambda-style indirection: the scraper must follow discovery swaps
+            _DiscoveryProxy(self),
+            args.engine_stats_interval,
+        )
+        self.metrics = RouterMetrics()
+        self.request_service = RequestService(self)
+        self.feature_gates = FeatureGates(args.feature_gates)
+        self.rewriter = make_rewriter(args.request_rewriter)
+        self.callbacks = load_callbacks(args.callbacks)
+        self.model_aliases: dict[str, str] = (
+            json.loads(args.model_aliases) if args.model_aliases else {}
+        )
+        self.dynamic_config: DynamicConfigWatcher | None = None
+        self.semantic_cache = None
+        self.pii_middleware = None
+        self.batch_service = None
+        self.files = None
+        self.started_at = time.time()
+
+    async def apply_dynamic_config(self, config: dict) -> None:
+        """Hot-swap discovery/routing from a dynamic config dict."""
+        if "model_aliases" in config:
+            self.model_aliases = dict(config["model_aliases"])
+        if any(k.startswith("static") or k == "service_discovery" for k in config):
+            merged = dict(vars(self.args))
+            merged.update(config)
+            ns = _ArgsView(merged)
+            new = make_discovery(**_discovery_kwargs(ns))
+            old, self.discovery = self.discovery, new
+            await new.start()
+            await old.stop()
+        if "routing_logic" in config:
+            merged = {**vars(self.args), **config}
+            old_policy = self.policy
+            self.policy = make_policy(
+                config["routing_logic"], **_policy_kwargs(merged)
+            )
+            await old_policy.close()
+
+
+class _ArgsView:
+    def __init__(self, d: dict):
+        self.__dict__.update(d)
+
+
+class _DiscoveryProxy:
+    """Lets long-lived components read the *current* discovery through state."""
+
+    def __init__(self, state: RouterState):
+        self._state = state
+
+    def endpoints(self):
+        return self._state.discovery.endpoints()
+
+
+def _discovery_kwargs(args) -> dict:
+    kw: dict = {"kind": args.service_discovery}
+    if args.service_discovery == "static":
+        kw["urls"] = [u.strip() for u in args.static_backends.split(",")]
+        if getattr(args, "static_models", None):
+            kw["models"] = [
+                [m.strip() for m in group.split(",") if m.strip()]
+                for group in args.static_models.split(";")
+            ]
+        if getattr(args, "static_model_labels", None):
+            kw["model_labels"] = [
+                x.strip() for x in args.static_model_labels.split(",")
+            ]
+        kw["probe_interval"] = getattr(args, "health_probe_interval", None)
+    else:
+        kw["k8s"] = {
+            "namespace": args.k8s_namespace,
+            "label_selector": args.k8s_label_selector,
+            "port": args.k8s_port,
+        }
+    return kw
+
+
+def _policy_kwargs(d: dict) -> dict:
+    split = lambda v: [x.strip() for x in v.split(",")] if isinstance(v, str) else (v or [])  # noqa: E731
+    return {
+        "session_key": d.get("session_key") or "",
+        "kv_controller_url": d.get("kv_controller_url") or "",
+        "kv_aware_threshold": d.get("kv_aware_threshold", 256),
+        "prefill_model_labels": split(d.get("prefill_model_labels")),
+        "decode_model_labels": split(d.get("decode_model_labels")),
+    }
+
+
+# -- handlers ---------------------------------------------------------------
+
+
+def _state(request: web.Request) -> RouterState:
+    return request.app["state"]
+
+
+# everything that proxies to or controls engines requires the API key;
+# /health /metrics /version stay open for probes and scrapers
+_PROTECTED_PREFIXES = ("/v1", "/tokenize", "/detokenize")
+_PROTECTED_EXACT = ("/sleep", "/wake_up", "/is_sleeping", "/engines")
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    state = _state(request)
+    key = state.args.api_key
+    needs_auth = request.path.startswith(_PROTECTED_PREFIXES) or (
+        request.path in _PROTECTED_EXACT
+    )
+    if key and needs_auth:
+        auth = request.headers.get("Authorization", "")
+        if auth != f"Bearer {key}":
+            return web.json_response(
+                {"error": {"message": "invalid API key", "type": "auth_error"}},
+                status=401,
+            )
+    return await handler(request)
+
+
+async def handle_openai(request: web.Request) -> web.StreamResponse:
+    state = _state(request)
+    if state.pii_middleware is not None:
+        blocked = await state.pii_middleware.check(request)
+        if blocked is not None:
+            return blocked
+    if state.semantic_cache is not None and request.path == "/v1/chat/completions":
+        hit = await state.semantic_cache.lookup(request)
+        if hit is not None:
+            return hit
+    resp = await state.request_service.route_openai_request(request)
+    return resp
+
+
+async def handle_models(request: web.Request) -> web.Response:
+    state = _state(request)
+    seen: dict[str, dict] = {}
+    for ep in state.discovery.endpoints():
+        for name in ep.model_names:
+            info = ep.model_info.get(name)
+            card = {
+                "id": name,
+                "object": "model",
+                "created": info.created if info else int(ep.added_at),
+                "owned_by": info.owned_by if info else "tpu-stack",
+            }
+            if info and info.parent:
+                card["parent"] = info.parent
+                card["root"] = info.root
+            seen.setdefault(name, card)
+    for alias, target in state.model_aliases.items():
+        if target in seen and alias not in seen:
+            seen[alias] = {**seen[target], "id": alias}
+    return web.json_response({"object": "list", "data": list(seen.values())})
+
+
+async def handle_engines(request: web.Request) -> web.Response:
+    state = _state(request)
+    engine_stats = state.engine_scraper.get_engine_stats()
+    request_stats = state.request_monitor.get_request_stats()
+    out = []
+    for ep in state.discovery.endpoints():
+        entry = ep.to_dict()
+        es = engine_stats.get(ep.url)
+        rs = request_stats.get(ep.url)
+        entry["engine_stats"] = es.__dict__ if es else None
+        entry["request_stats"] = rs.__dict__ if rs else None
+        out.append(entry)
+    return web.json_response({"engines": out})
+
+
+async def handle_health(request: web.Request) -> web.Response:
+    state = _state(request)
+    problems = []
+    if not state.discovery.is_healthy():
+        problems.append("service discovery watcher is down")
+    if not state.engine_scraper.is_healthy():
+        problems.append("engine stats scraper is down")
+    body = {
+        "status": "unhealthy" if problems else "ok",
+        "problems": problems,
+        "version": VERSION,
+        "uptime": time.time() - state.started_at,
+    }
+    if state.dynamic_config is not None:
+        body["dynamic_config"] = {
+            "reloads": state.dynamic_config.reload_count,
+            "current": state.dynamic_config.current,
+        }
+    return web.json_response(body, status=503 if problems else 200)
+
+
+async def handle_metrics(request: web.Request) -> web.Response:
+    state = _state(request)
+    return web.Response(
+        body=state.metrics.render(state),
+        content_type="text/plain",
+        charset="utf-8",
+    )
+
+
+async def handle_version(request: web.Request) -> web.Response:
+    return web.json_response({"version": VERSION})
+
+
+async def handle_sleep(request: web.Request) -> web.Response:
+    return await _state(request).request_service.sleep_control(request, "sleep")
+
+
+async def handle_wake(request: web.Request) -> web.Response:
+    return await _state(request).request_service.sleep_control(request, "wake_up")
+
+
+async def handle_is_sleeping(request: web.Request) -> web.Response:
+    return await _state(request).request_service.sleep_control(
+        request, "is_sleeping"
+    )
+
+
+# -- assembly ---------------------------------------------------------------
+
+OPENAI_PROXY_PATHS = (
+    "/v1/chat/completions",
+    "/v1/completions",
+    "/v1/embeddings",
+    "/v1/rerank",
+    "/v1/score",
+    "/tokenize",
+    "/detokenize",
+    "/v1/audio/transcriptions",
+)
+
+
+def build_app(args) -> web.Application:
+    state = RouterState(args)
+    app = web.Application(middlewares=[auth_middleware], client_max_size=64 * 2**20)
+    app["state"] = state
+
+    for path in OPENAI_PROXY_PATHS:
+        app.router.add_post(path, handle_openai)
+    app.router.add_get("/v1/models", handle_models)
+    app.router.add_get("/engines", handle_engines)
+    app.router.add_get("/health", handle_health)
+    app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/version", handle_version)
+    app.router.add_post("/sleep", handle_sleep)
+    app.router.add_post("/wake_up", handle_wake)
+    app.router.add_get("/is_sleeping", handle_is_sleeping)
+
+    if args.enable_batch_api:
+        from .batch import BatchService
+        from .files import FileStorage
+
+        state.files = FileStorage(args.files_dir)
+        state.batch_service = BatchService(args.batch_db, state)
+        state.files.register_routes(app)
+        state.batch_service.register_routes(app)
+
+    if state.feature_gates.enabled("SemanticCache") and args.semantic_cache_dir:
+        from .semantic_cache import SemanticCache
+
+        state.semantic_cache = SemanticCache(
+            args.semantic_cache_dir, args.semantic_cache_threshold
+        )
+    if state.feature_gates.enabled("PIIDetection"):
+        from .pii import PIIMiddleware
+
+        state.pii_middleware = PIIMiddleware()
+
+    async def on_startup(app):
+        await state.request_service.start()
+        await state.discovery.start()
+        await state.engine_scraper.start()
+        if state.batch_service is not None:
+            await state.batch_service.start()
+        if args.dynamic_config_file:
+            state.dynamic_config = DynamicConfigWatcher(
+                args.dynamic_config_file, state, args.dynamic_config_interval
+            )
+            await state.dynamic_config.start()
+        if args.log_stats_interval > 0:
+            app["log_stats_task"] = asyncio.create_task(
+                _log_stats_loop(state, args.log_stats_interval)
+            )
+
+    async def on_cleanup(app):
+        task = app.get("log_stats_task")
+        if task:
+            task.cancel()
+        if state.dynamic_config is not None:
+            await state.dynamic_config.stop()
+        if state.batch_service is not None:
+            await state.batch_service.stop()
+        await state.engine_scraper.stop()
+        await state.discovery.stop()
+        await state.policy.close()
+        await state.request_service.stop()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+async def _log_stats_loop(state: RouterState, interval: float) -> None:
+    while True:
+        await asyncio.sleep(interval)
+        req = state.request_monitor.get_request_stats()
+        eng = state.engine_scraper.get_engine_stats()
+        for ep in state.discovery.endpoints():
+            r, e = req.get(ep.url), eng.get(ep.url)
+            logger.info(
+                "stats %s qps=%.2f ttft=%.3fs running=%s queued=%s kv=%.1f%%",
+                ep.url,
+                r.qps if r else 0.0,
+                r.ttft if r else 0.0,
+                int(e.num_running_requests) if e else "?",
+                int(e.num_queuing_requests) if e else "?",
+                (e.hbm_kv_usage_perc * 100) if e else 0.0,
+            )
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = parse_args(argv)
+    app = build_app(args)
+    logger.info(
+        "router starting on %s:%d discovery=%s routing=%s",
+        args.host,
+        args.port,
+        args.service_discovery,
+        args.routing_logic,
+    )
+    web.run_app(app, host=args.host, port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()

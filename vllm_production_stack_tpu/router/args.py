@@ -1,0 +1,139 @@
+"""Router CLI.
+
+Three coordinated config layers like the reference (SURVEY §5): argparse CLI
+(reference parsers/parser.py:118-386), an optional YAML/JSON config file whose
+values become parser defaults (CLI wins), and the dynamic-config file watched
+at runtime. Validation mirrors parser.py:85-115: static discovery requires
+backends; session routing requires a session key; PD requires both label
+lists."""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+import yaml
+
+from .routing import ROUTING_POLICIES
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU stack OpenAI-compatible router")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8001)
+    p.add_argument(
+        "--config",
+        default=None,
+        help="YAML/JSON file of defaults for any flag (CLI values win)",
+    )
+
+    d = p.add_argument_group("service discovery")
+    d.add_argument(
+        "--service-discovery",
+        choices=["static", "k8s_pod_ip", "k8s_service_name"],
+        default="static",
+    )
+    d.add_argument(
+        "--static-backends",
+        default=None,
+        help="comma-separated engine base URLs (static mode)",
+    )
+    d.add_argument(
+        "--static-models",
+        default=None,
+        help="semicolon-separated per-backend comma-lists of model names",
+    )
+    d.add_argument(
+        "--static-model-labels",
+        default=None,
+        help="comma-separated per-backend model labels (for PD pools)",
+    )
+    d.add_argument(
+        "--health-probe-interval",
+        type=float,
+        default=None,
+        help="seconds between static-backend health probes (off when unset)",
+    )
+    d.add_argument("--k8s-namespace", default="default")
+    d.add_argument("--k8s-label-selector", default="")
+    d.add_argument("--k8s-port", type=int, default=8000)
+
+    r = p.add_argument_group("routing")
+    r.add_argument("--routing-logic", choices=ROUTING_POLICIES, default="roundrobin")
+    r.add_argument("--session-key", default=None, help="session header name")
+    r.add_argument("--kv-controller-url", default=None)
+    r.add_argument("--kv-aware-threshold", type=int, default=256)
+    r.add_argument("--prefill-model-labels", default=None, help="comma-separated")
+    r.add_argument("--decode-model-labels", default=None, help="comma-separated")
+    r.add_argument(
+        "--model-aliases",
+        default=None,
+        help='JSON object {"alias": "served-model"}',
+    )
+
+    s = p.add_argument_group("stats")
+    s.add_argument("--engine-stats-interval", type=float, default=10.0)
+    s.add_argument("--request-stats-window", type=float, default=60.0)
+    s.add_argument("--log-stats-interval", type=float, default=0.0,
+                   help="seconds between stats log lines (0 = off)")
+
+    x = p.add_argument_group("extensions")
+    x.add_argument("--dynamic-config-file", default=None)
+    x.add_argument("--dynamic-config-interval", type=float, default=10.0)
+    x.add_argument("--callbacks", default=None, help="module[:Class] or path.py")
+    x.add_argument("--request-rewriter", default=None, help="module:Class")
+    x.add_argument("--feature-gates", default="")
+    x.add_argument("--api-key", default=None, help="require this bearer token")
+    x.add_argument("--enable-batch-api", action="store_true")
+    x.add_argument("--files-dir", default="/tmp/tpu_router_files")
+    x.add_argument("--batch-db", default="/tmp/tpu_router_batch.sqlite")
+    x.add_argument(
+        "--semantic-cache-dir", default=None,
+        help="embedding model dir for the semantic cache (gate SemanticCache)",
+    )
+    x.add_argument("--semantic-cache-threshold", type=float, default=0.9)
+    return p
+
+
+def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
+    parser = build_parser()
+    # first pass just to find --config; file values become defaults, CLI wins
+    pre, _ = parser.parse_known_args(argv)
+    if pre.config:
+        text = Path(pre.config).read_text()
+        loaded = (
+            json.loads(text) if pre.config.endswith(".json") else yaml.safe_load(text)
+        ) or {}
+        defaults = {k.replace("-", "_"): v for k, v in loaded.items()}
+        known = {a.dest for a in parser._actions}
+        unknown = set(defaults) - known
+        if unknown:
+            parser.error(f"unknown keys in --config file: {sorted(unknown)}")
+        parser.set_defaults(**defaults)
+    args = parser.parse_args(argv)
+    validate_args(parser, args)
+    return args
+
+
+def validate_args(parser: argparse.ArgumentParser, args) -> None:
+    if args.service_discovery == "static" and not args.static_backends:
+        parser.error("--service-discovery static requires --static-backends")
+    if args.routing_logic == "session" and not args.session_key:
+        parser.error("--routing-logic session requires --session-key")
+    if args.routing_logic == "kvaware" and not args.kv_controller_url:
+        parser.error("--routing-logic kvaware requires --kv-controller-url")
+    if args.routing_logic == "disaggregated_prefill" and not (
+        args.prefill_model_labels and args.decode_model_labels
+    ):
+        parser.error(
+            "--routing-logic disaggregated_prefill requires "
+            "--prefill-model-labels and --decode-model-labels"
+        )
+    if args.static_models and args.static_backends:
+        n_b = len(args.static_backends.split(","))
+        n_m = len(args.static_models.split(";"))
+        if n_b != n_m:
+            parser.error(
+                f"--static-models has {n_m} groups for {n_b} backends"
+            )

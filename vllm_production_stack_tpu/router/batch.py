@@ -1,0 +1,277 @@
+"""OpenAI-style batch API.
+
+Jobs land in a SQLite queue and a background task executes each JSONL line as
+a real routed request through the router's own proxy machinery, writing an
+output file with per-line responses. The reference keeps the same queue shape
+but stubs the processing (services/batch_service/local_processor.py:192-203
+simulates work); here processing is real since the router can route.
+stdlib sqlite3 run in a thread executor — the write rate is a handful of
+status updates per job, not worth an async driver."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sqlite3
+import time
+import uuid
+
+from aiohttp import web
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS batches (
+    id TEXT PRIMARY KEY,
+    status TEXT NOT NULL,
+    input_file_id TEXT NOT NULL,
+    endpoint TEXT NOT NULL,
+    completion_window TEXT,
+    created_at INTEGER,
+    started_at INTEGER,
+    completed_at INTEGER,
+    output_file_id TEXT,
+    error TEXT,
+    user TEXT,
+    counts TEXT DEFAULT '{}'
+)
+"""
+
+
+class BatchService:
+    def __init__(self, db_path: str, state):
+        self.db_path = db_path
+        self.state = state
+        self._task: asyncio.Task | None = None
+
+    # -- db helpers (sync, called via to_thread) ---------------------------
+
+    def _db(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self.db_path)
+        conn.row_factory = sqlite3.Row
+        conn.execute(_SCHEMA)
+        return conn
+
+    def _insert(self, row: dict) -> None:
+        with self._db() as conn:
+            conn.execute(
+                "INSERT INTO batches (id,status,input_file_id,endpoint,"
+                "completion_window,created_at,user) VALUES (?,?,?,?,?,?,?)",
+                (
+                    row["id"],
+                    row["status"],
+                    row["input_file_id"],
+                    row["endpoint"],
+                    row["completion_window"],
+                    row["created_at"],
+                    row["user"],
+                ),
+            )
+
+    def _update(self, batch_id: str, **fields) -> None:
+        sets = ", ".join(f"{k}=?" for k in fields)
+        with self._db() as conn:
+            conn.execute(
+                f"UPDATE batches SET {sets} WHERE id=?",
+                (*fields.values(), batch_id),
+            )
+
+    def _get(self, batch_id: str) -> dict | None:
+        with self._db() as conn:
+            row = conn.execute(
+                "SELECT * FROM batches WHERE id=?", (batch_id,)
+            ).fetchone()
+        return dict(row) if row else None
+
+    def _list(self, user: str) -> list[dict]:
+        with self._db() as conn:
+            rows = conn.execute(
+                "SELECT * FROM batches WHERE user=? ORDER BY created_at", (user,)
+            ).fetchall()
+        return [dict(r) for r in rows]
+
+    def _next_pending(self) -> dict | None:
+        with self._db() as conn:
+            row = conn.execute(
+                "SELECT * FROM batches WHERE status='validating' "
+                "ORDER BY created_at LIMIT 1"
+            ).fetchone()
+        return dict(row) if row else None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._worker())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _worker(self) -> None:
+        while True:
+            try:
+                job = await asyncio.to_thread(self._next_pending)
+                if job is None:
+                    await asyncio.sleep(2.0)
+                    continue
+                await self._process(job)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.warning("batch worker error: %s", e)
+                await asyncio.sleep(2.0)
+
+    async def _process(self, job: dict) -> None:
+        batch_id = job["id"]
+        await asyncio.to_thread(
+            self._update, batch_id, status="in_progress", started_at=int(time.time())
+        )
+        files = self.state.files
+        content = files.get_content(job["user"], job["input_file_id"])
+        if content is None:
+            await asyncio.to_thread(
+                self._update, batch_id, status="failed", error="input file not found"
+            )
+            return
+        out_lines, ok, failed = [], 0, 0
+        for line in content.decode().splitlines():
+            if not line.strip():
+                continue
+            item: dict | None = None
+            try:
+                parsed = json.loads(line)
+                item = parsed if isinstance(parsed, dict) else None
+                if item is None:
+                    raise ValueError("batch line is not a JSON object")
+                resp = await self._run_one(item, job["endpoint"])
+                out_lines.append(json.dumps(resp))
+                ok += 1
+            except Exception as e:
+                failed += 1
+                out_lines.append(
+                    json.dumps(
+                        {
+                            "custom_id": item.get("custom_id") if item else None,
+                            "error": {"message": str(e)},
+                        }
+                    )
+                )
+        out_meta = files.save(
+            job["user"], f"{batch_id}_output.jsonl",
+            "\n".join(out_lines).encode(), "batch_output",
+        )
+        await asyncio.to_thread(
+            self._update,
+            batch_id,
+            status="completed",
+            completed_at=int(time.time()),
+            output_file_id=out_meta["id"],
+            counts=json.dumps({"total": ok + failed, "completed": ok, "failed": failed}),
+        )
+        logger.info("batch %s finished: %d ok, %d failed", batch_id, ok, failed)
+
+    async def _run_one(self, item: dict, endpoint: str) -> dict:
+        """Execute one batch line through an engine chosen by the router's
+        policy (a thin internal client — no HTTP hop through ourselves)."""
+        from .routing import RoutingContext
+
+        body = item.get("body", {})
+        svc = self.state.request_service
+        model = svc.resolve_alias(body.get("model"))
+        eps = svc._eligible_endpoints(model)
+        if not eps:
+            raise RuntimeError(f"no engine for model {model!r}")
+        ctx = RoutingContext(
+            endpoints=eps,
+            request_stats=self.state.request_monitor.get_request_stats(),
+            body=body,
+        )
+        url = await self.state.policy.route(ctx)
+        async with svc.session.post(url + endpoint, json=body) as resp:
+            payload = await resp.json()
+        return {
+            "id": f"batch_req_{uuid.uuid4().hex[:12]}",
+            "custom_id": item.get("custom_id"),
+            "response": {"status_code": resp.status, "body": payload},
+        }
+
+    # -- routes ------------------------------------------------------------
+
+    def register_routes(self, app: web.Application) -> None:
+        app.router.add_post("/v1/batches", self.h_create)
+        app.router.add_get("/v1/batches", self.h_list)
+        app.router.add_get("/v1/batches/{batch_id}", self.h_get)
+        app.router.add_post("/v1/batches/{batch_id}/cancel", self.h_cancel)
+
+    @staticmethod
+    def _user(request: web.Request) -> str:
+        return request.headers.get("X-User-Id", "anonymous")
+
+    @staticmethod
+    def _card(row: dict) -> dict:
+        return {
+            "id": row["id"],
+            "object": "batch",
+            "endpoint": row["endpoint"],
+            "input_file_id": row["input_file_id"],
+            "completion_window": row["completion_window"],
+            "status": row["status"],
+            "created_at": row["created_at"],
+            "in_progress_at": row["started_at"],
+            "completed_at": row["completed_at"],
+            "output_file_id": row["output_file_id"],
+            "request_counts": json.loads(row["counts"] or "{}"),
+            "errors": row["error"],
+        }
+
+    async def h_create(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        for field in ("input_file_id", "endpoint"):
+            if field not in body:
+                return web.json_response(
+                    {"error": {"message": f"missing {field}"}}, status=400
+                )
+        row = {
+            "id": f"batch_{uuid.uuid4().hex[:24]}",
+            "status": "validating",
+            "input_file_id": body["input_file_id"],
+            "endpoint": body["endpoint"],
+            "completion_window": body.get("completion_window", "24h"),
+            "created_at": int(time.time()),
+            "user": self._user(request),
+        }
+        await asyncio.to_thread(self._insert, row)
+        stored = await asyncio.to_thread(self._get, row["id"])
+        return web.json_response(self._card(stored))
+
+    async def h_list(self, request: web.Request) -> web.Response:
+        rows = await asyncio.to_thread(self._list, self._user(request))
+        return web.json_response(
+            {"object": "list", "data": [self._card(r) for r in rows]}
+        )
+
+    async def h_get(self, request: web.Request) -> web.Response:
+        row = await asyncio.to_thread(self._get, request.match_info["batch_id"])
+        if row is None:
+            return web.json_response(
+                {"error": {"message": "batch not found"}}, status=404
+            )
+        return web.json_response(self._card(row))
+
+    async def h_cancel(self, request: web.Request) -> web.Response:
+        batch_id = request.match_info["batch_id"]
+        row = await asyncio.to_thread(self._get, batch_id)
+        if row is None:
+            return web.json_response(
+                {"error": {"message": "batch not found"}}, status=404
+            )
+        if row["status"] in ("validating", "in_progress"):
+            await asyncio.to_thread(self._update, batch_id, status="cancelled")
+            row = await asyncio.to_thread(self._get, batch_id)
+        return web.json_response(self._card(row))

@@ -1,0 +1,67 @@
+"""User-supplied request callbacks.
+
+The reference dynamically imports a module exposing `CustomCallbackHandler`
+with `pre_request` (may short-circuit a response) and `post_request` hooks
+(services/callbacks_service/callbacks.py:23-32). Same contract here; hooks
+are awaited, and a non-None return from pre_request is sent to the client
+instead of proxying."""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import inspect
+import sys
+from pathlib import Path
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class CallbackHandler:
+    async def pre_request(self, request, body: dict):
+        """Return an aiohttp Response to short-circuit, or None to proceed."""
+        return None
+
+    async def post_request(self, request, response_body: bytes) -> None:
+        return None
+
+
+class _UserCallbacks(CallbackHandler):
+    def __init__(self, impl) -> None:
+        self.impl = impl
+
+    async def _call(self, fn, *args):
+        if fn is None:
+            return None
+        out = fn(*args)
+        if inspect.isawaitable(out):
+            out = await out
+        return out
+
+    async def pre_request(self, request, body: dict):
+        return await self._call(getattr(self.impl, "pre_request", None), request, body)
+
+    async def post_request(self, request, response_body: bytes) -> None:
+        await self._call(
+            getattr(self.impl, "post_request", None), request, response_body
+        )
+
+
+def load_callbacks(spec: str | None) -> CallbackHandler | None:
+    """`spec` is "module" / "module:Class" / a path to a .py file."""
+    if not spec:
+        return None
+    mod_name, _, cls_name = spec.partition(":")
+    if mod_name.endswith(".py"):
+        path = Path(mod_name)
+        loader_spec = importlib.util.spec_from_file_location(path.stem, path)
+        module = importlib.util.module_from_spec(loader_spec)
+        sys.modules[path.stem] = module
+        loader_spec.loader.exec_module(module)
+    else:
+        module = importlib.import_module(mod_name)
+    cls = getattr(module, cls_name or "CustomCallbackHandler")
+    logger.info("loaded custom callbacks from %s", spec)
+    return _UserCallbacks(cls())

@@ -1,0 +1,419 @@
+"""Serving-engine discovery for the router.
+
+The reference tracks engine endpoints three ways (service_discovery.py:206-1176):
+a static URL list with optional health probes, a Kubernetes pod-IP watch, and a
+Kubernetes service watch. Same trio here. The Kubernetes modes talk to the API
+server directly over aiohttp streaming watches (the `kubernetes` client package
+is not a dependency); in-cluster credentials come from the standard service
+account mount.
+
+Discovery is the single source of truth for (a) which engines exist, (b) which
+models each serves (scraped from the engine's /v1/models), and (c) whether an
+engine is sleeping — routing filters on all three.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import ssl
+import time
+import uuid
+from dataclasses import dataclass, field
+
+import aiohttp
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+@dataclass
+class ModelInfo:
+    """One entry of an engine's /v1/models listing. `parent` set means a LoRA
+    adapter derived from a base model (the reference's adapter convention,
+    service_discovery.py:42-77)."""
+
+    id: str
+    created: int = 0
+    owned_by: str = "tpu-stack"
+    root: str | None = None
+    parent: str | None = None
+
+    @property
+    def is_adapter(self) -> bool:
+        return self.parent is not None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModelInfo":
+        return cls(
+            id=d.get("id", ""),
+            created=d.get("created", 0),
+            owned_by=d.get("owned_by", "tpu-stack"),
+            root=d.get("root"),
+            parent=d.get("parent"),
+        )
+
+
+@dataclass
+class Endpoint:
+    """A live serving engine the router can proxy to."""
+
+    url: str
+    model_names: list[str] = field(default_factory=list)
+    endpoint_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
+    model_label: str = ""
+    added_at: float = field(default_factory=time.time)
+    sleeping: bool = False
+    healthy: bool = True
+    pod_name: str | None = None
+    namespace: str | None = None
+    model_info: dict[str, ModelInfo] = field(default_factory=dict)
+
+    def has_model(self, model: str) -> bool:
+        return model in self.model_names
+
+    def base_models(self) -> list[str]:
+        return [m for m, i in self.model_info.items() if not i.parent]
+
+    def adapters(self) -> list[str]:
+        return [m for m, i in self.model_info.items() if i.parent]
+
+    def to_dict(self) -> dict:
+        return {
+            "url": self.url,
+            "model_names": self.model_names,
+            "endpoint_id": self.endpoint_id,
+            "model_label": self.model_label,
+            "added_at": self.added_at,
+            "sleeping": self.sleeping,
+            "healthy": self.healthy,
+            "pod_name": self.pod_name,
+            "namespace": self.namespace,
+        }
+
+
+class ServiceDiscovery:
+    """Base: maintains the endpoint snapshot the hot path reads.
+
+    `endpoints()` must be cheap and non-blocking — it is called on every
+    request (reference request.py:207-208 takes a lock-guarded copy; here the
+    snapshot is an immutable list swapped atomically, so readers need no lock).
+    """
+
+    def __init__(self) -> None:
+        self._snapshot: list[Endpoint] = []
+
+    def endpoints(self) -> list[Endpoint]:
+        return self._snapshot
+
+    def _publish(self, eps: list[Endpoint]) -> None:
+        self._snapshot = list(eps)
+
+    async def start(self) -> None:  # pragma: no cover - overridden
+        pass
+
+    async def stop(self) -> None:  # pragma: no cover - overridden
+        pass
+
+    def is_healthy(self) -> bool:
+        return True
+
+    def set_sleeping(self, url: str, sleeping: bool) -> None:
+        """Record an engine's sleep state so routing can skip it (the
+        reference labels the pod instead, service_discovery.py:414-496; the
+        router-side flag covers static mode too)."""
+        for ep in self._snapshot:
+            if ep.url == url:
+                ep.sleeping = sleeping
+
+
+class StaticDiscovery(ServiceDiscovery):
+    """Fixed URL list, with an optional async health/model prober.
+
+    Mirrors the reference's StaticServiceDiscovery behavior
+    (service_discovery.py:206-341): when probing is on, each engine's
+    /v1/models is scraped on an interval; engines that fail the probe drop out
+    of the snapshot until they recover.
+    """
+
+    def __init__(
+        self,
+        urls: list[str],
+        models: list[list[str]] | None = None,
+        model_labels: list[str] | None = None,
+        probe_interval: float | None = None,
+    ):
+        super().__init__()
+        self.urls = urls
+        self.static_models = models
+        self.probe_interval = probe_interval
+        labels = model_labels or [""] * len(urls)
+        self._endpoints = [
+            Endpoint(
+                url=u,
+                model_names=list(models[i]) if models else [],
+                model_label=labels[i] if i < len(labels) else "",
+            )
+            for i, u in enumerate(urls)
+        ]
+        self._publish(self._endpoints)
+        self._task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        if self.probe_interval:
+            self._task = asyncio.create_task(self._probe_loop())
+        elif not self.static_models:
+            # one-shot best-effort model scrape so /v1/models isn't empty
+            await self._probe_once()
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _probe_loop(self) -> None:
+        while True:
+            try:
+                await self._probe_once()
+            except Exception as e:  # keep probing through transient faults
+                logger.warning("health probe pass failed: %s", e)
+            await asyncio.sleep(self.probe_interval)
+
+    async def _probe_once(self) -> None:
+        timeout = aiohttp.ClientTimeout(total=5)
+        async with aiohttp.ClientSession(timeout=timeout) as sess:
+            results = await asyncio.gather(
+                *(self._probe_endpoint(sess, ep) for ep in self._endpoints)
+            )
+        self._publish([ep for ep, ok in zip(self._endpoints, results) if ok])
+
+    async def _probe_endpoint(
+        self, sess: aiohttp.ClientSession, ep: Endpoint
+    ) -> bool:
+        try:
+            async with sess.get(ep.url + "/v1/models") as resp:
+                if resp.status != 200:
+                    ep.healthy = False
+                    return False
+                data = await resp.json()
+            ep.model_info = {
+                m["id"]: ModelInfo.from_dict(m) for m in data.get("data", [])
+            }
+            scraped = list(ep.model_info)
+            if self.static_models:
+                # static model list is authoritative; probe only gates health
+                if not ep.model_names:
+                    ep.model_names = scraped
+            else:
+                ep.model_names = scraped
+            ep.healthy = True
+            return True
+        except Exception:
+            ep.healthy = False
+            return False
+
+
+class KubernetesDiscovery(ServiceDiscovery):
+    """Watches pods (or services) matching a label selector via the API
+    server's streaming watch, scraping each ready pod's /v1/models.
+
+    The reference does the same through the kubernetes client in a daemon
+    thread (service_discovery.py:344-759); here it's an asyncio task speaking
+    the watch protocol directly. Ready pods with a `model` label become
+    endpoints; pods labeled `sleeping=true` stay listed but are filtered by
+    routing; deleted/unready pods drop out.
+    """
+
+    def __init__(
+        self,
+        namespace: str = "default",
+        label_selector: str = "",
+        port: int = 8000,
+        mode: str = "pod",  # "pod" (pod IPs) or "service" (service DNS)
+        api_server: str | None = None,
+        token: str | None = None,
+        rescrape_interval: float = 30.0,
+    ):
+        super().__init__()
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.port = port
+        self.mode = mode
+        self.rescrape_interval = rescrape_interval
+        self._api_server = api_server
+        self._token = token
+        self._ssl: ssl.SSLContext | bool = False
+        self._eps: dict[str, Endpoint] = {}  # pod/service name -> endpoint
+        self._task: asyncio.Task | None = None
+        self._watch_alive = False
+
+    # -- credentials -------------------------------------------------------
+
+    def _load_in_cluster(self) -> None:
+        if self._api_server is None:
+            import os
+
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            self._api_server = f"https://{host}:{port}"
+            try:
+                with open(f"{SA_DIR}/token") as f:
+                    self._token = f.read().strip()
+                ctx = ssl.create_default_context(cafile=f"{SA_DIR}/ca.crt")
+                self._ssl = ctx
+            except FileNotFoundError:
+                logger.warning("no in-cluster service account credentials found")
+
+    @property
+    def _headers(self) -> dict[str, str]:
+        return {"Authorization": f"Bearer {self._token}"} if self._token else {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._load_in_cluster()
+        self._task = asyncio.create_task(self._watch_loop())
+        self._rescrape_task = asyncio.create_task(self._rescrape_loop())
+
+    async def stop(self) -> None:
+        for task in (self._task, getattr(self, "_rescrape_task", None)):
+            if task:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+
+    async def _rescrape_loop(self) -> None:
+        """Periodically refresh each endpoint's model list: pods load LoRA
+        adapters (and finish model loads) without emitting pod events, so the
+        watch alone would serve a stale /v1/models forever."""
+        while True:
+            await asyncio.sleep(self.rescrape_interval)
+            try:
+                async with aiohttp.ClientSession(headers=self._headers) as sess:
+                    for name, ep in list(self._eps.items()):
+                        models = await self._scrape_models(sess, ep.url)
+                        if models is not None:
+                            ep.model_info = models
+                            ep.model_names = list(models)
+                self._publish(list(self._eps.values()))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.warning("model rescrape pass failed: %s", e)
+
+    def is_healthy(self) -> bool:
+        return self._watch_alive
+
+    # -- watch -------------------------------------------------------------
+
+    def _watch_url(self, watch: bool) -> str:
+        kind = "pods" if self.mode == "pod" else "services"
+        url = f"{self._api_server}/api/v1/namespaces/{self.namespace}/{kind}"
+        sel = f"labelSelector={self.label_selector}" if self.label_selector else ""
+        q = "&".join(x for x in (sel, "watch=true" if watch else "") if x)
+        return f"{url}?{q}" if q else url
+
+    async def _watch_loop(self) -> None:
+        while True:
+            try:
+                async with aiohttp.ClientSession(headers=self._headers) as sess:
+                    # initial list, then watch for deltas
+                    async with sess.get(self._watch_url(False), ssl=self._ssl) as r:
+                        data = await r.json()
+                    for item in data.get("items", []):
+                        await self._on_event(sess, "ADDED", item)
+                    self._watch_alive = True
+                    timeout = aiohttp.ClientTimeout(total=None, sock_read=300)
+                    async with sess.get(
+                        self._watch_url(True), ssl=self._ssl, timeout=timeout
+                    ) as resp:
+                        async for line in resp.content:
+                            if not line.strip():
+                                continue
+                            ev = json.loads(line)
+                            await self._on_event(
+                                sess, ev.get("type", ""), ev.get("object", {})
+                            )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self._watch_alive = False
+                logger.warning("k8s watch interrupted, retrying: %s", e)
+                await asyncio.sleep(2.0)
+
+    async def _on_event(
+        self, sess: aiohttp.ClientSession, ev_type: str, obj: dict
+    ) -> None:
+        meta = obj.get("metadata", {})
+        name = meta.get("name", "")
+        if not name:
+            return
+        if ev_type == "DELETED" or meta.get("deletionTimestamp"):
+            self._eps.pop(name, None)
+            self._publish(list(self._eps.values()))
+            return
+        labels = meta.get("labels", {}) or {}
+        if self.mode == "pod":
+            status = obj.get("status", {})
+            ready = any(
+                c.get("type") == "Ready" and c.get("status") == "True"
+                for c in status.get("conditions", [])
+            )
+            ip = status.get("podIP")
+            if not ready or not ip:
+                self._eps.pop(name, None)
+                self._publish(list(self._eps.values()))
+                return
+            url = f"http://{ip}:{self.port}"
+        else:
+            url = f"http://{name}.{self.namespace}.svc:{self.port}"
+
+        ep = self._eps.get(name)
+        if ep is None or ep.url != url:
+            ep = Endpoint(url=url, pod_name=name, namespace=self.namespace)
+            models = await self._scrape_models(sess, url)
+            if models is None:
+                return  # not serving yet; next MODIFIED event retries
+            ep.model_info = models
+            ep.model_names = list(models)
+        ep.model_label = labels.get("model", ep.model_label)
+        ep.sleeping = labels.get("sleeping", "") == "true"
+        self._eps[name] = ep
+        self._publish(list(self._eps.values()))
+
+    async def _scrape_models(
+        self, sess: aiohttp.ClientSession, url: str
+    ) -> dict[str, ModelInfo] | None:
+        try:
+            async with sess.get(
+                url + "/v1/models", timeout=aiohttp.ClientTimeout(total=5)
+            ) as resp:
+                if resp.status != 200:
+                    return None
+                data = await resp.json()
+            return {m["id"]: ModelInfo.from_dict(m) for m in data.get("data", [])}
+        except Exception:
+            return None
+
+
+def make_discovery(kind: str, **kw) -> ServiceDiscovery:
+    if kind == "static":
+        return StaticDiscovery(
+            urls=kw["urls"],
+            models=kw.get("models"),
+            model_labels=kw.get("model_labels"),
+            probe_interval=kw.get("probe_interval"),
+        )
+    if kind in ("k8s", "k8s_pod_ip"):
+        return KubernetesDiscovery(mode="pod", **kw.get("k8s", {}))
+    if kind in ("k8s_service", "k8s_service_name"):
+        return KubernetesDiscovery(mode="service", **kw.get("k8s", {}))
+    raise ValueError(f"unknown service discovery kind: {kind}")

@@ -1,0 +1,93 @@
+"""Hot-reloadable router configuration.
+
+Watches a YAML/JSON file and, on content change, swaps the app's discovery
+and routing policy in place — the reference's DynamicConfigWatcher
+(dynamic_config.py:43-288) with an asyncio task instead of a thread. The
+current config and a reload counter surface in /health so operators can
+confirm a rollout took."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from pathlib import Path
+
+import yaml
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+# keys the watcher understands; anything else in the file is rejected loudly
+_ALLOWED = {
+    "service_discovery",
+    "static_backends",
+    "static_models",
+    "static_model_labels",
+    "routing_logic",
+    "session_key",
+    "kv_controller_url",
+    "kv_aware_threshold",
+    "prefill_model_labels",
+    "decode_model_labels",
+    "model_aliases",
+}
+
+
+def load_config_file(path: str | Path) -> dict:
+    text = Path(path).read_text()
+    data = (
+        json.loads(text)
+        if str(path).endswith(".json")
+        else yaml.safe_load(text) or {}
+    )
+    unknown = set(data) - _ALLOWED
+    if unknown:
+        raise ValueError(f"unknown dynamic config keys: {sorted(unknown)}")
+    return data
+
+
+class DynamicConfigWatcher:
+    def __init__(self, path: str, state, interval: float = 10.0):
+        self.path = Path(path)
+        self.state = state
+        self.interval = interval
+        self.reload_count = 0
+        self.current: dict = {}
+        self._last_raw: str | None = None
+        self._task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.check_once()
+            except Exception as e:
+                logger.warning("dynamic config reload failed: %s", e)
+            await asyncio.sleep(self.interval)
+
+    async def check_once(self) -> bool:
+        """Returns True when a reload was applied."""
+        try:
+            raw = self.path.read_text()
+        except FileNotFoundError:
+            return False
+        if raw == self._last_raw:
+            return False
+        config = load_config_file(self.path)
+        await self.state.apply_dynamic_config(config)
+        self._last_raw = raw
+        self.current = config
+        self.reload_count += 1
+        logger.info("applied dynamic config #%d from %s", self.reload_count, self.path)
+        return True

@@ -1,0 +1,104 @@
+"""Engine /metrics scraper.
+
+Polls every discovered engine's Prometheus endpoint and parses the `tpu:*`
+serving metrics into an `EngineStats` snapshot per URL. This is the TPU
+counterpart of the reference's EngineStatsScraper, which parses `vllm:*`
+names (stats/engine_stats.py:42-218); the names here come from
+metrics_contract.py so engine exporter and router scraper can't drift.
+Runs as an asyncio task (the reference uses a daemon thread)."""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+import aiohttp
+from prometheus_client.parser import text_string_to_metric_families
+
+from .. import metrics_contract as mc
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class EngineStats:
+    num_running_requests: float = 0
+    num_queuing_requests: float = 0
+    hbm_kv_usage_perc: float = 0.0
+    prefix_cache_hit_rate: float = 0.0
+    prefix_cache_hits_total: float = 0
+    prefix_cache_queries_total: float = 0
+
+    _FIELDS = {
+        mc.NUM_REQUESTS_RUNNING: "num_running_requests",
+        mc.NUM_REQUESTS_WAITING: "num_queuing_requests",
+        mc.HBM_KV_USAGE_PERC: "hbm_kv_usage_perc",
+        mc.PREFIX_CACHE_HIT_RATE: "prefix_cache_hit_rate",
+        mc.PREFIX_CACHE_HITS: "prefix_cache_hits_total",
+        mc.PREFIX_CACHE_QUERIES: "prefix_cache_queries_total",
+    }
+
+    @classmethod
+    def from_scrape(cls, text: str) -> "EngineStats":
+        stats = cls()
+        for family in text_string_to_metric_families(text):
+            for sample in family.samples:
+                # counters' samples keep the _total suffix the family drops
+                field = cls._FIELDS.get(sample.name)
+                if field is not None:
+                    setattr(stats, field, sample.value)
+        return stats
+
+
+class EngineStatsScraper:
+    def __init__(self, discovery, interval: float = 10.0):
+        self.discovery = discovery
+        self.interval = interval
+        self._stats: dict[str, EngineStats] = {}
+        self._task: asyncio.Task | None = None
+
+    def get_engine_stats(self) -> dict[str, EngineStats]:
+        return dict(self._stats)
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    def is_healthy(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.scrape_once()
+            except Exception as e:
+                logger.warning("engine stats scrape failed: %s", e)
+            await asyncio.sleep(self.interval)
+
+    async def scrape_once(self) -> None:
+        eps = self.discovery.endpoints()
+        timeout = aiohttp.ClientTimeout(total=5)
+        async with aiohttp.ClientSession(timeout=timeout) as sess:
+            results = await asyncio.gather(
+                *(self._scrape(sess, ep.url) for ep in eps)
+            )
+        fresh = {url: s for url, s in results if s is not None}
+        # keep only live endpoints so dead engines don't pin stale stats
+        self._stats = fresh
+
+    async def _scrape(self, sess, url: str):
+        try:
+            async with sess.get(url + "/metrics") as resp:
+                if resp.status != 200:
+                    return url, None
+                return url, EngineStats.from_scrape(await resp.text())
+        except Exception:
+            return url, None

@@ -1,0 +1,55 @@
+"""Kubernetes-style feature gates.
+
+`--feature-gates SemanticCache=true,PIIDetection=false` toggles optional
+subsystems; each gate has a maturity stage with a default (reference
+experimental/feature_gates.py:16-109). Parsed once at startup into a plain
+object on the app state — no singleton."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class Stage(enum.Enum):
+    ALPHA = "alpha"  # default off
+    BETA = "beta"  # default on
+    GA = "ga"  # always on
+
+
+@dataclass(frozen=True)
+class GateSpec:
+    name: str
+    stage: Stage
+    description: str
+
+
+KNOWN_GATES = {
+    g.name: g
+    for g in (
+        GateSpec("SemanticCache", Stage.ALPHA, "semantic response cache"),
+        GateSpec("PIIDetection", Stage.ALPHA, "PII request screening"),
+    )
+}
+
+
+class FeatureGates:
+    def __init__(self, spec: str = ""):
+        self._enabled: dict[str, bool] = {
+            name: g.stage is not Stage.ALPHA for name, g in KNOWN_GATES.items()
+        }
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            name, _, value = part.partition("=")
+            if name not in KNOWN_GATES:
+                raise ValueError(
+                    f"unknown feature gate {name!r}; known: {sorted(KNOWN_GATES)}"
+                )
+            if KNOWN_GATES[name].stage is Stage.GA and value.lower() == "false":
+                raise ValueError(f"GA feature gate {name!r} cannot be disabled")
+            self._enabled[name] = value.lower() in ("true", "1", "yes")
+
+    def enabled(self, name: str) -> bool:
+        return self._enabled.get(name, False)
+
+    def as_dict(self) -> dict[str, bool]:
+        return dict(self._enabled)

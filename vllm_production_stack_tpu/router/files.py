@@ -1,0 +1,145 @@
+"""OpenAI-style files service with local-disk storage.
+
+Reference: services/files_service/ (storage.py:20-170, file_storage.py:27-136)
+— an abstract Storage with a local-FS impl under a per-user directory, plus
+`/v1/files` upload/get/content routes. Same surface here; metadata rides in a
+sidecar JSON next to each stored blob."""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+import uuid
+from pathlib import Path
+
+from aiohttp import web
+
+_SAFE_COMPONENT = re.compile(r"[A-Za-z0-9._@-]{1,128}")
+
+
+class FileStorage:
+    def __init__(self, root: str):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    # -- storage ----------------------------------------------------------
+
+    @staticmethod
+    def _safe(component: str) -> str:
+        """Path components come from client headers/URLs — allow only a flat
+        name so neither `..` nor absolute paths can escape the storage root."""
+        if not component or not _SAFE_COMPONENT.fullmatch(component):
+            raise web.HTTPBadRequest(
+                text=json.dumps(
+                    {"error": {"message": f"invalid identifier {component!r}"}}
+                ),
+                content_type="application/json",
+            )
+        return component
+
+    def _paths(self, user: str, file_id: str) -> tuple[Path, Path]:
+        d = self.root / self._safe(user)
+        file_id = self._safe(file_id)
+        return d / file_id, d / f"{file_id}.json"
+
+    def save(self, user: str, filename: str, content: bytes, purpose: str) -> dict:
+        file_id = f"file-{uuid.uuid4().hex[:24]}"
+        blob, meta_path = self._paths(user, file_id)
+        blob.parent.mkdir(parents=True, exist_ok=True)
+        blob.write_bytes(content)
+        meta = {
+            "id": file_id,
+            "object": "file",
+            "bytes": len(content),
+            "created_at": int(time.time()),
+            "filename": filename,
+            "purpose": purpose,
+        }
+        meta_path.write_text(json.dumps(meta))
+        return meta
+
+    def get_meta(self, user: str, file_id: str) -> dict | None:
+        _, meta_path = self._paths(user, file_id)
+        if not meta_path.exists():
+            return None
+        return json.loads(meta_path.read_text())
+
+    def get_content(self, user: str, file_id: str) -> bytes | None:
+        blob, _ = self._paths(user, file_id)
+        return blob.read_bytes() if blob.exists() else None
+
+    def list_files(self, user: str) -> list[dict]:
+        d = self.root / user
+        if not d.exists():
+            return []
+        return sorted(
+            (json.loads(p.read_text()) for p in d.glob("*.json")),
+            key=lambda m: m["created_at"],
+        )
+
+    def delete(self, user: str, file_id: str) -> bool:
+        blob, meta_path = self._paths(user, file_id)
+        existed = blob.exists()
+        blob.unlink(missing_ok=True)
+        meta_path.unlink(missing_ok=True)
+        return existed
+
+    # -- routes ------------------------------------------------------------
+
+    def register_routes(self, app: web.Application) -> None:
+        app.router.add_post("/v1/files", self.h_upload)
+        app.router.add_get("/v1/files", self.h_list)
+        app.router.add_get("/v1/files/{file_id}", self.h_get)
+        app.router.add_delete("/v1/files/{file_id}", self.h_delete)
+        app.router.add_get("/v1/files/{file_id}/content", self.h_content)
+
+    @staticmethod
+    def _user(request: web.Request) -> str:
+        return request.headers.get("X-User-Id", "anonymous")
+
+    async def h_upload(self, request: web.Request) -> web.Response:
+        if not request.content_type.startswith("multipart/"):
+            return web.json_response(
+                {"error": {"message": "multipart/form-data upload expected"}},
+                status=400,
+            )
+        reader = await request.multipart()
+        purpose, filename, content = "batch", "upload", b""
+        async for part in reader:
+            if part.name == "purpose":
+                purpose = (await part.read()).decode()
+            elif part.name == "file":
+                filename = part.filename or "upload"
+                content = await part.read()
+        meta = self.save(self._user(request), filename, content, purpose)
+        return web.json_response(meta)
+
+    async def h_list(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"object": "list", "data": self.list_files(self._user(request))}
+        )
+
+    async def h_get(self, request: web.Request) -> web.Response:
+        meta = self.get_meta(self._user(request), request.match_info["file_id"])
+        if meta is None:
+            return web.json_response(
+                {"error": {"message": "file not found"}}, status=404
+            )
+        return web.json_response(meta)
+
+    async def h_delete(self, request: web.Request) -> web.Response:
+        fid = request.match_info["file_id"]
+        ok = self.delete(self._user(request), fid)
+        return web.json_response(
+            {"id": fid, "object": "file", "deleted": ok},
+            status=200 if ok else 404,
+        )
+
+    async def h_content(self, request: web.Request) -> web.Response:
+        content = self.get_content(self._user(request), request.match_info["file_id"])
+        if content is None:
+            return web.json_response(
+                {"error": {"message": "file not found"}}, status=404
+            )
+        return web.Response(body=content, content_type="application/octet-stream")

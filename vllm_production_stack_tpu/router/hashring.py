@@ -1,0 +1,72 @@
+"""Consistent-hash ring for session-sticky routing.
+
+The reference uses the `uhashring` package (routing_logic.py:170-219); this is
+a self-contained equivalent: each node owns `replicas` virtual points on a
+64-bit ring (xxhash64 of "node#i"), a key maps to the first point clockwise.
+Adding/removing a node only remaps the keys that landed on its points — the
+property the reference's session-stickiness tests assert
+(src/tests/test_session_router.py:24-230).
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import xxhash
+
+
+def _h64(s: str) -> int:
+    return xxhash.xxh64_intdigest(s)
+
+
+class HashRing:
+    def __init__(self, replicas: int = 120):
+        self.replicas = replicas
+        self._points: list[int] = []  # sorted virtual-point hashes
+        self._owner: dict[int, str] = {}  # point hash -> node
+        self._nodes: set[str] = set()
+
+    def nodes(self) -> set[str]:
+        return set(self._nodes)
+
+    def add_node(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self.replicas):
+            p = _h64(f"{node}#{i}")
+            # 64-bit collisions across distinct nodes are ~impossible; keep
+            # first owner if one happens so removal stays symmetric
+            if p in self._owner:
+                continue
+            self._owner[p] = node
+            bisect.insort(self._points, p)
+
+    def remove_node(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        for i in range(self.replicas):
+            p = _h64(f"{node}#{i}")
+            if self._owner.get(p) == node:
+                del self._owner[p]
+                idx = bisect.bisect_left(self._points, p)
+                self._points.pop(idx)
+
+    def sync(self, nodes: list[str]) -> None:
+        """Converge ring membership to `nodes` (reference
+        _update_hash_ring, routing_logic.py:84-103)."""
+        target = set(nodes)
+        for n in self._nodes - target:
+            self.remove_node(n)
+        for n in target - self._nodes:
+            self.add_node(n)
+
+    def get_node(self, key: str) -> str | None:
+        if not self._points:
+            return None
+        p = _h64(key)
+        idx = bisect.bisect_right(self._points, p)
+        if idx == len(self._points):
+            idx = 0  # wrap
+        return self._owner[self._points[idx]]

@@ -1,0 +1,86 @@
+"""Prefix hash-trie for prefix-aware routing.
+
+Prompts are split into fixed-size character chunks; each chunk is xxhash64'd
+and the hash sequence walks a trie whose nodes record which endpoints have
+seen that prefix (reference prefix/hashtrie.py:24-103, chunk size 128 —
+matching the Go gateway picker, prefix_aware_picker.go:134-213). The router
+inserts the prompt under whichever endpoint it picked, so the trie converges
+to "who has which prefix cached" without talking to the engines.
+
+Mutations and lookups take one asyncio lock: trie ops are microseconds of
+pure CPU, so per-node locks (the reference's choice) buy contention relief
+the router doesn't need.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import xxhash
+
+CHUNK_CHARS = 128
+
+
+class _Node:
+    __slots__ = ("children", "endpoints")
+
+    def __init__(self) -> None:
+        self.children: dict[int, _Node] = {}
+        self.endpoints: set[str] = set()
+
+
+class HashTrie:
+    def __init__(self, chunk_chars: int = CHUNK_CHARS):
+        self.chunk_chars = chunk_chars
+        self.root = _Node()
+        self._lock = asyncio.Lock()
+
+    def _chunks(self, text: str):
+        for i in range(0, len(text), self.chunk_chars):
+            yield xxhash.xxh64_intdigest(text[i : i + self.chunk_chars])
+
+    async def insert(self, text: str, endpoint: str) -> None:
+        async with self._lock:
+            node = self.root
+            node.endpoints.add(endpoint)
+            for h in self._chunks(text):
+                node = node.children.setdefault(h, _Node())
+                node.endpoints.add(endpoint)
+
+    async def longest_prefix_match(
+        self, text: str, available: set[str] | None = None
+    ) -> tuple[int, set[str]]:
+        """Returns (matched chunk count, endpoints sharing that prefix). When
+        nothing matches, the candidate set falls back to `available` (pick
+        anywhere, then insert) — reference hashtrie.py:76-103."""
+        async with self._lock:
+            node = self.root
+            matched = 0
+            best: set[str] = set()
+            for h in self._chunks(text):
+                nxt = node.children.get(h)
+                if nxt is None:
+                    break
+                cand = (
+                    nxt.endpoints & available
+                    if available is not None
+                    else nxt.endpoints
+                )
+                if not cand:
+                    break
+                node = nxt
+                matched += 1
+                best = cand
+        if not best:
+            best = set(available) if available else set()
+        return matched, best
+
+    async def remove_endpoint(self, endpoint: str) -> None:
+        """Drop a dead endpoint everywhere (lazily pruning empty nodes is not
+        worth the bookkeeping at router scale)."""
+        async with self._lock:
+            stack = [self.root]
+            while stack:
+                node = stack.pop()
+                node.endpoints.discard(endpoint)
+                stack.extend(node.children.values())

@@ -1,0 +1,68 @@
+"""Router-side Prometheus metrics.
+
+Per-engine gauges refreshed from the stats monitors on each /metrics scrape
+(pull-time refresh instead of the reference's push-from-logger-thread,
+services/metrics_service/__init__.py + routers/metrics_router.py:42-123).
+Engine-scraped prefix-cache numbers are re-exported so dashboards and the
+prometheus-adapter can read everything from the router.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+LABEL = ["server"]
+
+
+class RouterMetrics:
+    def __init__(self) -> None:
+        self.registry = CollectorRegistry()
+        g = lambda name, doc: Gauge(  # noqa: E731
+            name, doc, LABEL, registry=self.registry
+        )
+        self.current_qps = g("router_current_qps", "Arrival QPS per engine")
+        self.avg_ttft = g("router_avg_ttft", "Avg time-to-first-token (s)")
+        self.avg_latency = g("router_avg_latency", "Avg request latency (s)")
+        self.in_prefill = g("router_num_prefill_requests", "Requests awaiting first byte")
+        self.in_decoding = g("router_num_decoding_requests", "Requests streaming")
+        self.finished = g("router_num_finished_requests", "Finished requests")
+        self.engine_running = g(
+            "router_engine_num_running_requests", "Engine-reported running requests"
+        )
+        self.engine_queuing = g(
+            "router_engine_num_queuing_requests", "Engine-reported queued requests"
+        )
+        self.kv_usage = g(
+            "router_engine_hbm_kv_usage_perc", "Engine-reported HBM KV usage fraction"
+        )
+        self.kv_hit_rate = g(
+            "router_engine_prefix_cache_hit_rate", "Engine-reported prefix cache hit rate"
+        )
+        self.healthy_engines = Gauge(
+            "router_healthy_engines_total",
+            "Engines currently routable",
+            registry=self.registry,
+        )
+
+    def render(self, state) -> bytes:
+        req_stats = state.request_monitor.get_request_stats()
+        for url, st in req_stats.items():
+            self.current_qps.labels(server=url).set(st.qps)
+            self.avg_ttft.labels(server=url).set(st.ttft)
+            self.avg_latency.labels(server=url).set(st.latency)
+            self.in_prefill.labels(server=url).set(st.in_prefill_requests)
+            self.in_decoding.labels(server=url).set(st.in_decoding_requests)
+            self.finished.labels(server=url).set(st.finished_requests)
+        for url, st in state.engine_scraper.get_engine_stats().items():
+            self.engine_running.labels(server=url).set(st.num_running_requests)
+            self.engine_queuing.labels(server=url).set(st.num_queuing_requests)
+            self.kv_usage.labels(server=url).set(st.hbm_kv_usage_perc)
+            self.kv_hit_rate.labels(server=url).set(st.prefix_cache_hit_rate)
+        self.healthy_engines.set(
+            sum(
+                1
+                for e in state.discovery.endpoints()
+                if e.healthy and not e.sleeping
+            )
+        )
+        return generate_latest(self.registry)

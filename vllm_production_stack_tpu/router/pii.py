@@ -1,0 +1,102 @@
+"""PII screening middleware (feature gate: PIIDetection).
+
+Blocks requests whose prompt text contains detectable PII, mirroring the
+reference's regex analyzer set (experimental/pii/analyzers/regex.py) — email,
+phone, SSN, credit card (Luhn-checked), IP address, API-key-shaped secrets.
+The Presidio analyzer path is not carried over (heavyweight optional dep);
+the analyzer interface keeps that door open."""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass
+
+from aiohttp import web
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass(frozen=True)
+class PIIMatch:
+    category: str
+    span: tuple[int, int]
+
+
+_PATTERNS: dict[str, re.Pattern] = {
+    "email": re.compile(r"\b[\w.+-]+@[\w-]+\.[\w.-]{2,}\b"),
+    "phone": re.compile(
+        r"(?<![\w.])(?:\+?\d{1,2}[\s.-]?)?(?:\(\d{3}\)|\d{3})[\s.-]\d{3}[\s.-]\d{4}\b"
+    ),
+    "ssn": re.compile(r"\b\d{3}-\d{2}-\d{4}\b"),
+    "credit_card": re.compile(r"\b(?:\d[ -]?){13,19}\b"),
+    "ip_address": re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b"),
+    "secret_key": re.compile(r"\b(?:sk|pk|api|key)[-_][A-Za-z0-9_-]{16,}\b"),
+}
+
+
+def _luhn_ok(digits: str) -> bool:
+    ds = [int(c) for c in digits if c.isdigit()]
+    if not 13 <= len(ds) <= 19:
+        return False
+    total = 0
+    for i, d in enumerate(reversed(ds)):
+        if i % 2 == 1:
+            d *= 2
+            if d > 9:
+                d -= 9
+        total += d
+    return total % 10 == 0
+
+
+class RegexAnalyzer:
+    def analyze(self, text: str) -> list[PIIMatch]:
+        found = []
+        for cat, pat in _PATTERNS.items():
+            for m in pat.finditer(text):
+                if cat == "credit_card" and not _luhn_ok(m.group()):
+                    continue
+                found.append(PIIMatch(cat, m.span()))
+        return found
+
+
+class PIIMiddleware:
+    def __init__(self, analyzer=None):
+        self.analyzer = analyzer or RegexAnalyzer()
+        self.blocked_total = 0
+
+    async def check(self, request: web.Request) -> web.Response | None:
+        """Returns a 400 response when PII is found, else None."""
+        raw = await request.read()
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError:
+            return None
+        texts = []
+        for m in body.get("messages", []):
+            c = m.get("content")
+            if isinstance(c, str):
+                texts.append(c)
+        p = body.get("prompt")
+        if isinstance(p, str):
+            texts.append(p)
+        elif isinstance(p, list):
+            texts.extend(str(x) for x in p)
+        matches = self.analyzer.analyze("\n".join(texts))
+        if not matches:
+            return None
+        self.blocked_total += 1
+        cats = sorted({m.category for m in matches})
+        logger.info("blocked request containing PII: %s", cats)
+        return web.json_response(
+            {
+                "error": {
+                    "message": f"request blocked: detected PII ({', '.join(cats)})",
+                    "type": "pii_detected",
+                    "categories": cats,
+                }
+            },
+            status=400,
+        )

@@ -1,0 +1,294 @@
+"""The proxy hot path: pick an engine, relay the request, stream the reply.
+
+Reference shape (services/request_service/request.py:55-431): parse body →
+callbacks.pre_request → rewrite → alias resolution → filter endpoints by
+model and sleep state → policy.route → stream relay firing request-stats
+hooks (arrival / first byte / completion) → StreamingResponse with
+X-Request-Id. Disaggregated prefill adds the 2-phase dance: the same body
+with max_tokens=1 goes to a prefill engine (KV lands in its pool and ships
+to the decode peer), then the original body streams from a decode engine.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+
+import aiohttp
+from aiohttp import web
+
+from ..utils.logging import init_logger
+from .routing import DisaggregatedPrefillPolicy, RoutingContext, qps_min_url
+
+logger = init_logger(__name__)
+
+# hop-by-hop headers must not be forwarded either direction
+_HOP_HEADERS = {
+    "connection",
+    "keep-alive",
+    "proxy-authenticate",
+    "proxy-authorization",
+    "te",
+    "trailers",
+    "transfer-encoding",
+    "upgrade",
+    "host",
+    "content-length",
+}
+
+
+def _forward_headers(headers) -> dict[str, str]:
+    return {k: v for k, v in headers.items() if k.lower() not in _HOP_HEADERS}
+
+
+class RequestService:
+    """Owns the shared client session and the proxy logic. One instance per
+    router app; the app handlers delegate here."""
+
+    def __init__(self, state):
+        self.state = state  # RouterState (app.py) — discovery/policy/stats
+        self._session: aiohttp.ClientSession | None = None
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10)
+        )
+
+    async def stop(self) -> None:
+        if self._session:
+            await self._session.close()
+
+    @property
+    def session(self) -> aiohttp.ClientSession:
+        assert self._session is not None, "RequestService not started"
+        return self._session
+
+    # -- endpoint selection ------------------------------------------------
+
+    def _eligible_endpoints(self, model: str | None) -> list:
+        eps = [
+            e
+            for e in self.state.discovery.endpoints()
+            if not e.sleeping and e.healthy
+        ]
+        if model:
+            by_model = [e for e in eps if e.has_model(model)]
+            # engines that published no model list yet still count as
+            # candidates in static mode (they may simply not be probed)
+            eps = by_model or [e for e in eps if not e.model_names]
+        return eps
+
+    def resolve_alias(self, model: str | None) -> str | None:
+        if model and model in self.state.model_aliases:
+            return self.state.model_aliases[model]
+        return model
+
+    # -- the proxy ---------------------------------------------------------
+
+    async def route_openai_request(self, request: web.Request) -> web.StreamResponse:
+        """Generic /v1/* proxy with routing."""
+        raw = await request.read()
+        try:
+            body = json.loads(raw) if raw else {}
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "request body is not valid JSON"}},
+                status=400,
+            )
+
+        request_id = request.headers.get("X-Request-Id") or uuid.uuid4().hex
+        if self.state.callbacks is not None:
+            short = await self.state.callbacks.pre_request(request, body)
+            if short is not None:
+                return short
+        body = self.state.rewriter.rewrite(request.path, body)
+
+        alias = body.get("model")
+        model = self.resolve_alias(alias)
+        if model != alias:
+            body = {**body, "model": model}
+        eps = self._eligible_endpoints(model)
+        if not eps:
+            return web.json_response(
+                {
+                    "error": {
+                        "message": f"no engine serving model {model!r} is available",
+                        "type": "service_unavailable",
+                    }
+                },
+                status=503,
+            )
+
+        if isinstance(self.state.policy, DisaggregatedPrefillPolicy):
+            return await self._route_disaggregated(request, body, eps, request_id)
+
+        ctx = RoutingContext(
+            endpoints=eps,
+            engine_stats=self.state.engine_scraper.get_engine_stats(),
+            request_stats=self.state.request_monitor.get_request_stats(),
+            headers=dict(request.headers),
+            body=body,
+        )
+        try:
+            url = await self.state.policy.route(ctx)
+        except LookupError as e:
+            return web.json_response(
+                {"error": {"message": str(e), "type": "service_unavailable"}},
+                status=503,
+            )
+        logger.info("Routing request %s to %s at %f", request_id, url, time.time())
+        return await self._proxy_stream(request, body, url, request_id)
+
+    async def _proxy_stream(
+        self,
+        request: web.Request,
+        body: dict,
+        backend_url: str,
+        request_id: str,
+    ) -> web.StreamResponse:
+        mon = self.state.request_monitor
+        data = json.dumps(body).encode()
+        mon.on_new_request(backend_url, request_id, time.time())
+        cacheable = (
+            self.state.semantic_cache is not None
+            and request.path == "/v1/chat/completions"
+            and not body.get("stream")
+        )
+        # only buffer the reply when something will actually consume it —
+        # otherwise N concurrent long streams double the router's memory
+        want_body = cacheable or self.state.callbacks is not None
+        full = bytearray()
+        resp: web.StreamResponse | None = None
+        try:
+            async with self.session.request(
+                request.method,
+                backend_url + request.path,
+                headers=_forward_headers(request.headers),
+                data=data,
+            ) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in _HOP_HEADERS:
+                        resp.headers[k] = v
+                resp.headers["X-Request-Id"] = request_id
+                await resp.prepare(request)
+                first = True
+                async for chunk in upstream.content.iter_any():
+                    if first:
+                        first = False
+                        mon.on_first_token(backend_url, request_id, time.time())
+                    if want_body:
+                        full.extend(chunk)
+                    await resp.write(chunk)
+                await resp.write_eof()
+                if cacheable and upstream.status == 200:
+                    try:
+                        self.state.semantic_cache.store(body, json.loads(bytes(full)))
+                    except (json.JSONDecodeError, UnicodeDecodeError):
+                        pass
+                return resp
+        except aiohttp.ClientError as e:
+            if resp is None or not resp.prepared:
+                return web.json_response(
+                    {"error": {"message": f"engine unreachable: {e}"}}, status=502
+                )
+            # headers (and possibly chunks) already went out — the only honest
+            # signal left is severing the connection so the client sees a
+            # truncated transfer instead of a clean end
+            logger.warning(
+                "engine %s died mid-stream for request %s: %s",
+                backend_url,
+                request_id,
+                e,
+            )
+            resp.force_close()
+            if request.transport is not None:
+                request.transport.close()
+            return resp
+        finally:
+            mon.on_request_complete(backend_url, request_id, time.time())
+            if self.state.callbacks is not None:
+                await self.state.callbacks.post_request(request, bytes(full))
+
+    # -- disaggregated prefill --------------------------------------------
+
+    async def _route_disaggregated(
+        self,
+        request: web.Request,
+        body: dict,
+        eps: list,
+        request_id: str,
+    ) -> web.StreamResponse:
+        """2-phase: run the prompt on a prefill engine with max_tokens=1 (its
+        KV pages ship to the decode peer), then stream the real request from
+        a decode engine (reference request.py:339-431)."""
+        policy: DisaggregatedPrefillPolicy = self.state.policy
+        prefill_eps, decode_eps = policy.pools(eps)
+        if not prefill_eps or not decode_eps:
+            return web.json_response(
+                {"error": {"message": "prefill/decode pools are not both available"}},
+                status=503,
+            )
+        stats = self.state.request_monitor.get_request_stats()
+        prefill_body = {**body, "max_tokens": 1, "stream": False}
+        # pick within each pool directly: routing by body inspection would
+        # misfile a legitimate client max_tokens=1 request in the decode phase
+        prefill_url = qps_min_url(prefill_eps, stats)
+        t0 = time.time()
+        try:
+            async with self.session.post(
+                prefill_url + request.path,
+                json=prefill_body,
+                headers=_forward_headers(request.headers),
+            ) as resp:
+                await resp.read()
+                if resp.status != 200:
+                    return web.json_response(
+                        {"error": {"message": f"prefill engine returned {resp.status}"}},
+                        status=502,
+                    )
+        except aiohttp.ClientError as e:
+            return web.json_response(
+                {"error": {"message": f"prefill engine unreachable: {e}"}},
+                status=502,
+            )
+        logger.info(
+            "PD prefill for %s on %s took %.3fs", request_id, prefill_url, time.time() - t0
+        )
+        decode_url = qps_min_url(decode_eps, stats)
+        logger.info("Routing request %s to %s at %f", request_id, decode_url, time.time())
+        return await self._proxy_stream(request, body, decode_url, request_id)
+
+    # -- sleep / wake control ---------------------------------------------
+
+    async def sleep_control(
+        self, request: web.Request, action: str
+    ) -> web.Response:
+        """Proxy /sleep, /wake_up, /is_sleeping to a chosen engine and track
+        its sleep flag for routing filters (reference request.py:434-510)."""
+        url = request.query.get("url") or request.headers.get("X-Engine-Url")
+        eps = self.state.discovery.endpoints()
+        if url is None and len(eps) == 1:
+            url = eps[0].url
+        if url is None or not any(e.url == url for e in eps):
+            return web.json_response(
+                {"error": {"message": "specify a known engine with ?url="}},
+                status=400,
+            )
+        try:
+            if action == "is_sleeping":
+                async with self.session.get(url + "/is_sleeping") as resp:
+                    return web.json_response(await resp.json(), status=resp.status)
+            level = request.query.get("level", "1")
+            async with self.session.post(
+                f"{url}/{action}", params={"level": level}
+            ) as resp:
+                payload = await resp.json()
+            if resp.status == 200:
+                self.state.discovery.set_sleeping(url, action == "sleep")
+            return web.json_response(payload, status=resp.status)
+        except aiohttp.ClientError as e:
+            return web.json_response(
+                {"error": {"message": f"engine unreachable: {e}"}}, status=502
+            )

@@ -1,0 +1,130 @@
+"""Router-side per-engine request statistics.
+
+The proxy calls three hooks as each request flows through it —
+`on_new_request` (arrival), `on_first_token` (TTFT), `on_request_complete`
+(latency) — and QPS/TTFT/latency are computed over a sliding time window,
+like the reference's RequestStatsMonitor (stats/request_stats.py:58-306).
+QPS counts arrivals in the window; TTFT/latency average over completions in
+the window. Routing's QPS-min fallback and the /metrics endpoint read these.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+
+
+class SlidingWindow:
+    """(timestamp, value) samples; O(1) amortized expiry."""
+
+    def __init__(self, window: float):
+        self.window = window
+        self._samples: deque[tuple[float, float]] = deque()
+        self._sum = 0.0
+
+    def add(self, ts: float, value: float) -> None:
+        self._samples.append((ts, value))
+        self._sum += value
+        self._expire(ts)
+
+    def _expire(self, now: float) -> None:
+        cutoff = now - self.window
+        while self._samples and self._samples[0][0] < cutoff:
+            _, v = self._samples.popleft()
+            self._sum -= v
+
+    def rate(self, now: float) -> float:
+        self._expire(now)
+        return len(self._samples) / self.window
+
+    def average(self, now: float) -> float:
+        self._expire(now)
+        return self._sum / len(self._samples) if self._samples else 0.0
+
+
+@dataclass
+class RequestStats:
+    qps: float = 0.0
+    ttft: float = 0.0  # avg seconds to first byte
+    latency: float = 0.0  # avg end-to-end seconds
+    in_prefill_requests: int = 0
+    in_decoding_requests: int = 0
+    finished_requests: int = 0
+    uptime: float = 0.0
+
+
+class RequestStatsMonitor:
+    def __init__(self, sliding_window: float = 60.0):
+        self.sliding_window = sliding_window
+        self._qps: dict[str, SlidingWindow] = {}
+        self._ttft: dict[str, SlidingWindow] = {}
+        self._latency: dict[str, SlidingWindow] = {}
+        self._start: dict[tuple[str, str], float] = {}
+        self._first_token: dict[tuple[str, str], float] = {}
+        self.in_prefill: dict[str, int] = {}
+        self.in_decoding: dict[str, int] = {}
+        self.finished: dict[str, int] = {}
+        self.first_request_time: float | None = None
+
+    def _win(self, table: dict, url: str) -> SlidingWindow:
+        if url not in table:
+            table[url] = SlidingWindow(self.sliding_window)
+        return table[url]
+
+    # -- hooks (called by the proxy) --------------------------------------
+
+    def on_new_request(self, url: str, request_id: str, ts: float) -> None:
+        self._start[(url, request_id)] = ts
+        self.in_prefill[url] = self.in_prefill.get(url, 0) + 1
+        self._win(self._qps, url).add(ts, 1.0)
+        if self.first_request_time is None:
+            self.first_request_time = ts
+
+    def on_first_token(self, url: str, request_id: str, ts: float) -> None:
+        key = (url, request_id)
+        start = self._start.get(key)
+        if start is None or key in self._first_token:
+            return
+        self._first_token[key] = ts
+        self.in_prefill[url] = max(0, self.in_prefill.get(url, 1) - 1)
+        self.in_decoding[url] = self.in_decoding.get(url, 0) + 1
+        self._win(self._ttft, url).add(ts, ts - start)
+
+    def on_request_complete(self, url: str, request_id: str, ts: float) -> None:
+        key = (url, request_id)
+        start = self._start.pop(key, None)
+        if start is None:
+            return
+        if self._first_token.pop(key, None) is not None:
+            self.in_decoding[url] = max(0, self.in_decoding.get(url, 1) - 1)
+        else:
+            # completed without any byte (error/abort) — still leaves prefill
+            self.in_prefill[url] = max(0, self.in_prefill.get(url, 1) - 1)
+        self.finished[url] = self.finished.get(url, 0) + 1
+        self._win(self._latency, url).add(ts, ts - start)
+
+    # -- snapshot ---------------------------------------------------------
+
+    def get_request_stats(self, now: float | None = None) -> dict[str, RequestStats]:
+        now = time.time() if now is None else now
+        urls = (
+            set(self._qps) | set(self.in_prefill) | set(self.in_decoding)
+            | set(self.finished)
+        )
+        out = {}
+        for url in urls:
+            out[url] = RequestStats(
+                qps=self._win(self._qps, url).rate(now),
+                ttft=self._win(self._ttft, url).average(now),
+                latency=self._win(self._latency, url).average(now),
+                in_prefill_requests=self.in_prefill.get(url, 0),
+                in_decoding_requests=self.in_decoding.get(url, 0),
+                finished_requests=self.finished.get(url, 0),
+                uptime=(
+                    now - self.first_request_time
+                    if self.first_request_time
+                    else 0.0
+                ),
+            )
+        return out

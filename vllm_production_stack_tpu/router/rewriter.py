@@ -1,0 +1,28 @@
+"""Pluggable request-body rewriting before proxying.
+
+Reference: services/request_service/rewriter.py:29-70 — an interface with a
+no-op default; operators subclass to mutate bodies (inject defaults, strip
+fields) without touching the proxy."""
+
+from __future__ import annotations
+
+
+class RequestRewriter:
+    def rewrite(self, path: str, body: dict) -> dict:
+        raise NotImplementedError
+
+
+class NoopRequestRewriter(RequestRewriter):
+    def rewrite(self, path: str, body: dict) -> dict:
+        return body
+
+
+def make_rewriter(spec: str | None) -> RequestRewriter:
+    """`spec` is "module:ClassName" importable from PYTHONPATH, or None."""
+    if not spec:
+        return NoopRequestRewriter()
+    import importlib
+
+    mod_name, _, cls_name = spec.partition(":")
+    cls = getattr(importlib.import_module(mod_name), cls_name or "Rewriter")
+    return cls()

@@ -1,0 +1,250 @@
+"""Routing policies.
+
+Five algorithms behind one interface, mirroring the reference's capability
+set (routers/routing_logic.py:50-527): round-robin, session-sticky
+(consistent-hash ring on a header key, QPS-min fallback), prefix-aware
+(chunk-hash trie), KV-aware (asks the KV controller which engine holds the
+longest cached prefix), and disaggregated-prefill (label-partitioned
+prefill/decode pools). Policies are plain objects constructed by
+`make_policy` and owned by the app state — reconfiguration swaps the object.
+
+Every policy implements `async route(ctx) -> url`. Async because the
+prefix/kv policies await a trie lock or a controller HTTP call; the cheap
+policies just return.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+import aiohttp
+
+from ..utils.logging import init_logger
+from .discovery import Endpoint
+from .engine_stats import EngineStats
+from .hashring import HashRing
+from .hashtrie import HashTrie
+from .request_stats import RequestStats
+
+logger = init_logger(__name__)
+
+ROUTING_POLICIES = (
+    "roundrobin",
+    "session",
+    "prefixaware",
+    "kvaware",
+    "disaggregated_prefill",
+)
+
+
+@dataclass
+class RoutingContext:
+    """Everything a policy may look at for one request."""
+
+    endpoints: list[Endpoint]
+    engine_stats: dict[str, EngineStats] = field(default_factory=dict)
+    request_stats: dict[str, RequestStats] = field(default_factory=dict)
+    headers: dict[str, str] = field(default_factory=dict)
+    body: dict = field(default_factory=dict)
+
+    def prompt_text(self) -> str:
+        """Routable text of the request: the completions prompt, or the chat
+        messages' text parts joined (incl. multimodal text segments) — the
+        reference's extraction (routing_logic.py:383-412)."""
+        if "messages" in self.body:
+            parts = []
+            for msg in self.body.get("messages", []):
+                content = msg.get("content", "")
+                if isinstance(content, list):
+                    parts.append(
+                        " ".join(
+                            p.get("text", "")
+                            for p in content
+                            if isinstance(p, dict) and p.get("type") == "text"
+                        )
+                    )
+                elif content:
+                    parts.append(str(content))
+            return "\n".join(parts)
+        prompt = self.body.get("prompt", "")
+        if isinstance(prompt, list):
+            return "\n".join(str(p) for p in prompt)
+        return str(prompt)
+
+
+def qps_min_url(
+    endpoints: list[Endpoint], request_stats: dict[str, RequestStats]
+) -> str:
+    """Least-loaded fallback: an engine with no recorded requests wins
+    immediately, else lowest QPS (reference _qps_routing,
+    routing_logic.py:60-82)."""
+    best, best_qps = None, float("inf")
+    for ep in endpoints:
+        st = request_stats.get(ep.url)
+        if st is None:
+            return ep.url
+        if st.qps < best_qps:
+            best_qps, best = st.qps, ep.url
+    return best
+
+
+class RoutingPolicy:
+    name = "base"
+
+    async def route(self, ctx: RoutingContext) -> str:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        """Release any connections the policy holds (swap/shutdown)."""
+
+
+class RoundRobinPolicy(RoutingPolicy):
+    """URL-sorted round robin, stable under endpoint churn."""
+
+    name = "roundrobin"
+
+    def __init__(self) -> None:
+        self._i = 0
+
+    async def route(self, ctx: RoutingContext) -> str:
+        eps = sorted(ctx.endpoints, key=lambda e: e.url)
+        url = eps[self._i % len(eps)].url
+        self._i += 1
+        return url
+
+
+class SessionPolicy(RoutingPolicy):
+    """Consistent-hash the session header onto the ring; requests without a
+    session id go to the least-loaded engine."""
+
+    name = "session"
+
+    def __init__(self, session_key: str):
+        if not session_key:
+            raise ValueError("session routing requires a session key header name")
+        self.session_key = session_key
+        self.ring = HashRing()
+
+    async def route(self, ctx: RoutingContext) -> str:
+        self.ring.sync([e.url for e in ctx.endpoints])
+        session_id = ctx.headers.get(self.session_key)
+        if session_id is None:
+            return qps_min_url(ctx.endpoints, ctx.request_stats)
+        return self.ring.get_node(session_id)
+
+
+class PrefixAwarePolicy(RoutingPolicy):
+    """Longest-prefix match over the router's own chunk-hash trie; random
+    choice among engines sharing the deepest prefix, then record the choice."""
+
+    name = "prefixaware"
+
+    def __init__(self) -> None:
+        self.trie = HashTrie()
+
+    async def route(self, ctx: RoutingContext) -> str:
+        prompt = ctx.prompt_text()
+        available = {e.url for e in ctx.endpoints}
+        _, matched = await self.trie.longest_prefix_match(prompt, available)
+        url = random.choice(sorted(matched))
+        await self.trie.insert(prompt, url)
+        return url
+
+
+class KvawarePolicy(RoutingPolicy):
+    """Ask the KV controller which engine holds the longest cached KV prefix
+    for this prompt; below `threshold` matched tokens (or on any controller
+    fault) fall back to least-loaded. The controller is the stack's LMCache-
+    controller equivalent (engine/kv_controller.py) speaking clean REST, the
+    deployment shape the reference's Go picker assumes
+    (gateway_inference_extension/kv_aware_picker.go:90-133) rather than an
+    in-process import."""
+
+    name = "kvaware"
+
+    def __init__(self, controller_url: str, threshold_tokens: int = 256):
+        self.controller_url = controller_url.rstrip("/")
+        self.threshold_tokens = threshold_tokens
+        self._session: aiohttp.ClientSession | None = None
+
+    def _sess(self) -> aiohttp.ClientSession:
+        # one long-lived session: the lookup is on the hot path, per-request
+        # session+connection churn would tax latency and file descriptors
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=2)
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def route(self, ctx: RoutingContext) -> str:
+        available = {e.url for e in ctx.endpoints}
+        try:
+            async with self._sess().post(
+                self.controller_url + "/lookup",
+                json={"text": ctx.prompt_text()},
+            ) as resp:
+                data = await resp.json()
+            url = data.get("url")
+            if (
+                url in available
+                and data.get("matched_tokens", 0) >= self.threshold_tokens
+            ):
+                return url
+        except Exception as e:
+            logger.debug("kv controller lookup failed: %s", e)
+        return qps_min_url(ctx.endpoints, ctx.request_stats)
+
+
+class DisaggregatedPrefillPolicy(RoutingPolicy):
+    """Partition engines into prefill/decode pools by model label; the proxy's
+    2-phase orchestration calls this twice per request (phase passed in the
+    body by the request service, matching the reference's max_tokens==1
+    prefill convention, routing_logic.py:426-466)."""
+
+    name = "disaggregated_prefill"
+
+    def __init__(
+        self, prefill_labels: list[str], decode_labels: list[str]
+    ) -> None:
+        self.prefill_labels = set(prefill_labels)
+        self.decode_labels = set(decode_labels)
+
+    def pools(self, endpoints: list[Endpoint]) -> tuple[list[Endpoint], list[Endpoint]]:
+        prefill = [e for e in endpoints if e.model_label in self.prefill_labels]
+        decode = [e for e in endpoints if e.model_label in self.decode_labels]
+        return prefill, decode
+
+    async def route(self, ctx: RoutingContext) -> str:
+        prefill, decode = self.pools(ctx.endpoints)
+        is_prefill = ctx.body.get("max_tokens", 0) == 1
+        pool = prefill if is_prefill else decode
+        if not pool:
+            raise LookupError(
+                f"no {'prefill' if is_prefill else 'decode'} engines available"
+            )
+        return qps_min_url(pool, ctx.request_stats)
+
+
+def make_policy(name: str, **kw) -> RoutingPolicy:
+    if name == "roundrobin":
+        return RoundRobinPolicy()
+    if name == "session":
+        return SessionPolicy(kw.get("session_key", ""))
+    if name == "prefixaware":
+        return PrefixAwarePolicy()
+    if name == "kvaware":
+        return KvawarePolicy(
+            kw.get("kv_controller_url", ""),
+            kw.get("kv_aware_threshold", 256),
+        )
+    if name == "disaggregated_prefill":
+        return DisaggregatedPrefillPolicy(
+            kw.get("prefill_model_labels", []),
+            kw.get("decode_model_labels", []),
+        )
+    raise ValueError(f"unknown routing policy: {name}")

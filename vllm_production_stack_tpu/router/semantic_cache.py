@@ -1,0 +1,132 @@
+"""Semantic response cache (feature gate: SemanticCache).
+
+Embeds the chat messages and serves a cached completion when a previous
+request is similar enough (inner product >= threshold). The reference uses
+sentence-transformers + FAISS (experimental/semantic_cache/:16-353); here the
+index is plain numpy — at router cache sizes (thousands of entries) a matmul
+against the normalized embedding matrix beats carrying a native ANN
+dependency. The embedder is pluggable so tests inject a deterministic one."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+from aiohttp import web
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class NumpyIndex:
+    """Exact inner-product search over normalized vectors."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        self._vecs = np.zeros((0, dim), dtype=np.float32)
+        self._payloads: list[dict] = []
+
+    def __len__(self) -> int:
+        return len(self._payloads)
+
+    def add(self, vec: np.ndarray, payload: dict) -> None:
+        vec = vec.astype(np.float32).reshape(1, -1)
+        vec /= np.linalg.norm(vec) + 1e-9
+        self._vecs = np.concatenate([self._vecs, vec])
+        self._payloads.append(payload)
+
+    def search(self, vec: np.ndarray) -> tuple[float, dict | None]:
+        if not self._payloads:
+            return 0.0, None
+        q = vec.astype(np.float32).ravel()
+        q /= np.linalg.norm(q) + 1e-9
+        sims = self._vecs @ q
+        best = int(np.argmax(sims))
+        return float(sims[best]), self._payloads[best]
+
+
+class HashingEmbedder:
+    """Dependency-free fallback embedder: token-hash bag-of-words. Real
+    deployments pass a sentence-transformers dir; tests and air-gapped runs
+    still get exact-duplicate hits from this."""
+
+    def __init__(self, dim: int = 512):
+        self.dim = dim
+
+    def encode(self, text: str) -> np.ndarray:
+        import xxhash
+
+        v = np.zeros(self.dim, dtype=np.float32)
+        for tok in text.lower().split():
+            v[xxhash.xxh64_intdigest(tok) % self.dim] += 1.0
+        return v
+
+
+def _load_embedder(model_dir: str):
+    if model_dir in ("hashing", "builtin"):
+        return HashingEmbedder()
+    try:
+        from sentence_transformers import SentenceTransformer
+
+        m = SentenceTransformer(model_dir)
+        return m
+    except Exception as e:
+        logger.warning(
+            "falling back to hashing embedder (%s unusable: %s)", model_dir, e
+        )
+        return HashingEmbedder()
+
+
+class SemanticCache:
+    def __init__(self, model_dir: str, threshold: float = 0.9, embedder=None):
+        self.threshold = threshold
+        self.embedder = embedder or _load_embedder(model_dir)
+        probe = np.asarray(self.embedder.encode("probe"), dtype=np.float32)
+        self.index = NumpyIndex(probe.ravel().shape[0])
+        self.hits = 0
+        self.lookups = 0
+
+    @staticmethod
+    def _text_of(body: dict) -> str:
+        msgs = body.get("messages", [])
+        return "\n".join(
+            f"{m.get('role', '')}: {m.get('content', '')}"
+            for m in msgs
+            if isinstance(m.get("content"), str)
+        )
+
+    async def lookup(self, request: web.Request):
+        """Returns a cached Response or None. Streaming requests skip the
+        cache (a cached body can't replay a stream faithfully)."""
+        raw = await request.read()
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError:
+            return None
+        if body.get("stream"):
+            return None
+        self.lookups += 1
+        vec = np.asarray(self.embedder.encode(self._text_of(body)))
+        sim, payload = self.index.search(vec)
+        if payload is None or sim < self.threshold:
+            return None
+        if payload.get("model") != body.get("model"):
+            return None
+        self.hits += 1
+        cached = dict(payload["response"])
+        cached["cached"] = True
+        cached["similarity"] = round(sim, 4)
+        return web.json_response(cached)
+
+    def store(self, body: dict, response: dict) -> None:
+        vec = np.asarray(self.embedder.encode(self._text_of(body)))
+        self.index.add(
+            vec,
+            {
+                "model": body.get("model"),
+                "response": response,
+                "stored_at": time.time(),
+            },
+        )

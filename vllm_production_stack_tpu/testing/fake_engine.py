@@ -1,0 +1,199 @@
+"""Fake TPU engine: an OpenAI+metrics server emitting tokens at a fixed rate.
+
+The perf rig the router is tested against without hardware — the reference's
+fake-openai-server (src/tests/perftest/fake-openai-server.py) plays this role
+for its CI (router-e2e-test.yml:51-87). Speaks exactly the surface the router
+consumes: /v1/models, /v1/chat/completions, /v1/completions (stream and not),
+/metrics with the `tpu:*` contract, /health, /sleep /wake_up /is_sleeping.
+
+Run: python -m vllm_production_stack_tpu.testing.fake_engine --port 9001 \
+        --model fake-llama --tokens-per-sec 500
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+
+from aiohttp import web
+
+from .. import metrics_contract as mc
+
+
+class FakeEngine:
+    def __init__(
+        self,
+        model: str = "fake-model",
+        tokens_per_sec: float = 500.0,
+        default_tokens: int = 64,
+        model_label: str = "",
+    ):
+        self.model = model
+        self.tokens_per_sec = tokens_per_sec
+        self.default_tokens = default_tokens
+        self.model_label = model_label
+        self.running = 0
+        self.total_requests = 0
+        self.prompt_tokens_total = 0
+        self.generation_tokens_total = 0
+        self.sleeping = False
+        self.seen_request_log: list[dict] = []  # tests inspect who got what
+
+    # -- handlers ----------------------------------------------------------
+
+    async def h_models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": self.model,
+                        "object": "model",
+                        "created": 0,
+                        "owned_by": "fake-tpu",
+                    }
+                ],
+            }
+        )
+
+    async def h_completion(self, request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        if self.sleeping:
+            return web.json_response(
+                {"error": {"message": "engine is asleep"}}, status=503
+            )
+        self.total_requests += 1
+        self.seen_request_log.append(
+            {"path": request.path, "body": body, "t": time.time()}
+        )
+        is_chat = request.path.endswith("chat/completions")
+        n = int(body.get("max_tokens") or self.default_tokens)
+        prompt = body.get("prompt") or json.dumps(body.get("messages", []))
+        n_prompt = max(1, len(str(prompt)) // 4)
+        self.prompt_tokens_total += n_prompt
+        rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        gap = 1.0 / self.tokens_per_sec
+
+        self.running += 1
+        try:
+            if body.get("stream"):
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "text/event-stream"}
+                )
+                await resp.prepare(request)
+                for i in range(n):
+                    await asyncio.sleep(gap)
+                    delta = (
+                        {"delta": {"content": f"tok{i} "}}
+                        if is_chat
+                        else {"text": f"tok{i} "}
+                    )
+                    chunk = {
+                        "id": rid,
+                        "object": (
+                            "chat.completion.chunk" if is_chat else "text_completion"
+                        ),
+                        "created": created,
+                        "model": body.get("model", self.model),
+                        "choices": [{"index": 0, **delta, "finish_reason": None}],
+                    }
+                    await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                self.generation_tokens_total += n
+                return resp
+            await asyncio.sleep(gap * n)
+            self.generation_tokens_total += n
+            text = " ".join(f"tok{i}" for i in range(n))
+            choice = (
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": "length",
+                }
+                if is_chat
+                else {"index": 0, "text": text, "finish_reason": "length"}
+            )
+            return web.json_response(
+                {
+                    "id": rid,
+                    "object": "chat.completion" if is_chat else "text_completion",
+                    "created": created,
+                    "model": body.get("model", self.model),
+                    "choices": [choice],
+                    "usage": {
+                        "prompt_tokens": n_prompt,
+                        "completion_tokens": n,
+                        "total_tokens": n_prompt + n,
+                    },
+                }
+            )
+        finally:
+            self.running -= 1
+
+    async def h_metrics(self, request: web.Request) -> web.Response:
+        label = f'{{model_name="{self.model}"}}'
+        lines = [
+            f"# TYPE {mc.NUM_REQUESTS_RUNNING.replace(':', '_')} gauge",
+            f"{mc.NUM_REQUESTS_RUNNING}{label} {self.running}",
+            f"{mc.NUM_REQUESTS_WAITING}{label} 0",
+            f"{mc.HBM_KV_USAGE_PERC}{label} {min(1.0, self.running * 0.1):.3f}",
+            f"{mc.PREFIX_CACHE_HIT_RATE}{label} 0.5",
+            f"{mc.PREFIX_CACHE_HITS}{label} {self.total_requests * 2}",
+            f"{mc.PREFIX_CACHE_QUERIES}{label} {self.total_requests * 4}",
+            f"{mc.PROMPT_TOKENS}{label} {self.prompt_tokens_total}",
+            f"{mc.GENERATION_TOKENS}{label} {self.generation_tokens_total}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def h_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def h_sleep(self, request: web.Request) -> web.Response:
+        self.sleeping = True
+        return web.json_response({"status": "ok", "sleeping": True})
+
+    async def h_wake(self, request: web.Request) -> web.Response:
+        self.sleeping = False
+        return web.json_response({"status": "ok", "sleeping": False})
+
+    async def h_is_sleeping(self, request: web.Request) -> web.Response:
+        return web.json_response({"is_sleeping": self.sleeping})
+
+    # -- assembly ----------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/v1/models", self.h_models)
+        app.router.add_post("/v1/chat/completions", self.h_completion)
+        app.router.add_post("/v1/completions", self.h_completion)
+        app.router.add_get("/metrics", self.h_metrics)
+        app.router.add_get("/health", self.h_health)
+        app.router.add_post("/sleep", self.h_sleep)
+        app.router.add_post("/wake_up", self.h_wake)
+        app.router.add_get("/is_sleeping", self.h_is_sleeping)
+        return app
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="fake TPU engine for router testing")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9001)
+    p.add_argument("--model", default="fake-model")
+    p.add_argument("--tokens-per-sec", type=float, default=500.0)
+    p.add_argument("--model-label", default="")
+    args = p.parse_args(argv)
+    engine = FakeEngine(
+        model=args.model,
+        tokens_per_sec=args.tokens_per_sec,
+        model_label=args.model_label,
+    )
+    web.run_app(engine.build_app(), host=args.host, port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()
