@@ -43,7 +43,9 @@ def req(rid, n_prompt, **kw):
 def drive(sched, work, start_token=1000):
     """Apply fake sampled tokens for every sample slot in the work."""
     if isinstance(work, PrefillWork):
-        rows = [[start_token]] if work.sample else [[]]
+        rows = [
+            [start_token + i] if s else [] for i, s in enumerate(work.sample)
+        ]
     else:
         rows = [
             [start_token + i * 100 + k for k in range(work.window)]
@@ -61,7 +63,7 @@ def test_chunked_prefill_then_decode():
     while not r.prefill_done:
         w = s.schedule()
         assert isinstance(w, PrefillWork)
-        sizes.append(len(w.token_ids))
+        sizes.append(len(w.token_ids[0]))
         drive(s, w)
     assert sizes == [8, 8, 3]
     assert len(r.output_token_ids) == 1  # sampled at prompt end
@@ -79,7 +81,7 @@ def test_decode_prefill_alternation():
     a, b = req("a", 4, max_tokens=16), req("b", 12, max_tokens=16)
     s.add_request(a)
     w = s.schedule()
-    assert isinstance(w, PrefillWork) and w.request is a
+    assert isinstance(w, PrefillWork) and w.requests == [a]
     drive(s, w)
     s.add_request(b)
     kinds = []
@@ -105,7 +107,37 @@ def test_prefix_cache_hit_on_second_request():
     assert isinstance(w, PrefillWork)
     # two full blocks (8 tokens) served from cache; only the tail computed
     assert b.num_cached_prompt_tokens == 8
-    assert w.positions == [8, 9]
+    assert w.positions == [[8, 9]]
+
+
+def test_batched_prefill_packs_multiple_requests():
+    s = make_scheduler(num_blocks=32, max_batched=16, max_seqs=4)
+    reqs = [req(f"r{i}", 5, max_tokens=4) for i in range(3)]
+    for r in reqs:
+        s.add_request(r)
+    w = s.schedule()
+    assert isinstance(w, PrefillWork)
+    # 16-token budget fits all three 5-token prompts in ONE dispatch
+    assert w.requests == reqs
+    assert [len(t) for t in w.token_ids] == [5, 5, 5]
+    assert w.sample == [True, True, True]
+    results = drive(s, w)
+    assert all(len(toks) == 1 for _, toks in results)
+    assert all(len(r.output_token_ids) == 1 for r in reqs)
+
+
+def test_batched_prefill_respects_token_budget():
+    s = make_scheduler(num_blocks=64, max_batched=8, max_seqs=4)
+    a, b = req("a", 6, max_tokens=4), req("b", 6, max_tokens=4)
+    s.add_request(a)
+    s.add_request(b)
+    w = s.schedule()
+    # 8-token budget: a's full 6-token chunk + b's first 2 tokens
+    assert w.requests == [a, b]
+    assert [len(t) for t in w.token_ids] == [6, 2]
+    assert w.sample == [True, False]
+    drive(s, w)
+    assert a.output_token_ids and not b.output_token_ids
 
 
 def test_preemption_and_resume():
@@ -136,22 +168,18 @@ def test_preemption_and_resume():
 
 def test_windowed_decode_accept_and_discard():
     s = make_scheduler(num_blocks=32, max_batched=16, window=4)
-    a = req("a", 6, max_tokens=7)  # finishes mid-way through the joint window
+    a = req("a", 6, max_tokens=3)  # finishes mid-way through the joint window
     b = req("b", 6, max_tokens=10)
     s.add_request(a)
     s.add_request(b)
-    drive(s, s.schedule())  # prefill a (+1 output)
-    # alternation policy: a decode-only window for a runs before b's prefill
-    w = s.schedule()
-    assert isinstance(w, DecodeWork) and w.requests == [a] and w.window == 4
-    drive(s, w)  # a now has 5 outputs
-    drive(s, s.schedule())  # prefill b (+1 output)
+    drive(s, s.schedule())  # batched prefill of a AND b (+1 output each)
+    assert a.output_token_ids and b.output_token_ids
     w = s.schedule()
     assert isinstance(w, DecodeWork)
     assert w.window == 4 and len(w.requests) == 2
     results = s.postprocess(w, [[11, 12, 13, 14], [21, 22, 23, 24]])
     by_id = {r.request_id: toks for r, toks in results}
-    # a had 5 outputs + window 4, max_tokens=7 -> accepts 2, discards 2
+    # a had 1 output + window 4, max_tokens=3 -> accepts 2, discards 2
     assert by_id["a"] == [11, 12]
     assert a.status.finished and a.status.name == "FINISHED_LENGTH"
     assert by_id["b"] == [21, 22, 23, 24]

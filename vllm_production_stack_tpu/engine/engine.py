@@ -54,6 +54,19 @@ class LLMEngine:
         mesh=None,
         tokenizer: TokenizerWrapper | None = None,
     ):
+        if config.cache.num_blocks is None:
+            from dataclasses import replace
+
+            from .memory import derive_num_blocks
+
+            config = config.replace(
+                cache=replace(
+                    config.cache,
+                    num_blocks=derive_num_blocks(
+                        config.model, config.cache, config.parallel
+                    ),
+                )
+            )
         self.config = config
         self.tokenizer = tokenizer or TokenizerWrapper(
             config.model.tokenizer or config.model.checkpoint

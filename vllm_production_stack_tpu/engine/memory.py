@@ -1,0 +1,87 @@
+"""HBM budgeting: size the KV pool from what's left after weights.
+
+The reference engines size their pools via vLLM's --gpu-memory-utilization
+(deployment-vllm-multi.yaml:160-195; values.yaml `gpuMemoryUtilization`);
+`CacheConfig.hbm_utilization` is the TPU analogue. Weights and KV bytes are
+computed analytically from the model config (both are exact for our stacked
+layouts), so sizing needs no trial allocation."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..utils.logging import init_logger
+from .config import CacheConfig, ModelConfig, ParallelConfig
+
+logger = init_logger(__name__)
+
+DEFAULT_HBM_BYTES = 16 * 1024**3  # v5e-class chip
+# XLA workspace + fragmentation + activation headroom per device
+RESERVE_BYTES = 1024**3
+
+
+def dtype_bytes(dtype: str) -> int:
+    return np.dtype(dtype).itemsize
+
+
+def param_bytes(cfg: ModelConfig, tp: int = 1) -> int:
+    """Per-device bytes of the stacked Llama param tree (models/llama.py
+    init_params) under tensor parallelism `tp`."""
+    h, hd = cfg.hidden_size, cfg.head_dim
+    nh, nkv, it, L = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size, cfg.num_layers
+    attn = h * nh * hd + 2 * h * nkv * hd + nh * hd * h
+    mlp = 3 * h * it
+    norms = 2 * h
+    per_layer = (attn + mlp) // tp + norms
+    embed = cfg.vocab_size * h // tp
+    head = 0 if cfg.tie_word_embeddings else h * cfg.vocab_size // tp
+    total = embed + L * per_layer + h + head
+    if cfg.attention_bias:
+        total += L * (nh * hd + 2 * nkv * hd) // tp
+    return total * dtype_bytes(cfg.dtype)
+
+
+def kv_block_bytes(cfg: ModelConfig, block_size: int, tp: int = 1) -> int:
+    """Per-device bytes of ONE pool block across all layers (the pool array
+    is (L, 2, num_blocks, block_size, kvH, D), kv heads sharded by tp)."""
+    kvh = max(1, cfg.num_kv_heads // tp)
+    return (
+        cfg.num_layers * 2 * block_size * kvh * cfg.head_dim
+        * dtype_bytes(cfg.dtype)
+    )
+
+
+def device_hbm_bytes() -> int:
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return DEFAULT_HBM_BYTES
+
+
+def derive_num_blocks(
+    model: ModelConfig,
+    cache: CacheConfig,
+    parallel: ParallelConfig,
+    hbm_bytes: int | None = None,
+) -> int:
+    """Blocks that fit in hbm_utilization × HBM after weights + reserve."""
+    hbm = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
+    tp = parallel.tensor_parallel_size
+    budget = int(hbm * cache.hbm_utilization) - param_bytes(model, tp) - RESERVE_BYTES
+    per_block = kv_block_bytes(model, cache.block_size, tp)
+    n = max(2, budget // per_block)
+    # no point holding more pages than max_model_len × max concurrent seqs
+    # could ever reference (keeps tiny models from grabbing the whole chip)
+    logger.info(
+        "KV pool: %d blocks of %d tokens (%.2f GiB of %.2f GiB HBM; weights %.2f GiB)",
+        n,
+        cache.block_size,
+        n * per_block / 1024**3,
+        hbm / 1024**3,
+        param_bytes(model, tp) / 1024**3,
+    )
+    return int(n)

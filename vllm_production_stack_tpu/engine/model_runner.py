@@ -189,27 +189,48 @@ class ModelRunner:
             return self._execute_prefill(work)
         return self._execute_decode(work)
 
-    def _execute_prefill(self, work: PrefillWork) -> list[int]:
+    def _execute_prefill(self, work: PrefillWork) -> list[list[int]]:
+        """One dispatch for the whole prefill batch: rows padded to a common
+        chunk bucket, batch padded to a power of two. Every row samples at its
+        chunk's last token (static shapes); non-sampling rows' tokens are
+        discarded host-side."""
         sched = self.config.scheduler
-        t = len(work.token_ids)
+        b = len(work.requests)
+        b_pad = self._batch_bucket(b)
+        t = max(len(row) for row in work.token_ids)
         t_pad = sched.bucket_for(t, sched.prefill_buckets)
 
-        token_ids = np.zeros((1, t_pad), np.int32)
-        token_ids[0, :t] = work.token_ids
-        positions = np.zeros((1, t_pad), np.int32)
-        positions[0, :t] = work.positions
-        slots = np.zeros(t_pad, np.int32)  # padding -> null page slots
-        slots[:t] = work.slot_mapping
-        block_tables = self._block_table_array([work.request.block_table])
-        context_lens = np.asarray([work.context_len], np.int32)
-        sample_rows = np.asarray([t - 1], np.int32)
-        s = work.request.sampling
-        tokens = self._run(
-            token_ids, positions, block_tables, slots, context_lens,
-            sample_rows, [s.temperature], [s.top_p], [s.top_k],
-            seeds=[s.seed], counts=[len(work.request.output_token_ids)],
+        token_ids = np.zeros((b_pad, t_pad), np.int32)
+        positions = np.zeros((b_pad, t_pad), np.int32)
+        slots = np.zeros((b_pad, t_pad), np.int32)  # padding -> null page
+        context_lens = np.zeros(b_pad, np.int32)
+        sample_rows = np.zeros(b_pad, np.int32)
+        temps = np.zeros(b_pad, np.float32)
+        top_ps = np.ones(b_pad, np.float32)
+        top_ks = np.zeros(b_pad, np.int32)
+        seeds: list[int | None] = [None] * b_pad
+        counts = np.zeros(b_pad, np.int32)
+        for i, req in enumerate(work.requests):
+            row = work.token_ids[i]
+            token_ids[i, : len(row)] = row
+            positions[i, : len(row)] = work.positions[i]
+            slots[i, : len(row)] = work.slot_mappings[i]
+            context_lens[i] = work.context_lens[i]
+            sample_rows[i] = i * t_pad + len(row) - 1
+            s = req.sampling
+            temps[i], top_ps[i], top_ks[i] = s.temperature, s.top_p, s.top_k
+            seeds[i] = s.seed
+            counts[i] = len(req.output_token_ids)
+        block_tables = self._block_table_array(
+            [r.block_table for r in work.requests], pad_to=b_pad
         )
-        return [[int(tokens[0])]] if work.sample else [[]]
+        tokens = self._run(
+            token_ids, positions, block_tables, slots.reshape(-1), context_lens,
+            sample_rows, temps, top_ps, top_ks, seeds=seeds, counts=counts,
+        )
+        return [
+            [int(tokens[i])] if work.sample[i] else [] for i in range(b)
+        ]
 
     def _execute_decode(self, work: DecodeWork) -> list[list[int]]:
         if self._sleeping_params_host is not None:
@@ -288,11 +309,25 @@ class ModelRunner:
         )
         return np.asarray(jax.device_get(tokens))
 
+    @staticmethod
+    def _batch_bucket(b: int) -> int:
+        """Next power of two — bounds compiled program count to log2 sizes."""
+        return 1 << max(0, b - 1).bit_length()
+
     def _block_table_array(
         self, tables: list[list[int]], pad_to: int | None = None
     ) -> np.ndarray:
+        """(B, nb) table where nb is the *bucketed max blocks in use* — not
+        max_model_len/block_size. The gathered context is nb*block_size wide,
+        so sizing nb to the batch's real context (round-1 weak #2: the full
+        max-len gather per layer per step was the dominant waste) cuts HBM
+        traffic by max_model_len/actual_len; power-of-two nb keeps the
+        compiled-program set logarithmic."""
         b = pad_to or len(tables)
-        arr = np.zeros((b, self.max_blocks), np.int32)  # 0 = null page
+        longest = max((len(t) for t in tables), default=1)
+        nb = min(self._batch_bucket(longest), self.max_blocks)
+        nb = max(nb, 1)
+        arr = np.zeros((b, nb), np.int32)  # 0 = null page
         for i, tbl in enumerate(tables):
             arr[i, : len(tbl)] = tbl
         return arr

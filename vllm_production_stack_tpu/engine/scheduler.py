@@ -21,16 +21,35 @@ from .request import Request, RequestStatus
 
 @dataclass
 class PrefillWork:
-    """One chunk of one request's prompt. `sample` is set when the chunk
-    reaches the end of the prompt (its last-token logits produce the first
-    output token)."""
+    """One batched prefill dispatch: one chunk from each of N requests, padded
+    by the runner into a (B, T) device shape. Per-request `sample[i]` is set
+    when row i's chunk reaches the end of its prompt (its last-token logits
+    produce the first output token). Batching prompts into one dispatch is
+    where prefill throughput comes from — B=1-per-dispatch serializes the MXU
+    on small matmuls."""
 
-    request: Request
-    token_ids: list[int]
-    positions: list[int]
-    slot_mapping: list[int]
-    context_len: int
-    sample: bool
+    requests: list[Request] = field(default_factory=list)
+    token_ids: list[list[int]] = field(default_factory=list)
+    positions: list[list[int]] = field(default_factory=list)
+    slot_mappings: list[list[int]] = field(default_factory=list)
+    context_lens: list[int] = field(default_factory=list)
+    sample: list[bool] = field(default_factory=list)
+
+    def add_row(
+        self,
+        request: Request,
+        token_ids: list[int],
+        positions: list[int],
+        slot_mapping: list[int],
+        context_len: int,
+        sample: bool,
+    ) -> None:
+        self.requests.append(request)
+        self.token_ids.append(token_ids)
+        self.positions.append(positions)
+        self.slot_mappings.append(slot_mapping)
+        self.context_lens.append(context_len)
+        self.sample.append(sample)
 
 
 @dataclass
@@ -134,29 +153,60 @@ class Scheduler:
         return None
 
     def _schedule_prefill(self, prefilling: list[Request]) -> PrefillWork | None:
-        req = None
-        if prefilling:
-            req = prefilling[0]
-        elif self.waiting:
+        """Pack chunks from multiple requests into one dispatch: in-flight
+        prefills continue first (FIFO), then new admissions, until the
+        per-step token budget (max_num_batched_tokens) or the seat limit
+        (max_num_seqs rows) is hit."""
+        work = PrefillWork()
+        budget = self.config.max_num_batched_tokens
+
+        for req in prefilling:
+            if budget <= 0 or len(work.requests) >= self.config.max_num_seqs:
+                break
+            budget -= self._try_add_chunk(work, req, budget)
+
+        while (
+            budget > 0
+            and self.waiting
+            and len(self.running) < self.config.max_num_seqs
+            and len(work.requests) < self.config.max_num_seqs
+        ):
             req = self.waiting[0]
             if not self._can_admit(req):
-                return None
+                if req in self.waiting:
+                    break  # watermark: stop admitting until memory frees
+                continue  # impossible-fit request was aborted; try the next
             self.waiting.popleft()
             self._admit(req)
             req.status = RequestStatus.RUNNING
             self.running.append(req)
-        if req is None:
-            return None
+            budget -= self._try_add_chunk(work, req, budget)
 
+        # _ensure_blocks for a later row may have preempted an earlier row's
+        # request (newest-victim policy); its slots now point at reallocated
+        # blocks, so the row must be dropped — the request recomputes later
+        if any(r not in self.running for r in work.requests):
+            keep = [i for i, r in enumerate(work.requests) if r in self.running]
+            for name in (
+                "requests", "token_ids", "positions", "slot_mappings",
+                "context_lens", "sample",
+            ):
+                setattr(work, name, [getattr(work, name)[i] for i in keep])
+        return work if work.requests else None
+
+    def _try_add_chunk(self, work: PrefillWork, req: Request, budget: int) -> int:
+        """Add one chunk of `req` to the batch; returns tokens consumed."""
         target = req.prefill_target
-        chunk = min(
-            self.config.max_num_batched_tokens, target - req.num_computed_tokens
-        )
+        chunk = min(budget, target - req.num_computed_tokens)
+        if chunk <= 0:
+            return 0
         if not self._ensure_blocks(req, req.num_computed_tokens + chunk):
-            return None
+            return 0  # req preempted itself; it's back in waiting
+        if req not in self.running:
+            return 0
         start = req.num_computed_tokens
         idxs = range(start, start + chunk)
-        work = PrefillWork(
+        work.add_row(
             request=req,
             token_ids=[req.token_at(i) for i in idxs],
             positions=list(idxs),
@@ -166,7 +216,7 @@ class Scheduler:
             # requests already know their next token
             sample=start + chunk == target and not req.output_token_ids,
         )
-        return work
+        return chunk
 
     def _schedule_decode(self, ready: list[Request]) -> DecodeWork | None:
         cand = ready[: self.config.max_num_seqs]
@@ -317,17 +367,17 @@ class Scheduler:
         are discarded."""
         results: list[tuple[Request, list[int]]] = []
         if isinstance(work, PrefillWork):
-            req = work.request
-            start = req.num_computed_tokens
-            req.num_computed_tokens = work.context_len
-            self._register_full_blocks(req, start, work.context_len)
-            if work.sample:
-                tok = sampled[0][0]
-                req.output_token_ids.append(tok)
-                self._maybe_finish(req)
-                results.append((req, [tok]))
-            else:
-                results.append((req, []))
+            for i, req in enumerate(work.requests):
+                start = req.num_computed_tokens
+                req.num_computed_tokens = work.context_lens[i]
+                self._register_full_blocks(req, start, work.context_lens[i])
+                if work.sample[i]:
+                    tok = sampled[i][0]
+                    req.output_token_ids.append(tok)
+                    self._maybe_finish(req)
+                    results.append((req, [tok]))
+                else:
+                    results.append((req, []))
         else:
             for req, row in zip(work.requests, sampled):
                 accepted: list[int] = []

@@ -56,8 +56,10 @@ def llama_param_specs(cfg: ModelConfig) -> dict:
 
 
 def kv_cache_spec() -> P:
-    """[L, 2, num_blocks, block_size, kv_heads, head_dim] — shard kv heads."""
-    return P(None, None, None, None, TP_AXIS, None)
+    """Per-layer leaf [2, num_blocks, block_size, kv_heads, head_dim] — shard
+    kv heads. Applies to every leaf of the per-layer KV tuple (jit/`device_put`
+    treat a single spec as a pytree prefix)."""
+    return P(None, None, None, TP_AXIS, None)
 
 
 def decode_tokens_spec() -> P:
