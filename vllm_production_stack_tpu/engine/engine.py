@@ -63,7 +63,10 @@ class LLMEngine:
                 cache=replace(
                     config.cache,
                     num_blocks=derive_num_blocks(
-                        config.model, config.cache, config.parallel
+                        config.model,
+                        config.cache,
+                        config.parallel,
+                        max_num_seqs=config.scheduler.max_num_seqs,
                     ),
                 )
             )

@@ -19,6 +19,8 @@ logger = init_logger(__name__)
 DEFAULT_HBM_BYTES = 16 * 1024**3  # v5e-class chip
 # XLA workspace + fragmentation + activation headroom per device
 RESERVE_BYTES = 1024**3
+# extra pool capacity beyond live-sequence needs, kept as LRU prefix-cache room
+PREFIX_CACHE_OVERPROVISION = 4
 
 
 def dtype_bytes(dtype: str) -> int:
@@ -67,15 +69,33 @@ def derive_num_blocks(
     cache: CacheConfig,
     parallel: ParallelConfig,
     hbm_bytes: int | None = None,
+    max_num_seqs: int | None = None,
 ) -> int:
-    """Blocks that fit in hbm_utilization × HBM after weights + reserve."""
+    """Blocks that fit in hbm_utilization × HBM after weights + reserve.
+
+    The fused decode window keeps the pool loop-invariant (staged-KV design,
+    ops/attention.py:paged_attention_with_staged), so compile-time temps no
+    longer scale with pool size and the pool really can take ~the whole
+    post-weights budget. When `max_num_seqs` is known the pool is still
+    capped at what the workload can use — live-sequence capacity, times
+    PREFIX_CACHE_OVERPROVISION when prefix caching is on (LRU cache room) —
+    so tiny models on big chips don't hold HBM they can never reference."""
     hbm = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
     tp = parallel.tensor_parallel_size
     budget = int(hbm * cache.hbm_utilization) - param_bytes(model, tp) - RESERVE_BYTES
     per_block = kv_block_bytes(model, cache.block_size, tp)
-    n = max(2, budget // per_block)
-    # no point holding more pages than max_model_len × max concurrent seqs
-    # could ever reference (keeps tiny models from grabbing the whole chip)
+    if budget < 2 * per_block:
+        raise ValueError(
+            f"model weights ({param_bytes(model, tp) / 1024**3:.2f} GiB/device) "
+            f"+ reserve leave no room for a KV pool in "
+            f"{cache.hbm_utilization:.0%} of {hbm / 1024**3:.2f} GiB HBM — "
+            f"raise hbm_utilization, shard wider (tp={tp}), or shrink the model"
+        )
+    n = budget // per_block
+    if max_num_seqs is not None:
+        per_seq = cache.max_blocks_per_seq(model.max_model_len)
+        over = PREFIX_CACHE_OVERPROVISION if cache.enable_prefix_caching else 1
+        n = min(n, over * max_num_seqs * per_seq)
     logger.info(
         "KV pool: %d blocks of %d tokens (%.2f GiB of %.2f GiB HBM; weights %.2f GiB)",
         n,

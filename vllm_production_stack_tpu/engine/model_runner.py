@@ -117,11 +117,18 @@ class ModelRunner:
 
     def _build_decode_window_fn(self):
         """K decode iterations fused into one dispatch: a lax.fori_loop feeds
-        each iteration's sampled tokens into the next ON DEVICE, computes KV
-        slots from the block tables in-device, and returns the (B, K) token
-        matrix in a single fetch. Host↔device round-trip latency — the
-        dominant per-step cost, especially through remote-device tunnels —
-        amortizes over B*K tokens instead of B."""
+        each iteration's sampled tokens into the next ON DEVICE and returns
+        the (B, K) token matrix in a single fetch. Host↔device round-trip
+        latency — the dominant per-step cost, especially through
+        remote-device tunnels — amortizes over B*K tokens instead of B.
+
+        The KV pool is deliberately NOT a loop carry: each iteration writes
+        its K/V into a small (L, 2, W, B, kvH, D) staging buffer and attends
+        over [pooled history + staged window]; the pool is scattered into
+        once, after the loop. Carrying the pool ping-pongs it in the while
+        body — two extra full-pool buffers of compile-time temp (measured
+        2.0 GiB pool → 4.28 GiB temp), which is what used to cap pool sizes
+        far below HBM."""
         cfg = self.config.model
         block_size = self.config.cache.block_size
 
@@ -136,7 +143,6 @@ class ModelRunner:
             first_tokens,  # (B,) input token per request
             positions0,  # (B,) first decode position per request
             block_tables,  # (B, max_blocks) covering the whole window
-            context0,  # (B,) context length at the first step
             temperature,  # (B,)
             top_p,  # (B,)
             top_k,  # (B,)
@@ -148,33 +154,37 @@ class ModelRunner:
         ):
             b = first_tokens.shape[0]
             out = jnp.zeros((b, window), jnp.int32)
+            staged = llama.init_staged_kv(cfg, window, b)
+            # pool history for row r is positions < positions0[r]; the window
+            # tokens themselves live in `staged` until the post-loop commit
+            s_ctx = block_tables.shape[1] * block_size
+            hist_mask = (
+                jnp.arange(s_ctx, dtype=jnp.int32)[None, :] < positions0[:, None]
+            )
 
             def body(k, carry):
-                kv, cur, out = carry
-                pos = positions0 + k
-                ctx = context0 + k
-                slot = (
-                    jnp.take_along_axis(
-                        block_tables, (pos // block_size)[:, None], axis=1
-                    )[:, 0]
-                    * block_size
-                    + pos % block_size
+                staged, cur, out = carry
+                hidden, staged = llama.decode_window_step(
+                    cfg, params, cur, positions0 + k, kv_caches,
+                    block_tables, staged, k, hist_mask,
                 )
-                hidden, kv = llama.forward(
-                    cfg, params, cur[:, None], pos[:, None], kv,
-                    block_tables, slot, ctx,
-                )
-                logits = llama.compute_logits(cfg, params, hidden[:, 0])
+                logits = llama.compute_logits(cfg, params, hidden)
                 toks = sample(
                     logits, temperature, top_p, top_k,
                     jax.random.fold_in(base_key, k),
                     seeds, has_seed, counts0 + k,
                 )
-                return kv, toks, out.at[:, k].set(toks)
+                return staged, toks, out.at[:, k].set(toks)
 
-            kv_caches, _, out = jax.lax.fori_loop(
-                0, window, body, (kv_caches, first_tokens, out)
+            staged, _, out = jax.lax.fori_loop(
+                0, window, body, (staged, first_tokens, out)
             )
+            # commit the window's KV to the pool: slots for row r, step k are
+            # position positions0[r] + k via the row's block table
+            pos = positions0[:, None] + jnp.arange(window, dtype=jnp.int32)[None, :]
+            blk = jnp.take_along_axis(block_tables, pos // block_size, axis=1)
+            slots = (blk * block_size + pos % block_size).reshape(-1)
+            kv_caches = llama.commit_staged_kv(kv_caches, staged, slots)
             return kv_caches, out
 
         return decode_window_fn
@@ -246,8 +256,6 @@ class ModelRunner:
         block_tables = self._block_table_array(
             [r.block_table for r in work.requests], pad_to=b_pad
         )
-        context0 = np.zeros(b_pad, np.int32)
-        context0[:b] = work.context_lens
         temps = [r.sampling.temperature for r in work.requests] + [0.0] * (b_pad - b)
         top_ps = [r.sampling.top_p for r in work.requests] + [1.0] * (b_pad - b)
         top_ks = [r.sampling.top_k for r in work.requests] + [0] * (b_pad - b)
@@ -263,7 +271,6 @@ class ModelRunner:
             first_tokens,
             positions0,
             block_tables,
-            context0,
             np.asarray(temps, np.float32),
             np.asarray(top_ps, np.float32),
             np.asarray(top_ks, np.int32),

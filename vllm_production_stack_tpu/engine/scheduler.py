@@ -63,7 +63,6 @@ class DecodeWork:
     window: int = 1
     token_ids: list[int] = field(default_factory=list)  # first token per req
     positions: list[int] = field(default_factory=list)  # first position per req
-    context_lens: list[int] = field(default_factory=list)  # at first step
 
 
 ScheduleOutput = PrefillWork | DecodeWork
@@ -220,14 +219,23 @@ class Scheduler:
 
     def _schedule_decode(self, ready: list[Request]) -> DecodeWork | None:
         cand = ready[: self.config.max_num_seqs]
-        # window bounded by model length per seq and by the largest remaining
-        # output budget (beyond that every token would be discarded)
+        # window bounded by model length per seq and by the batch's largest
+        # remaining output budget rounded UP to a power of two: past that
+        # every token of every row would be discarded, but rounding up keeps
+        # the window inside the same {1,2,4,...} compile set as the snap
+        # below (each distinct window value is a ~20 s XLA compile; walking
+        # the window down through arbitrary integers at the tail of a run
+        # compiled fresh programs for tokens that cost microseconds to
+        # overshoot)
         window = max(1, self.config.decode_window)
+        max_remaining = max(
+            r.sampling.max_tokens - len(r.output_token_ids) for r in cand
+        )
         window = min(
             window,
+            1 << max(0, max_remaining - 1).bit_length(),
             min(self.model_config.max_model_len - r.num_computed_tokens
                 for r in cand),
-            max(r.sampling.max_tokens - len(r.output_token_ids) for r in cand),
         )
         # clamp to pool headroom: the batch's total new-block demand at this
         # window must fit in currently-free blocks, so _ensure_blocks below
@@ -257,7 +265,6 @@ class Scheduler:
             pos = req.num_computed_tokens
             batch.token_ids.append(req.token_at(pos))
             batch.positions.append(pos)
-            batch.context_lens.append(pos + 1)
         return batch
 
     # -- memory ------------------------------------------------------------

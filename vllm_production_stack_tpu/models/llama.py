@@ -23,6 +23,7 @@ from ..engine.config import ModelConfig
 from ..ops.attention import (
     apply_rope,
     causal_page_mask,
+    paged_attention_with_staged,
     paged_attention_xla,
     write_kv_pages,
 )
@@ -166,6 +167,85 @@ def forward(
         new_kv.append(layer_kv)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return x, tuple(new_kv)
+
+
+def init_staged_kv(
+    cfg: ModelConfig, window: int, batch: int, dtype: Any | None = None
+) -> jax.Array:
+    """Staging buffer for one fused decode window: (L, 2, W, B, kvH, D).
+    Small (MBs), so carrying it through the window loop is cheap — unlike the
+    pool itself (see paged_attention_with_staged)."""
+    dt = jnp.dtype(dtype) if dtype is not None else _dtype(cfg)
+    return jnp.zeros(
+        (cfg.num_layers, 2, window, batch, cfg.num_kv_heads, cfg.head_dim), dt
+    )
+
+
+def decode_window_step(
+    cfg: ModelConfig,
+    params: dict,
+    token_ids: jax.Array,  # (B,) this iteration's input token per row
+    positions: jax.Array,  # (B,) this iteration's position per row
+    kv_caches: tuple[jax.Array, ...],  # read-only pool
+    block_tables: jax.Array,  # (B, max_blocks)
+    staged: jax.Array,  # (L, 2, W, B, kvH, D) window staging buffer
+    step_k: jax.Array,  # scalar int32: iteration index within the window
+    hist_mask: jax.Array,  # (B, S): pool positions < row history length
+) -> tuple[jax.Array, jax.Array]:
+    """One decode iteration inside a fused window: reads the pool, writes this
+    token's K/V into `staged` (not the pool — the pool stays loop-invariant so
+    XLA doesn't ping-pong it through the loop carry; see
+    ops/attention.py:paged_attention_with_staged). Returns (hidden (B, h),
+    staged')."""
+    b = token_ids.shape[0]
+    hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+    window = staged.shape[2]
+    x = params["embed"][token_ids].astype(_dtype(cfg))  # (B, h)
+    # staged slot w is attendable once written: w <= k
+    staged_mask = jnp.arange(window, dtype=jnp.int32) <= step_k
+
+    for i in range(cfg.num_layers):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+        res = x
+        xn = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        ap = lp["attn"]
+        q = xn @ ap["wq"]
+        k = xn @ ap["wk"]
+        v = xn @ ap["wv"]
+        if cfg.attention_bias:
+            q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
+        q = apply_rope(q.reshape(b, 1, nh, hd), positions[:, None], cfg.rope_theta)
+        k = apply_rope(k.reshape(b, 1, nkv, hd), positions[:, None], cfg.rope_theta)
+        v = v.reshape(b, nkv, hd)
+        staged = staged.at[i, 0, step_k].set(k[:, 0].astype(staged.dtype))
+        staged = staged.at[i, 1, step_k].set(v.astype(staged.dtype))
+        attn = paged_attention_with_staged(
+            q, kv_caches[i], block_tables, hist_mask,
+            staged[i, 0], staged[i, 1], staged_mask, scale=hd**-0.5,
+        )
+        x = res + attn.reshape(b, nh * hd) @ ap["wo"]
+        res = x
+        xn = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        mp = lp["mlp"]
+        x = res + (jax.nn.silu(xn @ mp["gate"]) * (xn @ mp["up"])) @ mp["down"]
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return x, staged
+
+
+def commit_staged_kv(
+    kv_caches: tuple[jax.Array, ...],
+    staged: jax.Array,  # (L, 2, W, B, kvH, D)
+    slot_mapping: jax.Array,  # (B*W,) flat pool slots, row-major (b, w)
+) -> tuple[jax.Array, ...]:
+    """Scatter a whole window's staged K/V into the (donated) pool, one
+    scatter per layer — the only pool write of the fused decode window."""
+    L, _, w, b, kvh, d = staged.shape
+    new_kv: list[jax.Array] = []
+    for i in range(L):
+        k_rows = jnp.moveaxis(staged[i, 0], 0, 1).reshape(b * w, kvh, d)
+        v_rows = jnp.moveaxis(staged[i, 1], 0, 1).reshape(b * w, kvh, d)
+        new_kv.append(write_kv_pages(kv_caches[i], k_rows, v_rows, slot_mapping))
+    return tuple(new_kv)
 
 
 def compute_logits(cfg: ModelConfig, params: dict, hidden: jax.Array) -> jax.Array:

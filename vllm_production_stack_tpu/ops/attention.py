@@ -90,6 +90,35 @@ def causal_page_mask(
     return valid[:, None, :] & causal
 
 
+def masked_attention(
+    q: jax.Array,
+    keys: jax.Array,
+    values: jax.Array,
+    mask: jax.Array,
+    *,
+    scale: float,
+) -> jax.Array:
+    """GQA attention over already-contiguous keys/values.
+
+    q: (B, T, num_heads, D); keys/values: (B, S, kvH, D); mask: (B, T, S).
+    returns: (B, T, num_heads, D)
+    """
+    b, t, num_heads, d = q.shape
+    kvh = keys.shape[2]
+    qpk = num_heads // kvh
+    qg = q.reshape(b, t, kvh, qpk, d)
+    # scores: (B, kvH, qpk, T, S)
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg.astype(jnp.float32), keys.astype(jnp.float32)
+    )
+    scores *= scale
+    scores = jnp.where(mask[:, None, None], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, values.astype(jnp.float32))
+    return out.reshape(b, t, num_heads, d).astype(q.dtype)
+
+
 def paged_attention_xla(
     q: jax.Array,
     kv: jax.Array,
@@ -110,19 +139,62 @@ def paged_attention_xla(
     mask: (B, T, S) from causal_page_mask
     returns: (B, T, num_heads, D)
     """
+    keys, values = gather_pages(kv, block_tables)  # (B, S, kvH, D)
+    return masked_attention(q, keys, values, mask, scale=scale)
+
+
+def paged_attention_with_staged(
+    q: jax.Array,
+    kv: jax.Array,
+    block_tables: jax.Array,
+    hist_mask: jax.Array,
+    staged_k: jax.Array,
+    staged_v: jax.Array,
+    staged_mask: jax.Array,
+    *,
+    scale: float,
+) -> jax.Array:
+    """Decode-window attention: pooled history + this window's staged KV.
+
+    Inside the fused decode window the pool is LOOP-INVARIANT (read-only):
+    the window's new K/V live in a small staging buffer and are committed to
+    the pool once after the loop. Carrying the full pool through the
+    lax.fori_loop instead ping-pongs it — two extra full-pool buffers of
+    compile-time temp (measured: 2.0 GiB pool → 4.28 GiB temp), which is what
+    capped pool sizes well below HBM.
+
+    q: (B, 1, num_heads, D) — decode queries
+    kv: (2, num_blocks, block_size, kvH, D), read-only
+    hist_mask: (B, S) — pool positions < this row's history length
+    staged_k/staged_v: (W, B, kvH, D) — this window's K/V so far
+    staged_mask: (W,) — staged slots valid at this iteration (w <= k)
+    returns: (B, 1, num_heads, D)
+    """
     b, t, num_heads, d = q.shape
     kvh = kv.shape[3]
     qpk = num_heads // kvh
-    keys, values = gather_pages(kv, block_tables)  # (B, S, kvH, D)
-
-    qg = q.reshape(b, t, kvh, qpk, d)
-    # scores: (B, kvH, qpk, T, S)
-    scores = jnp.einsum(
-        "btkgd,bskd->bkgts", qg.astype(jnp.float32), keys.astype(jnp.float32)
+    hist_k, hist_v = gather_pages(kv, block_tables)  # (B, S, kvH, D)
+    qg = q.reshape(b, t, kvh, qpk, d).astype(jnp.float32)
+    # score the two regions separately and concatenate SCORES (small, f32)
+    # rather than keys/values — concatenating K and V materializes a fresh
+    # (B, S+W, kvH, D) copy of the gathered history per layer per iteration
+    hist_scores = jnp.einsum("btkgd,bskd->bkgts", qg, hist_k.astype(jnp.float32))
+    st_scores = jnp.einsum(
+        "btkgd,wbkd->bkgtw", qg, staged_k.astype(jnp.float32)
     )
-    scores *= scale
+    scores = jnp.concatenate([hist_scores, st_scores], axis=-1) * scale
+    s = hist_k.shape[1]
+    mask = jnp.concatenate(
+        [
+            jnp.broadcast_to(hist_mask[:, None, :], (b, t, s)),
+            jnp.broadcast_to(staged_mask[None, None, :], (b, t, staged_mask.shape[0])),
+        ],
+        axis=-1,
+    )
     scores = jnp.where(mask[:, None, None], scores, NEG_INF)
-
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bskd->btkgd", probs, values.astype(jnp.float32))
+    out = jnp.einsum("bkgts,bskd->btkgd", probs[..., :s], hist_v.astype(jnp.float32))
+    out += jnp.einsum(
+        "bkgtw,wbkd->btkgd", probs[..., s:], staged_v.astype(jnp.float32)
+    )
     return out.reshape(b, t, num_heads, d).astype(q.dtype)
