@@ -95,7 +95,8 @@ def derive_num_blocks(
     if max_num_seqs is not None:
         per_seq = cache.max_blocks_per_seq(model.max_model_len)
         over = PREFIX_CACHE_OVERPROVISION if cache.enable_prefix_caching else 1
-        n = min(n, over * max_num_seqs * per_seq)
+        # +1: block 0 is the reserved null page, not usable capacity
+        n = min(n, over * max_num_seqs * per_seq + 1)
     logger.info(
         "KV pool: %d blocks of %d tokens (%.2f GiB of %.2f GiB HBM; weights %.2f GiB)",
         n,

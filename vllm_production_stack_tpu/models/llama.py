@@ -27,6 +27,7 @@ from ..ops.attention import (
     paged_attention_xla,
     write_kv_pages,
 )
+from ..ops.paged_attention_pallas import paged_decode_attention
 
 
 def _dtype(cfg: ModelConfig):
@@ -97,16 +98,17 @@ def init_kv_cache(
     )
 
 
-def _layer(
+def _layer_body(
     cfg: ModelConfig,
     lp: dict,
-    kv_layer: jax.Array,
-    x: jax.Array,
-    positions: jax.Array,
-    block_tables: jax.Array,
-    slot_mapping: jax.Array,
-    mask: jax.Array,
-) -> tuple[jax.Array, jax.Array]:
+    x: jax.Array,  # (B, T, h)
+    positions: jax.Array,  # (B, T)
+    attend,  # (q (B,T,nh,D), k (B,T,kvH,D), v (B,T,kvH,D)) -> (B,T,nh,D)
+) -> jax.Array:
+    """The Llama layer math shared by every execution mode — prefill and the
+    fused decode window differ ONLY in how attention consumes/stores KV, so
+    that strategy is injected as `attend` and everything else (projections,
+    bias, RoPE, residuals, MLP) exists exactly once."""
     b, t, h = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
 
@@ -118,23 +120,45 @@ def _layer(
     v = x @ ap["wv"]
     if cfg.attention_bias:
         q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
-    q = q.reshape(b, t, nh, hd)
-    k = k.reshape(b, t, nkv, hd)
+    q = apply_rope(q.reshape(b, t, nh, hd), positions, cfg.rope_theta)
+    k = apply_rope(k.reshape(b, t, nkv, hd), positions, cfg.rope_theta)
     v = v.reshape(b, t, nkv, hd)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
 
-    kv_layer = write_kv_pages(
-        kv_layer, k.reshape(b * t, nkv, hd), v.reshape(b * t, nkv, hd), slot_mapping
-    )
-    attn = paged_attention_xla(q, kv_layer, block_tables, mask, scale=hd**-0.5)
+    attn = attend(q, k, v)
     x = res + attn.reshape(b, t, nh * hd) @ ap["wo"]
 
     res = x
     x = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
     mp = lp["mlp"]
     x = (jax.nn.silu(x @ mp["gate"]) * (x @ mp["up"])) @ mp["down"]
-    return res + x, kv_layer
+    return res + x
+
+
+def _layer(
+    cfg: ModelConfig,
+    lp: dict,
+    kv_layer: jax.Array,
+    x: jax.Array,
+    positions: jax.Array,
+    block_tables: jax.Array,
+    slot_mapping: jax.Array,
+    mask: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    b, t = x.shape[0], x.shape[1]
+    hd, nkv = cfg.head_dim, cfg.num_kv_heads
+
+    def attend(q, k, v):
+        nonlocal kv_layer
+        kv_layer = write_kv_pages(
+            kv_layer, k.reshape(b * t, nkv, hd), v.reshape(b * t, nkv, hd),
+            slot_mapping,
+        )
+        return paged_attention_xla(
+            q, kv_layer, block_tables, mask, scale=hd**-0.5
+        )
+
+    x = _layer_body(cfg, lp, x, positions, attend)
+    return x, kv_layer
 
 
 def forward(
@@ -190,45 +214,45 @@ def decode_window_step(
     block_tables: jax.Array,  # (B, max_blocks)
     staged: jax.Array,  # (L, 2, W, B, kvH, D) window staging buffer
     step_k: jax.Array,  # scalar int32: iteration index within the window
-    hist_mask: jax.Array,  # (B, S): pool positions < row history length
+    hist_len: jax.Array,  # (B,): pool positions < hist_len are history
+    backend: str = "xla",  # "xla" | "pallas" (TPU kernel) | "pallas_interpret"
 ) -> tuple[jax.Array, jax.Array]:
     """One decode iteration inside a fused window: reads the pool, writes this
     token's K/V into `staged` (not the pool — the pool stays loop-invariant so
     XLA doesn't ping-pong it through the loop carry; see
     ops/attention.py:paged_attention_with_staged). Returns (hidden (B, h),
     staged')."""
-    b = token_ids.shape[0]
-    hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+    hd = cfg.head_dim
     window = staged.shape[2]
-    x = params["embed"][token_ids].astype(_dtype(cfg))  # (B, h)
+    x = params["embed"][token_ids].astype(_dtype(cfg))[:, None]  # (B, 1, h)
     # staged slot w is attendable once written: w <= k
     staged_mask = jnp.arange(window, dtype=jnp.int32) <= step_k
+    if backend == "xla":
+        s_ctx = block_tables.shape[1] * kv_caches[0].shape[2]
+        hist_mask = (
+            jnp.arange(s_ctx, dtype=jnp.int32)[None, :] < hist_len[:, None]
+        )
 
     for i in range(cfg.num_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
-        res = x
-        xn = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        ap = lp["attn"]
-        q = xn @ ap["wq"]
-        k = xn @ ap["wk"]
-        v = xn @ ap["wv"]
-        if cfg.attention_bias:
-            q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
-        q = apply_rope(q.reshape(b, 1, nh, hd), positions[:, None], cfg.rope_theta)
-        k = apply_rope(k.reshape(b, 1, nkv, hd), positions[:, None], cfg.rope_theta)
-        v = v.reshape(b, nkv, hd)
-        staged = staged.at[i, 0, step_k].set(k[:, 0].astype(staged.dtype))
-        staged = staged.at[i, 1, step_k].set(v.astype(staged.dtype))
-        attn = paged_attention_with_staged(
-            q, kv_caches[i], block_tables, hist_mask,
-            staged[i, 0], staged[i, 1], staged_mask, scale=hd**-0.5,
-        )
-        x = res + attn.reshape(b, nh * hd) @ ap["wo"]
-        res = x
-        xn = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        mp = lp["mlp"]
-        x = res + (jax.nn.silu(xn @ mp["gate"]) * (xn @ mp["up"])) @ mp["down"]
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+
+        def attend(q, k, v, i=i):
+            nonlocal staged
+            staged = staged.at[i, 0, step_k].set(k[:, 0].astype(staged.dtype))
+            staged = staged.at[i, 1, step_k].set(v[:, 0].astype(staged.dtype))
+            if backend == "xla":
+                return paged_attention_with_staged(
+                    q, kv_caches[i], block_tables, hist_mask,
+                    staged[i, 0], staged[i, 1], staged_mask, scale=hd**-0.5,
+                )
+            return paged_decode_attention(
+                q[:, 0], kv_caches[i], block_tables, hist_len,
+                staged[i, 0], staged[i, 1], step_k, scale=hd**-0.5,
+                interpret=backend == "pallas_interpret",
+            )[:, None]
+
+        x = _layer_body(cfg, lp, x, positions[:, None], attend)
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps)
     return x, staged
 
 

@@ -1,0 +1,205 @@
+"""Pallas TPU kernel: paged decode attention over the HBM KV pool.
+
+The XLA fallback (ops/attention.py:paged_attention_with_staged) materializes
+each row's gathered history — (B, S, kvH, D) per layer per window iteration —
+in HBM before attending. This kernel never materializes the gather: the
+Pallas pipeline streams KV pages HBM→VMEM directly from the paged pool, with
+the page id for each grid step read from the scalar-prefetched block table
+(the "gather" IS the pipeline's index_map), and a flash-style online softmax
+accumulates across pages in VMEM scratch. The window's staged K/V (see
+models/llama.py:decode_window_step) are folded in by a final grid step so the
+kernel computes the complete decode attention output.
+
+Reference parity: the reference stack delegates this op to vLLM's CUDA
+paged-attention kernels inside its engine images (external to its repo);
+SURVEY §7.3 ranks a TPU-native equivalent as hard part #1.
+
+Layout notes (pallas_guide.md): last dim 128 lanes — head_dim (64/128) maps
+onto lanes; token-position and head axes map onto sublanes. All matmuls are
+(≤heads × D) @ (D × page) — small for the MXU, but decode is HBM-bandwidth
+bound, so the win is streaming pages once, not MXU utilization.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    tables_ref,  # (B, nb) int32 — page id per (row, page-slot)
+    hist_ref,  # (B,) int32 — pool history length per row
+    step_ref,  # (1,) int32 — iteration index inside the fused window
+    # pipeline inputs
+    q_ref,  # (1, nh, D)
+    kv_ref,  # (2, 1, bs, kvh, D) — this grid step's pool page (K and V)
+    staged_k_ref,  # (W, 1, kvh, D) — this row's staged window K
+    staged_v_ref,  # (W, 1, kvh, D)
+    # output
+    out_ref,  # (1, nh, D)
+    # scratch
+    m_ref,  # (nh, 1) f32 running max
+    l_ref,  # (nh, 1) f32 running denominator
+    acc_ref,  # (nh, D) f32 running numerator
+    *,
+    scale: float,
+    block_size: int,
+    num_kv_heads: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    num_pages = pl.num_programs(1) - 1  # last step handles the staged window
+
+    nh, d = q_ref.shape[1], q_ref.shape[2]
+    qpk = nh // num_kv_heads
+    q = q_ref[0].astype(jnp.float32)  # (nh, D)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def flash_update(scores, values):
+        """scores: (nh, S) f32 already masked; values: (S, kvh, D)."""
+        s_len = scores.shape[1]
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_cur = jnp.max(scores, axis=1, keepdims=True)  # (nh, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # (nh, 1)
+        p = jnp.exp(scores - m_new)  # (nh, S)
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = m_new
+        # per-kv-head GQA: the qpk query heads of group g attend values[:, g].
+        # Built by concatenation — value-level .at[].add lowers to scatter-add,
+        # which the Pallas TPU lowering doesn't implement
+        v_f = values.astype(jnp.float32)
+        acc = acc_ref[:] * alpha  # (nh, D)
+        acc_ref[:] = jnp.concatenate(
+            [
+                acc[g * qpk : (g + 1) * qpk]
+                + jax.lax.dot(
+                    p[g * qpk : (g + 1) * qpk],
+                    v_f[:, g, :],
+                    preferred_element_type=jnp.float32,
+                )
+                for g in range(num_kv_heads)
+            ],
+            axis=0,
+        )
+
+    @pl.when(j < num_pages)
+    def _():
+        k_page = kv_ref[0, 0].astype(jnp.float32)  # (bs, kvh, D)
+        v_page = kv_ref[1, 0]
+        # token positions covered by this page slot
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1
+        )
+        valid = pos < hist_ref[b]  # (1, bs)
+        scores = jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    q[g * qpk : (g + 1) * qpk],
+                    k_page[:, g, :],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for g in range(num_kv_heads)
+            ],
+            axis=0,
+        )  # (nh, bs)
+        scores = jnp.where(valid, scores * scale, NEG_INF)
+        flash_update(scores, v_page)
+
+    @pl.when(j == num_pages)
+    def _():
+        w = staged_k_ref.shape[0]
+        k_st = staged_k_ref[:, 0].astype(jnp.float32)  # (W, kvh, D)
+        v_st = staged_v_ref[:, 0]
+        widx = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+        valid = widx <= step_ref[0]  # staged slot written iff w <= k
+        scores = jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    q[g * qpk : (g + 1) * qpk],
+                    k_st[:, g, :],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for g in range(num_kv_heads)
+            ],
+            axis=0,
+        )  # (nh, W)
+        scores = jnp.where(valid, scores * scale, NEG_INF)
+        flash_update(scores, v_st)
+        out_ref[0] = (acc_ref[:] / l_ref[:]).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_decode_attention(
+    q: jax.Array,  # (B, nh, D) — decode queries, one token per row
+    kv: jax.Array,  # (2, num_blocks, bs, kvh, D) — the pool, read-only
+    block_tables: jax.Array,  # (B, nb) int32
+    hist_len: jax.Array,  # (B,) int32 — pool positions < hist_len are valid
+    staged_k: jax.Array,  # (W, B, kvh, D) — fused-window staged keys
+    staged_v: jax.Array,  # (W, B, kvh, D)
+    step_k: jax.Array,  # scalar int32 — current iteration in the window
+    *,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Complete decode attention (pooled history + staged window) without
+    materializing the per-row gather. Returns (B, nh, D)."""
+    b, nh, d = q.shape
+    kvh, bs = kv.shape[3], kv.shape[2]
+    nb = block_tables.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # tables, hist_len, step_k
+        grid=(b, nb + 1),
+        in_specs=[
+            pl.BlockSpec((1, nh, d), lambda i, j, t, h, s: (i, 0, 0)),
+            # the paged "gather": page id for grid step (i, j) comes straight
+            # from the prefetched block table (clamped on the final step,
+            # whose fetch is unused)
+            pl.BlockSpec(
+                (2, 1, bs, kvh, d),
+                lambda i, j, t, h, s: (
+                    0,
+                    t[i, jnp.minimum(j, t.shape[1] - 1)],
+                    0,
+                    0,
+                    0,
+                ),
+            ),
+            pl.BlockSpec(
+                (staged_k.shape[0], 1, kvh, d), lambda i, j, t, h, s: (0, i, 0, 0)
+            ),
+            pl.BlockSpec(
+                (staged_v.shape[0], 1, kvh, d), lambda i, j, t, h, s: (0, i, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, nh, d), lambda i, j, t, h, s: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 1), jnp.float32),
+            pltpu.VMEM((nh, 1), jnp.float32),
+            pltpu.VMEM((nh, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, block_size=bs, num_kv_heads=kvh
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nh, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, hist_len, jnp.reshape(step_k, (1,)), q, kv, staged_k, staged_v)
