@@ -1,0 +1,134 @@
+"""Checkpoint loading: a real safetensors checkpoint on disk round-trips into
+the engine with HF logits parity.
+
+The reference's contract is model-path → served weights (its operator passes
+modelURL straight to `vllm serve`, vllmruntime_controller.go:228-286); here a
+tiny HF model is SAVED to disk and loaded back through the full path:
+config.json parse → safetensors → stacked/transposed param tree → forward.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import LlamaConfig as HFLlamaConfig
+from transformers import LlamaForCausalLM, Qwen2Config, Qwen2ForCausalLM
+
+import jax.numpy as jnp
+
+from vllm_production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    SchedulerConfig,
+)
+from vllm_production_stack_tpu.models import llama
+from vllm_production_stack_tpu.models.loader import load_checkpoint_params
+from vllm_production_stack_tpu.models.registry import resolve_model_config
+
+
+def _save_tiny_llama(tmp_path, tie=False):
+    hf_cfg = HFLlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=10000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=256, tie_word_embeddings=tie,
+        attention_bias=False, mlp_bias=False, torch_dtype="float32",
+    )
+    model = LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def _save_tiny_qwen2(tmp_path):
+    hf_cfg = Qwen2Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=10000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        torch_dtype="float32",
+    )
+    model = Qwen2ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def _jax_prefill_logits(cfg, params, tokens):
+    block_size, num_blocks = 8, 32
+    t = len(tokens)
+    kv = llama.init_kv_cache(cfg, num_blocks, block_size, jnp.float32)
+    nb = (t + block_size - 1) // block_size
+    table = np.zeros((1, num_blocks), np.int32)
+    table[0, :nb] = np.arange(1, nb + 1)
+    slots = (
+        table[0, np.arange(t) // block_size] * block_size
+        + np.arange(t) % block_size
+    )
+    hidden, _ = llama.forward(
+        cfg, params,
+        jnp.asarray([tokens], jnp.int32),
+        jnp.asarray([list(range(t))], jnp.int32),
+        kv, jnp.asarray(table), jnp.asarray(slots, jnp.int32),
+        jnp.asarray([t], jnp.int32),
+    )
+    return np.asarray(llama.compute_logits(cfg, params, hidden[0]))
+
+
+@pytest.mark.parametrize("tie", [False, True])
+def test_llama_checkpoint_logits_parity(tmp_path, tie):
+    hf_model = _save_tiny_llama(tmp_path, tie=tie)
+    cfg = resolve_model_config(str(tmp_path), dtype="float32")
+    assert cfg.checkpoint == str(tmp_path)
+    assert cfg.tie_word_embeddings == tie
+    params = load_checkpoint_params(cfg)
+
+    tokens = list(np.random.RandomState(0).randint(1, 512, size=17))
+    ours = _jax_prefill_logits(cfg, params, tokens)
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor([tokens])).logits[0].numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_checkpoint_with_bias(tmp_path):
+    hf_model = _save_tiny_qwen2(tmp_path)
+    cfg = resolve_model_config(str(tmp_path), dtype="float32")
+    assert cfg.architecture == "qwen2"
+    assert cfg.attention_bias
+    params = load_checkpoint_params(cfg)
+    assert "bq" in params["layers"]["attn"]
+
+    tokens = list(np.random.RandomState(1).randint(1, 512, size=11))
+    ours = _jax_prefill_logits(cfg, params, tokens)
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor([tokens])).logits[0].numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_engine_serves_checkpoint_greedy_matches_hf(tmp_path):
+    """End-to-end: --model <dir> → engine serves the real weights."""
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    hf_model = _save_tiny_llama(tmp_path)
+    cfg = resolve_model_config(str(tmp_path), dtype="float32")
+    config = EngineConfig(
+        model=cfg,
+        cache=CacheConfig(block_size=8, num_blocks=64),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=64,
+            decode_buckets=(4,), prefill_buckets=(32, 64), decode_window=4,
+        ),
+    )
+    engine = LLMEngine(config)
+    prompt = list(np.random.RandomState(2).randint(1, 512, size=9))
+    out = engine.generate(
+        [prompt], SamplingParams(max_tokens=6, temperature=0.0,
+                                 ignore_eos=True)
+    )[0]
+
+    with torch.no_grad():
+        ids = torch.tensor([prompt])
+        hf_out = hf_model.generate(
+            ids, max_new_tokens=6, do_sample=False,
+            pad_token_id=0, eos_token_id=None,
+        )[0, len(prompt):].tolist()
+    assert out["token_ids"] == hf_out

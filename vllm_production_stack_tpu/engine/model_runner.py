@@ -53,6 +53,10 @@ class ModelRunner:
         param_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), llama_param_specs(cfg)
         )
+        if params is None and cfg.checkpoint:
+            from ..models.loader import load_checkpoint_params
+
+            params = load_checkpoint_params(cfg)
         self._random_weights = params is None
         if params is None:
             logger.info("initializing random weights for %s", cfg.model)

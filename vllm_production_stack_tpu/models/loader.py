@@ -1,0 +1,114 @@
+"""Checkpoint loading: HF safetensors → the stacked JAX param tree.
+
+The reference stack's contract is model-URL → served weights — its operator
+passes the model path straight to `vllm serve`
+(reference: operator/internal/controller/vllmruntime_controller.go:228-286)
+and caches weights on a PVC (helm/templates/pvc.yaml, tutorial 03). The TPU
+engine's equivalent: a local HF checkpoint dir (config.json +
+*.safetensors), mapped into the scan-stacked layout of
+models/llama.py:init_params:
+
+- HF stores projection weights (out, in); ours are (in, out) so the forward
+  pass is plain ``x @ w`` — every matrix transposes on load.
+- Per-layer weights stack along a leading L axis (one traced layer body).
+
+Weights land on device via the caller's NamedShardings (ModelRunner
+device_puts each leaf into its TP layout), so a checkpoint loads directly
+into its sharded placement without a replicated copy first.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+from ..engine.config import ModelConfig
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class _ShardedCheckpoint:
+    """All tensors across a checkpoint's *.safetensors shards, opened lazily."""
+
+    def __init__(self, path: str):
+        from safetensors import safe_open
+
+        files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+        if not files:
+            raise FileNotFoundError(f"no *.safetensors under {path}")
+        self._handles = [safe_open(f, framework="np") for f in files]
+        self._index: dict[str, int] = {}
+        for fi, h in enumerate(self._handles):
+            for name in h.keys():
+                self._index[name] = fi
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def get(self, name: str) -> np.ndarray:
+        if name not in self._index:
+            raise KeyError(
+                f"tensor {name!r} missing from checkpoint "
+                f"(have e.g. {list(self._index)[:5]})"
+            )
+        return self._handles[self._index[name]].get_tensor(name)
+
+    def keys(self):
+        return self._index.keys()
+
+
+def load_checkpoint_params(cfg: ModelConfig) -> dict:
+    """Read cfg.checkpoint (HF dir) into the stacked param tree as numpy
+    arrays in cfg.dtype. Llama / Mistral / Qwen2 weight naming."""
+    import ml_dtypes
+
+    assert cfg.checkpoint, "ModelConfig.checkpoint is not set"
+    ckpt = _ShardedCheckpoint(cfg.checkpoint)
+    dt = (
+        ml_dtypes.bfloat16 if cfg.dtype == "bfloat16" else np.dtype(cfg.dtype)
+    )
+
+    def mat(name: str) -> np.ndarray:
+        # HF (out, in) -> ours (in, out)
+        return np.ascontiguousarray(ckpt.get(name).T).astype(dt)
+
+    def vec(name: str) -> np.ndarray:
+        return ckpt.get(name).astype(dt)
+
+    def stack(fmt: str, kind) -> np.ndarray:
+        return np.stack([kind(fmt.format(i)) for i in range(cfg.num_layers)])
+
+    p = "model.layers.{}."
+    params: dict = {
+        "embed": vec("model.embed_tokens.weight"),
+        "layers": {
+            "attn": {
+                "wq": stack(p + "self_attn.q_proj.weight", mat),
+                "wk": stack(p + "self_attn.k_proj.weight", mat),
+                "wv": stack(p + "self_attn.v_proj.weight", mat),
+                "wo": stack(p + "self_attn.o_proj.weight", mat),
+            },
+            "mlp": {
+                "gate": stack(p + "mlp.gate_proj.weight", mat),
+                "up": stack(p + "mlp.up_proj.weight", mat),
+                "down": stack(p + "mlp.down_proj.weight", mat),
+            },
+            "input_norm": stack(p + "input_layernorm.weight", vec),
+            "post_attn_norm": stack(p + "post_attention_layernorm.weight", vec),
+        },
+        "final_norm": vec("model.norm.weight"),
+    }
+    if cfg.attention_bias:
+        params["layers"]["attn"]["bq"] = stack(p + "self_attn.q_proj.bias", vec)
+        params["layers"]["attn"]["bk"] = stack(p + "self_attn.k_proj.bias", vec)
+        params["layers"]["attn"]["bv"] = stack(p + "self_attn.v_proj.bias", vec)
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = mat("lm_head.weight")
+    logger.info(
+        "loaded checkpoint %s (%d tensors, dtype %s)",
+        cfg.checkpoint, len(list(ckpt.keys())), cfg.dtype,
+    )
+    return params

@@ -4,6 +4,14 @@ tests can't download vocabularies)."""
 
 from __future__ import annotations
 
+import os
+
+from .logging import init_logger
+
+logger = init_logger(__name__)
+
+_TOKENIZER_FILES = ("tokenizer.json", "tokenizer_config.json", "vocab.json")
+
 
 class ByteTokenizer:
     """256 byte tokens + BOS/EOS/PAD. Deterministic, dependency-free."""
@@ -48,12 +56,27 @@ class TokenizerWrapper:
     incremental detokenization for streaming."""
 
     def __init__(self, tokenizer_path: str | None = None):
+        if tokenizer_path and self._is_dir_without_tokenizer(tokenizer_path):
+            # weights-only checkpoint dir: serve token-ids with the byte
+            # fallback rather than refusing to start. A mistyped/remote path
+            # or broken tokenizer files still fail loudly below.
+            logger.warning(
+                "no tokenizer files (%s) under %s; using the byte fallback",
+                "/".join(_TOKENIZER_FILES), tokenizer_path,
+            )
+            tokenizer_path = None
         if tokenizer_path:
             from transformers import AutoTokenizer
 
             self._tok = AutoTokenizer.from_pretrained(tokenizer_path)
         else:
             self._tok = ByteTokenizer()
+
+    @staticmethod
+    def _is_dir_without_tokenizer(path: str) -> bool:
+        return os.path.isdir(path) and not any(
+            os.path.exists(os.path.join(path, f)) for f in _TOKENIZER_FILES
+        )
 
     @property
     def eos_token_id(self) -> int | None:
