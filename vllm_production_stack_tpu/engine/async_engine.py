@@ -103,7 +103,7 @@ class AsyncEngine:
     # -- serving API -------------------------------------------------------
 
     def _submit(
-        self, request_id, prompt, prompt_token_ids, sampling, q
+        self, request_id, prompt, prompt_token_ids, sampling, q, lora_name=None
     ) -> str:
         """Runs in an executor: the step thread may hold the lock for a full
         device step (or a 10-40s first compile) — never block the event loop
@@ -125,6 +125,7 @@ class AsyncEngine:
                 prompt=prompt,
                 prompt_token_ids=prompt_token_ids,
                 sampling=sampling,
+                lora_name=lora_name,
             )
             self._queues[rid] = q
         self._wake.set()
@@ -136,6 +137,7 @@ class AsyncEngine:
         prompt_token_ids: list[int] | None = None,
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
+        lora_name: str | None = None,
     ) -> AsyncIterator[RequestOutput]:
         """Submit a request and yield its incremental outputs."""
         if self._step_error is not None:
@@ -143,7 +145,8 @@ class AsyncEngine:
         q: asyncio.Queue[RequestOutput] = asyncio.Queue()
         loop = asyncio.get_running_loop()
         rid = await loop.run_in_executor(
-            None, self._submit, request_id, prompt, prompt_token_ids, sampling, q
+            None, self._submit, request_id, prompt, prompt_token_ids, sampling,
+            q, lora_name,
         )
         finished = False
         try:
@@ -207,3 +210,17 @@ class AsyncEngine:
     def wake(self) -> None:
         with self._lock:
             self.engine.wake()
+
+    async def load_lora(self, name: str, path: str) -> None:
+        def work():
+            with self._lock:
+                self.engine.load_lora(name, path)
+
+        await asyncio.get_running_loop().run_in_executor(None, work)
+
+    async def unload_lora(self, name: str) -> None:
+        def work():
+            with self._lock:
+                self.engine.unload_lora(name)
+
+        await asyncio.get_running_loop().run_in_executor(None, work)

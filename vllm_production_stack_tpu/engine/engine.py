@@ -77,6 +77,8 @@ class LLMEngine:
         self.scheduler = Scheduler(config.model, config.cache, config.scheduler)
         self.runner = ModelRunner(config, params=params, mesh=mesh)
         self._states: dict[str, _RequestState] = {}
+        self._lora_slots: dict[str, int] = {}  # adapter name -> slot index
+        self._lora_paths: dict[str, str] = {}  # adapter name -> source path
         self._req_counter = itertools.count()
         self._prompt_tokens = 0
         self._generation_tokens = 0
@@ -89,17 +91,23 @@ class LLMEngine:
         prompt: str | None = None,
         prompt_token_ids: list[int] | None = None,
         sampling: SamplingParams | None = None,
+        lora_name: str | None = None,
     ) -> str:
         request_id = request_id or f"req-{next(self._req_counter)}"
         if prompt_token_ids is None:
             if prompt is None:
                 raise ValueError("need prompt or prompt_token_ids")
             prompt_token_ids = self.tokenizer.encode(prompt)
+        if lora_name is not None and lora_name not in self._lora_slots:
+            # races with a concurrent unload land here too — a clear 4xx-able
+            # error, not a KeyError 500
+            raise ValueError(f"LoRA adapter {lora_name!r} is not loaded")
         req = Request(
             request_id=request_id,
             prompt_token_ids=list(prompt_token_ids),
             sampling=sampling or SamplingParams(),
             eos_token_id=self.tokenizer.eos_token_id,
+            lora_index=self._lora_slots[lora_name] if lora_name else 0,
         )
         self.scheduler.add_request(req)
         self._states[request_id] = _RequestState(
@@ -112,6 +120,63 @@ class LLMEngine:
         req = self.scheduler.abort_request(request_id)
         self._states.pop(request_id, None)
         return req is not None
+
+    # -- LoRA adapters (reference contract: vLLM /v1/load_lora_adapter used
+    #    by the LoRA controller, loraadapter_controller.go:582-611) ---------
+
+    def load_lora(self, name: str, path: str) -> None:
+        """Parse a PEFT adapter dir and install it into a free slot; serving
+        `model=name` then computes base + (alpha/r)·B·A per request."""
+        from ..models.lora_loader import load_lora_adapter
+
+        if self.config.lora.max_loras == 0:
+            raise RuntimeError(
+                "LoRA is disabled (lora.max_loras=0); restart the engine "
+                "with adapter slots to load adapters"
+            )
+        if name in self._lora_slots:
+            raise ValueError(f"adapter {name!r} is already loaded")
+        used = set(self._lora_slots.values())
+        free = [
+            s for s in range(1, self.config.lora.num_slots) if s not in used
+        ]
+        if not free:
+            raise RuntimeError(
+                f"all {self.config.lora.max_loras} adapter slots in use"
+            )
+        adapter = load_lora_adapter(path, self.config.model, self.config.lora)
+        self.runner.install_lora(free[0], adapter)
+        self._lora_slots[name] = free[0]
+        self._lora_paths[name] = path
+
+    def unload_lora(self, name: str) -> None:
+        slot = self._lora_slots.get(name)
+        if slot is None:
+            raise KeyError(f"adapter {name!r} is not loaded")
+        # an in-flight request would silently continue on zeroed (or, after a
+        # slot-reusing load, a DIFFERENT adapter's) weights — refuse instead
+        busy = [
+            r.request_id
+            for r in (*self.scheduler.running, *self.scheduler.waiting)
+            if r.lora_index == slot
+        ]
+        if busy:
+            raise RuntimeError(
+                f"adapter {name!r} is serving request(s) {busy[:3]}; drain "
+                "or abort them before unloading"
+            )
+        del self._lora_slots[name]
+        self._lora_paths.pop(name, None)
+        self.runner.remove_lora(slot)
+
+    def list_loras(self) -> list[str]:
+        return sorted(self._lora_slots)
+
+    @property
+    def lora_adapters(self) -> dict[str, str]:
+        """name → source path of loaded adapters (the single registry — the
+        server and /v1/models read this view)."""
+        return dict(self._lora_paths)
 
     def has_request(self, request_id: str) -> bool:
         return request_id in self._states
@@ -234,15 +299,27 @@ class LLMEngine:
     # -- convenience (offline / bench) ------------------------------------
 
     def generate(
-        self, prompts: list[str] | list[list[int]], sampling: SamplingParams
+        self,
+        prompts: list[str] | list[list[int]],
+        sampling: SamplingParams,
+        lora_name: str | None = None,
     ) -> list[dict]:
         """Blocking batch generation; returns [{request_id, token_ids, text}]."""
         ids = []
         for p in prompts:
             if isinstance(p, str):
-                ids.append(self.add_request(prompt=p, sampling=sampling))
+                ids.append(
+                    self.add_request(
+                        prompt=p, sampling=sampling, lora_name=lora_name
+                    )
+                )
             else:
-                ids.append(self.add_request(prompt_token_ids=p, sampling=sampling))
+                ids.append(
+                    self.add_request(
+                        prompt_token_ids=p, sampling=sampling,
+                        lora_name=lora_name,
+                    )
+                )
         done: dict[str, dict] = {
             i: {"request_id": i, "token_ids": [], "text": ""} for i in ids
         }

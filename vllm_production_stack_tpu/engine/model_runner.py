@@ -28,7 +28,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import llama
 from ..parallel import mesh as mesh_lib
-from ..parallel.sharding import kv_cache_spec, llama_param_specs
+from ..parallel.sharding import (
+    kv_cache_spec,
+    llama_param_specs,
+    lora_param_specs,
+)
 from .config import EngineConfig
 from .sampling import sample
 from .scheduler import DecodeWork, PrefillWork, ScheduleOutput
@@ -76,6 +80,19 @@ class ModelRunner:
             ),
             out_shardings=kv_sharding,
         )()
+        self._use_lora = config.lora.max_loras > 0
+        if self._use_lora:
+            lora_sh = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s),
+                lora_param_specs(cfg, config.lora),
+            )
+            self.lora_params = jax.jit(
+                lambda: llama.init_lora_params(cfg, config.lora),
+                out_shardings=lora_sh,
+            )()
+            self._lora_shardings = lora_sh
+        else:
+            self.lora_params = None
         self._rng = jax.random.PRNGKey(config.seed ^ 0x5EED)
         self._rep = NamedSharding(self.mesh, P())
         # dp shards every batch-dim input across the dp mesh axis: each dp
@@ -104,6 +121,7 @@ class ModelRunner:
         self._step_fn = self._build_step_fn()
         self._decode_window_fn = self._build_decode_window_fn()
         self._sleeping_params_host: Any | None = None
+        self._sleeping_lora_host: Any | None = None
 
     def _resolve_attention_backend(self) -> str:
         """'auto' → XLA staged attention. Measured on a v5e chip (llama-1b
@@ -137,12 +155,14 @@ class ModelRunner:
         @functools.partial(jax.jit, donate_argnames=("kv_caches",))
         def step_fn(
             params,
+            lora_params,  # stacked adapter tree, or None when LoRA disabled
             kv_caches,
             token_ids,  # (B, T)
             positions,  # (B, T)
             block_tables,  # (B, max_blocks)
             slot_mapping,  # (B*T,)
             context_lens,  # (B,)
+            lora_idx,  # (B,) adapter slot per row (None when disabled)
             sample_rows,  # (num_samples,) row index into (B*T) flat hidden
             temperature,  # (num_samples,)
             top_p,  # (num_samples,)
@@ -155,6 +175,7 @@ class ModelRunner:
             hidden, kv_caches = llama.forward(
                 cfg, params, token_ids, positions, kv_caches,
                 block_tables, slot_mapping, context_lens,
+                lora=lora_params, lora_idx=lora_idx,
             )
             flat = hidden.reshape(-1, hidden.shape[-1])
             picked = flat[sample_rows]  # (num_samples, h)
@@ -190,10 +211,12 @@ class ModelRunner:
         )
         def decode_window_fn(
             params,
+            lora_params,  # stacked adapter tree, or None when LoRA disabled
             kv_caches,
             first_tokens,  # (B,) input token per request
             positions0,  # (B,) first decode position per request
             block_tables,  # (B, max_blocks) covering the whole window
+            lora_idx,  # (B,) adapter slot per row (None when disabled)
             temperature,  # (B,)
             top_p,  # (B,)
             top_k,  # (B,)
@@ -215,6 +238,7 @@ class ModelRunner:
                     cfg, params, cur, positions0 + k, kv_caches,
                     block_tables, staged, k, positions0,
                     backend=self._attention_backend,
+                    lora=lora_params, lora_idx=lora_idx,
                 )
                 logits = llama.compute_logits(cfg, params, hidden)
                 toks = sample(
@@ -282,9 +306,13 @@ class ModelRunner:
         block_tables = self._block_table_array(
             [r.block_table for r in work.requests], pad_to=b_pad
         )
+        lora_idx = np.zeros(b_pad, np.int32)
+        for i, req in enumerate(work.requests):
+            lora_idx[i] = req.lora_index
         tokens = self._run(
             token_ids, positions, block_tables, slots.reshape(-1), context_lens,
-            sample_rows, temps, top_ps, top_ks, seeds=seeds, counts=counts,
+            lora_idx, sample_rows, temps, top_ps, top_ks, seeds=seeds,
+            counts=counts,
         )
         return [
             [int(tokens[i])] if work.sample[i] else [] for i in range(b)
@@ -313,12 +341,17 @@ class ModelRunner:
         self._rng, step_key = jax.random.split(self._rng)
         has_seed = np.asarray([s is not None for s in seeds], bool)
         seed_vals = np.asarray([(s or 0) & 0xFFFFFFFF for s in seeds], np.uint32)
+        lora_idx = np.zeros(b_pad, np.int32)
+        for i, req in enumerate(work.requests):
+            lora_idx[i] = req.lora_index
         self.kv_caches, tokens = self._decode_window_fn(
             self.params,
+            self.lora_params,
             self.kv_caches,
             self._put(first_tokens, self._batch1),
             self._put(positions0, self._batch1),
             self._put(block_tables, self._batch2),
+            self._put(lora_idx, self._batch1) if self._use_lora else None,
             self._put(np.asarray(temps, np.float32), self._batch1),
             self._put(np.asarray(top_ps, np.float32), self._batch1),
             self._put(np.asarray(top_ks, np.int32), self._batch1),
@@ -335,7 +368,7 @@ class ModelRunner:
 
     def _run(
         self, token_ids, positions, block_tables, slots, context_lens,
-        sample_rows, temps, top_ps, top_ks, seeds, counts,
+        lora_idx, sample_rows, temps, top_ps, top_ks, seeds, counts,
     ):
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
@@ -347,12 +380,14 @@ class ModelRunner:
         )
         self.kv_caches, tokens = self._step_fn(
             self.params,
+            self.lora_params,
             self.kv_caches,
             self._put(token_ids, self._batch2),
             self._put(positions, self._batch2),
             self._put(block_tables, self._batch2),
             self._put(slots, self._batch1),  # (B*T,) — B divisible by dp
             self._put(context_lens, self._batch1),
+            self._put(lora_idx, self._batch1) if self._use_lora else None,
             self._put(sample_rows, self._batch1),
             self._put(np.asarray(temps, np.float32), self._batch1),
             self._put(np.asarray(top_ps, np.float32), self._batch1),
@@ -400,6 +435,34 @@ class ModelRunner:
             arr[i, : len(tbl)] = tbl
         return arr
 
+    # -- LoRA slots --------------------------------------------------------
+
+    def install_lora(self, slot: int, adapter) -> None:
+        """Write a parsed adapter (models/lora_loader.LoRAAdapter) into slot
+        buffers on device. Same shapes every time — no recompile."""
+        assert self._use_lora and 1 <= slot < self.config.lora.num_slots
+        lp = self.lora_params
+        for name, mod in lp.items():
+            if name == "scale":
+                continue
+            if name in adapter.modules:
+                a = jnp.asarray(adapter.modules[name]["A"], mod["A"].dtype)
+                b = jnp.asarray(adapter.modules[name]["B"], mod["B"].dtype)
+            else:  # module not targeted by this adapter: zero its delta
+                a = jnp.zeros_like(mod["A"][slot])
+                b = jnp.zeros_like(mod["B"][slot])
+            mod["A"] = mod["A"].at[slot].set(a)
+            mod["B"] = mod["B"].at[slot].set(b)
+        lp["scale"] = lp["scale"].at[slot].set(adapter.scale)
+        self.lora_params = jax.device_put(lp, self._lora_shardings)
+
+    def remove_lora(self, slot: int) -> None:
+        """Free a slot: zeroing its scale makes every delta exactly 0."""
+        assert self._use_lora and 1 <= slot < self.config.lora.num_slots
+        self.lora_params["scale"] = (
+            self.lora_params["scale"].at[slot].set(0.0)
+        )
+
     # -- sleep / wake (reference: router /sleep proxying, request.py:434-510;
     #    vLLM sleep levels; SURVEY §7.3 hard part 3) ------------------------
 
@@ -424,6 +487,11 @@ class ModelRunner:
         else:
             self._sleeping_params_host = jax.device_get(self.params)
         self.params = None
+        # LoRA buffers are HBM-resident too (num_slots × L × 7 modules);
+        # sleep's whole point is reclaiming HBM, so park them alongside
+        if self.lora_params is not None:
+            self._sleeping_lora_host = jax.device_get(self.lora_params)
+            self.lora_params = None
         # drop the KV pool too; sleeping engines are drained by the router
         self.kv_caches = None
 
@@ -443,6 +511,11 @@ class ModelRunner:
             self.params = jax.tree.map(
                 jax.device_put, self._sleeping_params_host, param_shardings
             )
+        if self._sleeping_lora_host is not None:
+            self.lora_params = jax.device_put(
+                self._sleeping_lora_host, self._lora_shardings
+            )
+            self._sleeping_lora_host = None
         self.kv_caches = jax.jit(
             lambda: llama.init_kv_cache(
                 cfg.model, cfg.cache.num_blocks, cfg.cache.block_size

@@ -55,6 +55,9 @@ class Request:
     eos_token_id: int | None = None
     arrival_time: float = field(default_factory=time.monotonic)
 
+    # adapter slot in the runner's stacked LoRA buffers (0 = base model)
+    lora_index: int = 0
+
     status: RequestStatus = RequestStatus.WAITING
     output_token_ids: list[int] = field(default_factory=list)
     # blocks owned by this request, logical order (block_table[i] = page of

@@ -25,7 +25,13 @@ from .. import __version__
 from ..models.registry import resolve_model_config
 from ..utils.logging import init_logger
 from .async_engine import AsyncEngine, EngineSleepingError
-from .config import CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig
+from .config import (
+    CacheConfig,
+    EngineConfig,
+    LoRAConfig,
+    ParallelConfig,
+    SchedulerConfig,
+)
 from .engine import LLMEngine
 from .metrics import EngineMetrics
 from .protocol import (
@@ -56,9 +62,13 @@ class EngineServer:
         self.async_engine = AsyncEngine(engine)
         self.model_name = served_model_name or engine.config.model.model
         self.metrics = EngineMetrics(self.model_name)
-        # adapter name -> source path; surfaced in /v1/models like vLLM does
-        self.lora_adapters: dict[str, str] = {}
         self._start_time = time.time()
+
+    @property
+    def lora_adapters(self) -> dict[str, str]:
+        """Loaded adapters (name → path). The ENGINE is the single registry —
+        a server-side mirror desyncs the moment a load/unload half-fails."""
+        return self.engine.lora_adapters
 
     # -- app wiring --------------------------------------------------------
 
@@ -97,13 +107,9 @@ class EngineServer:
             return error(400, f"invalid request: {e}")
         if body.n != 1:
             return error(400, "n>1 is not supported")
-        if body.model in self.lora_adapters:
-            return error(
-                501,
-                f"adapter '{body.model}' is registered but adapter inference "
-                "is not implemented yet",
-                "not_implemented",
-            )
+        if err := self._check_model(body.model):
+            return err
+        lora_name = body.model if body.model in self.lora_adapters else None
         prompt = self.async_engine.chat_prompt(
             [m.model_dump() for m in body.messages]
         )
@@ -111,9 +117,12 @@ class EngineServer:
         rid = request.headers.get("X-Request-Id") or random_id("chatcmpl")
         if body.stream:
             return await self._stream(
-                request, rid, prompt, sampling, body, chat=True
+                request, rid, prompt, sampling, body, chat=True,
+                lora_name=lora_name,
             )
-        return await self._complete(rid, prompt, sampling, chat=True)
+        return await self._complete(
+            rid, prompt, sampling, chat=True, lora_name=lora_name
+        )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -122,13 +131,9 @@ class EngineServer:
             return error(400, f"invalid request: {e}")
         if body.n != 1:
             return error(400, "n>1 is not supported")
-        if body.model in self.lora_adapters:
-            return error(
-                501,
-                f"adapter '{body.model}' is registered but adapter inference "
-                "is not implemented yet",
-                "not_implemented",
-            )
+        if err := self._check_model(body.model):
+            return err
+        lora_name = body.model if body.model in self.lora_adapters else None
         prompt, prompt_ids = self._resolve_prompt(body.prompt)
         if prompt is None and prompt_ids is None:
             return error(400, "batched prompts are not supported yet")
@@ -137,11 +142,22 @@ class EngineServer:
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=False,
-                prompt_ids=prompt_ids,
+                prompt_ids=prompt_ids, lora_name=lora_name,
             )
         return await self._complete(
-            rid, prompt, sampling, chat=False, prompt_ids=prompt_ids
+            rid, prompt, sampling, chat=False, prompt_ids=prompt_ids,
+            lora_name=lora_name,
         )
+
+    def _check_model(self, model: str):
+        """vLLM-compatible 404 for unknown model/adapter names — the
+        router's model-filtered dispatch and the LoRA controller's
+        reconciliation both rely on names being authoritative."""
+        if model != self.model_name and model not in self.lora_adapters:
+            return error(
+                404, f"model '{model}' not found", "not_found_error"
+            )
+        return None
 
     @staticmethod
     def _resolve_prompt(prompt) -> tuple[str | None, list[int] | None]:
@@ -158,7 +174,8 @@ class EngineServer:
         return None, None
 
     async def _complete(
-        self, rid, prompt, sampling, *, chat: bool, prompt_ids=None
+        self, rid, prompt, sampling, *, chat: bool, prompt_ids=None,
+        lora_name=None,
     ) -> web.Response:
         text = ""
         token_ids: list[int] = []
@@ -167,7 +184,7 @@ class EngineServer:
         try:
             async for out in self.async_engine.generate(
                 prompt=prompt, prompt_token_ids=prompt_ids,
-                sampling=sampling, request_id=rid,
+                sampling=sampling, request_id=rid, lora_name=lora_name,
             ):
                 text += out.text_delta
                 token_ids.extend(out.new_token_ids)
@@ -204,7 +221,8 @@ class EngineServer:
         )
 
     async def _stream(
-        self, request, rid, prompt, sampling, body, *, chat: bool, prompt_ids=None
+        self, request, rid, prompt, sampling, body, *, chat: bool,
+        prompt_ids=None, lora_name=None,
     ) -> web.StreamResponse:
         if self.async_engine.is_sleeping:
             return error(503, "engine is sleeping", "service_unavailable")
@@ -230,7 +248,7 @@ class EngineServer:
         try:
             async for out in self.async_engine.generate(
                 prompt=prompt, prompt_token_ids=prompt_ids,
-                sampling=sampling, request_id=rid,
+                sampling=sampling, request_id=rid, lora_name=lora_name,
             ):
                 n_prompt = out.num_prompt_tokens
                 n_out = out.num_output_tokens
@@ -330,16 +348,24 @@ class EngineServer:
         path = body.get("lora_path")
         if not name or not path:
             return error(400, "lora_name and lora_path are required")
-        self.lora_adapters[name] = path
-        logger.info("registered LoRA adapter %s from %s", name, path)
+        try:
+            await self.async_engine.load_lora(name, path)
+        except (ValueError, KeyError, FileNotFoundError) as e:
+            return error(400, str(e))
+        except RuntimeError as e:
+            return error(409, str(e), "conflict")
+        logger.info("loaded LoRA adapter %s from %s", name, path)
         return web.json_response({"status": "ok"})
 
     async def unload_lora_adapter(self, request: web.Request) -> web.Response:
         body = await request.json()
         name = body.get("lora_name")
-        if name not in self.lora_adapters:
+        try:
+            await self.async_engine.unload_lora(name)
+        except KeyError:
             return error(404, f"adapter {name} not loaded", "not_found_error")
-        del self.lora_adapters[name]
+        except RuntimeError as e:  # in-flight requests still use the adapter
+            return error(409, str(e), "conflict")
         return web.json_response({"status": "ok"})
 
     async def tokenize(self, request: web.Request) -> web.Response:
@@ -378,6 +404,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-enable-prefix-caching", dest="enable_prefix_caching",
                    action="store_false")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-loras", type=int, default=0,
+                   help="runtime LoRA adapter slots (0 disables LoRA)")
+    p.add_argument("--max-lora-rank", type=int, default=8)
     return p
 
 
@@ -408,6 +437,9 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             prefill_buckets=prefill_buckets,
         ),
         parallel=ParallelConfig(tensor_parallel_size=args.tensor_parallel_size),
+        lora=LoRAConfig(
+            max_loras=args.max_loras, max_lora_rank=args.max_lora_rank
+        ),
         seed=args.seed,
     )
 

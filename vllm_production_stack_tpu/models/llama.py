@@ -98,40 +98,123 @@ def init_kv_cache(
     )
 
 
+def lora_module_dims(cfg: ModelConfig) -> dict[str, tuple[int, int]]:
+    """(in, out) dims per PEFT target-module name."""
+    h, hd = cfg.hidden_size, cfg.head_dim
+    nh, nkv, it = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+    return {
+        "q_proj": (h, nh * hd),
+        "k_proj": (h, nkv * hd),
+        "v_proj": (h, nkv * hd),
+        "o_proj": (nh * hd, h),
+        "gate_proj": (h, it),
+        "up_proj": (h, it),
+        "down_proj": (it, h),
+    }
+
+
+def init_lora_params(cfg: ModelConfig, lora_cfg) -> dict:
+    """Stacked adapter buffers: per target module A (n_slots, L, in, r) and
+    B (n_slots, L, r, out), plus per-slot "scale" (n_slots,) = alpha/r. Slot
+    0 is the reserved all-zeros base adapter, so a batch row with no adapter
+    computes delta 0 through the exact same program — adapter selection is a
+    per-row gather, never a recompile (SURVEY §7.3 hard part 3)."""
+    n, r, L = lora_cfg.num_slots, lora_cfg.max_lora_rank, cfg.num_layers
+    dt = _dtype(cfg)
+    dims = lora_module_dims(cfg)
+    unknown = [m for m in lora_cfg.target_modules if m not in dims]
+    if unknown:
+        raise ValueError(
+            f"unknown LoRA target modules {unknown}; supported: "
+            f"{sorted(dims)}"
+        )
+    tree: dict = {
+        name: {
+            "A": jnp.zeros((n, L, din, r), dt),
+            "B": jnp.zeros((n, L, r, dout), dt),
+        }
+        for name, (din, dout) in dims.items()
+        if name in lora_cfg.target_modules
+    }
+    tree["scale"] = jnp.zeros((n,), jnp.float32)
+    return tree
+
+
+def _lora_delta(
+    x: jax.Array,  # (B, T, in) — the projection's input
+    mod: dict,  # {"A": (n, in, r), "B": (n, r, out)} — this layer's slice
+    idx: jax.Array,  # (B,) adapter slot per row
+    scale: jax.Array,  # (B,) alpha/r per row (0 for base rows)
+) -> jax.Array:
+    a = mod["A"][idx]  # (B, in, r)
+    b = mod["B"][idx]  # (B, r, out)
+    u = jnp.einsum("bti,bir->btr", x, a)
+    return jnp.einsum("btr,bro->bto", u, b) * scale[:, None, None].astype(x.dtype)
+
+
 def _layer_body(
     cfg: ModelConfig,
     lp: dict,
     x: jax.Array,  # (B, T, h)
     positions: jax.Array,  # (B, T)
     attend,  # (q (B,T,nh,D), k (B,T,kvH,D), v (B,T,kvH,D)) -> (B,T,nh,D)
+    lora: dict | None = None,  # layer-sliced init_lora_params tree
+    lora_idx: jax.Array | None = None,  # (B,) slot per row
 ) -> jax.Array:
     """The Llama layer math shared by every execution mode — prefill and the
     fused decode window differ ONLY in how attention consumes/stores KV, so
     that strategy is injected as `attend` and everything else (projections,
-    bias, RoPE, residuals, MLP) exists exactly once."""
+    bias, RoPE, residuals, MLP, LoRA deltas) exists exactly once."""
     b, t, h = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+
+    if lora is not None:
+        lscale = lora["scale"][lora_idx]
+
+        def proj(xin, w, name):
+            out = xin @ w
+            if name in lora:
+                out += _lora_delta(xin, lora[name], lora_idx, lscale)
+            return out
+    else:
+
+        def proj(xin, w, name):
+            return xin @ w
 
     res = x
     x = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
     ap = lp["attn"]
-    q = x @ ap["wq"]
-    k = x @ ap["wk"]
-    v = x @ ap["wv"]
+    q = proj(x, ap["wq"], "q_proj")
+    k = proj(x, ap["wk"], "k_proj")
+    v = proj(x, ap["wv"], "v_proj")
     if cfg.attention_bias:
         q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
     q = apply_rope(q.reshape(b, t, nh, hd), positions, cfg.rope_theta)
     k = apply_rope(k.reshape(b, t, nkv, hd), positions, cfg.rope_theta)
     v = v.reshape(b, t, nkv, hd)
 
-    attn = attend(q, k, v)
-    x = res + attn.reshape(b, t, nh * hd) @ ap["wo"]
+    attn = attend(q, k, v).reshape(b, t, nh * hd)
+    x = res + proj(attn, ap["wo"], "o_proj")
 
     res = x
     x = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
     mp = lp["mlp"]
-    x = (jax.nn.silu(x @ mp["gate"]) * (x @ mp["up"])) @ mp["down"]
-    return res + x
+    inner = jax.nn.silu(proj(x, mp["gate"], "gate_proj")) * proj(
+        x, mp["up"], "up_proj"
+    )
+    return res + proj(inner, mp["down"], "down_proj")
+
+
+def _lora_layer_slice(lora: dict | None, i: int) -> dict | None:
+    """Layer i's slice of the stacked adapter tree (scale is per-slot,
+    layer-invariant)."""
+    if lora is None:
+        return None
+    out: dict = {"scale": lora["scale"]}
+    for name, mod in lora.items():
+        if name != "scale":
+            out[name] = {"A": mod["A"][:, i], "B": mod["B"][:, i]}
+    return out
 
 
 def _layer(
@@ -143,6 +226,8 @@ def _layer(
     block_tables: jax.Array,
     slot_mapping: jax.Array,
     mask: jax.Array,
+    lora: dict | None = None,
+    lora_idx: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     b, t = x.shape[0], x.shape[1]
     hd, nkv = cfg.head_dim, cfg.num_kv_heads
@@ -157,7 +242,7 @@ def _layer(
             q, kv_layer, block_tables, mask, scale=hd**-0.5
         )
 
-    x = _layer_body(cfg, lp, x, positions, attend)
+    x = _layer_body(cfg, lp, x, positions, attend, lora, lora_idx)
     return x, kv_layer
 
 
@@ -170,6 +255,8 @@ def forward(
     block_tables: jax.Array,  # (B, max_blocks) int32
     slot_mapping: jax.Array,  # (B*T,) flat slots (padding -> block 0 slots)
     context_lens: jax.Array,  # (B,) tokens resident after this step
+    lora: dict | None = None,  # stacked adapter tree (init_lora_params)
+    lora_idx: jax.Array | None = None,  # (B,) adapter slot per row
 ) -> tuple[jax.Array, jax.Array]:
     """One model step over a token batch. Prefill is (B=1, T=chunk); decode is
     (B=batch, T=1). Returns (hidden (B,T,h), updated kv_caches)."""
@@ -186,7 +273,8 @@ def forward(
     for i in range(cfg.num_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
         x, layer_kv = _layer(
-            cfg, lp, kv_caches[i], x, positions, block_tables, slot_mapping, mask
+            cfg, lp, kv_caches[i], x, positions, block_tables, slot_mapping,
+            mask, _lora_layer_slice(lora, i), lora_idx,
         )
         new_kv.append(layer_kv)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
@@ -216,6 +304,8 @@ def decode_window_step(
     step_k: jax.Array,  # scalar int32: iteration index within the window
     hist_len: jax.Array,  # (B,): pool positions < hist_len are history
     backend: str = "xla",  # "xla" | "pallas" (TPU kernel) | "pallas_interpret"
+    lora: dict | None = None,  # stacked adapter tree (init_lora_params)
+    lora_idx: jax.Array | None = None,  # (B,) adapter slot per row
 ) -> tuple[jax.Array, jax.Array]:
     """One decode iteration inside a fused window: reads the pool, writes this
     token's K/V into `staged` (not the pool — the pool stays loop-invariant so
@@ -251,7 +341,10 @@ def decode_window_step(
                 interpret=backend == "pallas_interpret",
             )[:, None]
 
-        x = _layer_body(cfg, lp, x, positions[:, None], attend)
+        x = _layer_body(
+            cfg, lp, x, positions[:, None], attend,
+            _lora_layer_slice(lora, i), lora_idx,
+        )
     x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps)
     return x, staged
 

@@ -1,0 +1,109 @@
+"""PEFT-format LoRA adapter loading.
+
+The reference's LoRA controller downloads adapters (HF/S3/local) to a shared
+PVC and hot-loads them into engines via /v1/load_lora_adapter
+(loraadapter_controller.go:334-391, 582-611). This module parses the on-disk
+artifact it ships: a PEFT adapter dir with `adapter_config.json` (r,
+lora_alpha, target_modules) and `adapter_model.safetensors` with keys like
+
+    base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight  (r, in)
+    base_model.model.model.layers.{i}.self_attn.q_proj.lora_B.weight  (out, r)
+
+mapped into the engine's stacked slot buffers (models/llama.py
+init_lora_params): A → (L, in, max_rank), B → (L, max_rank, out), transposed
+to (in, out) orientation and zero-padded from the adapter's rank r to
+max_lora_rank so every slot shares one shape (no recompile on load).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..engine.config import LoRAConfig, ModelConfig
+from .llama import lora_module_dims
+
+_MODULE_PARENTS = {
+    "q_proj": "self_attn",
+    "k_proj": "self_attn",
+    "v_proj": "self_attn",
+    "o_proj": "self_attn",
+    "gate_proj": "mlp",
+    "up_proj": "mlp",
+    "down_proj": "mlp",
+}
+
+
+class LoRAAdapter:
+    """Parsed adapter: per-module stacked (L, in, max_rank)/(L, max_rank, out)
+    numpy arrays + the PEFT scaling alpha/r."""
+
+    def __init__(self, modules: dict[str, dict[str, np.ndarray]], scale: float,
+                 rank: int):
+        self.modules = modules
+        self.scale = scale
+        self.rank = rank
+
+
+def load_lora_adapter(
+    path: str, model_cfg: ModelConfig, lora_cfg: LoRAConfig
+) -> LoRAAdapter:
+    from safetensors import safe_open
+
+    with open(os.path.join(path, "adapter_config.json")) as f:
+        acfg = json.load(f)
+    rank = int(acfg["r"])
+    alpha = float(acfg.get("lora_alpha", rank))
+    targets = acfg.get("target_modules") or []
+    if rank > lora_cfg.max_lora_rank:
+        raise ValueError(
+            f"adapter rank {rank} exceeds max_lora_rank="
+            f"{lora_cfg.max_lora_rank}; raise it in LoRAConfig"
+        )
+    unsupported = [t for t in targets if t not in _MODULE_PARENTS]
+    if unsupported:
+        raise ValueError(f"unsupported LoRA target modules {unsupported}")
+    untargetable = [t for t in targets if t not in lora_cfg.target_modules]
+    if untargetable:
+        raise ValueError(
+            f"adapter targets {untargetable} but the engine only reserves "
+            f"buffers for {lora_cfg.target_modules}"
+        )
+
+    sft = os.path.join(path, "adapter_model.safetensors")
+    dims = lora_module_dims(model_cfg)
+    dt = np.dtype("float32") if model_cfg.dtype == "float32" else None
+    with safe_open(sft, framework="np") as f:
+        keys = set(f.keys())
+
+        def get(name: str) -> np.ndarray:
+            # PEFT key prefixes vary slightly across versions
+            for prefix in (
+                "base_model.model.model.layers.",
+                "base_model.model.layers.",
+            ):
+                k = prefix + name
+                if k in keys:
+                    return f.get_tensor(k)
+            raise KeyError(f"missing LoRA tensor ...{name}")
+
+        modules: dict[str, dict[str, np.ndarray]] = {}
+        L, r_max = model_cfg.num_layers, lora_cfg.max_lora_rank
+        for mod in targets:
+            din, dout = dims[mod]
+            a = np.zeros((L, din, r_max), np.float32)
+            b = np.zeros((L, r_max, dout), np.float32)
+            parent = _MODULE_PARENTS[mod]
+            for i in range(L):
+                # PEFT lora_A (r, in) -> ours (in, r); lora_B (out, r) -> (r, out)
+                a[i, :, :rank] = get(f"{i}.{parent}.{mod}.lora_A.weight").T
+                b[i, :rank, :] = get(f"{i}.{parent}.{mod}.lora_B.weight").T
+            modules[mod] = {"A": a, "B": b}
+    if dt is not None:
+        modules = {
+            m: {k: v.astype(dt) for k, v in mm.items()}
+            for m, mm in modules.items()
+        }
+    return LoRAAdapter(modules, scale=alpha / rank, rank=rank)

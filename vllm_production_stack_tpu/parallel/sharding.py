@@ -55,6 +55,31 @@ def llama_param_specs(cfg: ModelConfig) -> dict:
     return specs
 
 
+def lora_param_specs(cfg: ModelConfig, lora_cfg) -> dict:
+    """Spec tree matching init_lora_params. Column-parallel modules shard
+    B's out axis (like their base weight); row-parallel modules shard A's in
+    axis; the rank axis stays replicated (it's ≤ max_lora_rank)."""
+    from ..models.llama import lora_module_dims
+
+    row_parallel = {"o_proj", "down_proj"}
+    # same module filter as init_lora_params, so the spec tree and the param
+    # tree can never diverge structurally
+    names = [m for m in lora_cfg.target_modules if m in lora_module_dims(cfg)]
+    specs: dict = {"scale": P()}
+    for name in names:
+        if name in row_parallel:
+            specs[name] = {
+                "A": P(None, None, TP_AXIS, None),  # (n, L, in, r)
+                "B": P(None, None, None, None),  # (n, L, r, out)
+            }
+        else:
+            specs[name] = {
+                "A": P(None, None, None, None),
+                "B": P(None, None, None, TP_AXIS),
+            }
+    return specs
+
+
 def kv_cache_spec() -> P:
     """Per-layer leaf [2, num_blocks, block_size, kv_heads, head_dim] — shard
     kv heads. Applies to every leaf of the per-layer KV tuple (jit/`device_put`
