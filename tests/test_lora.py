@@ -151,6 +151,7 @@ def test_unload_restores_base(tmp_path):
         engine.unload_lora("a1")
     # the freed slot now behaves as base even if a stale request pointed at it
     engine._lora_slots["ghost"] = 1
+    engine._lora_salts["ghost"] = 99
     ghost = engine.generate([prompt], sampling, lora_name="ghost")
     base_out = engine.generate([prompt], sampling)
     assert ghost[0]["token_ids"] == base_out[0]["token_ids"]

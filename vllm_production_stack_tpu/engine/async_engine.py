@@ -211,6 +211,21 @@ class AsyncEngine:
         with self._lock:
             self.engine.wake()
 
+    async def kv_lookup(self, text=None, token_ids=None) -> int:
+        def work():
+            # tokenize OUTSIDE the lock: the controller fans lookups to every
+            # engine per routed request, and encode() needs no engine state —
+            # holding the lock for it would serialize probes against decode
+            ids = (
+                token_ids
+                if token_ids is not None
+                else self.engine.tokenizer.encode(text or "")
+            )
+            with self._lock:
+                return self.engine.kv_lookup(token_ids=ids)
+
+        return await asyncio.get_running_loop().run_in_executor(None, work)
+
     async def load_lora(self, name: str, path: str) -> None:
         def work():
             with self._lock:

@@ -36,6 +36,9 @@ class EngineStatsSnapshot:
     num_preemptions: int = 0
     generation_tokens: int = 0
     prompt_tokens: int = 0
+    host_kv_usage_perc: float = 0.0
+    host_kv_offloads: int = 0
+    host_kv_reloads: int = 0
 
 
 @dataclass
@@ -74,11 +77,26 @@ class LLMEngine:
         self.tokenizer = tokenizer or TokenizerWrapper(
             config.model.tokenizer or config.model.checkpoint
         )
-        self.scheduler = Scheduler(config.model, config.cache, config.scheduler)
         self.runner = ModelRunner(config, params=params, mesh=mesh)
+        self.host_tier = None
+        if config.cache.num_host_blocks > 0:
+            from .kv_host_tier import HostKVTier
+
+            self.host_tier = HostKVTier(
+                config.cache.num_host_blocks,
+                self.runner.fetch_block,
+                self.runner.upload_block,
+            )
+        self.scheduler = Scheduler(
+            config.model, config.cache, config.scheduler,
+            host_tier=self.host_tier,
+        )
         self._states: dict[str, _RequestState] = {}
         self._lora_slots: dict[str, int] = {}  # adapter name -> slot index
         self._lora_paths: dict[str, str] = {}  # adapter name -> source path
+        # per-LOAD unique KV-chain salts (slots get reused; salts never are)
+        self._lora_salts: dict[str, int] = {}
+        self._lora_salt_counter = itertools.count(1)
         self._req_counter = itertools.count()
         self._prompt_tokens = 0
         self._generation_tokens = 0
@@ -108,6 +126,7 @@ class LLMEngine:
             sampling=sampling or SamplingParams(),
             eos_token_id=self.tokenizer.eos_token_id,
             lora_index=self._lora_slots[lora_name] if lora_name else 0,
+            lora_cache_salt=self._lora_salts[lora_name] if lora_name else 0,
         )
         self.scheduler.add_request(req)
         self._states[request_id] = _RequestState(
@@ -148,6 +167,7 @@ class LLMEngine:
         self.runner.install_lora(free[0], adapter)
         self._lora_slots[name] = free[0]
         self._lora_paths[name] = path
+        self._lora_salts[name] = next(self._lora_salt_counter)
 
     def unload_lora(self, name: str) -> None:
         slot = self._lora_slots.get(name)
@@ -167,6 +187,7 @@ class LLMEngine:
             )
         del self._lora_slots[name]
         self._lora_paths.pop(name, None)
+        self._lora_salts.pop(name, None)
         self.runner.remove_lora(slot)
 
     def list_loras(self) -> list[str]:
@@ -177,6 +198,15 @@ class LLMEngine:
         """name → source path of loaded adapters (the single registry — the
         server and /v1/models read this view)."""
         return dict(self._lora_paths)
+
+    def kv_lookup(self, text: str | None = None,
+                  token_ids: list[int] | None = None) -> int:
+        """Longest KV prefix (tokens) resident across HBM + host tiers —
+        the probe behind KV-aware routing (reference: LMCache controller
+        LookupMsg, routing_logic.py:264-344)."""
+        if token_ids is None:
+            token_ids = self.tokenizer.encode(text or "")
+        return self.scheduler.pool.match_length(list(token_ids))
 
     def has_request(self, request_id: str) -> bool:
         return request_id in self._states
@@ -348,6 +378,15 @@ class LLMEngine:
             num_preemptions=self.scheduler.total_preemptions,
             generation_tokens=self._generation_tokens,
             prompt_tokens=self._prompt_tokens,
+            host_kv_usage_perc=(
+                self.host_tier.usage_perc if self.host_tier else 0.0
+            ),
+            host_kv_offloads=(
+                self.host_tier.stats.offloads if self.host_tier else 0
+            ),
+            host_kv_reloads=(
+                self.host_tier.stats.reloads if self.host_tier else 0
+            ),
         )
 
     @property

@@ -47,13 +47,20 @@ class KVBlockPool:
     """Host-side accounting for the device page pool of ONE engine."""
 
     def __init__(
-        self, num_blocks: int, block_size: int, enable_prefix_caching: bool = True
+        self,
+        num_blocks: int,
+        block_size: int,
+        enable_prefix_caching: bool = True,
+        host_tier=None,
     ):
         if num_blocks < 2:
             raise ValueError("need at least 2 blocks (block 0 is the null page)")
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.enable_prefix_caching = enable_prefix_caching
+        # optional HostKVTier: evicted cached blocks offload HBM→host and
+        # prefix matches continue into it (engine/kv_host_tier.py)
+        self.host_tier = host_tier
         # block 0 reserved as the null page
         self._free: deque[int] = deque(range(1, num_blocks))
         self._ref: dict[int, int] = {}
@@ -90,6 +97,11 @@ class KVBlockPool:
             blk, _ = self._evictable.popitem(last=False)
             h = self._block_to_hash.pop(blk)
             self._hash_to_block.pop(h, None)
+            if self.host_tier is not None:
+                # offload BEFORE the block id is handed out for reuse — the
+                # device executes in dispatch order, so the host copy reads
+                # the old pages even though the fetch is asynchronous
+                self.host_tier.store(h, blk)
         else:
             return None
         self._ref[blk] = 1
@@ -110,26 +122,77 @@ class KVBlockPool:
 
     # -- prefix caching ----------------------------------------------------
 
-    def match_prefix(self, token_ids: list[int]) -> list[int]:
-        """Longest run of cached full blocks matching the prompt's head.
-        Acquires a reference on every matched block."""
+    def _chain(self, token_ids: list[int], parent: int):
+        """Yield the chain hash of each FULL block of the prompt, in order —
+        the single definition of block identity shared by match_prefix and
+        match_length (so the /kv/lookup probe can never diverge from what a
+        real match would reuse)."""
+        n_full = len(token_ids) // self.block_size
+        for i in range(n_full):
+            chunk = tuple(
+                token_ids[i * self.block_size : (i + 1) * self.block_size]
+            )
+            parent = chain_hash(parent, chunk)
+            yield parent
+
+    def match_prefix(
+        self, token_ids: list[int], parent: int | None = None
+    ) -> list[int]:
+        """Longest run of cached full blocks matching the prompt's head —
+        HBM-resident blocks first, then continuing into the host tier (each
+        host hit uploads into a freshly allocated HBM block). Acquires a
+        reference on every matched block. `parent` is the chain root — the
+        scheduler salts it per LoRA adapter so base and adapter KV never
+        cross-match (their K/V bytes differ when k/v projections carry
+        deltas)."""
         matched: list[int] = []
         if not self.enable_prefix_caching:
             return matched
-        parent = _ROOT_HASH
-        n_full = len(token_ids) // self.block_size
-        for i in range(n_full):
+        for h in self._chain(token_ids, _ROOT_HASH if parent is None else parent):
             self.stats.queries += 1
-            chunk = tuple(token_ids[i * self.block_size : (i + 1) * self.block_size])
-            h = chain_hash(parent, chunk)
             blk = self._hash_to_block.get(h)
             if blk is None:
-                break
+                blk = self._reload_from_host(h)
+                if blk is None:
+                    break
+            else:
+                self._acquire(blk)
             self.stats.hits += 1
-            self._acquire(blk)
             matched.append(blk)
-            parent = h
         return matched
+
+    def _reload_from_host(self, h: int) -> int | None:
+        """Host-tier continuation of a prefix match: allocate an HBM block and
+        upload hash h's offloaded pages into it."""
+        if self.host_tier is None or h not in self.host_tier:
+            return None
+        blk = self.allocate()  # may itself evict (and offload) another block
+        if blk is None:
+            return None
+        if not self.host_tier.reload_into(h, blk):  # raced an eviction
+            self.free_block(blk)
+            return None
+        self._hash_to_block[h] = blk
+        self._block_to_hash[blk] = h
+        return blk
+
+    def match_length(
+        self, token_ids: list[int], parent: int | None = None
+    ) -> int:
+        """Matched-prefix length in TOKENS across both tiers, without taking
+        references or moving any data — the /kv/lookup probe the KV-aware
+        router depends on (reference: LMCache LookupMsg, routing_logic.py:
+        222-344; gateway kv_aware_picker.go:90-133)."""
+        if not self.enable_prefix_caching:
+            return 0
+        n = 0
+        for h in self._chain(token_ids, _ROOT_HASH if parent is None else parent):
+            if h not in self._hash_to_block and (
+                self.host_tier is None or h not in self.host_tier
+            ):
+                break
+            n += self.block_size
+        return n
 
     def _acquire(self, blk: int) -> None:
         if blk in self._ref:

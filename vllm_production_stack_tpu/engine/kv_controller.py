@@ -1,0 +1,130 @@
+"""KV controller: the cluster-level KV-prefix lookup service.
+
+The reference embeds an LMCacheControllerManager inside the router process
+(routing_logic.py:222-344, which is why its kvaware image builds on the vLLM
+image), while its Go gateway picker assumes a clean REST controller
+(`/lookup` → instance with the longest KV prefix, kv_aware_picker.go:90-133).
+This service is that REST shape: a standalone aiohttp app that fans a lookup
+out to every registered engine's /kv/lookup (HBM + host tiers,
+engine/server.py) and answers with the engine holding the longest match. The
+router's `kvaware` policy (router/routing.py) points at it via
+--kv-controller-url.
+
+Run:
+    python -m vllm_production_stack_tpu.engine.kv_controller \
+        --port 9000 --engines http://e1:8000,http://e2:8000
+Engines can also (de)register dynamically via POST /register /deregister
+(the deployment layer wires this like the reference wires
+LMCACHE_CONTROLLER_URL into engine pods, deployment-vllm-multi.yaml:324-339).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+import aiohttp
+from aiohttp import web
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class KVController:
+    def __init__(self, engine_urls: list[str] | None = None,
+                 timeout_s: float = 2.0):
+        self.engines: set[str] = {u.rstrip("/") for u in engine_urls or []}
+        self._timeout = aiohttp.ClientTimeout(total=timeout_s)
+        self._session: aiohttp.ClientSession | None = None
+
+    def _sess(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(timeout=self._timeout)
+        return self._session
+
+    async def lookup(self, payload: dict) -> dict:
+        """Fan out to every engine; return the longest resident prefix."""
+
+        async def probe(url: str) -> tuple[str, int]:
+            try:
+                async with self._sess().post(
+                    url + "/kv/lookup", json=payload
+                ) as resp:
+                    data = await resp.json()
+                    return url, int(data.get("matched_tokens", 0))
+            except Exception as e:
+                logger.debug("kv lookup to %s failed: %s", url, e)
+                return url, -1
+
+        results = await asyncio.gather(*(probe(u) for u in sorted(self.engines)))
+        reachable = [(u, n) for u, n in results if n >= 0]
+        if not reachable:
+            return {"url": None, "matched_tokens": 0}
+        url, n = max(reachable, key=lambda r: r[1])
+        return {"url": url, "matched_tokens": n}
+
+    # -- HTTP surface ------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/lookup", self._handle_lookup)
+        app.router.add_post("/register", self._handle_register)
+        app.router.add_post("/deregister", self._handle_deregister)
+        app.router.add_get("/engines", self._handle_engines)
+        app.router.add_get("/health", self._handle_health)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_cleanup(self, app: web.Application) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def _handle_lookup(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        if body.get("text") is None and body.get("token_ids") is None:
+            return web.json_response(
+                {"error": "text or token_ids is required"}, status=400
+            )
+        payload = {
+            k: body[k] for k in ("text", "token_ids") if body.get(k) is not None
+        }
+        return web.json_response(await self.lookup(payload))
+
+    async def _handle_register(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        url = (body.get("url") or "").rstrip("/")
+        if not url:
+            return web.json_response({"error": "url is required"}, status=400)
+        self.engines.add(url)
+        return web.json_response({"status": "ok", "engines": sorted(self.engines)})
+
+    async def _handle_deregister(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        self.engines.discard((body.get("url") or "").rstrip("/"))
+        return web.json_response({"status": "ok", "engines": sorted(self.engines)})
+
+    async def _handle_engines(self, request: web.Request) -> web.Response:
+        return web.json_response({"engines": sorted(self.engines)})
+
+    async def _handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "engines": len(self.engines)})
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="TPU stack KV controller")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9000)
+    p.add_argument("--engines", default="",
+                   help="comma-separated engine base URLs")
+    args = p.parse_args(argv)
+    urls = [u for u in args.engines.split(",") if u]
+    controller = KVController(urls)
+    logger.info("KV controller on %s:%d over %d engines",
+                args.host, args.port, len(urls))
+    web.run_app(controller.build_app(), host=args.host, port=args.port,
+                access_log=None)
+
+
+if __name__ == "__main__":
+    main()

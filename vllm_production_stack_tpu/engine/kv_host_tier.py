@@ -1,0 +1,104 @@
+"""Host-RAM KV offload tier (the LMCache CPU-offload equivalent).
+
+Reference: engines get `LMCACHE_LOCAL_CPU=True` + `LMCACHE_MAX_LOCAL_CPU_SIZE`
+(deployment-vllm-multi.yaml:306-313; vllmruntime_controller.go:337-347) so
+evicted GPU KV parks in host RAM instead of being recomputed. TPU analogue:
+when the HBM pool evicts a content-addressed block, its pages are copied
+HBM→host into this LRU ring; a later prompt whose hash chain continues into
+the ring gets the block uploaded back into a fresh HBM page — KV reuse across
+a working set larger than HBM.
+
+The tier stores by content hash (the pool's chain hash), so entries stay
+valid across sleep/wake: bytes are bytes, and a reload re-registers them
+under the same hash.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class HostTierStats:
+    offloads: int = 0  # blocks copied HBM -> host
+    reloads: int = 0  # blocks served host -> HBM
+    evictions: int = 0  # blocks dropped off the ring
+
+
+class HostKVTier:
+    """LRU ring of `num_blocks` full KV blocks in host RAM, keyed by the
+    pool's content hash. fetch/upload callbacks bind to the ModelRunner
+    (device transfers); the pool calls `store` from its eviction hook and
+    `reload_into` from prefix matching."""
+
+    def __init__(self, num_blocks: int, fetch_block, upload_block):
+        self.num_blocks = num_blocks
+        # fetch returns per-layer device slices with host copies STARTED
+        # (ModelRunner.fetch_block); entries resolve to numpy one store
+        # behind, so the device→host transfer overlaps the next step instead
+        # of stalling the scheduler loop
+        self._fetch = fetch_block
+        self._upload = upload_block  # (device_block_id, np.ndarray) -> None
+        self._data: OrderedDict[int, object] = OrderedDict()
+        self._pending: list[int] = []  # hashes whose entry is still on device
+        self.stats = HostTierStats()
+
+    def _resolve(self, h: int) -> np.ndarray | None:
+        entry = self._data.get(h)
+        if entry is None:
+            return None
+        if not isinstance(entry, np.ndarray):
+            entry = np.stack([np.asarray(p) for p in entry])
+            self._data[h] = entry
+        return entry
+
+    def _drain_pending(self, keep_latest: int = 1) -> None:
+        while len(self._pending) > keep_latest:
+            self._resolve(self._pending.pop(0))
+
+    def __contains__(self, h: int) -> bool:
+        return h in self._data
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    @property
+    def usage_perc(self) -> float:
+        return len(self._data) / self.num_blocks if self.num_blocks else 0.0
+
+    def store(self, h: int, device_block: int) -> None:
+        """Offload an evicted device block's pages under hash h. The fetch is
+        dispatched here; the host bytes materialize on the NEXT store (or on
+        reload) — the device buffer must be sliced before the block id is
+        reused, which this call order guarantees."""
+        if self.num_blocks == 0:
+            return
+        if h in self._data:  # already offloaded earlier; refresh recency
+            self._data.move_to_end(h)
+            return
+        self._data[h] = self._fetch(device_block)
+        self._pending.append(h)
+        self._drain_pending(keep_latest=1)
+        self.stats.offloads += 1
+        while len(self._data) > self.num_blocks:
+            evicted, _ = self._data.popitem(last=False)
+            if evicted in self._pending:
+                self._pending.remove(evicted)
+            self.stats.evictions += 1
+
+    def reload_into(self, h: int, device_block: int) -> bool:
+        """Upload hash h's pages into a freshly allocated device block.
+        Returns False if h is not resident. The entry stays in the ring (it
+        may be needed again after the device copy is evicted)."""
+        data = self._resolve(h)
+        if data is None:
+            return False
+        if h in self._pending:
+            self._pending.remove(h)
+        self._data.move_to_end(h)
+        self._upload(device_block, data)
+        self.stats.reloads += 1
+        return True

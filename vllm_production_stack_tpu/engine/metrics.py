@@ -45,6 +45,15 @@ class EngineMetrics:
             mc.PREFIX_CACHE_QUERIES, "Prefix cache block queries"
         )
         self.preemptions = counter(mc.NUM_PREEMPTIONS, "Scheduler preemptions")
+        self.host_kv_usage = gauge(
+            mc.HOST_KV_USAGE_PERC, "Fraction of host-RAM KV tier in use"
+        )
+        self.host_offloads = counter(
+            mc.HOST_KV_OFFLOADS, "KV blocks offloaded HBM to host RAM"
+        )
+        self.host_reloads = counter(
+            mc.HOST_KV_RELOADS, "KV blocks reloaded host RAM to HBM"
+        )
         self.prompt_tokens = counter(mc.PROMPT_TOKENS, "Prompt tokens processed")
         self.generation_tokens = counter(mc.GENERATION_TOKENS, "Tokens generated")
         self._counter_values: dict[str, int] = {}
@@ -58,6 +67,9 @@ class EngineMetrics:
         self._bump(self.prefix_hits, "hits", s.prefix_cache_hits)
         self._bump(self.prefix_queries, "queries", s.prefix_cache_queries)
         self._bump(self.preemptions, "preempt", s.num_preemptions)
+        self.host_kv_usage.labels(**lb).set(s.host_kv_usage_perc)
+        self._bump(self.host_offloads, "host_off", s.host_kv_offloads)
+        self._bump(self.host_reloads, "host_re", s.host_kv_reloads)
         self._bump(self.prompt_tokens, "prompt", s.prompt_tokens)
         self._bump(self.generation_tokens, "gen", s.generation_tokens)
 

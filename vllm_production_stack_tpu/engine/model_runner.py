@@ -122,6 +122,8 @@ class ModelRunner:
         self._decode_window_fn = self._build_decode_window_fn()
         self._sleeping_params_host: Any | None = None
         self._sleeping_lora_host: Any | None = None
+        self._upload_block_fn = None
+        self._fetch_block_fn = None
 
     def _resolve_attention_backend(self) -> str:
         """'auto' → XLA staged attention. Measured on a v5e chip (llama-1b
@@ -434,6 +436,40 @@ class ModelRunner:
         for i, tbl in enumerate(tables):
             arr[i, : len(tbl)] = tbl
         return arr
+
+    # -- host KV tier transfers (engine/kv_host_tier.py) -------------------
+
+    def fetch_block(self, blk: int) -> list[jax.Array]:
+        """HBM→host, non-blocking: slice one block's pages per layer and
+        start their host copies. The caller (HostKVTier) resolves the
+        transfer to numpy later — offloads happen inside the scheduler loop
+        with the engine lock held, so blocking here would stall a device
+        round-trip per evicted block (the transfer instead overlaps the next
+        step's compute)."""
+        if self._fetch_block_fn is None:
+            self._fetch_block_fn = jax.jit(
+                lambda kv, blk: tuple(leaf[:, blk] for leaf in kv)
+            )
+        parts = self._fetch_block_fn(self.kv_caches, jnp.int32(blk))
+        for p in parts:
+            p.copy_to_host_async()
+        return list(parts)
+
+    def upload_block(self, blk: int, data: np.ndarray) -> None:
+        """Host→HBM: write offloaded pages into block `blk` in place."""
+        if self._upload_block_fn is None:
+
+            @functools.partial(jax.jit, donate_argnames=("kv_caches",))
+            def upload_fn(kv_caches, data, blk):
+                return tuple(
+                    leaf.at[:, blk].set(data[i].astype(leaf.dtype))
+                    for i, leaf in enumerate(kv_caches)
+                )
+
+            self._upload_block_fn = upload_fn
+        self.kv_caches = self._upload_block_fn(
+            self.kv_caches, data, jnp.int32(blk)
+        )
 
     # -- LoRA slots --------------------------------------------------------
 

@@ -57,6 +57,11 @@ class Request:
 
     # adapter slot in the runner's stacked LoRA buffers (0 = base model)
     lora_index: int = 0
+    # stable per-adapter-load salt for the KV hash chain (0 = base model).
+    # Slot numbers get REUSED across adapter loads, so the prefix cache keys
+    # on this instead: adapter KV differs from base KV whenever k/v
+    # projections carry deltas, and cross-matching would be silent corruption
+    lora_cache_salt: int = 0
 
     status: RequestStatus = RequestStatus.WAITING
     output_token_ids: list[int] = field(default_factory=list)

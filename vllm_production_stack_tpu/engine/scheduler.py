@@ -74,6 +74,7 @@ class Scheduler:
         model_config: ModelConfig,
         cache_config: CacheConfig,
         scheduler_config: SchedulerConfig,
+        host_tier=None,
     ):
         self.model_config = model_config
         self.cache_config = cache_config
@@ -83,6 +84,7 @@ class Scheduler:
             cache_config.num_blocks,
             cache_config.block_size,
             cache_config.enable_prefix_caching,
+            host_tier=host_tier,
         )
         self.waiting: deque[Request] = deque()
         self.running: list[Request] = []
@@ -300,11 +302,21 @@ class Scheduler:
         out, self._finished_externally = self._finished_externally, []
         return out
 
+    def _chain_root(self, req: Request) -> int:
+        """Root of a request's KV hash chain. Base model = the pool root;
+        LoRA requests salt it with their adapter's load-unique id — adapter
+        KV differs from base KV (k/v-projection deltas), so letting the two
+        cross-match would be silent attention corruption."""
+        if req.lora_cache_salt:
+            return chain_hash(self.pool.root_hash(), (req.lora_cache_salt,))
+        return self.pool.root_hash()
+
     def _admit(self, req: Request) -> None:
         """Prefix-cache lookup for a waiting (possibly resumed) request.
         The matchable sequence is everything that will be recomputed."""
         seq = req.all_token_ids
-        matched = self.pool.match_prefix(seq)
+        root = self._chain_root(req)
+        matched = self.pool.match_prefix(seq, parent=root)
         # keep at least one token to actually compute (its logits / its KV
         # write are what the next step needs)
         while matched and len(matched) * self.block_size >= req.prefill_target:
@@ -314,7 +326,7 @@ class Scheduler:
         req.num_cached_prompt_tokens = min(
             req.num_computed_tokens, req.num_prompt_tokens
         )
-        chain = [self.pool.root_hash()]
+        chain = [root]
         for i in range(len(matched)):
             chunk = tuple(seq[i * self.block_size : (i + 1) * self.block_size])
             chain.append(chain_hash(chain[-1], chunk))
@@ -402,7 +414,7 @@ class Scheduler:
 
     def _register_full_blocks(self, req: Request, start: int, end: int) -> None:
         chain = self._hash_chains.setdefault(
-            req.request_id, [self.pool.root_hash()]
+            req.request_id, [self._chain_root(req)]
         )
         first_new = start // self.block_size
         last_full = end // self.block_size  # blocks [0, last_full) are full

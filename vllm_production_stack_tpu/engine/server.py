@@ -85,6 +85,7 @@ class EngineServer:
         r.add_get("/is_sleeping", self.is_sleeping)
         r.add_post("/v1/load_lora_adapter", self.load_lora_adapter)
         r.add_post("/v1/unload_lora_adapter", self.unload_lora_adapter)
+        r.add_post("/kv/lookup", self.kv_lookup)
         r.add_post("/tokenize", self.tokenize)
         r.add_post("/detokenize", self.detokenize)
         r.add_get("/version", self.version)
@@ -368,6 +369,16 @@ class EngineServer:
             return error(409, str(e), "conflict")
         return web.json_response({"status": "ok"})
 
+    async def kv_lookup(self, request: web.Request) -> web.Response:
+        """KV-aware routing probe: longest resident KV prefix for a prompt
+        (HBM + host tiers). The KV controller fans /lookup out to this."""
+        body = await request.json()
+        text, token_ids = body.get("text"), body.get("token_ids")
+        if text is None and token_ids is None:
+            return error(400, "text or token_ids is required")
+        n = await self.async_engine.kv_lookup(text=text, token_ids=token_ids)
+        return web.json_response({"matched_tokens": n})
+
     async def tokenize(self, request: web.Request) -> web.Response:
         body = await request.json()
         ids = self.async_engine.tokenize(body.get("prompt", ""))
@@ -397,6 +408,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--num-blocks", type=int, default=512,
                    help="HBM KV pages in the pool")
+    p.add_argument("--num-host-blocks", type=int, default=0,
+                   help="host-RAM KV offload tier size in blocks (0 = off)")
     p.add_argument("--max-num-seqs", type=int, default=64)
     p.add_argument("--max-num-batched-tokens", type=int, default=512)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
@@ -428,6 +441,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         cache=CacheConfig(
             block_size=args.block_size,
             num_blocks=args.num_blocks,
+            num_host_blocks=args.num_host_blocks,
             enable_prefix_caching=args.enable_prefix_caching,
         ),
         scheduler=SchedulerConfig(

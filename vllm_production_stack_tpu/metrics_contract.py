@@ -15,6 +15,8 @@ NUM_REQUESTS_RUNNING = "tpu:num_requests_running"
 NUM_REQUESTS_WAITING = "tpu:num_requests_waiting"
 HBM_KV_USAGE_PERC = "tpu:hbm_kv_usage_perc"
 PREFIX_CACHE_HIT_RATE = "tpu:hbm_prefix_cache_hit_rate"
+# host-RAM offload tier (LMCache CPU-offload equivalent)
+HOST_KV_USAGE_PERC = "tpu:host_kv_usage_perc"
 
 # counters
 PREFIX_CACHE_HITS = "tpu:hbm_prefix_cache_hits_total"
@@ -22,12 +24,15 @@ PREFIX_CACHE_QUERIES = "tpu:hbm_prefix_cache_queries_total"
 NUM_PREEMPTIONS = "tpu:num_preemptions_total"
 PROMPT_TOKENS = "tpu:prompt_tokens_total"
 GENERATION_TOKENS = "tpu:generation_tokens_total"
+HOST_KV_OFFLOADS = "tpu:host_kv_offloaded_blocks_total"
+HOST_KV_RELOADS = "tpu:host_kv_reloaded_blocks_total"
 
 ALL_GAUGES = (
     NUM_REQUESTS_RUNNING,
     NUM_REQUESTS_WAITING,
     HBM_KV_USAGE_PERC,
     PREFIX_CACHE_HIT_RATE,
+    HOST_KV_USAGE_PERC,
 )
 ALL_COUNTERS = (
     PREFIX_CACHE_HITS,
@@ -35,4 +40,6 @@ ALL_COUNTERS = (
     NUM_PREEMPTIONS,
     PROMPT_TOKENS,
     GENERATION_TOKENS,
+    HOST_KV_OFFLOADS,
+    HOST_KV_RELOADS,
 )
