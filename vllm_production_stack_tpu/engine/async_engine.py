@@ -211,7 +211,7 @@ class AsyncEngine:
         with self._lock:
             self.engine.wake()
 
-    async def kv_lookup(self, text=None, token_ids=None) -> int:
+    async def kv_lookup(self, text=None, token_ids=None, lora_name=None) -> int:
         def work():
             # tokenize OUTSIDE the lock: the controller fans lookups to every
             # engine per routed request, and encode() needs no engine state —
@@ -222,7 +222,26 @@ class AsyncEngine:
                 else self.engine.tokenizer.encode(text or "")
             )
             with self._lock:
-                return self.engine.kv_lookup(token_ids=ids)
+                return self.engine.kv_lookup(token_ids=ids, lora_name=lora_name)
+
+        return await asyncio.get_running_loop().run_in_executor(None, work)
+
+    async def kv_export(self, text=None, token_ids=None, lora_name=None):
+        def work():
+            ids = (
+                token_ids
+                if token_ids is not None
+                else self.engine.tokenizer.encode(text or "")
+            )
+            with self._lock:
+                return self.engine.kv_export(token_ids=ids, lora_name=lora_name)
+
+        return await asyncio.get_running_loop().run_in_executor(None, work)
+
+    async def kv_import(self, hashes, blocks, fingerprint="") -> int:
+        def work():
+            with self._lock:
+                return self.engine.kv_import(hashes, blocks, fingerprint)
 
         return await asyncio.get_running_loop().run_in_executor(None, work)
 

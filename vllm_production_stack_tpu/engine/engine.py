@@ -94,12 +94,20 @@ class LLMEngine:
         self._states: dict[str, _RequestState] = {}
         self._lora_slots: dict[str, int] = {}  # adapter name -> slot index
         self._lora_paths: dict[str, str] = {}  # adapter name -> source path
-        # per-LOAD unique KV-chain salts (slots get reused; salts never are)
+        # KV-chain salts per adapter (name, path) — see load_lora
         self._lora_salts: dict[str, int] = {}
-        self._lora_salt_counter = itertools.count(1)
         self._req_counter = itertools.count()
         self._prompt_tokens = 0
         self._generation_tokens = 0
+        # identity of the weights this engine serves: same config + same
+        # checkpoint (or same random seed) => same KV bytes for same tokens.
+        # KV adoption (disaggregated prefill) refuses mismatched senders —
+        # same-shape-different-weights KV would silently corrupt attention
+        import hashlib
+
+        self.model_fingerprint = hashlib.sha256(
+            repr((config.model, config.seed)).encode()
+        ).hexdigest()[:16]
 
     # -- request lifecycle -------------------------------------------------
 
@@ -167,7 +175,20 @@ class LLMEngine:
         self.runner.install_lora(free[0], adapter)
         self._lora_slots[name] = free[0]
         self._lora_paths[name] = path
-        self._lora_salts[name] = next(self._lora_salt_counter)
+        # STABLE across engines serving the same (name, path) — the LoRA
+        # controller loads adapters under one name cluster-wide, and
+        # cross-engine KV transfer needs the salted chains to line up.
+        # A different path under a reused name still gets a fresh salt
+        import hashlib
+
+        # 63 bits: chain_hash packs tuple entries as signed 8-byte ints
+        self._lora_salts[name] = (
+            int.from_bytes(
+                hashlib.sha256(f"{name}\0{path}".encode()).digest()[:8],
+                "little",
+            )
+            >> 1
+        ) or 1
 
     def unload_lora(self, name: str) -> None:
         slot = self._lora_slots.get(name)
@@ -199,14 +220,60 @@ class LLMEngine:
         server and /v1/models read this view)."""
         return dict(self._lora_paths)
 
-    def kv_lookup(self, text: str | None = None,
-                  token_ids: list[int] | None = None) -> int:
-        """Longest KV prefix (tokens) resident across HBM + host tiers —
-        the probe behind KV-aware routing (reference: LMCache controller
-        LookupMsg, routing_logic.py:264-344)."""
+    def _cache_root(self, lora_name: str | None) -> int:
+        """Chain root for lookups/exports: salted when the name is a loaded
+        adapter (its KV differs from base KV), the pool root otherwise."""
+        from .kv_cache import chain_hash
+
+        salt = self._lora_salts.get(lora_name or "")
+        if salt:
+            return chain_hash(self.scheduler.pool.root_hash(), (salt,))
+        return self.scheduler.pool.root_hash()
+
+    def kv_export(
+        self,
+        text: str | None = None,
+        token_ids: list[int] | None = None,
+        lora_name: str | None = None,
+    ):
+        """Disaggregated prefill: export the prompt's resident KV blocks
+        (engine/kv_transfer.py). Called on the prefill engine."""
+        from .kv_transfer import KVTransfer
+
         if token_ids is None:
             token_ids = self.tokenizer.encode(text or "")
-        return self.scheduler.pool.match_length(list(token_ids))
+        return KVTransfer(self.scheduler.pool, self.runner).export_prompt(
+            list(token_ids), parent=self._cache_root(lora_name)
+        )
+
+    def kv_import(self, hashes, blocks, fingerprint: str = "") -> int:
+        """Disaggregated prefill: adopt shipped KV blocks into this
+        engine's pool. Called on the decode engine. Refuses KV from a sender
+        with different weights (fingerprint mismatch)."""
+        from .kv_transfer import KVTransfer
+
+        if fingerprint and fingerprint != self.model_fingerprint:
+            raise ValueError(
+                f"KV fingerprint mismatch: sender {fingerprint!r} != this "
+                f"engine {self.model_fingerprint!r} — different weights"
+            )
+        return KVTransfer(self.scheduler.pool, self.runner).import_blocks(
+            hashes, blocks
+        )
+
+    def kv_lookup(self, text: str | None = None,
+                  token_ids: list[int] | None = None,
+                  lora_name: str | None = None) -> int:
+        """Longest KV prefix (tokens) resident across HBM + host tiers —
+        the probe behind KV-aware routing (reference: LMCache controller
+        LookupMsg, routing_logic.py:264-344). `lora_name` (the request's
+        model field) salts the chain for adapter traffic so routing doesn't
+        chase base-model KV a LoRA request can't reuse."""
+        if token_ids is None:
+            token_ids = self.tokenizer.encode(text or "")
+        return self.scheduler.pool.match_length(
+            list(token_ids), parent=self._cache_root(lora_name)
+        )
 
     def has_request(self, request_id: str) -> bool:
         return request_id in self._states

@@ -1,0 +1,133 @@
+"""KV page transfer between engines (disaggregated prefill fabric).
+
+The reference ships KV pages prefill→decode with NIXL over UCX
+(GPU-direct/RDMA when available, TCP otherwise — deployment-vllm-multi.yaml:
+267-305, values-16-disagg-prefill.yaml). The TPU stack's transfer is
+content-addressed: a prompt's full blocks are identified by the same chain
+hashes the prefix cache uses, so "shipping KV" is exporting (hash, pages)
+pairs from the prefill engine's pool and adopting them into the decode
+engine's pool — after which the decode request is an ordinary 100% prefix
+hit.
+
+Transports: this module defines the wire format (npz: hashes as uint64
+hi/lo pairs + one stacked page tensor) served over the engines' HTTP
+surface (/kv/export, /kv/import, /kv/pull). On multi-slice TPU deployments
+the same export/adopt protocol can ride jax device-to-device transfers over
+ICI instead of host-staged HTTP — the pool-side bookkeeping (this module)
+is transport-agnostic, exactly like the reference's NIXL sender/receiver
+split from LMCache's bookkeeping.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+def serialize_blocks(
+    hashes: list[int], blocks: np.ndarray, fingerprint: str = ""
+) -> bytes:
+    """npz payload: N 128-bit chain hashes (as (N, 2) uint64 hi/lo), the
+    stacked page tensor (N, L, 2, block_size, kvH, D), and the sender's
+    model fingerprint."""
+    hi_lo = np.array(
+        [(h >> 64, h & 0xFFFFFFFFFFFFFFFF) for h in hashes], dtype=np.uint64
+    ).reshape(-1, 2)
+    buf = io.BytesIO()
+    # bf16 isn't npz-portable everywhere; ship as uint16 bit patterns
+    view = (
+        blocks.view(np.uint16)
+        if blocks.dtype.name == "bfloat16"
+        else blocks
+    )
+    np.savez(
+        buf, hashes=hi_lo, blocks=view, dtype=np.array(blocks.dtype.name),
+        fingerprint=np.array(fingerprint),
+    )
+    return buf.getvalue()
+
+
+def deserialize_blocks(payload: bytes) -> tuple[list[int], np.ndarray, str]:
+    with np.load(io.BytesIO(payload)) as z:
+        hi_lo = z["hashes"]
+        blocks = z["blocks"]
+        dtype = str(z["dtype"])
+        fingerprint = str(z["fingerprint"]) if "fingerprint" in z else ""
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        blocks = blocks.view(ml_dtypes.bfloat16)
+    hashes = [int(hi) << 64 | int(lo) for hi, lo in hi_lo]
+    return hashes, blocks, fingerprint
+
+
+class KVTransfer:
+    """Pool-side export/adopt bookkeeping, bound to one engine's scheduler
+    pool + runner. All methods assume the caller holds the engine lock."""
+
+    def __init__(self, pool, runner):
+        self.pool = pool
+        self.runner = runner
+
+    def block_shape(self) -> tuple[int, ...]:
+        """(L, 2, block_size, kvH, D) — the only page geometry this engine
+        can adopt."""
+        leaf = self.runner.kv_caches[0]
+        return (len(self.runner.kv_caches), 2, leaf.shape[2], *leaf.shape[3:])
+
+    def export_prompt(
+        self, token_ids: list[int], parent: int | None = None
+    ) -> tuple[list[int], np.ndarray]:
+        """(hashes, pages) for the prompt's HBM-resident full blocks —
+        called on the prefill engine right after its max_tokens=1 pass.
+        All fetches dispatch before any resolves, so the device→host copies
+        pipeline instead of serializing under the engine lock."""
+        root = self.pool.root_hash() if parent is None else parent
+        pending: list[tuple[int, list]] = []
+        for h in self.pool._chain(list(token_ids), root):
+            blk = self.pool._hash_to_block.get(h)
+            if blk is None:
+                break
+            pending.append((h, self.runner.fetch_block(blk)))
+        if not pending:
+            return [], np.empty((0,))
+        hashes = [h for h, _ in pending]
+        data = [
+            np.stack([np.asarray(p) for p in parts]) for _, parts in pending
+        ]
+        return hashes, np.stack(data)
+
+    def import_blocks(self, hashes: list[int], blocks: np.ndarray) -> int:
+        """Adopt shipped pages into this engine's pool as evictable cached
+        blocks. Returns blocks actually adopted (already-resident and
+        pool-full blocks are skipped; a partial import still shortens the
+        decode engine's recompute)."""
+        want = self.block_shape()
+        if len(hashes) and tuple(blocks.shape[1:]) != want:
+            raise ValueError(
+                f"KV page geometry mismatch: got {tuple(blocks.shape[1:])}, "
+                f"this engine needs {want}"
+            )
+        adopted = 0
+        for h, data in zip(hashes, blocks):
+            if h in self.pool._hash_to_block:
+                continue
+            blk = self.pool.allocate()
+            if blk is None:
+                break
+            try:
+                self.runner.upload_block(blk, data)
+            except Exception:
+                self.pool.free_block(blk)  # don't leak the block on failure
+                raise
+            self.pool._hash_to_block[h] = blk
+            self.pool._block_to_hash[blk] = h
+            # park as an evictable cached block (refcount 0, addressable)
+            self.pool.free_block(blk)
+            adopted += 1
+        return adopted

@@ -62,6 +62,7 @@ class EngineServer:
         self.async_engine = AsyncEngine(engine)
         self.model_name = served_model_name or engine.config.model.model
         self.metrics = EngineMetrics(self.model_name)
+        self._session = None  # lazy outbound ClientSession (kv_pull)
         self._start_time = time.time()
 
     @property
@@ -86,6 +87,9 @@ class EngineServer:
         r.add_post("/v1/load_lora_adapter", self.load_lora_adapter)
         r.add_post("/v1/unload_lora_adapter", self.unload_lora_adapter)
         r.add_post("/kv/lookup", self.kv_lookup)
+        r.add_post("/kv/export", self.kv_export)
+        r.add_post("/kv/import", self.kv_import)
+        r.add_post("/kv/pull", self.kv_pull)
         r.add_post("/tokenize", self.tokenize)
         r.add_post("/detokenize", self.detokenize)
         r.add_get("/version", self.version)
@@ -98,6 +102,19 @@ class EngineServer:
 
     async def _on_cleanup(self, app: web.Application) -> None:
         self.async_engine.shutdown()
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    def _client_session(self):
+        """Long-lived outbound session (KV pulls are on the PD hot path —
+        per-request session churn taxes latency and file descriptors)."""
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30)
+            )
+        return self._session
 
     # -- inference routes --------------------------------------------------
 
@@ -376,8 +393,87 @@ class EngineServer:
         text, token_ids = body.get("text"), body.get("token_ids")
         if text is None and token_ids is None:
             return error(400, "text or token_ids is required")
-        n = await self.async_engine.kv_lookup(text=text, token_ids=token_ids)
+        n = await self.async_engine.kv_lookup(
+            text=text, token_ids=token_ids, lora_name=body.get("model")
+        )
         return web.json_response({"matched_tokens": n})
+
+    async def kv_export(self, request: web.Request) -> web.Response:
+        """Disaggregated prefill, sender side: the prompt's resident KV
+        blocks as an npz payload (engine/kv_transfer.py wire format)."""
+        from .kv_transfer import serialize_blocks
+
+        body = await request.json()
+        if body.get("text") is None and body.get("token_ids") is None:
+            return error(400, "text or token_ids is required")
+        hashes, blocks = await self.async_engine.kv_export(
+            text=body.get("text"), token_ids=body.get("token_ids"),
+            lora_name=body.get("model"),
+        )
+        return web.Response(
+            body=serialize_blocks(
+                hashes, blocks, self.engine.model_fingerprint
+            ),
+            content_type="application/octet-stream",
+            headers={"X-KV-Blocks": str(len(hashes))},
+        )
+
+    async def kv_import(self, request: web.Request) -> web.Response:
+        """Disaggregated prefill, receiver side: adopt shipped KV blocks."""
+        from .kv_transfer import deserialize_blocks
+
+        payload = await request.read()
+        try:
+            hashes, blocks, fp = deserialize_blocks(payload)
+        except Exception as e:
+            return error(400, f"malformed KV payload: {e}")
+        try:
+            n = await self.async_engine.kv_import(hashes, blocks, fp)
+        except ValueError as e:  # geometry or fingerprint mismatch
+            return error(409, str(e), "conflict")
+        return web.json_response({"imported_blocks": n, "offered": len(hashes)})
+
+    async def kv_pull(self, request: web.Request) -> web.Response:
+        """Disaggregated prefill orchestration target: fetch the prompt's KV
+        from the prefill engine (source_url) and adopt it locally. The router
+        calls this on the DECODE engine between its two phases
+        (reference request.py:305-431; NIXL receiver role)."""
+        import aiohttp
+
+        from .kv_transfer import deserialize_blocks
+
+        body = await request.json()
+        source = (body.get("source_url") or "").rstrip("/")
+        if not source:
+            return error(400, "source_url is required")
+        if body.get("messages") is not None:
+            probe = {"text": self.async_engine.chat_prompt(body["messages"])}
+        elif body.get("text") is not None:
+            probe = {"text": body["text"]}
+        elif body.get("token_ids") is not None:
+            probe = {"token_ids": body["token_ids"]}
+        else:
+            return error(400, "messages, text, or token_ids is required")
+        if body.get("model"):
+            probe["model"] = body["model"]
+        try:
+            async with self._client_session().post(
+                source + "/kv/export", json=probe
+            ) as resp:
+                if resp.status != 200:
+                    return error(
+                        502, f"source engine returned {resp.status}",
+                        "bad_gateway",
+                    )
+                payload = await resp.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            return error(502, f"source engine unreachable: {e}", "bad_gateway")
+        hashes, blocks, fp = deserialize_blocks(payload)
+        try:
+            n = await self.async_engine.kv_import(hashes, blocks, fp)
+        except ValueError as e:
+            return error(409, str(e), "conflict")
+        return web.json_response({"imported_blocks": n, "offered": len(hashes)})
 
     async def tokenize(self, request: web.Request) -> web.Response:
         body = await request.json()

@@ -257,6 +257,42 @@ class RequestService:
             "PD prefill for %s on %s took %.3fs", request_id, prefill_url, time.time() - t0
         )
         decode_url = qps_min_url(decode_eps, stats)
+        # ship the prompt's KV pages prefill->decode (content-addressed
+        # export/adopt, engine/kv_transfer.py — the NIXL-equivalent hop). A
+        # failed transfer degrades to recompute on the decode engine, so it
+        # logs rather than fails the request.
+        pull_body = {"source_url": prefill_url}
+        if "messages" in body:
+            pull_body["messages"] = body["messages"]
+        elif "prompt" in body:
+            p = body["prompt"]
+            if isinstance(p, str):
+                pull_body["text"] = p
+            elif isinstance(p, list) and p and isinstance(p[0], int):
+                pull_body["token_ids"] = p
+            elif isinstance(p, list) and len(p) == 1 and isinstance(p[0], str):
+                pull_body["text"] = p[0]
+        try:
+            async with self.session.post(
+                decode_url + "/kv/pull", json=pull_body,
+                timeout=aiohttp.ClientTimeout(total=30),
+            ) as resp:
+                if resp.status == 200:
+                    logger.info(
+                        "PD KV transfer for %s: %s -> %s: %s",
+                        request_id, prefill_url, decode_url,
+                        await resp.json(),
+                    )
+                else:
+                    logger.warning(
+                        "PD KV transfer for %s returned %d (%s); decode "
+                        "will recompute",
+                        request_id, resp.status, await resp.text(),
+                    )
+        except Exception as e:  # ANY transfer fault degrades to recompute
+            logger.warning(
+                "PD KV transfer failed (%s); decode will recompute", e
+            )
         logger.info("Routing request %s to %s at %f", request_id, decode_url, time.time())
         return await self._proxy_stream(request, body, decode_url, request_id)
 
