@@ -1,0 +1,316 @@
+"""Multi-round QA serving benchmark — the stack's north-star workload.
+
+Re-implementation of the reference harness's workload semantics
+(benchmarks/multi-round-qa/multi-round-qa.py:18-534): N concurrent simulated
+users share a long system prompt, each carries a per-user history, and every
+round appends a question + the model's answer — so a serving stack with
+prefix caching / KV-aware routing re-uses the shared and per-user context
+instead of recomputing it. Users ramp up with gap = num_users/qps, leave
+after num_rounds, and are replaced to hold concurrency steady.
+
+Speaks plain OpenAI chat completions over aiohttp (works against the TPU
+router, a single engine, or any OpenAI endpoint — the reference harness
+only needs the API too). Emits a per-request CSV and a summary with QPS,
+prompt/generation throughput, and TTFT percentiles (README.md:80-86 of the
+reference benchmark).
+
+Usage:
+    python benchmarks/multi_round_qa.py --base-url http://localhost:8000 \
+        --model llama-3-8b --num-users 320 --qps 2.0 --num-rounds 10 \
+        --system-prompt-len 1000 --user-info-len 2000 --answer-len 100
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import csv
+import json
+import random
+import statistics
+import sys
+import time
+from dataclasses import dataclass, field
+
+import aiohttp
+
+_WORDS = (
+    "alpha beta gamma delta epsilon zeta eta theta iota kappa lambda mu nu "
+    "xi omicron pi rho sigma tau upsilon phi chi psi omega"
+).split()
+
+
+def filler_text(n_tokens: int, seed: int = 0) -> str:
+    """~n_tokens of deterministic filler (1 word ≈ 1 token is close enough
+    for load shaping; the reference uses dummy-token text the same way)."""
+    rng = random.Random(seed)
+    return " ".join(rng.choice(_WORDS) for _ in range(max(1, n_tokens)))
+
+
+@dataclass
+class WorkloadConfig:
+    num_users: int = 10
+    system_prompt_len: int = 1000
+    user_info_len: int = 2000
+    answer_len: int = 100
+    num_rounds: int = 5
+    qps: float = 1.0
+    model: str = "tiny-llama"
+    base_url: str = "http://localhost:8000"
+    duration_s: float = 60.0
+    enable_user_id: bool = False
+    temperature: float = 0.0
+
+
+@dataclass
+class RequestRecord:
+    user_id: int
+    round_idx: int
+    launch_time: float
+    ttft: float | None = None
+    finish_time: float | None = None
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    error: str | None = None
+
+    @property
+    def latency(self) -> float | None:
+        if self.finish_time is None:
+            return None
+        return self.finish_time - self.launch_time
+
+
+class UserSession:
+    """One simulated user's conversation state machine."""
+
+    def __init__(self, cfg: WorkloadConfig, user_id: int, system_prompt: str):
+        self.cfg = cfg
+        self.user_id = user_id
+        self.system_prompt = system_prompt
+        self.user_info = filler_text(cfg.user_info_len, seed=1000 + user_id)
+        self.history: list[dict] = []
+        self.round_idx = 0
+        self.inflight = False
+
+    @property
+    def done(self) -> bool:
+        return self.round_idx >= self.cfg.num_rounds and not self.inflight
+
+    def build_messages(self) -> list[dict]:
+        q = (
+            f"Question {self.round_idx} from user {self.user_id}: "
+            + filler_text(16, seed=self.user_id * 97 + self.round_idx)
+        )
+        return [
+            {"role": "system", "content": self.system_prompt},
+            {"role": "user", "content": f"My notes: {self.user_info}"},
+            *self.history,
+            {"role": "user", "content": q},
+        ]
+
+    async def launch_round(
+        self, session: aiohttp.ClientSession, records: list[RequestRecord]
+    ) -> None:
+        cfg = self.cfg
+        rec = RequestRecord(self.user_id, self.round_idx, time.time())
+        records.append(rec)
+        self.inflight = True
+        messages = self.build_messages()
+        body = {
+            "model": cfg.model,
+            "messages": messages,
+            "max_tokens": cfg.answer_len,
+            "temperature": cfg.temperature,
+            "stream": True,
+            "stream_options": {"include_usage": True},
+        }
+        headers = {}
+        if cfg.enable_user_id:
+            headers["x-user-id"] = str(self.user_id)
+        answer_parts: list[str] = []
+        try:
+            async with session.post(
+                cfg.base_url + "/v1/chat/completions", json=body,
+                headers=headers,
+            ) as resp:
+                if resp.status != 200:
+                    rec.error = f"HTTP {resp.status}"
+                    return
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    payload = line[len("data: "):]
+                    if payload == "[DONE]":
+                        break
+                    chunk = json.loads(payload)
+                    if chunk.get("error"):
+                        # engines surface post-header failures (e.g. prompt
+                        # too long) as SSE error events on a 200 stream
+                        rec.error = str(chunk["error"].get("message", "error"))
+                        return
+                    if chunk.get("choices"):
+                        choice = chunk["choices"][0]
+                        delta = choice.get("delta", {})
+                        text = delta.get("content")
+                        # first generated-token signal: a content delta (even
+                        # one held back to "" by incremental detokenization
+                        # of partial UTF-8) or the finish marker
+                        if rec.ttft is None and (
+                            text is not None or choice.get("finish_reason")
+                        ):
+                            rec.ttft = time.time() - rec.launch_time
+                        if text:
+                            answer_parts.append(text)
+                    if chunk.get("usage"):
+                        rec.prompt_tokens = chunk["usage"].get(
+                            "prompt_tokens", 0
+                        )
+                        rec.completion_tokens = chunk["usage"].get(
+                            "completion_tokens", 0
+                        )
+            rec.finish_time = time.time()
+            if rec.completion_tokens == 0 and answer_parts:
+                # endpoint sent no usage chunk; approximate from the stream
+                rec.completion_tokens = len(answer_parts)
+            self.history.append(messages[-1])
+            self.history.append(
+                {"role": "assistant", "content": "".join(answer_parts)}
+            )
+            self.round_idx += 1
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            rec.error = str(e)
+        finally:
+            self.inflight = False
+
+
+class UserSessionManager:
+    """Ramps users up at gap = num_users/qps, holds concurrency at
+    num_users (a finished user is replaced by a fresh one), and launches
+    one round per user per scheduling opportunity at the target QPS."""
+
+    def __init__(self, cfg: WorkloadConfig):
+        self.cfg = cfg
+        self.system_prompt = (
+            "You are a helpful assistant. "
+            + filler_text(cfg.system_prompt_len, seed=42)
+        )
+        self.sessions: list[UserSession] = []
+        self.records: list[RequestRecord] = []
+        self._next_user_id = 0
+        self._gap = 1.0 / cfg.qps if cfg.qps > 0 else 0.1
+        self._last_launch = 0.0
+
+    def _spawn(self) -> UserSession:
+        s = UserSession(self.cfg, self._next_user_id, self.system_prompt)
+        self._next_user_id += 1
+        self.sessions.append(s)
+        return s
+
+    def step(self, now: float, session: aiohttp.ClientSession,
+             tasks: set) -> None:
+        # replace finished users; ramp until num_users live
+        self.sessions = [s for s in self.sessions if not s.done]
+        while len(self.sessions) < self.cfg.num_users:
+            self._spawn()
+        if now - self._last_launch < self._gap:
+            return
+        # round-robin the launch opportunity over idle users
+        idle = [
+            s for s in self.sessions
+            if not s.inflight and s.round_idx < self.cfg.num_rounds
+        ]
+        if not idle:
+            return
+        user = min(idle, key=lambda s: s.round_idx)
+        self._last_launch = now
+        t = asyncio.ensure_future(user.launch_round(session, self.records))
+        tasks.add(t)
+        t.add_done_callback(tasks.discard)
+
+    # -- reporting --------------------------------------------------------
+
+    def summary(self, elapsed: float) -> dict:
+        ok = [r for r in self.records if r.finish_time is not None]
+        ttfts = sorted(r.ttft for r in ok if r.ttft is not None)
+
+        def pct(p):
+            return ttfts[int(p * (len(ttfts) - 1))] if ttfts else None
+
+        return {
+            "requests_completed": len(ok),
+            "requests_failed": sum(1 for r in self.records if r.error),
+            "qps": round(len(ok) / elapsed, 3) if elapsed else 0,
+            "prompt_tok_per_s": round(
+                sum(r.prompt_tokens for r in ok) / elapsed, 1
+            ),
+            "gen_tok_per_s": round(
+                sum(r.completion_tokens for r in ok) / elapsed, 1
+            ),
+            "avg_ttft_s": round(statistics.mean(ttfts), 4) if ttfts else None,
+            "p50_ttft_s": round(pct(0.50), 4) if ttfts else None,
+            "p90_ttft_s": round(pct(0.90), 4) if ttfts else None,
+            "elapsed_s": round(elapsed, 1),
+        }
+
+    def write_csv(self, path: str) -> None:
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow([
+                "user_id", "round", "launch_time", "ttft", "latency",
+                "prompt_tokens", "completion_tokens", "error",
+            ])
+            for r in self.records:
+                w.writerow([
+                    r.user_id, r.round_idx, f"{r.launch_time:.3f}",
+                    f"{r.ttft:.4f}" if r.ttft is not None else "",
+                    f"{r.latency:.4f}" if r.latency is not None else "",
+                    r.prompt_tokens, r.completion_tokens, r.error or "",
+                ])
+
+
+async def run_benchmark(cfg: WorkloadConfig) -> tuple[dict, UserSessionManager]:
+    manager = UserSessionManager(cfg)
+    tasks: set = set()
+    timeout = aiohttp.ClientTimeout(total=300)
+    start = time.time()
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        while time.time() - start < cfg.duration_s:
+            manager.step(time.time(), session, tasks)
+            await asyncio.sleep(0.02)
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+    return manager.summary(time.time() - start), manager
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--base-url", default="http://localhost:8000")
+    p.add_argument("--model", default="tiny-llama")
+    p.add_argument("--num-users", type=int, default=10)
+    p.add_argument("--qps", type=float, default=1.0)
+    p.add_argument("--num-rounds", type=int, default=5)
+    p.add_argument("--system-prompt-len", type=int, default=1000)
+    p.add_argument("--user-info-len", type=int, default=2000)
+    p.add_argument("--answer-len", type=int, default=100)
+    p.add_argument("--duration", type=float, default=60.0)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--enable-user-id", action="store_true",
+                   help="send x-user-id (exercises session-sticky routing)")
+    p.add_argument("--output", default="summary.csv")
+    args = p.parse_args(argv)
+    cfg = WorkloadConfig(
+        num_users=args.num_users, system_prompt_len=args.system_prompt_len,
+        user_info_len=args.user_info_len, answer_len=args.answer_len,
+        num_rounds=args.num_rounds, qps=args.qps, model=args.model,
+        base_url=args.base_url.rstrip("/"), duration_s=args.duration,
+        enable_user_id=args.enable_user_id, temperature=args.temperature,
+    )
+    summary, manager = asyncio.run(run_benchmark(cfg))
+    manager.write_csv(args.output)
+    print(json.dumps(summary))
+    return 0 if summary["requests_completed"] > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""Fixed-QPS load generator for the router perf rig.
+
+Counterpart of the reference's src/tests/perftest/request_generator.py: fire
+chat completions at a target QPS against the router (backed by fake engines,
+vllm_production_stack_tpu/testing/fake_engine.py) and report achieved
+QPS/latency — the router-only throughput gate used in CI
+(router-e2e-test.yml:51-66; 4 fake engines @ 500 tok/s, --qps 10).
+
+    python benchmarks/request_generator.py --base-url http://localhost:8000 \
+        --model fake-model --qps 10 --duration 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import statistics
+import time
+
+import aiohttp
+
+
+async def fire(session, base_url, model, results):
+    t0 = time.time()
+    try:
+        async with session.post(
+            base_url + "/v1/chat/completions",
+            json={
+                "model": model,
+                "messages": [{"role": "user", "content": "ping"}],
+                "max_tokens": 16,
+            },
+        ) as resp:
+            await resp.read()
+            results.append((resp.status, time.time() - t0))
+    except (aiohttp.ClientError, asyncio.TimeoutError):
+        results.append((0, time.time() - t0))
+
+
+async def run(base_url, model, qps, duration) -> dict:
+    results: list[tuple[int, float]] = []
+    tasks: set = set()
+    gap = 1.0 / qps
+    start = time.time()
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=60)
+    ) as session:
+        nxt = start
+        while time.time() - start < duration:
+            now = time.time()
+            if now >= nxt:
+                t = asyncio.ensure_future(
+                    fire(session, base_url, model, results)
+                )
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+                nxt += gap
+            await asyncio.sleep(min(0.005, max(0.0, nxt - time.time())))
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+    elapsed = time.time() - start
+    ok = [lat for status, lat in results if status == 200]
+    return {
+        "target_qps": qps,
+        "achieved_qps": round(len(ok) / elapsed, 2),
+        "errors": sum(1 for s, _ in results if s != 200),
+        "avg_latency_s": round(statistics.mean(ok), 4) if ok else None,
+        "p99_latency_s": (
+            round(sorted(ok)[int(0.99 * (len(ok) - 1))], 4) if ok else None
+        ),
+    }
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--base-url", default="http://localhost:8000")
+    p.add_argument("--model", default="fake-model")
+    p.add_argument("--qps", type=float, default=10.0)
+    p.add_argument("--duration", type=float, default=30.0)
+    args = p.parse_args(argv)
+    print(json.dumps(asyncio.run(
+        run(args.base_url.rstrip("/"), args.model, args.qps, args.duration)
+    )))
+
+
+if __name__ == "__main__":
+    main()

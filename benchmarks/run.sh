@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Multi-round QA benchmark recipe (mirrors the reference's
+# benchmarks/multi-round-qa/run.sh: warmup with long histories, then a QPS
+# sweep against the deployed router).
+#
+#   ./benchmarks/run.sh <router-url> <model-name>
+set -euo pipefail
+
+URL="${1:-http://localhost:8000}"
+MODEL="${2:-llama-3-8b}"
+OUT_DIR="${OUT_DIR:-bench-results}"
+mkdir -p "$OUT_DIR"
+
+# Warmup: populate prefix caches with the shared system prompt + user
+# histories (the reference warms 400 users with 20k-token histories).
+python benchmarks/multi_round_qa.py \
+    --base-url "$URL" --model "$MODEL" \
+    --num-users "${WARMUP_USERS:-40}" --qps "${WARMUP_QPS:-2}" \
+    --num-rounds 2 --system-prompt-len 1000 --user-info-len 2000 \
+    --answer-len 100 --duration "${WARMUP_S:-60}" \
+    --output "$OUT_DIR/warmup.csv"
+
+# QPS sweep (reference sweeps 0.1 -> 4.1)
+for QPS in ${QPS_SWEEP:-0.5 1.0 2.0 4.0}; do
+    echo "=== qps=$QPS ==="
+    python benchmarks/multi_round_qa.py \
+        --base-url "$URL" --model "$MODEL" \
+        --num-users "${NUM_USERS:-320}" --qps "$QPS" \
+        --num-rounds "${NUM_ROUNDS:-10}" \
+        --system-prompt-len "${SYS_LEN:-1000}" \
+        --user-info-len "${USER_LEN:-20000}" \
+        --answer-len "${ANSWER_LEN:-100}" \
+        --duration "${DURATION_S:-120}" \
+        --output "$OUT_DIR/sweep-qps$QPS.csv" \
+        | tee "$OUT_DIR/summary-qps$QPS.json"
+done

@@ -72,7 +72,7 @@ def test_export_import_makes_prompt_resident():
     assert len(hashes) == 4  # all full prompt blocks resident
 
     assert b.kv_lookup(token_ids=prompt) == 0
-    adopted = b.kv_import(hashes, blocks)
+    adopted = b.kv_import(hashes, blocks, a.model_fingerprint)
     assert adopted == 4
     assert b.kv_lookup(token_ids=prompt) == 4 * BS
 
@@ -87,7 +87,13 @@ def test_export_import_makes_prompt_resident():
     assert toks == out_a  # same model, same KV -> same greedy continuation
 
     # re-import is a no-op (blocks already resident)
-    assert b.kv_import(hashes, blocks) == 0
+    assert b.kv_import(hashes, blocks, a.model_fingerprint) == 0
+    # foreign/absent fingerprints are refused outright
+    import pytest
+    with pytest.raises(ValueError, match="fingerprint"):
+        b.kv_import(hashes, blocks)
+    with pytest.raises(ValueError, match="fingerprint"):
+        b.kv_import(hashes, blocks, "deadbeef")
 
 
 def test_pd_e2e_through_router():

@@ -175,19 +175,21 @@ class LLMEngine:
         self.runner.install_lora(free[0], adapter)
         self._lora_slots[name] = free[0]
         self._lora_paths[name] = path
-        # STABLE across engines serving the same (name, path) — the LoRA
+        # STABLE across engines serving the same adapter CONTENT — the LoRA
         # controller loads adapters under one name cluster-wide, and
-        # cross-engine KV transfer needs the salted chains to line up.
-        # A different path under a reused name still gets a fresh salt
+        # cross-engine KV transfer needs the salted chains to line up. The
+        # file digest is folded in so overwriting an adapter in place and
+        # reloading it can never prefix-hit the old weights' cached KV
         import hashlib
+        import os
 
+        digest = hashlib.sha256(f"{name}\0{path}".encode())
+        sft = os.path.join(path, "adapter_model.safetensors")
+        with open(sft, "rb") as f:
+            digest.update(f.read())
         # 63 bits: chain_hash packs tuple entries as signed 8-byte ints
         self._lora_salts[name] = (
-            int.from_bytes(
-                hashlib.sha256(f"{name}\0{path}".encode()).digest()[:8],
-                "little",
-            )
-            >> 1
+            int.from_bytes(digest.digest()[:8], "little") >> 1
         ) or 1
 
     def unload_lora(self, name: str) -> None:
@@ -252,10 +254,12 @@ class LLMEngine:
         with different weights (fingerprint mismatch)."""
         from .kv_transfer import KVTransfer
 
-        if fingerprint and fingerprint != self.model_fingerprint:
+        if fingerprint != self.model_fingerprint:
+            # empty counts as mismatch too: the wire format always carries a
+            # fingerprint, so a missing one means a foreign/corrupt sender
             raise ValueError(
                 f"KV fingerprint mismatch: sender {fingerprint!r} != this "
-                f"engine {self.model_fingerprint!r} — different weights"
+                f"engine {self.model_fingerprint!r} — refusing foreign KV"
             )
         return KVTransfer(self.scheduler.pool, self.runner).import_blocks(
             hashes, blocks

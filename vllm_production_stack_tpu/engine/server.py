@@ -410,10 +410,13 @@ class EngineServer:
             text=body.get("text"), token_ids=body.get("token_ids"),
             lora_name=body.get("model"),
         )
+        # multi-MB payloads: never serialize on the event loop
+        payload = await asyncio.get_running_loop().run_in_executor(
+            None, serialize_blocks, hashes, blocks,
+            self.engine.model_fingerprint,
+        )
         return web.Response(
-            body=serialize_blocks(
-                hashes, blocks, self.engine.model_fingerprint
-            ),
+            body=payload,
             content_type="application/octet-stream",
             headers={"X-KV-Blocks": str(len(hashes))},
         )
@@ -424,7 +427,8 @@ class EngineServer:
 
         payload = await request.read()
         try:
-            hashes, blocks, fp = deserialize_blocks(payload)
+            hashes, blocks, fp = await asyncio.get_running_loop(
+            ).run_in_executor(None, deserialize_blocks, payload)
         except Exception as e:
             return error(400, f"malformed KV payload: {e}")
         try:
@@ -468,7 +472,12 @@ class EngineServer:
                 payload = await resp.read()
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             return error(502, f"source engine unreachable: {e}", "bad_gateway")
-        hashes, blocks, fp = deserialize_blocks(payload)
+        try:
+            hashes, blocks, fp = await asyncio.get_running_loop(
+            ).run_in_executor(None, deserialize_blocks, payload)
+        except Exception as e:  # truncated/corrupt upstream payload
+            return error(502, f"malformed KV payload from source: {e}",
+                         "bad_gateway")
         try:
             n = await self.async_engine.kv_import(hashes, blocks, fp)
         except ValueError as e:

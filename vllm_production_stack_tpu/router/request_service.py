@@ -262,6 +262,11 @@ class RequestService:
         # failed transfer degrades to recompute on the decode engine, so it
         # logs rather than fails the request.
         pull_body = {"source_url": prefill_url}
+        if body.get("model"):
+            # the engines salt KV chains per LoRA adapter (model field);
+            # omitting it would make adapter exports walk the base chain
+            # and ship nothing
+            pull_body["model"] = body["model"]
         if "messages" in body:
             pull_body["messages"] = body["messages"]
         elif "prompt" in body:

@@ -102,6 +102,26 @@ class FakeEngine:
                         "choices": [{"index": 0, **delta, "finish_reason": None}],
                     }
                     await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                opts = body.get("stream_options") or {}
+                if opts.get("include_usage"):
+                    usage_chunk = {
+                        "id": rid,
+                        "object": (
+                            "chat.completion.chunk" if is_chat
+                            else "text_completion"
+                        ),
+                        "created": created,
+                        "model": body.get("model", self.model),
+                        "choices": [],
+                        "usage": {
+                            "prompt_tokens": n_prompt,
+                            "completion_tokens": n,
+                            "total_tokens": n_prompt + n,
+                        },
+                    }
+                    await resp.write(
+                        f"data: {json.dumps(usage_chunk)}\n\n".encode()
+                    )
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()
                 self.generation_tokens_total += n
