@@ -143,7 +143,13 @@ class UserSession:
                     payload = line[len("data: "):]
                     if payload == "[DONE]":
                         break
-                    chunk = json.loads(payload)
+                    try:
+                        chunk = json.loads(payload)
+                    except json.JSONDecodeError:
+                        # a truncated/garbage SSE line is a failed request,
+                        # not a vanished one
+                        rec.error = f"malformed SSE line: {payload[:80]!r}"
+                        return
                     if chunk.get("error"):
                         # engines surface post-header failures (e.g. prompt
                         # too long) as SSE error events on a 200 stream
@@ -199,7 +205,9 @@ class UserSessionManager:
         self.records: list[RequestRecord] = []
         self._next_user_id = 0
         self._gap = 1.0 / cfg.qps if cfg.qps > 0 else 0.1
-        self._last_launch = 0.0
+        # absolute schedule: launches catch up after slow ticks instead of
+        # drifting below the target QPS by up to a poll interval per request
+        self._next_launch: float | None = None
 
     def _spawn(self) -> UserSession:
         s = UserSession(self.cfg, self._next_user_id, self.system_prompt)
@@ -213,20 +221,23 @@ class UserSessionManager:
         self.sessions = [s for s in self.sessions if not s.done]
         while len(self.sessions) < self.cfg.num_users:
             self._spawn()
-        if now - self._last_launch < self._gap:
-            return
-        # round-robin the launch opportunity over idle users
-        idle = [
-            s for s in self.sessions
-            if not s.inflight and s.round_idx < self.cfg.num_rounds
-        ]
-        if not idle:
-            return
-        user = min(idle, key=lambda s: s.round_idx)
-        self._last_launch = now
-        t = asyncio.ensure_future(user.launch_round(session, self.records))
-        tasks.add(t)
-        t.add_done_callback(tasks.discard)
+        if self._next_launch is None:
+            self._next_launch = now
+        while now >= self._next_launch:
+            # round-robin the launch opportunity over idle users
+            idle = [
+                s for s in self.sessions
+                if not s.inflight and s.round_idx < self.cfg.num_rounds
+            ]
+            if not idle:
+                # nobody to launch: don't accrue an unbounded backlog
+                self._next_launch = now + self._gap
+                return
+            user = min(idle, key=lambda s: s.round_idx)
+            self._next_launch += self._gap
+            t = asyncio.ensure_future(user.launch_round(session, self.records))
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
 
     # -- reporting --------------------------------------------------------
 

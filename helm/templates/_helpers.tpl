@@ -1,0 +1,62 @@
+{{/* Common naming + label helpers */}}
+{{- define "tpustack.fullname" -}}
+{{- .Release.Name | trunc 40 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "tpustack.labels" -}}
+app.kubernetes.io/part-of: tpu-production-stack
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
+{{- range $k, $v := .Values.servingEngineSpec.labels }}
+{{ $k }}: {{ $v | quote }}
+{{- end }}
+{{- end -}}
+
+{{/* Engine deployment name for one modelSpec entry */}}
+{{- define "tpustack.engineName" -}}
+{{- printf "%s-engine-%s" .release .spec.name | trunc 60 | trimSuffix "-" -}}
+{{- end -}}
+
+{{/* The engine serving command for one modelSpec entry — the TPU analogue of
+     the reference's generated `vllm serve` args
+     (deployment-vllm-multi.yaml:108-199) */}}
+{{- define "tpustack.engineArgs" -}}
+- "-m"
+- "vllm_production_stack_tpu.engine.server"
+- "--model"
+- {{ .modelURL | quote }}
+- "--served-model-name"
+- {{ .name | quote }}
+- "--port"
+- "8000"
+{{- if .maxModelLen }}
+- "--max-model-len"
+- {{ .maxModelLen | quote }}
+{{- end }}
+{{- if .dtype }}
+- "--dtype"
+- {{ .dtype | quote }}
+{{- end }}
+{{- if .tensorParallelSize }}
+- "--tensor-parallel-size"
+- {{ .tensorParallelSize | quote }}
+{{- end }}
+{{- if .maxNumSeqs }}
+- "--max-num-seqs"
+- {{ .maxNumSeqs | quote }}
+{{- end }}
+{{- if .numHostBlocks }}
+- "--num-host-blocks"
+- {{ .numHostBlocks | quote }}
+{{- end }}
+{{- if .maxLoras }}
+- "--max-loras"
+- {{ .maxLoras | quote }}
+{{- end }}
+{{- if eq (.enablePrefixCaching | default true) false }}
+- "--no-enable-prefix-caching"
+{{- end }}
+{{- range .extraArgs }}
+- {{ . | quote }}
+{{- end }}
+{{- end -}}

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Install the observability stack: kube-prometheus-stack + the TPU dashboard
+# + prometheus-adapter custom metrics (reference observability/install.sh).
+set -euo pipefail
+NS="${NS:-monitoring}"
+
+helm repo add prometheus-community \
+    https://prometheus-community.github.io/helm-charts
+helm repo update
+helm upgrade --install kube-prom-stack \
+    prometheus-community/kube-prometheus-stack -n "$NS" --create-namespace
+
+kubectl -n "$NS" create configmap tpu-dashboard \
+    --from-file=tpu-dashboard.json="$(dirname "$0")/tpu-dashboard.json" \
+    --dry-run=client -o yaml | kubectl apply -f -
+kubectl -n "$NS" label configmap tpu-dashboard grafana_dashboard=1 --overwrite
+
+helm upgrade --install prom-adapter \
+    prometheus-community/prometheus-adapter -n "$NS" \
+    -f "$(dirname "$0")/prom-adapter.yaml"
+
+kubectl apply -f "$(dirname "$0")/podmonitor.yaml"
+echo "observability stack installed in namespace $NS"

@@ -177,16 +177,20 @@ class LLMEngine:
         self._lora_paths[name] = path
         # STABLE across engines serving the same adapter CONTENT — the LoRA
         # controller loads adapters under one name cluster-wide, and
-        # cross-engine KV transfer needs the salted chains to line up. The
-        # file digest is folded in so overwriting an adapter in place and
-        # reloading it can never prefix-hit the old weights' cached KV
+        # cross-engine KV transfer needs the salted chains to line up, so
+        # the seed is (name, file bytes) and deliberately NOT the local path
+        # (per-node download dirs differ). The content digest also means
+        # overwriting an adapter in place and reloading it can never
+        # prefix-hit the old weights' cached KV. Chunked read: this runs
+        # under the engine lock and adapters can be hundreds of MB
         import hashlib
         import os
 
-        digest = hashlib.sha256(f"{name}\0{path}".encode())
+        digest = hashlib.sha256(name.encode() + b"\0")
         sft = os.path.join(path, "adapter_model.safetensors")
         with open(sft, "rb") as f:
-            digest.update(f.read())
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                digest.update(chunk)
         # 63 bits: chain_hash packs tuple entries as signed 8-byte ints
         self._lora_salts[name] = (
             int.from_bytes(digest.digest()[:8], "little") >> 1
