@@ -99,8 +99,34 @@ class EngineServer:
 
     async def _on_startup(self, app: web.Application) -> None:
         self.async_engine.start(asyncio.get_running_loop())
+        await self._register_with_kv_controller("/register")
+
+    async def _register_with_kv_controller(self, endpoint: str) -> None:
+        """Join/leave the KV controller's engine set when deployed with
+        KV_CONTROLLER_URL (+POD_IP/ENGINE_PORT from the operator's downward
+        API) — the LMCACHE_CONTROLLER_URL contract
+        (deployment-vllm-multi.yaml:324-339)."""
+        import os
+
+        controller = os.environ.get("KV_CONTROLLER_URL")
+        pod_ip = os.environ.get("POD_IP")
+        if not controller or not pod_ip:
+            return
+        port = os.environ.get("ENGINE_PORT", "8000")
+        my_url = f"http://{pod_ip}:{port}"
+        try:
+            async with self._client_session().post(
+                controller.rstrip("/") + endpoint, json={"url": my_url}
+            ) as resp:
+                logger.info(
+                    "KV controller %s%s (%s): HTTP %d",
+                    controller, endpoint, my_url, resp.status,
+                )
+        except Exception as e:
+            logger.warning("KV controller %s failed: %s", endpoint, e)
 
     async def _on_cleanup(self, app: web.Application) -> None:
+        await self._register_with_kv_controller("/deregister")
         self.async_engine.shutdown()
         if self._session is not None and not self._session.closed:
             await self._session.close()

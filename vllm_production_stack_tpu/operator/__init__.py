@@ -1,0 +1,3 @@
+"""Kubernetes operator for the TPU serving stack (reference: operator/ —
+Go/kubebuilder with 4 CRDs; here a Python control plane over raw K8s REST,
+same reconcile semantics)."""

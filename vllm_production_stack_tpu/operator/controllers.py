@@ -1,0 +1,225 @@
+"""Reconcilers for the four CRDs.
+
+Same control loops as the reference's Go controllers:
+- TPURuntime  → Service → PVC → Deployment, drift detection, status from
+  deployment replica counts (vllmruntime_controller.go:57-187, 624-735)
+- TPURouter   → router Deployment + args from spec
+  (vllmrouter_controller.go:62-195)
+- CacheServer → KV-controller Deployment (cacheserver_controller.go:54-133)
+- LoraAdapter → desired placement over ready pods labeled with the base
+  model, diffed against live /v1/models registrations, loaded/unloaded via
+  the engines' /v1/load_lora_adapter (loraadapter_controller.go:73-232,
+  582-693 — control plane talking straight to data-plane HTTP)
+"""
+
+from __future__ import annotations
+
+import aiohttp
+
+from ..utils.logging import init_logger
+from . import resources
+from .k8s_client import K8sClient
+
+logger = init_logger(__name__)
+
+
+def _spec_drifted(live: dict, desired: dict) -> bool:
+    """Compare the fields the operator owns (reference deploymentNeedsUpdate
+    checks replicas/model/image/resources/env diff, :624-705). Pod-level
+    placement fields (nodeSelector, volumes) and template labels are owned
+    too — a tpuTopology or storage change must roll the deployment. Fields
+    the apiserver defaults (strategy, probes' scheme, ...) are deliberately
+    NOT compared, or every loop would look drifted on a real cluster."""
+    lspec, dspec = live.get("spec", {}), desired["spec"]
+    if lspec.get("replicas") != dspec.get("replicas"):
+        return True
+    lt, dt = lspec["template"], dspec["template"]
+    if lt["metadata"].get("labels") != dt["metadata"].get("labels"):
+        return True
+    lp, dp = lt["spec"], dt["spec"]
+    if lp.get("nodeSelector") != dp.get("nodeSelector"):
+        return True
+    if lp.get("volumes") != dp.get("volumes"):
+        return True
+    lc, dc = lp["containers"][0], dp["containers"][0]
+    return any(
+        lc.get(f) != dc.get(f)
+        for f in ("image", "args", "env", "resources", "volumeMounts",
+                  "ports")
+    )
+
+
+class TPURuntimeReconciler:
+    plural = "tpuruntimes"
+
+    def __init__(self, client: K8sClient):
+        self.c = client
+
+    async def reconcile(self, cr: dict) -> None:
+        name = cr["metadata"]["name"]
+        await self.c.apply(self.c.services, resources.service_for_runtime(cr))
+        pvc = resources.pvc_for_runtime(cr)
+        if pvc is not None and await self.c.get(
+            self.c.pvcs(pvc["metadata"]["name"])
+        ) is None:
+            # PVCs are immutable-ish: create once, never replace
+            await self.c.create(self.c.pvcs(), pvc)
+        desired = resources.deployment_for_runtime(cr)
+        live = await self.c.get(self.c.deployments(desired["metadata"]["name"]))
+        if live is None or _spec_drifted(live, desired):
+            await self.c.apply(self.c.deployments, desired)
+            logger.info("TPURuntime %s: deployment %s",
+                        name, "created" if live is None else "updated")
+        # status from deployment replica counts
+        live = await self.c.get(self.c.deployments(desired["metadata"]["name"]))
+        st = (live or {}).get("status", {})
+        ready = st.get("readyReplicas", 0) or 0
+        want = cr["spec"].get("replicas", 1)
+        await self.c.patch_status(self.c.crs(self.plural, name), {
+            "replicas": want,
+            "readyReplicas": ready,
+            "phase": "Ready" if ready >= want else "Progressing",
+        })
+
+
+class TPURouterReconciler:
+    plural = "tpurouters"
+
+    def __init__(self, client: K8sClient):
+        self.c = client
+
+    async def reconcile(self, cr: dict) -> None:
+        name = cr["metadata"]["name"]
+        desired = resources.deployment_for_router(cr)
+        live = await self.c.get(self.c.deployments(desired["metadata"]["name"]))
+        if live is None or _spec_drifted(live, desired):
+            await self.c.apply(self.c.deployments, desired)
+        runtimes = await self.c.list(self.c.crs("tpuruntimes"))
+        await self.c.patch_status(self.c.crs(self.plural, name), {
+            "activeRuntimes": [r["metadata"]["name"] for r in runtimes],
+            "phase": "Ready",
+        })
+
+
+class CacheServerReconciler:
+    plural = "cacheservers"
+
+    def __init__(self, client: K8sClient):
+        self.c = client
+
+    async def reconcile(self, cr: dict) -> None:
+        desired = resources.deployment_for_cacheserver(cr)
+        live = await self.c.get(self.c.deployments(desired["metadata"]["name"]))
+        if live is None or _spec_drifted(live, desired):
+            await self.c.apply(self.c.deployments, desired)
+        await self.c.patch_status(
+            self.c.crs(self.plural, cr["metadata"]["name"]), {"phase": "Ready"}
+        )
+
+
+class LoraAdapterReconciler:
+    plural = "loraadapters"
+
+    def __init__(self, client: K8sClient, http: aiohttp.ClientSession,
+                 engine_port: int = 8000):
+        self.c = client
+        self.http = http
+        self.engine_port = engine_port
+
+    async def _ready_pods(self, base_model: str) -> list[dict]:
+        from .resources import label_safe
+
+        pods = await self.c.list(
+            self.c.pods(), label_selector=f"model={label_safe(base_model)}"
+        )
+        out = []
+        for p in pods:
+            conds = {
+                c["type"]: c["status"]
+                for c in p.get("status", {}).get("conditions", [])
+            }
+            if conds.get("Ready") == "True" and p["status"].get("podIP"):
+                out.append(p)
+        return out
+
+    def _engine_url(self, pod: dict) -> str:
+        """Data-plane URL of an engine pod (tests override to point at
+        loopback TestServers)."""
+        return f"http://{pod['status']['podIP']}:{self.engine_port}"
+
+    async def _registrations(self, url: str) -> set[str]:
+        """Adapters live on one engine, from its /v1/models (the reference
+        reconciles against exactly this output, :613-693)."""
+        import asyncio
+        import json
+
+        try:
+            async with self.http.get(url + "/v1/models") as resp:
+                data = await resp.json()
+            return {
+                m["id"] for m in data.get("data", [])
+                if m.get("parent") is not None
+            }
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                json.JSONDecodeError, KeyError, TypeError) as e:
+            logger.warning("reading /v1/models from %s failed: %s", url, e)
+            return set()
+
+    async def reconcile(self, cr: dict) -> None:
+        name = cr["metadata"]["name"]
+        spec = cr["spec"]
+        adapter_name = spec["adapterSource"].get("adapterName") or name
+        path = spec["adapterSource"].get("adapterPath", "")
+        pods = await self._ready_pods(spec["baseModel"])
+        placement = spec.get("placement", {})
+        want_n = placement.get("replicas") or len(pods)
+        targets = sorted(pods, key=lambda p: p["metadata"]["name"])[:want_n]
+        target_names = {p["metadata"]["name"] for p in targets}
+
+        loaded: list[dict] = []
+        for pod in pods:
+            ip = pod["status"]["podIP"]
+            is_target = pod["metadata"]["name"] in target_names
+            url = self._engine_url(pod)
+            regs = await self._registrations(url)
+            if is_target and adapter_name not in regs:
+                try:
+                    async with self.http.post(
+                        url + "/v1/load_lora_adapter",
+                        json={"lora_name": adapter_name, "lora_path": path},
+                    ) as resp:
+                        if resp.status == 200:
+                            regs.add(adapter_name)
+                        else:
+                            logger.warning(
+                                "load %s on %s: HTTP %d", adapter_name, url,
+                                resp.status,
+                            )
+                except aiohttp.ClientError as e:
+                    logger.warning("load %s on %s failed: %s",
+                                   adapter_name, url, e)
+            elif not is_target and adapter_name in regs:
+                try:
+                    async with self.http.post(
+                        url + "/v1/unload_lora_adapter",
+                        json={"lora_name": adapter_name},
+                    ) as resp:
+                        if resp.status == 200:
+                            regs.discard(adapter_name)
+                except aiohttp.ClientError:
+                    pass
+            if adapter_name in regs:
+                loaded.append({
+                    "pod": pod["metadata"]["name"], "podIP": ip,
+                })
+        requested = placement.get("replicas") or len(pods)
+        if not pods:
+            phase = "Pending"  # no ready base-model pods to load onto
+        elif loaded and len(loaded) >= requested:
+            phase = "Loaded"
+        else:
+            phase = "Loading"
+        await self.c.patch_status(self.c.crs(self.plural, name), {
+            "loadedAdapters": loaded,
+            "phase": phase,
+        })

@@ -1,0 +1,155 @@
+"""Minimal Kubernetes REST client (aiohttp, no external k8s SDK).
+
+The reference operator is Go/kubebuilder on controller-runtime; this image
+has no Go toolchain and no kubernetes python package, so the operator talks
+to the API server over its plain REST surface directly — which also makes it
+trivially testable against an in-process fake API server (the envtest
+strategy the reference uses, suite_test.go:52-60, without the binary).
+
+In-cluster config: service-account token + CA from the standard paths;
+tests construct the client with an explicit base_url.
+"""
+
+from __future__ import annotations
+
+import os
+import ssl
+
+import aiohttp
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"K8s API {status}: {body[:200]}")
+        self.status = status
+
+
+class K8sClient:
+    def __init__(self, base_url: str | None = None, token: str | None = None,
+                 namespace: str = "default", ssl_ctx=None):
+        if base_url is None:  # in-cluster
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            base_url = f"https://{host}:{port}"
+            with open(f"{SA_DIR}/token") as f:
+                token = f.read().strip()
+            with open(f"{SA_DIR}/namespace") as f:
+                namespace = f.read().strip()
+            ssl_ctx = ssl.create_default_context(cafile=f"{SA_DIR}/ca.crt")
+        self.base_url = base_url.rstrip("/")
+        self.namespace = namespace
+        self._token = token
+        self._ssl = ssl_ctx
+        self._session: aiohttp.ClientSession | None = None
+
+    def _sess(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            headers = {}
+            if self._token:
+                headers["Authorization"] = f"Bearer {self._token}"
+            self._session = aiohttp.ClientSession(
+                headers=headers, timeout=aiohttp.ClientTimeout(total=30)
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def _request(self, method: str, path: str, body=None,
+                       content_type: str = "application/json"):
+        kwargs: dict = {"ssl": self._ssl}
+        if body is not None:
+            kwargs["json"] = body
+            kwargs["headers"] = {"Content-Type": content_type}
+        async with self._sess().request(
+            method, self.base_url + path, **kwargs
+        ) as resp:
+            if resp.status == 404:
+                return None
+            if resp.status >= 400:
+                raise ApiError(resp.status, await resp.text())
+            return await resp.json()
+
+    # -- typed paths -------------------------------------------------------
+
+    def _core(self, kind_plural: str, name: str = "") -> str:
+        p = f"/api/v1/namespaces/{self.namespace}/{kind_plural}"
+        return f"{p}/{name}" if name else p
+
+    def _apps(self, kind_plural: str, name: str = "") -> str:
+        p = f"/apis/apps/v1/namespaces/{self.namespace}/{kind_plural}"
+        return f"{p}/{name}" if name else p
+
+    def _crd(self, plural: str, name: str = "") -> str:
+        p = (
+            f"/apis/production-stack.tpu.ai/v1alpha1/namespaces/"
+            f"{self.namespace}/{plural}"
+        )
+        return f"{p}/{name}" if name else p
+
+    # -- operations --------------------------------------------------------
+
+    async def get(self, path: str):
+        return await self._request("GET", path)
+
+    async def list(self, path: str, label_selector: str | None = None):
+        if label_selector:
+            from urllib.parse import quote
+
+            path = f"{path}?labelSelector={quote(label_selector)}"
+        out = await self._request("GET", path)
+        return (out or {}).get("items", [])
+
+    async def create(self, path: str, obj: dict):
+        return await self._request("POST", path, obj)
+
+    async def replace(self, path: str, obj: dict):
+        return await self._request("PUT", path, obj)
+
+    async def delete(self, path: str):
+        return await self._request("DELETE", path)
+
+    async def patch_status(self, path: str, status: dict):
+        return await self._request(
+            "PATCH", path + "/status", {"status": status},
+            content_type="application/merge-patch+json",
+        )
+
+    async def apply(self, path_fn, obj: dict) -> dict:
+        """Create-or-replace by name (server-side apply equivalent for the
+        few object kinds the operator manages)."""
+        name = obj["metadata"]["name"]
+        existing = await self.get(path_fn(name))
+        if existing is None:
+            return await self.create(path_fn(""), obj) or obj
+        obj = {**obj}
+        obj["metadata"] = {
+            **obj["metadata"],
+            "resourceVersion": existing["metadata"].get("resourceVersion"),
+        }
+        if obj.get("kind") == "Service":
+            # clusterIP(s) are apiserver-assigned and immutable: a replace
+            # that omits them is a 422 on a real apiserver
+            for field in ("clusterIP", "clusterIPs"):
+                if field in existing.get("spec", {}):
+                    obj.setdefault("spec", {})[field] = existing["spec"][field]
+        return await self.replace(path_fn(name), obj) or obj
+
+    # convenience bound path builders
+    def deployments(self, name: str = "") -> str:
+        return self._apps("deployments", name)
+
+    def services(self, name: str = "") -> str:
+        return self._core("services", name)
+
+    def pvcs(self, name: str = "") -> str:
+        return self._core("persistentvolumeclaims", name)
+
+    def pods(self, name: str = "") -> str:
+        return self._core("pods", name)
+
+    def crs(self, plural: str, name: str = "") -> str:
+        return self._crd(plural, name)

@@ -1,0 +1,112 @@
+"""Operator manager: periodic reconcile loops over the four CRDs.
+
+The reference uses controller-runtime's watch-driven manager with leader
+election (operator/cmd/main.go:58-266); this manager polls CR lists on an
+interval — level-triggered reconciliation gives the same convergence
+guarantees at small-cluster scale without a watch cache, and keeps the
+operator runnable against any API server the minimal REST client can reach.
+
+Run (in-cluster): python -m vllm_production_stack_tpu.operator.manager
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+import aiohttp
+
+from ..utils.logging import init_logger
+from .controllers import (
+    CacheServerReconciler,
+    LoraAdapterReconciler,
+    TPURouterReconciler,
+    TPURuntimeReconciler,
+)
+from .k8s_client import K8sClient
+
+logger = init_logger(__name__)
+
+
+class OperatorManager:
+    def __init__(self, client: K8sClient, engine_port: int = 8000):
+        self.c = client
+        self._engine_port = engine_port
+        self._http: aiohttp.ClientSession | None = None
+        self._reconcilers: list | None = None
+
+    @property
+    def http(self) -> aiohttp.ClientSession:
+        # lazy: ClientSession needs a running event loop, and main()
+        # constructs the manager before asyncio.run()
+        if self._http is None or self._http.closed:
+            self._http = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=15)
+            )
+        return self._http
+
+    @property
+    def reconcilers(self) -> list:
+        if self._reconcilers is None:
+            self._reconcilers = [
+                TPURuntimeReconciler(self.c),
+                TPURouterReconciler(self.c),
+                CacheServerReconciler(self.c),
+                LoraAdapterReconciler(self.c, self.http, self._engine_port),
+            ]
+        return self._reconcilers
+
+    async def reconcile_all(self) -> int:
+        """One pass over every CR of every kind; returns CRs reconciled.
+        Errors are per-CR: one bad object must not wedge the others."""
+        n = 0
+        for rec in self.reconcilers:
+            try:
+                crs = await self.c.list(self.c.crs(rec.plural))
+            except Exception as e:
+                logger.warning("listing %s failed: %s", rec.plural, e)
+                continue
+            for cr in crs:
+                try:
+                    await rec.reconcile(cr)
+                    n += 1
+                except Exception:
+                    logger.exception(
+                        "reconcile %s/%s failed", rec.plural,
+                        cr["metadata"]["name"],
+                    )
+        return n
+
+    async def run(self, interval_s: float = 10.0) -> None:
+        logger.info("operator manager started (interval %.0fs)", interval_s)
+        try:
+            while True:
+                await self.reconcile_all()
+                await asyncio.sleep(interval_s)
+        finally:
+            await self.close()
+
+    async def close(self) -> None:
+        if self._http is not None and not self._http.closed:
+            await self._http.close()
+        await self.c.close()
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="TPU stack operator")
+    p.add_argument("--interval", type=float, default=10.0)
+    p.add_argument("--engine-port", type=int, default=8000)
+    p.add_argument("--api-server", default=None,
+                   help="API server URL (default: in-cluster config)")
+    p.add_argument("--namespace", default="default")
+    args = p.parse_args(argv)
+    client = (
+        K8sClient(args.api_server, namespace=args.namespace)
+        if args.api_server
+        else K8sClient()
+    )
+    asyncio.run(OperatorManager(client, args.engine_port).run(args.interval))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,279 @@
+"""Desired-state builders: CR spec → Kubernetes objects.
+
+The reference's equivalents are deploymentForVLLMRuntime
+(vllmruntime_controller.go:190-523 — builds the full `vllm serve` arg list
+and LMCache env) and the router/cacheserver builders; here the args target
+the TPU engine/router CLIs and google.com/tpu resources.
+"""
+
+from __future__ import annotations
+
+import re
+
+
+def label_safe(value: str) -> str:
+    """Kubernetes label values: [A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?,
+    <= 63 chars. Served-model names like 'org/model' need sanitizing."""
+    v = re.sub(r"[^A-Za-z0-9_.-]", "-", value)[:63]
+    return v.strip("-_.") or "model"
+
+
+def _meta(name: str, owner: dict, extra_labels: dict | None = None) -> dict:
+    labels = {
+        "app.kubernetes.io/part-of": "tpu-production-stack",
+        "app.kubernetes.io/managed-by": "tpu-stack-operator",
+        **(extra_labels or {}),
+    }
+    return {
+        "name": name,
+        "labels": labels,
+        "ownerReferences": [{
+            "apiVersion": owner["apiVersion"],
+            "kind": owner["kind"],
+            "name": owner["metadata"]["name"],
+            "uid": owner["metadata"].get("uid", ""),
+            "controller": True,
+        }],
+    }
+
+
+def engine_args(spec: dict) -> list[str]:
+    """TPURuntime spec → engine server argv (reference builds `vllm serve`
+    args the same way, vllmruntime_controller.go:228-286)."""
+    model = spec.get("model", {})
+    tpu = spec.get("tpuConfig", {})
+    args = [
+        "-m", "vllm_production_stack_tpu.engine.server",
+        "--model", model.get("modelURL", "tiny-llama"),
+        "--port", str(tpu.get("port", 8000)),
+    ]
+    if model.get("servedModelName"):
+        args += ["--served-model-name", model["servedModelName"]]
+    if model.get("maxModelLen"):
+        args += ["--max-model-len", str(model["maxModelLen"])]
+    if model.get("dtype"):
+        args += ["--dtype", model["dtype"]]
+    if tpu.get("tensorParallelSize"):
+        args += ["--tensor-parallel-size", str(tpu["tensorParallelSize"])]
+    if tpu.get("maxNumSeqs"):
+        args += ["--max-num-seqs", str(tpu["maxNumSeqs"])]
+    if tpu.get("maxLoras"):
+        args += ["--max-loras", str(tpu["maxLoras"])]
+    if tpu.get("numHostBlocks"):
+        args += ["--num-host-blocks", str(tpu["numHostBlocks"])]
+    if tpu.get("enablePrefixCaching") is False:
+        args += ["--no-enable-prefix-caching"]
+    args += [str(a) for a in tpu.get("extraArgs", [])]
+    return args
+
+
+def deployment_for_runtime(cr: dict) -> dict:
+    spec = cr["spec"]
+    name = cr["metadata"]["name"]
+    tpu = spec.get("tpuConfig", {})
+    image = spec.get("image", {})
+    model_label = spec.get("modelLabel", "")
+    pod_labels = {
+        "app": "tpu-stack-engine",
+        "model": label_safe(
+            spec.get("model", {}).get("servedModelName", name)
+        ),
+        "tpuruntime": name,
+    }
+    if model_label:
+        pod_labels["model-label"] = model_label
+
+    container: dict = {
+        "name": "engine",
+        "image": f"{image.get('repository', 'tpu-stack-engine')}:"
+                 f"{image.get('tag', 'latest')}",
+        "command": ["python"],
+        "args": engine_args(spec),
+        "ports": [{"containerPort": tpu.get("port", 8000), "name": "http"}],
+        "startupProbe": {
+            "httpGet": {"path": "/health", "port": "http"},
+            "initialDelaySeconds": 30, "periodSeconds": 10,
+            "failureThreshold": 120,
+        },
+        "livenessProbe": {
+            "httpGet": {"path": "/health", "port": "http"},
+            "periodSeconds": 10, "failureThreshold": 3,
+        },
+    }
+    env = list(tpu.get("env", []))
+    hf_secret = spec.get("model", {}).get("hfTokenSecret")
+    if hf_secret:
+        env.append({
+            "name": "HF_TOKEN",
+            "valueFrom": {"secretKeyRef": {"name": hf_secret, "key": "token"}},
+        })
+    kv = spec.get("kvTransferConfig", {})
+    if kv.get("kvControllerURL"):
+        # the engine self-registers with the KV controller at startup
+        # (engine/server.py reads these — the LMCACHE_CONTROLLER_URL
+        # equivalent, deployment-vllm-multi.yaml:324-339)
+        env.append({"name": "KV_CONTROLLER_URL",
+                    "value": kv["kvControllerURL"]})
+        env.append({"name": "POD_IP", "valueFrom": {
+            "fieldRef": {"fieldPath": "status.podIP"}}})
+        env.append({"name": "ENGINE_PORT", "value": str(tpu.get("port", 8000))})
+    if env:
+        container["env"] = env
+
+    resources = dict(spec.get("resources", {}))
+    if tpu.get("requestTPU"):
+        n = str(tpu["requestTPU"])
+        resources.setdefault("requests", {})["google.com/tpu"] = n
+        resources.setdefault("limits", {})["google.com/tpu"] = n
+    if resources:
+        container["resources"] = resources
+
+    pod_spec: dict = {"containers": [container]}
+    if tpu.get("tpuAccelerator"):
+        sel = {"cloud.google.com/gke-tpu-accelerator": tpu["tpuAccelerator"]}
+        if tpu.get("tpuTopology"):
+            sel["cloud.google.com/gke-tpu-topology"] = tpu["tpuTopology"]
+        pod_spec["nodeSelector"] = sel
+    if spec.get("storage", {}).get("pvcStorage"):
+        container["volumeMounts"] = [{"name": "weights", "mountPath": "/data"}]
+        pod_spec["volumes"] = [{
+            "name": "weights",
+            "persistentVolumeClaim": {"claimName": f"{name}-pvc"},
+        }]
+
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta(f"{name}-engine", cr, pod_labels),
+        "spec": {
+            "replicas": spec.get("replicas", 1),
+            "selector": {"matchLabels": {"tpuruntime": name}},
+            "template": {"metadata": {"labels": pod_labels},
+                         "spec": pod_spec},
+        },
+    }
+
+
+def service_for_runtime(cr: dict) -> dict:
+    name = cr["metadata"]["name"]
+    port = cr["spec"].get("tpuConfig", {}).get("port", 8000)
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(f"{name}-service", cr),
+        "spec": {
+            "selector": {"tpuruntime": name},
+            "ports": [{"port": port, "targetPort": port, "name": "http"}],
+        },
+    }
+
+
+def pvc_for_runtime(cr: dict) -> dict | None:
+    storage = cr["spec"].get("storage", {})
+    if not storage.get("pvcStorage"):
+        return None
+    name = cr["metadata"]["name"]
+    spec: dict = {
+        "accessModes": ["ReadWriteOnce"],
+        "resources": {"requests": {"storage": storage["pvcStorage"]}},
+    }
+    if storage.get("storageClass"):
+        spec["storageClassName"] = storage["storageClass"]
+    return {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": _meta(f"{name}-pvc", cr),
+        "spec": spec,
+    }
+
+
+def router_args(spec: dict) -> list[str]:
+    args = [
+        "-m", "vllm_production_stack_tpu.router.app",
+        "--port", str(spec.get("port", 8000)),
+        "--service-discovery", spec.get("serviceDiscovery", "k8s_pod_ip"),
+        "--routing-logic", spec.get("routingLogic", "roundrobin"),
+    ]
+    if spec.get("serviceDiscovery") == "static":
+        args += ["--static-backends", spec.get("staticBackends", ""),
+                 "--static-models", spec.get("staticModels", "")]
+    elif spec.get("k8sLabelSelector"):
+        args += ["--k8s-label-selector", spec["k8sLabelSelector"]]
+    if spec.get("sessionKey"):
+        args += ["--session-key", spec["sessionKey"]]
+    if spec.get("kvControllerURL"):
+        args += ["--kv-controller-url", spec["kvControllerURL"]]
+    if spec.get("engineScrapeInterval"):
+        args += ["--engine-stats-interval", str(spec["engineScrapeInterval"])]
+    if spec.get("requestStatsWindow"):
+        args += ["--request-stats-window", str(spec["requestStatsWindow"])]
+    args += [str(a) for a in spec.get("extraArgs", [])]
+    return args
+
+
+def deployment_for_router(cr: dict) -> dict:
+    spec = cr["spec"]
+    name = cr["metadata"]["name"]
+    image = spec.get("image", {})
+    labels = {"app": f"{name}-router"}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta(f"{name}-router", cr, labels),
+        "spec": {
+            "replicas": spec.get("replicas", 1),
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {"containers": [{
+                    "name": "router",
+                    "image": f"{image.get('repository', 'tpu-stack-router')}:"
+                             f"{image.get('tag', 'latest')}",
+                    "command": ["python"],
+                    "args": router_args(spec),
+                    "ports": [{
+                        "containerPort": spec.get("port", 8000),
+                        "name": "http",
+                    }],
+                    "livenessProbe": {
+                        "httpGet": {"path": "/health", "port": "http"},
+                        "periodSeconds": 10,
+                    },
+                }]},
+            },
+        },
+    }
+
+
+def deployment_for_cacheserver(cr: dict) -> dict:
+    spec = cr["spec"]
+    name = cr["metadata"]["name"]
+    image = spec.get("image", {})
+    labels = {"app": f"{name}-kv-controller"}
+    args = ["-m", "vllm_production_stack_tpu.engine.kv_controller",
+            "--port", str(spec.get("port", 9000))]
+    if spec.get("engines"):
+        args += ["--engines", ",".join(spec["engines"])]
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta(f"{name}-kv-controller", cr, labels),
+        "spec": {
+            "replicas": spec.get("replicas", 1),
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {"containers": [{
+                    "name": "kv-controller",
+                    "image": f"{image.get('repository', 'tpu-stack-router')}:"
+                             f"{image.get('tag', 'latest')}",
+                    "command": ["python"],
+                    "args": args,
+                    "ports": [{
+                        "containerPort": spec.get("port", 9000),
+                        "name": "http",
+                    }],
+                }]},
+            },
+        },
+    }
