@@ -122,3 +122,43 @@ def test_engine_e2e_on_dp_tp_mesh():
     single = build(tp=1, dp=1).generate(prompts, sampling)
     for a, b in zip(sharded, single):
         assert a["token_ids"] == b["token_ids"]
+
+
+def test_engine_e2e_on_pp_mesh():
+    """Pipeline stages via GSPMD layer-axis sharding: a (pp=2, tp=2) engine
+    reproduces single-device greedy outputs (VERDICT r1 row 16:
+    pipeline_parallel_size used to be a dead field)."""
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    cfg = ModelConfig.tiny(num_heads=4, num_kv_heads=2, num_layers=4,
+                           dtype="float32")
+
+    def build(tp, dp, pp):
+        return LLMEngine(
+            EngineConfig(
+                model=cfg,
+                cache=CacheConfig(block_size=8, num_blocks=32),
+                scheduler=SchedulerConfig(
+                    max_num_seqs=2, max_num_batched_tokens=32,
+                    decode_buckets=(2,), prefill_buckets=(16, 32),
+                    decode_window=4,
+                ),
+                parallel=ParallelConfig(
+                    tensor_parallel_size=tp, data_parallel_size=dp,
+                    pipeline_parallel_size=pp,
+                ),
+            ),
+            mesh=mesh_lib.make_mesh(tp, dp, pp),
+        )
+
+    rng = np.random.RandomState(5)
+    prompts = [list(rng.randint(1, cfg.vocab_size, size=9 + i)) for i in range(2)]
+    sampling = SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)
+    pp_out = build(tp=2, dp=1, pp=2).generate(prompts, sampling)
+    ref_out = build(tp=1, dp=1, pp=1).generate(prompts, sampling)
+    for a, b in zip(pp_out, ref_out):
+        assert a["token_ids"] == b["token_ids"]

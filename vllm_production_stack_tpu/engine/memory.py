@@ -27,31 +27,36 @@ def dtype_bytes(dtype: str) -> int:
     return np.dtype(dtype).itemsize
 
 
-def param_bytes(cfg: ModelConfig, tp: int = 1) -> int:
+def param_bytes(cfg: ModelConfig, tp: int = 1, pp: int = 1) -> int:
     """Per-device bytes of the stacked Llama param tree (models/llama.py
-    init_params) under tensor parallelism `tp`."""
+    init_params) under tensor parallelism `tp` and pipeline stages `pp`
+    (per-layer leaves shard their L axis over pp, parallel/sharding.py)."""
     h, hd = cfg.hidden_size, cfg.head_dim
     nh, nkv, it, L = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size, cfg.num_layers
     attn = h * nh * hd + 2 * h * nkv * hd + nh * hd * h
     mlp = 3 * h * it
     norms = 2 * h
+    layers_per_stage = (L + pp - 1) // pp
     per_layer = (attn + mlp) // tp + norms
     embed = cfg.vocab_size * h // tp
     head = 0 if cfg.tie_word_embeddings else h * cfg.vocab_size // tp
-    total = embed + L * per_layer + h + head
+    total = embed + layers_per_stage * per_layer + h + head
     if cfg.attention_bias:
-        total += L * (nh * hd + 2 * nkv * hd) // tp
+        total += layers_per_stage * (nh * hd + 2 * nkv * hd) // tp
     return total * dtype_bytes(cfg.dtype)
 
 
-def kv_block_bytes(cfg: ModelConfig, block_size: int, tp: int = 1) -> int:
-    """Per-device bytes of ONE pool block across all layers (the pool array
-    is (L, 2, num_blocks, block_size, kvH, D), kv heads sharded by tp)."""
+def kv_block_bytes(cfg: ModelConfig, block_size: int, tp: int = 1,
+                   pp: int = 1) -> int:
+    """Per-device bytes of ONE pool block across all layers: kv heads shard
+    over tp and the block axis shards over pp, so a device holds every
+    layer's pages for 1/pp of the blocks — per-device cost of adding a
+    block is therefore 1/pp of its global bytes."""
     kvh = max(1, cfg.num_kv_heads // tp)
-    return (
+    return max(1, (
         cfg.num_layers * 2 * block_size * kvh * cfg.head_dim
         * dtype_bytes(cfg.dtype)
-    )
+    ) // pp)
 
 
 def device_hbm_bytes() -> int:
@@ -82,11 +87,16 @@ def derive_num_blocks(
     so tiny models on big chips don't hold HBM they can never reference."""
     hbm = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
     tp = parallel.tensor_parallel_size
-    budget = int(hbm * cache.hbm_utilization) - param_bytes(model, tp) - RESERVE_BYTES
-    per_block = kv_block_bytes(model, cache.block_size, tp)
+    pp = parallel.pipeline_parallel_size
+    budget = (
+        int(hbm * cache.hbm_utilization)
+        - param_bytes(model, tp, pp)
+        - RESERVE_BYTES
+    )
+    per_block = kv_block_bytes(model, cache.block_size, tp, pp)
     if budget < 2 * per_block:
         raise ValueError(
-            f"model weights ({param_bytes(model, tp) / 1024**3:.2f} GiB/device) "
+            f"model weights ({param_bytes(model, tp, pp) / 1024**3:.2f} GiB/device) "
             f"+ reserve leave no room for a KV pool in "
             f"{cache.hbm_utilization:.0%} of {hbm / 1024**3:.2f} GiB HBM — "
             f"raise hbm_utilization, shard wider (tp={tp}), or shrink the model"
@@ -97,12 +107,15 @@ def derive_num_blocks(
         over = PREFIX_CACHE_OVERPROVISION if cache.enable_prefix_caching else 1
         # +1: block 0 is the reserved null page, not usable capacity
         n = min(n, over * max_num_seqs * per_seq + 1)
+    if pp > 1:
+        # the pool's block axis shards over pp stages — keep it divisible
+        n = max(pp, (n // pp) * pp)
     logger.info(
         "KV pool: %d blocks of %d tokens (%.2f GiB of %.2f GiB HBM; weights %.2f GiB)",
         n,
         cache.block_size,
         n * per_block / 1024**3,
         hbm / 1024**3,
-        param_bytes(model, tp) / 1024**3,
+        param_bytes(model, tp, pp) / 1024**3,
     )
     return int(n)

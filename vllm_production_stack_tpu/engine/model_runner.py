@@ -49,8 +49,26 @@ class ModelRunner:
     ):
         self.config = config
         cfg = config.model
+        pp = config.parallel.pipeline_parallel_size
+        if pp > 1:
+            # friendly errors beat JAX's deep 'dimension not divisible'
+            if cfg.num_layers % pp:
+                raise ValueError(
+                    f"num_layers={cfg.num_layers} must be divisible by "
+                    f"pipeline_parallel_size={pp} (layer axis shards over "
+                    "pp stages)"
+                )
+            if config.cache.num_blocks and config.cache.num_blocks % pp:
+                raise ValueError(
+                    f"num_blocks={config.cache.num_blocks} must be divisible "
+                    f"by pipeline_parallel_size={pp} (the pool's block axis "
+                    "shards over pp stages); round it or leave num_blocks "
+                    "unset to derive from HBM"
+                )
         self.mesh = mesh or mesh_lib.make_mesh(
-            config.parallel.tensor_parallel_size, config.parallel.data_parallel_size
+            config.parallel.tensor_parallel_size,
+            config.parallel.data_parallel_size,
+            config.parallel.pipeline_parallel_size,
         )
         self.max_blocks = config.cache.max_blocks_per_seq(cfg.max_model_len)
 

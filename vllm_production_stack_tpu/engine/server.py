@@ -544,6 +544,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-num-seqs", type=int, default=64)
     p.add_argument("--max-num-batched-tokens", type=int, default=512)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--pipeline-parallel-size", type=int, default=1,
+                   help="GSPMD stage sharding of the layer axis (multi-host)")
+    p.add_argument("--data-parallel-size", type=int, default=1)
     p.add_argument("--enable-prefix-caching", action="store_true", default=True)
     p.add_argument("--no-enable-prefix-caching", dest="enable_prefix_caching",
                    action="store_false")
@@ -581,7 +584,11 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             decode_buckets=decode_buckets,
             prefill_buckets=prefill_buckets,
         ),
-        parallel=ParallelConfig(tensor_parallel_size=args.tensor_parallel_size),
+        parallel=ParallelConfig(
+            tensor_parallel_size=args.tensor_parallel_size,
+            data_parallel_size=args.data_parallel_size,
+            pipeline_parallel_size=args.pipeline_parallel_size,
+        ),
         lora=LoRAConfig(
             max_loras=args.max_loras, max_lora_rank=args.max_lora_rank
         ),

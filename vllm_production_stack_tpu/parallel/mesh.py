@@ -25,23 +25,30 @@ PP_AXIS = "pp"
 def make_mesh(
     tensor_parallel_size: int = 1,
     data_parallel_size: int = 1,
+    pipeline_parallel_size: int = 1,
     devices: list | None = None,
 ) -> Mesh:
-    """Build a (dp, tp) mesh over the available devices.
+    """Build a (dp, pp, tp) mesh over the available devices.
 
     TP is the innermost axis so that its collectives map onto
     nearest-neighbour ICI links (the same reason the reference pins TP within
-    a node via /dev/shm + NVLink, deployment-vllm-multi.yaml:424-431).
+    a node via /dev/shm + NVLink, deployment-vllm-multi.yaml:424-431); pp
+    sits between dp and tp so each stage is a contiguous tp group — on
+    multi-host deployments stage boundaries are the host/DCN boundaries
+    (the RayCluster replacement, ray-cluster.yaml:556-566).
     """
     devices = list(jax.devices()) if devices is None else list(devices)
-    want = tensor_parallel_size * data_parallel_size
+    want = tensor_parallel_size * data_parallel_size * pipeline_parallel_size
     if want > len(devices):
         raise ValueError(
             f"mesh needs {want} devices (tp={tensor_parallel_size} x "
-            f"dp={data_parallel_size}) but only {len(devices)} available"
+            f"dp={data_parallel_size} x pp={pipeline_parallel_size}) "
+            f"but only {len(devices)} available"
         )
-    grid = np.array(devices[:want]).reshape(data_parallel_size, tensor_parallel_size)
-    return Mesh(grid, (DP_AXIS, TP_AXIS))
+    grid = np.array(devices[:want]).reshape(
+        data_parallel_size, pipeline_parallel_size, tensor_parallel_size
+    )
+    return Mesh(grid, (DP_AXIS, PP_AXIS, TP_AXIS))
 
 
 def single_device_mesh() -> Mesh:

@@ -19,34 +19,45 @@ from __future__ import annotations
 from jax.sharding import PartitionSpec as P
 
 from ..engine.config import ModelConfig
-from .mesh import DP_AXIS, TP_AXIS
+from .mesh import DP_AXIS, PP_AXIS, TP_AXIS
 
 
 def llama_param_specs(cfg: ModelConfig) -> dict:
     """PartitionSpec tree structurally matching init_params(cfg)'s tree
     (optional leaves — attention biases, untied lm_head — included only when
-    the config produces them)."""
+    the config produces them).
+
+    Pipeline parallelism is GSPMD stage sharding: every per-layer leaf's
+    leading L axis is sharded over the pp mesh axis, so each stage holds a
+    contiguous block of layers and XLA moves the activations between stages
+    (the TPU replacement for the reference's Ray-deployed
+    --pipeline-parallel-size, ray-cluster.yaml:556-566). On a pp=1 mesh the
+    axis is size 1 and the specs degrade to pure tp."""
     attn = {
-        # [L, hidden, heads*head_dim] — shard output (head) axis
-        "wq": P(None, None, TP_AXIS),
-        "wk": P(None, None, TP_AXIS),
-        "wv": P(None, None, TP_AXIS),
+        # [L, hidden, heads*head_dim] — L over pp stages, head axis over tp
+        "wq": P(PP_AXIS, None, TP_AXIS),
+        "wk": P(PP_AXIS, None, TP_AXIS),
+        "wv": P(PP_AXIS, None, TP_AXIS),
         # [L, heads*head_dim, hidden] — shard input (head) axis; psum after
-        "wo": P(None, TP_AXIS, None),
+        "wo": P(PP_AXIS, TP_AXIS, None),
     }
     if cfg.attention_bias:
-        attn |= {"bq": P(None, TP_AXIS), "bk": P(None, TP_AXIS), "bv": P(None, TP_AXIS)}
+        attn |= {
+            "bq": P(PP_AXIS, TP_AXIS),
+            "bk": P(PP_AXIS, TP_AXIS),
+            "bv": P(PP_AXIS, TP_AXIS),
+        }
     specs = {
         "embed": P(TP_AXIS, None),  # [vocab, hidden] vocab-sharded
         "layers": {
             "attn": attn,
             "mlp": {
-                "gate": P(None, None, TP_AXIS),  # [L, hidden, inter]
-                "up": P(None, None, TP_AXIS),
-                "down": P(None, TP_AXIS, None),  # [L, inter, hidden]
+                "gate": P(PP_AXIS, None, TP_AXIS),  # [L, hidden, inter]
+                "up": P(PP_AXIS, None, TP_AXIS),
+                "down": P(PP_AXIS, TP_AXIS, None),  # [L, inter, hidden]
             },
-            "input_norm": P(None, None),
-            "post_attn_norm": P(None, None),
+            "input_norm": P(PP_AXIS, None),
+            "post_attn_norm": P(PP_AXIS, None),
         },
         "final_norm": P(None),
     }
@@ -63,28 +74,32 @@ def lora_param_specs(cfg: ModelConfig, lora_cfg) -> dict:
 
     row_parallel = {"o_proj", "down_proj"}
     # same module filter as init_lora_params, so the spec tree and the param
-    # tree can never diverge structurally
+    # tree can never diverge structurally; L (axis 1) shards over pp stages
+    # alongside the base weights
     names = [m for m in lora_cfg.target_modules if m in lora_module_dims(cfg)]
     specs: dict = {"scale": P()}
     for name in names:
         if name in row_parallel:
             specs[name] = {
-                "A": P(None, None, TP_AXIS, None),  # (n, L, in, r)
-                "B": P(None, None, None, None),  # (n, L, r, out)
+                "A": P(None, PP_AXIS, TP_AXIS, None),  # (n, L, in, r)
+                "B": P(None, PP_AXIS, None, None),  # (n, L, r, out)
             }
         else:
             specs[name] = {
-                "A": P(None, None, None, None),
-                "B": P(None, None, None, TP_AXIS),
+                "A": P(None, PP_AXIS, None, None),
+                "B": P(None, PP_AXIS, None, TP_AXIS),
             }
     return specs
 
 
 def kv_cache_spec() -> P:
-    """Per-layer leaf [2, num_blocks, block_size, kv_heads, head_dim] — shard
-    kv heads. Applies to every leaf of the per-layer KV tuple (jit/`device_put`
-    treat a single spec as a pytree prefix)."""
-    return P(None, None, None, TP_AXIS, None)
+    """Per-layer leaf [2, num_blocks, block_size, kv_heads, head_dim] — kv
+    heads shard over tp; the block axis shards over pp so each stage holds
+    1/pp of the pool (per-layer leaves can't be placed per-stage with one
+    prefix spec, but block-sharding splits the memory the same way).
+    Applies to every leaf of the per-layer KV tuple (jit/`device_put` treat
+    a single spec as a pytree prefix)."""
+    return P(None, PP_AXIS, None, TP_AXIS, None)
 
 
 def decode_tokens_spec() -> P:
