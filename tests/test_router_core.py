@@ -329,3 +329,33 @@ def test_parser_model_count_mismatch():
                 "--static-models", "m1",
             ]
         )
+
+
+def test_tracing_is_soft_dependency():
+    """--sentry-dsn / OTLP endpoint without the SDKs must no-op, never crash
+    (reference inits Sentry unconditionally when configured, app.py:123-130;
+    here APM stacks stay optional)."""
+    import os
+    from unittest import mock
+
+    import builtins
+
+    from vllm_production_stack_tpu.router.tracing import init_otel, init_sentry
+
+    assert init_sentry(None) is False
+
+    real_import = builtins.__import__
+
+    def no_apm(name, *a, **kw):
+        if name.startswith(("sentry_sdk", "opentelemetry")):
+            raise ImportError(name)
+        return real_import(name, *a, **kw)
+
+    # simulate SDK absence regardless of what this image has installed
+    with mock.patch.object(builtins, "__import__", side_effect=no_apm):
+        assert init_sentry("https://key@sentry.example/1") is False
+        with mock.patch.dict(
+            os.environ, {"OTEL_EXPORTER_OTLP_ENDPOINT": "http://otel:4317"}
+        ):
+            assert init_otel() is False
+    assert init_otel() is False  # unset endpoint

@@ -1,0 +1,89 @@
+"""Concurrency stress: the async engine under parallel submissions, aborts,
+disconnects, and control-plane calls.
+
+The reference has no sanitizers in-repo; SURVEY §5 calls threading stress
+tests the cheap win for a stack whose safety is lock-by-construction. The
+engine's step thread + executor submissions + abort reaping all contend on
+one lock — this pins that nothing deadlocks, leaks requests, or loses KV
+blocks under churn.
+"""
+
+import asyncio
+
+import numpy as np
+from aiohttp.test_utils import TestClient, TestServer
+
+from vllm_production_stack_tpu.engine.config import (
+    CacheConfig,
+    EngineConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from vllm_production_stack_tpu.engine.engine import LLMEngine
+from vllm_production_stack_tpu.engine.server import EngineServer
+
+
+def _server():
+    return EngineServer(LLMEngine(EngineConfig(
+        model=ModelConfig.tiny(),
+        cache=CacheConfig(block_size=8, num_blocks=128),
+        scheduler=SchedulerConfig(
+            max_num_seqs=8, max_num_batched_tokens=64,
+            decode_buckets=(8,), prefill_buckets=(32, 64), decode_window=4,
+        ),
+    )), served_model_name="tiny-llama")
+
+
+def test_concurrent_streams_aborts_and_control_plane():
+    srv = _server()
+
+    async def go():
+        client = TestClient(TestServer(srv.build_app()))
+        await client.start_server()
+        rng = np.random.RandomState(0)
+
+        async def stream_one(i: int, cancel: bool):
+            prompt = [int(x) for x in rng.randint(1, 500, size=6 + i % 9)]
+            resp = await client.post("/v1/completions", json={
+                "model": "tiny-llama", "prompt": prompt,
+                "max_tokens": 12, "temperature": 0.5, "seed": i,
+                "stream": True,
+            })
+            assert resp.status == 200
+            seen = 0
+            async for line in resp.content:
+                if line.startswith(b"data: "):
+                    seen += 1
+                if cancel and seen >= 2:
+                    resp.close()  # client disconnect mid-stream
+                    return "cancelled"
+            return "done"
+
+        async def poke_control(n: int):
+            for _ in range(n):
+                r = await client.get("/metrics")
+                assert r.status == 200
+                r = await client.post("/kv/lookup", json={"text": "probe"})
+                assert r.status == 200
+                await asyncio.sleep(0.01)
+            return "control"
+
+        results = await asyncio.gather(
+            *[stream_one(i, cancel=i % 3 == 0) for i in range(12)],
+            poke_control(10),
+        )
+        assert results.count("done") == 8
+        assert results.count("cancelled") == 4
+
+        # engine drained: no leaked requests, every block reclaimed
+        for _ in range(200):
+            if not srv.engine.has_unfinished():
+                break
+            await asyncio.sleep(0.05)
+        assert not srv.engine.has_unfinished()
+        pool = srv.engine.scheduler.pool
+        assert pool.num_free == pool.num_usable  # all blocks back
+        assert (await client.get("/health")).status == 200
+        await client.close()
+
+    asyncio.run(go())

@@ -94,7 +94,9 @@ def derive_num_blocks(
         - RESERVE_BYTES
     )
     per_block = kv_block_bytes(model, cache.block_size, tp, pp)
-    if budget < 2 * per_block:
+    # pp shards the block axis, so the pool must hold >= pp blocks (and the
+    # pp-divisibility rounding below must never round UP past the budget)
+    if budget < 2 * per_block * max(1, pp):
         raise ValueError(
             f"model weights ({param_bytes(model, tp, pp) / 1024**3:.2f} GiB/device) "
             f"+ reserve leave no room for a KV pool in "
@@ -109,7 +111,9 @@ def derive_num_blocks(
         n = min(n, over * max_num_seqs * per_seq + 1)
     if pp > 1:
         # the pool's block axis shards over pp stages — keep it divisible
-        n = max(pp, (n // pp) * pp)
+        # (round DOWN: the guard above ensures n >= 2*pp, so this never
+        # under-runs the 2-block minimum or overruns the budget)
+        n = (n // pp) * pp
     logger.info(
         "KV pool: %d blocks of %d tokens (%.2f GiB of %.2f GiB HBM; weights %.2f GiB)",
         n,

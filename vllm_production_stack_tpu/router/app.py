@@ -372,6 +372,12 @@ async def _log_stats_loop(state: RouterState, interval: float) -> None:
 
 def main(argv: list[str] | None = None) -> None:
     args = parse_args(argv)
+    from .tracing import init_otel, init_sentry
+
+    # process-global, once: re-init per build_app would stack OTel
+    # providers/export threads (build_app runs per-test in the suite)
+    init_sentry(args.sentry_dsn, args.sentry_traces_sample_rate)
+    init_otel()
     app = build_app(args)
     logger.info(
         "router starting on %s:%d discovery=%s routing=%s",

@@ -85,6 +85,9 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--request-rewriter", default=None, help="module:Class")
     x.add_argument("--feature-gates", default="")
     x.add_argument("--api-key", default=None, help="require this bearer token")
+    x.add_argument("--sentry-dsn", default=None,
+                   help="enable Sentry error reporting (requires sentry-sdk)")
+    x.add_argument("--sentry-traces-sample-rate", type=float, default=0.0)
     x.add_argument("--enable-batch-api", action="store_true")
     x.add_argument("--files-dir", default="/tmp/tpu_router_files")
     x.add_argument("--batch-db", default="/tmp/tpu_router_batch.sqlite")

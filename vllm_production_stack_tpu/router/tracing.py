@@ -1,0 +1,68 @@
+"""Optional tracing/error reporting (reference: Sentry init in app.py:123-130
++ parser.py:341-359; OTel→Jaeger via engine env, tutorial 12).
+
+Both integrations are soft dependencies: if the SDK isn't installed the
+flags log a warning and no-op, so the router never gains a hard dependency
+on an APM stack. Engine-side traces come from the engines themselves (set
+OTEL_EXPORTER_OTLP_ENDPOINT on engine pods — JAX/XLA profiles via xprof are
+the device-level complement, SURVEY §5)."""
+
+from __future__ import annotations
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+def init_sentry(dsn: str | None, traces_sample_rate: float = 0.0,
+                profiles_sample_rate: float = 0.0) -> bool:
+    """Initialize Sentry if a DSN is configured and the SDK is available."""
+    if not dsn:
+        return False
+    try:
+        import sentry_sdk
+    except ImportError:
+        logger.warning(
+            "--sentry-dsn set but sentry-sdk is not installed; "
+            "error reporting disabled"
+        )
+        return False
+    sentry_sdk.init(
+        dsn=dsn,
+        traces_sample_rate=traces_sample_rate,
+        profiles_sample_rate=profiles_sample_rate,
+    )
+    logger.info("sentry initialized (traces %.2f, profiles %.2f)",
+                traces_sample_rate, profiles_sample_rate)
+    return True
+
+
+def init_otel(service_name: str = "tpu-stack-router") -> bool:
+    """Initialize OpenTelemetry trace export if the SDK is available and
+    OTEL_EXPORTER_OTLP_ENDPOINT is set (standard OTel env contract)."""
+    import os
+
+    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+    if not endpoint:
+        return False
+    try:
+        from opentelemetry import trace
+        from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+            OTLPSpanExporter,
+        )
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+    except ImportError:
+        logger.warning(
+            "OTEL_EXPORTER_OTLP_ENDPOINT set but the opentelemetry SDK is "
+            "not installed; tracing disabled"
+        )
+        return False
+    provider = TracerProvider(
+        resource=Resource.create({"service.name": service_name})
+    )
+    provider.add_span_processor(BatchSpanProcessor(OTLPSpanExporter()))
+    trace.set_tracer_provider(provider)
+    logger.info("OTLP tracing to %s", endpoint)
+    return True
