@@ -27,6 +27,10 @@ from vllm_production_stack_tpu.models.registry import resolve_model_config
 
 
 def _save_tiny_llama(tmp_path, tie=False):
+    # deterministic weights: downstream assertions compare generations, and
+    # the byte-fallback detokenizer can map unlucky random weights' tokens
+    # to empty strings on both sides of a comparison
+    torch.manual_seed(1234)
     hf_cfg = HFLlamaConfig(
         vocab_size=512, hidden_size=64, intermediate_size=128,
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
