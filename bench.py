@@ -1,7 +1,7 @@
 """Headline benchmark: offline serving throughput of the TPU engine.
 
 Runs the flagship Llama-class engine (llama-1b preset, bf16, random weights —
-zero-egress container) on the real chip: 16 concurrent requests, 128-token
+zero-egress container) on the real chip: 256 concurrent requests, 128-token
 prompts, 128 greedy output tokens each, continuous batching with batched
 chunked prefill over the paged HBM KV pool (sized from HBM utilization).
 
@@ -34,27 +34,31 @@ def main() -> None:
     from vllm_production_stack_tpu.engine.scheduler import PrefillWork
     from vllm_production_stack_tpu.models.registry import resolve_model_config
 
-    n_seqs, prompt_len, gen_len = 16, 128, 128
+    n_seqs, prompt_len, gen_len = 256, 128, 128
     model_cfg = resolve_model_config("llama-1b", max_model_len=1024,
                                      dtype="bfloat16")
     config = EngineConfig(
         model=model_cfg,
-        cache=CacheConfig(block_size=16, num_blocks=None),  # size from HBM
+        cache=CacheConfig(block_size=16, num_blocks=None,
+                          hbm_utilization=0.78),  # size from HBM
         scheduler=SchedulerConfig(
             max_num_seqs=n_seqs,
-            # the whole 16x128 prompt wave fits ONE batched prefill dispatch
+            # the whole 256x128 prompt wave fits ONE batched prefill dispatch
             max_num_batched_tokens=n_seqs * prompt_len,
             decode_buckets=(n_seqs,),
-            prefill_buckets=(256, 1024, n_seqs * prompt_len),
-            # dispatch overhead (~160 ms tunnel RTT) dominates per-token
-            # compute (~4 ms/row-step for 1B): a 64-step fused window
-            # amortizes it across 1024 tokens per dispatch
+            # bucket_for pads each ROW to the smallest bucket >= its chunk
+            # length: the row bucket must sit at prompt_len or the batch
+            # pads 16x (a 2048-only bucket cost 2.4s of a 3.9s run)
+            prefill_buckets=(prompt_len, 2048, n_seqs * prompt_len),
+            # dispatch overhead (~160 ms tunnel RTT) amortizes across
+            # window x batch = 16K tokens per fused decode dispatch
             decode_window=64,
         ),
         parallel=ParallelConfig(tensor_parallel_size=1),
     )
     engine = LLMEngine(config)
-    sampling = SamplingParams(max_tokens=gen_len, temperature=0.0)
+    sampling = SamplingParams(max_tokens=gen_len, temperature=0.0,
+                              ignore_eos=True)
 
     # instrument the runner for a per-phase breakdown
     phase_time = {"prefill": 0.0, "decode": 0.0}

@@ -1,8 +1,11 @@
 """Batched on-device sampling: greedy / temperature / top-k / top-p.
 
-Everything is fixed-shape and branch-free (where-masks instead of Python
-control flow) so it fuses into the jitted decode step — the sampled token ids
-are the only per-step device→host transfer.
+Fixed-shape and jit-fused: the sampled token ids are the only per-step
+device→host transfer. Within the sampling pipeline, per-row variation uses
+where-masks (no Python control flow), but the pipeline as a whole sits
+behind ONE runtime lax.cond — an all-greedy batch (the serving default)
+skips the (B, V) sort + gumbel draw entirely, which at 128K vocab would
+otherwise dwarf the decode step's own FLOPs.
 """
 
 from __future__ import annotations
@@ -47,25 +50,36 @@ def sample(
     b, v = logits.shape
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
-    sorted_desc = -jnp.sort(-scaled, axis=-1)  # (B, V) descending
+    def sampled(_):
+        scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+        sorted_desc = -jnp.sort(-scaled, axis=-1)  # (B, V) descending
 
-    # top-k threshold: the k-th largest logit (k=0 -> keep all)
-    k = jnp.where(top_k > 0, top_k, v).astype(jnp.int32)
-    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # (B,1)
+        # top-k threshold: the k-th largest logit (k=0 -> keep all)
+        k = jnp.where(top_k > 0, top_k, v).astype(jnp.int32)
+        kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
 
-    # top-p threshold: smallest logit whose *exclusive* cumulative prob < p
-    probs = jax.nn.softmax(sorted_desc, axis=-1)
-    cum_excl = jnp.cumsum(probs, axis=-1) - probs
-    keep = cum_excl < top_p[:, None]
-    num_keep = jnp.maximum(jnp.sum(keep, axis=-1), 1)
-    pth = jnp.take_along_axis(sorted_desc, (num_keep - 1)[:, None], axis=-1)
+        # top-p threshold: smallest logit whose *exclusive* cumulative
+        # prob < p
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum_excl = jnp.cumsum(probs, axis=-1) - probs
+        keep = cum_excl < top_p[:, None]
+        num_keep = jnp.maximum(jnp.sum(keep, axis=-1), 1)
+        pth = jnp.take_along_axis(sorted_desc, (num_keep - 1)[:, None], axis=-1)
 
-    thresh = jnp.maximum(kth, pth)
-    masked = jnp.where(scaled >= thresh, scaled, NEG_INF)
+        thresh = jnp.maximum(kth, pth)
+        masked = jnp.where(scaled >= thresh, scaled, NEG_INF)
 
-    keys = _row_keys(base_key, seeds, has_seed, counts)
-    gumbel = jax.vmap(lambda k: jax.random.gumbel(k, (v,), jnp.float32))(keys)
-    sampled_tok = jnp.argmax(masked + gumbel, axis=-1).astype(jnp.int32)
+        keys = _row_keys(base_key, seeds, has_seed, counts)
+        gumbel = jax.vmap(
+            lambda k: jax.random.gumbel(k, (v,), jnp.float32)
+        )(keys)
+        return jnp.argmax(masked + gumbel, axis=-1).astype(jnp.int32)
 
+    # the sampling pipeline sorts (B, V) and draws (B, V) gumbel noise per
+    # step — for a 128K vocab that dwarfs the model's own decode FLOPs. An
+    # all-greedy batch (the common serving default) skips it entirely at
+    # runtime via cond; mixed batches pay it once for the whole batch
+    sampled_tok = jax.lax.cond(
+        jnp.any(temperature != 0.0), sampled, lambda _: greedy_tok, None
+    )
     return jnp.where(temperature == 0.0, greedy_tok, sampled_tok)
