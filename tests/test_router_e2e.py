@@ -487,3 +487,54 @@ def test_files_and_batch_api(tmp_path):
             assert engines[0].total_requests == 3
 
     asyncio.run(go())
+
+
+def test_callbacks_short_circuit_and_rewriter(tmp_path):
+    """Pluggable callbacks (pre_request may short-circuit) + body rewriter
+    run on the proxy path (reference callbacks_service/callbacks.py:23-32,
+    request_service/rewriter.py:29-70)."""
+    import sys
+
+    (tmp_path / "my_hooks.py").write_text(
+        "from aiohttp import web\n"
+        "class CustomCallbackHandler:\n"
+        "    async def pre_request(self, request, body):\n"
+        "        if body.get('block_me'):\n"
+        "            return web.json_response({'blocked': True}, status=403)\n"
+        "        return None\n"
+        "    async def post_request(self, request, response_body):\n"
+        "        pass\n"
+        "class Rewriter:\n"
+        "    def rewrite(self, path, body):\n"
+        "        return {**body, 'max_tokens': min(body.get('max_tokens', 16), 4)}\n"
+    )
+    sys.path.insert(0, str(tmp_path))
+    try:
+        async def go():
+            args = [
+                "--callbacks", "my_hooks",
+                "--request-rewriter", "my_hooks:Rewriter",
+            ]
+            async with router_rig(n_engines=1, router_args=args) as (
+                client, engines, _,
+            ):
+                # callback short-circuits before any engine sees the request
+                r = await client.post(
+                    "/v1/chat/completions",
+                    json={**chat_body(), "block_me": True},
+                )
+                assert r.status == 403
+                assert (await r.json())["blocked"] is True
+                assert engines[0].total_requests == 0
+
+                # rewriter clamps max_tokens before proxying
+                r = await client.post(
+                    "/v1/chat/completions", json=chat_body(max_tokens=99)
+                )
+                assert r.status == 200
+                assert (await r.json())["usage"]["completion_tokens"] == 4
+                assert engines[0].seen_request_log[0]["body"]["max_tokens"] == 4
+
+        asyncio.run(go())
+    finally:
+        sys.path.remove(str(tmp_path))
