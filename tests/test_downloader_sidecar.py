@@ -1,0 +1,109 @@
+"""Download sidecar: the reference's huggingface_downloader equivalent
+(scripts/huggingface_downloader.py, POST /model/download on port 30090)."""
+
+import asyncio
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from vllm_production_stack_tpu.operator.downloader_sidecar import (
+    DownloaderSidecar,
+)
+
+
+def _run(coro_fn, base_dir):
+    async def go():
+        side = DownloaderSidecar(str(base_dir))
+        client = TestClient(TestServer(side.build_app()))
+        await client.start_server()
+        try:
+            return await coro_fn(client, side)
+        finally:
+            await client.close()
+
+    return asyncio.run(go())
+
+
+def test_local_copy_idempotent_and_confined(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "adapter_model.safetensors").write_bytes(b"weights")
+    (src / "adapter_config.json").write_text("{}")
+    base = tmp_path / "pvc"
+
+    async def go(client, side):
+        body = {"source": "local", "path": str(src), "target_dir": "sql-lora"}
+        r1 = await (await client.post("/model/download", json=body)).json()
+        assert r1["status"] == "ok"
+        assert (base / "sql-lora" / "adapter_model.safetensors").read_bytes() \
+            == b"weights"
+        # idempotent: marker short-circuits, mutated source is NOT re-copied
+        (src / "adapter_model.safetensors").write_bytes(b"changed")
+        r2 = await (await client.post("/model/download", json=body)).json()
+        assert r2["local_path"] == r1["local_path"]
+        assert (base / "sql-lora" / "adapter_model.safetensors").read_bytes() \
+            == b"weights"
+        # path traversal rejected
+        r3 = await client.post("/model/download", json={
+            "source": "local", "path": str(src), "target_dir": "../escape",
+        })
+        assert r3.status == 400
+        # health
+        assert (await client.get("/health")).status == 200
+
+    _run(go, base)
+
+
+def test_http_fetch(tmp_path):
+    async def file_handler(request):
+        return web.Response(body=b"adapter-bytes")
+
+    async def go_all():
+        file_app = web.Application()
+        file_app.router.add_get("/files/a.safetensors", file_handler)
+        file_srv = TestServer(file_app)
+        await file_srv.start_server()
+
+        side = DownloaderSidecar(str(tmp_path / "pvc"))
+        client = TestClient(TestServer(side.build_app()))
+        await client.start_server()
+        try:
+            url = f"http://127.0.0.1:{file_srv.port}/files/a.safetensors"
+            r = await (await client.post("/model/download", json={
+                "source": "http", "url": url, "target_dir": "dl",
+            })).json()
+            assert r["status"] == "ok"
+            assert (tmp_path / "pvc" / "dl" / "a.safetensors").read_bytes() \
+                == b"adapter-bytes"
+        finally:
+            await client.close()
+            await file_srv.close()
+
+    asyncio.run(go_all())
+
+
+def test_changed_source_redownloads_and_s3_without_boto3_is_permanent(tmp_path):
+    src1 = tmp_path / "s1"
+    src2 = tmp_path / "s2"
+    for d, content in ((src1, b"v1"), (src2, b"v2")):
+        d.mkdir()
+        (d / "adapter_model.safetensors").write_bytes(content)
+
+    async def go(client, side):
+        body = {"source": "local", "path": str(src1), "target_dir": "ad"}
+        await client.post("/model/download", json=body)
+        # same target_dir, DIFFERENT source path -> fresh download, not stale
+        r = await client.post("/model/download", json={
+            "source": "local", "path": str(src2), "target_dir": "ad",
+        })
+        assert r.status == 200
+        base = tmp_path / "pvc"
+        assert (base / "ad" / "adapter_model.safetensors").read_bytes() == b"v2"
+        # s3 without boto3 is a 400 (permanent), not a retry-forever 502
+        r = await client.post("/model/download", json={
+            "source": "s3", "url": "s3://bucket/prefix", "target_dir": "s3ad",
+        })
+        assert r.status == 400
+        assert "boto3" in (await r.json())["error"]
+
+    _run(go, tmp_path / "pvc")

@@ -23,6 +23,11 @@ from .k8s_client import K8sClient
 logger = init_logger(__name__)
 
 
+class PermanentDownloadError(Exception):
+    """Adapter source spec the sidecar can never satisfy — surfaces as an
+    Error phase on the CR instead of an eternal silent Loading loop."""
+
+
 def _spec_drifted(live: dict, desired: dict) -> bool:
     """Compare the fields the operator owns (reference deploymentNeedsUpdate
     checks replicas/model/image/resources/env diff, :624-705). Pod-level
@@ -121,10 +126,11 @@ class LoraAdapterReconciler:
     plural = "loraadapters"
 
     def __init__(self, client: K8sClient, http: aiohttp.ClientSession,
-                 engine_port: int = 8000):
+                 engine_port: int = 8000, sidecar_port: int = 30090):
         self.c = client
         self.http = http
         self.engine_port = engine_port
+        self.sidecar_port = sidecar_port
 
     async def _ready_pods(self, base_model: str) -> list[dict]:
         from .resources import label_safe
@@ -146,6 +152,48 @@ class LoraAdapterReconciler:
         """Data-plane URL of an engine pod (tests override to point at
         loopback TestServers)."""
         return f"http://{pod['status']['podIP']}:{self.engine_port}"
+
+    def _sidecar_url(self, pod: dict) -> str:
+        return f"http://{pod['status']['podIP']}:{self.sidecar_port}"
+
+    async def _ensure_downloaded(self, pod: dict, spec: dict) -> str | None:
+        """Non-local adapter sources land on the pod's PVC via its download
+        sidecar first (reference: HF download through the sidecar's
+        /model/download on port 30090, loraadapter_controller.go:334-391).
+        Returns the pod-local path, or None on failure."""
+        src = spec["adapterSource"]
+        if src.get("type", "local") == "local":
+            return src.get("adapterPath", "")
+        body = {
+            "source": "hf" if src["type"] == "huggingface" else src["type"],
+            "model_id": src.get("adapterPath"),
+            "url": src.get("adapterPath"),
+            "target_dir": src.get("adapterName")
+            or src.get("adapterPath", "").replace("/", "--"),
+        }
+        import asyncio
+
+        try:
+            async with self.http.post(
+                self._sidecar_url(pod) + "/model/download", json=body,
+                # downloads run long; the operator's shared 15s session
+                # timeout would cancel every real fetch
+                timeout=aiohttp.ClientTimeout(total=900),
+            ) as resp:
+                if resp.status == 400:  # permanent: bad source spec
+                    detail = (await resp.json()).get("error", "")
+                    raise PermanentDownloadError(detail)
+                if resp.status != 200:
+                    logger.warning(
+                        "sidecar download on %s: HTTP %d",
+                        pod["metadata"]["name"], resp.status,
+                    )
+                    return None
+                return (await resp.json()).get("local_path")
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            logger.warning("sidecar download on %s failed: %s",
+                           pod["metadata"]["name"], e)
+            return None
 
     async def _registrations(self, url: str) -> set[str]:
         """Adapters live on one engine, from its /v1/models (the reference
@@ -169,7 +217,6 @@ class LoraAdapterReconciler:
         name = cr["metadata"]["name"]
         spec = cr["spec"]
         adapter_name = spec["adapterSource"].get("adapterName") or name
-        path = spec["adapterSource"].get("adapterPath", "")
         pods = await self._ready_pods(spec["baseModel"])
         placement = spec.get("placement", {})
         want_n = placement.get("replicas") or len(pods)
@@ -177,12 +224,20 @@ class LoraAdapterReconciler:
         target_names = {p["metadata"]["name"] for p in targets}
 
         loaded: list[dict] = []
+        permanent_error: str | None = None
         for pod in pods:
             ip = pod["status"]["podIP"]
             is_target = pod["metadata"]["name"] in target_names
             url = self._engine_url(pod)
             regs = await self._registrations(url)
             if is_target and adapter_name not in regs:
+                try:
+                    path = await self._ensure_downloaded(pod, spec)
+                except PermanentDownloadError as e:
+                    permanent_error = str(e)
+                    continue
+                if path is None:
+                    continue  # transient; retry next reconcile loop
                 try:
                     async with self.http.post(
                         url + "/v1/load_lora_adapter",
@@ -213,13 +268,14 @@ class LoraAdapterReconciler:
                     "pod": pod["metadata"]["name"], "podIP": ip,
                 })
         requested = placement.get("replicas") or len(pods)
-        if not pods:
-            phase = "Pending"  # no ready base-model pods to load onto
+        status: dict = {"loadedAdapters": loaded}
+        if permanent_error:
+            status["phase"] = "Error"
+            status["reason"] = permanent_error
+        elif not pods:
+            status["phase"] = "Pending"  # no ready base-model pods
         elif loaded and len(loaded) >= requested:
-            phase = "Loaded"
+            status["phase"] = "Loaded"
         else:
-            phase = "Loading"
-        await self.c.patch_status(self.c.crs(self.plural, name), {
-            "loadedAdapters": loaded,
-            "phase": phase,
-        })
+            status["phase"] = "Loading"
+        await self.c.patch_status(self.c.crs(self.plural, name), status)

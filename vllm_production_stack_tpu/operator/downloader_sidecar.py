@@ -1,0 +1,199 @@
+"""Adapter/model download sidecar.
+
+Reference: scripts/huggingface_downloader.py — a FastAPI service on port
+30090 in the engine pod (docker/Dockerfile.sidecar) that the LoRA controller
+calls via POST /model/download to land HF repos on the shared PVC
+(loraadapter_controller.go:334-391). Same contract here on aiohttp:
+
+    POST /model/download {"source": "hf|local|http",
+                          "model_id": "...",        # hf: repo id
+                          "url": "...",             # http: file URL
+                          "path": "...",            # local: source dir
+                          "target_dir": "relative/subdir"}
+    → {"status": "ok", "local_path": "/data/models/<target_dir>"}
+
+Downloads are idempotent (a completed marker short-circuits re-downloads)
+and serialized per target dir. `hf` needs egress + huggingface_hub; `local`
+copies from an already-mounted volume; `http` fetches a single file —
+enough for adapters exported as a tarball-free safetensors pair.
+
+Run: python -m vllm_production_stack_tpu.operator.downloader_sidecar \
+        --port 30090 --base-dir /data/models
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import shutil
+
+from aiohttp import web
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+_MARKER = ".download-complete"
+
+
+def _spec_key(spec: dict) -> str:
+    """Identity of WHAT was downloaded — the idempotency marker stores it so
+    a changed source (new repo/revision/url under the same target_dir)
+    re-downloads instead of silently serving stale weights."""
+    import hashlib
+
+    fields = (spec.get("source", "hf"), spec.get("model_id"),
+              spec.get("url"), spec.get("path"))
+    return hashlib.sha256(repr(fields).encode()).hexdigest()[:32]
+
+
+class DownloaderSidecar:
+    def __init__(self, base_dir: str):
+        self.base_dir = os.path.abspath(base_dir)
+        os.makedirs(self.base_dir, exist_ok=True)
+        self._locks: dict[str, asyncio.Lock] = {}
+
+    def _target(self, target_dir: str) -> str:
+        """Resolve + confine the target under base_dir (no path escapes)."""
+        path = os.path.abspath(os.path.join(self.base_dir, target_dir))
+        if not path.startswith(self.base_dir + os.sep):
+            raise ValueError(f"target_dir {target_dir!r} escapes the base dir")
+        return path
+
+    async def download(self, spec: dict) -> str:
+        target = self._target(spec.get("target_dir") or spec.get("model_id", ""))
+        lock = self._locks.setdefault(target, asyncio.Lock())
+        key = _spec_key(spec)
+        async with lock:
+            marker = os.path.join(target, _MARKER)
+            if os.path.exists(marker):
+                if open(marker).read() == key:
+                    return target  # idempotent: same source already landed
+                # same target, DIFFERENT source: re-download fresh
+                shutil.rmtree(target)
+            os.makedirs(target, exist_ok=True)
+            source = spec.get("source", "hf")
+            loop = asyncio.get_running_loop()
+            if source == "local":
+                await loop.run_in_executor(
+                    None, self._copy_local, spec["path"], target
+                )
+            elif source == "http":
+                await self._fetch_http(spec["url"], target)
+            elif source == "hf":
+                await loop.run_in_executor(
+                    None, self._snapshot_hf, spec["model_id"], target
+                )
+            elif source == "s3":
+                await loop.run_in_executor(
+                    None, self._fetch_s3, spec["url"] or spec["model_id"],
+                    target,
+                )
+            else:
+                raise ValueError(f"unknown source {source!r}")
+            with open(marker, "w") as f:
+                f.write(key)
+            logger.info("downloaded %s -> %s", spec, target)
+            return target
+
+    @staticmethod
+    def _copy_local(src: str, target: str) -> None:
+        for name in os.listdir(src):
+            s = os.path.join(src, name)
+            d = os.path.join(target, name)
+            if os.path.isdir(s):
+                shutil.copytree(s, d, dirs_exist_ok=True)
+            else:
+                shutil.copy2(s, d)
+
+    async def _fetch_http(self, url: str, target: str) -> None:
+        import aiohttp
+        from urllib.parse import urlparse
+
+        # basename of the URL PATH — query strings (presigned URLs) must not
+        # leak into the on-disk filename
+        name = os.path.basename(urlparse(url).path) or "download"
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=600)
+        ) as sess:
+            async with sess.get(url) as resp:
+                resp.raise_for_status()
+                with open(os.path.join(target, name), "wb") as f:
+                    async for chunk in resp.content.iter_chunked(1 << 20):
+                        f.write(chunk)
+
+    @staticmethod
+    def _fetch_s3(uri: str, target: str) -> None:
+        """s3://bucket/prefix → target (needs boto3 in the sidecar image;
+        credentials via the pod's AWS_* env, the reference's
+        credentialsSecret contract)."""
+        try:
+            import boto3
+        except ImportError as e:
+            raise ValueError(
+                "s3 adapter sources need boto3 in the sidecar image"
+            ) from e
+        from urllib.parse import urlparse
+
+        parsed = urlparse(uri)
+        bucket, prefix = parsed.netloc, parsed.path.lstrip("/")
+        s3 = boto3.client("s3")
+        paginator = s3.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=bucket, Prefix=prefix):
+            for obj in page.get("Contents", []):
+                rel = obj["Key"][len(prefix):].lstrip("/") or                     os.path.basename(obj["Key"])
+                dest = os.path.join(target, rel)
+                os.makedirs(os.path.dirname(dest) or target, exist_ok=True)
+                s3.download_file(bucket, obj["Key"], dest)
+
+    @staticmethod
+    def _snapshot_hf(model_id: str, target: str) -> None:
+        from huggingface_hub import snapshot_download
+
+        snapshot_download(
+            repo_id=model_id, local_dir=target,
+            token=os.environ.get("HF_TOKEN"),
+        )
+
+    # -- HTTP surface ------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/model/download", self._handle_download)
+        app.router.add_get("/health", self._handle_health)
+        return app
+
+    async def _handle_download(self, request: web.Request) -> web.Response:
+        spec = await request.json()
+        if not (spec.get("model_id") or spec.get("path") or spec.get("url")):
+            return web.json_response(
+                {"error": "model_id, path, or url is required"}, status=400
+            )
+        try:
+            path = await self.download(spec)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:
+            logger.exception("download failed")
+            return web.json_response({"error": str(e)}, status=502)
+        return web.json_response({"status": "ok", "local_path": path})
+
+    async def _handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "base_dir": self.base_dir})
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="model download sidecar")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=30090)
+    p.add_argument("--base-dir", default="/data/models")
+    args = p.parse_args(argv)
+    web.run_app(
+        DownloaderSidecar(args.base_dir).build_app(),
+        host=args.host, port=args.port, access_log=None,
+    )
+
+
+if __name__ == "__main__":
+    main()
