@@ -226,6 +226,25 @@ class AsyncEngine:
 
         return await asyncio.get_running_loop().run_in_executor(None, work)
 
+    async def embed(self, inputs) -> tuple[list[list[float]], int]:
+        """Chunked so a large embedding batch can't monopolize the engine
+        lock — decode steps interleave between chunks."""
+        loop = asyncio.get_running_loop()
+        vectors: list[list[float]] = []
+        total_tokens = 0
+        CHUNK = 16
+        for i in range(0, len(inputs), CHUNK):
+            chunk = inputs[i : i + CHUNK]
+
+            def work(c=chunk):
+                with self._lock:
+                    return self.engine.embed(c)
+
+            v, n = await loop.run_in_executor(None, work)
+            vectors.extend(v)
+            total_tokens += n
+        return vectors, total_tokens
+
     async def kv_export(self, text=None, token_ids=None, lora_name=None):
         def work():
             ids = (

@@ -236,6 +236,49 @@ class LLMEngine:
             return chain_hash(self.scheduler.pool.root_hash(), (salt,))
         return self.scheduler.pool.root_hash()
 
+    def embed(
+        self,
+        inputs: list[str] | list[list[int]],
+    ) -> tuple[list[list[float]], int]:
+        """OpenAI /v1/embeddings backend: last-token pooled, L2-normalized
+        final hidden states (how decoder-only embedding serving works in the
+        reference's engines). Returns (vectors, total prompt tokens)."""
+        import numbers
+
+        rows: list[list[int]] = []
+        vocab = self.config.model.vocab_size
+        for x in inputs:
+            if isinstance(x, str):
+                rows.append(self.tokenizer.encode(x))
+            elif isinstance(x, list) and all(
+                isinstance(t, numbers.Integral) and not isinstance(t, bool)
+                for t in x
+            ):
+                bad = [int(t) for t in x if not 0 <= t < vocab]
+                if bad:
+                    # JAX gathers CLAMP out-of-range ids — that would be a
+                    # silent wrong-answer, not an error
+                    raise ValueError(
+                        f"token id(s) {bad[:3]} out of range [0, {vocab})"
+                    )
+                rows.append([int(t) for t in x])
+            else:
+                raise ValueError(
+                    "each embedding input must be a string or a list of "
+                    "token ids"
+                )
+        max_t = max(self.config.scheduler.prefill_buckets)
+        for r in rows:
+            if not r:
+                raise ValueError("empty embedding input")
+            if len(r) > max_t:
+                raise ValueError(
+                    f"embedding input of {len(r)} tokens exceeds the largest "
+                    f"prefill bucket ({max_t})"
+                )
+        vectors = self.runner.embed(rows).tolist()
+        return vectors, sum(len(r) for r in rows)
+
     def kv_export(
         self,
         text: str | None = None,

@@ -142,6 +142,7 @@ class ModelRunner:
         self._sleeping_lora_host: Any | None = None
         self._upload_block_fn = None
         self._fetch_block_fn = None
+        self._embed_fn = None
 
     def _resolve_attention_backend(self) -> str:
         """'auto' → XLA staged attention. Measured on a v5e chip (llama-1b
@@ -454,6 +455,47 @@ class ModelRunner:
         for i, tbl in enumerate(tables):
             arr[i, : len(tbl)] = tbl
         return arr
+
+    # -- embeddings (/v1/embeddings; plain encode, no paged KV) ------------
+
+    def embed(self, rows: list[list[int]]) -> np.ndarray:
+        """L2-normalized last-token embeddings for a batch of token rows.
+        Rows group by prefill bucket and batch into ONE dispatch per
+        (batch-bucket, length-bucket) pair — same compile-bounding
+        discipline as the serving steps, and no per-row device round-trips.
+        Returns (N, hidden) float32."""
+        if self._sleeping_params_host is not None:
+            raise RuntimeError("engine is sleeping; wake it before running")
+        if self._embed_fn is None:
+            self._embed_fn = jax.jit(
+                lambda p, ids, lens: llama.embed_encode(
+                    self.config.model, p, ids, lens
+                )
+            )
+        sched = self.config.scheduler
+        out = np.zeros(
+            (len(rows), self.config.model.hidden_size), np.float32
+        )
+        groups: dict[int, list[int]] = {}
+        for idx, row in enumerate(rows):
+            t_pad = sched.bucket_for(len(row), sched.prefill_buckets)
+            groups.setdefault(t_pad, []).append(idx)
+        for t_pad, idxs in groups.items():
+            b_pad = self._batch_bucket(len(idxs))
+            ids = np.zeros((b_pad, t_pad), np.int32)
+            lens = np.ones(b_pad, np.int32)  # padding rows pool token 0
+            for j, idx in enumerate(idxs):
+                ids[j, : len(rows[idx])] = rows[idx]
+                lens[j] = len(rows[idx])
+            vecs = self._embed_fn(
+                self.params,
+                self._put(ids, self._batch2),
+                self._put(lens, self._batch1),
+            )
+            got = np.asarray(jax.device_get(vecs))
+            for j, idx in enumerate(idxs):
+                out[idx] = got[j]
+        return out
 
     # -- host KV tier transfers (engine/kv_host_tier.py) -------------------
 

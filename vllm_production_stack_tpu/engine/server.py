@@ -78,6 +78,7 @@ class EngineServer:
         r = app.router
         r.add_post("/v1/chat/completions", self.chat_completions)
         r.add_post("/v1/completions", self.completions)
+        r.add_post("/v1/embeddings", self.embeddings)
         r.add_get("/v1/models", self.list_models)
         r.add_get("/health", self.health)
         r.add_get("/metrics", self.metrics_endpoint)
@@ -192,6 +193,46 @@ class EngineServer:
             rid, prompt, sampling, chat=False, prompt_ids=prompt_ids,
             lora_name=lora_name,
         )
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI embeddings: last-token pooled decoder hidden states."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return error(400, f"invalid request: {e}")
+        model = body.get("model", self.model_name)
+        if err := self._check_model(model):
+            return err
+        if model in self.lora_adapters:
+            return error(
+                400,
+                "embeddings through a LoRA adapter are not supported; use "
+                "the base model name",
+            )
+        raw = body.get("input")
+        if isinstance(raw, str):
+            inputs = [raw]
+        elif isinstance(raw, list) and raw and isinstance(raw[0], int):
+            inputs = [raw]
+        elif isinstance(raw, list) and raw:
+            inputs = raw
+        else:
+            return error(400, "input must be a string, token array, or list")
+        try:
+            vectors, n_tokens = await self.async_engine.embed(inputs)
+        except ValueError as e:
+            return error(400, str(e))
+        except RuntimeError as e:
+            return error(503, str(e), "service_unavailable")
+        return web.json_response({
+            "object": "list",
+            "model": body.get("model", self.model_name),
+            "data": [
+                {"object": "embedding", "index": i, "embedding": v}
+                for i, v in enumerate(vectors)
+            ],
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        })
 
     def _check_model(self, model: str):
         """vLLM-compatible 404 for unknown model/adapter names — the

@@ -23,6 +23,7 @@ from ..engine.config import ModelConfig
 from ..ops.attention import (
     apply_rope,
     causal_page_mask,
+    masked_attention,
     paged_attention_with_staged,
     paged_attention_xla,
     write_kv_pages,
@@ -363,6 +364,39 @@ def commit_staged_kv(
         v_rows = jnp.moveaxis(staged[i, 1], 0, 1).reshape(b * w, kvh, d)
         new_kv.append(write_kv_pages(kv_caches[i], k_rows, v_rows, slot_mapping))
     return tuple(new_kv)
+
+
+def embed_encode(
+    cfg: ModelConfig,
+    params: dict,
+    token_ids: jax.Array,  # (B, T) int32 (rows padded with 0s)
+    lengths: jax.Array,  # (B,) true lengths
+) -> jax.Array:
+    """Plain causal self-attention encode (no paged KV): final-layer hidden
+    state at each row's LAST real token, L2-normalized — the /v1/embeddings
+    path (vLLM serves decoder embeddings the same way: last-token pooling).
+    Returns (B, h) float32."""
+    b, t = token_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    x = params["embed"][token_ids].astype(_dtype(cfg))
+    mask = causal_page_mask(positions, lengths, t)  # (B, T, T)
+
+    for i in range(cfg.num_layers):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+
+        def attend(q, k, v):
+            return masked_attention(
+                q, k, v, mask, scale=cfg.head_dim**-0.5
+            )
+
+        x = _layer_body(cfg, lp, x, positions, attend)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0].astype(jnp.float32)  # (B, h)
+    return last / jnp.maximum(
+        jnp.linalg.norm(last, axis=-1, keepdims=True), 1e-9
+    )
 
 
 def compute_logits(cfg: ModelConfig, params: dict, hidden: jax.Array) -> jax.Array:
