@@ -107,3 +107,24 @@ def test_embed_batched_groups_match_single():
     for i, row in enumerate(rows):
         solo, _ = engine.embed([row])
         np.testing.assert_allclose(batched[i], solo[0], rtol=1e-5, atol=1e-5)
+
+
+def test_embeddings_unsupported_params_rejected():
+    srv = EngineServer(LLMEngine(EngineConfig.tiny()),
+                       served_model_name="tiny-llama")
+
+    async def go(client):
+        b64 = await client.post("/v1/embeddings", json={
+            "model": "tiny-llama", "input": "x",
+            "encoding_format": "base64",
+        })
+        dims = await client.post("/v1/embeddings", json={
+            "model": "tiny-llama", "input": "x", "dimensions": 32,
+        })
+        too_long = await client.post("/v1/embeddings", json={
+            "model": "tiny-llama", "input": [1] * 1000,  # > tiny max len 256
+        })
+        return b64.status, dims.status, too_long.status
+
+    s_b64, s_dims, s_long = run_with_client(srv, go)
+    assert s_b64 == 400 and s_dims == 400 and s_long == 400

@@ -141,3 +141,40 @@ def test_incremental_detokenizer_multibyte():
         got += detok.push([i])
     assert got == text
     assert detok.text == text
+
+
+def test_long_context_prefill_through_flash_path():
+    """A prompt long enough that prefill attention takes the chunked
+    online-softmax path (S > FLASH_CHUNK) must still generate correctly and
+    match the same engine re-run (determinism through the flash path)."""
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+    from vllm_production_stack_tpu.ops.attention import FLASH_CHUNK
+
+    cfg = ModelConfig.tiny(max_model_len=8192)
+
+    def run():
+        engine = LLMEngine(EngineConfig(
+            model=cfg,
+            cache=CacheConfig(block_size=8, num_blocks=1200),
+            scheduler=SchedulerConfig(
+                max_num_seqs=1, max_num_batched_tokens=512,
+                decode_buckets=(1,), prefill_buckets=(512,), decode_window=4,
+            ),
+        ))
+        prompt = list(
+            np.random.RandomState(0).randint(1, 500, size=2 * FLASH_CHUNK + 100)
+        )
+        return engine.generate(
+            [prompt],
+            SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True),
+        )[0]["token_ids"]
+
+    out1 = run()
+    assert len(out1) == 4
+    assert run() == out1

@@ -108,3 +108,38 @@ def test_decode_window_step_pallas_backend_matches_xla():
                                atol=2e-4)
     np.testing.assert_allclose(np.asarray(st_p), np.asarray(st_x), rtol=2e-5,
                                atol=2e-5)
+
+
+def test_flash_chunked_matches_direct_long_context():
+    """masked_attention's online-softmax path (S > FLASH_CHUNK) must match
+    the direct score-materializing path — long-context prefill correctness."""
+    from vllm_production_stack_tpu.ops import attention as att
+
+    rng = np.random.RandomState(0)
+    b, t, kvh, qpk, d = 2, 8, 2, 2, 16
+    s = 4096  # > FLASH_CHUNK and divisible
+    q = jnp.asarray(rng.randn(b, t, kvh * qpk, d), jnp.float32)
+    keys = jnp.asarray(rng.randn(b, s, kvh, d) * 0.3, jnp.float32)
+    values = jnp.asarray(rng.randn(b, s, kvh, d), jnp.float32)
+    # realistic mask: per-row valid length + causal-ish stagger, plus one
+    # fully-masked padding row
+    lens = np.array([3000, 1], dtype=np.int32)
+    mask_np = np.zeros((b, t, s), bool)
+    for i in range(b):
+        for j in range(t):
+            mask_np[i, j, : max(0, lens[i] - (t - 1 - j) * 7)] = True
+    mask_np[1, 0, :] = False  # fully masked query row
+    mask = jnp.asarray(mask_np)
+
+    flash = att.masked_attention(q, keys, values, mask, scale=0.25)
+
+    # force the direct path by raising the threshold
+    orig = att.FLASH_CHUNK
+    att.FLASH_CHUNK = s + 1
+    try:
+        direct = att.masked_attention(q, keys, values, mask, scale=0.25)
+    finally:
+        att.FLASH_CHUNK = orig
+    np.testing.assert_allclose(
+        np.asarray(flash), np.asarray(direct), rtol=2e-5, atol=2e-5
+    )

@@ -267,14 +267,14 @@ class LLMEngine:
                     "each embedding input must be a string or a list of "
                     "token ids"
                 )
-        max_t = max(self.config.scheduler.prefill_buckets)
+        max_t = self.config.model.max_model_len
         for r in rows:
             if not r:
                 raise ValueError("empty embedding input")
             if len(r) > max_t:
                 raise ValueError(
-                    f"embedding input of {len(r)} tokens exceeds the largest "
-                    f"prefill bucket ({max_t})"
+                    f"embedding input of {len(r)} tokens exceeds "
+                    f"max_model_len ({max_t})"
                 )
         vectors = self.runner.embed(rows).tolist()
         return vectors, sum(len(r) for r in rows)

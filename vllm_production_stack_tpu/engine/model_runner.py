@@ -472,13 +472,18 @@ class ModelRunner:
                     self.config.model, p, ids, lens
                 )
             )
-        sched = self.config.scheduler
         out = np.zeros(
             (len(rows), self.config.model.hidden_size), np.float32
         )
+        # pow2 length buckets up to max_model_len — embeddings must accept
+        # anything the model's context fits (the scheduler's prefill buckets
+        # cap chunk sizes, not document lengths), with a log2-bounded
+        # compiled-program set
         groups: dict[int, list[int]] = {}
         for idx, row in enumerate(rows):
-            t_pad = sched.bucket_for(len(row), sched.prefill_buckets)
+            t_pad = min(
+                self._pow2(len(row)), self.config.model.max_model_len
+            )
             groups.setdefault(t_pad, []).append(idx)
         for t_pad, idxs in groups.items():
             b_pad = self._batch_bucket(len(idxs))

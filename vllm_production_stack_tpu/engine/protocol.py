@@ -28,6 +28,14 @@ class StreamOptions(OpenAIModel):
     include_usage: bool = False
 
 
+class EmbeddingRequest(OpenAIModel):
+    model: str
+    input: str | list
+    encoding_format: str = "float"
+    dimensions: int | None = None
+    user: str | None = None
+
+
 class ChatCompletionRequest(OpenAIModel):
     model: str
     messages: list[ChatMessage]

@@ -37,6 +37,7 @@ from .metrics import EngineMetrics
 from .protocol import (
     ChatCompletionRequest,
     CompletionRequest,
+    EmbeddingRequest,
     ErrorResponse,
     ModelCard,
     ModelList,
@@ -197,10 +198,10 @@ class EngineServer:
     async def embeddings(self, request: web.Request) -> web.Response:
         """OpenAI embeddings: last-token pooled decoder hidden states."""
         try:
-            body = await request.json()
-        except json.JSONDecodeError as e:
+            body = EmbeddingRequest.model_validate(await request.json())
+        except (ValidationError, json.JSONDecodeError) as e:
             return error(400, f"invalid request: {e}")
-        model = body.get("model", self.model_name)
+        model = body.model
         if err := self._check_model(model):
             return err
         if model in self.lora_adapters:
@@ -209,7 +210,19 @@ class EngineServer:
                 "embeddings through a LoRA adapter are not supported; use "
                 "the base model name",
             )
-        raw = body.get("input")
+        if body.encoding_format != "float":
+            return error(
+                400,
+                f"encoding_format {body.encoding_format!r} is not supported "
+                "(only 'float')",
+            )
+        if body.dimensions is not None:
+            return error(
+                400,
+                "the dimensions parameter is not supported; vectors have "
+                "the model's hidden size",
+            )
+        raw = body.input
         if isinstance(raw, str):
             inputs = [raw]
         elif isinstance(raw, list) and raw and isinstance(raw[0], int):
@@ -226,7 +239,7 @@ class EngineServer:
             return error(503, str(e), "service_unavailable")
         return web.json_response({
             "object": "list",
-            "model": body.get("model", self.model_name),
+            "model": model,
             "data": [
                 {"object": "embedding", "index": i, "embedding": v}
                 for i, v in enumerate(vectors)

@@ -90,6 +90,13 @@ def causal_page_mask(
     return valid[:, None, :] & causal
 
 
+# context length above which masked_attention switches to the chunked
+# flash path: the direct path materializes (B, kvH, qpk, T, S) f32 scores,
+# which at long context is GBs per layer (e.g. B=16, T=512 chunk, S=8192:
+# 2.1 GiB) — the chunked path holds one S-chunk of scores at a time
+FLASH_CHUNK = 2048
+
+
 def masked_attention(
     q: jax.Array,
     keys: jax.Array,
@@ -104,9 +111,19 @@ def masked_attention(
     returns: (B, T, num_heads, D)
     """
     b, t, num_heads, d = q.shape
+    s = keys.shape[1]
     kvh = keys.shape[2]
     qpk = num_heads // kvh
     qg = q.reshape(b, t, kvh, qpk, d)
+    if s > FLASH_CHUNK:
+        pad = (-s) % FLASH_CHUNK
+        if pad:
+            # pad to a chunk multiple; masked-off padding contributes zero
+            # weight, so the result is unchanged
+            keys = jnp.pad(keys, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            values = jnp.pad(values, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            mask = jnp.pad(mask, ((0, 0), (0, 0), (0, pad)))
+        return _flash_masked_attention(qg, keys, values, mask, scale=scale)
     # scores: (B, kvH, qpk, T, S)
     scores = jnp.einsum(
         "btkgd,bskd->bkgts", qg.astype(jnp.float32), keys.astype(jnp.float32)
@@ -117,6 +134,54 @@ def masked_attention(
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, values.astype(jnp.float32))
     return out.reshape(b, t, num_heads, d).astype(q.dtype)
+
+
+def _flash_masked_attention(
+    qg: jax.Array,  # (B, T, kvH, qpk, D)
+    keys: jax.Array,  # (B, S, kvH, D)
+    values: jax.Array,  # (B, S, kvH, D)
+    mask: jax.Array,  # (B, T, S)
+    *,
+    scale: float,
+) -> jax.Array:
+    """Online-softmax over S chunks (lax.scan): peak score memory is one
+    (B, kvH, qpk, T, FLASH_CHUNK) block instead of the full S axis. Same
+    math as the direct path up to float associativity."""
+    b, t, kvh, qpk, d = qg.shape
+    s = keys.shape[1]
+    n = s // FLASH_CHUNK
+    qf = qg.astype(jnp.float32)
+    # chunk-major stacks for scan
+    k_c = keys.reshape(b, n, FLASH_CHUNK, kvh, d).transpose(1, 0, 2, 3, 4)
+    v_c = values.reshape(b, n, FLASH_CHUNK, kvh, d).transpose(1, 0, 2, 3, 4)
+    m_c = mask.reshape(b, t, n, FLASH_CHUNK).transpose(2, 0, 1, 3)
+
+    def body(carry, inputs):
+        m_prev, l_prev, acc = carry
+        k, v, msk = inputs
+        scores = jnp.einsum(
+            "btkgd,bskd->bkgts", qf, k.astype(jnp.float32)
+        ) * scale
+        scores = jnp.where(msk[:, None, None], scores, NEG_INF)
+        m_cur = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new[..., None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bkgts,bskd->bkgtd", p, v.astype(jnp.float32)
+        )
+        return (m_new, l_new, acc), None
+
+    init = (
+        jnp.full((b, kvh, qpk, t), NEG_INF, jnp.float32),
+        jnp.zeros((b, kvh, qpk, t), jnp.float32),
+        jnp.zeros((b, kvh, qpk, t, d), jnp.float32),
+    )
+    (m, l, acc), _ = jax.lax.scan(body, init, (k_c, v_c, m_c))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # (B, kvH, qpk, T, D)
+    out = out.transpose(0, 3, 1, 2, 4).reshape(b, t, kvh * qpk, d)
+    return out.astype(qg.dtype)
 
 
 def paged_attention_xla(
