@@ -178,3 +178,21 @@ def test_long_context_prefill_through_flash_path():
     out1 = run()
     assert len(out1) == 4
     assert run() == out1
+
+
+def test_warmup_compiles_bucket_set():
+    """engine.warmup() runs every prefill/decode bucket program; subsequent
+    traffic reuses them (no mid-serving compile stalls)."""
+    from vllm_production_stack_tpu.engine.config import EngineConfig
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    engine = LLMEngine(EngineConfig.tiny())
+    warmed = engine.warmup()
+    assert warmed > 0
+    assert not engine.has_unfinished()  # warmup drains fully
+    out = engine.generate(
+        [[5, 6, 7]],
+        SamplingParams(max_tokens=3, temperature=0.0, ignore_eos=True),
+    )
+    assert len(out[0]["token_ids"]) == 3

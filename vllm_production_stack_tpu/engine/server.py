@@ -605,6 +605,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-enable-prefix-caching", dest="enable_prefix_caching",
                    action="store_false")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--warmup", action="store_true",
+                   help="compile the prefill/decode bucket programs before "
+                        "accepting traffic (first requests otherwise stall "
+                        "on 10-40s XLA compiles)")
     p.add_argument("--max-loras", type=int, default=0,
                    help="runtime LoRA adapter slots (0 disables LoRA)")
     p.add_argument("--max-lora-rank", type=int, default=8)
@@ -656,6 +660,9 @@ def main(argv: list[str] | None = None) -> None:
     logger.info("starting engine for model=%s on %s:%d",
                 args.model, args.host, args.port)
     engine = LLMEngine(config)
+    if args.warmup:
+        logger.info("warming serving buckets (compiles every program)...")
+        engine.warmup()
     server = EngineServer(engine, served_model_name=args.served_model_name)
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)
