@@ -1,0 +1,92 @@
+"""Sampler correctness: the binary-search top-k/top-p thresholds must admit
+EXACTLY the token support the sorted reference formulation admits."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_production_stack_tpu.engine.sampling import sample as _sample
+
+# production always runs the sampler inside the jitted step; eager op-by-op
+# dispatch of its cond/fori_loop internals is minutes-slow on this box
+sample = jax.jit(_sample)
+
+
+def _support_reference(logits: np.ndarray, temperature, top_p, top_k):
+    """Sorted-formulation support mask (the pre-optimization semantics)."""
+    scaled = logits / max(temperature, 1e-6)
+    order = np.argsort(-scaled)
+    sorted_desc = scaled[order]
+    v = len(scaled)
+    k = top_k if top_k > 0 else v
+    kth = sorted_desc[k - 1]
+    probs = np.exp(sorted_desc - sorted_desc.max())
+    probs /= probs.sum()
+    cum_excl = np.cumsum(probs) - probs
+    num_keep = max(int((cum_excl < top_p).sum()), 1)
+    pth = sorted_desc[num_keep - 1]
+    return scaled >= max(kth, pth)
+
+
+def _empirical_support(logits, temperature, top_p, top_k, n=600):
+    b = len(logits)
+    seen = [set() for _ in range(b)]
+    for trial in range(n):
+        toks = sample(
+            jnp.asarray(logits, jnp.float32),
+            jnp.full((b,), temperature, jnp.float32),
+            jnp.full((b,), top_p, jnp.float32),
+            jnp.full((b,), top_k, jnp.int32),
+            jax.random.PRNGKey(trial),
+            jnp.zeros((b,), jnp.uint32),
+            jnp.zeros((b,), bool),
+            jnp.zeros((b,), jnp.int32),
+        )
+        for i, t in enumerate(np.asarray(toks)):
+            seen[i].add(int(t))
+    return seen
+
+
+@pytest.mark.parametrize("top_p,top_k", [(1.0, 3), (0.7, 0), (0.85, 5),
+                                         (1.0, 0)])
+def test_sampled_support_matches_sorted_reference(top_p, top_k):
+    rng = np.random.RandomState(0)
+    # small vocab so empirical sampling can cover the full support
+    logits = rng.randn(3, 12) * 2.0
+    ref_masks = [
+        _support_reference(row, 0.8, top_p, top_k) for row in logits
+    ]
+    seen = _empirical_support(logits, 0.8, top_p, top_k)
+    for i, mask in enumerate(ref_masks):
+        allowed = {int(t) for t in np.flatnonzero(mask)}
+        # nothing outside the reference support may EVER be sampled
+        assert seen[i] <= allowed, (i, seen[i], allowed)
+        # and every allowed token with non-trivial in-support mass shows up
+        # in 600 draws (a 0.1%-mass tail token can legitimately miss them)
+        scaled = logits[i] / 0.8
+        probs = np.exp(scaled - scaled.max()) * mask
+        probs /= probs.sum()
+        must_see = {int(t) for t in np.flatnonzero(probs >= 0.01)}
+        assert must_see <= seen[i], (i, seen[i], must_see)
+
+
+def test_seeded_rows_reproduce_regardless_of_batch():
+    logits = np.random.RandomState(1).randn(4, 50).astype(np.float32) * 3
+
+    def draw(batch_rows, seed_row):
+        b = len(batch_rows)
+        toks = sample(
+            jnp.asarray(logits[batch_rows], jnp.float32),
+            jnp.full((b,), 0.9, jnp.float32),
+            jnp.full((b,), 0.95, jnp.float32),
+            jnp.zeros((b,), jnp.int32),
+            jax.random.PRNGKey(123),
+            jnp.full((b,), 77, jnp.uint32),
+            jnp.ones((b,), bool),
+            jnp.full((b,), 5, jnp.int32),
+        )
+        return int(np.asarray(toks)[seed_row])
+
+    # same (seed, count) row must sample the same token in any batch shape
+    assert draw([0, 1, 2, 3], 2) == draw([2], 0)

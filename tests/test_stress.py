@@ -69,11 +69,11 @@ def test_concurrent_streams_aborts_and_control_plane():
             return "control"
 
         results = await asyncio.gather(
-            *[stream_one(i, cancel=i % 3 == 0) for i in range(12)],
+            *[stream_one(i, cancel=i % 3 == 0) for i in range(9)],
             poke_control(10),
         )
-        assert results.count("done") == 8
-        assert results.count("cancelled") == 4
+        assert results.count("done") == 6
+        assert results.count("cancelled") == 3
 
         # engine drained: no leaked requests, every block reclaimed
         for _ in range(200):

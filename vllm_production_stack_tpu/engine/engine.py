@@ -317,21 +317,33 @@ class LLMEngine:
             )
             passes += 1
 
-        for t in sched.prefill_buckets:
-            if t > sched.max_num_batched_tokens or t >= cfg.max_model_len:
-                continue  # no chunk can ever land in this bucket per row
-            per_seq = t + sched.decode_window + 1
+        longest_chunk = min(
+            sched.max_num_batched_tokens, cfg.max_model_len - 1
+        )
+        prev_bucket = 0
+        for t in sorted(sched.prefill_buckets):
+            # bucket t is reachable iff some chunk length in
+            # (prev_bucket, longest_chunk] pads up to it (bucket_for picks
+            # the smallest bucket >= the chunk)
+            if prev_bucket >= longest_chunk:
+                break
+            prompt_len = min(t, longest_chunk)
+            per_seq = prompt_len + sched.decode_window + 1
             rows = max(1, min(sched.max_num_seqs, usable_tokens // per_seq))
-            wave(rows, t, 1)
+            wave(rows, prompt_len, 1)
+            prev_bucket = t
         w = 1
         while w <= sched.decode_window:
             for b in sched.decode_buckets:
                 if b > sched.max_num_seqs:
                     continue  # unreachable batch bucket
-                per_seq = 8 + w + 1
+                per_seq = 8 + w + 2
                 rows = max(1, min(b, usable_tokens // per_seq))
                 if rows == b or b == min(sched.decode_buckets):
-                    wave(rows, 8, w)
+                    # prefill emits the FIRST output token, so max_tokens
+                    # w+1 leaves exactly w for the fused window — hitting
+                    # window program w, not round_up_pow2(w-1)
+                    wave(rows, 8, w + 1)
             w *= 2
         logger.info("warmup ran %d bucket passes", passes)
         return passes

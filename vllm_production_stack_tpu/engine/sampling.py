@@ -2,10 +2,11 @@
 
 Fixed-shape and jit-fused: the sampled token ids are the only per-step
 device→host transfer. Within the sampling pipeline, per-row variation uses
-where-masks (no Python control flow), but the pipeline as a whole sits
-behind ONE runtime lax.cond — an all-greedy batch (the serving default)
-skips the (B, V) sort + gumbel draw entirely, which at 128K vocab would
-otherwise dwarf the decode step's own FLOPs.
+where-masks (no Python control flow); top-k/top-p thresholds come from a
+binary search over the logit value domain (~30 cheap VPU reductions — a
+full (B, V) sort at 128K vocab would dwarf the decode step's own FLOPs).
+The pipeline as a whole sits behind ONE runtime lax.cond so an all-greedy
+batch (the serving default) skips even that.
 """
 
 from __future__ import annotations
@@ -52,22 +53,53 @@ def sample(
 
     def sampled(_):
         scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
-        sorted_desc = -jnp.sort(-scaled, axis=-1)  # (B, V) descending
 
-        # top-k threshold: the k-th largest logit (k=0 -> keep all)
+        # Thresholds by BINARY SEARCH over the logit value domain instead of
+        # a full (B, V) sort: a 128K-vocab sort per decode step dwarfs the
+        # model's own FLOPs on TPU, while ~30 masked reductions are cheap
+        # VPU sweeps. Masks use `scaled >= threshold`, so value ties are
+        # included exactly like the sorted-kth-value formulation.
+        lo0 = jnp.min(scaled, axis=-1)  # (B,)
+        hi0 = jnp.max(scaled, axis=-1)
+
+        def search(pred_ge):
+            """Largest t (per row, to f32 precision) with pred_ge(t) True,
+            where pred_ge is monotone decreasing in t. Returns (B,)."""
+
+            def body(_, carry):
+                lo, hi = carry
+                mid = 0.5 * (lo + hi)
+                ok = pred_ge(mid)
+                return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)
+
+            lo, hi = jax.lax.fori_loop(0, 30, body, (lo0, hi0))
+            return lo
+
+        # top-k: the largest t with count(scaled >= t) >= k equals the k-th
+        # largest value (k=0 -> keep all)
         k = jnp.where(top_k > 0, top_k, v).astype(jnp.int32)
-        kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+        kth = search(
+            lambda t: jnp.sum(scaled >= t[:, None], axis=-1) >= k
+        )
 
-        # top-p threshold: smallest logit whose *exclusive* cumulative
-        # prob < p
-        probs = jax.nn.softmax(sorted_desc, axis=-1)
-        cum_excl = jnp.cumsum(probs, axis=-1) - probs
-        keep = cum_excl < top_p[:, None]
-        num_keep = jnp.maximum(jnp.sum(keep, axis=-1), 1)
-        pth = jnp.take_along_axis(sorted_desc, (num_keep - 1)[:, None], axis=-1)
+        # top-p: the sorted formulation keeps the smallest prefix whose
+        # cumulative prob reaches p; equivalently the k-th value where the
+        # EXCLUSIVE mass above it is < p — i.e. the largest t whose
+        # inclusive mass(scaled >= t) reaches p
+        probs = jax.nn.softmax(scaled, axis=-1)
+        pth = search(
+            lambda t: jnp.sum(
+                jnp.where(scaled >= t[:, None], probs, 0.0), axis=-1
+            )
+            >= jnp.minimum(top_p, 1.0) - 1e-6
+        )
 
+        # disabled filters keep EVERYTHING exactly (the searches would only
+        # approach the row minimum to f32 precision)
+        kth = jnp.where(k >= v, lo0, kth)
+        pth = jnp.where(top_p >= 1.0, lo0, pth)
         thresh = jnp.maximum(kth, pth)
-        masked = jnp.where(scaled >= thresh, scaled, NEG_INF)
+        masked = jnp.where(scaled >= thresh[:, None], scaled, NEG_INF)
 
         keys = _row_keys(base_key, seeds, has_seed, counts)
         gumbel = jax.vmap(
@@ -75,10 +107,10 @@ def sample(
         )(keys)
         return jnp.argmax(masked + gumbel, axis=-1).astype(jnp.int32)
 
-    # the sampling pipeline sorts (B, V) and draws (B, V) gumbel noise per
-    # step — for a 128K vocab that dwarfs the model's own decode FLOPs. An
-    # all-greedy batch (the common serving default) skips it entirely at
-    # runtime via cond; mixed batches pay it once for the whole batch
+    # the sampled branch still runs ~30 (B, V) reductions + a (B, V)
+    # gumbel draw per step; an all-greedy batch (the common serving
+    # default) skips it entirely at runtime via cond — mixed batches pay
+    # it once for the whole batch
     sampled_tok = jax.lax.cond(
         jnp.any(temperature != 0.0), sampled, lambda _: greedy_tok, None
     )
