@@ -69,6 +69,8 @@ class ModelRunner:
             config.parallel.tensor_parallel_size,
             config.parallel.data_parallel_size,
             config.parallel.pipeline_parallel_size,
+            sequence_parallel_size=config.parallel.sequence_parallel_size,
+            expert_parallel_size=config.parallel.expert_parallel_size,
         )
         self.max_blocks = config.cache.max_blocks_per_seq(cfg.max_model_len)
 
@@ -135,8 +137,34 @@ class ModelRunner:
                 raise ValueError(
                     f"decode_buckets {bad} not divisible by dp={self._dp}"
                 )
+        # sp shards the PREFILL chunk's sequence axis over the ring
+        # (parallel/ring_attention.py); decode (T=1) has no sequence axis to
+        # shard, so sp devices replicate decode work — size sp for prefill-
+        # heavy / long-context serving (disaggregated prefill-role engines)
+        self._sp = config.parallel.sequence_parallel_size
+        self._seq2 = NamedSharding(
+            self.mesh, P(mesh_lib.DP_AXIS, mesh_lib.SP_AXIS)
+        )
+        if self._sp > 1:
+            bad_t = [
+                t for t in config.scheduler.prefill_buckets if t % self._sp
+            ]
+            if bad_t:
+                raise ValueError(
+                    f"prefill_buckets {bad_t} not divisible by "
+                    f"sequence_parallel_size={self._sp} (the chunk axis "
+                    "shards evenly over the sp ring)"
+                )
+        if config.parallel.expert_parallel_size > 1 and not cfg.num_experts:
+            raise ValueError(
+                f"expert_parallel_size={config.parallel.expert_parallel_size} "
+                f"requires an MoE model, but {cfg.model} has no experts — "
+                "the ep axis would only replicate dense compute"
+            )
         self._attention_backend = self._resolve_attention_backend()
-        self._step_fn = self._build_step_fn()
+        self._step_fn = (
+            self._build_sp_step_fn() if self._sp > 1 else self._build_step_fn()
+        )
         self._decode_window_fn = self._build_decode_window_fn()
         self._sleeping_params_host: Any | None = None
         self._sleeping_lora_host: Any | None = None
@@ -183,6 +211,7 @@ class ModelRunner:
             block_tables,  # (B, max_blocks)
             slot_mapping,  # (B*T,)
             context_lens,  # (B,)
+            chunk_lens,  # (B,) real chunk tokens (used by the sp path only)
             lora_idx,  # (B,) adapter slot per row (None when disabled)
             sample_rows,  # (num_samples,) row index into (B*T) flat hidden
             temperature,  # (num_samples,)
@@ -193,6 +222,7 @@ class ModelRunner:
             has_seed,  # (num_samples,) bool
             counts,  # (num_samples,) int32 output tokens so far
         ):
+            del chunk_lens  # paged path masks purely by context_lens
             hidden, kv_caches = llama.forward(
                 cfg, params, token_ids, positions, kv_caches,
                 block_tables, slot_mapping, context_lens,
@@ -207,6 +237,51 @@ class ModelRunner:
             return kv_caches, tokens
 
         return step_fn
+
+    def _build_sp_step_fn(self):
+        """Prefill step with the chunk's sequence axis sharded over the sp
+        mesh axis — ring attention seeded with the pooled history block
+        (models/llama.py:forward_sp_prefill). Same signature as the paged
+        step so the host-side batching code is identical."""
+        cfg = self.config.model
+        mesh = self.mesh
+
+        @functools.partial(jax.jit, donate_argnames=("kv_caches",))
+        def sp_step_fn(
+            params,
+            lora_params,
+            kv_caches,
+            token_ids,  # (B, T) — T sharded over sp
+            positions,  # (B, T)
+            block_tables,  # (B, max_blocks)
+            slot_mapping,  # (B*T,)
+            context_lens,  # (B,) resident AFTER this chunk
+            chunk_lens,  # (B,) real chunk tokens this step
+            lora_idx,
+            sample_rows,
+            temperature,
+            top_p,
+            top_k,
+            rng,
+            seeds,
+            has_seed,
+            counts,
+        ):
+            hist_lens = context_lens - chunk_lens
+            hidden, kv_caches = llama.forward_sp_prefill(
+                cfg, params, token_ids, positions, kv_caches, block_tables,
+                slot_mapping, chunk_lens, hist_lens, mesh,
+                lora=lora_params, lora_idx=lora_idx,
+            )
+            flat = hidden.reshape(-1, hidden.shape[-1])
+            picked = flat[sample_rows]
+            logits = llama.compute_logits(cfg, params, picked)
+            tokens = sample(
+                logits, temperature, top_p, top_k, rng, seeds, has_seed, counts
+            )
+            return kv_caches, tokens
+
+        return sp_step_fn
 
     def _build_decode_window_fn(self):
         """K decode iterations fused into one dispatch: a lax.fori_loop feeds
@@ -307,6 +382,7 @@ class ModelRunner:
         positions = np.zeros((b_pad, t_pad), np.int32)
         slots = np.zeros((b_pad, t_pad), np.int32)  # padding -> null page
         context_lens = np.zeros(b_pad, np.int32)
+        chunk_lens = np.zeros(b_pad, np.int32)
         sample_rows = np.zeros(b_pad, np.int32)
         temps = np.zeros(b_pad, np.float32)
         top_ps = np.ones(b_pad, np.float32)
@@ -319,6 +395,7 @@ class ModelRunner:
             positions[i, : len(row)] = work.positions[i]
             slots[i, : len(row)] = work.slot_mappings[i]
             context_lens[i] = work.context_lens[i]
+            chunk_lens[i] = len(row)
             sample_rows[i] = i * t_pad + len(row) - 1
             s = req.sampling
             temps[i], top_ps[i], top_ks[i] = s.temperature, s.top_p, s.top_k
@@ -332,8 +409,8 @@ class ModelRunner:
             lora_idx[i] = req.lora_index
         tokens = self._run(
             token_ids, positions, block_tables, slots.reshape(-1), context_lens,
-            lora_idx, sample_rows, temps, top_ps, top_ks, seeds=seeds,
-            counts=counts,
+            chunk_lens, lora_idx, sample_rows, temps, top_ps, top_ks,
+            seeds=seeds, counts=counts,
         )
         return [
             [int(tokens[i])] if work.sample[i] else [] for i in range(b)
@@ -389,7 +466,8 @@ class ModelRunner:
 
     def _run(
         self, token_ids, positions, block_tables, slots, context_lens,
-        lora_idx, sample_rows, temps, top_ps, top_ks, seeds, counts,
+        chunk_lens, lora_idx, sample_rows, temps, top_ps, top_ks, seeds,
+        counts,
     ):
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
@@ -399,15 +477,18 @@ class ModelRunner:
         seed_vals = np.asarray(
             [(s or 0) & 0xFFFFFFFF for s in seeds], np.uint32
         )
+        # sp shards the chunk axis; dp-only meshes leave T unsharded
+        tok_sh = self._seq2 if self._sp > 1 else self._batch2
         self.kv_caches, tokens = self._step_fn(
             self.params,
             self.lora_params,
             self.kv_caches,
-            self._put(token_ids, self._batch2),
-            self._put(positions, self._batch2),
+            self._put(token_ids, tok_sh),
+            self._put(positions, tok_sh),
             self._put(block_tables, self._batch2),
             self._put(slots, self._batch1),  # (B*T,) — B divisible by dp
             self._put(context_lens, self._batch1),
+            self._put(chunk_lens, self._batch1),
             self._put(lora_idx, self._batch1) if self._use_lora else None,
             self._put(sample_rows, self._batch1),
             self._put(np.asarray(temps, np.float32), self._batch1),

@@ -601,6 +601,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pipeline-parallel-size", type=int, default=1,
                    help="GSPMD stage sharding of the layer axis (multi-host)")
     p.add_argument("--data-parallel-size", type=int, default=1)
+    p.add_argument("--sequence-parallel-size", type=int, default=1,
+                   help="ring-attention context parallelism: shard prefill "
+                        "chunks' sequence axis over an sp ring "
+                        "(parallel/ring_attention.py); size it for "
+                        "long-context / prefill-role engines")
+    p.add_argument("--expert-parallel-size", type=int, default=1,
+                   help="MoE expert parallelism: shard Mixtral-family "
+                        "expert FFNs over an ep mesh axis")
     p.add_argument("--enable-prefix-caching", action="store_true", default=True)
     p.add_argument("--no-enable-prefix-caching", dest="enable_prefix_caching",
                    action="store_false")
@@ -646,6 +654,8 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             tensor_parallel_size=args.tensor_parallel_size,
             data_parallel_size=args.data_parallel_size,
             pipeline_parallel_size=args.pipeline_parallel_size,
+            sequence_parallel_size=args.sequence_parallel_size,
+            expert_parallel_size=args.expert_parallel_size,
         ),
         lora=LoRAConfig(
             max_loras=args.max_loras, max_lora_rank=args.max_lora_rank

@@ -23,6 +23,7 @@ from ..engine.config import ModelConfig
 from ..ops.attention import (
     apply_rope,
     causal_page_mask,
+    gather_pages,
     masked_attention,
     paged_attention_with_staged,
     paged_attention_xla,
@@ -47,6 +48,23 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> dict:
         scale = scale if scale is not None else shape[-2] ** -0.5
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
 
+    if cfg.num_experts:
+        # Mixtral-family sparse MoE: per-layer router + E expert SwiGLUs
+        e = cfg.num_experts
+        mlp = {
+            "router": w(next(keys), L, h, e),
+            "gate": w(next(keys), L, e, h, it),
+            "up": w(next(keys), L, e, h, it),
+            "down": w(next(keys), L, e, it, h),
+        }
+        mlp_key = "moe"
+    else:
+        mlp = {
+            "gate": w(next(keys), L, h, it),
+            "up": w(next(keys), L, h, it),
+            "down": w(next(keys), L, it, h),
+        }
+        mlp_key = "mlp"
     params: dict[str, Any] = {
         "embed": w(next(keys), cfg.vocab_size, h, scale=0.02),
         "layers": {
@@ -56,11 +74,7 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> dict:
                 "wv": w(next(keys), L, h, nkv * hd),
                 "wo": w(next(keys), L, nh * hd, h),
             },
-            "mlp": {
-                "gate": w(next(keys), L, h, it),
-                "up": w(next(keys), L, h, it),
-                "down": w(next(keys), L, it, h),
-            },
+            mlp_key: mlp,
             "input_norm": jnp.ones((L, h), dt),
             "post_attn_norm": jnp.ones((L, h), dt),
         },
@@ -100,18 +114,25 @@ def init_kv_cache(
 
 
 def lora_module_dims(cfg: ModelConfig) -> dict[str, tuple[int, int]]:
-    """(in, out) dims per PEFT target-module name."""
+    """(in, out) dims per PEFT target-module name. MoE models expose only the
+    attention projections (per-expert MLP LoRA would need per-expert deltas —
+    the MoE path never consults the adapter tree, so advertising mlp modules
+    there would be a silent no-op)."""
     h, hd = cfg.hidden_size, cfg.head_dim
     nh, nkv, it = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
-    return {
+    dims = {
         "q_proj": (h, nh * hd),
         "k_proj": (h, nkv * hd),
         "v_proj": (h, nkv * hd),
         "o_proj": (nh * hd, h),
-        "gate_proj": (h, it),
-        "up_proj": (h, it),
-        "down_proj": (it, h),
     }
+    if not cfg.num_experts:
+        dims |= {
+            "gate_proj": (h, it),
+            "up_proj": (h, it),
+            "down_proj": (it, h),
+        }
+    return dims
 
 
 def init_lora_params(cfg: ModelConfig, lora_cfg) -> dict:
@@ -199,11 +220,42 @@ def _layer_body(
 
     res = x
     x = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+    if "moe" in lp:
+        return res + _moe_mlp(cfg, lp["moe"], x)
     mp = lp["mlp"]
     inner = jax.nn.silu(proj(x, mp["gate"], "gate_proj")) * proj(
         x, mp["up"], "up_proj"
     )
     return res + proj(inner, mp["down"], "down_proj")
+
+
+def _moe_mlp(cfg: ModelConfig, mp: dict, x: jax.Array) -> jax.Array:
+    """Sparse-MoE MLP, HF Mixtral routing semantics: softmax over ALL expert
+    logits, take top-k, renormalize the selected weights to sum to 1.
+
+    Compute is the dense-expert formulation: every expert evaluates every
+    token and the top-k mask zeroes the rest. That spends num_experts/top_k
+    more FLOPs than a gather-based dispatch, but the shapes are static, every
+    matmul is a large dense MXU op, and under expert parallelism GSPMD shards
+    the E axis over the ep mesh axis — each device runs E/ep experts and the
+    final combine psums over ep (+ tp on the inner axis). At serving batch
+    sizes every expert is active anyway, so the "waste" is bounded and the
+    alternative (capacity-factor dispatch à la GShard) drops tokens — wrong
+    for inference. x: (B, T, h) → (B, T, h)."""
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    logits = (x @ mp["router"]).astype(jnp.float32)  # (B, T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    # (B, T, E) combine weights: topv scattered back onto the expert axis
+    w = jnp.sum(
+        jax.nn.one_hot(topi, e, dtype=jnp.float32) * topv[..., None], axis=-2
+    )
+    inner = jax.nn.silu(
+        jnp.einsum("bth,ehi->btei", x, mp["gate"])
+    ) * jnp.einsum("bth,ehi->btei", x, mp["up"])
+    out = jnp.einsum("btei,eih->bteh", inner, mp["down"])
+    return jnp.einsum("bteh,bte->bth", out, w.astype(x.dtype))
 
 
 def _lora_layer_slice(lora: dict | None, i: int) -> dict | None:
@@ -397,6 +449,107 @@ def embed_encode(
     return last / jnp.maximum(
         jnp.linalg.norm(last, axis=-1, keepdims=True), 1e-9
     )
+
+
+def forward_sp_prefill(
+    cfg: ModelConfig,
+    params: dict,
+    token_ids: jax.Array,  # (B, T) chunk tokens, T sharded over sp
+    positions: jax.Array,  # (B, T) GLOBAL positions of the chunk
+    kv_caches: tuple[jax.Array, ...],
+    block_tables: jax.Array,  # (B, max_blocks)
+    slot_mapping: jax.Array,  # (B*T,) flat pool slots for the chunk
+    chunk_lens: jax.Array,  # (B,) real tokens in this chunk per row
+    hist_lens: jax.Array,  # (B,) already-resident context before the chunk
+    mesh,  # the engine mesh (must carry an sp axis > 1 to be useful)
+    lora: dict | None = None,
+    lora_idx: jax.Array | None = None,
+) -> tuple[jax.Array, tuple[jax.Array, ...]]:
+    """The engine's CHUNKED-PREFILL step with the chunk's sequence axis
+    sharded over the sp mesh axis: attention is ring attention
+    (parallel/ring_attention.py) seeded with the pooled history block, so it
+    supports exactly the same chunk-by-chunk contract as `forward` while no
+    device ever holds a (T, T) score matrix or the whole chunk's activations.
+    Projections / norms / MLP are token-parallel and shard over sp for free
+    under GSPMD. Chunk K/V are written to the pool AFTER attention (the ring
+    provides the chunk's own causality; the pool provides history).
+
+    Returns (hidden (B, T, h) sp-sharded, updated kv_caches)."""
+    from ..parallel.ring_attention import ring_attention
+
+    b, t = token_ids.shape
+    kv_valid = (
+        jnp.arange(t, dtype=jnp.int32)[None, :] < chunk_lens[:, None]
+    )  # (B, T) real chunk tokens
+    x = params["embed"][token_ids].astype(_dtype(cfg))
+    nkv, hd = cfg.num_kv_heads, cfg.head_dim
+
+    new_kv: list[jax.Array] = []
+    for i in range(cfg.num_layers):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+
+        def attend(q, k, v, i=i):
+            hist_k, hist_v = gather_pages(kv_caches[i], block_tables)
+            out = ring_attention(
+                mesh, q, k, v, positions, kv_valid, scale=hd**-0.5,
+                hist_k=hist_k, hist_v=hist_v, hist_len=hist_lens,
+            )
+            new_kv.append(
+                write_kv_pages(
+                    kv_caches[i],
+                    k.reshape(b * t, nkv, hd),
+                    v.reshape(b * t, nkv, hd),
+                    slot_mapping,
+                )
+            )
+            return out
+
+        x = _layer_body(
+            cfg, lp, x, positions, attend, _lora_layer_slice(lora, i), lora_idx
+        )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return x, tuple(new_kv)
+
+
+def forward_context_parallel(
+    cfg: ModelConfig,
+    params: dict,
+    token_ids: jax.Array,  # (B, T) int32, T sharded over the sp mesh axis
+    lengths: jax.Array,  # (B,) true lengths (padding rows masked out)
+    mesh,  # jax.sharding.Mesh with an "sp" axis
+) -> tuple[jax.Array, jax.Array]:
+    """Long-context prefill with the SEQUENCE axis sharded over the mesh's sp
+    axis: every layer's attention runs as ring attention
+    (parallel/ring_attention.py — flash accumulation + ppermute K/V rotation),
+    so no device ever materializes the full (T, T) score matrix or the full
+    sequence's K/V. Projections/norms/MLP are token-parallel and shard over
+    sp for free under GSPMD.
+
+    Returns (hidden (B, T, h) sp-sharded, per-layer stacked KV
+    (L, 2, B, T, kvH, D) for the caller to commit into the paged pool).
+    The reference inherits this capability from its engines' context-parallel
+    attention; this is the TPU-native construction (SURVEY §2.4).
+    """
+    from ..parallel.ring_attention import ring_attention
+
+    b, t = token_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    kv_valid = positions < lengths[:, None]
+    x = params["embed"][token_ids].astype(_dtype(cfg))
+
+    kv_out: list[jax.Array] = []
+    for i in range(cfg.num_layers):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+
+        def attend(q, k, v):
+            kv_out.append(jnp.stack([k, v]).astype(_dtype(cfg)))
+            return ring_attention(
+                mesh, q, k, v, positions, kv_valid, scale=cfg.head_dim**-0.5
+            )
+
+        x = _layer_body(cfg, lp, x, positions, attend)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return x, jnp.stack(kv_out)
 
 
 def compute_logits(cfg: ModelConfig, params: dict, hidden: jax.Array) -> jax.Array:

@@ -82,6 +82,38 @@ def load_checkpoint_params(cfg: ModelConfig) -> dict:
         return np.stack([kind(fmt.format(i)) for i in range(cfg.num_layers)])
 
     p = "model.layers.{}."
+    if cfg.num_experts:
+        # Mixtral: block_sparse_moe.gate is the router; experts' w1/w3/w2
+        # are gate/up/down. Expert matrices stack along a leading E axis
+        # within each layer → (L, E, in, out)
+        def estack(fmt: str) -> np.ndarray:
+            return np.stack(
+                [
+                    np.stack(
+                        [
+                            mat(fmt.format(i, j))
+                            for j in range(cfg.num_experts)
+                        ]
+                    )
+                    for i in range(cfg.num_layers)
+                ]
+            )
+
+        ex = "model.layers.{0}.block_sparse_moe.experts.{1}."
+        mlp = {
+            "router": stack(p + "block_sparse_moe.gate.weight", mat),
+            "gate": estack(ex + "w1.weight"),
+            "up": estack(ex + "w3.weight"),
+            "down": estack(ex + "w2.weight"),
+        }
+        mlp_key = "moe"
+    else:
+        mlp = {
+            "gate": stack(p + "mlp.gate_proj.weight", mat),
+            "up": stack(p + "mlp.up_proj.weight", mat),
+            "down": stack(p + "mlp.down_proj.weight", mat),
+        }
+        mlp_key = "mlp"
     params: dict = {
         "embed": vec("model.embed_tokens.weight"),
         "layers": {
@@ -91,11 +123,7 @@ def load_checkpoint_params(cfg: ModelConfig) -> dict:
                 "wv": stack(p + "self_attn.v_proj.weight", mat),
                 "wo": stack(p + "self_attn.o_proj.weight", mat),
             },
-            "mlp": {
-                "gate": stack(p + "mlp.gate_proj.weight", mat),
-                "up": stack(p + "mlp.up_proj.weight", mat),
-                "down": stack(p + "mlp.down_proj.weight", mat),
-            },
+            mlp_key: mlp,
             "input_norm": stack(p + "input_layernorm.weight", vec),
             "post_attn_norm": stack(p + "post_attention_layernorm.weight", vec),
         },

@@ -47,12 +47,25 @@ PRESETS: dict[str, dict] = {
         max_model_len=8192, rope_theta=1000000.0, attention_bias=True,
         architecture="qwen2",
     ),
+    "mixtral-8x7b": dict(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        max_model_len=8192, rope_theta=1000000.0, architecture="mixtral",
+        num_experts=8, num_experts_per_tok=2,
+    ),
+    "tiny-mixtral": dict(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, max_model_len=256,
+        dtype="float32", architecture="mixtral", num_experts=4,
+        num_experts_per_tok=2,
+    ),
 }
 
 _ARCH_MAP = {
     "LlamaForCausalLM": "llama",
     "MistralForCausalLM": "llama",
     "Qwen2ForCausalLM": "qwen2",
+    "MixtralForCausalLM": "mixtral",
 }
 
 
@@ -88,7 +101,16 @@ def _from_hf_config(path: str) -> dict:
     if arch is None:
         raise ValueError(f"unsupported architecture(s) {archs} in {path}")
     heads = hf["num_attention_heads"]
+    moe = (
+        dict(
+            num_experts=hf["num_local_experts"],
+            num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        )
+        if arch == "mixtral"
+        else {}
+    )
     return dict(
+        **moe,
         model=path,
         architecture=arch,
         vocab_size=hf["vocab_size"],
@@ -97,7 +119,9 @@ def _from_hf_config(path: str) -> dict:
         num_layers=hf["num_hidden_layers"],
         num_heads=heads,
         num_kv_heads=hf.get("num_key_value_heads", heads),
-        head_dim=hf.get("head_dim", hf["hidden_size"] // heads),
+        # some configs carry an explicit null head_dim — fall through to the
+        # conventional hidden/heads in that case too
+        head_dim=hf.get("head_dim") or hf["hidden_size"] // heads,
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
         max_model_len=hf.get("max_position_embeddings", 4096),

@@ -8,6 +8,8 @@ all-gather / reduce-scatter) over ICI within a slice and DCN across slices.
 Axis convention (used by every PartitionSpec in this package):
   - "dp": data parallel (request batch replicas)
   - "tp": tensor parallel (megatron-style weight sharding; rides ICI)
+  - "sp": sequence/context parallel (ring attention over sequence shards
+          for long-context prefill; rides ICI next to tp)
   - "pp": pipeline stages (multi-slice / DCN)  [stage meshes, later rounds]
 """
 
@@ -20,6 +22,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DP_AXIS = "dp"
 TP_AXIS = "tp"
 PP_AXIS = "pp"
+SP_AXIS = "sp"
+EP_AXIS = "ep"
 
 
 def make_mesh(
@@ -27,28 +31,46 @@ def make_mesh(
     data_parallel_size: int = 1,
     pipeline_parallel_size: int = 1,
     devices: list | None = None,
+    sequence_parallel_size: int = 1,
+    expert_parallel_size: int = 1,
 ) -> Mesh:
-    """Build a (dp, pp, tp) mesh over the available devices.
+    """Build a (dp, pp, ep, sp, tp) mesh over the available devices.
 
     TP is the innermost axis so that its collectives map onto
     nearest-neighbour ICI links (the same reason the reference pins TP within
-    a node via /dev/shm + NVLink, deployment-vllm-multi.yaml:424-431); pp
-    sits between dp and tp so each stage is a contiguous tp group — on
-    multi-host deployments stage boundaries are the host/DCN boundaries
-    (the RayCluster replacement, ray-cluster.yaml:556-566).
+    a node via /dev/shm + NVLink, deployment-vllm-multi.yaml:424-431); sp sits
+    directly outside tp so the ring-attention ppermute hops are also
+    single-ICI-hop neighbours; pp is outermost-but-one so each stage is a
+    contiguous sp×tp group — on multi-host deployments stage boundaries are
+    the host/DCN boundaries (the RayCluster replacement,
+    ray-cluster.yaml:556-566).
     """
     devices = list(jax.devices()) if devices is None else list(devices)
-    want = tensor_parallel_size * data_parallel_size * pipeline_parallel_size
+    want = (
+        tensor_parallel_size
+        * data_parallel_size
+        * pipeline_parallel_size
+        * sequence_parallel_size
+        * expert_parallel_size
+    )
     if want > len(devices):
         raise ValueError(
             f"mesh needs {want} devices (tp={tensor_parallel_size} x "
-            f"dp={data_parallel_size} x pp={pipeline_parallel_size}) "
+            f"dp={data_parallel_size} x pp={pipeline_parallel_size} x "
+            f"sp={sequence_parallel_size} x ep={expert_parallel_size}) "
             f"but only {len(devices)} available"
         )
+    # sp stays adjacent to tp (innermost-but-one) so ring-attention ppermute
+    # hops are single-ICI-hop neighbours; the latency-tolerant ep psum sits
+    # outside both
     grid = np.array(devices[:want]).reshape(
-        data_parallel_size, pipeline_parallel_size, tensor_parallel_size
+        data_parallel_size,
+        pipeline_parallel_size,
+        expert_parallel_size,
+        sequence_parallel_size,
+        tensor_parallel_size,
     )
-    return Mesh(grid, (DP_AXIS, PP_AXIS, TP_AXIS))
+    return Mesh(grid, (DP_AXIS, PP_AXIS, EP_AXIS, SP_AXIS, TP_AXIS))
 
 
 def single_device_mesh() -> Mesh:

@@ -19,7 +19,7 @@ from __future__ import annotations
 from jax.sharding import PartitionSpec as P
 
 from ..engine.config import ModelConfig
-from .mesh import DP_AXIS, PP_AXIS, TP_AXIS
+from .mesh import DP_AXIS, EP_AXIS, PP_AXIS, TP_AXIS
 
 
 def llama_param_specs(cfg: ModelConfig) -> dict:
@@ -47,15 +47,29 @@ def llama_param_specs(cfg: ModelConfig) -> dict:
             "bk": P(PP_AXIS, TP_AXIS),
             "bv": P(PP_AXIS, TP_AXIS),
         }
+    if cfg.num_experts:
+        # Mixtral MoE: expert axis over ep (each device holds E/ep experts —
+        # the reason ep exists: 8x7B expert weights don't fit one chip),
+        # inner axis over tp; GSPMD psums the combine over ep and tp
+        mlp = {
+            "router": P(PP_AXIS, None, None),  # [L, hidden, E] tiny
+            "gate": P(PP_AXIS, EP_AXIS, None, TP_AXIS),  # [L, E, h, inter]
+            "up": P(PP_AXIS, EP_AXIS, None, TP_AXIS),
+            "down": P(PP_AXIS, EP_AXIS, TP_AXIS, None),  # [L, E, inter, h]
+        }
+        mlp_key = "moe"
+    else:
+        mlp = {
+            "gate": P(PP_AXIS, None, TP_AXIS),  # [L, hidden, inter]
+            "up": P(PP_AXIS, None, TP_AXIS),
+            "down": P(PP_AXIS, TP_AXIS, None),  # [L, inter, hidden]
+        }
+        mlp_key = "mlp"
     specs = {
         "embed": P(TP_AXIS, None),  # [vocab, hidden] vocab-sharded
         "layers": {
             "attn": attn,
-            "mlp": {
-                "gate": P(PP_AXIS, None, TP_AXIS),  # [L, hidden, inter]
-                "up": P(PP_AXIS, None, TP_AXIS),
-                "down": P(PP_AXIS, TP_AXIS, None),  # [L, inter, hidden]
-            },
+            mlp_key: mlp,
             "input_norm": P(PP_AXIS, None),
             "post_attn_norm": P(PP_AXIS, None),
         },
