@@ -1,0 +1,149 @@
+// Native batch KV chain-hasher for the engine's content-addressed prefix
+// cache (engine/kv_cache.py).
+//
+// The reference stack's KV indexing lives in LMCache's native token-hash
+// path; here block identity is chain = sha256(parent_128 || block_tokens)
+// truncated to 128 bits (kv_cache.py:chain_hash). Hashing runs on the host
+// for EVERY prompt admission and /kv/lookup probe — at 256 concurrent
+// requests x thousands of prompt tokens that is tens of thousands of
+// sha256 calls per scheduling wave, where the Python per-block byte packing
+// dominates. This extension computes a whole prompt's chain in ONE call.
+//
+// Byte-exact contract with the Python implementation:
+//   digest = sha256( parent.to_bytes(16, 'little')
+//                    || each token int64 little-endian signed )
+//   next_parent = int.from_bytes(digest[:16], 'little')
+//
+// Built as a plain shared library (no pybind11 in this image); bound via
+// ctypes from vllm_production_stack_tpu/utils/native.py.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// ---- SHA-256 (FIPS 180-4) -------------------------------------------------
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+struct Sha256 {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  uint8_t buf[64];
+  uint64_t bytes = 0;
+
+  void compress(const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t* p, size_t n) {
+    size_t fill = bytes % 64;
+    bytes += n;
+    if (fill) {
+      size_t take = 64 - fill < n ? 64 - fill : n;
+      std::memcpy(buf + fill, p, take);
+      p += take;
+      n -= take;
+      if (fill + take == 64) compress(buf);
+      else return;
+    }
+    while (n >= 64) {
+      compress(p);
+      p += 64;
+      n -= 64;
+    }
+    if (n) std::memcpy(buf, p, n);
+  }
+
+  // first 16 digest bytes as a little-endian 128-bit integer (lo, hi)
+  void final16(uint64_t* lo, uint64_t* hi) {
+    uint64_t bitlen = bytes * 8;
+    uint8_t pad[72] = {0x80};
+    size_t fill = bytes % 64;
+    size_t padlen = (fill < 56) ? 56 - fill : 120 - fill;
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bitlen >> (56 - 8 * i));
+    update(pad, padlen);
+    update(lenb, 8);
+    uint8_t d[16];
+    for (int i = 0; i < 4; i++) {
+      d[4 * i] = uint8_t(h[i] >> 24);
+      d[4 * i + 1] = uint8_t(h[i] >> 16);
+      d[4 * i + 2] = uint8_t(h[i] >> 8);
+      d[4 * i + 3] = uint8_t(h[i]);
+    }
+    uint64_t l = 0, g = 0;
+    for (int i = 0; i < 8; i++) l |= uint64_t(d[i]) << (8 * i);
+    for (int i = 0; i < 8; i++) g |= uint64_t(d[8 + i]) << (8 * i);
+    *lo = l;
+    *hi = g;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Compute the chain hashes of every FULL block of a prompt in one call.
+//   parent_lo/hi : 128-bit chain root (little-endian halves)
+//   tokens       : the prompt's token ids (int64)
+//   n_tokens     : prompt length; n_full = n_tokens / block_size blocks hash
+//   out_lo/out_hi: n_full entries, the chain hash after each block
+// Returns n_full.
+int64_t kvhash_chain(uint64_t parent_lo, uint64_t parent_hi,
+                     const int64_t* tokens, int64_t n_tokens,
+                     int64_t block_size, uint64_t* out_lo, uint64_t* out_hi) {
+  if (block_size <= 0) return 0;
+  int64_t n_full = n_tokens / block_size;
+  for (int64_t b = 0; b < n_full; b++) {
+    Sha256 s;
+    uint8_t parent[16];
+    for (int i = 0; i < 8; i++) parent[i] = uint8_t(parent_lo >> (8 * i));
+    for (int i = 0; i < 8; i++) parent[8 + i] = uint8_t(parent_hi >> (8 * i));
+    s.update(parent, 16);
+    // tokens are written little-endian int64 (two's complement covers the
+    // signed=True of the Python packing)
+    s.update(reinterpret_cast<const uint8_t*>(tokens + b * block_size),
+             size_t(block_size) * 8);
+    s.final16(&parent_lo, &parent_hi);
+    out_lo[b] = parent_lo;
+    out_hi[b] = parent_hi;
+  }
+  return n_full;
+}
+}

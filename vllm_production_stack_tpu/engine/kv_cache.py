@@ -70,6 +70,14 @@ class KVBlockPool:
         # refcount-0 cached blocks, LRU order (oldest first -> evicted first)
         self._evictable: OrderedDict[int, None] = OrderedDict()
         self.stats = CacheStats()
+        if enable_prefix_caching:
+            # warm the native batch hasher NOW (pool construction = engine
+            # init, where XLA compiles already dominate) — never lazily from
+            # the admission path, where a cold g++ build would stall the
+            # first request and everything queued behind it
+            from ..utils.native import chain_hashes_native
+
+            chain_hashes_native(_ROOT_HASH, [0] * block_size, block_size)
 
     # -- capacity ----------------------------------------------------------
 
@@ -126,7 +134,15 @@ class KVBlockPool:
         """Yield the chain hash of each FULL block of the prompt, in order —
         the single definition of block identity shared by match_prefix and
         match_length (so the /kv/lookup probe can never diverge from what a
-        real match would reuse)."""
+        real match would reuse). Uses the native batch hasher
+        (csrc/kvhash.cpp via utils/native.py) when available — one C call per
+        prompt instead of one Python sha256 round-trip per block."""
+        from ..utils.native import chain_hashes_native
+
+        hashes = chain_hashes_native(parent, token_ids, self.block_size)
+        if hashes is not None:
+            yield from hashes
+            return
         n_full = len(token_ids) // self.block_size
         for i in range(n_full):
             chunk = tuple(
