@@ -53,9 +53,10 @@ def main() -> None:
             # cached full blocks) land in a small bucket instead of padding
             # back up to prompt_len
             prefill_buckets=(32, prompt_len, 2048, n_seqs * prompt_len),
-            # dispatch overhead (~160 ms tunnel RTT) amortizes across
-            # window x batch = 16K tokens per fused decode dispatch
-            decode_window=64,
+            # dispatch + per-window fixed cost (~90-160 ms: tunnel RTT,
+            # hoisted history gather) amortizes across window x batch = 32K
+            # tokens — the whole generation is ONE fused decode dispatch
+            decode_window=128,
         ),
         parallel=ParallelConfig(tensor_parallel_size=1),
     )

@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import llama
+from ..ops.attention import gather_pages
 from ..parallel import mesh as mesh_lib
 from ..parallel.sharding import (
     kv_cache_spec,
@@ -162,6 +163,7 @@ class ModelRunner:
                 "the ep axis would only replicate dense compute"
             )
         self._attention_backend = self._resolve_attention_backend()
+        self._hoist_budget = self._compute_hoist_budget()
         self._step_fn = (
             self._build_sp_step_fn() if self._sp > 1 else self._build_step_fn()
         )
@@ -195,6 +197,55 @@ class ModelRunner:
                 "only (no GSPMD partition rule for pallas_call)"
             )
         return backend
+
+    def _compute_hoist_budget(self) -> int:
+        """Per-device HBM headroom (bytes) available for hoisting the decode
+        window's loop-invariant history gather out of the loop (one
+        contiguous per-layer K/V copy per window instead of a fresh gather
+        per iteration — the measured decode bottleneck; see
+        ops/attention.py:attention_with_hist). Headroom = HBM − pool −
+        weights − reserve; each compiled window program compares its own
+        static (batch, context) hoist footprint against this and falls back
+        to the per-iteration gather when it doesn't fit."""
+        from .memory import (
+            RESERVE_BYTES,
+            device_hbm_bytes,
+            kv_block_bytes,
+            param_bytes,
+        )
+
+        par = self.config.parallel
+        tp, pp = par.tensor_parallel_size, par.pipeline_parallel_size
+        pool = self.config.cache.num_blocks * kv_block_bytes(
+            self.config.model, self.config.cache.block_size, tp, pp
+        )
+        return max(
+            0,
+            device_hbm_bytes()
+            - pool
+            - param_bytes(self.config.model, tp, pp)
+            - RESERVE_BYTES,
+        )
+
+    def _hoist_bytes(self, batch: int, s_ctx: int) -> int:
+        """Per-device bytes of hoisted contiguous history for one window
+        program: all layers' (B, S, kvH, D) K+V, batch sharded over dp and
+        kv heads over tp. Expressed via memory.kv_block_bytes so the hoist
+        budget can never diverge from the pool accounting it is compared
+        against."""
+        from .memory import kv_block_bytes
+
+        par = self.config.parallel
+        block_size = self.config.cache.block_size
+        b_local = max(1, batch // self._dp)
+        return (
+            b_local
+            * (s_ctx // block_size)
+            * kv_block_bytes(
+                self.config.model, block_size,
+                par.tensor_parallel_size, par.pipeline_parallel_size,
+            )
+        )
 
     # -- compiled step -----------------------------------------------------
 
@@ -325,6 +376,22 @@ class ModelRunner:
             b = first_tokens.shape[0]
             out = jnp.zeros((b, window), jnp.int32)
             staged = llama.init_staged_kv(cfg, window, b)
+            # hoist the loop-invariant history gather out of the window loop
+            # when this program's contiguous copy fits HBM headroom (static
+            # per compiled (batch, nb, window) program — no runtime branch)
+            s_ctx = block_tables.shape[1] * self.config.cache.block_size
+            hoist = (
+                self._attention_backend == "xla"
+                and self._hoist_bytes(b, s_ctx) <= self._hoist_budget
+            )
+            hists = (
+                tuple(
+                    gather_pages(kv_caches[i], block_tables)
+                    for i in range(cfg.num_layers)
+                )
+                if hoist
+                else None
+            )
 
             def body(k, carry):
                 staged, cur, out = carry
@@ -334,7 +401,7 @@ class ModelRunner:
                     cfg, params, cur, positions0 + k, kv_caches,
                     block_tables, staged, k, positions0,
                     backend=self._attention_backend,
-                    lora=lora_params, lora_idx=lora_idx,
+                    lora=lora_params, lora_idx=lora_idx, hists=hists,
                 )
                 logits = llama.compute_logits(cfg, params, hidden)
                 toks = sample(

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from ..engine.config import ModelConfig
 from ..ops.attention import (
     apply_rope,
+    attention_with_hist,
     causal_page_mask,
     gather_pages,
     masked_attention,
@@ -359,19 +360,26 @@ def decode_window_step(
     backend: str = "xla",  # "xla" | "pallas" (TPU kernel) | "pallas_interpret"
     lora: dict | None = None,  # stacked adapter tree (init_lora_params)
     lora_idx: jax.Array | None = None,  # (B,) adapter slot per row
+    hists: tuple | None = None,  # per-layer pre-gathered (hist_k, hist_v)
 ) -> tuple[jax.Array, jax.Array]:
     """One decode iteration inside a fused window: reads the pool, writes this
     token's K/V into `staged` (not the pool — the pool stays loop-invariant so
     XLA doesn't ping-pong it through the loop carry; see
-    ops/attention.py:paged_attention_with_staged). Returns (hidden (B, h),
-    staged')."""
+    ops/attention.py:paged_attention_with_staged). When the runner hoisted the
+    loop-invariant history gather out of the window loop, `hists` carries the
+    contiguous per-layer (hist_k, hist_v) and the pool is not touched here
+    (ops/attention.py:attention_with_hist). Returns (hidden (B, h), staged')."""
     hd = cfg.head_dim
     window = staged.shape[2]
     x = params["embed"][token_ids].astype(_dtype(cfg))[:, None]  # (B, 1, h)
     # staged slot w is attendable once written: w <= k
     staged_mask = jnp.arange(window, dtype=jnp.int32) <= step_k
     if backend == "xla":
-        s_ctx = block_tables.shape[1] * kv_caches[0].shape[2]
+        s_ctx = (
+            hists[0][0].shape[1]
+            if hists is not None
+            else block_tables.shape[1] * kv_caches[0].shape[2]
+        )
         hist_mask = (
             jnp.arange(s_ctx, dtype=jnp.int32)[None, :] < hist_len[:, None]
         )
@@ -384,6 +392,12 @@ def decode_window_step(
             staged = staged.at[i, 0, step_k].set(k[:, 0].astype(staged.dtype))
             staged = staged.at[i, 1, step_k].set(v[:, 0].astype(staged.dtype))
             if backend == "xla":
+                if hists is not None:
+                    return attention_with_hist(
+                        q, hists[i][0], hists[i][1], hist_mask,
+                        staged[i, 0], staged[i, 1], staged_mask,
+                        scale=hd**-0.5,
+                    )
                 return paged_attention_with_staged(
                     q, kv_caches[i], block_tables, hist_mask,
                     staged[i, 0], staged[i, 1], staged_mask, scale=hd**-0.5,

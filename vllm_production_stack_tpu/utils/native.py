@@ -37,11 +37,20 @@ _KVHASH: ctypes.CDLL | None = None
 _KVHASH_FAILED = False
 
 
-def _build_dir() -> str:
+def _build_dir() -> str | None:
     d = os.environ.get("VLLM_TPU_NATIVE_CACHE") or os.path.join(
         tempfile.gettempdir(), f"vllm-tpu-native-{os.getuid()}"
     )
-    os.makedirs(d, exist_ok=True)
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    # refuse a cache dir we don't own: on a multi-user host an attacker could
+    # pre-create the predictable path and plant a .so that CDLL would execute
+    st = os.stat(d)
+    if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+        logger.warning(
+            "native cache dir %s is not private to this user; "
+            "refusing to load native libraries from it", d,
+        )
+        return None
     return d
 
 
@@ -54,9 +63,12 @@ def _compile(name: str) -> str | None:
     src = os.path.join(_CSRC, f"{name}.cpp")
     if not os.path.exists(src):
         return None
+    build_dir = _build_dir()
+    if build_dir is None:
+        return None
     with open(src, "rb") as f:
         tag = hashlib.sha256(f.read()).hexdigest()[:16]
-    out = os.path.join(_build_dir(), f"lib{name}-{tag}.so")
+    out = os.path.join(build_dir, f"lib{name}-{tag}.so")
     if os.path.exists(out):
         return out
     tmp = out + f".tmp{os.getpid()}"
