@@ -212,3 +212,35 @@ def test_warmup_compiles_bucket_set():
         SamplingParams(max_tokens=3, temperature=0.0, ignore_eos=True),
     )
     assert len(out[0]["token_ids"]) == 3
+
+
+def test_midblock_chunked_prefill_matches_unchunked():
+    """Chunk sizes that are NOT multiples of the block size force every
+    continuation chunk to start mid-block — the blockwise KV commit
+    (ops/attention.py:write_kv_pages_blockwise) must merge, not clobber, the
+    earlier chunk's tokens in the shared page."""
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, ModelConfig, SchedulerConfig,
+    )
+
+    cfg = ModelConfig.tiny()
+
+    def build(chunk):
+        return LLMEngine(
+            EngineConfig(
+                model=cfg,
+                cache=CacheConfig(block_size=8, num_blocks=64),
+                scheduler=SchedulerConfig(
+                    max_num_seqs=4, max_num_batched_tokens=chunk,
+                    decode_buckets=(4,), prefill_buckets=(chunk, 32),
+                    decode_window=4,
+                ),
+            )
+        )
+
+    prompts = [prompt_ids(40 + i, 29 + 5 * i) for i in range(3)]
+    greedy = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    # chunk 12 with block 8: chunks start at offsets 12, 24, ... (mid-block)
+    chunked = [r["token_ids"] for r in build(12).generate(prompts, greedy)]
+    whole = [r["token_ids"] for r in build(64).generate(prompts, greedy)]
+    assert chunked == whole

@@ -90,6 +90,7 @@ class LLMEngine:
         self.scheduler = Scheduler(
             config.model, config.cache, config.scheduler,
             host_tier=self.host_tier,
+            need_slot_mappings=config.parallel.sequence_parallel_size > 1,
         )
         self._states: dict[str, _RequestState] = {}
         self._lora_slots: dict[str, int] = {}  # adapter name -> slot index

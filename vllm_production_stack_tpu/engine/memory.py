@@ -8,6 +8,8 @@ layouts), so sizing needs no trial allocation."""
 
 from __future__ import annotations
 
+import math
+
 import jax
 import numpy as np
 
@@ -21,6 +23,12 @@ DEFAULT_HBM_BYTES = 16 * 1024**3  # v5e-class chip
 RESERVE_BYTES = 1024**3
 # extra pool capacity beyond live-sequence needs, kept as LRU prefix-cache room
 PREFIX_CACHE_OVERPROVISION = 4
+# context tokens per row the pool sizing sets aside (inside hbm_utilization)
+# for the decode window's hoisted contiguous history copy — the runner hoists
+# the loop-invariant gather only for programs whose footprint fits this
+# funded headroom (model_runner._compute_hoist_budget); contexts past the
+# allowance fall back to the per-iteration gather
+HOIST_CTX_TOKENS = 256
 
 
 def dtype_bytes(dtype: str) -> int:
@@ -59,6 +67,47 @@ def kv_block_bytes(cfg: ModelConfig, block_size: int, tp: int = 1,
     ) // pp)
 
 
+def hoist_reserve_bytes(
+    model: ModelConfig,
+    cache: CacheConfig,
+    parallel: ParallelConfig,
+    max_num_seqs: int | None,
+) -> int:
+    """Per-device bytes set aside for hoisted decode-window history
+    (HOIST_CTX_TOKENS of context per live row; same kv_block_bytes layout
+    arithmetic the budget check uses, so funded == admissible)."""
+    if max_num_seqs is None:
+        return 0
+    tokens = min(model.max_model_len, HOIST_CTX_TOKENS)
+    blocks = math.ceil(tokens / cache.block_size)
+    b_local = math.ceil(max_num_seqs / parallel.data_parallel_size)
+    return b_local * blocks * kv_block_bytes(
+        model, cache.block_size, parallel.tensor_parallel_size,
+        parallel.pipeline_parallel_size,
+    )
+
+
+def headroom_budget(
+    model: ModelConfig,
+    cache: CacheConfig,
+    parallel: ParallelConfig,
+    hbm_bytes: int | None = None,
+) -> int:
+    """Utilization-capped HBM minus weights minus reserve — the ONE
+    definition of the engine's allocatable budget, shared by pool sizing
+    (derive_num_blocks) and the runner's hoist admission
+    (model_runner._compute_hoist_budget) so the two can't drift."""
+    hbm = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
+    return (
+        int(hbm * cache.hbm_utilization)
+        - param_bytes(
+            model, parallel.tensor_parallel_size,
+            parallel.pipeline_parallel_size,
+        )
+        - RESERVE_BYTES
+    )
+
+
 def device_hbm_bytes() -> int:
     try:
         stats = jax.local_devices()[0].memory_stats()
@@ -88,11 +137,9 @@ def derive_num_blocks(
     hbm = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
     tp = parallel.tensor_parallel_size
     pp = parallel.pipeline_parallel_size
-    budget = (
-        int(hbm * cache.hbm_utilization)
-        - param_bytes(model, tp, pp)
-        - RESERVE_BYTES
-    )
+    budget = headroom_budget(
+        model, cache, parallel, hbm
+    ) - hoist_reserve_bytes(model, cache, parallel, max_num_seqs)
     per_block = kv_block_bytes(model, cache.block_size, tp, pp)
     # pp shards the block axis, so the pool must hold >= pp blocks (and the
     # pp-divisibility rounding below must never round UP past the budget)

@@ -203,28 +203,22 @@ class ModelRunner:
         window's loop-invariant history gather out of the loop (one
         contiguous per-layer K/V copy per window instead of a fresh gather
         per iteration — the measured decode bottleneck; see
-        ops/attention.py:attention_with_hist). Headroom = HBM − pool −
-        weights − reserve; each compiled window program compares its own
-        static (batch, context) hoist footprint against this and falls back
-        to the per-iteration gather when it doesn't fit."""
-        from .memory import (
-            RESERVE_BYTES,
-            device_hbm_bytes,
-            kv_block_bytes,
-            param_bytes,
-        )
+        ops/attention.py:attention_with_hist). Headroom = utilization-capped
+        HBM − pool − weights − reserve; each compiled window program compares
+        its own static (batch, context) hoist footprint against this and
+        falls back to the per-iteration gather when it doesn't fit. The cap
+        matters: memory the operator withheld via hbm_utilization (co-located
+        workloads) must not be absorbed by hoisted copies."""
+        from .memory import headroom_budget, kv_block_bytes
 
         par = self.config.parallel
-        tp, pp = par.tensor_parallel_size, par.pipeline_parallel_size
         pool = self.config.cache.num_blocks * kv_block_bytes(
-            self.config.model, self.config.cache.block_size, tp, pp
+            self.config.model, self.config.cache.block_size,
+            par.tensor_parallel_size, par.pipeline_parallel_size,
         )
         return max(
             0,
-            device_hbm_bytes()
-            - pool
-            - param_bytes(self.config.model, tp, pp)
-            - RESERVE_BYTES,
+            headroom_budget(self.config.model, self.config.cache, par) - pool,
         )
 
     def _hoist_bytes(self, batch: int, s_ctx: int) -> int:
@@ -260,9 +254,11 @@ class ModelRunner:
             token_ids,  # (B, T)
             positions,  # (B, T)
             block_tables,  # (B, max_blocks)
-            slot_mapping,  # (B*T,)
+            slot_mapping,  # (1,) placeholder — only the sp path row-scatters
             context_lens,  # (B,)
-            chunk_lens,  # (B,) real chunk tokens (used by the sp path only)
+            chunk_lens,  # (B,) real chunk tokens this step
+            write_ids,  # (B, NBW) pool blocks of the chunk's written span
+            start_off,  # (B,) chunk's first-token offset in its first block
             lora_idx,  # (B,) adapter slot per row (None when disabled)
             sample_rows,  # (num_samples,) row index into (B*T) flat hidden
             temperature,  # (num_samples,)
@@ -273,11 +269,15 @@ class ModelRunner:
             has_seed,  # (num_samples,) bool
             counts,  # (num_samples,) int32 output tokens so far
         ):
-            del chunk_lens  # paged path masks purely by context_lens
             hidden, kv_caches = llama.forward(
                 cfg, params, token_ids, positions, kv_caches,
                 block_tables, slot_mapping, context_lens,
                 lora=lora_params, lora_idx=lora_idx,
+                write_blocks={
+                    "ids": write_ids,
+                    "start_off": start_off,
+                    "chunk_lens": chunk_lens,
+                },
             )
             flat = hidden.reshape(-1, hidden.shape[-1])
             picked = flat[sample_rows]  # (num_samples, h)
@@ -308,6 +308,8 @@ class ModelRunner:
             slot_mapping,  # (B*T,)
             context_lens,  # (B,) resident AFTER this chunk
             chunk_lens,  # (B,) real chunk tokens this step
+            write_ids,  # unused: the sp path row-scatters (sharded over sp)
+            start_off,  # unused
             lora_idx,
             sample_rows,
             temperature,
@@ -318,6 +320,7 @@ class ModelRunner:
             has_seed,
             counts,
         ):
+            del write_ids, start_off
             hist_lens = context_lens - chunk_lens
             hidden, kv_caches = llama.forward_sp_prefill(
                 cfg, params, token_ids, positions, kv_caches, block_tables,
@@ -447,9 +450,21 @@ class ModelRunner:
 
         token_ids = np.zeros((b_pad, t_pad), np.int32)
         positions = np.zeros((b_pad, t_pad), np.int32)
-        slots = np.zeros((b_pad, t_pad), np.int32)  # padding -> null page
+        # per-token slots feed only the sp path's row scatter; the paged path
+        # commits blockwise (write_ids below) and takes a placeholder
+        slots = (
+            np.zeros((b_pad, t_pad), np.int32)  # padding -> null page
+            if self._sp > 1
+            else None
+        )
         context_lens = np.zeros(b_pad, np.int32)
         chunk_lens = np.zeros(b_pad, np.int32)
+        # blockwise KV commit: a T_pad chunk starting at worst-case offset
+        # bs-1 spans (T_pad-1)//bs + 2 pool pages; padding -> null page
+        bs = self.config.cache.block_size
+        nbw = (t_pad - 1) // bs + 2
+        write_ids = np.zeros((b_pad, nbw), np.int32)
+        start_off = np.zeros(b_pad, np.int32)
         sample_rows = np.zeros(b_pad, np.int32)
         temps = np.zeros(b_pad, np.float32)
         top_ps = np.ones(b_pad, np.float32)
@@ -460,9 +475,15 @@ class ModelRunner:
             row = work.token_ids[i]
             token_ids[i, : len(row)] = row
             positions[i, : len(row)] = work.positions[i]
-            slots[i, : len(row)] = work.slot_mappings[i]
+            if slots is not None:
+                slots[i, : len(row)] = work.slot_mappings[i]
             context_lens[i] = work.context_lens[i]
             chunk_lens[i] = len(row)
+            hist = work.context_lens[i] - len(row)
+            first_blk = hist // bs
+            n_span = (work.context_lens[i] - 1) // bs - first_blk + 1
+            write_ids[i, :n_span] = req.block_table[first_blk : first_blk + n_span]
+            start_off[i] = hist % bs
             sample_rows[i] = i * t_pad + len(row) - 1
             s = req.sampling
             temps[i], top_ps[i], top_ks[i] = s.temperature, s.top_p, s.top_k
@@ -475,9 +496,10 @@ class ModelRunner:
         for i, req in enumerate(work.requests):
             lora_idx[i] = req.lora_index
         tokens = self._run(
-            token_ids, positions, block_tables, slots.reshape(-1), context_lens,
-            chunk_lens, lora_idx, sample_rows, temps, top_ps, top_ks,
-            seeds=seeds, counts=counts,
+            token_ids, positions, block_tables,
+            slots.reshape(-1) if slots is not None else np.zeros(1, np.int32),
+            context_lens, chunk_lens, write_ids, start_off, lora_idx,
+            sample_rows, temps, top_ps, top_ks, seeds=seeds, counts=counts,
         )
         return [
             [int(tokens[i])] if work.sample[i] else [] for i in range(b)
@@ -533,8 +555,8 @@ class ModelRunner:
 
     def _run(
         self, token_ids, positions, block_tables, slots, context_lens,
-        chunk_lens, lora_idx, sample_rows, temps, top_ps, top_ks, seeds,
-        counts,
+        chunk_lens, write_ids, start_off, lora_idx, sample_rows, temps,
+        top_ps, top_ks, seeds, counts,
     ):
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
@@ -553,9 +575,13 @@ class ModelRunner:
             self._put(token_ids, tok_sh),
             self._put(positions, tok_sh),
             self._put(block_tables, self._batch2),
-            self._put(slots, self._batch1),  # (B*T,) — B divisible by dp
+            # (B*T,) for the sp path (B divisible by dp); (1,) placeholder
+            # (replicated) for the paged path
+            self._put(slots, self._batch1 if self._sp > 1 else self._rep),
             self._put(context_lens, self._batch1),
             self._put(chunk_lens, self._batch1),
+            self._put(write_ids, self._batch2),
+            self._put(start_off, self._batch1),
             self._put(lora_idx, self._batch1) if self._use_lora else None,
             self._put(sample_rows, self._batch1),
             self._put(np.asarray(temps, np.float32), self._batch1),

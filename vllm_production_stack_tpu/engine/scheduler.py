@@ -75,11 +75,16 @@ class Scheduler:
         cache_config: CacheConfig,
         scheduler_config: SchedulerConfig,
         host_tier=None,
+        need_slot_mappings: bool = False,
     ):
         self.model_config = model_config
         self.cache_config = cache_config
         self.config = scheduler_config
         self.block_size = cache_config.block_size
+        # per-token slot mappings feed only the sp>1 prefill path (row
+        # scatter); the paged path commits blockwise, so skipping ~T Python
+        # _slot calls per scheduled chunk keeps the host off the hot path
+        self.need_slot_mappings = need_slot_mappings
         self.pool = KVBlockPool(
             cache_config.num_blocks,
             cache_config.block_size,
@@ -211,7 +216,11 @@ class Scheduler:
             request=req,
             token_ids=[req.token_at(i) for i in idxs],
             positions=list(idxs),
-            slot_mapping=[self._slot(req, i) for i in idxs],
+            slot_mapping=(
+                [self._slot(req, i) for i in idxs]
+                if self.need_slot_mappings
+                else []
+            ),
             context_len=start + chunk,
             # sample only when this chunk completes a *fresh* prompt; resumed
             # requests already know their next token

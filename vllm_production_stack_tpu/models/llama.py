@@ -29,6 +29,7 @@ from ..ops.attention import (
     paged_attention_with_staged,
     paged_attention_xla,
     write_kv_pages,
+    write_kv_pages_blockwise,
 )
 from ..ops.paged_attention_pallas import paged_decode_attention
 
@@ -90,11 +91,35 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> dict:
     return params
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, add_one: bool = False
+) -> jax.Array:
+    """Llama convention: normalize, cast to input dtype, scale by weight.
+    Gemma (add_one): weights are stored as (w - 1) and the scale by (1 + w)
+    happens in float32 BEFORE the downcast — both match their HF reference
+    bit-for-bit in f32."""
     dt = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return (xf * jax.lax.rsqrt(var + eps)).astype(dt) * weight
+    normed = xf * jax.lax.rsqrt(var + eps)
+    if add_one:
+        return (normed * (1.0 + weight.astype(jnp.float32))).astype(dt)
+    return normed.astype(dt) * weight
+
+
+def _activation(cfg: ModelConfig):
+    if cfg.hidden_act == "silu":
+        return jax.nn.silu
+    if cfg.hidden_act == "gelu_tanh":  # Gemma GeGLU
+        return lambda x: jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"unknown hidden_act {cfg.hidden_act!r}")
+
+
+def _embed(cfg: ModelConfig, params: dict, token_ids: jax.Array) -> jax.Array:
+    x = params["embed"][token_ids].astype(_dtype(cfg))
+    if cfg.scale_embeddings:  # Gemma: sqrt(h) in the embedding dtype
+        x = x * jnp.asarray(cfg.hidden_size**0.5, _dtype(cfg))
+    return x
 
 
 def init_kv_cache(
@@ -205,7 +230,7 @@ def _layer_body(
             return xin @ w
 
     res = x
-    x = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
     ap = lp["attn"]
     q = proj(x, ap["wq"], "q_proj")
     k = proj(x, ap["wk"], "k_proj")
@@ -220,11 +245,11 @@ def _layer_body(
     x = res + proj(attn, ap["wo"], "o_proj")
 
     res = x
-    x = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
     if "moe" in lp:
         return res + _moe_mlp(cfg, lp["moe"], x)
     mp = lp["mlp"]
-    inner = jax.nn.silu(proj(x, mp["gate"], "gate_proj")) * proj(
+    inner = _activation(cfg)(proj(x, mp["gate"], "gate_proj")) * proj(
         x, mp["up"], "up_proj"
     )
     return res + proj(inner, mp["down"], "down_proj")
@@ -252,7 +277,7 @@ def _moe_mlp(cfg: ModelConfig, mp: dict, x: jax.Array) -> jax.Array:
     w = jnp.sum(
         jax.nn.one_hot(topi, e, dtype=jnp.float32) * topv[..., None], axis=-2
     )
-    inner = jax.nn.silu(
+    inner = _activation(cfg)(
         jnp.einsum("bth,ehi->btei", x, mp["gate"])
     ) * jnp.einsum("bth,ehi->btei", x, mp["up"])
     out = jnp.einsum("btei,eih->bteh", inner, mp["down"])
@@ -282,16 +307,23 @@ def _layer(
     mask: jax.Array,
     lora: dict | None = None,
     lora_idx: jax.Array | None = None,
+    write_blocks: dict | None = None,  # blockwise-write inputs (see forward)
 ) -> tuple[jax.Array, jax.Array]:
     b, t = x.shape[0], x.shape[1]
     hd, nkv = cfg.head_dim, cfg.num_kv_heads
 
     def attend(q, k, v):
         nonlocal kv_layer
-        kv_layer = write_kv_pages(
-            kv_layer, k.reshape(b * t, nkv, hd), v.reshape(b * t, nkv, hd),
-            slot_mapping,
-        )
+        if write_blocks is not None:
+            kv_layer = write_kv_pages_blockwise(
+                kv_layer, k, v, write_blocks["ids"],
+                write_blocks["start_off"], write_blocks["chunk_lens"],
+            )
+        else:
+            kv_layer = write_kv_pages(
+                kv_layer, k.reshape(b * t, nkv, hd),
+                v.reshape(b * t, nkv, hd), slot_mapping,
+            )
         return paged_attention_xla(
             q, kv_layer, block_tables, mask, scale=hd**-0.5
         )
@@ -311,10 +343,15 @@ def forward(
     context_lens: jax.Array,  # (B,) tokens resident after this step
     lora: dict | None = None,  # stacked adapter tree (init_lora_params)
     lora_idx: jax.Array | None = None,  # (B,) adapter slot per row
+    write_blocks: dict | None = None,  # {"ids": (B, NBW) written-span pool
+    #   blocks, "start_off": (B,), "chunk_lens": (B,)} — when given, chunk
+    #   K/V commits via the page-granular read-modify-write
+    #   (ops/attention.py:write_kv_pages_blockwise) instead of the per-token
+    #   row scatter; the serving prefill path passes this
 ) -> tuple[jax.Array, jax.Array]:
     """One model step over a token batch. Prefill is (B=1, T=chunk); decode is
     (B=batch, T=1). Returns (hidden (B,T,h), updated kv_caches)."""
-    x = params["embed"][token_ids].astype(_dtype(cfg))
+    x = _embed(cfg, params, token_ids)
     # layer-invariant attention mask, built once and reused by every layer
     s_ctx = block_tables.shape[1] * kv_caches[0].shape[2]
     mask = causal_page_mask(positions, context_lens, s_ctx)
@@ -328,10 +365,10 @@ def forward(
         lp = jax.tree.map(lambda a: a[i], params["layers"])
         x, layer_kv = _layer(
             cfg, lp, kv_caches[i], x, positions, block_tables, slot_mapping,
-            mask, _lora_layer_slice(lora, i), lora_idx,
+            mask, _lora_layer_slice(lora, i), lora_idx, write_blocks,
         )
         new_kv.append(layer_kv)
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
     return x, tuple(new_kv)
 
 
@@ -371,7 +408,7 @@ def decode_window_step(
     (ops/attention.py:attention_with_hist). Returns (hidden (B, h), staged')."""
     hd = cfg.head_dim
     window = staged.shape[2]
-    x = params["embed"][token_ids].astype(_dtype(cfg))[:, None]  # (B, 1, h)
+    x = _embed(cfg, params, token_ids)[:, None]  # (B, 1, h)
     # staged slot w is attendable once written: w <= k
     staged_mask = jnp.arange(window, dtype=jnp.int32) <= step_k
     if backend == "xla":
@@ -412,7 +449,7 @@ def decode_window_step(
             cfg, lp, x, positions[:, None], attend,
             _lora_layer_slice(lora, i), lora_idx,
         )
-    x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
     return x, staged
 
 
@@ -444,7 +481,7 @@ def embed_encode(
     Returns (B, h) float32."""
     b, t = token_ids.shape
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
-    x = params["embed"][token_ids].astype(_dtype(cfg))
+    x = _embed(cfg, params, token_ids)
     mask = causal_page_mask(positions, lengths, t)  # (B, T, T)
 
     for i in range(cfg.num_layers):
@@ -456,7 +493,7 @@ def embed_encode(
             )
 
         x = _layer_body(cfg, lp, x, positions, attend)
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0].astype(jnp.float32)  # (B, h)
@@ -495,7 +532,7 @@ def forward_sp_prefill(
     kv_valid = (
         jnp.arange(t, dtype=jnp.int32)[None, :] < chunk_lens[:, None]
     )  # (B, T) real chunk tokens
-    x = params["embed"][token_ids].astype(_dtype(cfg))
+    x = _embed(cfg, params, token_ids)
     nkv, hd = cfg.num_kv_heads, cfg.head_dim
 
     new_kv: list[jax.Array] = []
@@ -521,7 +558,7 @@ def forward_sp_prefill(
         x = _layer_body(
             cfg, lp, x, positions, attend, _lora_layer_slice(lora, i), lora_idx
         )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
     return x, tuple(new_kv)
 
 
@@ -549,7 +586,7 @@ def forward_context_parallel(
     b, t = token_ids.shape
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
     kv_valid = positions < lengths[:, None]
-    x = params["embed"][token_ids].astype(_dtype(cfg))
+    x = _embed(cfg, params, token_ids)
 
     kv_out: list[jax.Array] = []
     for i in range(cfg.num_layers):
@@ -562,7 +599,7 @@ def forward_context_parallel(
             )
 
         x = _layer_body(cfg, lp, x, positions, attend)
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
     return x, jnp.stack(kv_out)
 
 

@@ -47,6 +47,20 @@ PRESETS: dict[str, dict] = {
         max_model_len=8192, rope_theta=1000000.0, attention_bias=True,
         architecture="qwen2",
     ),
+    "tiny-gemma": dict(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=24, max_model_len=256,
+        dtype="float32", architecture="gemma", hidden_act="gelu_tanh",
+        rms_norm_add_one=True, scale_embeddings=True,
+        tie_word_embeddings=True, rms_norm_eps=1e-6,
+    ),
+    "gemma-7b": dict(
+        vocab_size=256000, hidden_size=3072, intermediate_size=24576,
+        num_layers=28, num_heads=16, num_kv_heads=16, head_dim=256,
+        max_model_len=8192, rope_theta=10000.0, architecture="gemma",
+        hidden_act="gelu_tanh", rms_norm_add_one=True, scale_embeddings=True,
+        tie_word_embeddings=True, rms_norm_eps=1e-6,
+    ),
     "mixtral-8x7b": dict(
         vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
@@ -66,6 +80,7 @@ _ARCH_MAP = {
     "MistralForCausalLM": "llama",
     "Qwen2ForCausalLM": "qwen2",
     "MixtralForCausalLM": "mixtral",
+    "GemmaForCausalLM": "gemma",
 }
 
 
@@ -109,8 +124,17 @@ def _from_hf_config(path: str) -> dict:
         if arch == "mixtral"
         else {}
     )
+    gemma = (
+        dict(
+            hidden_act="gelu_tanh", rms_norm_add_one=True,
+            scale_embeddings=True,
+        )
+        if arch == "gemma"
+        else {}
+    )
     return dict(
         **moe,
+        **gemma,
         model=path,
         architecture=arch,
         vocab_size=hf["vocab_size"],
@@ -125,7 +149,9 @@ def _from_hf_config(path: str) -> dict:
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
         max_model_len=hf.get("max_position_embeddings", 4096),
-        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        # Gemma ties by default and HF omits class-default fields from
+        # config.json, so the fallback is architecture-dependent
+        tie_word_embeddings=hf.get("tie_word_embeddings", arch == "gemma"),
         attention_bias=hf.get("attention_bias", arch == "qwen2"),
         checkpoint=path,
         tokenizer=path,
