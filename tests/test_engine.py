@@ -231,15 +231,15 @@ def test_midblock_chunked_prefill_matches_unchunked():
                 model=cfg,
                 cache=CacheConfig(block_size=8, num_blocks=64),
                 scheduler=SchedulerConfig(
-                    max_num_seqs=4, max_num_batched_tokens=chunk,
-                    decode_buckets=(4,), prefill_buckets=(chunk, 32),
+                    max_num_seqs=2, max_num_batched_tokens=chunk,
+                    decode_buckets=(2,), prefill_buckets=(chunk,),
                     decode_window=4,
                 ),
             )
         )
 
-    prompts = [prompt_ids(40 + i, 29 + 5 * i) for i in range(3)]
-    greedy = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    prompts = [prompt_ids(40 + i, 29 + 5 * i) for i in range(2)]
+    greedy = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
     # chunk 12 with block 8: chunks start at offsets 12, 24, ... (mid-block)
     chunked = [r["token_ids"] for r in build(12).generate(prompts, greedy)]
     whole = [r["token_ids"] for r in build(64).generate(prompts, greedy)]

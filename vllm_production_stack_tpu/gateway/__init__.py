@@ -1,0 +1,1 @@
+"""Gateway integration: the Envoy ext-proc Endpoint Picker (EPP)."""

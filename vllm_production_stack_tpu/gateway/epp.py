@@ -1,0 +1,270 @@
+"""Endpoint Picker (EPP): an Envoy ext-proc gRPC service that picks the
+serving engine for each request and hands it back to the gateway as the
+`x-gateway-destination-endpoint` header.
+
+This is the TPU stack's equivalent of the reference's Go gateway inference
+extension (src/gateway_inference_extension/*.go): a Gateway-API
+InferencePool's extensionRef points at this service; Envoy/kgateway streams
+each request through `ExternalProcessor.Process`, the EPP parses the
+OpenAI-format body, consults the SAME routing policies the router uses
+(router/routing.py — session / prefix-aware / kv-aware / round-robin), and
+mutates the request headers so the gateway forwards to the chosen engine.
+
+The protocol subset lives in gateway/protos/ext_proc_min.proto — message and
+field numbering are wire-compatible with envoy.service.ext_proc.v3, compiled
+with the system protoc at import time into the same user-private cache the
+native C++ components use (no grpc_tools in this image).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import grpc
+
+from ..router.discovery import Endpoint
+from ..router.routing import RoutingContext, make_policy
+from ..utils.logging import init_logger
+from ..utils.native import _build_dir
+
+logger = init_logger(__name__)
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_PROTO = os.path.join(_REPO_ROOT, "gateway", "protos", "ext_proc_min.proto")
+
+ENDPOINT_HEADER = "x-gateway-destination-endpoint"
+
+
+def _load_pb2():
+    """protoc-compile the minimal ext-proc proto into the private cache and
+    import the generated module (cache key = source content hash)."""
+    import hashlib
+
+    build_dir = _build_dir()
+    if build_dir is None:
+        raise RuntimeError("no private cache dir for generated protos")
+    with open(_PROTO, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:12]
+    out_dir = os.path.join(build_dir, f"extproc_pb2_{tag}")
+    marker = os.path.join(out_dir, "ext_proc_min_pb2.py")
+    if not os.path.exists(marker):
+        os.makedirs(out_dir, exist_ok=True)
+        subprocess.run(
+            [
+                "protoc",
+                f"-I{os.path.dirname(_PROTO)}",
+                f"--python_out={out_dir}",
+                os.path.basename(_PROTO),
+            ],
+            check=True,
+            capture_output=True,
+        )
+    if out_dir not in sys.path:
+        sys.path.insert(0, out_dir)
+    import ext_proc_min_pb2  # noqa: E402
+
+    return ext_proc_min_pb2
+
+
+pb2 = _load_pb2()
+
+_SERVICE = "envoy.service.ext_proc.v3.ExternalProcessor"
+
+
+class EppService:
+    """One ext-proc stream per request: buffer request headers, pick the
+    endpoint when the body (or end-of-stream headers) arrives, reply with a
+    header mutation. Response-phase messages pass through untouched."""
+
+    def __init__(self, policy, endpoints_fn):
+        self.policy = policy
+        self.endpoints_fn = endpoints_fn  # () -> list[Endpoint]
+
+    async def _pick(self, headers: dict[str, str], body: dict) -> str | None:
+        endpoints = [e for e in self.endpoints_fn() if e.healthy and not e.sleeping]
+        if not endpoints:
+            return None
+        ctx = RoutingContext(endpoints=endpoints, headers=headers, body=body)
+        return await self.policy.route(ctx)
+
+    @staticmethod
+    def _header_dict(http_headers) -> dict[str, str]:
+        out = {}
+        for hv in http_headers.headers.headers:
+            out[hv.key.lower()] = hv.value or hv.raw_value.decode(
+                "utf-8", "replace"
+            )
+        return out
+
+    def _mutation_response(self, kind: str, url: str):
+        mut = pb2.HeaderMutation(
+            set_headers=[
+                pb2.HeaderValueOption(
+                    header=pb2.HeaderValue(
+                        key=ENDPOINT_HEADER, raw_value=url.encode()
+                    )
+                )
+            ]
+        )
+        common = pb2.CommonResponse(
+            status=pb2.CommonResponse.CONTINUE, header_mutation=mut
+        )
+        if kind == "headers":
+            return pb2.ProcessingResponse(
+                request_headers=pb2.HeadersResponse(response=common)
+            )
+        return pb2.ProcessingResponse(
+            request_body=pb2.BodyResponse(response=common)
+        )
+
+    @staticmethod
+    def _immediate(code: int, message: str):
+        return pb2.ProcessingResponse(
+            immediate_response=pb2.ImmediateResponse(
+                status=pb2.HttpStatus(code=code),
+                body=json.dumps({"error": message}).encode(),
+                details=message,
+            )
+        )
+
+    async def Process(self, request_iterator, context):
+        headers: dict[str, str] = {}
+        body_chunks: list[bytes] = []
+        async for req in request_iterator:
+            which = req.WhichOneof("request")
+            if which == "request_headers":
+                headers = self._header_dict(req.request_headers)
+                if req.request_headers.end_of_stream:
+                    # bodyless request: route on headers alone
+                    url = await self._pick(headers, {})
+                    if url is None:
+                        yield self._immediate(503, "no healthy endpoints")
+                        return
+                    yield self._mutation_response("headers", url)
+                    continue
+                yield pb2.ProcessingResponse(
+                    request_headers=pb2.HeadersResponse(
+                        response=pb2.CommonResponse(
+                            status=pb2.CommonResponse.CONTINUE
+                        )
+                    )
+                )
+            elif which == "request_body":
+                # STREAMED mode delivers the body in chunks: buffer until
+                # end_of_stream so routing sees the complete JSON exactly
+                # once (each chunk still gets its protocol-mandated reply)
+                body_chunks.append(req.request_body.body)
+                if not req.request_body.end_of_stream:
+                    yield pb2.ProcessingResponse(
+                        request_body=pb2.BodyResponse(
+                            response=pb2.CommonResponse(
+                                status=pb2.CommonResponse.CONTINUE
+                            )
+                        )
+                    )
+                    continue
+                try:
+                    body = json.loads(b"".join(body_chunks) or b"{}")
+                except json.JSONDecodeError:
+                    body = {}
+                body_chunks = []
+                url = await self._pick(headers, body)
+                if url is None:
+                    yield self._immediate(503, "no healthy endpoints")
+                    return
+                yield self._mutation_response("body", url)
+            elif which == "response_headers":
+                yield pb2.ProcessingResponse(
+                    response_headers=pb2.HeadersResponse(
+                        response=pb2.CommonResponse(
+                            status=pb2.CommonResponse.CONTINUE
+                        )
+                    )
+                )
+            elif which == "response_body":
+                yield pb2.ProcessingResponse(
+                    response_body=pb2.BodyResponse(
+                        response=pb2.CommonResponse(
+                            status=pb2.CommonResponse.CONTINUE
+                        )
+                    )
+                )
+            elif which == "request_trailers":
+                yield pb2.ProcessingResponse(
+                    request_trailers=pb2.TrailersResponse()
+                )
+            elif which == "response_trailers":
+                yield pb2.ProcessingResponse(
+                    response_trailers=pb2.TrailersResponse()
+                )
+
+
+def make_server(service: EppService, port: int = 0) -> tuple[grpc.aio.Server, int]:
+    """grpc.aio server with a hand-wired generic handler (no grpc_tools
+    codegen in this image — serializers come straight from the pb2 classes).
+    Returns (server, bound_port)."""
+    server = grpc.aio.server()
+    handler = grpc.method_handlers_generic_handler(
+        _SERVICE,
+        {
+            "Process": grpc.stream_stream_rpc_method_handler(
+                service.Process,
+                request_deserializer=pb2.ProcessingRequest.FromString,
+                response_serializer=pb2.ProcessingResponse.SerializeToString,
+            )
+        },
+    )
+    server.add_generic_rpc_handlers((handler,))
+    bound = server.add_insecure_port(f"[::]:{port}")
+    return server, bound
+
+
+async def _amain(args) -> None:
+    from ..router.discovery import StaticDiscovery
+
+    urls = args.static_backends.split(",")
+    discovery = StaticDiscovery(
+        urls=urls,
+        models=(
+            [args.static_models.split(",")] * len(urls)
+            if args.static_models
+            else None
+        ),
+    )
+    await discovery.start()
+    policy = make_policy(args.routing_policy, **(
+        {"session_key": args.session_key} if args.routing_policy == "session"
+        else {"kv_controller_url": args.kv_controller_url}
+        if args.routing_policy == "kvaware" else {}
+    ))
+    service = EppService(policy, discovery.endpoints)
+    server, port = make_server(service, args.port)
+    await server.start()
+    logger.info("EPP listening on :%d (policy=%s)", port, args.routing_policy)
+    await server.wait_for_termination()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="ext-proc endpoint picker")
+    p.add_argument("--port", type=int, default=9002)
+    p.add_argument("--routing-policy", default="prefixaware",
+                   choices=["roundrobin", "session", "prefixaware", "kvaware"])
+    p.add_argument("--session-key", default="x-session-id")
+    p.add_argument("--kv-controller-url", default="http://localhost:9100")
+    p.add_argument("--static-backends", required=True,
+                   help="comma-separated engine base URLs")
+    p.add_argument("--static-models", default="",
+                   help="comma-separated model names per backend")
+    args = p.parse_args()
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()
