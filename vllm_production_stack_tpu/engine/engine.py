@@ -106,8 +106,17 @@ class LLMEngine:
         # same-shape-different-weights KV would silently corrupt attention
         import hashlib
 
+        # the pool storage dtype is part of the identity: adopting e.g.
+        # fp8-quantized pages into an exact bf16 cache would silently mark
+        # lossy KV as byte-identical to locally computed KV
         self.model_fingerprint = hashlib.sha256(
-            repr((config.model, config.seed)).encode()
+            repr(
+                (
+                    config.model,
+                    config.seed,
+                    config.cache.resolved_kv_dtype(config.model.dtype),
+                )
+            ).encode()
         ).hexdigest()[:16]
 
     # -- request lifecycle -------------------------------------------------

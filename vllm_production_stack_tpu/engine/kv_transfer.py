@@ -39,12 +39,14 @@ def serialize_blocks(
         [(h >> 64, h & 0xFFFFFFFFFFFFFFFF) for h in hashes], dtype=np.uint64
     ).reshape(-1, 2)
     buf = io.BytesIO()
-    # bf16 isn't npz-portable everywhere; ship as uint16 bit patterns
-    view = (
-        blocks.view(np.uint16)
-        if blocks.dtype.name == "bfloat16"
-        else blocks
-    )
+    # ml_dtypes (bf16, fp8 pools) aren't npz-portable everywhere; ship as
+    # same-width unsigned bit patterns and re-view on the other side
+    if blocks.dtype.name == "bfloat16":
+        view = blocks.view(np.uint16)
+    elif blocks.dtype.name == "float8_e4m3fn":
+        view = blocks.view(np.uint8)
+    else:
+        view = blocks
     np.savez(
         buf, hashes=hi_lo, blocks=view, dtype=np.array(blocks.dtype.name),
         fingerprint=np.array(fingerprint),
@@ -62,6 +64,10 @@ def deserialize_blocks(payload: bytes) -> tuple[list[int], np.ndarray, str]:
         import ml_dtypes
 
         blocks = blocks.view(ml_dtypes.bfloat16)
+    elif dtype == "float8_e4m3fn":
+        import ml_dtypes
+
+        blocks = blocks.view(ml_dtypes.float8_e4m3fn)
     hashes = [int(hi) << 64 | int(lo) for hi, lo in hi_lo]
     return hashes, blocks, fingerprint
 

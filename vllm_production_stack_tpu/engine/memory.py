@@ -32,7 +32,8 @@ HOIST_CTX_TOKENS = 256
 
 
 def dtype_bytes(dtype: str) -> int:
-    return np.dtype(dtype).itemsize
+    # jnp.dtype resolves the ml_dtypes names too (float8_e4m3fn, bfloat16)
+    return jax.numpy.dtype(dtype).itemsize
 
 
 def param_bytes(cfg: ModelConfig, tp: int = 1, pp: int = 1) -> int:
@@ -55,15 +56,16 @@ def param_bytes(cfg: ModelConfig, tp: int = 1, pp: int = 1) -> int:
 
 
 def kv_block_bytes(cfg: ModelConfig, block_size: int, tp: int = 1,
-                   pp: int = 1) -> int:
+                   pp: int = 1, kv_dtype: str | None = None) -> int:
     """Per-device bytes of ONE pool block across all layers: kv heads shard
     over tp and the block axis shards over pp, so a device holds every
     layer's pages for 1/pp of the blocks — per-device cost of adding a
-    block is therefore 1/pp of its global bytes."""
+    block is therefore 1/pp of its global bytes. kv_dtype overrides the
+    model dtype when the pool is quantized (CacheConfig.kv_cache_dtype)."""
     kvh = max(1, cfg.num_kv_heads // tp)
     return max(1, (
         cfg.num_layers * 2 * block_size * kvh * cfg.head_dim
-        * dtype_bytes(cfg.dtype)
+        * dtype_bytes(kv_dtype or cfg.dtype)
     ) // pp)
 
 
@@ -84,6 +86,7 @@ def hoist_reserve_bytes(
     return b_local * blocks * kv_block_bytes(
         model, cache.block_size, parallel.tensor_parallel_size,
         parallel.pipeline_parallel_size,
+        kv_dtype=cache.resolved_kv_dtype(model.dtype),
     )
 
 
@@ -140,7 +143,10 @@ def derive_num_blocks(
     budget = headroom_budget(
         model, cache, parallel, hbm
     ) - hoist_reserve_bytes(model, cache, parallel, max_num_seqs)
-    per_block = kv_block_bytes(model, cache.block_size, tp, pp)
+    per_block = kv_block_bytes(
+        model, cache.block_size, tp, pp,
+        kv_dtype=cache.resolved_kv_dtype(model.dtype),
+    )
     # pp shards the block axis, so the pool must hold >= pp blocks (and the
     # pp-divisibility rounding below must never round UP past the budget)
     if budget < 2 * per_block * max(1, pp):

@@ -95,9 +95,11 @@ class ModelRunner:
         else:
             self.params = jax.tree.map(jax.device_put, params, param_shardings)
         kv_sharding = NamedSharding(self.mesh, kv_cache_spec())
+        self._kv_dtype = config.cache.resolved_kv_dtype(cfg.dtype)
         self.kv_caches = jax.jit(
             lambda: llama.init_kv_cache(
-                cfg, config.cache.num_blocks, config.cache.block_size
+                cfg, config.cache.num_blocks, config.cache.block_size,
+                dtype=self._kv_dtype,
             ),
             out_shardings=kv_sharding,
         )()
@@ -196,6 +198,14 @@ class ModelRunner:
                 "attention_backend='pallas' supports single-device meshes "
                 "only (no GSPMD partition rule for pallas_call)"
             )
+        if backend.startswith("pallas") and self._kv_dtype != (
+            self.config.model.dtype
+        ):
+            raise ValueError(
+                "attention_backend='pallas' does not support a quantized KV "
+                f"cache (kv_cache_dtype={self.config.cache.kv_cache_dtype}); "
+                "use the XLA backend"
+            )
         return backend
 
     def _compute_hoist_budget(self) -> int:
@@ -215,6 +225,7 @@ class ModelRunner:
         pool = self.config.cache.num_blocks * kv_block_bytes(
             self.config.model, self.config.cache.block_size,
             par.tensor_parallel_size, par.pipeline_parallel_size,
+            kv_dtype=self._kv_dtype,
         )
         return max(
             0,
@@ -238,6 +249,7 @@ class ModelRunner:
             * kv_block_bytes(
                 self.config.model, block_size,
                 par.tensor_parallel_size, par.pipeline_parallel_size,
+                kv_dtype=self._kv_dtype,
             )
         )
 
@@ -793,7 +805,8 @@ class ModelRunner:
             self._sleeping_lora_host = None
         self.kv_caches = jax.jit(
             lambda: llama.init_kv_cache(
-                cfg.model, cfg.cache.num_blocks, cfg.cache.block_size
+                cfg.model, cfg.cache.num_blocks, cfg.cache.block_size,
+                dtype=self._kv_dtype,
             ),
             out_shardings=NamedSharding(self.mesh, kv_cache_spec()),
         )()

@@ -609,6 +609,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--expert-parallel-size", type=int, default=1,
                    help="MoE expert parallelism: shard Mixtral-family "
                         "expert FFNs over an ep mesh axis")
+    p.add_argument("--kv-cache-dtype", default="auto",
+                   choices=["auto", "fp8"],
+                   help="KV pool storage dtype: fp8 (float8_e4m3fn) halves "
+                        "KV HBM traffic and doubles pool capacity")
     p.add_argument("--enable-prefix-caching", action="store_true", default=True)
     p.add_argument("--no-enable-prefix-caching", dest="enable_prefix_caching",
                    action="store_false")
@@ -640,6 +644,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         model=model_cfg,
         cache=CacheConfig(
             block_size=args.block_size,
+            kv_cache_dtype=args.kv_cache_dtype,
             num_blocks=args.num_blocks,
             num_host_blocks=args.num_host_blocks,
             enable_prefix_caching=args.enable_prefix_caching,

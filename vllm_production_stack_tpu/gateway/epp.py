@@ -55,17 +55,28 @@ def _load_pb2():
     out_dir = os.path.join(build_dir, f"extproc_pb2_{tag}")
     marker = os.path.join(out_dir, "ext_proc_min_pb2.py")
     if not os.path.exists(marker):
-        os.makedirs(out_dir, exist_ok=True)
+        # generate into a per-pid temp dir and os.replace into place, so a
+        # concurrent first start can never import a half-written module
+        # (same discipline as utils/native._compile)
+        tmp_dir = f"{out_dir}.tmp{os.getpid()}"
+        os.makedirs(tmp_dir, exist_ok=True)
         subprocess.run(
             [
                 "protoc",
                 f"-I{os.path.dirname(_PROTO)}",
-                f"--python_out={out_dir}",
+                f"--python_out={tmp_dir}",
                 os.path.basename(_PROTO),
             ],
             check=True,
             capture_output=True,
         )
+        try:
+            os.replace(tmp_dir, out_dir)
+        except OSError:
+            # another process won the race with a complete dir — use theirs
+            import shutil
+
+            shutil.rmtree(tmp_dir, ignore_errors=True)
     if out_dir not in sys.path:
         sys.path.insert(0, out_dir)
     import ext_proc_min_pb2  # noqa: E402
@@ -89,6 +100,15 @@ class EppService:
 
     async def _pick(self, headers: dict[str, str], body: dict) -> str | None:
         endpoints = [e for e in self.endpoints_fn() if e.healthy and not e.sleeping]
+        # model filtering mirrors the router's _eligible_endpoints
+        # (router/request_service.py): only engines actually serving the
+        # requested model are candidates; if none advertises it, fall back
+        # to the full healthy set (engines may not have been probed yet)
+        model = body.get("model")
+        if model:
+            by_model = [e for e in endpoints if e.has_model(model)]
+            if by_model:
+                endpoints = by_model
         if not endpoints:
             return None
         ctx = RoutingContext(endpoints=endpoints, headers=headers, body=body)
@@ -174,6 +194,11 @@ class EppService:
                     body = json.loads(b"".join(body_chunks) or b"{}")
                 except json.JSONDecodeError:
                     body = {}
+                if not isinstance(body, dict):
+                    # valid JSON but not an object (array/string/number):
+                    # policies index into it — route as bodyless instead of
+                    # crashing the stream
+                    body = {}
                 body_chunks = []
                 url = await self._pick(headers, body)
                 if url is None:
@@ -229,15 +254,23 @@ def make_server(service: EppService, port: int = 0) -> tuple[grpc.aio.Server, in
 async def _amain(args) -> None:
     from ..router.discovery import StaticDiscovery
 
-    urls = args.static_backends.split(",")
-    discovery = StaticDiscovery(
-        urls=urls,
-        models=(
-            [args.static_models.split(",")] * len(urls)
-            if args.static_models
-            else None
-        ),
-    )
+    urls = [u.strip() for u in args.static_backends.split(",")]
+    models = None
+    if args.static_models:
+        # the router's convention (router/app.py): ';' separates per-backend
+        # groups, ',' separates models within a group
+        models = [
+            [m.strip() for m in group.split(",") if m.strip()]
+            for group in args.static_models.split(";")
+        ]
+        if len(models) == 1 and len(urls) > 1:
+            models = models * len(urls)  # one group: applies to every backend
+        if len(models) != len(urls):
+            raise SystemExit(
+                f"--static-models has {len(models)} group(s) for "
+                f"{len(urls)} backend(s)"
+            )
+    discovery = StaticDiscovery(urls=urls, models=models)
     await discovery.start()
     policy = make_policy(args.routing_policy, **(
         {"session_key": args.session_key} if args.routing_policy == "session"
@@ -261,7 +294,8 @@ def main() -> None:
     p.add_argument("--static-backends", required=True,
                    help="comma-separated engine base URLs")
     p.add_argument("--static-models", default="",
-                   help="comma-separated model names per backend")
+                   help="';'-separated per-backend groups of ','-separated "
+                        "model names (one group applies to all backends)")
     args = p.parse_args()
     asyncio.run(_amain(args))
 

@@ -541,6 +541,9 @@ def forward_sp_prefill(
 
         def attend(q, k, v, i=i):
             hist_k, hist_v = gather_pages(kv_caches[i], block_tables)
+            # quantized pools convert to the compute dtype as they stream in
+            hist_k = hist_k.astype(q.dtype)
+            hist_v = hist_v.astype(q.dtype)
             out = ring_attention(
                 mesh, q, k, v, positions, kv_valid, scale=hd**-0.5,
                 hist_k=hist_k, hist_v=hist_v, hist_len=hist_lens,

@@ -48,6 +48,14 @@ def apply_rope(
     return jnp.concatenate([rx1, rx2], axis=-1).astype(x.dtype)
 
 
+
+def _to_compute(x: jax.Array, like: jax.Array) -> jax.Array:
+    """Convert quantized (e.g. float8 pool) K/V to the compute dtype as it
+    streams into attention. XLA fuses the convert into the consumer, so the
+    HBM read stays at the storage width — the whole point of a quantized
+    cache."""
+    return x if x.dtype == like.dtype else x.astype(like.dtype)
+
 def write_kv_pages(
     kv: jax.Array, k: jax.Array, v: jax.Array, slot_mapping: jax.Array
 ) -> jax.Array:
@@ -157,6 +165,7 @@ def masked_attention(
     s = keys.shape[1]
     kvh = keys.shape[2]
     qpk = num_heads // kvh
+    keys, values = _to_compute(keys, q), _to_compute(values, q)
     qg = q.reshape(b, t, kvh, qpk, d)
     if s > FLASH_CHUNK:
         pad = (-s) % FLASH_CHUNK
@@ -320,6 +329,7 @@ def attention_with_hist(
     b, t, num_heads, d = q.shape
     kvh = hist_k.shape[2]
     qpk = num_heads // kvh
+    hist_k, hist_v = _to_compute(hist_k, q), _to_compute(hist_v, q)
     qg = q.reshape(b, t, kvh, qpk, d)
     # score the two regions separately and concatenate SCORES (small, f32)
     # rather than keys/values — concatenating K and V materializes a fresh
