@@ -597,6 +597,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="host-RAM KV offload tier size in blocks (0 = off)")
     p.add_argument("--max-num-seqs", type=int, default=64)
     p.add_argument("--max-num-batched-tokens", type=int, default=512)
+    p.add_argument("--decode-window", type=int, default=8,
+                   help="decode iterations fused into one device dispatch; "
+                        "raise on high-RTT links (remote chips) — dispatch "
+                        "overhead amortizes over window x batch tokens, at "
+                        "the cost of up to window-1 discarded tokens past a "
+                        "stop condition")
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--pipeline-parallel-size", type=int, default=1,
                    help="GSPMD stage sharding of the layer axis (multi-host)")
@@ -654,6 +660,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             max_num_batched_tokens=args.max_num_batched_tokens,
             decode_buckets=decode_buckets,
             prefill_buckets=prefill_buckets,
+            decode_window=args.decode_window,
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,

@@ -58,24 +58,31 @@ def _load_pb2():
         # generate into a per-pid temp dir and os.replace into place, so a
         # concurrent first start can never import a half-written module
         # (same discipline as utils/native._compile)
+        import shutil
+
         tmp_dir = f"{out_dir}.tmp{os.getpid()}"
         os.makedirs(tmp_dir, exist_ok=True)
-        subprocess.run(
-            [
-                "protoc",
-                f"-I{os.path.dirname(_PROTO)}",
-                f"--python_out={tmp_dir}",
-                os.path.basename(_PROTO),
-            ],
-            check=True,
-            capture_output=True,
-        )
         try:
-            os.replace(tmp_dir, out_dir)
-        except OSError:
-            # another process won the race with a complete dir — use theirs
-            import shutil
-
+            subprocess.run(
+                [
+                    "protoc",
+                    f"-I{os.path.dirname(_PROTO)}",
+                    f"--python_out={tmp_dir}",
+                    os.path.basename(_PROTO),
+                ],
+                check=True,
+                capture_output=True,
+            )
+            try:
+                os.replace(tmp_dir, out_dir)
+            except OSError:
+                # replace can fail because (a) a concurrent start won the
+                # race with a COMPLETE dir — use theirs — or (b) out_dir is
+                # stale debris without the module: clear it and retry once
+                if not os.path.exists(marker):
+                    shutil.rmtree(out_dir, ignore_errors=True)
+                    os.replace(tmp_dir, out_dir)
+        finally:
             shutil.rmtree(tmp_dir, ignore_errors=True)
     if out_dir not in sys.path:
         sys.path.insert(0, out_dir)
@@ -102,13 +109,13 @@ class EppService:
         endpoints = [e for e in self.endpoints_fn() if e.healthy and not e.sleeping]
         # model filtering mirrors the router's _eligible_endpoints
         # (router/request_service.py): only engines actually serving the
-        # requested model are candidates; if none advertises it, fall back
-        # to the full healthy set (engines may not have been probed yet)
+        # requested model are candidates; the only fallback is engines with
+        # NO published model list (not yet probed) — never engines that
+        # advertise a different model
         model = body.get("model")
         if model:
             by_model = [e for e in endpoints if e.has_model(model)]
-            if by_model:
-                endpoints = by_model
+            endpoints = by_model or [e for e in endpoints if not e.model_names]
         if not endpoints:
             return None
         ctx = RoutingContext(endpoints=endpoints, headers=headers, body=body)
