@@ -1,0 +1,148 @@
+"""N-gram speculative decoding: the proposer, verification-path correctness
+(spec and non-spec engines must produce IDENTICAL greedy outputs), token
+accounting, and the acceptance counters."""
+
+import numpy as np
+
+from vllm_production_stack_tpu.engine.config import (
+    CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
+)
+from vllm_production_stack_tpu.engine.engine import LLMEngine
+from vllm_production_stack_tpu.engine.request import SamplingParams
+from vllm_production_stack_tpu.engine.spec_decode import propose_ngram
+
+
+def test_propose_ngram_basic():
+    # tail [7, 8] recurs earlier; continuation follows the match
+    toks = [1, 7, 8, 9, 4, 5, 7, 8]
+    assert propose_ngram(toks, k=2) == [9, 4]
+    # longest n-gram wins: tail [5, 7, 8] also recurs? it doesn't — [7, 8]
+    assert propose_ngram(toks, k=5) == [9, 4, 5, 7, 8][:5]
+    # no recurrence
+    assert propose_ngram([1, 2, 3, 4], k=2) is None
+    # most recent match wins
+    toks = [7, 8, 1, 1, 7, 8, 2, 2, 7, 8]
+    assert propose_ngram(toks, k=1) == [2]
+    assert propose_ngram([], k=2) is None
+    assert propose_ngram([1, 2, 3], k=0) is None
+
+
+def _build(spec_k):
+    return LLMEngine(
+        EngineConfig(
+            model=ModelConfig.tiny(),
+            cache=CacheConfig(block_size=8, num_blocks=64),
+            scheduler=SchedulerConfig(
+                max_num_seqs=4, max_num_batched_tokens=32,
+                decode_buckets=(4,), prefill_buckets=(16, 32),
+                decode_window=4, num_speculative_tokens=spec_k,
+            ),
+        )
+    )
+
+
+def test_spec_engine_matches_plain_greedy():
+    """The whole point: speculation must be lossless for greedy decoding —
+    identical tokens, whatever the acceptance pattern. Repetitive prompts
+    give the proposer real n-gram hits."""
+    rng = np.random.RandomState(0)
+    base = list(rng.randint(1, 500, size=6))
+    prompts = [
+        base * 3,  # strongly repetitive: proposals fire
+        list(rng.randint(1, 500, size=11)),  # random: proposals rarely fire
+        base * 2 + list(rng.randint(1, 500, size=3)),
+    ]
+    greedy = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+
+    plain = [r["token_ids"] for r in _build(0).generate(prompts, greedy)]
+    spec_engine = _build(3)
+    spec = [r["token_ids"] for r in spec_engine.generate(prompts, greedy)]
+    assert spec == plain
+    stats = spec_engine.stats()
+    assert stats.spec_draft_tokens > 0  # proposals actually fired
+    # generated text is model output on random weights; acceptance may be
+    # low, but the counters must be consistent
+    assert 0 <= stats.spec_accepted_tokens <= stats.spec_draft_tokens
+
+
+def test_spec_mixed_sampling_batch():
+    """Non-greedy rows keep the decode-window path (seeded sampling must be
+    reproducible against a plain engine) while greedy rows verify."""
+    rng = np.random.RandomState(1)
+    prompts = [list(rng.randint(1, 500, size=7)) for _ in range(2)]
+    seeded = SamplingParams(
+        max_tokens=8, temperature=0.8, seed=42, ignore_eos=True
+    )
+    greedy = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+
+    plain_engine = _build(0)
+    spec_engine = _build(3)
+
+    plain = [
+        plain_engine.generate([p], s)[0]["token_ids"]
+        for p, s in zip(prompts, (seeded, greedy))
+    ]
+    # submit both to the spec engine concurrently (mixed batch)
+    ids = [
+        spec_engine.add_request(prompt_token_ids=p, sampling=s)
+        for p, s in zip(prompts, (seeded, greedy))
+    ]
+    outs = {i: [] for i in ids}
+    while spec_engine.has_unfinished():
+        for out in spec_engine.step():
+            outs[out.request_id].extend(out.new_token_ids)
+    assert [outs[i] for i in ids] == plain
+
+
+def test_spec_respects_max_tokens_and_stops():
+    """Accepted runs must clip at max_tokens and at stop tokens even when a
+    whole proposal batch was accepted."""
+    rng = np.random.RandomState(2)
+    base = list(rng.randint(1, 500, size=5))
+    engine = _build(4)
+    out = engine.generate(
+        [base * 4],
+        SamplingParams(max_tokens=3, temperature=0.0, ignore_eos=True),
+    )[0]
+    assert len(out["token_ids"]) == 3
+
+    # stop token: find what greedy generates first, then stop on it
+    probe = engine.generate(
+        [base * 4],
+        SamplingParams(max_tokens=1, temperature=0.0, ignore_eos=True),
+    )[0]["token_ids"][0]
+    out = engine.generate(
+        [base * 4],
+        SamplingParams(
+            max_tokens=8, temperature=0.0, ignore_eos=True,
+            stop_token_ids=[probe],
+        ),
+    )[0]
+    assert out["token_ids"][-1] == probe
+    assert len(out["token_ids"]) == 1
+
+
+def test_spec_sole_request_near_pool_exhaustion_finishes():
+    """Round-1's scheduler livelock lesson, verify-path edition: a sole
+    greedy request whose proposal would overrun the pool must shrink its
+    proposal instead of self-preempting forever."""
+    rng = np.random.RandomState(3)
+    base = list(rng.randint(1, 500, size=4))
+    engine = LLMEngine(
+        EngineConfig(
+            model=ModelConfig.tiny(),
+            cache=CacheConfig(block_size=8, num_blocks=6),  # 5 usable blocks
+            scheduler=SchedulerConfig(
+                max_num_seqs=2, max_num_batched_tokens=16,
+                decode_buckets=(2,), prefill_buckets=(16,),
+                decode_window=4, num_speculative_tokens=4,
+            ),
+        )
+    )
+    # prompt 16 = 2 blocks; 24 more tokens stretch to the 5-block limit
+    out = engine.generate(
+        [base * 4],
+        SamplingParams(max_tokens=22, temperature=0.0, ignore_eos=True),
+    )[0]
+    assert len(out["token_ids"]) == 22
+    assert engine.scheduler.total_preemptions < 50

@@ -39,6 +39,8 @@ class EngineStatsSnapshot:
     host_kv_usage_perc: float = 0.0
     host_kv_offloads: int = 0
     host_kv_reloads: int = 0
+    spec_draft_tokens: int = 0
+    spec_accepted_tokens: int = 0
 
 
 @dataclass
@@ -573,6 +575,8 @@ class LLMEngine:
             prefix_cache_hits=pool.stats.hits,
             prefix_cache_queries=pool.stats.queries,
             num_preemptions=self.scheduler.total_preemptions,
+            spec_draft_tokens=self.scheduler.spec_proposed_tokens,
+            spec_accepted_tokens=self.scheduler.spec_accepted_tokens,
             generation_tokens=self._generation_tokens,
             prompt_tokens=self._prompt_tokens,
             host_kv_usage_perc=(

@@ -54,6 +54,12 @@ class EngineMetrics:
         self.host_reloads = counter(
             mc.HOST_KV_RELOADS, "KV blocks reloaded host RAM to HBM"
         )
+        self.spec_draft = counter(
+            mc.SPEC_DRAFT_TOKENS, "Speculative tokens proposed (ngram)"
+        )
+        self.spec_accepted = counter(
+            mc.SPEC_ACCEPTED_TOKENS, "Speculative tokens accepted"
+        )
         self.prompt_tokens = counter(mc.PROMPT_TOKENS, "Prompt tokens processed")
         self.generation_tokens = counter(mc.GENERATION_TOKENS, "Tokens generated")
         self._counter_values: dict[str, int] = {}
@@ -70,6 +76,8 @@ class EngineMetrics:
         self.host_kv_usage.labels(**lb).set(s.host_kv_usage_perc)
         self._bump(self.host_offloads, "host_off", s.host_kv_offloads)
         self._bump(self.host_reloads, "host_re", s.host_kv_reloads)
+        self._bump(self.spec_draft, "spec_draft", s.spec_draft_tokens)
+        self._bump(self.spec_accepted, "spec_acc", s.spec_accepted_tokens)
         self._bump(self.prompt_tokens, "prompt", s.prompt_tokens)
         self._bump(self.generation_tokens, "gen", s.generation_tokens)
 

@@ -36,7 +36,7 @@ from ..parallel.sharding import (
 )
 from .config import EngineConfig
 from .sampling import sample
-from .scheduler import DecodeWork, PrefillWork, ScheduleOutput
+from .scheduler import DecodeWork, PrefillWork, ScheduleOutput, VerifyWork
 
 logger = logging.getLogger(__name__)
 
@@ -170,6 +170,11 @@ class ModelRunner:
             self._build_sp_step_fn() if self._sp > 1 else self._build_step_fn()
         )
         self._decode_window_fn = self._build_decode_window_fn()
+        self._verify_fn = (
+            self._build_verify_fn()
+            if config.scheduler.num_speculative_tokens > 0
+            else None
+        )
         self._sleeping_params_host: Any | None = None
         self._sleeping_lora_host: Any | None = None
         self._upload_block_fn = None
@@ -439,14 +444,110 @@ class ModelRunner:
 
         return decode_window_fn
 
+    def _build_verify_fn(self):
+        """Speculative-verification program (engine/spec_decode.py): a
+        chunked-prefill-shaped forward over [current token + proposals] with
+        GREEDY argmax at EVERY position — m[j] confirms or replaces the
+        proposal for position j+1, so one dispatch yields 1..k+1 tokens per
+        row. Same paged attention + blockwise KV commit as prefill."""
+        cfg = self.config.model
+
+        @functools.partial(jax.jit, donate_argnames=("kv_caches",))
+        def verify_fn(
+            params,
+            lora_params,
+            kv_caches,
+            token_ids,  # (B, T) fed tokens: [cur, p0..pk-1], padded
+            positions,  # (B, T)
+            block_tables,  # (B, nb)
+            context_lens,  # (B,) resident after this step
+            chunk_lens,  # (B,) real fed tokens per row
+            write_ids,  # (B, NBW)
+            start_off,  # (B,)
+            lora_idx,
+        ):
+            hidden, kv_caches = llama.forward(
+                cfg, params, token_ids, positions, kv_caches,
+                block_tables, jnp.zeros((1,), jnp.int32), context_lens,
+                lora=lora_params, lora_idx=lora_idx,
+                write_blocks={
+                    "ids": write_ids,
+                    "start_off": start_off,
+                    "chunk_lens": chunk_lens,
+                },
+            )
+            logits = llama.compute_logits(
+                cfg, params, hidden.reshape(-1, hidden.shape[-1])
+            )
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return kv_caches, toks.reshape(hidden.shape[0], hidden.shape[1])
+
+        return verify_fn
+
+    def _execute_verify(self, work: VerifyWork) -> list[list[int]]:
+        sched = self.config.scheduler
+        b = len(work.requests)
+        b_pad = sched.bucket_for(b, sched.decode_buckets)
+        t = max(len(row) for row in work.token_ids)
+        t_pad = max(2, self._pow2(t))  # tiny chunks: k+1 <= 8 typically
+
+        bs = self.config.cache.block_size
+        nbw = (t_pad - 1) // bs + 2
+        token_ids = np.zeros((b_pad, t_pad), np.int32)
+        positions = np.zeros((b_pad, t_pad), np.int32)
+        context_lens = np.zeros(b_pad, np.int32)
+        chunk_lens = np.zeros(b_pad, np.int32)
+        write_ids = np.zeros((b_pad, nbw), np.int32)
+        start_off = np.zeros(b_pad, np.int32)
+        lora_idx = np.zeros(b_pad, np.int32)
+        for i, req in enumerate(work.requests):
+            row = work.token_ids[i]
+            token_ids[i, : len(row)] = row
+            positions[i, : len(row)] = work.positions[i]
+            context_lens[i] = work.context_lens[i]
+            chunk_lens[i] = len(row)
+            hist = work.context_lens[i] - len(row)
+            first_blk = hist // bs
+            n_span = (work.context_lens[i] - 1) // bs - first_blk + 1
+            write_ids[i, :n_span] = req.block_table[first_blk : first_blk + n_span]
+            start_off[i] = hist % bs
+            lora_idx[i] = req.lora_index
+        block_tables = self._block_table_array(
+            [r.block_table for r in work.requests], pad_to=b_pad
+        )
+        if self._sleeping_params_host is not None:
+            raise RuntimeError("engine is sleeping; wake it before running")
+        self.kv_caches, toks = self._verify_fn(
+            self.params,
+            self.lora_params,
+            self.kv_caches,
+            self._put(token_ids, self._batch2),
+            self._put(positions, self._batch2),
+            self._put(block_tables, self._batch2),
+            self._put(context_lens, self._batch1),
+            self._put(chunk_lens, self._batch1),
+            self._put(write_ids, self._batch2),
+            self._put(start_off, self._batch1),
+            self._put(lora_idx, self._batch1) if self._use_lora else None,
+        )
+        mat = np.asarray(jax.device_get(toks))
+        # row i's usable predictions are its first chunk_lens[i] positions
+        return [
+            list(map(int, mat[i, : len(work.token_ids[i])]))
+            for i in range(b)
+        ]
+
     # -- public API --------------------------------------------------------
 
     def execute(self, work: ScheduleOutput) -> list[list[int]]:
         """Run one scheduled step; returns one token row per request
         (prefill: [[tok]] if work.sample else [[]]; decode: up to `window`
-        candidate tokens per request)."""
+        candidate tokens per request; verify: argmax at every fed
+        position)."""
         if isinstance(work, PrefillWork):
             return self._execute_prefill(work)
+        if isinstance(work, VerifyWork):
+            return self._execute_verify(work)
         return self._execute_decode(work)
 
     def _execute_prefill(self, work: PrefillWork) -> list[list[int]]:

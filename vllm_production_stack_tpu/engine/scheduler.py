@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from .config import CacheConfig, ModelConfig, SchedulerConfig
 from .kv_cache import KVBlockPool, chain_hash
 from .request import Request, RequestStatus
+from .spec_decode import propose_ngram
 
 
 @dataclass
@@ -65,7 +66,22 @@ class DecodeWork:
     positions: list[int] = field(default_factory=list)  # first position per req
 
 
-ScheduleOutput = PrefillWork | DecodeWork
+@dataclass
+class VerifyWork:
+    """One speculative-verification dispatch (engine/spec_decode.py): each
+    row feeds [current token] + its n-gram proposal; the model's argmax at
+    every position confirms or replaces proposals, yielding 1..k+1 tokens
+    per row in one dispatch. Rows without a proposal feed just their
+    current token (a plain greedy decode step)."""
+
+    requests: list[Request] = field(default_factory=list)
+    token_ids: list[list[int]] = field(default_factory=list)  # fed tokens
+    positions: list[list[int]] = field(default_factory=list)
+    proposals: list[list[int]] = field(default_factory=list)
+    context_lens: list[int] = field(default_factory=list)  # resident after
+
+
+ScheduleOutput = PrefillWork | DecodeWork | VerifyWork
 
 
 class Scheduler:
@@ -95,7 +111,12 @@ class Scheduler:
         self.running: list[Request] = []
         self._hash_chains: dict[str, list[int]] = {}  # req id -> per-block hashes
         self._last_was_prefill = False
+        self._last_was_verify = False
         self.total_preemptions = 0
+        # speculative-decoding counters (vLLM metric parity:
+        # spec_decode_num_draft_tokens / num_accepted_tokens)
+        self.spec_proposed_tokens = 0
+        self.spec_accepted_tokens = 0
         # requests finished outside a step (e.g. resumed request that outgrew
         # the pool) — the engine drains these to emit terminal outputs
         self._finished_externally: list[Request] = []
@@ -152,11 +173,93 @@ class Scheduler:
                 self._last_was_prefill = True
                 return work
         if decode_ready:
-            work = self._schedule_decode(decode_ready)
+            work = self._schedule_decode_or_verify(decode_ready)
             if work is not None:
                 self._last_was_prefill = False
                 return work
         return None
+
+    def _schedule_decode_or_verify(
+        self, ready: list[Request]
+    ) -> ScheduleOutput | None:
+        """With speculative decoding on, greedy rows route through the
+        verify program (which subsumes plain decode: no proposal -> 1 bonus
+        token); sampled rows keep the fused decode window. When both kinds
+        are ready the two dispatch types alternate."""
+        k = self.config.num_speculative_tokens
+        if k <= 0:
+            return self._schedule_decode(ready)
+        # only greedy rows whose proposer actually fires go through verify;
+        # proposal-less greedy rows keep the fused decode window (1 token
+        # per verify dispatch would re-expose the per-token round-trip the
+        # window amortizes), as do sampled rows
+        proposals: dict[str, list[int]] = {}
+        for r in ready:
+            if r.sampling.temperature == 0.0:
+                p = propose_ngram(
+                    r.all_token_ids, k, self.config.speculative_min_ngram
+                )
+                if p:
+                    proposals[r.request_id] = p
+        spec = [r for r in ready if r.request_id in proposals]
+        plain = [r for r in ready if r.request_id not in proposals]
+        first, second = (
+            (spec, plain) if not self._last_was_verify else (plain, spec)
+        )
+        for group in (first, second):
+            if not group:
+                continue
+            if group is spec:
+                work = self._schedule_verify(group, proposals)
+            else:
+                work = self._schedule_decode(group)
+            if work is not None:
+                self._last_was_verify = group is spec
+                return work
+        return None
+
+    def _schedule_verify(
+        self, ready: list[Request], proposals: dict[str, list[int]]
+    ) -> VerifyWork | None:
+        work = VerifyWork()
+        for req in ready[: self.config.max_num_seqs]:
+            if req not in self.running:
+                continue
+            start = req.num_computed_tokens
+            proposal = list(proposals.get(req.request_id, []))
+            # bound by remaining model length (the fed chunk itself must fit)
+            room = self.model_config.max_model_len - start - 1
+            proposal = proposal[: max(0, room)]
+            # clamp to pool headroom, mirroring the decode window's clamp: a
+            # proposal must never make _ensure_blocks preempt the request
+            # ITSELF (re-admit, recompute, re-propose — a livelock); shrunk
+            # to nothing it degrades to a plain 1-token verify, the same
+            # exposure as decode at window 1
+            while proposal and (
+                self._blocks_needed(start + 1 + len(proposal))
+                - len(req.block_table)
+                > self.pool.num_free
+            ):
+                proposal.pop()
+            if not self._ensure_blocks(req, start + 1 + len(proposal)):
+                continue  # req preempted itself; others may still verify
+            fed = [req.token_at(start), *proposal]
+            work.requests.append(req)
+            work.token_ids.append(fed)
+            work.positions.append(list(range(start, start + len(fed))))
+            work.proposals.append(proposal)
+            work.context_lens.append(start + len(fed))
+        # a later _ensure_blocks may have preempted an earlier row's request
+        if any(r not in self.running for r in work.requests):
+            keep = [
+                i for i, r in enumerate(work.requests) if r in self.running
+            ]
+            for name in (
+                "requests", "token_ids", "positions", "proposals",
+                "context_lens",
+            ):
+                setattr(work, name, [getattr(work, name)[i] for i in keep])
+        return work if work.requests else None
 
     def _schedule_prefill(self, prefilling: list[Request]) -> PrefillWork | None:
         """Pack chunks from multiple requests into one dispatch: in-flight
@@ -394,6 +497,26 @@ class Scheduler:
         that didn't finish the prompt. Decode candidates past a stop condition
         are discarded."""
         results: list[tuple[Request, list[int]]] = []
+        if isinstance(work, VerifyWork):
+            # acceptance: the model's argmax m[j] at fed position j is valid
+            # output iff every earlier proposal matched; the first mismatch
+            # position still yields m[j] itself (the "bonus" token) — so a
+            # row emits 1..k+1 tokens, and a proposal-less row emits exactly
+            # its plain greedy token
+            accepted_rows: list[list[int]] = []
+            for i, req in enumerate(work.requests):
+                m = sampled[i]
+                p = work.proposals[i]
+                accepted: list[int] = []
+                for j in range(len(p) + 1):
+                    accepted.append(int(m[j]))
+                    if j < len(p) and int(m[j]) != p[j]:
+                        break
+                self.spec_proposed_tokens += len(p)
+                self.spec_accepted_tokens += len(accepted) - 1
+                accepted_rows.append(accepted)
+            work = DecodeWork(requests=work.requests)  # shared accounting
+            sampled = accepted_rows
         if isinstance(work, PrefillWork):
             for i, req in enumerate(work.requests):
                 start = req.num_computed_tokens

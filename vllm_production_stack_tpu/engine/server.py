@@ -615,6 +615,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--expert-parallel-size", type=int, default=1,
                    help="MoE expert parallelism: shard Mixtral-family "
                         "expert FFNs over an ep mesh axis")
+    p.add_argument("--num-speculative-tokens", type=int, default=0,
+                   help="n-gram speculative decoding: propose up to this "
+                        "many tokens by prompt lookup and verify them in "
+                        "one dispatch (greedy requests only; 0 disables)")
+    p.add_argument("--speculative-min-ngram", type=int, default=2)
     p.add_argument("--kv-cache-dtype", default="auto",
                    choices=["auto", "fp8"],
                    help="KV pool storage dtype: fp8 (float8_e4m3fn) halves "
@@ -661,6 +666,8 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             decode_buckets=decode_buckets,
             prefill_buckets=prefill_buckets,
             decode_window=args.decode_window,
+            num_speculative_tokens=args.num_speculative_tokens,
+            speculative_min_ngram=args.speculative_min_ngram,
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,

@@ -1,0 +1,54 @@
+"""N-gram (prompt-lookup) speculative decoding.
+
+The reference stack's engines inherit vLLM's `--speculative-config
+{"method": "ngram", ...}`: propose the next k tokens by matching the tail
+of the sequence against its own history, then VERIFY all k in one model
+step — the model's argmax at each proposed position either confirms the
+proposal token or replaces it, so one dispatch yields 1..k+1 tokens
+instead of 1. Greedy-only (verification of sampled tokens needs rejection
+sampling; vLLM's ngram path is typically used the same way).
+
+TPU shape of the idea: verification is exactly a chunked-prefill step with
+argmax at EVERY position (models/llama.py:forward over the paged pool —
+static (batch, k+1) shapes, no new kernel), and a row with no n-gram match
+simply proposes nothing and gets its 1 bonus token — so the verify program
+SUBSUMES plain decode for greedy rows and the scheduler can route all of
+them through it.
+"""
+
+from __future__ import annotations
+
+MAX_NGRAM = 4
+# history window the proposer searches: bounds the per-step host cost at
+# long context (this runs in the scheduler loop for every greedy row every
+# decode step; vLLM's ngram speculator has the same knob)
+MAX_LOOKBACK = 1024
+
+
+def propose_ngram(
+    tokens: list[int],
+    k: int,
+    min_ngram: int = 2,
+    max_ngram: int = MAX_NGRAM,
+    max_lookback: int = MAX_LOOKBACK,
+) -> list[int] | None:
+    """Propose up to k continuation tokens by matching the sequence's tail
+    n-gram against its recent history (longest n first, most recent match
+    wins). Returns None when no n-gram of length >= min_ngram recurs in the
+    lookback window."""
+    if k <= 0 or len(tokens) < min_ngram + 1:
+        return None
+    lo = max(0, len(tokens) - max_lookback)
+    window = tokens[lo:]
+    for n in range(min(max_ngram, len(window) - 1), min_ngram - 1, -1):
+        tail = window[-n:]
+        first = tail[0]
+        # scan right-to-left over history (exclude the tail match itself)
+        for start in range(len(window) - n - 1, -1, -1):
+            # cheap first-element pre-check before the slice+compare
+            if window[start] != first or window[start : start + n] != tail:
+                continue
+            cont = window[start + n : start + n + k]
+            if cont:
+                return cont
+    return None

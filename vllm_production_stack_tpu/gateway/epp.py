@@ -62,28 +62,28 @@ def _load_pb2():
 
         tmp_dir = f"{out_dir}.tmp{os.getpid()}"
         os.makedirs(tmp_dir, exist_ok=True)
+        subprocess.run(
+            [
+                "protoc",
+                f"-I{os.path.dirname(_PROTO)}",
+                f"--python_out={tmp_dir}",
+                os.path.basename(_PROTO),
+            ],
+            check=True,
+            capture_output=True,
+        )
         try:
-            subprocess.run(
-                [
-                    "protoc",
-                    f"-I{os.path.dirname(_PROTO)}",
-                    f"--python_out={tmp_dir}",
-                    os.path.basename(_PROTO),
-                ],
-                check=True,
-                capture_output=True,
-            )
-            try:
-                os.replace(tmp_dir, out_dir)
-            except OSError:
-                # replace can fail because (a) a concurrent start won the
-                # race with a COMPLETE dir — use theirs — or (b) out_dir is
-                # stale debris without the module: clear it and retry once
-                if not os.path.exists(marker):
-                    shutil.rmtree(out_dir, ignore_errors=True)
-                    os.replace(tmp_dir, out_dir)
-        finally:
+            os.replace(tmp_dir, out_dir)
+        except OSError:
+            pass  # a concurrent start won the rename race
+        if os.path.exists(marker):
+            # the canonical dir is complete (ours or the race winner's)
             shutil.rmtree(tmp_dir, ignore_errors=True)
+        else:
+            # out_dir is stale debris from a killed run — NEVER delete it
+            # (a concurrent starter may be importing from it); import from
+            # our own private tmp_dir instead
+            out_dir = tmp_dir
     if out_dir not in sys.path:
         sys.path.insert(0, out_dir)
     import ext_proc_min_pb2  # noqa: E402

@@ -26,6 +26,9 @@ PROMPT_TOKENS = "tpu:prompt_tokens_total"
 GENERATION_TOKENS = "tpu:generation_tokens_total"
 HOST_KV_OFFLOADS = "tpu:host_kv_offloaded_blocks_total"
 HOST_KV_RELOADS = "tpu:host_kv_reloaded_blocks_total"
+# n-gram speculative decoding (vLLM parity: vllm:spec_decode_num_*_tokens)
+SPEC_DRAFT_TOKENS = "tpu:spec_decode_num_draft_tokens_total"
+SPEC_ACCEPTED_TOKENS = "tpu:spec_decode_num_accepted_tokens_total"
 
 ALL_GAUGES = (
     NUM_REQUESTS_RUNNING,
@@ -42,4 +45,6 @@ ALL_COUNTERS = (
     GENERATION_TOKENS,
     HOST_KV_OFFLOADS,
     HOST_KV_RELOADS,
+    SPEC_DRAFT_TOKENS,
+    SPEC_ACCEPTED_TOKENS,
 )
