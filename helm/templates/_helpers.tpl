@@ -53,6 +53,26 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--max-loras"
 - {{ .maxLoras | quote }}
 {{- end }}
+{{- if .sequenceParallelSize }}
+- "--sequence-parallel-size"
+- {{ .sequenceParallelSize | quote }}
+{{- end }}
+{{- if .expertParallelSize }}
+- "--expert-parallel-size"
+- {{ .expertParallelSize | quote }}
+{{- end }}
+{{- if .kvCacheDtype }}
+- "--kv-cache-dtype"
+- {{ .kvCacheDtype | quote }}
+{{- end }}
+{{- if .numSpeculativeTokens }}
+- "--num-speculative-tokens"
+- {{ .numSpeculativeTokens | quote }}
+{{- end }}
+{{- if .decodeWindow }}
+- "--decode-window"
+- {{ .decodeWindow | quote }}
+{{- end }}
 {{- if eq (.enablePrefixCaching | default true) false }}
 - "--no-enable-prefix-caching"
 {{- end }}

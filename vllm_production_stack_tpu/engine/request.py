@@ -23,7 +23,6 @@ class SamplingParams:
     stop_token_ids: tuple[int, ...] = ()
     ignore_eos: bool = False
     seed: int | None = None
-    logprobs: int | None = None
 
     @property
     def greedy(self) -> bool:
