@@ -298,3 +298,34 @@ def test_loraadapter_reconcile_loads_on_ready_pods(tmp_path):
                 await s.close()
 
     _with_fake_k8s(go)
+
+
+def test_engine_args_parse_with_real_engine_argparse():
+    """Every spec->argv mapping must produce flags the engine's own argparse
+    accepts (a typo here otherwise only surfaces as a crash-looping pod)."""
+    from vllm_production_stack_tpu.engine.server import build_parser
+    from vllm_production_stack_tpu.operator.resources import engine_args
+
+    spec = {
+        "model": {
+            "modelURL": "tiny-llama", "servedModelName": "m",
+            "maxModelLen": 256, "dtype": "float32",
+        },
+        "tpuConfig": {
+            "tensorParallelSize": 2, "maxNumSeqs": 8, "maxLoras": 1,
+            "numHostBlocks": 4, "sequenceParallelSize": 2,
+            "expertParallelSize": 2, "kvCacheDtype": "fp8",
+            "numSpeculativeTokens": 3, "decodeWindow": 16,
+            "enablePrefixCaching": False, "extraArgs": ["--seed", "7"],
+        },
+    }
+    argv = engine_args(spec)
+    assert argv[:2] == ["-m", "vllm_production_stack_tpu.engine.server"]
+    ns = build_parser().parse_args(argv[2:])  # raises on any unknown flag
+    assert ns.sequence_parallel_size == 2
+    assert ns.expert_parallel_size == 2
+    assert ns.kv_cache_dtype == "fp8"
+    assert ns.num_speculative_tokens == 3
+    assert ns.decode_window == 16
+    assert ns.enable_prefix_caching is False
+    assert ns.seed == 7

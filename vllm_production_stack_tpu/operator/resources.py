@@ -61,6 +61,16 @@ def engine_args(spec: dict) -> list[str]:
         args += ["--max-loras", str(tpu["maxLoras"])]
     if tpu.get("numHostBlocks"):
         args += ["--num-host-blocks", str(tpu["numHostBlocks"])]
+    if tpu.get("sequenceParallelSize"):
+        args += ["--sequence-parallel-size", str(tpu["sequenceParallelSize"])]
+    if tpu.get("expertParallelSize"):
+        args += ["--expert-parallel-size", str(tpu["expertParallelSize"])]
+    if tpu.get("kvCacheDtype"):
+        args += ["--kv-cache-dtype", str(tpu["kvCacheDtype"])]
+    if tpu.get("numSpeculativeTokens"):
+        args += ["--num-speculative-tokens", str(tpu["numSpeculativeTokens"])]
+    if tpu.get("decodeWindow"):
+        args += ["--decode-window", str(tpu["decodeWindow"])]
     if tpu.get("enablePrefixCaching") is False:
         args += ["--no-enable-prefix-caching"]
     args += [str(a) for a in tpu.get("extraArgs", [])]
