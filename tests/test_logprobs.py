@@ -1,0 +1,153 @@
+"""OpenAI logprobs: per-token chosen logprob + top-N alternatives through
+the completions and chat endpoints (streaming and not), with greedy
+consistency (chosen == top-1) and API-bound validation."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vllm_production_stack_tpu.engine.config import EngineConfig
+from vllm_production_stack_tpu.engine.engine import LLMEngine
+from vllm_production_stack_tpu.engine.server import EngineServer
+
+
+@pytest.fixture(scope="module")
+def srv():
+    engine = LLMEngine(EngineConfig.tiny())
+    return EngineServer(engine, served_model_name="tiny-llama")
+
+
+def run_with_client(srv, coro_fn):
+    async def runner():
+        client = TestClient(TestServer(srv.build_app()))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def test_completions_logprobs_greedy(srv):
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={
+                "model": "tiny-llama", "prompt": "hello world",
+                "max_tokens": 6, "temperature": 0, "logprobs": 3,
+            },
+        )
+        return r.status, await r.json()
+
+    status, body = run_with_client(srv, go)
+    assert status == 200
+    lp = body["choices"][0]["logprobs"]
+    assert len(lp["tokens"]) == 6
+    assert len(lp["token_logprobs"]) == 6
+    assert len(lp["top_logprobs"]) == 6
+    assert lp["text_offset"][0] == 0
+    for chosen, top in zip(lp["token_logprobs"], lp["top_logprobs"]):
+        assert chosen <= 0.0
+        assert len(top) == 3
+        # greedy: the chosen token IS the argmax, so its logprob equals the
+        # best alternative's
+        assert abs(chosen - max(top.values())) < 1e-5
+
+
+def test_chat_logprobs_content(srv):
+    async def go(client):
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny-llama",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "temperature": 0,
+                "logprobs": True, "top_logprobs": 2,
+            },
+        )
+        return r.status, await r.json()
+
+    status, body = run_with_client(srv, go)
+    assert status == 200
+    content = body["choices"][0]["logprobs"]["content"]
+    assert len(content) == 4
+    for entry in content:
+        assert entry["logprob"] <= 0.0
+        assert len(entry["top_logprobs"]) == 2
+        assert isinstance(entry["bytes"], list)
+        assert abs(
+            entry["logprob"] - entry["top_logprobs"][0]["logprob"]
+        ) < 1e-5  # greedy: chosen == top-1
+
+
+def test_streaming_chat_logprobs(srv):
+    async def go(client):
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny-llama",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "temperature": 0, "stream": True,
+                "logprobs": True, "top_logprobs": 1,
+            },
+        )
+        chunks = []
+        async for line in r.content:
+            line = line.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                chunks.append(json.loads(line[6:]))
+        return chunks
+
+    chunks = run_with_client(srv, go)
+    entries = [
+        e
+        for c in chunks
+        if c["choices"] and c["choices"][0].get("logprobs")
+        for e in c["choices"][0]["logprobs"]["content"]
+    ]
+    assert len(entries) == 4
+    assert all(e["logprob"] <= 0.0 for e in entries)
+
+
+def test_logprobs_bound_validation(srv):
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={
+                "model": "tiny-llama", "prompt": "x", "max_tokens": 2,
+                "logprobs": 50,
+            },
+        )
+        return r.status
+
+    assert run_with_client(srv, go) == 400
+
+
+def test_logprobs_with_sampling_and_no_logprobs_default(srv):
+    """Sampled requests collect logprobs too; requests without the field
+    get none."""
+    async def go(client):
+        r1 = await client.post(
+            "/v1/completions",
+            json={
+                "model": "tiny-llama", "prompt": "abc", "max_tokens": 3,
+                "temperature": 0.7, "seed": 5, "logprobs": 0,
+            },
+        )
+        r2 = await client.post(
+            "/v1/completions",
+            json={
+                "model": "tiny-llama", "prompt": "abc", "max_tokens": 3,
+                "temperature": 0.7, "seed": 5,
+            },
+        )
+        return await r1.json(), await r2.json()
+
+    b1, b2 = run_with_client(srv, go)
+    lp = b1["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 3
+    assert lp["top_logprobs"] == [{}, {}, {}]  # N=0: chosen-only
+    assert "logprobs" not in b2["choices"][0]

@@ -312,7 +312,10 @@ class LLMEngine:
         )
         passes = 0
 
-        def wave(rows: int, prompt_len: int, max_tokens: int) -> None:
+        def wave(
+            rows: int, prompt_len: int, max_tokens: int,
+            logprobs: int | None = None,
+        ) -> None:
             nonlocal passes
             prompts = [
                 list(
@@ -325,7 +328,7 @@ class LLMEngine:
             self.generate(
                 prompts,
                 SamplingParams(max_tokens=max_tokens, temperature=0.0,
-                               ignore_eos=True),
+                               ignore_eos=True, logprobs=logprobs),
             )
             passes += 1
 
@@ -357,6 +360,20 @@ class LLMEngine:
                     # window program w, not round_up_pow2(w-1)
                     wave(rows, 8, w + 1)
             w *= 2
+        # logprobs variants (want_logprobs is a static jit arg -> separate
+        # programs): warm the largest prefill bucket and every decode bucket
+        # at the full window — the common production hit. Smaller windows'
+        # logprob variants still compile lazily (warming the full cross
+        # product would double warmup time for a rarely-mixed dimension).
+        wave(1, min(sorted(sched.prefill_buckets)[0], longest_chunk), 1,
+             logprobs=0)
+        for b in sched.decode_buckets:
+            if b > sched.max_num_seqs:
+                continue
+            per_seq = 8 + sched.decode_window + 2
+            rows = max(1, min(b, usable_tokens // per_seq))
+            if rows == b or b == min(sched.decode_buckets):
+                wave(rows, 8, sched.decode_window + 1, logprobs=0)
         logger.info("warmup ran %d bucket passes", passes)
         return passes
 
@@ -427,11 +444,16 @@ class LLMEngine:
             self._drop_finished(outputs)
             return outputs
         sampled = self.runner.execute(work)
+        lp_rows = self.runner.last_logprobs  # parallel to sampled rows
         results = self.scheduler.postprocess(work, sampled)
 
-        for req, toks in results:
+        for row_i, (req, toks) in enumerate(results):
             if not toks:  # mid-prompt prefill chunk: progress, no tokens
                 continue
+            new_lp = None
+            if lp_rows is not None and req.sampling.logprobs is not None:
+                # accepted tokens are a prefix of the dispatched row
+                new_lp = lp_rows[row_i][: len(toks)]
             self._generation_tokens += len(toks)
             if req.first_token_time is None:
                 req.first_token_time = time.monotonic()
@@ -449,7 +471,9 @@ class LLMEngine:
                         self.scheduler.finish_request(
                             req, RequestStatus.FINISHED_STOPPED
                         )
-                    outputs.append(self._make_output(req, toks, emit, "stop"))
+                    outputs.append(
+                        self._make_output(req, toks, emit, "stop", new_lp)
+                    )
                     continue
                 if req.status.finished:  # eos/length: flush held-back text
                     emit = state.pending_text
@@ -458,14 +482,18 @@ class LLMEngine:
                 else:  # hold back text that could be a stop-string prefix
                     emit = self._emittable(state, req.sampling.stop)
                 outputs.append(
-                    self._make_output(req, toks, emit, self._finish_reason(req))
+                    self._make_output(
+                        req, toks, emit, self._finish_reason(req), new_lp
+                    )
                 )
                 continue
 
             if state is not None:
                 state.text += new_text
             outputs.append(
-                self._make_output(req, toks, new_text, self._finish_reason(req))
+                self._make_output(
+                    req, toks, new_text, self._finish_reason(req), new_lp
+                )
             )
 
         self._drop_finished(outputs)
@@ -477,9 +505,11 @@ class LLMEngine:
                 self._states.pop(out.request_id, None)
 
     def _make_output(
-        self, req: Request, toks: list[int], text: str, finish_reason: str | None
+        self, req: Request, toks: list[int], text: str,
+        finish_reason: str | None, new_logprobs=None,
     ) -> RequestOutput:
         out = RequestOutput(
+            new_logprobs=new_logprobs,
             request_id=req.request_id,
             new_token_ids=toks,
             finished=req.status.finished,

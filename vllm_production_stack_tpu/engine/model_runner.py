@@ -40,6 +40,19 @@ from .scheduler import DecodeWork, PrefillWork, ScheduleOutput, VerifyWork
 
 logger = logging.getLogger(__name__)
 
+# top-N alternatives collected when a batch contains logprobs requests —
+# static (one extra compiled variant per program, lazily); requests asking
+# for more are rejected at the API layer
+LOGPROBS_TOPN = 8
+
+
+def _collect_logprobs(logits: jax.Array, tokens: jax.Array):
+    """(chosen_lp (S,), top_lp (S, N), top_id (S, N)) from (S, V) logits."""
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    chosen = jnp.take_along_axis(lp, tokens[:, None].astype(jnp.int32), 1)[:, 0]
+    top_lp, top_id = jax.lax.top_k(lp, LOGPROBS_TOPN)
+    return chosen, top_lp, top_id.astype(jnp.int32)
+
 
 class ModelRunner:
     def __init__(
@@ -175,6 +188,10 @@ class ModelRunner:
             if config.scheduler.num_speculative_tokens > 0
             else None
         )
+        # per-execute logprob rows (parallel to the returned token rows)
+        # when the dispatched batch requested them; None otherwise. Read by
+        # LLMEngine.step right after execute().
+        self.last_logprobs: list | None = None
         self._sleeping_params_host: Any | None = None
         self._sleeping_lora_host: Any | None = None
         self._upload_block_fn = None
@@ -263,7 +280,11 @@ class ModelRunner:
     def _build_step_fn(self):
         cfg = self.config.model
 
-        @functools.partial(jax.jit, donate_argnames=("kv_caches",))
+        @functools.partial(
+            jax.jit,
+            donate_argnames=("kv_caches",),
+            static_argnames=("want_logprobs",),
+        )
         def step_fn(
             params,
             lora_params,  # stacked adapter tree, or None when LoRA disabled
@@ -285,6 +306,7 @@ class ModelRunner:
             seeds,  # (num_samples,) int32
             has_seed,  # (num_samples,) bool
             counts,  # (num_samples,) int32 output tokens so far
+            want_logprobs=False,  # static: also return chosen/top-N logprobs
         ):
             hidden, kv_caches = llama.forward(
                 cfg, params, token_ids, positions, kv_caches,
@@ -302,6 +324,8 @@ class ModelRunner:
             tokens = sample(
                 logits, temperature, top_p, top_k, rng, seeds, has_seed, counts
             )
+            if want_logprobs:
+                return kv_caches, tokens, _collect_logprobs(logits, tokens)
             return kv_caches, tokens
 
         return step_fn
@@ -314,7 +338,11 @@ class ModelRunner:
         cfg = self.config.model
         mesh = self.mesh
 
-        @functools.partial(jax.jit, donate_argnames=("kv_caches",))
+        @functools.partial(
+            jax.jit,
+            donate_argnames=("kv_caches",),
+            static_argnames=("want_logprobs",),
+        )
         def sp_step_fn(
             params,
             lora_params,
@@ -336,6 +364,7 @@ class ModelRunner:
             seeds,
             has_seed,
             counts,
+            want_logprobs=False,
         ):
             del write_ids, start_off
             hist_lens = context_lens - chunk_lens
@@ -350,6 +379,8 @@ class ModelRunner:
             tokens = sample(
                 logits, temperature, top_p, top_k, rng, seeds, has_seed, counts
             )
+            if want_logprobs:
+                return kv_caches, tokens, _collect_logprobs(logits, tokens)
             return kv_caches, tokens
 
         return sp_step_fn
@@ -373,7 +404,7 @@ class ModelRunner:
 
         @functools.partial(
             jax.jit,
-            static_argnames=("window",),
+            static_argnames=("window", "want_logprobs"),
             donate_argnames=("kv_caches",),
         )
         def decode_window_fn(
@@ -392,9 +423,13 @@ class ModelRunner:
             has_seed,  # (B,) bool
             counts0,  # (B,) output tokens generated before this window
             window: int,
+            want_logprobs: bool = False,
         ):
             b = first_tokens.shape[0]
             out = jnp.zeros((b, window), jnp.int32)
+            lp_out = jnp.zeros((b, window), jnp.float32)
+            top_lp_out = jnp.zeros((b, window, LOGPROBS_TOPN), jnp.float32)
+            top_id_out = jnp.zeros((b, window, LOGPROBS_TOPN), jnp.int32)
             staged = llama.init_staged_kv(cfg, window, b)
             # hoist the loop-invariant history gather out of the window loop
             # when this program's contiguous copy fits HBM headroom (static
@@ -414,7 +449,7 @@ class ModelRunner:
             )
 
             def body(k, carry):
-                staged, cur, out = carry
+                staged, cur, out, lp_out, top_lp_out, top_id_out = carry
                 # pool history for row r is positions < positions0[r]; the
                 # window's own tokens live in `staged` until the final commit
                 hidden, staged = llama.decode_window_step(
@@ -429,10 +464,19 @@ class ModelRunner:
                     jax.random.fold_in(base_key, k),
                     seeds, has_seed, counts0 + k,
                 )
-                return staged, toks, out.at[:, k].set(toks)
+                if want_logprobs:
+                    chosen, top_lp, top_id = _collect_logprobs(logits, toks)
+                    lp_out = lp_out.at[:, k].set(chosen)
+                    top_lp_out = top_lp_out.at[:, k].set(top_lp)
+                    top_id_out = top_id_out.at[:, k].set(top_id)
+                return (
+                    staged, toks, out.at[:, k].set(toks),
+                    lp_out, top_lp_out, top_id_out,
+                )
 
-            staged, _, out = jax.lax.fori_loop(
-                0, window, body, (staged, first_tokens, out)
+            staged, _, out, lp_out, top_lp_out, top_id_out = jax.lax.fori_loop(
+                0, window, body,
+                (staged, first_tokens, out, lp_out, top_lp_out, top_id_out),
             )
             # commit the window's KV to the pool: slots for row r, step k are
             # position positions0[r] + k via the row's block table
@@ -440,6 +484,8 @@ class ModelRunner:
             blk = jnp.take_along_axis(block_tables, pos // block_size, axis=1)
             slots = (blk * block_size + pos % block_size).reshape(-1)
             kv_caches = llama.commit_staged_kv(kv_caches, staged, slots)
+            if want_logprobs:
+                return kv_caches, out, (lp_out, top_lp_out, top_id_out)
             return kv_caches, out
 
         return decode_window_fn
@@ -485,6 +531,9 @@ class ModelRunner:
         return verify_fn
 
     def _execute_verify(self, work: VerifyWork) -> list[list[int]]:
+        # logprobs requests are routed away from the verify path
+        # (scheduler._schedule_decode_or_verify)
+        self.last_logprobs = None
         sched = self.config.scheduler
         b = len(work.requests)
         b_pad = sched.bucket_for(b, sched.decode_buckets)
@@ -608,12 +657,31 @@ class ModelRunner:
         lora_idx = np.zeros(b_pad, np.int32)
         for i, req in enumerate(work.requests):
             lora_idx[i] = req.lora_index
-        tokens = self._run(
+        want_lp = any(
+            work.sample[i] and req.sampling.logprobs is not None
+            for i, req in enumerate(work.requests)
+        )
+        tokens, lp = self._run(
             token_ids, positions, block_tables,
             slots.reshape(-1) if slots is not None else np.zeros(1, np.int32),
             context_lens, chunk_lens, write_ids, start_off, lora_idx,
             sample_rows, temps, top_ps, top_ks, seeds=seeds, counts=counts,
+            want_logprobs=want_lp,
         )
+        if lp is None:
+            self.last_logprobs = None
+        else:
+            chosen, top_lp, top_id = lp
+            self.last_logprobs = [
+                (
+                    [(float(chosen[i]),
+                      list(map(int, top_id[i])),
+                      list(map(float, top_lp[i])))]
+                    if work.sample[i]
+                    else []
+                )
+                for i in range(b)
+            ]
         return [
             [int(tokens[i])] if work.sample[i] else [] for i in range(b)
         ]
@@ -644,7 +712,10 @@ class ModelRunner:
         lora_idx = np.zeros(b_pad, np.int32)
         for i, req in enumerate(work.requests):
             lora_idx[i] = req.lora_index
-        self.kv_caches, tokens = self._decode_window_fn(
+        want_lp = any(
+            r.sampling.logprobs is not None for r in work.requests
+        )
+        result = self._decode_window_fn(
             self.params,
             self.lora_params,
             self.kv_caches,
@@ -660,7 +731,32 @@ class ModelRunner:
             self._put(has_seed, self._batch1),
             self._put(np.asarray(counts, np.int32), self._batch1),
             window=work.window,
+            want_logprobs=want_lp,
         )
+        if want_lp:
+            self.kv_caches, tokens, (lp_w, top_lp_w, top_id_w) = result
+            lp_w = np.asarray(jax.device_get(lp_w))
+            top_lp_w = np.asarray(jax.device_get(top_lp_w))
+            top_id_w = np.asarray(jax.device_get(top_id_w))
+            # python-ify only the rows that asked — the device already
+            # computed the whole batch, but 256x32x8 tuple-building on the
+            # host for rows the engine will ignore is pure waste
+            self.last_logprobs = [
+                (
+                    [
+                        (float(lp_w[i, k]),
+                         list(map(int, top_id_w[i, k])),
+                         list(map(float, top_lp_w[i, k])))
+                        for k in range(work.window)
+                    ]
+                    if req.sampling.logprobs is not None
+                    else []
+                )
+                for i, req in enumerate(work.requests)
+            ]
+        else:
+            self.kv_caches, tokens = result
+            self.last_logprobs = None
         mat = np.asarray(jax.device_get(tokens))
         return [list(map(int, mat[i])) for i in range(b)]
 
@@ -669,7 +765,7 @@ class ModelRunner:
     def _run(
         self, token_ids, positions, block_tables, slots, context_lens,
         chunk_lens, write_ids, start_off, lora_idx, sample_rows, temps,
-        top_ps, top_ks, seeds, counts,
+        top_ps, top_ks, seeds, counts, want_logprobs=False,
     ):
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
@@ -681,7 +777,7 @@ class ModelRunner:
         )
         # sp shards the chunk axis; dp-only meshes leave T unsharded
         tok_sh = self._seq2 if self._sp > 1 else self._batch2
-        self.kv_caches, tokens = self._step_fn(
+        result = self._step_fn(
             self.params,
             self.lora_params,
             self.kv_caches,
@@ -704,8 +800,15 @@ class ModelRunner:
             self._put(seed_vals, self._batch1),
             self._put(has_seed, self._batch1),
             self._put(np.asarray(counts, np.int32), self._batch1),
+            want_logprobs=want_logprobs,
         )
-        return np.asarray(jax.device_get(tokens))
+        if want_logprobs:
+            self.kv_caches, tokens, lp = result
+            lp = tuple(np.asarray(jax.device_get(x)) for x in lp)
+        else:
+            self.kv_caches, tokens = result
+            lp = None
+        return np.asarray(jax.device_get(tokens)), lp
 
     @staticmethod
     def _pow2(n: int) -> int:

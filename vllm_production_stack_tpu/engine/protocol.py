@@ -51,6 +51,8 @@ class ChatCompletionRequest(OpenAIModel):
     seed: int | None = None
     user: str | None = None
     ignore_eos: bool = False  # extension (benchmark harnesses rely on it)
+    logprobs: bool = False
+    top_logprobs: int | None = None
 
     def sampling(self, default_max_tokens: int) -> SamplingParams:
         stop = self.stop if self.stop is not None else []
@@ -66,6 +68,9 @@ class ChatCompletionRequest(OpenAIModel):
             stop=tuple(stop),
             seed=self.seed,
             ignore_eos=self.ignore_eos,
+            logprobs=(
+                (self.top_logprobs or 0) if self.logprobs else None
+            ),
         )
 
 
@@ -84,6 +89,7 @@ class CompletionRequest(OpenAIModel):
     echo: bool = False
     user: str | None = None
     ignore_eos: bool = False
+    logprobs: int | None = None
 
     def sampling(self, default_max_tokens: int) -> SamplingParams:
         stop = self.stop if self.stop is not None else []
@@ -97,6 +103,7 @@ class CompletionRequest(OpenAIModel):
             stop=tuple(stop),
             seed=self.seed,
             ignore_eos=self.ignore_eos,
+            logprobs=self.logprobs,
         )
 
 

@@ -23,6 +23,10 @@ class SamplingParams:
     stop_token_ids: tuple[int, ...] = ()
     ignore_eos: bool = False
     seed: int | None = None
+    # OpenAI logprobs: None = off; N = return the chosen token's logprob
+    # plus the top-N alternatives per generated token (N <= runner
+    # LOGPROBS_TOPN; 0 = chosen-only)
+    logprobs: int | None = None
 
     @property
     def greedy(self) -> bool:
@@ -121,3 +125,6 @@ class RequestOutput:
     num_output_tokens: int = 0
     num_cached_prompt_tokens: int = 0
     text_delta: str = ""
+    # aligned with new_token_ids when the request asked for logprobs:
+    # one (chosen_logprob, top_ids, top_logprobs) triple per token
+    new_logprobs: list[tuple[float, list[int], list[float]]] | None = None

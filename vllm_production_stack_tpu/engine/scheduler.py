@@ -195,7 +195,9 @@ class Scheduler:
         # window amortizes), as do sampled rows
         proposals: dict[str, list[int]] = {}
         for r in ready:
-            if r.sampling.temperature == 0.0:
+            # logprobs requests stay on the decode-window path (the verify
+            # program returns argmax ids only)
+            if r.sampling.temperature == 0.0 and r.sampling.logprobs is None:
                 p = propose_ngram(
                     r.all_token_ids, k, self.config.speculative_min_ngram
                 )

@@ -64,6 +64,7 @@ class EngineServer:
         self.model_name = served_model_name or engine.config.model.model
         self.metrics = EngineMetrics(self.model_name)
         self._session = None  # lazy outbound ClientSession (kv_pull)
+        self._tok_repr_cache: dict[int, tuple[str, list[int]]] = {}
         self._start_time = time.time()
 
     @property
@@ -160,6 +161,8 @@ class EngineServer:
             [m.model_dump() for m in body.messages]
         )
         sampling = body.sampling(DEFAULT_MAX_TOKENS)
+        if err := self._check_logprobs(sampling):
+            return err
         rid = request.headers.get("X-Request-Id") or random_id("chatcmpl")
         if body.stream:
             return await self._stream(
@@ -184,6 +187,8 @@ class EngineServer:
         if prompt is None and prompt_ids is None:
             return error(400, "batched prompts are not supported yet")
         sampling = body.sampling(DEFAULT_MAX_TOKENS)
+        if err := self._check_logprobs(sampling):
+            return err
         rid = request.headers.get("X-Request-Id") or random_id("cmpl")
         if body.stream:
             return await self._stream(
@@ -271,12 +276,82 @@ class EngineServer:
             return prompt[0], None
         return None, None
 
+    def _check_logprobs(self, sampling):
+        from .model_runner import LOGPROBS_TOPN
+
+        if sampling.logprobs is not None and not (
+            0 <= sampling.logprobs <= LOGPROBS_TOPN
+        ):
+            return error(
+                400,
+                f"logprobs/top_logprobs must be between 0 and {LOGPROBS_TOPN}",
+            )
+        return None
+
+    def _tok_entry(self, tid: int) -> tuple[str, list[int]]:
+        """(display string, byte list) for one token, cached per id. Tokens
+        with no representation get a unique placeholder — the legacy
+        completions top_logprobs dict is keyed by the string, and collisions
+        would silently drop alternatives."""
+        tid = int(tid)
+        cached = self._tok_repr_cache.get(tid)
+        if cached is not None:
+            return cached
+        s, bts = self.engine.tokenizer.token_repr(tid)
+        if not s:
+            s = f"<token_{tid}>"
+        entry = (s, list(bts if bts else s.encode("utf-8")))
+        if len(self._tok_repr_cache) < 65536:
+            self._tok_repr_cache[tid] = entry
+        return entry
+
+    def _chat_logprobs(self, toks, entries, n):
+        """OpenAI chat logprobs.content entries for one delta."""
+        content = []
+        for tid, (chosen, top_ids, top_lps) in zip(toks, entries):
+            s, bts = self._tok_entry(tid)
+            top = []
+            for i, l in zip(top_ids[:n], top_lps[:n]):
+                ts, tb = self._tok_entry(i)
+                top.append({"token": ts, "logprob": l, "bytes": tb})
+            content.append({
+                "token": s,
+                "logprob": chosen,
+                "bytes": bts,
+                "top_logprobs": top,
+            })
+        return {"content": content}
+
+    def _completion_logprobs(self, toks, entries, n, offset0=0):
+        """Legacy completions logprobs block for one delta."""
+        tokens, token_logprobs, top_logprobs, text_offset = [], [], [], []
+        off = offset0
+        for tid, (chosen, top_ids, top_lps) in zip(toks, entries):
+            s, _ = self._tok_entry(tid)
+            tokens.append(s)
+            token_logprobs.append(chosen)
+            top_logprobs.append(
+                {
+                    self._tok_entry(i)[0]: l
+                    for i, l in zip(top_ids[:n], top_lps[:n])
+                }
+            )
+            text_offset.append(off)
+            off += len(s)
+        return {
+            "tokens": tokens,
+            "token_logprobs": token_logprobs,
+            "top_logprobs": top_logprobs,
+            "text_offset": text_offset,
+        }, off
+
     async def _complete(
         self, rid, prompt, sampling, *, chat: bool, prompt_ids=None,
         lora_name=None,
     ) -> web.Response:
         text = ""
         token_ids: list[int] = []
+        lp_entries: list = []
         finish_reason = None
         n_prompt = 0
         try:
@@ -286,6 +361,8 @@ class EngineServer:
             ):
                 text += out.text_delta
                 token_ids.extend(out.new_token_ids)
+                if out.new_logprobs:
+                    lp_entries.extend(out.new_logprobs)
                 finish_reason = out.finish_reason
                 n_prompt = out.num_prompt_tokens
         except ValueError as e:
@@ -303,9 +380,17 @@ class EngineServer:
                 "message": {"role": "assistant", "content": text},
                 "finish_reason": finish_reason,
             }
+            if sampling.logprobs is not None:
+                choice["logprobs"] = self._chat_logprobs(
+                    token_ids, lp_entries, sampling.logprobs
+                )
             obj = "chat.completion"
         else:
             choice = {"index": 0, "text": text, "finish_reason": finish_reason}
+            if sampling.logprobs is not None:
+                choice["logprobs"], _ = self._completion_logprobs(
+                    token_ids, lp_entries, sampling.logprobs
+                )
             obj = "text_completion"
         return web.json_response(
             {
@@ -341,6 +426,7 @@ class EngineServer:
         async def send(payload: dict) -> None:
             await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
 
+        lp_off = 0  # running text offset for completions logprobs
         if chat:  # role preamble chunk
             await send(self._chunk(rid, obj, created, {"role": "assistant"}, None))
         try:
@@ -353,18 +439,37 @@ class EngineServer:
                 if out.finish_reason == "error":
                     await send({"error": {"message": out.text_delta}})
                     break
-                if out.text_delta or out.finished:
+                # with logprobs on, token-bearing chunks must go out even
+                # when detok held their text back (multi-byte sequences) —
+                # the per-token entries ride the chunk
+                if out.text_delta or out.finished or (
+                    sampling.logprobs is not None and out.new_token_ids
+                ):
                     delta = (
                         {"content": out.text_delta}
                         if chat
                         else out.text_delta
                     )
-                    await send(
-                        self._chunk(
-                            rid, obj, created, delta,
-                            out.finish_reason if out.finished else None,
-                        )
+                    chunk = self._chunk(
+                        rid, obj, created, delta,
+                        out.finish_reason if out.finished else None,
                     )
+                    if sampling.logprobs is not None and out.new_logprobs:
+                        if chat:
+                            chunk["choices"][0]["logprobs"] = (
+                                self._chat_logprobs(
+                                    out.new_token_ids, out.new_logprobs,
+                                    sampling.logprobs,
+                                )
+                            )
+                        else:
+                            chunk["choices"][0]["logprobs"], lp_off = (
+                                self._completion_logprobs(
+                                    out.new_token_ids, out.new_logprobs,
+                                    sampling.logprobs, lp_off,
+                                )
+                            )
+                    await send(chunk)
         except ConnectionResetError:
             await self.async_engine.abort(rid)
             return resp

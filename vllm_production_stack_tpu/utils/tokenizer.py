@@ -88,6 +88,31 @@ class TokenizerWrapper:
     def decode(self, ids: list[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
+    def token_repr(self, tid: int) -> tuple[str, bytes]:
+        """(display string, raw bytes) for ONE token id — the logprobs API
+        surface. decode() of a single id is wrong for this: SentencePiece
+        strips leading-space markers and partial UTF-8 bytes decode to
+        nothing, so strings/offsets/bytes built that way don't reconstruct
+        the output. Uses the tokenizer's piece vocabulary when it has one;
+        the byte fallback reports the literal byte."""
+        tid = int(tid)
+        tok = self._tok
+        if hasattr(tok, "convert_ids_to_tokens"):
+            piece = tok.convert_ids_to_tokens(tid)
+            if piece is None:
+                return "", b""
+            # sentencepiece / byte-level BPE markers -> readable text
+            s = (
+                piece.replace("\u2581", " ")
+                .replace("\u0120", " ")
+                .replace("\u010a", "\n")
+            )
+            return s, piece.encode("utf-8")
+        if 0 <= tid < 256:
+            s = chr(tid) if 32 <= tid < 127 else f"<0x{tid:02x}>"
+            return s, bytes([tid])
+        return "", b""
+
     def chat_prompt(self, messages: list[dict]) -> str:
         try:
             out = self._tok.apply_chat_template(
