@@ -20,6 +20,13 @@ from .request import Request, RequestStatus
 from .spec_decode import propose_ngram
 
 
+def _is_stop_token(tok: int, sampling, eos: int | None) -> bool:
+    """THE stop-token predicate — shared by the bulk-accept cut scan and
+    _maybe_finish so a new stop condition can't be added to one and silently
+    missed by the other."""
+    return (eos is not None and tok == eos) or tok in sampling.stop_token_ids
+
+
 @dataclass
 class PrefillWork:
     """One batched prefill dispatch: one chunk from each of N requests, padded
@@ -533,16 +540,38 @@ class Scheduler:
                     results.append((req, []))
         else:
             for req, row in zip(work.requests, sampled):
-                accepted: list[int] = []
-                for tok in row:
+                # bulk accept: a decode window hands up to `window` candidate
+                # tokens per row — the previous token-at-a-time loop
+                # (computed += 1, register, append, finish-check per token)
+                # cost ~0.4 s of host time per 256x128 wave. Compute the cut
+                # point first, then apply in one pass; semantics match the
+                # per-token loop exactly (first eos/stop token is ACCEPTED
+                # then finishes; length caps clip the row).
+                s = req.sampling
+                n = min(
+                    len(row),
+                    s.max_tokens - len(req.output_token_ids),
+                    self.model_config.max_model_len - req.num_tokens,
+                )
+                cut = n
+                eos = None if s.ignore_eos else req.eos_token_id
+                if eos is not None or s.stop_token_ids:
+                    for j in range(n):
+                        if _is_stop_token(row[j], s, eos):
+                            cut = j + 1
+                            break
+                accepted = [int(t) for t in row[:cut]]
+                if accepted:
+                    # outputs FIRST: _register_full_blocks hashes block
+                    # contents via token_at over positions that include the
+                    # just-accepted tokens
+                    req.output_token_ids.extend(accepted)
                     start = req.num_computed_tokens
-                    req.num_computed_tokens += 1
-                    self._register_full_blocks(req, start, req.num_computed_tokens)
-                    req.output_token_ids.append(tok)
-                    accepted.append(tok)
+                    req.num_computed_tokens += len(accepted)
+                    self._register_full_blocks(
+                        req, start, req.num_computed_tokens
+                    )
                     self._maybe_finish(req)
-                    if req.status.finished:
-                        break
                 results.append((req, accepted))
         return results
 
@@ -565,9 +594,8 @@ class Scheduler:
     def _maybe_finish(self, req: Request) -> None:
         s = req.sampling
         last = req.output_token_ids[-1]
-        if not s.ignore_eos and req.eos_token_id is not None and last == req.eos_token_id:
-            status = RequestStatus.FINISHED_STOPPED
-        elif last in s.stop_token_ids:
+        eos = None if s.ignore_eos else req.eos_token_id
+        if _is_stop_token(last, s, eos):
             status = RequestStatus.FINISHED_STOPPED
         elif len(req.output_token_ids) >= s.max_tokens:
             status = RequestStatus.FINISHED_LENGTH
