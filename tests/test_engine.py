@@ -244,3 +244,29 @@ def test_midblock_chunked_prefill_matches_unchunked():
     chunked = [r["token_ids"] for r in build(12).generate(prompts, greedy)]
     whole = [r["token_ids"] for r in build(64).generate(prompts, greedy)]
     assert chunked == whole
+
+
+def test_min_tokens_suppresses_stop(engine):
+    """min_tokens holds off eos/stop-token finishes (vLLM extension): with
+    the first greedy token as a stop id, min_tokens forces generation past
+    it; without min_tokens it stops immediately."""
+    prompt = prompt_ids(77, 9)
+    probe = engine.generate(
+        [prompt], SamplingParams(max_tokens=1, temperature=0.0,
+                                 ignore_eos=True)
+    )[0]["token_ids"][0]
+    stopped = engine.generate(
+        [prompt],
+        SamplingParams(max_tokens=8, temperature=0.0,
+                       stop_token_ids=[probe]),
+    )[0]
+    assert len(stopped["token_ids"]) == 1
+    held = engine.generate(
+        [prompt],
+        SamplingParams(max_tokens=8, temperature=0.0,
+                       stop_token_ids=[probe], min_tokens=4),
+    )[0]
+    assert len(held["token_ids"]) >= 4
+    # vLLM semantics: below min_tokens the stop token is masked out of the
+    # DISTRIBUTION, not accepted-then-ignored — it never appears early
+    assert probe not in held["token_ids"][:4]

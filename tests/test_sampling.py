@@ -90,3 +90,51 @@ def test_seeded_rows_reproduce_regardless_of_batch():
 
     # same (seed, count) row must sample the same token in any batch shape
     assert draw([0, 1, 2, 3], 2) == draw([2], 0)
+
+
+def test_min_tokens_suppression_keeps_topk_functional():
+    """Suppression uses SUPPRESS_NEG (not -1e30): the top-k binary search
+    range stays resolvable, so a suppressed SAMPLED row still honors
+    top_k — the sampled token must come from the true top-k set."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.sampling import (
+        SUPPRESS_IDS, sample, suppress_stop_tokens,
+    )
+
+    rng = np.random.RandomState(0)
+    b, v = 4, 512
+    logits = jnp.asarray(rng.standard_normal((b, v)) * 3, jnp.float32)
+    stop_ids = np.full((b, SUPPRESS_IDS), -1, np.int32)
+    stop_ids[:, 0] = 7  # suppress token 7 everywhere
+    stop_ids[:, 1] = v + 100  # out-of-range: must be inert
+    sup = suppress_stop_tokens(
+        logits, jnp.zeros(b, jnp.int32), jnp.full(b, 10, jnp.int32),
+        jnp.asarray(stop_ids),
+    )
+    # token 7 suppressed, everything else untouched (incl. V-1: the
+    # out-of-range id must not clip onto it)
+    np.testing.assert_array_equal(
+        np.asarray(sup[:, :7]), np.asarray(logits[:, :7])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sup[:, 8:]), np.asarray(logits[:, 8:])
+    )
+    assert np.all(np.asarray(sup[:, 7]) < -1e4)
+
+    topk = 5
+    toks = sample(
+        sup,
+        jnp.full(b, 1.0, jnp.float32),
+        jnp.ones(b, jnp.float32),
+        jnp.full(b, topk, jnp.int32),
+        jax.random.PRNGKey(0),
+        jnp.zeros(b, jnp.uint32),
+        jnp.zeros(b, bool),
+        jnp.zeros(b, jnp.int32),
+    )
+    top_sets = np.argsort(np.asarray(sup), axis=-1)[:, -topk:]
+    for i in range(b):
+        assert int(toks[i]) in top_sets[i]

@@ -300,9 +300,10 @@ class LLMEngine:
         budget and < max_model_len) at FULL batch (the padded batch size is
         part of the program key), and every reachable decode bucket × the
         pow2 window set {1, 2, ..., decode_window} (window is a static jit
-        arg). NOT covered: block-table width buckets beyond those these
-        passes reach — they still compile lazily as contexts grow. Returns
-        the number of warmup passes run."""
+        arg), plus the want_logprobs / want_min_tokens static variants for
+        the common buckets. NOT covered: block-table width buckets beyond
+        those these passes reach — they still compile lazily as contexts
+        grow. Returns the number of warmup passes run."""
         import numpy as np
 
         sched = self.config.scheduler
@@ -314,7 +315,7 @@ class LLMEngine:
 
         def wave(
             rows: int, prompt_len: int, max_tokens: int,
-            logprobs: int | None = None,
+            logprobs: int | None = None, min_tokens: int = 0,
         ) -> None:
             nonlocal passes
             prompts = [
@@ -328,7 +329,8 @@ class LLMEngine:
             self.generate(
                 prompts,
                 SamplingParams(max_tokens=max_tokens, temperature=0.0,
-                               ignore_eos=True, logprobs=logprobs),
+                               ignore_eos=True, logprobs=logprobs,
+                               min_tokens=min_tokens),
             )
             passes += 1
 
@@ -365,15 +367,16 @@ class LLMEngine:
         # at the full window — the common production hit. Smaller windows'
         # logprob variants still compile lazily (warming the full cross
         # product would double warmup time for a rarely-mixed dimension).
-        wave(1, min(sorted(sched.prefill_buckets)[0], longest_chunk), 1,
-             logprobs=0)
-        for b in sched.decode_buckets:
-            if b > sched.max_num_seqs:
-                continue
-            per_seq = 8 + sched.decode_window + 2
-            rows = max(1, min(b, usable_tokens // per_seq))
-            if rows == b or b == min(sched.decode_buckets):
-                wave(rows, 8, sched.decode_window + 1, logprobs=0)
+        for extra in ({"logprobs": 0}, {"min_tokens": 1}):
+            wave(1, min(sorted(sched.prefill_buckets)[0], longest_chunk), 1,
+                 **extra)
+            for b in sched.decode_buckets:
+                if b > sched.max_num_seqs:
+                    continue
+                per_seq = 8 + sched.decode_window + 2
+                rows = max(1, min(b, usable_tokens // per_seq))
+                if rows == b or b == min(sched.decode_buckets):
+                    wave(rows, 8, sched.decode_window + 1, **extra)
         logger.info("warmup ran %d bucket passes", passes)
         return passes
 

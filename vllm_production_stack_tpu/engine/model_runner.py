@@ -35,7 +35,7 @@ from ..parallel.sharding import (
     lora_param_specs,
 )
 from .config import EngineConfig
-from .sampling import sample
+from .sampling import SUPPRESS_IDS, sample, suppress_stop_tokens
 from .scheduler import DecodeWork, PrefillWork, ScheduleOutput, VerifyWork
 
 logger = logging.getLogger(__name__)
@@ -192,6 +192,7 @@ class ModelRunner:
         # when the dispatched batch requested them; None otherwise. Read by
         # LLMEngine.step right after execute().
         self.last_logprobs: list | None = None
+        self._zero_stop_arrays: dict[int, tuple] = {}
         self._sleeping_params_host: Any | None = None
         self._sleeping_lora_host: Any | None = None
         self._upload_block_fn = None
@@ -283,7 +284,7 @@ class ModelRunner:
         @functools.partial(
             jax.jit,
             donate_argnames=("kv_caches",),
-            static_argnames=("want_logprobs",),
+            static_argnames=("want_logprobs", "want_min_tokens"),
         )
         def step_fn(
             params,
@@ -306,7 +307,11 @@ class ModelRunner:
             seeds,  # (num_samples,) int32
             has_seed,  # (num_samples,) bool
             counts,  # (num_samples,) int32 output tokens so far
+            min_toks,  # (num_samples,) min_tokens per row
+            stop_ids,  # (num_samples, SUPPRESS_IDS) eos/stop ids, -1 pad
             want_logprobs=False,  # static: also return chosen/top-N logprobs
+            want_min_tokens=False,  # static: suppression costs a full-logits
+            #   copy per dispatch, so it only compiles in when a row needs it
         ):
             hidden, kv_caches = llama.forward(
                 cfg, params, token_ids, positions, kv_caches,
@@ -321,6 +326,10 @@ class ModelRunner:
             flat = hidden.reshape(-1, hidden.shape[-1])
             picked = flat[sample_rows]  # (num_samples, h)
             logits = llama.compute_logits(cfg, params, picked)
+            if want_min_tokens:
+                logits = suppress_stop_tokens(
+                    logits, counts, min_toks, stop_ids
+                )
             tokens = sample(
                 logits, temperature, top_p, top_k, rng, seeds, has_seed, counts
             )
@@ -341,7 +350,7 @@ class ModelRunner:
         @functools.partial(
             jax.jit,
             donate_argnames=("kv_caches",),
-            static_argnames=("want_logprobs",),
+            static_argnames=("want_logprobs", "want_min_tokens"),
         )
         def sp_step_fn(
             params,
@@ -364,7 +373,10 @@ class ModelRunner:
             seeds,
             has_seed,
             counts,
+            min_toks,
+            stop_ids,
             want_logprobs=False,
+            want_min_tokens=False,
         ):
             del write_ids, start_off
             hist_lens = context_lens - chunk_lens
@@ -376,6 +388,10 @@ class ModelRunner:
             flat = hidden.reshape(-1, hidden.shape[-1])
             picked = flat[sample_rows]
             logits = llama.compute_logits(cfg, params, picked)
+            if want_min_tokens:
+                logits = suppress_stop_tokens(
+                    logits, counts, min_toks, stop_ids
+                )
             tokens = sample(
                 logits, temperature, top_p, top_k, rng, seeds, has_seed, counts
             )
@@ -404,7 +420,7 @@ class ModelRunner:
 
         @functools.partial(
             jax.jit,
-            static_argnames=("window", "want_logprobs"),
+            static_argnames=("window", "want_logprobs", "want_min_tokens"),
             donate_argnames=("kv_caches",),
         )
         def decode_window_fn(
@@ -422,8 +438,11 @@ class ModelRunner:
             seeds,  # (B,) uint32
             has_seed,  # (B,) bool
             counts0,  # (B,) output tokens generated before this window
+            min_toks,  # (B,) min_tokens per row
+            stop_ids,  # (B, SUPPRESS_IDS) eos/stop ids, -1 pad
             window: int,
             want_logprobs: bool = False,
+            want_min_tokens: bool = False,
         ):
             b = first_tokens.shape[0]
             out = jnp.zeros((b, window), jnp.int32)
@@ -459,6 +478,10 @@ class ModelRunner:
                     lora=lora_params, lora_idx=lora_idx, hists=hists,
                 )
                 logits = llama.compute_logits(cfg, params, hidden)
+                if want_min_tokens:
+                    logits = suppress_stop_tokens(
+                        logits, counts0 + k, min_toks, stop_ids
+                    )
                 toks = sample(
                     logits, temperature, top_p, top_k,
                     jax.random.fold_in(base_key, k),
@@ -661,12 +684,15 @@ class ModelRunner:
             work.sample[i] and req.sampling.logprobs is not None
             for i, req in enumerate(work.requests)
         )
+        min_toks, stop_ids_arr = self._stop_id_arrays(work.requests, b_pad)
+        want_mt = bool(min_toks.any())
         tokens, lp = self._run(
             token_ids, positions, block_tables,
             slots.reshape(-1) if slots is not None else np.zeros(1, np.int32),
             context_lens, chunk_lens, write_ids, start_off, lora_idx,
             sample_rows, temps, top_ps, top_ks, seeds=seeds, counts=counts,
-            want_logprobs=want_lp,
+            min_toks=min_toks, stop_ids_arr=stop_ids_arr,
+            want_logprobs=want_lp, want_min_tokens=want_mt,
         )
         if lp is None:
             self.last_logprobs = None
@@ -715,6 +741,8 @@ class ModelRunner:
         want_lp = any(
             r.sampling.logprobs is not None for r in work.requests
         )
+        min_toks, stop_ids_arr = self._stop_id_arrays(work.requests, b_pad)
+        want_mt = bool(min_toks.any())
         result = self._decode_window_fn(
             self.params,
             self.lora_params,
@@ -730,8 +758,11 @@ class ModelRunner:
             self._put(seed_vals, self._batch1),
             self._put(has_seed, self._batch1),
             self._put(np.asarray(counts, np.int32), self._batch1),
+            self._put(min_toks, self._batch1),
+            self._put(stop_ids_arr, self._batch2),
             window=work.window,
             want_logprobs=want_lp,
+            want_min_tokens=want_mt,
         )
         if want_lp:
             self.kv_caches, tokens, (lp_w, top_lp_w, top_id_w) = result
@@ -765,7 +796,8 @@ class ModelRunner:
     def _run(
         self, token_ids, positions, block_tables, slots, context_lens,
         chunk_lens, write_ids, start_off, lora_idx, sample_rows, temps,
-        top_ps, top_ks, seeds, counts, want_logprobs=False,
+        top_ps, top_ks, seeds, counts, min_toks, stop_ids_arr,
+        want_logprobs=False, want_min_tokens=False,
     ):
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
@@ -800,7 +832,10 @@ class ModelRunner:
             self._put(seed_vals, self._batch1),
             self._put(has_seed, self._batch1),
             self._put(np.asarray(counts, np.int32), self._batch1),
+            self._put(min_toks, self._batch1),
+            self._put(stop_ids_arr, self._batch2),
             want_logprobs=want_logprobs,
+            want_min_tokens=want_min_tokens,
         )
         if want_logprobs:
             self.kv_caches, tokens, lp = result
@@ -809,6 +844,36 @@ class ModelRunner:
             self.kv_caches, tokens = result
             lp = None
         return np.asarray(jax.device_get(tokens)), lp
+
+    def _stop_id_arrays(self, requests, pad_to: int):
+        """(min_toks (B,), stop_ids (B, SUPPRESS_IDS)) for device-side
+        min_tokens suppression (sampling.suppress_stop_tokens): eos first
+        (unless ignore_eos), then stop_token_ids; -1 pads. Batches with no
+        min_tokens rows (the steady state) reuse cached zero arrays — this
+        runs on every dispatch."""
+        if not any(r.sampling.min_tokens > 0 for r in requests):
+            cached = self._zero_stop_arrays.get(pad_to)
+            if cached is None:
+                cached = (
+                    np.zeros(pad_to, np.int32),
+                    np.full((pad_to, SUPPRESS_IDS), -1, np.int32),
+                )
+                self._zero_stop_arrays[pad_to] = cached
+            return cached
+        min_toks = np.zeros(pad_to, np.int32)
+        stop_ids = np.full((pad_to, SUPPRESS_IDS), -1, np.int32)
+        for i, req in enumerate(requests):
+            s = req.sampling
+            if s.min_tokens <= 0:
+                continue
+            min_toks[i] = s.min_tokens
+            ids = []
+            if not s.ignore_eos and req.eos_token_id is not None:
+                ids.append(req.eos_token_id)
+            ids.extend(s.stop_token_ids)
+            for j, tid in enumerate(ids[:SUPPRESS_IDS]):
+                stop_ids[i, j] = tid
+        return min_toks, stop_ids
 
     @staticmethod
     def _pow2(n: int) -> int:

@@ -51,6 +51,7 @@ class ChatCompletionRequest(OpenAIModel):
     seed: int | None = None
     user: str | None = None
     ignore_eos: bool = False  # extension (benchmark harnesses rely on it)
+    min_tokens: int = 0  # extension (vLLM-compatible)
     logprobs: bool = False
     top_logprobs: int | None = None
 
@@ -68,6 +69,7 @@ class ChatCompletionRequest(OpenAIModel):
             stop=tuple(stop),
             seed=self.seed,
             ignore_eos=self.ignore_eos,
+            min_tokens=self.min_tokens,
             logprobs=(
                 (self.top_logprobs or 0) if self.logprobs else None
             ),
@@ -89,6 +91,7 @@ class CompletionRequest(OpenAIModel):
     echo: bool = False
     user: str | None = None
     ignore_eos: bool = False
+    min_tokens: int = 0  # extension (vLLM-compatible)
     logprobs: int | None = None
 
     def sampling(self, default_max_tokens: int) -> SamplingParams:
@@ -103,6 +106,7 @@ class CompletionRequest(OpenAIModel):
             stop=tuple(stop),
             seed=self.seed,
             ignore_eos=self.ignore_eos,
+            min_tokens=self.min_tokens,
             logprobs=self.logprobs,
         )
 

@@ -22,6 +22,9 @@ class SamplingParams:
     stop: tuple[str, ...] = ()
     stop_token_ids: tuple[int, ...] = ()
     ignore_eos: bool = False
+    # vLLM extension: suppress eos/stop-token finishes until this many
+    # output tokens exist (stop STRINGS and length caps still apply)
+    min_tokens: int = 0
     seed: int | None = None
     # OpenAI logprobs: None = off; N = return the chosen token's logprob
     # plus the top-N alternatives per generated token (N <= runner

@@ -16,6 +16,46 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# stop ids suppressible on device per row: eos + up to 7 stop_token_ids
+# (OpenAI allows 4 stop sequences; longer internal lists keep correct
+# TERMINATION via the scheduler's host-side predicate, they just lose the
+# vLLM-style guarantee that the token never appears below min_tokens)
+SUPPRESS_IDS = 8
+
+
+# suppression value: low enough that exp() underflows to exactly 0 after
+# the softmax shift (real logits live within ~±100), but NOT -1e30 — the
+# top-k/top-p thresholds come from a binary search over [min(logits),
+# max(logits)] (sample() below), and a 1e30-wide range leaves the 30
+# halvings with ~1e21 resolution, silently disabling truncation for the
+# whole row
+SUPPRESS_NEG = -1e5
+
+
+def suppress_stop_tokens(
+    logits: jax.Array,  # (B, V) float32
+    counts: jax.Array,  # (B,) output tokens BEFORE this sample
+    min_toks: jax.Array,  # (B,) min_tokens per row
+    stop_ids: jax.Array,  # (B, SUPPRESS_IDS) int32, -1 = unused slot
+) -> jax.Array:
+    """vLLM min_tokens semantics: below min_tokens the eos/stop tokens are
+    masked out of the distribution entirely — never sampled, never fed back
+    as context, never reported in logprobs."""
+    v = logits.shape[-1]
+    # out-of-range ids are inert (they used to be harmless host-side
+    # comparisons; clipping one onto token V-1 would suppress a real token)
+    suppress = (
+        (counts < min_toks)[:, None] & (stop_ids >= 0) & (stop_ids < v)
+    )  # (B, K)
+    ids = jnp.clip(stop_ids, 0, v - 1)
+    cur = jnp.take_along_axis(logits, ids, axis=1)
+    new = jnp.where(suppress, SUPPRESS_NEG, cur)
+    b = logits.shape[0]
+    # scatter-min: padding slots clip onto real ids, so duplicate-index
+    # writes happen — min() is order-independent (set() is not) and
+    # unsuppressed slots write back their own value
+    return logits.at[jnp.arange(b)[:, None], ids].min(new)
+
 
 def _row_keys(
     base_key: jax.Array,

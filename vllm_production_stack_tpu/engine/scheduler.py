@@ -20,10 +20,15 @@ from .request import Request, RequestStatus
 from .spec_decode import propose_ngram
 
 
-def _is_stop_token(tok: int, sampling, eos: int | None) -> bool:
+def _is_stop_token(
+    tok: int, sampling, eos: int | None, n_outputs: int
+) -> bool:
     """THE stop-token predicate — shared by the bulk-accept cut scan and
     _maybe_finish so a new stop condition can't be added to one and silently
-    missed by the other."""
+    missed by the other. n_outputs counts output tokens INCLUDING `tok`
+    (min_tokens suppresses eos/stop finishes until satisfied)."""
+    if n_outputs < sampling.min_tokens:
+        return False
     return (eos is not None and tok == eos) or tok in sampling.stop_token_ids
 
 
@@ -202,9 +207,14 @@ class Scheduler:
         # window amortizes), as do sampled rows
         proposals: dict[str, list[int]] = {}
         for r in ready:
-            # logprobs requests stay on the decode-window path (the verify
-            # program returns argmax ids only)
-            if r.sampling.temperature == 0.0 and r.sampling.logprobs is None:
+            # logprobs and min_tokens requests stay on the decode-window
+            # path (the verify program returns raw argmax ids — no logprob
+            # collection, no min_tokens stop suppression)
+            if (
+                r.sampling.temperature == 0.0
+                and r.sampling.logprobs is None
+                and r.sampling.min_tokens <= 0
+            ):
                 p = propose_ngram(
                     r.all_token_ids, k, self.config.speculative_min_ngram
                 )
@@ -556,8 +566,9 @@ class Scheduler:
                 cut = n
                 eos = None if s.ignore_eos else req.eos_token_id
                 if eos is not None or s.stop_token_ids:
+                    n_out0 = len(req.output_token_ids)
                     for j in range(n):
-                        if _is_stop_token(row[j], s, eos):
+                        if _is_stop_token(row[j], s, eos, n_out0 + j + 1):
                             cut = j + 1
                             break
                 accepted = [int(t) for t in row[:cut]]
@@ -595,7 +606,7 @@ class Scheduler:
         s = req.sampling
         last = req.output_token_ids[-1]
         eos = None if s.ignore_eos else req.eos_token_id
-        if _is_stop_token(last, s, eos):
+        if _is_stop_token(last, s, eos, len(req.output_token_ids)):
             status = RequestStatus.FINISHED_STOPPED
         elif len(req.output_token_ids) >= s.max_tokens:
             status = RequestStatus.FINISHED_LENGTH

@@ -286,6 +286,14 @@ class EngineServer:
                 400,
                 f"logprobs/top_logprobs must be between 0 and {LOGPROBS_TOPN}",
             )
+        if sampling.min_tokens < 0:
+            return error(400, "min_tokens must be >= 0")
+        if sampling.min_tokens > sampling.max_tokens:
+            return error(
+                400,
+                f"min_tokens ({sampling.min_tokens}) cannot exceed "
+                f"max_tokens ({sampling.max_tokens})",
+            )
         return None
 
     def _tok_entry(self, tid: int) -> tuple[str, list[int]]:
