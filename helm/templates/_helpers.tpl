@@ -49,6 +49,10 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--num-host-blocks"
 - {{ .numHostBlocks | quote }}
 {{- end }}
+{{- if .hostKvGib }}
+- "--host-kv-gib"
+- {{ .hostKvGib | quote }}
+{{- end }}
 {{- if .maxLoras }}
 - "--max-loras"
 - {{ .maxLoras | quote }}

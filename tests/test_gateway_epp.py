@@ -15,6 +15,7 @@ if shutil.which("protoc") is None:  # the EPP compiles its proto at import
 from vllm_production_stack_tpu.gateway.epp import (
     ENDPOINT_HEADER,
     EppService,
+    endpoint_address,
     make_server,
     pb2,
 )
@@ -22,6 +23,9 @@ from vllm_production_stack_tpu.router.discovery import Endpoint
 from vllm_production_stack_tpu.router.routing import make_policy
 
 URLS = ["http://engine-a:8000", "http://engine-b:8000"]
+# the header carries an ip:port socket address (what Envoy original_dst
+# consumes), never a scheme-prefixed URL
+ADDRS = [endpoint_address(u) for u in URLS]
 
 
 def _endpoints():
@@ -77,6 +81,14 @@ async def _roundtrip(service, messages):
         await server.stop(None)
 
 
+def test_endpoint_address_forms():
+    assert endpoint_address("http://engine-a:8000") == "engine-a:8000"
+    assert endpoint_address("https://engine-a") == "engine-a:443"
+    assert endpoint_address("http://10.0.0.7") == "10.0.0.7:80"
+    assert endpoint_address("http://[fd00::1]:8000") == "[fd00::1]:8000"
+    assert endpoint_address("engine-a:8000") == "engine-a:8000"
+
+
 def test_epp_routes_body_phase_with_header_mutation():
     async def run():
         service = EppService(make_policy("roundrobin"), _endpoints)
@@ -91,7 +103,7 @@ def test_epp_routes_body_phase_with_header_mutation():
         assert resps[0].WhichOneof("response") == "request_headers"
         assert _picked(resps[0]) is None  # headers phase: CONTINUE only
         assert resps[1].WhichOneof("response") == "request_body"
-        assert _picked(resps[1]) in URLS
+        assert _picked(resps[1]) in ADDRS
     asyncio.run(run())
 
 
@@ -110,7 +122,7 @@ def test_epp_session_stickiness():
                 ],
             )
             picks.add(_picked(resps[1]))
-        assert len(picks) == 1 and picks.pop() in URLS
+        assert len(picks) == 1 and picks.pop() in ADDRS
     asyncio.run(run())
 
 
@@ -151,7 +163,7 @@ def test_epp_bodyless_request_routes_on_headers():
             service, [_headers_msg({":path": "/v1/models"}, end_of_stream=True)]
         )
         assert resps[0].WhichOneof("response") == "request_headers"
-        assert _picked(resps[0]) in URLS
+        assert _picked(resps[0]) in ADDRS
     asyncio.run(run())
 
 
@@ -181,5 +193,5 @@ def test_epp_streamed_body_buffers_until_end_of_stream():
             "request_trailers",
         ]
         assert _picked(resps[1]) is None  # partial chunk: CONTINUE only
-        assert _picked(resps[2]) in URLS  # pick on the full body
+        assert _picked(resps[2]) in ADDRS  # pick on the full body
     asyncio.run(run())

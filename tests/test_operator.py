@@ -196,6 +196,15 @@ def test_manager_reconciles_all_kinds():
         assert "--session-key" in \
             router_dep["spec"]["template"]["spec"]["containers"][0]["args"]
         assert await client.get(client.deployments("kvc-kv-controller"))
+        # the CacheServer CR also deploys the KV STORAGE server + Service
+        # (the LMCache-server equivalent — where KV bytes live off-engine)
+        store_dep = await client.get(client.deployments("kvc-kv-store"))
+        assert store_dep is not None
+        store_args = \
+            store_dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "vllm_production_stack_tpu.kvstore.server" in store_args
+        assert "--max-size-gib" in store_args
+        assert await client.get(client.services("kvc-kv-store"))
         router_cr = await client.get(client.crs("tpurouters", "router"))
         assert router_cr["status"]["activeRuntimes"] == ["llama3"]
 
@@ -318,6 +327,9 @@ def test_engine_args_parse_with_real_engine_argparse():
             "numSpeculativeTokens": 3, "decodeWindow": 16,
             "enablePrefixCaching": False, "extraArgs": ["--seed", "7"],
         },
+        "kvConfig": {
+            "hostKvGib": 8.5, "remoteKvUrl": "tpukv://kvc-kv-store:9200",
+        },
     }
     argv = engine_args(spec)
     assert argv[:2] == ["-m", "vllm_production_stack_tpu.engine.server"]
@@ -329,3 +341,126 @@ def test_engine_args_parse_with_real_engine_argparse():
     assert ns.decode_window == 16
     assert ns.enable_prefix_caching is False
     assert ns.seed == 7
+    assert ns.host_kv_gib == 8.5
+    assert ns.remote_kv_url == "tpukv://kvc-kv-store:9200"
+
+
+class FakeLoraEngine:
+    """Minimal engine data-plane for placement tests: /v1/models lists the
+    base model plus loaded adapters (parent set), load/unload mutate a set."""
+
+    def __init__(self, preloaded=()):
+        self.adapters = set(preloaded)
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+
+        async def models(request):
+            data = [{"id": "base", "parent": None}] + [
+                {"id": a, "parent": "base"} for a in sorted(self.adapters)
+            ]
+            return web.json_response({"data": data})
+
+        async def load(request):
+            self.adapters.add((await request.json())["lora_name"])
+            return web.json_response({"ok": True})
+
+        async def unload(request):
+            self.adapters.discard((await request.json())["lora_name"])
+            return web.json_response({"ok": True})
+
+        app.router.add_get("/v1/models", models)
+        app.router.add_post("/v1/load_lora_adapter", load)
+        app.router.add_post("/v1/unload_lora_adapter", unload)
+        return app
+
+
+def _placement_rig(preloaded_by_pod, algorithm, replicas, tmp_path):
+    """Run one LoraAdapter reconcile over fake engines with preset adapter
+    registrations; returns the per-engine adapter sets afterwards."""
+    adapter_dir = tmp_path / "adapter"
+    adapter_dir.mkdir(exist_ok=True)
+
+    async def go(fake, client):
+        engines = [FakeLoraEngine(pre) for pre in preloaded_by_pod]
+        srvs = []
+        try:
+            for eng in engines:
+                s = TestServer(eng.build_app())
+                await s.start_server()
+                srvs.append(s)
+            for i, s in enumerate(srvs):
+                await client.create(client.pods(), {
+                    "metadata": {"name": f"engine-{i}",
+                                 "labels": {"model": "base"}},
+                    "status": {
+                        "podIP": "127.0.0.1",
+                        "conditions": [{"type": "Ready", "status": "True"}],
+                    },
+                    "_port": s.port,
+                })
+            await client.create(client.crs("loraadapters"), {
+                "apiVersion": "production-stack.tpu.ai/v1alpha1",
+                "kind": "LoraAdapter",
+                "metadata": {"name": "new-lora", "uid": "u10"},
+                "spec": {
+                    "baseModel": "base",
+                    "adapterSource": {"type": "local",
+                                      "adapterPath": str(adapter_dir)},
+                    "placement": {"algorithm": algorithm,
+                                  "replicas": replicas},
+                },
+            })
+
+            class PortAwareReconciler(LoraAdapterReconciler):
+                def _engine_url(self, pod):
+                    return f"http://127.0.0.1:{pod['_port']}"
+
+            async with aiohttp.ClientSession() as http:
+                rec = PortAwareReconciler(client, http)
+                cr = await client.get(client.crs("loraadapters", "new-lora"))
+                await rec.reconcile(cr)
+            return [set(e.adapters) for e in engines]
+        finally:
+            for s in srvs:
+                await s.close()
+
+    return _with_fake_k8s(go)
+
+
+def test_lora_placement_ordered_packs_first_pods(tmp_path):
+    """ordered: name-sorted first-N regardless of load (the reference's
+    first-N placement, loraadapter_controller.go:394-441)."""
+    result = _placement_rig(
+        [{"busy-1", "busy-2"}, {"busy-3"}, set()],
+        algorithm="ordered", replicas=2, tmp_path=tmp_path,
+    )
+    assert "new-lora" in result[0]
+    assert "new-lora" in result[1]
+    assert "new-lora" not in result[2]
+
+
+def test_lora_placement_equalized_prefers_least_loaded(tmp_path):
+    """equalized: the N pods with the fewest other adapters get the new one
+    — engine-2 (0 adapters) and engine-1 (1) win over engine-0 (2)."""
+    result = _placement_rig(
+        [{"busy-1", "busy-2"}, {"busy-3"}, set()],
+        algorithm="equalized", replicas=2, tmp_path=tmp_path,
+    )
+    assert "new-lora" not in result[0]
+    assert "new-lora" in result[1]
+    assert "new-lora" in result[2]
+
+
+def test_lora_placement_equalized_unloads_from_overloaded(tmp_path):
+    """equalized with the adapter already on the busiest pod: reconcile moves
+    it — loads on the emptiest pods, unloads from the loaded-but-untargeted
+    one. The adapter itself is excluded from the load count so placement is
+    stable once equalized."""
+    result = _placement_rig(
+        [{"busy-1", "busy-2", "new-lora"}, set(), set()],
+        algorithm="equalized", replicas=2, tmp_path=tmp_path,
+    )
+    assert "new-lora" not in result[0]
+    assert "new-lora" in result[1]
+    assert "new-lora" in result[2]

@@ -55,6 +55,14 @@ class AsyncEngine:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        host_tier = getattr(self.engine, "host_tier", None)
+        remote = getattr(self.engine, "remote_tier", None)
+        if host_tier is not None:
+            # resolve pending device transfers (write-through to remote)
+            host_tier.flush()
+        if remote is not None:
+            remote.drain(timeout=5)
+            remote.close()
 
     @property
     def is_healthy(self) -> bool:
@@ -254,6 +262,22 @@ class AsyncEngine:
             )
             with self._lock:
                 return self.engine.kv_export(token_ids=ids, lora_name=lora_name)
+
+        return await asyncio.get_running_loop().run_in_executor(None, work)
+
+    async def kv_export_lazy(self, text=None, token_ids=None, lora_name=None):
+        """Lock held only for the chain walk + device fetch dispatch; the
+        per-block numpy resolution happens in the streaming handler."""
+        def work():
+            ids = (
+                token_ids
+                if token_ids is not None
+                else self.engine.tokenizer.encode(text or "")
+            )
+            with self._lock:
+                return self.engine.kv_export_lazy(
+                    token_ids=ids, lora_name=lora_name
+                )
 
         return await asyncio.get_running_loop().run_in_executor(None, work)
 

@@ -39,6 +39,8 @@ class EngineStatsSnapshot:
     host_kv_usage_perc: float = 0.0
     host_kv_offloads: int = 0
     host_kv_reloads: int = 0
+    remote_kv_stores: int = 0
+    remote_kv_fetched_blocks: int = 0
     spec_draft_tokens: int = 0
     spec_accepted_tokens: int = 0
 
@@ -80,14 +82,54 @@ class LLMEngine:
             config.model.tokenizer or config.model.checkpoint
         )
         self.runner = ModelRunner(config, params=params, mesh=mesh)
+        # identity of the weights this engine serves (needed BEFORE the KV
+        # tiers: the remote store namespaces blocks by it) — see the
+        # model_fingerprint comment below
+        import hashlib
+
+        self.model_fingerprint = hashlib.sha256(
+            repr(
+                (
+                    config.model,
+                    config.seed,
+                    config.cache.resolved_kv_dtype(config.model.dtype),
+                )
+            ).encode()
+        ).hexdigest()[:16]
         self.host_tier = None
-        if config.cache.num_host_blocks > 0:
+        self.remote_tier = None
+        num_host_blocks = config.cache.num_host_blocks
+        if config.cache.host_kv_gib > 0:
+            from .memory import kv_block_bytes
+
+            per_block = kv_block_bytes(
+                config.model,
+                config.cache.block_size,
+                config.parallel.tensor_parallel_size,
+                config.parallel.pipeline_parallel_size,
+                kv_dtype=config.cache.resolved_kv_dtype(config.model.dtype),
+            )
+            num_host_blocks = max(
+                num_host_blocks,
+                int(config.cache.host_kv_gib * 2**30) // per_block,
+            )
+        if config.cache.remote_kv_url:
+            from ..kvstore.client import RemoteKVTier
+
+            self.remote_tier = RemoteKVTier(
+                config.cache.remote_kv_url, self.model_fingerprint
+            )
+            # the remote tier stages through the host ring; give it a
+            # minimal ring even when CPU offload wasn't asked for
+            num_host_blocks = max(num_host_blocks, 16)
+        if num_host_blocks > 0:
             from .kv_host_tier import HostKVTier
 
             self.host_tier = HostKVTier(
-                config.cache.num_host_blocks,
+                num_host_blocks,
                 self.runner.fetch_block,
                 self.runner.upload_block,
+                remote=self.remote_tier,
             )
         self.scheduler = Scheduler(
             config.model, config.cache, config.scheduler,
@@ -102,24 +144,14 @@ class LLMEngine:
         self._req_counter = itertools.count()
         self._prompt_tokens = 0
         self._generation_tokens = 0
-        # identity of the weights this engine serves: same config + same
-        # checkpoint (or same random seed) => same KV bytes for same tokens.
-        # KV adoption (disaggregated prefill) refuses mismatched senders —
-        # same-shape-different-weights KV would silently corrupt attention
-        import hashlib
-
-        # the pool storage dtype is part of the identity: adopting e.g.
-        # fp8-quantized pages into an exact bf16 cache would silently mark
-        # lossy KV as byte-identical to locally computed KV
-        self.model_fingerprint = hashlib.sha256(
-            repr(
-                (
-                    config.model,
-                    config.seed,
-                    config.cache.resolved_kv_dtype(config.model.dtype),
-                )
-            ).encode()
-        ).hexdigest()[:16]
+        # model_fingerprint (computed above, before the KV tiers): same
+        # config + same checkpoint (or same random seed) => same KV bytes
+        # for same tokens. KV adoption (disaggregated prefill) refuses
+        # mismatched senders, and the remote KV store namespaces blocks by
+        # it — same-shape-different-weights KV would silently corrupt
+        # attention. The pool storage dtype is part of the identity:
+        # adopting e.g. fp8-quantized pages into an exact bf16 cache would
+        # silently mark lossy KV as byte-identical to locally computed KV.
 
     # -- request lifecycle -------------------------------------------------
 
@@ -368,7 +400,8 @@ class LLMEngine:
         # logprob variants still compile lazily (warming the full cross
         # product would double warmup time for a rarely-mixed dimension).
         for extra in ({"logprobs": 0}, {"min_tokens": 1}):
-            wave(1, min(sorted(sched.prefill_buckets)[0], longest_chunk), 1,
+            # largest reachable prefill bucket: the common production hit
+            wave(1, min(sorted(sched.prefill_buckets)[-1], longest_chunk), 1,
                  **extra)
             for b in sched.decode_buckets:
                 if b > sched.max_num_seqs:
@@ -395,6 +428,23 @@ class LLMEngine:
         return KVTransfer(self.scheduler.pool, self.runner).export_prompt(
             list(token_ids), parent=self._cache_root(lora_name)
         )
+
+    def kv_export_lazy(
+        self,
+        text: str | None = None,
+        token_ids: list[int] | None = None,
+        lora_name: str | None = None,
+    ):
+        """Streaming-sender variant of kv_export: dispatches the device→host
+        copies and returns (hashes, per-block device slices) — resolution to
+        numpy happens off the engine lock, per block, as frames go out."""
+        from .kv_transfer import KVTransfer
+
+        if token_ids is None:
+            token_ids = self.tokenizer.encode(text or "")
+        return KVTransfer(
+            self.scheduler.pool, self.runner
+        ).export_prompt_lazy(list(token_ids), parent=self._cache_root(lora_name))
 
     def kv_import(self, hashes, blocks, fingerprint: str = "") -> int:
         """Disaggregated prefill: adopt shipped KV blocks into this
@@ -465,7 +515,19 @@ class LLMEngine:
 
             if state is not None and req.sampling.stop:
                 state.pending_text += new_text
-                hit = self._find_stop(state.pending_text, req.sampling.stop)
+                # vLLM's stop checker skips ALL stop conditions below the
+                # min_tokens floor — stop STRINGS included, not just the
+                # token-id conditions the scheduler masks. Text still goes
+                # through pending_text so a straddling match fires once the
+                # floor is crossed.
+                below_min = (
+                    len(req.output_token_ids) < req.sampling.min_tokens
+                )
+                hit = (
+                    None
+                    if below_min
+                    else self._find_stop(state.pending_text, req.sampling.stop)
+                )
                 if hit is not None:
                     emit = state.pending_text[:hit]
                     state.text += emit
@@ -620,6 +682,13 @@ class LLMEngine:
             ),
             host_kv_reloads=(
                 self.host_tier.stats.reloads if self.host_tier else 0
+            ),
+            remote_kv_stores=(
+                self.remote_tier.stats.stores if self.remote_tier else 0
+            ),
+            remote_kv_fetched_blocks=(
+                self.remote_tier.stats.fetched_blocks
+                if self.remote_tier else 0
             ),
         )
 

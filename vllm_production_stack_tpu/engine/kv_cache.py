@@ -164,15 +164,45 @@ class KVBlockPool:
         matched: list[int] = []
         if not self.enable_prefix_caching:
             return matched
-        for h in self._chain(token_ids, _ROOT_HASH if parent is None else parent):
+        hashes = list(
+            self._chain(token_ids, _ROOT_HASH if parent is None else parent)
+        )
+        for idx, h in enumerate(hashes):
             self.stats.queries += 1
             blk = self._hash_to_block.get(h)
             if blk is None:
                 blk = self._reload_from_host(h)
                 if blk is None:
+                    # both local tiers miss: continue the chain into the
+                    # remote store (one batched mget for the remainder)
+                    matched.extend(self._match_remote(hashes[idx:]))
                     break
             else:
                 self._acquire(blk)
+            self.stats.hits += 1
+            matched.append(blk)
+        return matched
+
+    def _match_remote(self, hashes: list[int]) -> list[int]:
+        """Fetch the consecutive remote-held prefix of `hashes` into freshly
+        allocated HBM blocks (cross-engine KV reuse — the LMCache-server
+        capability). Fetched blocks are promoted into the host ring so the
+        next match stays local. queries for hashes[0] was already counted by
+        the caller; the rest count here."""
+        remote = getattr(self.host_tier, "remote", None)
+        if remote is None:
+            return []
+        matched: list[int] = []
+        for i, (h, data) in enumerate(zip(hashes, remote.fetch_run(hashes))):
+            if i > 0:
+                self.stats.queries += 1
+            blk = self.allocate()  # may evict (offload+write-through) others
+            if blk is None:
+                break
+            self.host_tier.upload(blk, data)
+            self._hash_to_block[h] = blk
+            self._block_to_hash[blk] = h
+            self.host_tier.insert_resolved(h, data)
             self.stats.hits += 1
             matched.append(blk)
         return matched
@@ -202,10 +232,18 @@ class KVBlockPool:
         if not self.enable_prefix_caching:
             return 0
         n = 0
-        for h in self._chain(token_ids, _ROOT_HASH if parent is None else parent):
+        hashes = list(
+            self._chain(token_ids, _ROOT_HASH if parent is None else parent)
+        )
+        for idx, h in enumerate(hashes):
             if h not in self._hash_to_block and (
                 self.host_tier is None or h not in self.host_tier
             ):
+                remote = getattr(self.host_tier, "remote", None)
+                if remote is not None:
+                    # continue the probe into the remote store: one batched
+                    # contains round trip, no data movement
+                    n += self.block_size * remote.contains_run(hashes[idx:])
                 break
             n += self.block_size
         return n

@@ -34,7 +34,7 @@ class HostKVTier:
     (device transfers); the pool calls `store` from its eviction hook and
     `reload_into` from prefix matching."""
 
-    def __init__(self, num_blocks: int, fetch_block, upload_block):
+    def __init__(self, num_blocks: int, fetch_block, upload_block, remote=None):
         self.num_blocks = num_blocks
         # fetch returns per-layer device slices with host copies STARTED
         # (ModelRunner.fetch_block); entries resolve to numpy one store
@@ -44,6 +44,10 @@ class HostKVTier:
         self._upload = upload_block  # (device_block_id, np.ndarray) -> None
         self._data: OrderedDict[int, object] = OrderedDict()
         self._pending: list[int] = []  # hashes whose entry is still on device
+        # optional kvstore.client.RemoteKVTier: resolved blocks write
+        # through (its writer thread dedupes), so the remote store holds a
+        # superset of the ring and cross-engine prefills can warm from it
+        self.remote = remote
         self.stats = HostTierStats()
 
     def _resolve(self, h: int) -> np.ndarray | None:
@@ -53,11 +57,19 @@ class HostKVTier:
         if not isinstance(entry, np.ndarray):
             entry = np.stack([np.asarray(p) for p in entry])
             self._data[h] = entry
+            if self.remote is not None:
+                self.remote.put_async(h, entry)
         return entry
 
     def _drain_pending(self, keep_latest: int = 1) -> None:
         while len(self._pending) > keep_latest:
             self._resolve(self._pending.pop(0))
+
+    def flush(self) -> None:
+        """Resolve every pending device transfer (and write each through to
+        the remote tier when configured) — used before engine shutdown/sleep
+        and by tests that need the remote store to be current."""
+        self._drain_pending(keep_latest=0)
 
     def __contains__(self, h: int) -> bool:
         return h in self._data
@@ -83,10 +95,21 @@ class HostKVTier:
         self._pending.append(h)
         self._drain_pending(keep_latest=1)
         self.stats.offloads += 1
+        self._evict_to_budget()
+
+    def _evict_to_budget(self) -> None:
         while len(self._data) > self.num_blocks:
-            evicted, _ = self._data.popitem(last=False)
+            evicted, entry = self._data.popitem(last=False)
             if evicted in self._pending:
                 self._pending.remove(evicted)
+            if self.remote is not None and not isinstance(entry, np.ndarray):
+                # an entry evicted before it was ever resolved hasn't been
+                # written through yet — materialize and push, or the remote
+                # tier silently misses exactly the blocks that fell off
+                # (resolved entries were already pushed by _resolve)
+                self.remote.put_async(
+                    evicted, np.stack([np.asarray(p) for p in entry])
+                )
             self.stats.evictions += 1
 
     def reload_into(self, h: int, device_block: int) -> bool:
@@ -102,3 +125,19 @@ class HostKVTier:
         self._upload(device_block, data)
         self.stats.reloads += 1
         return True
+
+    # -- remote-tier cooperation (kvstore.client.RemoteKVTier) -------------
+
+    def upload(self, device_block: int, data: np.ndarray) -> None:
+        """Host→HBM upload for blocks sourced OUTSIDE the ring (remote
+        fetches) — same runner callback the reload path uses."""
+        self._upload(device_block, data)
+
+    def insert_resolved(self, h: int, data: np.ndarray) -> None:
+        """Promote a remote-fetched block into the ring so the next match is
+        local. Budget enforced; no write-through needed (the remote tier's
+        dedupe set already knows h)."""
+        if self.num_blocks == 0 or h in self._data:
+            return
+        self._data[h] = data
+        self._evict_to_budget()

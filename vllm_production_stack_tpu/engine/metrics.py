@@ -54,6 +54,12 @@ class EngineMetrics:
         self.host_reloads = counter(
             mc.HOST_KV_RELOADS, "KV blocks reloaded host RAM to HBM"
         )
+        self.remote_stores = counter(
+            mc.REMOTE_KV_STORES, "KV blocks pushed to the remote store"
+        )
+        self.remote_fetches = counter(
+            mc.REMOTE_KV_FETCHES, "KV blocks fetched from the remote store"
+        )
         self.spec_draft = counter(
             mc.SPEC_DRAFT_TOKENS, "Speculative tokens proposed (ngram)"
         )
@@ -76,6 +82,10 @@ class EngineMetrics:
         self.host_kv_usage.labels(**lb).set(s.host_kv_usage_perc)
         self._bump(self.host_offloads, "host_off", s.host_kv_offloads)
         self._bump(self.host_reloads, "host_re", s.host_kv_reloads)
+        self._bump(self.remote_stores, "remote_store", s.remote_kv_stores)
+        self._bump(
+            self.remote_fetches, "remote_fetch", s.remote_kv_fetched_blocks
+        )
         self._bump(self.spec_draft, "spec_draft", s.spec_draft_tokens)
         self._bump(self.spec_accepted, "spec_acc", s.spec_accepted_tokens)
         self._bump(self.prompt_tokens, "prompt", s.prompt_tokens)

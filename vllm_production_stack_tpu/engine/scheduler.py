@@ -516,12 +516,17 @@ class Scheduler:
         that didn't finish the prompt. Decode candidates past a stop condition
         are discarded."""
         results: list[tuple[Request, list[int]]] = []
+        proposal_lens: list[int] | None = None
         if isinstance(work, VerifyWork):
             # acceptance: the model's argmax m[j] at fed position j is valid
             # output iff every earlier proposal matched; the first mismatch
             # position still yields m[j] itself (the "bonus" token) — so a
             # row emits 1..k+1 tokens, and a proposal-less row emits exactly
-            # its plain greedy token
+            # its plain greedy token. Acceptance COUNTERS are bumped in the
+            # decode loop below, after the max_tokens/stop cut, so the
+            # acceptance-rate metric never counts tokens that were clipped
+            # before emission.
+            proposal_lens = [len(p) for p in work.proposals]
             accepted_rows: list[list[int]] = []
             for i, req in enumerate(work.requests):
                 m = sampled[i]
@@ -531,8 +536,6 @@ class Scheduler:
                     accepted.append(int(m[j]))
                     if j < len(p) and int(m[j]) != p[j]:
                         break
-                self.spec_proposed_tokens += len(p)
-                self.spec_accepted_tokens += len(accepted) - 1
                 accepted_rows.append(accepted)
             work = DecodeWork(requests=work.requests)  # shared accounting
             sampled = accepted_rows
@@ -549,7 +552,7 @@ class Scheduler:
                 else:
                     results.append((req, []))
         else:
-            for req, row in zip(work.requests, sampled):
+            for i, (req, row) in enumerate(zip(work.requests, sampled)):
                 # bulk accept: a decode window hands up to `window` candidate
                 # tokens per row — the previous token-at-a-time loop
                 # (computed += 1, register, append, finish-check per token)
@@ -572,6 +575,11 @@ class Scheduler:
                             cut = j + 1
                             break
                 accepted = [int(t) for t in row[:cut]]
+                if proposal_lens is not None:
+                    # every emitted token past the first rode a matched
+                    # proposal; the first is the plain greedy/bonus token
+                    self.spec_proposed_tokens += proposal_lens[i]
+                    self.spec_accepted_tokens += max(0, len(accepted) - 1)
                 if accepted:
                     # outputs FIRST: _register_full_blocks hashes block
                     # contents via token_at over positions that include the

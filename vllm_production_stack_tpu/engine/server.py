@@ -708,6 +708,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="HBM KV pages in the pool")
     p.add_argument("--num-host-blocks", type=int, default=0,
                    help="host-RAM KV offload tier size in blocks (0 = off)")
+    p.add_argument("--host-kv-gib", type=float, default=0.0,
+                   help="host-RAM KV offload tier byte budget in GiB — the "
+                        "operator-facing unit (LMCACHE_MAX_LOCAL_CPU_SIZE "
+                        "equivalent); overrides --num-host-blocks when "
+                        "larger")
+    p.add_argument("--remote-kv-url", default="",
+                   help="remote KV store URL (tpukv://host:port, "
+                        "kvstore/server.py) — the LMCACHE_REMOTE_URL lm:// "
+                        "equivalent; enables cross-engine KV sharing")
     p.add_argument("--max-num-seqs", type=int, default=64)
     p.add_argument("--max-num-batched-tokens", type=int, default=512)
     p.add_argument("--decode-window", type=int, default=8,
@@ -771,6 +780,8 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             kv_cache_dtype=args.kv_cache_dtype,
             num_blocks=args.num_blocks,
             num_host_blocks=args.num_host_blocks,
+            host_kv_gib=args.host_kv_gib,
+            remote_kv_url=args.remote_kv_url,
             enable_prefix_caching=args.enable_prefix_caching,
         ),
         scheduler=SchedulerConfig(

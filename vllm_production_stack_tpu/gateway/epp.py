@@ -42,6 +42,24 @@ _PROTO = os.path.join(_REPO_ROOT, "gateway", "protos", "ext_proc_min.proto")
 ENDPOINT_HEADER = "x-gateway-destination-endpoint"
 
 
+def endpoint_address(url: str) -> str:
+    """`host:port` socket address for the destination header.
+
+    Gateway-API inference-extension data planes (Envoy original_dst /
+    kgateway, as consumed by the reference's Go pickers via the upstream EPP
+    framework) treat `x-gateway-destination-endpoint` as an ip:port address,
+    not a URL — a scheme-prefixed value would not route. The URL form stays
+    internal (policies, discovery); only the header gets the address."""
+    from urllib.parse import urlsplit
+
+    parts = urlsplit(url if "//" in url else f"//{url}")
+    host = parts.hostname or ""
+    if ":" in host:  # IPv6 literal: keep the bracket form Envoy expects
+        host = f"[{host}]"
+    port = parts.port or (443 if parts.scheme == "https" else 80)
+    return f"{host}:{port}"
+
+
 def _load_pb2():
     """protoc-compile the minimal ext-proc proto into the private cache and
     import the generated module (cache key = source content hash)."""
@@ -135,7 +153,8 @@ class EppService:
             set_headers=[
                 pb2.HeaderValueOption(
                     header=pb2.HeaderValue(
-                        key=ENDPOINT_HEADER, raw_value=url.encode()
+                        key=ENDPOINT_HEADER,
+                        raw_value=endpoint_address(url).encode(),
                     )
                 )
             ]

@@ -1,0 +1,9 @@
+"""Remote KV cache store — the LMCache-server equivalent.
+
+A standalone process that stores full KV blocks by content hash so engines
+can share computed KV across pods (reference: `lmcache_experimental_server`
+deployed by helm/templates/deployment-cache-server.yaml:1-74 and wired into
+engines as `LMCACHE_REMOTE_URL lm://host:port`,
+vllmruntime_controller.go:337-374). Server: `kvstore.server`; engine-side
+client/tier: `kvstore.client`.
+"""

@@ -1,0 +1,302 @@
+"""Engine-side remote KV tier: sync fetch path + write-behind store thread.
+
+Sits behind the host ring (`engine/kv_host_tier.py`): blocks resolved or
+evicted by the ring are pushed here asynchronously (a daemon writer thread —
+the scheduler loop never blocks on a store), and prefix matches that run off
+the end of the local tiers issue ONE batched `mget` for the remaining chain
+(reference: LMCache remote backend behind `LMCACHE_REMOTE_URL`,
+vllmruntime_controller.go:349-374).
+
+Fetches are synchronous HTTP on the engine thread — a deliberate trade: one
+round trip (<~ms in-cluster) buys back an entire prefill chunk's compute. A
+failure trips a cooldown so a dead server costs one timeout per
+`cooldown_s`, not one per prompt.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from urllib.parse import urlsplit
+
+import numpy as np
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # float8_e4m3fn etc. (jax dependency, always present)
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def parse_store_url(url: str) -> tuple[str, int]:
+    """Accepts `tpukv://host:port` (the stack's lm://-style scheme) or
+    `http://host:port`."""
+    parts = urlsplit(url if "//" in url else f"//{url}")
+    if not parts.hostname:
+        raise ValueError(f"invalid KV store URL {url!r}")
+    return parts.hostname, parts.port or 9200
+
+
+class _Conn:
+    """One keep-alive HTTP connection; reconnects once on a stale socket."""
+
+    def __init__(self, host: str, port: int, timeout: float):
+        self.host, self.port, self.timeout = host, port, timeout
+        self._c: http.client.HTTPConnection | None = None
+
+    def request(
+        self, method: str, path: str, body: bytes | None = None,
+        headers: dict | None = None,
+    ) -> tuple[int, dict, bytes]:
+        for attempt in (0, 1):
+            if self._c is None:
+                self._c = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout
+                )
+            try:
+                self._c.request(method, path, body=body, headers=headers or {})
+                resp = self._c.getresponse()
+                payload = resp.read()
+                return resp.status, dict(resp.getheaders()), payload
+            except (http.client.HTTPException, OSError):
+                self.close()
+                if attempt:
+                    raise
+        raise OSError("unreachable")  # pragma: no cover
+
+    def close(self) -> None:
+        if self._c is not None:
+            try:
+                self._c.close()
+            finally:
+                self._c = None
+
+
+@dataclass
+class RemoteTierStats:
+    stores: int = 0  # blocks pushed (writer thread, after dedupe)
+    dropped: int = 0  # pushes dropped on queue overflow / server error
+    fetches: int = 0  # mget round trips
+    fetched_blocks: int = 0  # blocks served remote -> engine
+    probe_hits: int = 0  # contains_run block hits (lookup probes)
+    errors: int = 0
+
+
+class RemoteKVTier:
+    """Client half of the remote tier. All hashes travel as decimal strings
+    (they're 128-bit; string form sidesteps any JSON integer-width trap)."""
+
+    def __init__(
+        self,
+        url: str,
+        fingerprint: str,
+        timeout: float = 2.0,
+        max_pending: int = 512,
+        dedupe_capacity: int = 65536,
+        cooldown_s: float = 5.0,
+    ):
+        self.host, self.port = parse_store_url(url)
+        self.fingerprint = fingerprint
+        self.cooldown_s = cooldown_s
+        self.stats = RemoteTierStats()
+        self._fetch_conn = _Conn(self.host, self.port, timeout)
+        self._store_conn = _Conn(self.host, self.port, timeout)
+        self._down_until = 0.0
+        # hashes known stored (by US — other engines' pushes are invisible,
+        # which only costs a redundant put); shared engine/writer thread
+        self._stored: OrderedDict[int, None] = OrderedDict()
+        self._inflight: set[int] = set()  # enqueued, not yet written
+        self._stored_lock = threading.Lock()
+        self._dedupe_capacity = dedupe_capacity
+        self._q: queue.Queue = queue.Queue(maxsize=max_pending)
+        self._enqueued = 0  # accepted into the queue (drain() accounting)
+        self._writer = threading.Thread(
+            target=self._writer_loop, daemon=True, name="kv-remote-writer"
+        )
+        self._writer.start()
+
+    # -- availability ------------------------------------------------------
+
+    def _available(self) -> bool:
+        return time.monotonic() >= self._down_until
+
+    def _trip(self, err: Exception) -> None:
+        self.stats.errors += 1
+        self._down_until = time.monotonic() + self.cooldown_s
+        logger.warning(
+            "KV store %s:%d unreachable (%s); cooling down %.0fs",
+            self.host, self.port, err, self.cooldown_s,
+        )
+
+    # -- store path (writer thread) ----------------------------------------
+
+    def put_async(self, h: int, arr: np.ndarray) -> None:
+        """Enqueue one block for the writer thread. Never blocks: a full
+        queue drops the block (it is a CACHE — losing a push only costs a
+        possible future recompute)."""
+        with self._stored_lock:
+            if h in self._stored:
+                self._stored.move_to_end(h)
+                return
+            if h in self._inflight:  # resolve-push + evict-push race
+                return
+            self._inflight.add(h)
+        try:
+            self._q.put_nowait((h, arr))
+            self._enqueued += 1
+        except queue.Full:
+            with self._stored_lock:
+                self._inflight.discard(h)
+            self.stats.dropped += 1
+
+    def _writer_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            h, arr = item
+            if not self._available():
+                with self._stored_lock:
+                    self._inflight.discard(h)
+                self.stats.dropped += 1
+                continue
+            try:
+                status, _, _ = self._store_conn.request(
+                    "PUT",
+                    f"/v1/blocks/{h}",
+                    body=np.ascontiguousarray(arr).tobytes(),
+                    headers={
+                        "X-KV-Fingerprint": self.fingerprint,
+                        "X-KV-Shape": ",".join(str(d) for d in arr.shape),
+                        "X-KV-Dtype": arr.dtype.name,
+                        "Content-Type": "application/octet-stream",
+                    },
+                )
+            except OSError as e:
+                self._trip(e)
+                with self._stored_lock:
+                    self._inflight.discard(h)
+                self.stats.dropped += 1
+                continue
+            if status == 200:
+                self.stats.stores += 1
+                with self._stored_lock:
+                    self._inflight.discard(h)
+                    self._stored[h] = None
+                    while len(self._stored) > self._dedupe_capacity:
+                        self._stored.popitem(last=False)
+            else:
+                with self._stored_lock:
+                    self._inflight.discard(h)
+                self.stats.dropped += 1
+
+    # -- fetch path (engine thread) ----------------------------------------
+
+    def contains_run(self, hashes: list[int]) -> int:
+        """How many of `hashes` (in order, consecutively) the store holds —
+        the /kv/lookup probe continuation. One round trip."""
+        if not hashes or not self._available():
+            return 0
+        try:
+            status, _, payload = self._fetch_conn.request(
+                "POST",
+                "/v1/contains",
+                body=json.dumps({
+                    "fingerprint": self.fingerprint,
+                    "hashes": [str(h) for h in hashes],
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+        except OSError as e:
+            self._trip(e)
+            return 0
+        if status != 200:
+            return 0
+        present = json.loads(payload).get("present", [])
+        n = 0
+        for ok in present:
+            if not ok:
+                break
+            n += 1
+        self.stats.probe_hits += n
+        return n
+
+    def fetch_run(self, hashes: list[int]) -> list[np.ndarray]:
+        """The consecutive present prefix of `hashes` as arrays, one batched
+        mget round trip."""
+        if not hashes or not self._available():
+            return []
+        try:
+            status, headers, payload = self._fetch_conn.request(
+                "POST",
+                "/v1/mget",
+                body=json.dumps({
+                    "fingerprint": self.fingerprint,
+                    "hashes": [str(h) for h in hashes],
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+        except OSError as e:
+            self._trip(e)
+            return []
+        if status != 200:
+            return []
+        self.stats.fetches += 1
+        out: list[np.ndarray] = []
+        off = 0
+        expect = [str(h) for h in hashes]
+        while off < len(payload) and len(out) < len(expect):
+            head_len = int.from_bytes(payload[off : off + 4], "little")
+            off += 4
+            head = json.loads(payload[off : off + head_len])
+            off += head_len
+            nbytes = head["nbytes"]
+            if head["hash"] != expect[len(out)]:
+                break  # server returned a non-consecutive frame; stop clean
+            # copy: a frombuffer view would pin the ENTIRE multi-block
+            # response buffer for as long as any one block stays referenced
+            # (the host ring retains these)
+            arr = np.frombuffer(
+                payload[off : off + nbytes], dtype=_np_dtype(head["dtype"])
+            ).reshape([int(d) for d in head["shape"].split(",")]).copy()
+            off += nbytes
+            out.append(arr)
+            # it exists remotely — teach the dedupe set so eviction of the
+            # promoted copy doesn't push it straight back
+            with self._stored_lock:
+                self._stored[int(head["hash"])] = None
+                while len(self._stored) > self._dedupe_capacity:
+                    self._stored.popitem(last=False)
+        self.stats.fetched_blocks += len(out)
+        return out
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Block until every enqueued store has been attempted (tests /
+        graceful shutdown). Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.stats.stores + self.stats.dropped >= self._enqueued:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def close(self) -> None:
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+        self._writer.join(timeout=5)
+        self._fetch_conn.close()
+        self._store_conn.close()

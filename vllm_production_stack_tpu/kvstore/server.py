@@ -1,0 +1,270 @@
+"""KV storage server: content-hash → KV-block bytes with a byte-budget LRU.
+
+The remote tier of the KV hierarchy (HBM → host ring → THIS). Engines push
+blocks that fall off their host ring (write-behind) and fetch runs of blocks
+their local tiers miss, so a prompt prefilled on engine A warms engine B's
+prefill — the cross-engine KV sharing the reference gets from the LMCache
+server (deployment-cache-server.yaml:1-74, `lm://` wiring in
+_helpers.tpl:195-197).
+
+Wire protocol (plain HTTP, framing documented per handler):
+  GET  /health               liveness + occupancy
+  GET  /metrics              Prometheus text (tpukv_* series)
+  PUT  /v1/blocks/{hash}     raw block bytes; X-KV-Shape/X-KV-Dtype/
+                             X-KV-Fingerprint headers
+  GET  /v1/blocks/{hash}     raw block bytes back (404 when absent)
+  POST /v1/contains          {"fingerprint", "hashes": [str]} ->
+                             {"present": [bool]}
+  POST /v1/mget              {"fingerprint", "hashes": [str]} -> binary
+                             frames of the CONSECUTIVE present prefix
+
+Blocks are namespaced by the engine's model fingerprint (weights identity +
+KV dtype): two models' identical token streams must never share KV bytes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+from collections import OrderedDict
+from dataclasses import dataclass
+
+from aiohttp import web
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class StoreStats:
+    puts: int = 0
+    hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+
+
+class BlockStore:
+    """Byte-budget LRU of KV blocks keyed by (fingerprint, hash)."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity_bytes = capacity_bytes
+        self._data: OrderedDict[tuple[str, str], tuple[bytes, dict]] = (
+            OrderedDict()
+        )
+        self.total_bytes = 0
+        self.stats = StoreStats()
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def put(self, fp: str, h: str, payload: bytes, meta: dict) -> None:
+        key = (fp, h)
+        old = self._data.pop(key, None)
+        if old is not None:
+            self.total_bytes -= len(old[0])
+        self._data[key] = (payload, meta)
+        self.total_bytes += len(payload)
+        self.stats.puts += 1
+        while self.total_bytes > self.capacity_bytes and len(self._data) > 1:
+            (_, _), (evicted, _m) = self._data.popitem(last=False)
+            self.total_bytes -= len(evicted)
+            self.stats.evictions += 1
+
+    def get(self, fp: str, h: str) -> tuple[bytes, dict] | None:
+        entry = self._data.get((fp, h))
+        if entry is None:
+            self.stats.misses += 1
+            return None
+        self._data.move_to_end((fp, h))
+        self.stats.hits += 1
+        return entry
+
+    def contains(self, fp: str, h: str) -> bool:
+        return (fp, h) in self._data
+
+
+def frame_block(h: str, payload: bytes, meta: dict) -> bytes:
+    """One mget frame: 4-byte LE header length, JSON header, raw bytes."""
+    head = json.dumps({"hash": h, **meta, "nbytes": len(payload)}).encode()
+    return len(head).to_bytes(4, "little") + head + payload
+
+
+class KVStoreServer:
+    def __init__(self, capacity_bytes: int):
+        self.store = BlockStore(capacity_bytes)
+
+    async def h_put(self, request: web.Request) -> web.Response:
+        h = request.match_info["hash"]
+        fp = request.headers.get("X-KV-Fingerprint", "")
+        meta = {
+            "shape": request.headers.get("X-KV-Shape", ""),
+            "dtype": request.headers.get("X-KV-Dtype", ""),
+        }
+        payload = await request.read()
+        if not payload:
+            return web.json_response(
+                {"error": "empty block payload"}, status=400
+            )
+        self.store.put(fp, h, payload, meta)
+        return web.json_response({"stored": True, "nbytes": len(payload)})
+
+    async def h_get(self, request: web.Request) -> web.Response:
+        h = request.match_info["hash"]
+        fp = request.query.get("fingerprint", "")
+        entry = self.store.get(fp, h)
+        if entry is None:
+            return web.json_response({"error": "not found"}, status=404)
+        payload, meta = entry
+        return web.Response(
+            body=payload,
+            headers={
+                "X-KV-Shape": meta["shape"],
+                "X-KV-Dtype": meta["dtype"],
+            },
+            content_type="application/octet-stream",
+        )
+
+    async def h_contains(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        fp = body.get("fingerprint", "")
+        present = [
+            self.store.contains(fp, str(h)) for h in body.get("hashes", [])
+        ]
+        return web.json_response({"present": present})
+
+    async def h_mget(self, request: web.Request) -> web.Response:
+        """Binary frames for the CONSECUTIVE present prefix of the requested
+        hashes — prefix KV is only reusable as an unbroken chain, so the
+        server stops at the first gap instead of shipping unusable blocks."""
+        body = await request.json()
+        fp = body.get("fingerprint", "")
+        frames: list[bytes] = []
+        for h in body.get("hashes", []):
+            entry = self.store.get(fp, str(h))
+            if entry is None:
+                break
+            payload, meta = entry
+            frames.append(frame_block(str(h), payload, meta))
+        return web.Response(
+            body=b"".join(frames),
+            headers={"X-KV-Count": str(len(frames))},
+            content_type="application/octet-stream",
+        )
+
+    async def h_health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "status": "ok",
+                "blocks": len(self.store),
+                "bytes": self.store.total_bytes,
+                "capacity_bytes": self.store.capacity_bytes,
+            }
+        )
+
+    async def h_metrics(self, request: web.Request) -> web.Response:
+        s = self.store.stats
+        lines = [
+            "# TYPE tpukv_blocks gauge",
+            f"tpukv_blocks {len(self.store)}",
+            "# TYPE tpukv_bytes gauge",
+            f"tpukv_bytes {self.store.total_bytes}",
+            "# TYPE tpukv_capacity_bytes gauge",
+            f"tpukv_capacity_bytes {self.store.capacity_bytes}",
+            "# TYPE tpukv_puts_total counter",
+            f"tpukv_puts_total {s.puts}",
+            "# TYPE tpukv_hits_total counter",
+            f"tpukv_hits_total {s.hits}",
+            "# TYPE tpukv_misses_total counter",
+            f"tpukv_misses_total {s.misses}",
+            "# TYPE tpukv_evictions_total counter",
+            f"tpukv_evictions_total {s.evictions}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n")
+
+    def build_app(self) -> web.Application:
+        # blocks are a few MiB each; cap single uploads well above that
+        app = web.Application(client_max_size=256 * 2**20)
+        app.router.add_put("/v1/blocks/{hash}", self.h_put)
+        app.router.add_get("/v1/blocks/{hash}", self.h_get)
+        app.router.add_post("/v1/contains", self.h_contains)
+        app.router.add_post("/v1/mget", self.h_mget)
+        app.router.add_get("/health", self.h_health)
+        app.router.add_get("/metrics", self.h_metrics)
+        return app
+
+
+def run_in_thread(capacity_bytes: int = 1 << 30, port: int = 0):
+    """Start a KV store server on its own thread + event loop (tests and
+    the engine-embedded mode). Returns (base_url, stop_fn, server)."""
+    import socket
+    import threading
+
+    if port == 0:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+    server = KVStoreServer(capacity_bytes)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    runner_box: dict = {}
+
+    def worker():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(server.build_app())
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            runner_box["runner"] = runner
+
+        loop.run_until_complete(start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=worker, daemon=True, name="kvstore")
+    t.start()
+    started.wait(timeout=10)
+
+    def stop():
+        async def cleanup():
+            await runner_box["runner"].cleanup()
+
+        fut = asyncio.run_coroutine_threadsafe(cleanup(), loop)
+        fut.result(timeout=10)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=10)
+
+    return f"http://127.0.0.1:{port}", stop, server
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU stack KV storage server")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9200)
+    p.add_argument(
+        "--max-size-gib",
+        type=float,
+        default=4.0,
+        help="byte budget for stored KV blocks (LRU beyond this)",
+    )
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    server = KVStoreServer(int(args.max_size_gib * 2**30))
+    logger.info(
+        "KV store listening on %s:%d (budget %.1f GiB)",
+        args.host, args.port, args.max_size_gib,
+    )
+    web.run_app(
+        server.build_app(), host=args.host, port=args.port, print=None
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,9 @@ PROMPT_TOKENS = "tpu:prompt_tokens_total"
 GENERATION_TOKENS = "tpu:generation_tokens_total"
 HOST_KV_OFFLOADS = "tpu:host_kv_offloaded_blocks_total"
 HOST_KV_RELOADS = "tpu:host_kv_reloaded_blocks_total"
+# remote KV store tier (LMCache remote-server equivalent, kvstore/)
+REMOTE_KV_STORES = "tpu:remote_kv_stored_blocks_total"
+REMOTE_KV_FETCHES = "tpu:remote_kv_fetched_blocks_total"
 # n-gram speculative decoding (vLLM parity: vllm:spec_decode_num_*_tokens)
 SPEC_DRAFT_TOKENS = "tpu:spec_decode_num_draft_tokens_total"
 SPEC_ACCEPTED_TOKENS = "tpu:spec_decode_num_accepted_tokens_total"
@@ -45,6 +48,8 @@ ALL_COUNTERS = (
     GENERATION_TOKENS,
     HOST_KV_OFFLOADS,
     HOST_KV_RELOADS,
+    REMOTE_KV_STORES,
+    REMOTE_KV_FETCHES,
     SPEC_DRAFT_TOKENS,
     SPEC_ACCEPTED_TOKENS,
 )

@@ -113,10 +113,25 @@ class CacheServerReconciler:
         self.c = client
 
     async def reconcile(self, cr: dict) -> None:
-        desired = resources.deployment_for_cacheserver(cr)
-        live = await self.c.get(self.c.deployments(desired["metadata"]["name"]))
-        if live is None or _spec_drifted(live, desired):
-            await self.c.apply(self.c.deployments, desired)
+        # two halves: the KV STORAGE server (holds KV bytes off-engine — the
+        # LMCache-server equivalent) and the KV lookup controller (answers
+        # the KV-aware router's /kv/lookup)
+        for desired in (
+            resources.deployment_for_kvstore(cr),
+            resources.deployment_for_cacheserver(cr),
+        ):
+            live = await self.c.get(
+                self.c.deployments(desired["metadata"]["name"])
+            )
+            if live is None or _spec_drifted(live, desired):
+                await self.c.apply(self.c.deployments, desired)
+        svc = resources.service_for_kvstore(cr)
+        live_svc = await self.c.get(self.c.services(svc["metadata"]["name"]))
+        if live_svc is None or live_svc.get("spec", {}).get("ports") != \
+                svc["spec"]["ports"]:
+            # re-apply on drift too (a storePort edit must retarget the
+            # Service, not just the Deployment)
+            await self.c.apply(self.c.services, svc)
         await self.c.patch_status(
             self.c.crs(self.plural, cr["metadata"]["name"]), {"phase": "Ready"}
         )
@@ -213,15 +228,49 @@ class LoraAdapterReconciler:
             logger.warning("reading /v1/models from %s failed: %s", url, e)
             return set()
 
+    def _placement_targets(
+        self,
+        pods: list[dict],
+        regs_by_pod: dict[str, set[str]],
+        adapter_name: str,
+        placement: dict,
+    ) -> set[str]:
+        """Pod names that should carry the adapter, per placement.algorithm
+        (crd-loraadapter.yaml): `ordered` (and `default`) packs the first N
+        name-sorted pods — the reference's first-N behavior
+        (loraadapter_controller.go:394-441); `equalized` picks the N pods
+        carrying the fewest OTHER adapters (live registrations), name-sorted
+        on ties, so a fleet's adapters spread instead of piling onto pod-0."""
+        want_n = placement.get("replicas") or len(pods)
+        algorithm = placement.get("algorithm") or "default"
+        if algorithm == "equalized":
+            def load_key(p):
+                pod_name = p["metadata"]["name"]
+                others = regs_by_pod.get(pod_name, set()) - {adapter_name}
+                return (len(others), pod_name)
+
+            chosen = sorted(pods, key=load_key)[:want_n]
+        else:  # default / ordered: deterministic name order, first N
+            chosen = sorted(
+                pods, key=lambda p: p["metadata"]["name"]
+            )[:want_n]
+        return {p["metadata"]["name"] for p in chosen}
+
     async def reconcile(self, cr: dict) -> None:
         name = cr["metadata"]["name"]
         spec = cr["spec"]
         adapter_name = spec["adapterSource"].get("adapterName") or name
         pods = await self._ready_pods(spec["baseModel"])
         placement = spec.get("placement", {})
-        want_n = placement.get("replicas") or len(pods)
-        targets = sorted(pods, key=lambda p: p["metadata"]["name"])[:want_n]
-        target_names = {p["metadata"]["name"] for p in targets}
+        regs_by_pod = {
+            pod["metadata"]["name"]: await self._registrations(
+                self._engine_url(pod)
+            )
+            for pod in pods
+        }
+        target_names = self._placement_targets(
+            pods, regs_by_pod, adapter_name, placement
+        )
 
         loaded: list[dict] = []
         permanent_error: str | None = None
@@ -229,7 +278,7 @@ class LoraAdapterReconciler:
             ip = pod["status"]["podIP"]
             is_target = pod["metadata"]["name"] in target_names
             url = self._engine_url(pod)
-            regs = await self._registrations(url)
+            regs = regs_by_pod[pod["metadata"]["name"]]
             if is_target and adapter_name not in regs:
                 try:
                     path = await self._ensure_downloaded(pod, spec)

@@ -73,6 +73,13 @@ def engine_args(spec: dict) -> list[str]:
         args += ["--decode-window", str(tpu["decodeWindow"])]
     if tpu.get("enablePrefixCaching") is False:
         args += ["--no-enable-prefix-caching"]
+    # KV tier config (the reference's LMCacheConfig block: CPU offload size
+    # in GiB + remote server URL, vllmruntime_controller.go:337-374)
+    kv = spec.get("kvConfig", {})
+    if kv.get("hostKvGib"):
+        args += ["--host-kv-gib", str(kv["hostKvGib"])]
+    if kv.get("remoteKvUrl"):
+        args += ["--remote-kv-url", str(kv["remoteKvUrl"])]
     args += [str(a) for a in tpu.get("extraArgs", [])]
     return args
 
@@ -256,6 +263,9 @@ def deployment_for_router(cr: dict) -> dict:
 
 
 def deployment_for_cacheserver(cr: dict) -> dict:
+    """The KV lookup controller half of the CacheServer CR (the component
+    KV-aware routing queries; reference embeds the LMCache controller
+    in-router, routing_logic.py:222-344 — here it is its own deployment)."""
     spec = cr["spec"]
     name = cr["metadata"]["name"]
     image = spec.get("image", {})
@@ -285,5 +295,62 @@ def deployment_for_cacheserver(cr: dict) -> dict:
                     }],
                 }]},
             },
+        },
+    }
+
+
+def deployment_for_kvstore(cr: dict) -> dict:
+    """The KV STORAGE server half of the CacheServer CR — the process that
+    holds KV bytes off-engine (the reference's lmcache_experimental_server
+    deployment, helm deployment-cache-server.yaml:1-74). Engines point
+    `--remote-kv-url tpukv://<name>-kv-store:<port>` at its Service."""
+    spec = cr["spec"]
+    name = cr["metadata"]["name"]
+    image = spec.get("image", {})
+    port = spec.get("storePort", 9200)
+    labels = {"app": f"{name}-kv-store"}
+    args = ["-m", "vllm_production_stack_tpu.kvstore.server",
+            "--port", str(port),
+            "--max-size-gib", str(spec.get("maxSizeGib", 4))]
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta(f"{name}-kv-store", cr, labels),
+        "spec": {
+            # the store is stateful-in-RAM; one replica per CR (scale by
+            # sharding across CRs, not replicas — replicas would split the
+            # hash space randomly and halve the hit rate)
+            "replicas": 1 if spec.get("replicas", 1) > 0 else 0,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {"containers": [{
+                    "name": "kv-store",
+                    "image": f"{image.get('repository', 'tpu-stack-router')}:"
+                             f"{image.get('tag', 'latest')}",
+                    "command": ["python"],
+                    "args": args,
+                    "ports": [{"containerPort": port, "name": "http"}],
+                    "readinessProbe": {
+                        "httpGet": {"path": "/health", "port": port},
+                        "periodSeconds": 5,
+                    },
+                }]},
+            },
+        },
+    }
+
+
+def service_for_kvstore(cr: dict) -> dict:
+    name = cr["metadata"]["name"]
+    port = cr["spec"].get("storePort", 9200)
+    labels = {"app": f"{name}-kv-store"}
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(f"{name}-kv-store", cr, labels),
+        "spec": {
+            "selector": labels,
+            "ports": [{"port": port, "targetPort": port, "name": "http"}],
         },
     }

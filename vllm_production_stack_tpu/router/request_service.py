@@ -88,6 +88,12 @@ class RequestService:
 
     async def route_openai_request(self, request: web.Request) -> web.StreamResponse:
         """Generic /v1/* proxy with routing."""
+        if request.content_type == "multipart/form-data":
+            # audio transcription (and any multipart upload) routes on the
+            # form's `model` field — json.loads on a multipart body can never
+            # succeed (reference handles this with a dedicated form-aware
+            # path, request.py:513-690)
+            return await self.route_multipart_request(request)
         raw = await request.read()
         try:
             body = json.loads(raw) if raw else {}
@@ -139,6 +145,117 @@ class RequestService:
             )
         logger.info("Routing request %s to %s at %f", request_id, url, time.time())
         return await self._proxy_stream(request, body, url, request_id)
+
+    async def route_multipart_request(
+        self, request: web.Request
+    ) -> web.StreamResponse:
+        """Multipart proxy for /v1/audio/transcriptions-class endpoints:
+        parse the form, route on its `model` field (preferring engines
+        labeled `transcription` when any carry labels), rebuild the form with
+        a fresh boundary, and relay the reply. Mirrors the reference's
+        form-aware path (request.py:513-690) on aiohttp primitives."""
+        request_id = request.headers.get("X-Request-Id") or uuid.uuid4().hex
+        form = await request.post()
+        for required in ("file", "model"):
+            if required not in form:
+                return web.json_response(
+                    {
+                        "error": {
+                            "message": f"missing '{required}' in form data"
+                        }
+                    },
+                    status=400,
+                )
+        alias = form["model"]
+        model = self.resolve_alias(alias if isinstance(alias, str) else None)
+        eps = self._eligible_endpoints(model)
+        labeled = [e for e in eps if e.model_label == "transcription"]
+        if labeled:
+            eps = labeled
+        if not eps:
+            return web.json_response(
+                {
+                    "error": {
+                        "message": f"no transcription backend for model {model!r}",
+                        "type": "not_found",
+                    }
+                },
+                status=404,
+            )
+        ctx = RoutingContext(
+            endpoints=eps,
+            engine_stats=self.state.engine_scraper.get_engine_stats(),
+            request_stats=self.state.request_monitor.get_request_stats(),
+            headers=dict(request.headers),
+            body={"model": model},
+        )
+        try:
+            url = await self.state.policy.route(ctx)
+        except LookupError as e:
+            return web.json_response(
+                {"error": {"message": str(e), "type": "service_unavailable"}},
+                status=503,
+            )
+        logger.info(
+            "Routing request %s to %s at %f", request_id, url, time.time()
+        )
+
+        fd = aiohttp.FormData()
+        for key, value in form.items():
+            if isinstance(value, web.FileField):
+                fd.add_field(
+                    key,
+                    value.file.read(),
+                    filename=value.filename,
+                    content_type=value.content_type,
+                )
+            elif key == "model":
+                fd.add_field(key, model or "")  # alias-resolved name
+            else:
+                fd.add_field(key, value)
+        # the original Content-Type names the OLD boundary — aiohttp sets the
+        # fresh one for the rebuilt form
+        headers = {
+            k: v
+            for k, v in _forward_headers(request.headers).items()
+            if k.lower() != "content-type"
+        }
+        mon = self.state.request_monitor
+        mon.on_new_request(url, request_id, time.time())
+        resp: web.StreamResponse | None = None
+        try:
+            async with self.session.post(
+                url + request.path,
+                data=fd,
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=300),
+            ) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in _HOP_HEADERS:
+                        resp.headers[k] = v
+                resp.headers["X-Request-Id"] = request_id
+                await resp.prepare(request)
+                first = True
+                async for chunk in upstream.content.iter_any():
+                    if first:
+                        first = False
+                        mon.on_first_token(url, request_id, time.time())
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        except aiohttp.ClientError as e:
+            if resp is None or not resp.prepared:
+                return web.json_response(
+                    {"error": {"message": f"engine unreachable: {e}"}},
+                    status=502,
+                )
+            resp.force_close()
+            if request.transport is not None:
+                request.transport.close()
+            return resp
+        finally:
+            mon.on_request_complete(url, request_id, time.time())
 
     async def _proxy_stream(
         self,

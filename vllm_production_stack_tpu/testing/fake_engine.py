@@ -155,6 +155,32 @@ class FakeEngine:
         finally:
             self.running -= 1
 
+    async def h_transcription(self, request: web.Request) -> web.Response:
+        """Echo the multipart upload back: proves the router relayed the file
+        bytes and form fields intact (shape of a Whisper-class response)."""
+        self.total_requests += 1
+        form = await request.post()
+        if "file" not in form or "model" not in form:
+            return web.json_response(
+                {"error": {"message": "missing form field"}}, status=400
+            )
+        f = form["file"]
+        payload = f.file.read()
+        fields = {
+            k: v for k, v in form.items() if not isinstance(v, web.FileField)
+        }
+        self.seen_request_log.append(
+            {"path": "/v1/audio/transcriptions", "fields": fields,
+             "filename": f.filename, "bytes": len(payload)}
+        )
+        return web.json_response(
+            {
+                "text": f"transcribed {len(payload)} bytes of {f.filename}",
+                "model": fields.get("model"),
+                "fields": fields,
+            }
+        )
+
     async def h_metrics(self, request: web.Request) -> web.Response:
         label = f'{{model_name="{self.model}"}}'
         lines = [
@@ -191,6 +217,7 @@ class FakeEngine:
         app.router.add_get("/v1/models", self.h_models)
         app.router.add_post("/v1/chat/completions", self.h_completion)
         app.router.add_post("/v1/completions", self.h_completion)
+        app.router.add_post("/v1/audio/transcriptions", self.h_transcription)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_get("/health", self.h_health)
         app.router.add_post("/sleep", self.h_sleep)

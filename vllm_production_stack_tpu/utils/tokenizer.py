@@ -51,6 +51,35 @@ def _content_text(message: dict) -> str:
     return content or ""
 
 
+_BYTE_DECODER: dict[str, int] | None = None
+
+
+def _gpt2_byte_decoder() -> dict[str, int]:
+    """Inverse of the GPT-2 bytes→unicode table byte-level BPE vocabularies
+    are written in (each vocab char stands for exactly one byte). Cached —
+    token_repr sits on the logprobs hot path."""
+    global _BYTE_DECODER
+    if _BYTE_DECODER is not None:
+        return _BYTE_DECODER
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    _BYTE_DECODER = {chr(c): b for b, c in zip(bs, cs)}
+    return _BYTE_DECODER
+
+
+_SP_BYTE_RE = None  # compiled lazily: sentencepiece byte-fallback "<0xNN>"
+
+
 class TokenizerWrapper:
     """Uniform interface over HF tokenizers and the byte fallback, with
     incremental detokenization for streaming."""
@@ -88,26 +117,65 @@ class TokenizerWrapper:
     def decode(self, ids: list[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
+    def _piece_family(self) -> str:
+        """"bytelevel" (GPT-2/Llama-3-style Ġ vocab), "sp" (SentencePiece ▁
+        vocab), or "plain". Detected once from the vocabulary — the family
+        decides how a piece's chars map to content bytes."""
+        fam = getattr(self, "_family", None)
+        if fam is None:
+            fam = "plain"
+            get_vocab = getattr(self._tok, "get_vocab", None)
+            if get_vocab is not None:
+                for key in get_vocab():
+                    if "Ġ" in key:
+                        fam = "bytelevel"
+                        break
+                    if "▁" in key:
+                        fam = "sp"
+                        break
+            self._family = fam
+        return fam
+
     def token_repr(self, tid: int) -> tuple[str, bytes]:
-        """(display string, raw bytes) for ONE token id — the logprobs API
-        surface. decode() of a single id is wrong for this: SentencePiece
-        strips leading-space markers and partial UTF-8 bytes decode to
-        nothing, so strings/offsets/bytes built that way don't reconstruct
-        the output. Uses the tokenizer's piece vocabulary when it has one;
-        the byte fallback reports the literal byte."""
+        """(display string, content bytes) for ONE token id — the logprobs
+        API surface. decode() of a single id is wrong for this (SentencePiece
+        strips leading-space markers; partial UTF-8 decodes to nothing), and
+        the piece's own UTF-8 is wrong too: byte-level-BPE chars are a byte
+        alphabet (Ġ = 0x20) and SentencePiece ▁ is a marker — the OpenAI
+        `bytes` field must carry the DECODED content bytes so concatenating
+        them reconstructs the output text."""
         tid = int(tid)
         tok = self._tok
         if hasattr(tok, "convert_ids_to_tokens"):
             piece = tok.convert_ids_to_tokens(tid)
             if piece is None:
                 return "", b""
-            # sentencepiece / byte-level BPE markers -> readable text
+            fam = self._piece_family()
+            if fam == "bytelevel":
+                bd = _gpt2_byte_decoder()
+                if all(c in bd for c in piece):
+                    raw = bytes(bd[c] for c in piece)
+                    return raw.decode("utf-8", errors="replace"), raw
+                # special token (<|eot_id|> etc.): literal text
+                return piece, piece.encode("utf-8")
+            if fam == "sp":
+                global _SP_BYTE_RE
+                if _SP_BYTE_RE is None:
+                    import re
+
+                    _SP_BYTE_RE = re.compile(r"^<0x([0-9A-Fa-f]{2})>$")
+                m = _SP_BYTE_RE.match(piece)
+                if m:  # sentencepiece byte-fallback token = one raw byte
+                    raw = bytes([int(m.group(1), 16)])
+                    return raw.decode("utf-8", errors="replace"), raw
+                s = piece.replace("\u2581", " ")
+                return s, s.encode("utf-8")
             s = (
                 piece.replace("\u2581", " ")
                 .replace("\u0120", " ")
                 .replace("\u010a", "\n")
             )
-            return s, piece.encode("utf-8")
+            return s, s.encode("utf-8")
         if 0 <= tid < 256:
             s = chr(tid) if 32 <= tid < 127 else f"<0x{tid:02x}>"
             return s, bytes([tid])
