@@ -130,6 +130,7 @@ class LLMEngine:
                 self.runner.fetch_block,
                 self.runner.upload_block,
                 remote=self.remote_tier,
+                upload_blocks=self.runner.upload_blocks,
             )
         self.scheduler = Scheduler(
             config.model, config.cache, config.scheduler,

@@ -193,18 +193,25 @@ class KVBlockPool:
         if remote is None:
             return []
         matched: list[int] = []
+        staged: list = []  # (blk, data) for ONE batched device upload
         for i, (h, data) in enumerate(zip(hashes, remote.fetch_run(hashes))):
             if i > 0:
                 self.stats.queries += 1
             blk = self.allocate()  # may evict (offload+write-through) others
             if blk is None:
                 break
-            self.host_tier.upload(blk, data)
+            staged.append((blk, data))
             self._hash_to_block[h] = blk
             self._block_to_hash[blk] = h
             self.host_tier.insert_resolved(h, data)
             self.stats.hits += 1
             matched.append(blk)
+        if staged:
+            # one dispatch for the whole fetched run — per-block uploads
+            # cost a device round trip each on high-RTT links
+            self.host_tier.upload_many(
+                [blk for blk, _ in staged], [d for _, d in staged]
+            )
         return matched
 
     def _reload_from_host(self, h: int) -> int | None:

@@ -34,7 +34,8 @@ class HostKVTier:
     (device transfers); the pool calls `store` from its eviction hook and
     `reload_into` from prefix matching."""
 
-    def __init__(self, num_blocks: int, fetch_block, upload_block, remote=None):
+    def __init__(self, num_blocks: int, fetch_block, upload_block,
+                 remote=None, upload_blocks=None):
         self.num_blocks = num_blocks
         # fetch returns per-layer device slices with host copies STARTED
         # (ModelRunner.fetch_block); entries resolve to numpy one store
@@ -42,6 +43,9 @@ class HostKVTier:
         # of stalling the scheduler loop
         self._fetch = fetch_block
         self._upload = upload_block  # (device_block_id, np.ndarray) -> None
+        # optional batched form: (block_ids, stacked np.ndarray) -> None —
+        # one device dispatch for N blocks (remote-fetch promotion path)
+        self._upload_many = upload_blocks
         self._data: OrderedDict[int, object] = OrderedDict()
         self._pending: list[int] = []  # hashes whose entry is still on device
         # optional kvstore.client.RemoteKVTier: resolved blocks write
@@ -132,6 +136,15 @@ class HostKVTier:
         """Host→HBM upload for blocks sourced OUTSIDE the ring (remote
         fetches) — same runner callback the reload path uses."""
         self._upload(device_block, data)
+
+    def upload_many(self, device_blocks: list[int], data) -> None:
+        """Batched host→HBM for remote-fetched runs: one device dispatch
+        when the runner supports it, per-block otherwise."""
+        if self._upload_many is not None:
+            self._upload_many(device_blocks, np.stack(data))
+        else:
+            for blk, d in zip(device_blocks, data):
+                self._upload(blk, d)
 
     def insert_resolved(self, h: int, data: np.ndarray) -> None:
         """Promote a remote-fetched block into the ring so the next match is

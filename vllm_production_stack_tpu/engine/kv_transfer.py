@@ -51,17 +51,23 @@ def np_dtype_from_name(name: str) -> np.dtype:
 # ever materializing the full tensor.
 
 
+def raw_frame(h, raw: bytes, dtype_name: str, shape: list[int]) -> bytes:
+    """Frame pre-serialized block bytes (the kvstore server streams stored
+    payloads without reconstructing arrays)."""
+    head = json.dumps({
+        "hash": str(h),
+        "dtype": dtype_name,
+        "shape": list(shape),
+        "nbytes": len(raw),
+    }).encode()
+    return struct.pack("<I", len(head)) + head + raw
+
+
 def block_frame(h: int, arr: np.ndarray) -> bytes:
     """One streamed KV block. The raw bytes are the array's own buffer (one
     tobytes copy — no npz container, no re-stacking)."""
     view = np.ascontiguousarray(arr)
-    head = json.dumps({
-        "hash": str(h),
-        "dtype": arr.dtype.name,
-        "shape": list(arr.shape),
-        "nbytes": view.nbytes,
-    }).encode()
-    return struct.pack("<I", len(head)) + head + view.tobytes()
+    return raw_frame(h, view.tobytes(), arr.dtype.name, list(arr.shape))
 
 
 class FrameParser:
@@ -206,21 +212,36 @@ class KVTransfer:
                 f"KV page geometry mismatch: got {tuple(blocks.shape[1:])}, "
                 f"this engine needs {want}"
             )
-        adopted = 0
+        # allocate + upload the whole group in ONE device dispatch
+        # (upload_blocks): per-block uploads cost a dispatch round trip
+        # each, which dominates PD transfer on high-RTT device links
+        adopt: list[tuple[int, int, np.ndarray]] = []  # (hash, blk, data)
         for h, data in zip(hashes, blocks):
             if h in self.pool._hash_to_block:
                 continue
             blk = self.pool.allocate()
             if blk is None:
                 break
-            try:
-                self.runner.upload_block(blk, data)
-            except Exception:
-                self.pool.free_block(blk)  # don't leak the block on failure
-                raise
+            adopt.append((h, blk, data))
+        if not adopt:
+            return 0
+        try:
+            upload_many = getattr(self.runner, "upload_blocks", None)
+            if upload_many is not None:
+                upload_many(
+                    [blk for _, blk, _ in adopt],
+                    np.stack([d for _, _, d in adopt]),
+                )
+            else:
+                for _, blk, data in adopt:
+                    self.runner.upload_block(blk, data)
+        except Exception:
+            for _, blk, _ in adopt:  # don't leak the blocks on failure
+                self.pool.free_block(blk)
+            raise
+        for h, blk, _ in adopt:
             self.pool._hash_to_block[h] = blk
             self.pool._block_to_hash[blk] = h
             # park as an evictable cached block (refcount 0, addressable)
             self.pool.free_block(blk)
-            adopted += 1
-        return adopted
+        return len(adopt)

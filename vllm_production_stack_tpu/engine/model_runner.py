@@ -991,6 +991,41 @@ class ModelRunner:
             self.kv_caches, data, jnp.int32(blk)
         )
 
+    def upload_blocks(self, blks: list[int], data: np.ndarray) -> None:
+        """Host→HBM for N blocks in ONE device dispatch — the PD import /
+        remote-fetch path. Per-block upload_block costs a dispatch round
+        trip each (ruinous through high-RTT tunnels: 512 blocks of an 8k
+        prompt ≈ 512 RTTs); this is one scatter for the whole group. `data`
+        is (N, L, 2, block_size, kvH, D). N is padded up to a power of two
+        (duplicating the last row — duplicate scatter indices with identical
+        payloads are benign) so arbitrary run lengths compile at most
+        log2(max) program variants instead of one per N."""
+        n = len(blks)
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        if bucket != n:
+            blks = list(blks) + [blks[-1]] * (bucket - n)
+            data = np.concatenate(
+                [data, np.repeat(data[-1:], bucket - n, axis=0)]
+            )
+        if getattr(self, "_upload_blocks_fn", None) is None:
+
+            @functools.partial(jax.jit, donate_argnames=("kv_caches",))
+            def upload_many_fn(kv_caches, data, blks):
+                return tuple(
+                    leaf.at[:, blks].set(
+                        jnp.swapaxes(data[:, i], 0, 1).astype(leaf.dtype)
+                    )
+                    for i, leaf in enumerate(kv_caches)
+                )
+
+            self._upload_blocks_fn = upload_many_fn
+        self.kv_caches = self._upload_blocks_fn(
+            self.kv_caches, np.ascontiguousarray(data),
+            jnp.asarray(blks, jnp.int32),
+        )
+
     # -- LoRA slots --------------------------------------------------------
 
     def install_lora(self, slot: int, adapter) -> None:

@@ -31,15 +31,6 @@ from ..utils.logging import init_logger
 logger = init_logger(__name__)
 
 
-def _np_dtype(name: str) -> np.dtype:
-    try:
-        return np.dtype(name)
-    except TypeError:
-        import ml_dtypes  # float8_e4m3fn etc. (jax dependency, always present)
-
-        return np.dtype(getattr(ml_dtypes, name))
-
-
 def parse_store_url(url: str) -> tuple[str, int]:
     """Accepts `tpukv://host:port` (the stack's lm://-style scheme) or
     `http://host:port`."""
@@ -87,7 +78,8 @@ class _Conn:
 @dataclass
 class RemoteTierStats:
     stores: int = 0  # blocks pushed (writer thread, after dedupe)
-    dropped: int = 0  # pushes dropped on queue overflow / server error
+    dropped: int = 0  # enqueued pushes abandoned (server error / cooldown)
+    overflow: int = 0  # pushes rejected at the queue (never enqueued)
     fetches: int = 0  # mget round trips
     fetched_blocks: int = 0  # blocks served remote -> engine
     probe_hits: int = 0  # contains_run block hits (lookup probes)
@@ -159,7 +151,9 @@ class RemoteKVTier:
         except queue.Full:
             with self._stored_lock:
                 self._inflight.discard(h)
-            self.stats.dropped += 1
+            # NOT counted in `dropped`: drain() balances stores+dropped
+            # against _enqueued, and this item never entered the queue
+            self.stats.overflow += 1
 
     def _writer_loop(self) -> None:
         while True:
@@ -253,30 +247,21 @@ class RemoteKVTier:
             return []
         if status != 200:
             return []
+        from ..engine.kv_transfer import FrameParser
+
         self.stats.fetches += 1
         out: list[np.ndarray] = []
-        off = 0
-        expect = [str(h) for h in hashes]
-        while off < len(payload) and len(out) < len(expect):
-            head_len = int.from_bytes(payload[off : off + 4], "little")
-            off += 4
-            head = json.loads(payload[off : off + head_len])
-            off += head_len
-            nbytes = head["nbytes"]
-            if head["hash"] != expect[len(out)]:
-                break  # server returned a non-consecutive frame; stop clean
+        for h, arr in FrameParser().feed(payload):
+            if len(out) >= len(hashes) or h != hashes[len(out)]:
+                break  # non-consecutive frame; stop clean
             # copy: a frombuffer view would pin the ENTIRE multi-block
             # response buffer for as long as any one block stays referenced
             # (the host ring retains these)
-            arr = np.frombuffer(
-                payload[off : off + nbytes], dtype=_np_dtype(head["dtype"])
-            ).reshape([int(d) for d in head["shape"].split(",")]).copy()
-            off += nbytes
-            out.append(arr)
+            out.append(arr.copy())
             # it exists remotely — teach the dedupe set so eviction of the
             # promoted copy doesn't push it straight back
             with self._stored_lock:
-                self._stored[int(head["hash"])] = None
+                self._stored[h] = None
                 while len(self._stored) > self._dedupe_capacity:
                     self._stored.popitem(last=False)
         self.stats.fetched_blocks += len(out)

@@ -85,10 +85,14 @@ class BlockStore:
         return (fp, h) in self._data
 
 
-def frame_block(h: str, payload: bytes, meta: dict) -> bytes:
-    """One mget frame: 4-byte LE header length, JSON header, raw bytes."""
-    head = json.dumps({"hash": h, **meta, "nbytes": len(payload)}).encode()
-    return len(head).to_bytes(4, "little") + head + payload
+def _meta_frame(h: str, payload: bytes, meta: dict) -> bytes:
+    """One mget frame in the shared streaming wire format
+    (engine/kv_transfer.py: raw_frame / FrameParser) — the PD transport and
+    the remote store speak the same framing."""
+    from ..engine.kv_transfer import raw_frame
+
+    shape = [int(d) for d in meta["shape"].split(",") if d]
+    return raw_frame(h, payload, meta["dtype"], shape)
 
 
 class KVStoreServer:
@@ -146,7 +150,7 @@ class KVStoreServer:
             if entry is None:
                 break
             payload, meta = entry
-            frames.append(frame_block(str(h), payload, meta))
+            frames.append(_meta_frame(str(h), payload, meta))
         return web.Response(
             body=b"".join(frames),
             headers={"X-KV-Count": str(len(frames))},

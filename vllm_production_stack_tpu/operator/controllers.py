@@ -127,8 +127,17 @@ class CacheServerReconciler:
                 await self.c.apply(self.c.deployments, desired)
         svc = resources.service_for_kvstore(cr)
         live_svc = await self.c.get(self.c.services(svc["metadata"]["name"]))
-        if live_svc is None or live_svc.get("spec", {}).get("ports") != \
-                svc["spec"]["ports"]:
+
+        def _port_pairs(obj):
+            # compare only the fields we set: the apiserver defaults others
+            # (protocol, nodePort), which would otherwise read as permanent
+            # drift and re-apply on every reconcile
+            return [
+                (p.get("port"), p.get("targetPort"))
+                for p in (obj or {}).get("spec", {}).get("ports", [])
+            ]
+
+        if live_svc is None or _port_pairs(live_svc) != _port_pairs(svc):
             # re-apply on drift too (a storePort edit must retarget the
             # Service, not just the Deployment)
             await self.c.apply(self.c.services, svc)
