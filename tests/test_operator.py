@@ -25,21 +25,31 @@ from vllm_production_stack_tpu.operator.manager import OperatorManager
 
 class FakeK8s:
     """In-memory namespaced object store speaking the REST subset the
-    operator uses."""
+    operator uses — including streaming watches (`?watch=1`) and
+    finalizer-aware deletion (DELETE on an object with finalizers sets
+    deletionTimestamp; a PUT that clears the finalizers completes the
+    delete), matching real apiserver semantics closely enough for the
+    watch/finalizer controller tests."""
 
     def __init__(self):
         self.store: dict[str, dict] = {}  # path prefix -> {name: obj}
         self._rv = 0
+        self._subs: list[tuple[str, asyncio.Queue]] = []
 
     def _bucket(self, prefix: str) -> dict:
         return self.store.setdefault(prefix, {})
+
+    def _notify(self, prefix: str, etype: str, obj: dict) -> None:
+        for p, q in list(self._subs):
+            if p == prefix:
+                q.put_nowait({"type": etype, "object": obj})
 
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", self.handle)
         return app
 
-    async def handle(self, request: web.Request) -> web.Response:
+    async def handle(self, request: web.Request):
         path = request.path
         parts = path.strip("/").split("/")
         # .../namespaces/<ns>/<plural>[/<name>[/status]]
@@ -51,6 +61,20 @@ class FakeK8s:
         bucket = self._bucket(prefix)
 
         if request.method == "GET" and name is None:
+            if request.query.get("watch"):
+                resp = web.StreamResponse()
+                await resp.prepare(request)
+                q: asyncio.Queue = asyncio.Queue()
+                self._subs.append((prefix, q))
+                try:
+                    while True:
+                        ev = await q.get()
+                        await resp.write(json.dumps(ev).encode() + b"\n")
+                except (asyncio.CancelledError, ConnectionResetError):
+                    pass
+                finally:
+                    self._subs.remove((prefix, q))
+                return resp
             items = list(bucket.values())
             sel = request.query.get("labelSelector")
             if sel:
@@ -59,7 +83,9 @@ class FakeK8s:
                     o for o in items
                     if o.get("metadata", {}).get("labels", {}).get(k) == v
                 ]
-            return web.json_response({"items": items})
+            return web.json_response(
+                {"items": items, "metadata": {"resourceVersion": str(self._rv)}}
+            )
         if request.method == "GET":
             obj = bucket.get(name)
             if obj is None:
@@ -67,20 +93,36 @@ class FakeK8s:
             return web.json_response(obj)
         if request.method == "POST":
             obj = await request.json()
+            if obj["metadata"]["name"] in bucket:
+                return web.json_response(
+                    {"reason": "AlreadyExists"}, status=409
+                )
             self._rv += 1
             obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
             bucket[obj["metadata"]["name"]] = obj
+            self._notify(prefix, "ADDED", obj)
             return web.json_response(obj)
         if request.method == "PUT":
             obj = await request.json()
             self._rv += 1
             obj["metadata"]["resourceVersion"] = str(self._rv)
+            prev = bucket.get(name)
+            # a PUT clearing the finalizers of a deleting object completes
+            # the delete
+            if prev and prev.get("metadata", {}).get("deletionTimestamp") \
+                    and not obj.get("metadata", {}).get("finalizers"):
+                del bucket[name]
+                self._notify(prefix, "DELETED", obj)
+                return web.json_response(obj)
             # status is a subresource: a PUT of the main resource never
             # clobbers it (matches real apiserver semantics)
-            prev = bucket.get(name)
             if prev and "status" in prev and "status" not in obj:
                 obj["status"] = prev["status"]
+            if prev and prev.get("metadata", {}).get("deletionTimestamp"):
+                obj["metadata"]["deletionTimestamp"] = \
+                    prev["metadata"]["deletionTimestamp"]
             bucket[name] = obj
+            self._notify(prefix, "MODIFIED", obj)
             return web.json_response(obj)
         if request.method == "PATCH" and status_sub:
             obj = bucket.get(name)
@@ -90,7 +132,18 @@ class FakeK8s:
             obj["status"] = {**obj.get("status", {}), **patch.get("status", {})}
             return web.json_response(obj)
         if request.method == "DELETE":
+            obj = bucket.get(name)
+            if obj is None:
+                return web.json_response({})
+            if obj.get("metadata", {}).get("finalizers"):
+                # finalizers pin the object: mark deleting, let the
+                # controller unload and clear them
+                obj["metadata"]["deletionTimestamp"] = \
+                    "2026-01-01T00:00:00Z"
+                self._notify(prefix, "MODIFIED", obj)
+                return web.json_response(obj)
             bucket.pop(name, None)
+            self._notify(prefix, "DELETED", obj)
             return web.json_response({})
         return web.json_response({}, status=405)
 
@@ -464,3 +517,191 @@ def test_lora_placement_equalized_unloads_from_overloaded(tmp_path):
     assert "new-lora" not in result[0]
     assert "new-lora" in result[1]
     assert "new-lora" in result[2]
+
+
+def test_watch_triggered_reconcile():
+    """Events drive reconciles — no poll interval: a freshly created CR's
+    Deployment appears within a watch round trip (reference: controller-
+    runtime informers, operator/cmd/main.go:58-266)."""
+    from vllm_production_stack_tpu.operator.manager import OperatorManager
+
+    async def go(fake, client):
+        mgr = OperatorManager(client)
+        task = asyncio.create_task(mgr.watch_kind(mgr.reconcilers[0]))
+        await asyncio.sleep(0.2)  # list+watch established
+        await client.create(
+            client.crs("tpuruntimes"), copy.deepcopy(RUNTIME_CR)
+        )
+        dep = None
+        for _ in range(100):
+            dep = await client.get(client.deployments("llama3-engine"))
+            if dep:
+                break
+            await asyncio.sleep(0.05)
+        assert dep is not None, "watch event did not trigger a reconcile"
+
+        # a spec edit (MODIFIED event) reconciles too
+        cr = await client.get(client.crs("tpuruntimes", "llama3"))
+        cr["spec"]["replicas"] = 5
+        await client.replace(client.crs("tpuruntimes", "llama3"), cr)
+        for _ in range(100):
+            dep = await client.get(client.deployments("llama3-engine"))
+            if dep["spec"]["replicas"] == 5:
+                break
+            await asyncio.sleep(0.05)
+        assert dep["spec"]["replicas"] == 5
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    _with_fake_k8s(go)
+
+
+def test_leader_election_split_brain_and_takeover():
+    """Exactly one replica reconciles: the standby cannot acquire a live
+    lease; an expired lease transfers; the deposed leader stays locked out
+    (reference: --leader-elect, cmd/main.go)."""
+    from vllm_production_stack_tpu.operator.manager import LeaderElector
+
+    async def go(fake, client):
+        a = LeaderElector(client, identity="replica-a", lease_duration_s=1.0)
+        b = LeaderElector(client, identity="replica-b", lease_duration_s=1.0)
+        assert await a.try_acquire()
+        assert not await b.try_acquire()  # split-brain prevented
+        assert await a.try_acquire()  # renewal succeeds
+        lease = await client.get(client.leases("tpu-stack-operator"))
+        assert lease["spec"]["holderIdentity"] == "replica-a"
+
+        await asyncio.sleep(1.3)  # let the lease expire
+        assert await b.try_acquire()  # takeover
+        lease = await client.get(client.leases("tpu-stack-operator"))
+        assert lease["spec"]["holderIdentity"] == "replica-b"
+        assert lease["spec"]["leaseTransitions"] == 1
+        assert not await a.try_acquire()  # deposed leader locked out
+
+    _with_fake_k8s(go)
+
+
+def test_lora_finalizer_unloads_on_delete(tmp_path):
+    """Deleting a LoraAdapter CR unloads the adapter from every pod BEFORE
+    the object disappears (reference finalizer flow,
+    loraadapter_controller.go:73-232)."""
+    adapter_dir = tmp_path / "adapter"
+    adapter_dir.mkdir()
+
+    async def go(fake, client):
+        engines = [FakeLoraEngine(), FakeLoraEngine()]
+        srvs = []
+        try:
+            for eng in engines:
+                s = TestServer(eng.build_app())
+                await s.start_server()
+                srvs.append(s)
+            for i, s in enumerate(srvs):
+                await client.create(client.pods(), {
+                    "metadata": {"name": f"engine-{i}",
+                                 "labels": {"model": "base"}},
+                    "status": {
+                        "podIP": "127.0.0.1",
+                        "conditions": [{"type": "Ready", "status": "True"}],
+                    },
+                    "_port": s.port,
+                })
+            await client.create(client.crs("loraadapters"), {
+                "apiVersion": "production-stack.tpu.ai/v1alpha1",
+                "kind": "LoraAdapter",
+                "metadata": {"name": "doomed-lora", "uid": "u11"},
+                "spec": {
+                    "baseModel": "base",
+                    "adapterSource": {"type": "local",
+                                      "adapterPath": str(adapter_dir)},
+                },
+            })
+
+            class PortAwareReconciler(LoraAdapterReconciler):
+                def _engine_url(self, pod):
+                    return f"http://127.0.0.1:{pod['_port']}"
+
+            async with aiohttp.ClientSession() as http:
+                rec = PortAwareReconciler(client, http)
+                cr = await client.get(client.crs("loraadapters", "doomed-lora"))
+                await rec.reconcile(cr)
+                # finalizer installed, adapter loaded everywhere
+                cr = await client.get(client.crs("loraadapters", "doomed-lora"))
+                assert rec.FINALIZER in cr["metadata"]["finalizers"]
+                assert all("doomed-lora" in e.adapters for e in engines)
+
+                # delete: apiserver pins the object on the finalizer
+                await client.delete(client.crs("loraadapters", "doomed-lora"))
+                cr = await client.get(client.crs("loraadapters", "doomed-lora"))
+                assert cr is not None
+                assert cr["metadata"]["deletionTimestamp"]
+
+                # the delete-path reconcile unloads, then releases the object
+                await rec.reconcile(cr)
+                assert all("doomed-lora" not in e.adapters for e in engines)
+                assert await client.get(
+                    client.crs("loraadapters", "doomed-lora")
+                ) is None
+        finally:
+            for s in srvs:
+                await s.close()
+
+    _with_fake_k8s(go)
+
+
+def test_manager_run_watch_loop_and_leadership_loss():
+    """Full manager lifecycle: acquires the lease, serves readiness, drives
+    reconciles from watch events, and aborts with LostLeadership when a
+    rival steals the lease (deployment restarts the pod as a standby)."""
+    from vllm_production_stack_tpu.operator.manager import (
+        LeaderElector,
+        LostLeadership,
+        OperatorManager,
+    )
+
+    async def go(fake, client):
+        mgr = OperatorManager(client)
+        elector = LeaderElector(
+            client, identity="mgr", lease_duration_s=1.0
+        )
+        run = asyncio.create_task(mgr.run(elector))
+        await asyncio.sleep(0.3)
+        assert mgr.is_leader
+
+        # health surface reflects leadership
+        health_client = TestClient(TestServer(mgr.build_health_app()))
+        await health_client.start_server()
+        try:
+            assert (await health_client.get("/healthz")).status == 200
+            assert (await health_client.get("/readyz")).status == 200
+            text = await (await health_client.get("/metrics")).text()
+            assert "tpu_operator_is_leader 1" in text
+        finally:
+            await health_client.close()
+
+        # watch-driven: a new CR reconciles without any poll interval
+        await client.create(
+            client.crs("tpuruntimes"), copy.deepcopy(RUNTIME_CR)
+        )
+        dep = None
+        for _ in range(100):
+            dep = await client.get(client.deployments("llama3-engine"))
+            if dep:
+                break
+            await asyncio.sleep(0.05)
+        assert dep is not None
+
+        # a rival takes the lease: the manager must notice and abort
+        rival = LeaderElector(
+            client, identity="rival", lease_duration_s=1.0
+        )
+        lease = await client.get(client.leases("tpu-stack-operator"))
+        lease["spec"]["holderIdentity"] = "rival"
+        lease["spec"]["renewTime"] = "2126-01-01T00:00:00.000000Z"
+        await client.replace(client.leases("tpu-stack-operator"), lease)
+        with __import__("pytest").raises(LostLeadership):
+            await asyncio.wait_for(run, timeout=5)
+        assert not mgr.is_leader
+        del rival
+
+    _with_fake_k8s(go)

@@ -251,7 +251,16 @@ class RemoteKVTier:
 
         self.stats.fetches += 1
         out: list[np.ndarray] = []
-        for h, arr in FrameParser().feed(payload):
+        try:
+            frames = FrameParser().feed(payload)
+        except Exception as e:
+            # a malformed/foreign-version response must degrade to a cache
+            # miss like every other remote-tier failure — never fail the
+            # user's request from inside match_prefix
+            logger.warning("malformed mget response: %s", e)
+            self.stats.errors += 1
+            return []
+        for h, arr in frames:
             if len(out) >= len(hashes) or h != hashes[len(out)]:
                 break  # non-consecutive frame; stop clean
             # copy: a frombuffer view would pin the ENTIRE multi-block

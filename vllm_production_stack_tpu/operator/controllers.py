@@ -148,6 +148,10 @@ class CacheServerReconciler:
 
 class LoraAdapterReconciler:
     plural = "loraadapters"
+    # finalizer-based delete (reference loraadapter_controller.go:73-232):
+    # a deleted CR must unload its adapter from every pod BEFORE the object
+    # disappears, or adapters stay loaded forever
+    FINALIZER = "production-stack.tpu.ai/lora-unload"
 
     def __init__(self, client: K8sClient, http: aiohttp.ClientSession,
                  engine_port: int = 8000, sidecar_port: int = 30090):
@@ -219,9 +223,11 @@ class LoraAdapterReconciler:
                            pod["metadata"]["name"], e)
             return None
 
-    async def _registrations(self, url: str) -> set[str]:
+    async def _registrations(self, url: str) -> set[str] | None:
         """Adapters live on one engine, from its /v1/models (the reference
-        reconciles against exactly this output, :613-693)."""
+        reconciles against exactly this output, :613-693). None = the pod's
+        state is UNKNOWN (unreachable/garbled) — callers must not treat
+        that as 'adapter absent' (the finalizer would leak the adapter)."""
         import asyncio
         import json
 
@@ -235,7 +241,7 @@ class LoraAdapterReconciler:
         except (aiohttp.ClientError, asyncio.TimeoutError,
                 json.JSONDecodeError, KeyError, TypeError) as e:
             logger.warning("reading /v1/models from %s failed: %s", url, e)
-            return set()
+            return None
 
     def _placement_targets(
         self,
@@ -267,16 +273,26 @@ class LoraAdapterReconciler:
 
     async def reconcile(self, cr: dict) -> None:
         name = cr["metadata"]["name"]
+        meta = cr["metadata"]
+        if meta.get("deletionTimestamp"):
+            await self._finalize(cr)
+            return
+        if self.FINALIZER not in meta.get("finalizers", []):
+            meta.setdefault("finalizers", []).append(self.FINALIZER)
+            updated = await self.c.replace(self.c.crs(self.plural, name), cr)
+            cr = updated or cr
         spec = cr["spec"]
         adapter_name = spec["adapterSource"].get("adapterName") or name
         pods = await self._ready_pods(spec["baseModel"])
         placement = spec.get("placement", {})
-        regs_by_pod = {
-            pod["metadata"]["name"]: await self._registrations(
-                self._engine_url(pod)
+        regs_by_pod = {}
+        for pod in pods:
+            regs = await self._registrations(self._engine_url(pod))
+            # unknown state reads as empty here: a load attempt on an
+            # unreachable pod just fails and retries next reconcile
+            regs_by_pod[pod["metadata"]["name"]] = (
+                regs if regs is not None else set()
             )
-            for pod in pods
-        }
         target_names = self._placement_targets(
             pods, regs_by_pod, adapter_name, placement
         )
@@ -337,3 +353,54 @@ class LoraAdapterReconciler:
         else:
             status["phase"] = "Loading"
         await self.c.patch_status(self.c.crs(self.plural, name), status)
+
+    async def _finalize(self, cr: dict) -> None:
+        """Delete path: unload the adapter from every pod that carries it,
+        then drop the finalizer so the apiserver completes the delete. An
+        unreachable pod keeps the finalizer (retry next reconcile) — better
+        a stuck delete than a leaked adapter."""
+        from .resources import label_safe
+
+        name = cr["metadata"]["name"]
+        spec = cr["spec"]
+        adapter_name = spec["adapterSource"].get("adapterName") or name
+        all_unloaded = True
+        # ALL pods carrying the base model, ready or not — a NotReady pod
+        # may still hold the adapter and come back
+        pods = await self.c.list(
+            self.c.pods(),
+            label_selector=f"model={label_safe(spec['baseModel'])}",
+        )
+        for pod in pods:
+            if not pod.get("status", {}).get("podIP"):
+                continue  # never scheduled/addressable: nothing loaded
+            url = self._engine_url(pod)
+            regs = await self._registrations(url)
+            if regs is None:
+                # state UNKNOWN: keep the finalizer and retry — better a
+                # stuck delete than a leaked adapter
+                all_unloaded = False
+                continue
+            if adapter_name not in regs:
+                continue
+            try:
+                async with self.http.post(
+                    url + "/v1/unload_lora_adapter",
+                    json={"lora_name": adapter_name},
+                ) as resp:
+                    if resp.status != 200:
+                        all_unloaded = False
+            except aiohttp.ClientError as e:
+                logger.warning(
+                    "finalizer unload of %s on %s failed: %s",
+                    adapter_name, url, e,
+                )
+                all_unloaded = False
+        if not all_unloaded:
+            return
+        finalizers = [
+            f for f in cr["metadata"].get("finalizers", [])
+            if f != self.FINALIZER
+        ]
+        cr["metadata"]["finalizers"] = finalizers
+        await self.c.replace(self.c.crs(self.plural, name), cr)

@@ -103,6 +103,55 @@ class K8sClient:
         out = await self._request("GET", path)
         return (out or {}).get("items", [])
 
+    async def list_raw(self, path: str) -> dict:
+        """Full list response including metadata.resourceVersion — the
+        start point for a watch."""
+        return await self._request("GET", path) or {}
+
+    async def watch(self, path: str, resource_version: str | None = None,
+                    timeout_s: float = 300.0):
+        """Streaming watch (the list+watch half of controller-runtime's
+        informers, operator/cmd/main.go:58-266): yields
+        {"type": ADDED|MODIFIED|DELETED|BOOKMARK, "object": {...}} events
+        as JSON lines arrive. Raises ApiError(410) when the
+        resourceVersion is too old — caller re-lists and re-watches."""
+        import json
+
+        from urllib.parse import quote
+
+        params = "?watch=1&allowWatchBookmarks=true"
+        if resource_version:
+            params += f"&resourceVersion={quote(str(resource_version))}"
+        async with self._sess().get(
+            self.base_url + path + params,
+            ssl=self._ssl,
+            timeout=aiohttp.ClientTimeout(total=None, sock_read=timeout_s),
+        ) as resp:
+            if resp.status >= 400:
+                raise ApiError(resp.status, await resp.text())
+            # incremental line buffer: resp.content's line iterator caps a
+            # line at the 64KB reader limit, and real watch events (big
+            # pod specs, managedFields) routinely exceed it
+            buf = bytearray()
+            async for chunk in resp.content.iter_any():
+                buf.extend(chunk)
+                while True:
+                    nl = buf.find(b"\n")
+                    if nl < 0:
+                        break
+                    line = bytes(buf[:nl]).strip()
+                    del buf[: nl + 1]
+                    if not line:
+                        continue
+                    event = json.loads(line)
+                    if event.get("type") == "ERROR":
+                        status = event.get("object", {})
+                        raise ApiError(
+                            status.get("code", 500),
+                            str(status.get("message")),
+                        )
+                    yield event
+
     async def create(self, path: str, obj: dict):
         return await self._request("POST", path, obj)
 
@@ -153,3 +202,10 @@ class K8sClient:
 
     def crs(self, plural: str, name: str = "") -> str:
         return self._crd(plural, name)
+
+    def leases(self, name: str = "") -> str:
+        p = (
+            f"/apis/coordination.k8s.io/v1/namespaces/"
+            f"{self.namespace}/leases"
+        )
+        return f"{p}/{name}" if name else p

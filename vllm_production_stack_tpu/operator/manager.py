@@ -1,10 +1,13 @@
-"""Operator manager: periodic reconcile loops over the four CRDs.
+"""Operator manager: watch-driven reconcile with Lease leader election.
 
-The reference uses controller-runtime's watch-driven manager with leader
-election (operator/cmd/main.go:58-266); this manager polls CR lists on an
-interval — level-triggered reconciliation gives the same convergence
-guarantees at small-cluster scale without a watch cache, and keeps the
-operator runnable against any API server the minimal REST client can reach.
+The reference uses controller-runtime's informer caches + leader election
+(operator/cmd/main.go:58-266). Same shape here on the minimal REST client:
+per-kind list+watch loops (reconcile on every ADDED/MODIFIED event, re-list
+on 410 Gone), a Pod watch that re-reconciles LoraAdapters on readiness
+transitions, a periodic level-triggered resync as the convergence backstop,
+and coordination.k8s.io/v1 Lease leadership so replicas don't fight over
+patches — standbys block until the lease expires, and a leader that loses
+its lease stops reconciling and exits (pod restart returns it as a standby).
 
 Run (in-cluster): python -m vllm_production_stack_tpu.operator.manager
 """
@@ -13,6 +16,9 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import datetime
+import os
+import socket
 
 import aiohttp
 
@@ -23,17 +29,171 @@ from .controllers import (
     TPURouterReconciler,
     TPURuntimeReconciler,
 )
-from .k8s_client import K8sClient
+from .k8s_client import ApiError, K8sClient
 
 logger = init_logger(__name__)
 
 
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _micro_time(dt: datetime.datetime) -> str:
+    """Kubernetes MicroTime format."""
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z"
+
+
+def _parse_time(s: str | None) -> datetime.datetime | None:
+    if not s:
+        return None
+    try:
+        return datetime.datetime.fromisoformat(s.replace("Z", "+00:00"))
+    except ValueError:
+        return None
+
+
+class LostLeadership(Exception):
+    pass
+
+
+class LeaderElector:
+    """Lease-based leader election (the reference enables the
+    controller-runtime equivalent via --leader-elect, cmd/main.go)."""
+
+    def __init__(self, client: K8sClient, lease_name: str = "tpu-stack-operator",
+                 identity: str | None = None, lease_duration_s: float = 15.0):
+        self.c = client
+        self.lease_name = lease_name
+        self.identity = identity or f"{socket.gethostname()}_{os.getpid()}"
+        self.duration_s = lease_duration_s
+
+    def _fresh_lease(self) -> dict:
+        now = _micro_time(_now())
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.lease_name},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": max(1, int(self.duration_s)),
+                "acquireTime": now,
+                "renewTime": now,
+                "leaseTransitions": 0,
+            },
+        }
+
+    async def try_acquire(self) -> bool:
+        """One acquisition/renewal attempt. True iff we hold the lease
+        afterwards. Conflicts (another replica raced us) return False."""
+        path = self.c.leases(self.lease_name)
+        try:
+            lease = await self.c.get(path)
+        except ApiError:
+            return False
+        if lease is None:
+            try:
+                await self.c.create(self.c.leases(), self._fresh_lease())
+                return True
+            except ApiError:
+                return False  # another replica created it first
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        renew = _parse_time(spec.get("renewTime"))
+        duration = spec.get("leaseDurationSeconds", int(self.duration_s))
+        expired = renew is None or (
+            (_now() - renew).total_seconds() > duration
+        )
+        if holder != self.identity and not expired:
+            return False  # live leader elsewhere
+        spec = {
+            **spec,
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": max(1, int(self.duration_s)),
+            "renewTime": _micro_time(_now()),
+        }
+        if holder != self.identity:
+            spec["acquireTime"] = spec["renewTime"]
+            spec["leaseTransitions"] = spec.get("leaseTransitions", 0) + 1
+        lease["spec"] = spec
+        try:
+            await self.c.replace(path, lease)
+            return True
+        except ApiError:
+            return False  # resourceVersion conflict: raced another replica
+
+    async def acquire(self, poll_s: float | None = None) -> None:
+        """Block until this replica is the leader."""
+        poll = poll_s if poll_s is not None else self.duration_s / 3
+        while not await self.try_acquire():
+            await asyncio.sleep(poll)
+        logger.info("leadership acquired by %s", self.identity)
+
+    async def renew_loop(self) -> None:
+        """Renew forever; raises LostLeadership only when the lease is
+        DEMONSTRABLY gone — another holder took it, or our last successful
+        renewal is older than the lease duration. Transient apiserver
+        errors within the lease window just retry (controller-runtime
+        semantics: abdicating early creates an avoidable leaderless
+        window)."""
+        import time
+
+        last_renew = time.monotonic()
+        while True:
+            await asyncio.sleep(self.duration_s / 3)
+            if await self.try_acquire():
+                last_renew = time.monotonic()
+                continue
+            try:
+                lease = await self.c.get(self.c.leases(self.lease_name))
+                holder = (lease or {}).get("spec", {}).get("holderIdentity")
+                if holder and holder != self.identity:
+                    raise LostLeadership(self.identity)  # usurped
+            except ApiError:
+                pass  # apiserver unavailable: fall through to the deadline
+            if time.monotonic() - last_renew > self.duration_s:
+                raise LostLeadership(self.identity)
+
+
 class OperatorManager:
-    def __init__(self, client: K8sClient, engine_port: int = 8000):
+    def __init__(self, client: K8sClient, engine_port: int = 8000,
+                 resync_s: float = 300.0):
         self.c = client
         self._engine_port = engine_port
+        self.resync_s = resync_s
         self._http: aiohttp.ClientSession | None = None
         self._reconcilers: list | None = None
+        self.is_leader = False
+        self.reconcile_total = 0
+        self.reconcile_errors = 0
+
+    def build_health_app(self):
+        """/healthz, /readyz (ready = leading), /metrics — the reference
+        manager's probe + metrics surface (cmd/main.go:58-266)."""
+        from aiohttp import web
+
+        async def healthz(request):
+            return web.json_response({"status": "ok"})
+
+        async def readyz(request):
+            if self.is_leader:
+                return web.json_response({"status": "leading"})
+            return web.json_response({"status": "standby"}, status=503)
+
+        async def metrics(request):
+            return web.Response(text=(
+                "# TYPE tpu_operator_reconcile_total counter\n"
+                f"tpu_operator_reconcile_total {self.reconcile_total}\n"
+                "# TYPE tpu_operator_reconcile_errors_total counter\n"
+                f"tpu_operator_reconcile_errors_total {self.reconcile_errors}\n"
+                "# TYPE tpu_operator_is_leader gauge\n"
+                f"tpu_operator_is_leader {int(self.is_leader)}\n"
+            ))
+
+        app = web.Application()
+        app.router.add_get("/healthz", healthz)
+        app.router.add_get("/readyz", readyz)
+        app.router.add_get("/metrics", metrics)
+        return app
 
     @property
     def http(self) -> aiohttp.ClientSession:
@@ -56,9 +216,23 @@ class OperatorManager:
             ]
         return self._reconcilers
 
+    async def _reconcile_one(self, rec, cr: dict) -> bool:
+        try:
+            await rec.reconcile(cr)
+            self.reconcile_total += 1
+            return True
+        except Exception:
+            self.reconcile_errors += 1
+            logger.exception(
+                "reconcile %s/%s failed", rec.plural,
+                cr.get("metadata", {}).get("name"),
+            )
+            return False
+
     async def reconcile_all(self) -> int:
-        """One pass over every CR of every kind; returns CRs reconciled.
-        Errors are per-CR: one bad object must not wedge the others."""
+        """One level-triggered pass over every CR of every kind; returns CRs
+        reconciled. Errors are per-CR: one bad object must not wedge the
+        others."""
         n = 0
         for rec in self.reconcilers:
             try:
@@ -67,23 +241,129 @@ class OperatorManager:
                 logger.warning("listing %s failed: %s", rec.plural, e)
                 continue
             for cr in crs:
-                try:
-                    await rec.reconcile(cr)
+                if await self._reconcile_one(rec, cr):
                     n += 1
-                except Exception:
-                    logger.exception(
-                        "reconcile %s/%s failed", rec.plural,
-                        cr["metadata"]["name"],
-                    )
         return n
 
-    async def run(self, interval_s: float = 10.0) -> None:
-        logger.info("operator manager started (interval %.0fs)", interval_s)
+    # -- watch loops -------------------------------------------------------
+
+    async def watch_kind(self, rec) -> None:
+        """list+watch one CR kind forever: reconcile everything once, then
+        reconcile each object as events arrive. 410 Gone or a dropped
+        connection restarts from a fresh list (informer semantics)."""
+        path = self.c.crs(rec.plural)
+        while True:
+            try:
+                listing = await self.c.list_raw(path)
+                rv = listing.get("metadata", {}).get("resourceVersion")
+                for cr in listing.get("items", []):
+                    await self._reconcile_one(rec, cr)
+                async for event in self.c.watch(path, resource_version=rv):
+                    etype = event.get("type")
+                    obj = event.get("object", {})
+                    rv = obj.get("metadata", {}).get("resourceVersion", rv)
+                    if etype == "BOOKMARK":
+                        continue
+                    if etype in ("ADDED", "MODIFIED"):
+                        await self._reconcile_one(rec, obj)
+                    # DELETED needs no action for owned resources (GC via
+                    # ownerReferences); LoraAdapter deletes arrive as
+                    # MODIFIED with deletionTimestamp (finalizer) first
+            except asyncio.CancelledError:
+                raise
+            except ApiError as e:
+                if e.status != 410:  # 410 Gone: just re-list
+                    logger.warning("watch %s error: %s", rec.plural, e)
+                    await asyncio.sleep(1.0)
+            except Exception as e:
+                logger.warning("watch %s dropped: %s", rec.plural, e)
+                await asyncio.sleep(1.0)
+
+    @staticmethod
+    def _pod_lora_state(pod: dict):
+        """The tuple whose change makes a pod event LoRA-relevant: only
+        model-labeled engine pods, only readiness/address transitions —
+        status heartbeats and unrelated pods must not fan out into
+        adapter reconciles (reference filters its Pod watch the same way,
+        loraadapter_controller.go:235-275)."""
+        if "model" not in pod.get("metadata", {}).get("labels", {}):
+            return None
+        conds = {
+            c.get("type"): c.get("status")
+            for c in pod.get("status", {}).get("conditions", [])
+        }
+        return (
+            conds.get("Ready") == "True",
+            pod.get("status", {}).get("podIP"),
+        )
+
+    async def watch_pods(self) -> None:
+        """Pod readiness transitions re-trigger LoraAdapter reconciles."""
+        lora = self.reconcilers[-1]
+        path = self.c.pods()
+        seen: dict[str, tuple] = {}
+        while True:
+            try:
+                listing = await self.c.list_raw(path)
+                rv = listing.get("metadata", {}).get("resourceVersion")
+                seen = {
+                    p["metadata"]["name"]: st
+                    for p in listing.get("items", [])
+                    if (st := self._pod_lora_state(p)) is not None
+                }
+                async for event in self.c.watch(path, resource_version=rv):
+                    etype = event.get("type")
+                    pod = event.get("object", {})
+                    name = pod.get("metadata", {}).get("name")
+                    if etype == "DELETED":
+                        relevant = seen.pop(name, None) is not None
+                    elif etype in ("ADDED", "MODIFIED"):
+                        state = self._pod_lora_state(pod)
+                        relevant = state is not None and \
+                            seen.get(name) != state
+                        if state is not None:
+                            seen[name] = state
+                    else:
+                        relevant = False
+                    if not relevant:
+                        continue
+                    for cr in await self.c.list(self.c.crs(lora.plural)):
+                        await self._reconcile_one(lora, cr)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.warning("pod watch dropped: %s", e)
+                await asyncio.sleep(1.0)
+
+    async def resync_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.resync_s)
+            await self.reconcile_all()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def run(self, elector: LeaderElector | None = None) -> None:
+        """Acquire leadership, then run all watch loops until leadership is
+        lost (raises LostLeadership) or cancelled."""
+        if elector is None:
+            elector = LeaderElector(self.c)
+        await elector.acquire()
+        self.is_leader = True
+        tasks = [
+            asyncio.create_task(self.watch_kind(rec))
+            for rec in self.reconcilers
+        ]
+        tasks.append(asyncio.create_task(self.watch_pods()))
+        tasks.append(asyncio.create_task(self.resync_loop()))
+        renew = asyncio.create_task(elector.renew_loop())
         try:
-            while True:
-                await self.reconcile_all()
-                await asyncio.sleep(interval_s)
+            await renew  # raises LostLeadership (or CancelledError)
         finally:
+            self.is_leader = False
+            renew.cancel()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
             await self.close()
 
     async def close(self) -> None:
@@ -94,18 +374,47 @@ class OperatorManager:
 
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(description="TPU stack operator")
-    p.add_argument("--interval", type=float, default=10.0)
     p.add_argument("--engine-port", type=int, default=8000)
+    p.add_argument("--resync", type=float, default=300.0,
+                   help="level-triggered full-resync interval (s)")
+    p.add_argument("--lease-name", default="tpu-stack-operator")
+    p.add_argument("--lease-duration", type=float, default=15.0)
     p.add_argument("--api-server", default=None,
                    help="API server URL (default: in-cluster config)")
     p.add_argument("--namespace", default="default")
+    p.add_argument("--health-port", type=int, default=8081,
+                   help="/healthz /readyz /metrics port (0 disables)")
     args = p.parse_args(argv)
     client = (
         K8sClient(args.api_server, namespace=args.namespace)
         if args.api_server
         else K8sClient()
     )
-    asyncio.run(OperatorManager(client, args.engine_port).run(args.interval))
+    mgr = OperatorManager(client, args.engine_port, resync_s=args.resync)
+    elector = LeaderElector(
+        client, lease_name=args.lease_name,
+        lease_duration_s=args.lease_duration,
+    )
+
+    async def amain():
+        runner = None
+        if args.health_port:
+            from aiohttp import web
+
+            runner = web.AppRunner(mgr.build_health_app())
+            await runner.setup()
+            await web.TCPSite(runner, "0.0.0.0", args.health_port).start()
+        try:
+            await mgr.run(elector)
+        finally:
+            if runner is not None:
+                await runner.cleanup()
+
+    try:
+        asyncio.run(amain())
+    except LostLeadership:
+        # exit non-zero: the Deployment restarts us as a standby
+        raise SystemExit(2)
 
 
 if __name__ == "__main__":
