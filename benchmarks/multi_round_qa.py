@@ -60,6 +60,29 @@ class WorkloadConfig:
     duration_s: float = 60.0
     enable_user_id: bool = False
     temperature: float = 0.0
+    # ShareGPT mode (reference data_preprocessing.py + run.sh --sharegpt):
+    # real conversation turns replace the synthetic filler questions
+    sharegpt_conversations: list[list[str]] | None = None
+
+
+def load_sharegpt(path: str) -> list[list[str]]:
+    """ShareGPT JSON -> per-conversation human turns. Accepts the common
+    dump format: [{"conversations": [{"from": "human"|"gpt", "value": ...},
+    ...]}, ...]; conversations with no human turns are dropped."""
+    with open(path) as f:
+        data = json.load(f)
+    out: list[list[str]] = []
+    for entry in data:
+        turns = [
+            t.get("value", "")
+            for t in entry.get("conversations", [])
+            if t.get("from") in ("human", "user") and t.get("value")
+        ]
+        if turns:
+            out.append(turns)
+    if not out:
+        raise ValueError(f"no usable conversations in {path}")
+    return out
 
 
 @dataclass
@@ -97,6 +120,15 @@ class UserSession:
         return self.round_idx >= self.cfg.num_rounds and not self.inflight
 
     def build_messages(self) -> list[dict]:
+        convs = self.cfg.sharegpt_conversations
+        if convs:
+            turns = convs[self.user_id % len(convs)]
+            q = turns[self.round_idx % len(turns)]
+            return [
+                {"role": "system", "content": self.system_prompt},
+                *self.history,
+                {"role": "user", "content": q},
+            ]
         q = (
             f"Question {self.round_idx} from user {self.user_id}: "
             + filler_text(16, seed=self.user_id * 97 + self.round_idx)
@@ -249,6 +281,7 @@ class UserSessionManager:
             return ttfts[int(p * (len(ttfts) - 1))] if ttfts else None
 
         return {
+            "target_qps": self.cfg.qps,
             "requests_completed": len(ok),
             "requests_failed": sum(1 for r in self.records if r.error),
             "qps": round(len(ok) / elapsed, 3) if elapsed else 0,
@@ -308,6 +341,10 @@ def main(argv=None) -> int:
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--enable-user-id", action="store_true",
                    help="send x-user-id (exercises session-sticky routing)")
+    p.add_argument("--sharegpt", default=None, metavar="FILE",
+                   help="ShareGPT JSON dump: real conversation turns "
+                        "replace the synthetic questions (reference "
+                        "data_preprocessing.py mode)")
     p.add_argument("--output", default="summary.csv")
     args = p.parse_args(argv)
     cfg = WorkloadConfig(
@@ -316,6 +353,9 @@ def main(argv=None) -> int:
         num_rounds=args.num_rounds, qps=args.qps, model=args.model,
         base_url=args.base_url.rstrip("/"), duration_s=args.duration,
         enable_user_id=args.enable_user_id, temperature=args.temperature,
+        sharegpt_conversations=(
+            load_sharegpt(args.sharegpt) if args.sharegpt else None
+        ),
     )
     summary, manager = asyncio.run(run_benchmark(cfg))
     manager.write_csv(args.output)

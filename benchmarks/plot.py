@@ -1,0 +1,76 @@
+"""Plot a QPS sweep (reference benchmarks/multi-round-qa/plot.py).
+
+Reads the per-QPS summary JSONs run.sh writes and draws the two headline
+curves: p50 TTFT vs offered QPS and generation throughput vs offered QPS.
+
+    python benchmarks/plot.py bench-results/ [-o bench-results/sweep.png]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_summaries(results_dir: str) -> list[dict]:
+    rows = []
+    for path in sorted(glob.glob(os.path.join(results_dir, "summary-qps*.json"))):
+        with open(path) as f:
+            rows.append(json.load(f))
+    rows.sort(key=lambda r: r.get("target_qps", 0))
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("results_dir")
+    p.add_argument("-o", "--output", default=None,
+                   help="output PNG (default: <results_dir>/sweep.png)")
+    args = p.parse_args(argv)
+    rows = load_summaries(args.results_dir)
+    if not rows:
+        print(f"no summary-qps*.json under {args.results_dir}",
+              file=sys.stderr)
+        return 1
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        # keep the data usable even without the plotting dep: emit one
+        # aggregate row per QPS (the reference's CSV summary role)
+        for r in rows:
+            print(json.dumps(r))
+        print("matplotlib unavailable; printed rows instead",
+              file=sys.stderr)
+        return 0
+
+    qps = [r.get("target_qps") for r in rows]
+    ttft = [r.get("p50_ttft_s") for r in rows]
+    tput = [r.get("gen_tok_per_s") for r in rows]
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(10, 4))
+    ax1.plot(qps, ttft, marker="o")
+    ax1.set_xlabel("offered QPS")
+    ax1.set_ylabel("p50 TTFT (s)")
+    ax1.set_title("TTFT vs load")
+    ax1.grid(True, alpha=0.3)
+    ax2.plot(qps, tput, marker="o", color="tab:green")
+    ax2.set_xlabel("offered QPS")
+    ax2.set_ylabel("generation throughput (tok/s)")
+    ax2.set_title("Throughput vs load")
+    ax2.grid(True, alpha=0.3)
+    fig.tight_layout()
+    out = args.output or os.path.join(args.results_dir, "sweep.png")
+    fig.savefig(out, dpi=120)
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

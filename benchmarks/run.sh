@@ -20,7 +20,13 @@ python benchmarks/multi_round_qa.py \
     --answer-len 100 --duration "${WARMUP_S:-60}" \
     --output "$OUT_DIR/warmup.csv"
 
-# QPS sweep (reference sweeps 0.1 -> 4.1)
+# QPS sweep (reference sweeps 0.1 -> 4.1). SHAREGPT=<file> switches the
+# question source to real ShareGPT conversation turns.
+SHAREGPT_ARG=""
+if [ -n "${SHAREGPT:-}" ]; then
+    SHAREGPT_ARG="--sharegpt $SHAREGPT"
+fi
+: > "$OUT_DIR/results.jsonl"
 for QPS in ${QPS_SWEEP:-0.5 1.0 2.0 4.0}; do
     echo "=== qps=$QPS ==="
     python benchmarks/multi_round_qa.py \
@@ -31,6 +37,12 @@ for QPS in ${QPS_SWEEP:-0.5 1.0 2.0 4.0}; do
         --user-info-len "${USER_LEN:-20000}" \
         --answer-len "${ANSWER_LEN:-100}" \
         --duration "${DURATION_S:-120}" \
+        $SHAREGPT_ARG \
         --output "$OUT_DIR/sweep-qps$QPS.csv" \
         | tee "$OUT_DIR/summary-qps$QPS.json"
+    # one aggregate row per QPS, machine-readable across the whole sweep
+    cat "$OUT_DIR/summary-qps$QPS.json" >> "$OUT_DIR/results.jsonl"
 done
+
+python benchmarks/plot.py "$OUT_DIR" || true
+echo "sweep complete: $OUT_DIR/results.jsonl"

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Install the Gateway-API inference-extension integration (reference:
+# src/gateway_inference_extension/install.sh): CRDs, the EPP + pool, the
+# model mappings, and the Gateway/HTTPRoute for the chosen data plane.
+#
+#   ./gateway/install.sh [kgateway|istio|gke]
+set -euo pipefail
+cd "$(dirname "$0")"
+
+PROVIDER="${1:-kgateway}"
+IE_VERSION="${IE_VERSION:-v0.3.0}"
+
+echo "== Gateway API inference extension CRDs ($IE_VERSION)"
+kubectl apply -f \
+  "https://github.com/kubernetes-sigs/gateway-api-inference-extension/releases/download/${IE_VERSION}/manifests.yaml"
+
+echo "== EPP + InferencePool"
+kubectl apply -f configs/inferencepool.yaml
+
+echo "== InferenceModels"
+kubectl apply -f configs/inferencemodel.yaml
+
+echo "== Gateway + HTTPRoute (provider: $PROVIDER)"
+case "$PROVIDER" in
+  kgateway) CLASS="kgateway" ;;
+  istio) CLASS="istio" ;;
+  gke) CLASS="gke-l7-regional-external-managed" ;;
+  *) echo "unknown provider $PROVIDER"; exit 1 ;;
+esac
+sed "s/gatewayClassName: kgateway/gatewayClassName: $CLASS/" \
+  configs/gateway.yaml | kubectl apply -f -
+
+echo "== Waiting for the gateway address"
+kubectl wait gateway/inference-gateway \
+  --for=condition=Programmed --timeout=300s || true
+kubectl get gateway inference-gateway
+echo "done. Try:"
+echo '  curl http://$GATEWAY_IP/v1/chat/completions -H "Content-Type: application/json" \'
+echo '    -d "{\"model\":\"llama-3-8b\",\"messages\":[{\"role\":\"user\",\"content\":\"hi\"}]}"'

@@ -82,3 +82,57 @@ def test_request_generator_against_router():
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert out["errors"] == 0
     assert out["achieved_qps"] > 10
+
+
+def test_sharegpt_mode_and_plot(tmp_path):
+    """--sharegpt replaces synthetic questions with real conversation turns,
+    and plot.py renders the sweep rows run.sh aggregates."""
+    sharegpt = tmp_path / "sharegpt.json"
+    sharegpt.write_text(json.dumps([
+        {"conversations": [
+            {"from": "human", "value": "What is the capital of France?"},
+            {"from": "gpt", "value": "Paris."},
+            {"from": "human", "value": "And of Italy?"},
+        ]},
+        {"conversations": [
+            {"from": "user", "value": "Write a haiku about TPUs."},
+        ]},
+        {"conversations": [
+            {"from": "gpt", "value": "no human turns here"},
+        ]},
+    ]))
+    out_csv = tmp_path / "out.csv"
+    proc, engines = _run_rig(lambda url: [
+        "benchmarks/multi_round_qa.py",
+        "--base-url", url, "--model", "fake-model",
+        "--num-users", "2", "--qps", "8", "--num-rounds", "2",
+        "--system-prompt-len", "32", "--answer-len", "8",
+        "--duration", "4", "--sharegpt", str(sharegpt),
+        "--output", str(out_csv),
+    ])
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["requests_completed"] > 0
+    assert summary["target_qps"] == 8.0
+    # the engines saw the ShareGPT turns, not synthetic filler
+    bodies = [
+        r["body"] for e in engines for r in e.seen_request_log
+        if "body" in r
+    ]
+    texts = json.dumps(bodies)
+    assert "capital of France" in texts or "haiku about TPUs" in texts
+
+    # plot.py consumes the per-QPS summaries run.sh writes
+    results = tmp_path / "results"
+    results.mkdir()
+    for qps, ttft in ((0.5, 0.2), (1.0, 0.35), (2.0, 0.9)):
+        (results / f"summary-qps{qps}.json").write_text(json.dumps({
+            "target_qps": qps, "p50_ttft_s": ttft,
+            "gen_tok_per_s": 1000 * qps,
+        }))
+    plot = subprocess.run(
+        [sys.executable, "benchmarks/plot.py", str(results)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert plot.returncode == 0, plot.stderr
+    assert (results / "sweep.png").exists() or "printed rows" in plot.stderr

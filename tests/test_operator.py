@@ -571,7 +571,14 @@ def test_leader_election_split_brain_and_takeover():
         lease = await client.get(client.leases("tpu-stack-operator"))
         assert lease["spec"]["holderIdentity"] == "replica-a"
 
-        await asyncio.sleep(1.3)  # let the lease expire
+        # b must observe the UNCHANGED record for a full lease duration
+        # before takeover (client-go semantics: local observation clock,
+        # never remote-timestamp vs local-wall-clock comparison — a skewed
+        # standby must not steal a live lease)
+        assert not await b.try_acquire()  # observes a's latest renewal
+        await asyncio.sleep(0.5)
+        assert not await b.try_acquire()  # not yet a full duration
+        await asyncio.sleep(0.8)  # record unchanged > leaseDuration
         assert await b.try_acquire()  # takeover
         lease = await client.get(client.leases("tpu-stack-operator"))
         assert lease["spec"]["holderIdentity"] == "replica-b"

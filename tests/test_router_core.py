@@ -359,3 +359,27 @@ def test_tracing_is_soft_dependency():
         ):
             assert init_otel() is False
     assert init_otel() is False  # unset endpoint
+
+
+def test_pii_analyzer_selection():
+    """Analyzer registry: regex works standalone; presidio is a soft dep
+    that fails with a CLEAR startup error when the package is absent
+    (never per-request); unknown names rejected."""
+    import pytest
+
+    from vllm_production_stack_tpu.router.pii import (
+        RegexAnalyzer,
+        make_analyzer,
+    )
+
+    assert isinstance(make_analyzer("regex"), RegexAnalyzer)
+    with pytest.raises(ValueError, match="unknown PII analyzer"):
+        make_analyzer("nope")
+    try:
+        import presidio_analyzer  # noqa: F401
+        has_presidio = True
+    except ImportError:
+        has_presidio = False
+    if not has_presidio:
+        with pytest.raises(RuntimeError, match="presidio-analyzer"):
+            make_analyzer("presidio")

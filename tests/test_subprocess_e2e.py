@@ -1,0 +1,177 @@
+"""Process-level e2e: router + fake engines as REAL separate processes.
+
+The reference's e2e drives a deployed router and asserts routing decisions
+by parsing its logs (tests/e2e/test-routing.py: roundrobin ≈ uniform,
+session 100% sticky). The in-process rig (test_router_e2e.py) can't catch
+lifecycle/port/signal bugs — this one crosses real process boundaries:
+subprocess spawn, TCP ports, SIGTERM shutdown, log files."""
+
+import json
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = __import__("pathlib").Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_http(url: str, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as resp:
+                if resp.status == 200:
+                    return
+        except Exception as e:
+            last = e
+        time.sleep(0.2)
+    raise TimeoutError(f"{url} not up: {last}")
+
+
+def _post_json(url: str, body: dict, headers: dict | None = None) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture
+def stack(tmp_path):
+    """2 fake engine processes + 1 router process; yields (router_url,
+    log_path, engine_urls, restart_router_fn)."""
+    procs: list[subprocess.Popen] = []
+    log_path = tmp_path / "router.log"
+
+    def spawn(args, log_file):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", *args],
+            cwd=REPO, stdout=log_file, stderr=subprocess.STDOUT,
+        )
+        procs.append(proc)
+        return proc
+
+    engine_ports = [_free_port(), _free_port()]
+    engine_logs = open(tmp_path / "engines.log", "w")
+    for port in engine_ports:
+        spawn(
+            ["vllm_production_stack_tpu.testing.fake_engine",
+             "--port", str(port), "--model", "fake-model",
+             "--tokens-per-sec", "5000"],
+            engine_logs,
+        )
+    engine_urls = [f"http://127.0.0.1:{p}" for p in engine_ports]
+    for u in engine_urls:
+        _wait_http(u + "/health")
+
+    router_port = _free_port()
+    router_log = open(log_path, "w")
+    router_proc_box = {}
+
+    def start_router(extra_args=()):
+        proc = spawn(
+            ["vllm_production_stack_tpu.router.app",
+             "--port", str(router_port),
+             "--static-backends", ",".join(engine_urls),
+             "--static-models", "fake-model;fake-model",
+             *extra_args],
+            router_log,
+        )
+        router_proc_box["proc"] = proc
+        _wait_http(f"http://127.0.0.1:{router_port}/health")
+        return proc
+
+    start_router()
+    try:
+        yield (
+            f"http://127.0.0.1:{router_port}", log_path, engine_urls,
+            start_router, router_proc_box,
+        )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        engine_logs.close()
+        router_log.close()
+
+
+def _routing_decisions(log_path) -> list[str]:
+    out = []
+    for line in log_path.read_text().splitlines():
+        if "Routing request" in line:
+            # "... Routing request <id> to <url> at <ts>"
+            out.append(line.split(" to ")[1].split(" at ")[0])
+    return out
+
+
+def test_roundrobin_distribution_across_processes(stack):
+    router_url, log_path, engine_urls, _, _ = stack
+    for i in range(12):
+        data = _post_json(router_url + "/v1/chat/completions", {
+            "model": "fake-model", "max_tokens": 4,
+            "messages": [{"role": "user", "content": f"hello {i}"}],
+        })
+        assert data["choices"][0]["message"]["content"]
+    # log-parsed decisions: uniform across both engine processes
+    time.sleep(0.3)
+    decisions = _routing_decisions(log_path)
+    assert len(decisions) == 12
+    counts = {u: decisions.count(u) for u in engine_urls}
+    assert counts == {engine_urls[0]: 6, engine_urls[1]: 6}, counts
+
+
+def test_graceful_sigterm_shutdown(stack):
+    """SIGTERM must shut the router down cleanly (K8s pod lifecycle) —
+    in-process rigs cannot test signal handling at all."""
+    router_url, _, _, _, box = stack
+    proc = box["proc"]
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=15)
+    assert proc.returncode in (0, -signal.SIGTERM)
+    # port released: a new router binds the same port and serves
+    with pytest.raises(Exception):
+        _post_json(router_url + "/v1/chat/completions", {"model": "x"})
+
+
+def test_session_stickiness_across_processes(stack):
+    """Session routing across real processes: restart the fixture's router
+    with the session policy, then assert (log-parsed) that each user's
+    requests all land on one engine (reference test-routing.py). This test
+    caught a real bug the in-process rig could not: urllib capitalizes
+    header names (X-User-Id), which broke a case-sensitive session-key
+    lookup."""
+    router_url, log_path, engine_urls, start_router, box = stack
+    box["proc"].terminate()
+    box["proc"].wait(timeout=15)
+    start_router(("--routing-logic", "session", "--session-key", "x-user-id"))
+    for user in ("alice", "bob", "carol"):
+        for i in range(4):
+            _post_json(
+                router_url + "/v1/chat/completions",
+                {"model": "fake-model", "max_tokens": 2,
+                 "messages": [{"role": "user", "content": f"q{i}"}]},
+                headers={"x-user-id": user},
+            )
+    time.sleep(0.3)
+    decisions = _routing_decisions(log_path)[-12:]
+    assert len(decisions) == 12
+    for u in range(3):  # 4 consecutive requests per user -> one engine
+        block = decisions[u * 4 : (u + 1) * 4]
+        assert len(set(block)) == 1, f"user {u} not sticky: {block}"

@@ -31,6 +31,13 @@ PRESETS: dict[str, dict] = {
         num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
         max_model_len=8192, rope_theta=500000.0,
     ),
+    # Llama-3.2-3B shape: the biggest bf16 preset that fits ONE v5e chip
+    # (≈6.0 GiB weights) with KV headroom — the north-star bench model
+    "llama-3b": dict(
+        vocab_size=128256, hidden_size=3072, intermediate_size=8192,
+        num_layers=28, num_heads=24, num_kv_heads=8, head_dim=128,
+        max_model_len=8192, rope_theta=500000.0, tie_word_embeddings=True,
+    ),
     "llama-3-8b": dict(
         vocab_size=128256, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,

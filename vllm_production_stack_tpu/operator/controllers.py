@@ -280,7 +280,12 @@ class LoraAdapterReconciler:
         if self.FINALIZER not in meta.get("finalizers", []):
             meta.setdefault("finalizers", []).append(self.FINALIZER)
             updated = await self.c.replace(self.c.crs(self.plural, name), cr)
-            cr = updated or cr
+            if updated is None:
+                # the CR vanished between our read and the finalizer PUT
+                # (deleted before any finalizer pinned it): loading now
+                # would leak an adapter no CR will ever unload
+                return
+            cr = updated
         spec = cr["spec"]
         adapter_name = spec["adapterSource"].get("adapterName") or name
         pods = await self._ready_pods(spec["baseModel"])

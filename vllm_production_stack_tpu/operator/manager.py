@@ -43,15 +43,6 @@ def _micro_time(dt: datetime.datetime) -> str:
     return dt.strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z"
 
 
-def _parse_time(s: str | None) -> datetime.datetime | None:
-    if not s:
-        return None
-    try:
-        return datetime.datetime.fromisoformat(s.replace("Z", "+00:00"))
-    except ValueError:
-        return None
-
-
 class LostLeadership(Exception):
     pass
 
@@ -66,6 +57,12 @@ class LeaderElector:
         self.lease_name = lease_name
         self.identity = identity or f"{socket.gethostname()}_{os.getpid()}"
         self.duration_s = lease_duration_s
+        # client-go semantics: expiry is timed from when THIS process last
+        # OBSERVED the lease record change (local monotonic clock) — never
+        # by comparing the holder's renewTime against our wall clock, which
+        # would let a skewed standby steal a live lease (split brain)
+        self._observed_record: str | None = None
+        self._observed_at: float = 0.0
 
     def _fresh_lease(self) -> dict:
         now = _micro_time(_now())
@@ -96,13 +93,20 @@ class LeaderElector:
                 return True
             except ApiError:
                 return False  # another replica created it first
+        import time
+
         spec = lease.get("spec", {})
         holder = spec.get("holderIdentity")
-        renew = _parse_time(spec.get("renewTime"))
         duration = spec.get("leaseDurationSeconds", int(self.duration_s))
-        expired = renew is None or (
-            (_now() - renew).total_seconds() > duration
-        )
+        record = f"{holder}|{spec.get('renewTime')}"
+        now_mono = time.monotonic()
+        if record != self._observed_record:
+            # the record moved: restart OUR expiry clock (a first sighting
+            # also lands here — a standby must watch an unchanged record
+            # for a full lease duration before calling it dead)
+            self._observed_record = record
+            self._observed_at = now_mono
+        expired = (now_mono - self._observed_at) > duration
         if holder != self.identity and not expired:
             return False  # live leader elsewhere
         spec = {

@@ -314,9 +314,11 @@ def build_app(args) -> web.Application:
             args.semantic_cache_dir, args.semantic_cache_threshold
         )
     if state.feature_gates.enabled("PIIDetection"):
-        from .pii import PIIMiddleware
+        from .pii import PIIMiddleware, make_analyzer
 
-        state.pii_middleware = PIIMiddleware()
+        state.pii_middleware = PIIMiddleware(
+            analyzer=make_analyzer(getattr(args, "pii_analyzer", "regex"))
+        )
 
     async def on_startup(app):
         await state.request_service.start()

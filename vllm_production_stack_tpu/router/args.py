@@ -84,6 +84,10 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--callbacks", default=None, help="module[:Class] or path.py")
     x.add_argument("--request-rewriter", default=None, help="module:Class")
     x.add_argument("--feature-gates", default="")
+    x.add_argument("--pii-analyzer", default="regex",
+                   choices=["regex", "presidio"],
+                   help="PII analyzer backend (presidio needs the "
+                        "presidio-analyzer package in the router image)")
     x.add_argument("--api-key", default=None, help="require this bearer token")
     x.add_argument("--sentry-dsn", default=None,
                    help="enable Sentry error reporting (requires sentry-sdk)")

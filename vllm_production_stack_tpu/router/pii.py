@@ -1,10 +1,12 @@
 """PII screening middleware (feature gate: PIIDetection).
 
 Blocks requests whose prompt text contains detectable PII, mirroring the
-reference's regex analyzer set (experimental/pii/analyzers/regex.py) — email,
-phone, SSN, credit card (Luhn-checked), IP address, API-key-shaped secrets.
-The Presidio analyzer path is not carried over (heavyweight optional dep);
-the analyzer interface keeps that door open."""
+reference's analyzer set (experimental/pii/analyzers/): the built-in regex
+analyzer — email, phone, SSN, credit card (Luhn-checked), IP address,
+API-key-shaped secrets — plus an optional Presidio-backed analyzer
+(reference analyzers/presidio.py) behind a soft import: NER-grade entity
+recognition when `presidio-analyzer` is installed in the router image,
+clean error if selected without it."""
 
 from __future__ import annotations
 
@@ -60,6 +62,50 @@ class RegexAnalyzer:
                     continue
                 found.append(PIIMatch(cat, m.span()))
         return found
+
+
+class PresidioAnalyzer:
+    """Microsoft Presidio NER analyzer (reference
+    experimental/pii/analyzers/presidio.py): statistical entity
+    recognition on top of what the regexes catch. Soft dependency — the
+    constructor raises a clear error when the package is absent so a
+    misconfigured deployment fails at startup, not per-request."""
+
+    def __init__(self, score_threshold: float = 0.5, language: str = "en"):
+        try:
+            from presidio_analyzer import AnalyzerEngine
+        except ImportError as e:
+            raise RuntimeError(
+                "--pii-analyzer presidio needs the presidio-analyzer "
+                "package in the router image (pip install "
+                "presidio-analyzer)"
+            ) from e
+        self._engine = AnalyzerEngine()
+        self.score_threshold = score_threshold
+        self.language = language
+
+    def analyze(self, text: str) -> list[PIIMatch]:
+        results = self._engine.analyze(text=text, language=self.language)
+        return [
+            PIIMatch(r.entity_type.lower(), (r.start, r.end))
+            for r in results
+            if r.score >= self.score_threshold
+        ]
+
+
+ANALYZERS = {
+    "regex": RegexAnalyzer,
+    "presidio": PresidioAnalyzer,
+}
+
+
+def make_analyzer(name: str):
+    if name not in ANALYZERS:
+        raise ValueError(
+            f"unknown PII analyzer {name!r}; expected one of "
+            f"{sorted(ANALYZERS)}"
+        )
+    return ANALYZERS[name]()
 
 
 class PIIMiddleware:

@@ -48,6 +48,20 @@ class RoutingContext:
     headers: dict[str, str] = field(default_factory=dict)
     body: dict = field(default_factory=dict)
 
+    def header(self, name: str) -> str | None:
+        """Case-insensitive header lookup. HTTP header names are
+        case-insensitive on the wire, and real clients vary the casing
+        (urllib capitalizes: X-User-Id) — an exact dict get would silently
+        break session stickiness for them."""
+        val = self.headers.get(name)
+        if val is not None:
+            return val
+        lname = name.lower()
+        for k, v in self.headers.items():
+            if k.lower() == lname:
+                return v
+        return None
+
     def prompt_text(self) -> str:
         """Routable text of the request: the completions prompt, or the chat
         messages' text parts joined (incl. multimodal text segments) — the
@@ -128,7 +142,7 @@ class SessionPolicy(RoutingPolicy):
 
     async def route(self, ctx: RoutingContext) -> str:
         self.ring.sync([e.url for e in ctx.endpoints])
-        session_id = ctx.headers.get(self.session_key)
+        session_id = ctx.header(self.session_key)
         if session_id is None:
             return qps_min_url(ctx.endpoints, ctx.request_stats)
         return self.ring.get_node(session_id)
