@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Create a GKE cluster with a TPU v5e node pool and install the stack
+# (reference: deployment_on_cloud/gcp — GPU clusters; this is the
+# TPU-native equivalent: google.com/tpu resources + TPU topology
+# node selectors instead of nvidia.com/gpu).
+#
+#   PROJECT=my-proj ZONE=us-west4-1 ./deploy/gke/create-cluster.sh
+set -euo pipefail
+
+PROJECT="${PROJECT:?set PROJECT}"
+ZONE="${ZONE:-us-west4-1}"
+CLUSTER="${CLUSTER:-tpu-stack}"
+TPU_TYPE="${TPU_TYPE:-tpu-v5-lite-podslice}"
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-1x1}"
+NUM_NODES="${NUM_NODES:-1}"
+VALUES="${VALUES:-helm/examples/values-01-minimal.yaml}"
+
+gcloud container clusters create "$CLUSTER" \
+  --project "$PROJECT" --zone "$ZONE" \
+  --release-channel rapid \
+  --num-nodes 1 --machine-type e2-standard-4
+
+gcloud container node-pools create tpu-pool \
+  --project "$PROJECT" --zone "$ZONE" --cluster "$CLUSTER" \
+  --machine-type ct5lp-hightpu-1t \
+  --tpu-topology "$TPU_TOPOLOGY" \
+  --num-nodes "$NUM_NODES"
+
+gcloud container clusters get-credentials "$CLUSTER" \
+  --project "$PROJECT" --zone "$ZONE"
+
+kubectl apply -f operator/crds/ || true
+helm install stack ./helm -f "$VALUES"
+
+echo "Stack installing. Watch: kubectl get pods -w"
+echo "Then: kubectl port-forward svc/stack-router 8000:80"

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Local kind cluster for CPU-only functional testing (reference:
+# utils/install-kind*.sh). Engines run with JAX_PLATFORMS=cpu.
+set -euo pipefail
+
+if ! command -v kind > /dev/null; then
+  echo "installing kind..."
+  GOBIN=/usr/local/bin go install sigs.k8s.io/kind@latest 2>/dev/null || {
+    curl -sLo /usr/local/bin/kind \
+      "https://kind.sigs.k8s.io/dl/latest/kind-linux-amd64"
+    chmod +x /usr/local/bin/kind
+  }
+fi
+
+kind create cluster --name tpu-stack
+docker build -t tpu-stack-engine:dev -f docker/Dockerfile .
+docker build -t tpu-stack-router:dev -f docker/Dockerfile.router .
+kind load docker-image tpu-stack-engine:dev --name tpu-stack
+kind load docker-image tpu-stack-router:dev --name tpu-stack
+
+helm install stack ./helm -f helm/examples/values-01-minimal.yaml \
+  --set 'servingEngineSpec.modelSpec[0].repository=tpu-stack-engine' \
+  --set 'servingEngineSpec.modelSpec[0].tag=dev' \
+  --set 'servingEngineSpec.modelSpec[0].requestTPU=0' \
+  --set 'servingEngineSpec.modelSpec[0].requestCPU=1' \
+  --set 'servingEngineSpec.modelSpec[0].requestMemory=2Gi' \
+  --set 'servingEngineSpec.modelSpec[0].env[0].name=JAX_PLATFORMS' \
+  --set 'servingEngineSpec.modelSpec[0].env[0].value=cpu' \
+  --set 'routerSpec.repository=tpu-stack-router' \
+  --set 'routerSpec.tag=dev'
+
+kubectl get pods

@@ -174,3 +174,37 @@ def test_hist_kernel_matches_xla_reference(step_k):
         q[:, None], hk, hv, hist_mask, sk, sv, staged_mask, scale=scale
     )[:, 0]
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_auto_backend_policy_gates():
+    """'auto' picks the measured winner — every gate of the pure predicate
+    covered directly (the sweep's decision table), plus the runner wiring
+    on this (CPU) platform."""
+    from vllm_production_stack_tpu.engine.model_runner import (
+        resolve_auto_attention_backend as auto,
+    )
+
+    base = dict(block_size=32, max_model_len=8192, mesh_size=1,
+                kv_quantized=False, platform="tpu")
+    assert auto(**base) == "pallas"  # the winning regime
+    assert auto(**{**base, "block_size": 16}) == "xla"  # small pages
+    assert auto(**{**base, "max_model_len": 2048}) == "xla"  # short ctx
+    assert auto(**{**base, "mesh_size": 2}) == "xla"  # no GSPMD rule
+    assert auto(**{**base, "kv_quantized": True}) == "xla"  # fp8 pool
+    assert auto(**{**base, "platform": "cpu"}) == "xla"  # needs Mosaic
+
+    # runner wiring: on the CPU test platform auto must resolve to xla
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.model_runner import ModelRunner
+
+    r = ModelRunner(EngineConfig(
+        model=ModelConfig.tiny(),
+        cache=CacheConfig(block_size=32, num_blocks=32),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, max_num_batched_tokens=64,
+            decode_buckets=(2,), prefill_buckets=(64,),
+        ),
+    ))
+    assert r._attention_backend == "xla"

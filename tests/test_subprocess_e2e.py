@@ -138,16 +138,25 @@ def test_roundrobin_distribution_across_processes(stack):
 
 
 def test_graceful_sigterm_shutdown(stack):
-    """SIGTERM must shut the router down cleanly (K8s pod lifecycle) —
+    """SIGTERM must shut the router down promptly AND release its port so
+    a replacement binds and serves (K8s pod replacement lifecycle) —
     in-process rigs cannot test signal handling at all."""
-    router_url, _, _, _, box = stack
+    router_url, _, _, start_router, box = stack
     proc = box["proc"]
     proc.send_signal(signal.SIGTERM)
     proc.wait(timeout=15)
     assert proc.returncode in (0, -signal.SIGTERM)
-    # port released: a new router binds the same port and serves
     with pytest.raises(Exception):
         _post_json(router_url + "/v1/chat/completions", {"model": "x"})
+    # the real assertion: the port is RELEASED — a replacement router
+    # binds the same port and serves traffic (a leaked listener or
+    # half-dead process would fail the bind or the request)
+    start_router()
+    data = _post_json(router_url + "/v1/chat/completions", {
+        "model": "fake-model", "max_tokens": 2,
+        "messages": [{"role": "user", "content": "post-restart"}],
+    })
+    assert data["choices"][0]["message"]["content"]
 
 
 def test_session_stickiness_across_processes(stack):

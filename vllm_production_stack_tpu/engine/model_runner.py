@@ -46,6 +46,28 @@ logger = logging.getLogger(__name__)
 LOGPROBS_TOPN = 8
 
 
+def resolve_auto_attention_backend(
+    *, block_size: int, max_model_len: int, mesh_size: int,
+    kv_quantized: bool, platform: str,
+) -> str:
+    """The 'auto' decode-attention choice as a pure predicate of the
+    static engine config (testable without a device). Derived from the
+    v5e sweep in ModelRunner._resolve_attention_backend: the Pallas kernel
+    wins at >=32-token pages in the LONG-context regime (ctx ~4k: -7% to
+    -19%) and loses or ties at ~1k contexts, so it is selected only for
+    engines configured for long contexts. Single-device unquantized pools
+    on a real TPU only (no GSPMD partition rule; Mosaic-compiled)."""
+    if (
+        block_size >= 32
+        and max_model_len >= 4096
+        and mesh_size == 1
+        and not kv_quantized
+        and platform == "tpu"
+    ):
+        return "pallas"
+    return "xla"
+
+
 def _collect_logprobs(logits: jax.Array, tokens: jax.Array):
     """(chosen_lp (S,), top_lp (S, N), top_id (S, N)) from (S, V) logits."""
     lp = jax.nn.log_softmax(logits, axis=-1)
@@ -200,17 +222,43 @@ class ModelRunner:
         self._embed_fn = None
 
     def _resolve_attention_backend(self) -> str:
-        """'auto' → XLA staged attention. Measured on a v5e chip (llama-1b
-        bf16, b=16, window=64): XLA 744 ms/window-dispatch vs Pallas 1065 ms
-        at ctx≈900, 679 vs 726 at ctx≈250 — the kernel's per-page pipeline
-        (16 KB DMAs, 16-token matmuls) loses to XLA's bulk gather at 16-token
-        pages; it becomes competitive with larger block_size. 'pallas' stays
-        opt-in (single-device only: GSPMD has no partition rule for
-        pallas_call; wrap in shard_map before enabling under tp>1), and CPU
-        tests pin its numerics via interpret mode."""
+        """'auto' → the measured winner for the pool's block size.
+
+        Swept on a v5e chip (benchmarks/sweep_attention.py, llama-1b decode
+        head shape, 64-iteration on-device loops, ms/iter):
+
+            batch ctx   block   pallas   xla     winner
+            16    1024  16      1.93     1.63    xla
+            16    1024  32      1.73     1.58    xla
+            16    1024  64      1.63     1.57    xla (±4%)
+            16    4096  16      3.40     2.72    xla
+            16    4096  32      2.71     2.93    pallas
+            16    4096  64      2.48     2.50    pallas (±1%)
+            64    1024  16      3.48     3.24    xla
+            64    1024  64      2.57     2.73    pallas
+            64    4096  64      4.68     5.80    pallas (-19%)
+
+        At 16-token pages the kernel's per-page pipeline (16 KB DMAs,
+        16-token matmuls) loses to XLA's bulk gather — XLA is 'auto' there
+        (the shipped default config). At 32/64-token pages the winner
+        flips with context: XLA still edges ~1k contexts, the kernel wins
+        the 4k rows — so 'auto' requires BOTH block_size >= 32 and a
+        long-context engine (max_model_len >= 4096), single device,
+        unquantized (resolve_auto_attention_backend — the pure predicate
+        tests pin). The kernel also never materializes the O(B×S) gather
+        scratch that OOMs large models (bench_northstar.py's llama-3b
+        finding). Explicit 'pallas' stays single-device-only (no GSPMD
+        partition rule for pallas_call; wrap in shard_map before enabling
+        under tp>1); CPU tests pin numerics via interpret mode."""
         backend = self.config.attention_backend
         if backend == "auto":
-            return "xla"
+            return resolve_auto_attention_backend(
+                block_size=self.config.cache.block_size,
+                max_model_len=self.config.model.max_model_len,
+                mesh_size=self.mesh.size,
+                kv_quantized=self._kv_dtype != self.config.model.dtype,
+                platform=jax.devices()[0].platform,
+            )
         if backend not in ("xla", "pallas", "pallas_interpret"):
             raise ValueError(
                 f"unknown attention_backend {backend!r}; expected one of "

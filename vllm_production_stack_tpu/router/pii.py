@@ -130,7 +130,15 @@ class PIIMiddleware:
             texts.append(p)
         elif isinstance(p, list):
             texts.extend(str(x) for x in p)
-        matches = self.analyzer.analyze("\n".join(texts))
+        import asyncio
+
+        # off the event loop: Presidio's NER pass is tens-to-hundreds of
+        # ms of CPU-bound work per request (regex is cheap, but large
+        # prompts aren't free either) — running it inline would stall
+        # every in-flight stream
+        matches = await asyncio.get_running_loop().run_in_executor(
+            None, self.analyzer.analyze, "\n".join(texts)
+        )
         if not matches:
             return None
         self.blocked_total += 1
