@@ -62,7 +62,7 @@ def time_fn(fn, q, *args) -> float:
 
 def bench_config(
     batch: int, ctx: int, block_size: int, nh: int, kvh: int, d: int,
-    window: int = 16, dtype=jnp.bfloat16, iters: int = 20,
+    window: int = 16, dtype=jnp.bfloat16,
 ) -> dict:
     from vllm_production_stack_tpu.ops.attention import (
         paged_attention_with_staged,
@@ -124,11 +124,8 @@ def main() -> None:
     ]
     if not args.quick:
         configs += [(64, 1024, 16), (64, 1024, 64), (64, 4096, 64)]
-    rows = []
     for batch, ctx, bs in configs:
-        row = bench_config(batch, ctx, bs, nh, kvh, d)
-        rows.append(row)
-        print(json.dumps(row), flush=True)
+        print(json.dumps(bench_config(batch, ctx, bs, nh, kvh, d)), flush=True)
 
 
 if __name__ == "__main__":

@@ -4,13 +4,12 @@
 # TPU-native equivalent: google.com/tpu resources + TPU topology
 # node selectors instead of nvidia.com/gpu).
 #
-#   PROJECT=my-proj ZONE=us-west4-1 ./deploy/gke/create-cluster.sh
+#   PROJECT=my-proj ZONE=us-west4-a ./deploy/gke/create-cluster.sh
 set -euo pipefail
 
 PROJECT="${PROJECT:?set PROJECT}"
-ZONE="${ZONE:-us-west4-1}"
+ZONE="${ZONE:-us-west4-a}"
 CLUSTER="${CLUSTER:-tpu-stack}"
-TPU_TYPE="${TPU_TYPE:-tpu-v5-lite-podslice}"
 TPU_TOPOLOGY="${TPU_TOPOLOGY:-1x1}"
 NUM_NODES="${NUM_NODES:-1}"
 VALUES="${VALUES:-helm/examples/values-01-minimal.yaml}"
@@ -22,7 +21,7 @@ gcloud container clusters create "$CLUSTER" \
 
 gcloud container node-pools create tpu-pool \
   --project "$PROJECT" --zone "$ZONE" --cluster "$CLUSTER" \
-  --machine-type ct5lp-hightpu-1t \
+  --machine-type "${MACHINE_TYPE:-ct5lp-hightpu-1t}" \
   --tpu-topology "$TPU_TOPOLOGY" \
   --num-nodes "$NUM_NODES"
 

@@ -5,9 +5,11 @@ set -euo pipefail
 
 if ! command -v kind > /dev/null; then
   echo "installing kind..."
-  GOBIN=/usr/local/bin go install sigs.k8s.io/kind@latest 2>/dev/null || {
-    curl -sLo /usr/local/bin/kind \
-      "https://kind.sigs.k8s.io/dl/latest/kind-linux-amd64"
+  KIND_VERSION="${KIND_VERSION:-v0.23.0}"
+  GOBIN=/usr/local/bin go install "sigs.k8s.io/kind@${KIND_VERSION}" 2>/dev/null || {
+    # -f: fail on HTTP errors instead of installing an error page as a binary
+    curl -fsLo /usr/local/bin/kind \
+      "https://kind.sigs.k8s.io/dl/${KIND_VERSION}/kind-linux-amd64"
     chmod +x /usr/local/bin/kind
   }
 fi

@@ -208,3 +208,37 @@ def test_auto_backend_policy_gates():
         ),
     ))
     assert r._attention_backend == "xla"
+
+
+def test_pallas_fp8_pool_numerics():
+    """fp8 KV pool through the Pallas kernel: same greedy outputs as the
+    XLA backend over the same fp8 pool (both upconvert pages to the
+    compute dtype — the kernel in VMEM, XLA in the gather)."""
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    def make(backend):
+        return LLMEngine(EngineConfig(
+            model=ModelConfig.tiny(max_model_len=512),
+            cache=CacheConfig(block_size=32, num_blocks=64,
+                              kv_cache_dtype="fp8"),
+            scheduler=SchedulerConfig(
+                max_num_seqs=2, max_num_batched_tokens=128,
+                prefill_buckets=(64, 128), decode_buckets=(2,),
+                decode_window=4,
+            ),
+            attention_backend=backend,
+        ))
+
+    rng = np.random.RandomState(9)
+    prompts = [list(rng.randint(1, 500, size=90)) for _ in range(2)]
+    sp = SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+    out_pallas = make("pallas_interpret").generate(prompts, sp)
+    out_xla = make("xla").generate(prompts, sp)
+    for i in range(2):
+        assert out_pallas[i]["token_ids"] == out_xla[i]["token_ids"]

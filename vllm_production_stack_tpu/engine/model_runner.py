@@ -54,9 +54,12 @@ def resolve_auto_attention_backend(
     static engine config (testable without a device). Derived from the
     v5e sweep in ModelRunner._resolve_attention_backend: the Pallas kernel
     wins at >=32-token pages in the LONG-context regime (ctx ~4k: -7% to
-    -19%) and loses or ties at ~1k contexts, so it is selected only for
-    engines configured for long contexts. Single-device unquantized pools
-    on a real TPU only (no GSPMD partition rule; Mosaic-compiled)."""
+    -19%); at ~1k contexts the outcome is batch-dependent (XLA wins at
+    batch 16, the kernel edges batch 64/block 64 by ~6%) — the gate keys
+    on max_model_len because batch varies at runtime while the program is
+    compiled per config, a deliberately conservative trade. Single-device
+    unquantized pools on a real TPU only for 'auto' (no GSPMD partition
+    rule; Mosaic-compiled); explicit 'pallas' also supports fp8 pools."""
     if (
         block_size >= 32
         and max_model_len >= 4096
@@ -269,14 +272,10 @@ class ModelRunner:
                 "attention_backend='pallas' supports single-device meshes "
                 "only (no GSPMD partition rule for pallas_call)"
             )
-        if backend.startswith("pallas") and self._kv_dtype != (
-            self.config.model.dtype
-        ):
-            raise ValueError(
-                "attention_backend='pallas' does not support a quantized KV "
-                f"cache (kv_cache_dtype={self.config.cache.kv_cache_dtype}); "
-                "use the XLA backend"
-            )
+        # quantized (fp8) pools are supported: the kernel casts pages to
+        # f32 as they stream into VMEM (Mosaic handles f8e4m3 loads on
+        # v5e), same upconvert the XLA path does — pinned by
+        # tests/test_pallas_attention.py::test_pallas_fp8_pool_numerics
         return backend
 
     def _compute_hoist_budget(self) -> int:

@@ -112,6 +112,14 @@ class PIIMiddleware:
     def __init__(self, analyzer=None):
         self.analyzer = analyzer or RegexAnalyzer()
         self.blocked_total = 0
+        # ONE worker: offloading keeps the event loop free, but Presidio's
+        # shared spaCy pipeline is not safe for concurrent calls — a
+        # single-thread executor serializes analysis without blocking I/O
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pii-analyzer"
+        )
 
     async def check(self, request: web.Request) -> web.Response | None:
         """Returns a 400 response when PII is found, else None."""
@@ -137,7 +145,7 @@ class PIIMiddleware:
         # prompts aren't free either) — running it inline would stall
         # every in-flight stream
         matches = await asyncio.get_running_loop().run_in_executor(
-            None, self.analyzer.analyze, "\n".join(texts)
+            self._executor, self.analyzer.analyze, "\n".join(texts)
         )
         if not matches:
             return None
