@@ -53,6 +53,12 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--host-kv-gib"
 - {{ .hostKvGib | quote }}
 {{- end }}
+{{- if .diskKvGib }}
+- "--disk-kv-dir"
+- {{ .diskKvDir | default "/data/kv-cache" | quote }}
+- "--disk-kv-gib"
+- {{ .diskKvGib | quote }}
+{{- end }}
 {{- if .maxLoras }}
 - "--max-loras"
 - {{ .maxLoras | quote }}

@@ -381,7 +381,8 @@ def test_engine_args_parse_with_real_engine_argparse():
             "enablePrefixCaching": False, "extraArgs": ["--seed", "7"],
         },
         "kvConfig": {
-            "hostKvGib": 8.5, "remoteKvUrl": "tpukv://kvc-kv-store:9200",
+            "hostKvGib": 8.5, "diskKvDir": "/data/kv", "diskKvGib": 50,
+            "remoteKvUrl": "tpukv://kvc-kv-store:9200",
         },
     }
     argv = engine_args(spec)
@@ -395,6 +396,8 @@ def test_engine_args_parse_with_real_engine_argparse():
     assert ns.enable_prefix_caching is False
     assert ns.seed == 7
     assert ns.host_kv_gib == 8.5
+    assert ns.disk_kv_dir == "/data/kv"
+    assert ns.disk_kv_gib == 50.0
     assert ns.remote_kv_url == "tpukv://kvc-kv-store:9200"
 
 

@@ -122,6 +122,16 @@ class LLMEngine:
             # the remote tier stages through the host ring; give it a
             # minimal ring even when CPU offload wasn't asked for
             num_host_blocks = max(num_host_blocks, 16)
+        disk_tier = None
+        if config.cache.disk_kv_dir and config.cache.disk_kv_gib > 0:
+            from .kv_disk_tier import DiskKVTier
+
+            disk_tier = DiskKVTier(
+                config.cache.disk_kv_dir,
+                int(config.cache.disk_kv_gib * 2**30),
+                fingerprint=self.model_fingerprint,
+            )
+            num_host_blocks = max(num_host_blocks, 16)
         if num_host_blocks > 0:
             from .kv_host_tier import HostKVTier
 
@@ -131,6 +141,7 @@ class LLMEngine:
                 self.runner.upload_block,
                 remote=self.remote_tier,
                 upload_blocks=self.runner.upload_blocks,
+                disk=disk_tier,
             )
         self.scheduler = Scheduler(
             config.model, config.cache, config.scheduler,

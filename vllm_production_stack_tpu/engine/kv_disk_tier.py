@@ -1,0 +1,144 @@
+"""Local-disk KV tier: the LMCache disk-offload equivalent.
+
+Middle rung of the KV hierarchy (HBM → host ring → THIS → remote store):
+blocks evicted off the host RAM ring persist to a local directory (one
+.npy per content hash) under a byte budget, so a working set larger than
+RAM still reloads from NVMe instead of recomputing — and survives engine
+restarts, since content-hashed bytes stay valid as long as the model
+fingerprint matches (reference: `LMCACHE_LOCAL_DISK` /
+`LMCACHE_MAX_LOCAL_DISK_SIZE`, vllmruntime_controller.go:337-374).
+
+Writes happen at ring-eviction time on the engine thread; one block is
+~0.1-2 MB, well under a millisecond on local SSD — cheap next to the
+device round trips the eviction path already pays. Loads are one np.load
+on the prefix-match path, each saving an entire chunk of prefill compute.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class DiskTierStats:
+    stores: int = 0
+    loads: int = 0
+    evictions: int = 0
+
+
+class DiskKVTier:
+    """Byte-budget LRU of KV blocks as `.kvb` files keyed by content hash.
+
+    Files use the stack's shared block-frame format (kv_transfer.raw_frame
+    / FrameParser — 4-byte header length, JSON header, raw bytes), NOT
+    np.save: numpy's format silently degrades ml_dtypes arrays (bfloat16 →
+    '|V2', float8_e4m3fn → '|V1' void dtypes), which would crash the
+    device upload for every production pool dtype. Writes are atomic
+    (temp + rename) so a crash mid-store can never leave a half-written
+    block that wedges the index.
+
+    The fingerprint namespaces the directory — a model/dtype change gets a
+    fresh subdirectory instead of silently serving stale KV bytes."""
+
+    SUFFIX = ".kvb"
+
+    def __init__(self, directory: str, max_bytes: int, fingerprint: str = ""):
+        self.dir = os.path.join(directory, fingerprint or "default")
+        os.makedirs(self.dir, exist_ok=True)
+        self.max_bytes = max_bytes
+        self.stats = DiskTierStats()
+        # LRU index rebuilt from the directory on start (restart survival):
+        # oldest-mtime first
+        self._index: OrderedDict[int, int] = OrderedDict()  # hash -> nbytes
+        self.total_bytes = 0
+        entries = []
+        for name in os.listdir(self.dir):
+            if not name.endswith(self.SUFFIX):
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                h = int(name[: -len(self.SUFFIX)])
+                st = os.stat(path)
+            except (ValueError, OSError):
+                continue
+            entries.append((st.st_mtime, h, st.st_size))
+        for _, h, size in sorted(entries):
+            self._index[h] = size
+            self.total_bytes += size
+
+    def _path(self, h: int) -> str:
+        return os.path.join(self.dir, f"{h}{self.SUFFIX}")
+
+    def __contains__(self, h: int) -> bool:
+        return h in self._index
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def store(self, h: int, arr: np.ndarray) -> None:
+        if self.max_bytes <= 0 or h in self._index:
+            return
+        from .kv_transfer import raw_frame
+
+        path = self._path(h)
+        tmp = f"{path}.tmp{os.getpid()}"
+        payload = raw_frame(
+            h, np.ascontiguousarray(arr).tobytes(), arr.dtype.name,
+            list(arr.shape),
+        )
+        try:
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        except OSError as e:  # full/readonly disk: a cache degrades, never fails
+            logger.warning("disk KV store of %x failed: %s", h, e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return
+        self._index[h] = len(payload)
+        self.total_bytes += len(payload)
+        self.stats.stores += 1
+        while self.total_bytes > self.max_bytes and len(self._index) > 1:
+            old, old_size = self._index.popitem(last=False)
+            try:
+                os.unlink(self._path(old))
+            except OSError:
+                pass
+            self.total_bytes -= old_size
+            self.stats.evictions += 1
+
+    def load(self, h: int) -> np.ndarray | None:
+        if h not in self._index:
+            return None
+        from .kv_transfer import FrameParser
+
+        try:
+            with open(self._path(h), "rb") as f:
+                frames = FrameParser().feed(f.read())
+            if not frames or frames[0][0] != h:
+                raise ValueError("truncated or mismatched block frame")
+            arr = frames[0][1]
+        except (OSError, ValueError, KeyError) as e:
+            logger.warning("disk KV load of %x failed: %s", h, e)
+            size = self._index.pop(h, 0)
+            self.total_bytes -= size
+            # unlink the corrupt file: leaving it would leak untracked
+            # bytes AND re-index the dead entry on every restart
+            try:
+                os.unlink(self._path(h))
+            except OSError:
+                pass
+            return None
+        self._index.move_to_end(h)
+        self.stats.loads += 1
+        return arr

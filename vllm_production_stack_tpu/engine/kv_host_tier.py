@@ -35,7 +35,7 @@ class HostKVTier:
     `reload_into` from prefix matching."""
 
     def __init__(self, num_blocks: int, fetch_block, upload_block,
-                 remote=None, upload_blocks=None):
+                 remote=None, upload_blocks=None, disk=None):
         self.num_blocks = num_blocks
         # fetch returns per-layer device slices with host copies STARTED
         # (ModelRunner.fetch_block); entries resolve to numpy one store
@@ -52,6 +52,9 @@ class HostKVTier:
         # through (its writer thread dedupes), so the remote store holds a
         # superset of the ring and cross-engine prefills can warm from it
         self.remote = remote
+        # optional DiskKVTier (kv_disk_tier.py): ring evictions persist to
+        # local disk — the middle rung between RAM and the remote store
+        self.disk = disk
         self.stats = HostTierStats()
 
     def _resolve(self, h: int) -> np.ndarray | None:
@@ -76,7 +79,9 @@ class HostKVTier:
         self._drain_pending(keep_latest=0)
 
     def __contains__(self, h: int) -> bool:
-        return h in self._data
+        # ring or disk: both are locally reloadable, so prefix matching and
+        # the /kv/lookup probe treat them as one local tier
+        return h in self._data or (self.disk is not None and h in self.disk)
 
     def __len__(self) -> int:
         return len(self._data)
@@ -106,26 +111,39 @@ class HostKVTier:
             evicted, entry = self._data.popitem(last=False)
             if evicted in self._pending:
                 self._pending.remove(evicted)
-            if self.remote is not None and not isinstance(entry, np.ndarray):
+            need_bytes = self.disk is not None or (
+                self.remote is not None and not isinstance(entry, np.ndarray)
+            )
+            if need_bytes and not isinstance(entry, np.ndarray):
+                entry = np.stack([np.asarray(p) for p in entry])
+            if self.disk is not None:
+                # ring → disk: the evicted block stays reloadable locally
+                self.disk.store(evicted, entry)
+            if self.remote is not None and isinstance(entry, np.ndarray):
                 # an entry evicted before it was ever resolved hasn't been
-                # written through yet — materialize and push, or the remote
-                # tier silently misses exactly the blocks that fell off
-                # (resolved entries were already pushed by _resolve)
-                self.remote.put_async(
-                    evicted, np.stack([np.asarray(p) for p in entry])
-                )
+                # written through yet — push now, or the remote tier
+                # silently misses exactly the blocks that fell off (the
+                # RemoteKVTier dedupes already-pushed hashes)
+                self.remote.put_async(evicted, entry)
             self.stats.evictions += 1
 
     def reload_into(self, h: int, device_block: int) -> bool:
         """Upload hash h's pages into a freshly allocated device block.
-        Returns False if h is not resident. The entry stays in the ring (it
-        may be needed again after the device copy is evicted)."""
+        Returns False if h is not resident in the ring OR on disk. The
+        entry stays resident (it may be needed again after the device copy
+        is evicted); a disk hit promotes back into the ring."""
         data = self._resolve(h)
         if data is None:
-            return False
-        if h in self._pending:
-            self._pending.remove(h)
-        self._data.move_to_end(h)
+            if self.disk is None:
+                return False
+            data = self.disk.load(h)
+            if data is None:
+                return False
+            self.insert_resolved(h, data)  # promote: next match stays in RAM
+        else:
+            if h in self._pending:
+                self._pending.remove(h)
+            self._data.move_to_end(h)
         self._upload(device_block, data)
         self.stats.reloads += 1
         return True

@@ -828,6 +828,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="remote KV store URL (tpukv://host:port, "
                         "kvstore/server.py) — the LMCACHE_REMOTE_URL lm:// "
                         "equivalent; enables cross-engine KV sharing")
+    p.add_argument("--disk-kv-dir", default="",
+                   help="local-disk KV tier directory (ring evictions "
+                        "persist here; LMCACHE_LOCAL_DISK equivalent)")
+    p.add_argument("--disk-kv-gib", type=float, default=0.0,
+                   help="disk KV tier byte budget in GiB (0 = off)")
     p.add_argument("--max-num-seqs", type=int, default=64)
     p.add_argument("--max-num-batched-tokens", type=int, default=512)
     p.add_argument("--decode-window", type=int, default=8,
@@ -892,6 +897,8 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             num_blocks=args.num_blocks,
             num_host_blocks=args.num_host_blocks,
             host_kv_gib=args.host_kv_gib,
+            disk_kv_dir=args.disk_kv_dir,
+            disk_kv_gib=args.disk_kv_gib,
             remote_kv_url=args.remote_kv_url,
             enable_prefix_caching=args.enable_prefix_caching,
         ),

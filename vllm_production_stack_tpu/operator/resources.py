@@ -78,6 +78,13 @@ def engine_args(spec: dict) -> list[str]:
     kv = spec.get("kvConfig", {})
     if kv.get("hostKvGib"):
         args += ["--host-kv-gib", str(kv["hostKvGib"])]
+    if kv.get("diskKvGib"):
+        # dir defaults like the helm template: a bare diskKvGib must turn
+        # the tier ON, not silently no-op behind the engine's dir+gib gate
+        args += ["--disk-kv-dir", str(kv.get("diskKvDir") or "/data/kv-cache")]
+        args += ["--disk-kv-gib", str(kv["diskKvGib"])]
+    elif kv.get("diskKvDir"):
+        args += ["--disk-kv-dir", str(kv["diskKvDir"])]
     if kv.get("remoteKvUrl"):
         args += ["--remote-kv-url", str(kv["remoteKvUrl"])]
     args += [str(a) for a in tpu.get("extraArgs", [])]
