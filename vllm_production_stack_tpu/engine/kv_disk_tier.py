@@ -128,7 +128,11 @@ class DiskKVTier:
             if not frames or frames[0][0] != h:
                 raise ValueError("truncated or mismatched block frame")
             arr = frames[0][1]
-        except (OSError, ValueError, KeyError) as e:
+        except Exception as e:
+            # broad on purpose: ANY corrupt-bytes failure (truncated frame,
+            # garbled JSON header, unrecognized dtype string → TypeError/
+            # AttributeError from the dtype lookup) must degrade to a cache
+            # miss and unlink — never kill the prefix-match path
             logger.warning("disk KV load of %x failed: %s", h, e)
             size = self._index.pop(h, 0)
             self.total_bytes -= size
