@@ -57,6 +57,8 @@ def main() -> None:
             # hoisted history gather) amortizes across window x batch = 32K
             # tokens — the whole generation is ONE fused decode dispatch
             decode_window=128,
+            # bench shapes are exactly warmed: keep gathers at true width
+            width_floor_blocks=1,
         ),
         parallel=ParallelConfig(tensor_parallel_size=1),
     )

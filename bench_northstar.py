@@ -111,6 +111,8 @@ def run_northstar(
             # latency-shaped: small enough that TTFT resolution is fine,
             # large enough to amortize the tunnel RTT over users x 16 tokens
             decode_window=decode_window,
+            # same-seed warmup covers the exact shapes: true-width gathers
+            width_floor_blocks=1,
         ),
         attention_backend=attention_backend,
     )

@@ -270,3 +270,31 @@ def test_min_tokens_suppresses_stop(engine):
     # vLLM semantics: below min_tokens the stop token is masked out of the
     # DISTRIBUTION, not accepted-then-ignored — it never appears early
     assert probe not in held["token_ids"][:4]
+
+
+def test_width_floor_blocks_config():
+    """The context-width program ladder floors at width_floor_blocks
+    (default 64 — serving must not compile a program per short-context
+    width); benches set 1 for true-width gathers."""
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.model_runner import ModelRunner
+
+    def runner(floor):
+        return ModelRunner(EngineConfig(
+            model=ModelConfig.tiny(max_model_len=2048),
+            cache=CacheConfig(block_size=8, num_blocks=512),
+            scheduler=SchedulerConfig(
+                max_num_seqs=2, max_num_batched_tokens=64,
+                decode_buckets=(2,), prefill_buckets=(64,),
+                width_floor_blocks=floor,
+            ),
+        ))
+
+    tables = [[1, 2, 3]]  # longest = 3 blocks
+    assert runner(64)._block_table_array(tables).shape[1] == 64  # floored
+    assert runner(1)._block_table_array(tables).shape[1] == 4  # true pow2
+    # the ladder still grows past the floor and caps at max_blocks (256)
+    wide = [list(range(1, 201))]  # 200 blocks
+    assert runner(64)._block_table_array(wide).shape[1] == 256

@@ -360,15 +360,17 @@ class LLMEngine:
         def wave(
             rows: int, prompt_len: int, max_tokens: int,
             logprobs: int | None = None, min_tokens: int = 0,
+            row_lens: list[int] | None = None,
         ) -> None:
             nonlocal passes
+            lens = row_lens if row_lens is not None else [prompt_len] * rows
             prompts = [
                 list(
                     np.random.RandomState(7000 + passes * 131 + i).randint(
-                        1, cfg.vocab_size, size=prompt_len
+                        1, cfg.vocab_size, size=n
                     )
                 )
-                for i in range(rows)
+                for i, n in enumerate(lens)
             ]
             self.generate(
                 prompts,
@@ -392,6 +394,25 @@ class LLMEngine:
             per_seq = prompt_len + sched.decode_window + 1
             rows = max(1, min(sched.max_num_seqs, usable_tokens // per_seq))
             wave(rows, prompt_len, 1)
+            # row-COUNT buckets: the prefill program key includes the pow2-
+            # padded row count, and production batches mix one long chunk
+            # with many short residuals — 1..max_num_seqs rows all occur.
+            # Missing these was the live-stack collapse mode: every new
+            # (rows, bucket) pair stalled serving for a 30-60s compile
+            # while queued decoders starved. One mixed-length wave per pow2
+            # row count covers them (lead row lands bucket t, 16-token
+            # residuals fill the rows within the token budget).
+            r = 1
+            while r <= sched.max_num_seqs:
+                lead = min(
+                    t, longest_chunk,
+                    sched.max_num_batched_tokens - (r - 1) * 16,
+                )
+                if lead <= prev_bucket or r == rows:
+                    r *= 2
+                    continue  # combo unreachable or already warmed above
+                wave(r, lead, 1, row_lens=[lead] + [16] * (r - 1))
+                r *= 2
             prev_bucket = t
         w = 1
         while w <= sched.decode_window:
@@ -406,6 +427,34 @@ class LLMEngine:
                     # window program w, not round_up_pow2(w-1)
                     wave(rows, 8, w + 1)
             w *= 2
+        # block-table WIDTH buckets: the (floored) pow2 width of the
+        # batch's longest context is part of every program key
+        # (model_runner._block_table_array). Without these waves, a long
+        # conversation's first crossing of each width boundary stalls
+        # serving for a 30-60s compile — the measured live-stack collapse
+        # mode. One 1-row wave per width above the 64-block floor walks a
+        # request's context up the ladder (chunked prefill compiles the
+        # prefill widths on the way; the trailing window compiles the
+        # decode width).
+        bs_tok = self.config.cache.block_size
+        max_w = self.runner.max_blocks
+        floor_w = sched.width_floor_blocks  # ladder starts above the floor
+        width = floor_w * 2
+        widths = []
+        while width < max_w:
+            widths.append(width)
+            width *= 2
+        if max_w > floor_w and max_w not in widths:
+            widths.append(max_w)
+        prev_len = 0
+        for w_blocks in widths:
+            prompt_len = min(
+                w_blocks * bs_tok, cfg.max_model_len, usable_tokens
+            ) - sched.decode_window - 2
+            if prompt_len <= prev_len:
+                break  # achievable context saturated: nothing new compiles
+            wave(1, prompt_len, sched.decode_window + 1)
+            prev_len = prompt_len
         # logprobs variants (want_logprobs is a static jit arg -> separate
         # programs): warm the largest prefill bucket and every decode bucket
         # at the full window — the common production hit. Smaller windows'

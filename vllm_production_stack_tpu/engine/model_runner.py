@@ -949,9 +949,16 @@ class ModelRunner:
         compiled-program set logarithmic."""
         b = pad_to or len(tables)
         longest = max((len(t) for t in tables), default=1)
-        # plain pow2 — the block axis is unsharded, so the batch bucket's
-        # ≥ dp clamp would only widen the per-layer KV gather for nothing
-        nb = min(self._pow2(longest), self.max_blocks)
+        # pow2 with a configurable FLOOR (default 64 blocks ≈ 1k tokens):
+        # every width is its own compiled program, and the fine-grained
+        # ladder below the floor bought little (short-context gathers are
+        # cheap to pad) while costing a 30-60s mid-serving compile stall
+        # each time a batch first crossed a width boundary — the measured
+        # live-stack collapse mode. The floor turns those widths into ONE
+        # program; the ladder above it stays logarithmic. Benches with
+        # exactly-warmed shapes set width_floor_blocks=1.
+        floor = self.config.scheduler.width_floor_blocks
+        nb = min(max(floor, self._pow2(longest)), self.max_blocks)
         nb = max(nb, 1)
         arr = np.zeros((b, nb), np.int32)  # 0 = null page
         for i, tbl in enumerate(tables):

@@ -835,6 +835,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disk KV tier byte budget in GiB (0 = off)")
     p.add_argument("--max-num-seqs", type=int, default=64)
     p.add_argument("--max-num-batched-tokens", type=int, default=512)
+    p.add_argument("--prefill-buckets", default="",
+                   help="comma-separated prefill chunk buckets (default: "
+                        "pow2 ladder up to --max-num-batched-tokens). "
+                        "FEWER buckets = fewer XLA programs = faster "
+                        "warmup and fewer lazy-compile stalls, at the cost "
+                        "of padding small chunks up")
+    p.add_argument("--decode-buckets", default="",
+                   help="comma-separated decode batch buckets (default: "
+                        "pow2 ladder up to --max-num-seqs)")
+    p.add_argument("--width-floor-blocks", type=int, default=64,
+                   help="floor of the context-width program ladder in pool "
+                        "blocks — lower = tighter KV gathers but more "
+                        "compiled programs (see SchedulerConfig)")
     p.add_argument("--decode-window", type=int, default=8,
                    help="decode iterations fused into one device dispatch; "
                         "raise on high-RTT links (remote chips) — dispatch "
@@ -878,17 +891,30 @@ def build_parser() -> argparse.ArgumentParser:
 
 def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
     model_cfg = resolve_model_config(args.model, args.max_model_len, args.dtype)
-    decode_buckets = tuple(
-        b for b in (8, 16, 32, 64, 128, 256) if b <= args.max_num_seqs
-    ) or (args.max_num_seqs,)
-    if decode_buckets[-1] < args.max_num_seqs:
-        decode_buckets += (args.max_num_seqs,)
-    prefill_buckets = tuple(
-        b for b in (64, 128, 256, 512, 1024, 2048)
-        if b <= args.max_num_batched_tokens
-    ) or (args.max_num_batched_tokens,)
-    if prefill_buckets[-1] < args.max_num_batched_tokens:
-        prefill_buckets += (args.max_num_batched_tokens,)
+    if getattr(args, "decode_buckets", ""):
+        # sorted: bucket_for scans in tuple order for the first bucket >= n,
+        # so an unordered list would silently pad everything to the first
+        # (possibly oversized) entry
+        decode_buckets = tuple(sorted(
+            int(b) for b in args.decode_buckets.split(",") if b.strip()
+        ))
+    else:
+        decode_buckets = tuple(
+            b for b in (8, 16, 32, 64, 128, 256) if b <= args.max_num_seqs
+        ) or (args.max_num_seqs,)
+        if decode_buckets[-1] < args.max_num_seqs:
+            decode_buckets += (args.max_num_seqs,)
+    if getattr(args, "prefill_buckets", ""):
+        prefill_buckets = tuple(sorted(
+            int(b) for b in args.prefill_buckets.split(",") if b.strip()
+        ))
+    else:
+        prefill_buckets = tuple(
+            b for b in (64, 128, 256, 512, 1024, 2048)
+            if b <= args.max_num_batched_tokens
+        ) or (args.max_num_batched_tokens,)
+        if prefill_buckets[-1] < args.max_num_batched_tokens:
+            prefill_buckets += (args.max_num_batched_tokens,)
     return EngineConfig(
         model=model_cfg,
         cache=CacheConfig(
@@ -908,6 +934,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             decode_buckets=decode_buckets,
             prefill_buckets=prefill_buckets,
             decode_window=args.decode_window,
+            width_floor_blocks=args.width_floor_blocks,
             num_speculative_tokens=args.num_speculative_tokens,
             speculative_min_ngram=args.speculative_min_ngram,
         ),
