@@ -148,6 +148,14 @@ class LLMEngine:
             host_tier=self.host_tier,
             need_slot_mappings=config.parallel.sequence_parallel_size > 1,
         )
+        if self.runner.kv_caches:
+            # page geometry the remote-match path validates fetched blocks
+            # against — same definition the KV import path enforces
+            from .kv_transfer import engine_block_shape
+
+            self.scheduler.pool.expected_block_shape = engine_block_shape(
+                self.runner
+            )
         self._states: dict[str, _RequestState] = {}
         self._lora_slots: dict[str, int] = {}  # adapter name -> slot index
         self._lora_paths: dict[str, str] = {}  # adapter name -> source path

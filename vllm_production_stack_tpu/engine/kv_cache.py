@@ -18,8 +18,13 @@ re-reference their KV without recompute. The hit/query counters back the
 from __future__ import annotations
 
 import hashlib
+import logging
 from collections import OrderedDict, deque
 from dataclasses import dataclass
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
 
 _ROOT_HASH = 0x9E3779B97F4A7C15
 
@@ -61,6 +66,10 @@ class KVBlockPool:
         # optional HostKVTier: evicted cached blocks offload HBM→host and
         # prefix matches continue into it (engine/kv_host_tier.py)
         self.host_tier = host_tier
+        # page geometry remote fetches are validated against; the engine
+        # sets this once the runner's pool exists (None = skip validation,
+        # e.g. unit tests with no device pool)
+        self.expected_block_shape: tuple[int, ...] | None = None
         # block 0 reserved as the null page
         self._free: deque[int] = deque(range(1, num_blocks))
         self._ref: dict[int, int] = {}
@@ -188,30 +197,56 @@ class KVBlockPool:
         allocated HBM blocks (cross-engine KV reuse — the LMCache-server
         capability). Fetched blocks are promoted into the host ring so the
         next match stays local. queries for hashes[0] was already counted by
-        the caller; the rest count here."""
+        the caller; the rest count here.
+
+        Mirrors import_blocks: geometry is validated against the engine's
+        page shape (a version-skewed remote store degrades to a miss, never
+        a corrupt match), and the hash→block mappings + hit counts commit
+        only AFTER the batched device upload succeeds — a failed upload frees
+        the staged blocks instead of leaving hashes pointing at pages whose
+        KV was never written."""
         remote = getattr(self.host_tier, "remote", None)
         if remote is None:
             return []
-        matched: list[int] = []
-        staged: list = []  # (blk, data) for ONE batched device upload
+        want = self.expected_block_shape
+        staged: list = []  # (hash, blk, data) for ONE batched device upload
         for i, (h, data) in enumerate(zip(hashes, remote.fetch_run(hashes))):
             if i > 0:
                 self.stats.queries += 1
+            if want is not None and tuple(np.shape(data)) != tuple(want):
+                logger.warning(
+                    "remote KV block %x has shape %s, engine needs %s — "
+                    "dropping the fetched run (version-skewed store?)",
+                    h, np.shape(data), want,
+                )
+                break
             blk = self.allocate()  # may evict (offload+write-through) others
             if blk is None:
                 break
-            staged.append((blk, data))
+            staged.append((h, blk, data))
+        if not staged:
+            return []
+        try:
+            # one dispatch for the whole fetched run — per-block uploads
+            # cost a device round trip each on high-RTT links
+            self.host_tier.upload_many(
+                [blk for _, blk, _ in staged], [d for _, _, d in staged]
+            )
+        except Exception:
+            logger.exception(
+                "remote KV upload failed — freeing %d staged blocks and "
+                "degrading to a cache miss", len(staged)
+            )
+            for _, blk, _ in staged:
+                self.free_block(blk)
+            return []
+        matched: list[int] = []
+        for h, blk, data in staged:
             self._hash_to_block[h] = blk
             self._block_to_hash[blk] = h
             self.host_tier.insert_resolved(h, data)
             self.stats.hits += 1
             matched.append(blk)
-        if staged:
-            # one dispatch for the whole fetched run — per-block uploads
-            # cost a device round trip each on high-RTT links
-            self.host_tier.upload_many(
-                [blk for blk, _ in staged], [d for _, d in staged]
-            )
         return matched
 
     def _reload_from_host(self, h: int) -> int | None:

@@ -72,10 +72,17 @@ def block_frame(h: int, arr: np.ndarray) -> bytes:
 
 class FrameParser:
     """Incremental parser for the streamed format: feed() network chunks in,
-    get complete (hash, array) blocks out."""
+    get complete (hash, array) blocks out.
 
-    def __init__(self):
+    `max_frame_bytes` bounds both the JSON header and the payload a single
+    frame may claim (default 256 MiB — far above any real KV block, far below
+    anything that could exhaust RAM). A corrupted or hostile stream claiming
+    a huge frame fails fast with ValueError instead of making the receiver
+    buffer the entire remaining response as residual bytes."""
+
+    def __init__(self, max_frame_bytes: int = 256 << 20):
         self._buf = bytearray()
+        self.max_frame_bytes = max_frame_bytes
 
     def feed(self, data: bytes) -> list[tuple[int, np.ndarray]]:
         self._buf.extend(data)
@@ -84,10 +91,21 @@ class FrameParser:
             if len(self._buf) < 4:
                 break
             head_len = struct.unpack_from("<I", self._buf)[0]
+            if head_len > self.max_frame_bytes:
+                raise ValueError(
+                    f"frame header claims {head_len} bytes "
+                    f"(max {self.max_frame_bytes}) — corrupt stream"
+                )
             if len(self._buf) < 4 + head_len:
                 break
             head = json.loads(bytes(self._buf[4 : 4 + head_len]))
-            total = 4 + head_len + head["nbytes"]
+            nbytes = int(head["nbytes"])
+            if nbytes < 0 or nbytes > self.max_frame_bytes:
+                raise ValueError(
+                    f"frame payload claims {nbytes} bytes "
+                    f"(max {self.max_frame_bytes}) — corrupt stream"
+                )
+            total = 4 + head_len + nbytes
             if len(self._buf) < total:
                 break
             raw = bytes(self._buf[4 + head_len : total])
@@ -146,6 +164,24 @@ def deserialize_blocks(payload: bytes) -> tuple[list[int], np.ndarray, str]:
     return hashes, blocks, fingerprint
 
 
+def engine_block_shape(runner) -> tuple[int, ...]:
+    """(L, 2, block_size, kvH, D) — the page geometry of one engine's pool.
+    The ONE definition shared by import validation (KVTransfer), remote-match
+    validation (KVBlockPool.expected_block_shape) and the stream receiver's
+    frame-size bound: a layout change lands everywhere or nowhere."""
+    leaf = runner.kv_caches[0]
+    return (len(runner.kv_caches), 2, leaf.shape[2], *leaf.shape[3:])
+
+
+def engine_block_nbytes(runner) -> int:
+    """Bytes of one KV block as stored in this engine's pool."""
+    shape = engine_block_shape(runner)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * runner.kv_caches[0].dtype.itemsize
+
+
 class KVTransfer:
     """Pool-side export/adopt bookkeeping, bound to one engine's scheduler
     pool + runner. All methods assume the caller holds the engine lock."""
@@ -157,8 +193,7 @@ class KVTransfer:
     def block_shape(self) -> tuple[int, ...]:
         """(L, 2, block_size, kvH, D) — the only page geometry this engine
         can adopt."""
-        leaf = self.runner.kv_caches[0]
-        return (len(self.runner.kv_caches), 2, leaf.shape[2], *leaf.shape[3:])
+        return engine_block_shape(self.runner)
 
     def export_prompt(
         self, token_ids: list[int], parent: int | None = None

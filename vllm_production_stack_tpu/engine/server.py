@@ -733,6 +733,7 @@ class EngineServer:
     async def _pull_streamed(self, source: str, probe: dict) -> web.Response:
         """Receiver half of the streaming PD path: read frames off the
         sender's chunked response and adopt them group-by-group."""
+        import aiohttp
         import numpy as np
 
         from .kv_transfer import FrameParser
@@ -755,7 +756,16 @@ class EngineServer:
                     f"engine {self.engine.model_fingerprint!r} — refusing "
                     "foreign KV"
                 )
-            parser = FrameParser()
+            # bound each frame at a small multiple of this engine's own
+            # per-block byte size — a corrupted stream fails fast instead of
+            # buffering the rest of the response as residual bytes
+            from .kv_transfer import engine_block_nbytes
+
+            block_nbytes = (
+                engine_block_nbytes(self.engine.runner)
+                if self.engine.runner.kv_caches else 64 << 20
+            )
+            parser = FrameParser(max_frame_bytes=max(4 * block_nbytes, 1 << 20))
             batch_h: list[int] = []
             batch_b: list[np.ndarray] = []
             imported = 0
@@ -771,7 +781,21 @@ class EngineServer:
                 batch_b.clear()
 
             async for chunk in resp.content.iter_any():
-                for h, arr in parser.feed(chunk):
+                try:
+                    frames = parser.feed(chunk)
+                except Exception as e:
+                    # corrupt stream bytes are a bad-gateway condition (like
+                    # a malformed npz payload → 502), NOT a 409 conflict —
+                    # kv_pull's ValueError clause is for fingerprint/geometry
+                    # mismatches. Broad on purpose: garbled headers surface
+                    # as KeyError/TypeError/AttributeError too (missing
+                    # nbytes, unknown dtype string — same family
+                    # kv_disk_tier.load handles)
+                    raise aiohttp.ClientPayloadError(
+                        f"corrupt KV stream from {source}: "
+                        f"{type(e).__name__}: {e}"
+                    ) from e
+                for h, arr in frames:
                     batch_h.append(h)
                     batch_b.append(arr)
                     if len(batch_h) >= self._PULL_GROUP:

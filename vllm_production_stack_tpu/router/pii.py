@@ -112,13 +112,20 @@ class PIIMiddleware:
     def __init__(self, analyzer=None):
         self.analyzer = analyzer or RegexAnalyzer()
         self.blocked_total = 0
-        # ONE worker: offloading keeps the event loop free, but Presidio's
-        # shared spaCy pipeline is not safe for concurrent calls — a
-        # single-thread executor serializes analysis without blocking I/O
+        # Offloading keeps the event loop free. Worker count depends on the
+        # analyzer: Presidio's shared spaCy pipeline is not safe for
+        # concurrent calls, so it gets ONE serializing worker. The regex
+        # analyzer is GIL-bound either way (sre holds the GIL), so extra
+        # threads add no matching throughput — the small pool only stops one
+        # pathologically large prompt from head-of-line-blocking every other
+        # request's analysis behind it.
         from concurrent.futures import ThreadPoolExecutor
 
+        # only the known-reentrant regex analyzer gets concurrency; any
+        # injected analyzer defaults to the safe serialized path
+        workers = 4 if isinstance(self.analyzer, RegexAnalyzer) else 1
         self._executor = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="pii-analyzer"
+            max_workers=workers, thread_name_prefix="pii-analyzer"
         )
 
     async def check(self, request: web.Request) -> web.Response | None:
