@@ -18,6 +18,7 @@ import json
 import logging
 import time
 
+import numpy as np
 from aiohttp import web
 from pydantic import ValidationError
 
@@ -41,6 +42,8 @@ from .protocol import (
     ErrorResponse,
     ModelCard,
     ModelList,
+    RerankRequest,
+    ScoreRequest,
     random_id,
     usage,
 )
@@ -85,9 +88,12 @@ class EngineServer:
         r.add_post("/v1/chat/completions", self.chat_completions)
         r.add_post("/v1/completions", self.completions)
         r.add_post("/v1/embeddings", self.embeddings)
+        r.add_post("/v1/score", self.score)
+        r.add_post("/v1/rerank", self.rerank)
         r.add_get("/v1/models", self.list_models)
         r.add_get("/health", self.health)
         r.add_get("/metrics", self.metrics_endpoint)
+        r.add_get("/debug/timing", self.debug_timing)
         r.add_post("/sleep", self.sleep)
         r.add_post("/wake_up", self.wake_up)
         r.add_get("/is_sleeping", self.is_sleeping)
@@ -256,6 +262,107 @@ class EngineServer:
             ],
             "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
         })
+
+    async def score(self, request: web.Request) -> web.Response:
+        """vLLM /v1/score: similarity of text_1 x text_2 pairs via
+        last-token-pooled embeddings (decoder-only models have no
+        cross-encoder head; cosine of the L2-normalized embedding vectors
+        is the vLLM embedding-model scoring path). The reference router
+        proxies this route to its engines (main_router.py:50-246)."""
+        try:
+            body = ScoreRequest.model_validate(await request.json())
+        except (ValidationError, json.JSONDecodeError) as e:
+            return error(400, f"invalid request: {e}")
+        if err := self._check_model(body.model):
+            return err
+        t1 = [body.text_1] if isinstance(body.text_1, str) else body.text_1
+        t2 = [body.text_2] if isinstance(body.text_2, str) else body.text_2
+        if not t1 or not t2:
+            return error(400, "text_1 and text_2 must be non-empty")
+        if len(t1) == 1:
+            pairs = [(t1[0], d) for d in t2]
+        elif len(t1) == len(t2):
+            pairs = list(zip(t1, t2))
+        else:
+            return error(
+                400,
+                f"text_1 ({len(t1)}) and text_2 ({len(t2)}) must be the "
+                "same length, or text_1 a single string",
+            )
+        try:
+            scores, n_tokens = await self._pair_scores(pairs)
+        except ValueError as e:
+            return error(400, str(e))
+        except RuntimeError as e:
+            return error(503, str(e), "service_unavailable")
+        return web.json_response({
+            "id": random_id("score"),
+            "object": "list",
+            "model": body.model,
+            "data": [
+                {"object": "score", "index": i, "score": s}
+                for i, s in enumerate(scores)
+            ],
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        })
+
+    async def rerank(self, request: web.Request) -> web.Response:
+        """Jina/Cohere-style /v1/rerank served by vLLM engines: order
+        `documents` by embedding similarity to `query`."""
+        try:
+            body = RerankRequest.model_validate(await request.json())
+        except (ValidationError, json.JSONDecodeError) as e:
+            return error(400, f"invalid request: {e}")
+        if err := self._check_model(body.model):
+            return err
+        if not body.documents:
+            return error(400, "documents must be non-empty")
+        if body.top_n is not None and body.top_n < 1:
+            return error(400, "top_n must be >= 1")
+        try:
+            scores, n_tokens = await self._pair_scores(
+                [(body.query, d) for d in body.documents]
+            )
+        except ValueError as e:
+            return error(400, str(e))
+        except RuntimeError as e:
+            return error(503, str(e), "service_unavailable")
+        order = sorted(
+            range(len(scores)), key=lambda i: scores[i], reverse=True
+        )
+        if body.top_n is not None:
+            order = order[: max(0, body.top_n)]
+        results = []
+        for i in order:
+            entry = {"index": i, "relevance_score": scores[i]}
+            if body.return_documents:
+                entry["document"] = {"text": body.documents[i]}
+            results.append(entry)
+        return web.json_response({
+            "id": random_id("rerank"),
+            "model": body.model,
+            "results": results,
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        })
+
+    async def _pair_scores(
+        self, pairs: list[tuple[str, str]]
+    ) -> tuple[list[float], int]:
+        """Cosine similarity per (a, b) pair. Each distinct text embeds
+        once (reranks share one query across every document)."""
+        texts: list[str] = []
+        index: dict[str, int] = {}
+        for a, b in pairs:
+            for t in (a, b):
+                if t not in index:
+                    index[t] = len(texts)
+                    texts.append(t)
+        vectors, n_tokens = await self.async_engine.embed(texts)
+        arr = np.asarray(vectors, dtype=np.float32)
+        scores = [
+            float(np.dot(arr[index[a]], arr[index[b]])) for a, b in pairs
+        ]
+        return scores, n_tokens
 
     def _check_model(self, model: str):
         """vLLM-compatible 404 for unknown model/adapter names — the
@@ -538,6 +645,19 @@ class EngineServer:
     async def metrics_endpoint(self, request: web.Request) -> web.Response:
         payload = self.metrics.render(await self.async_engine.stats_async())
         return web.Response(body=payload, content_type="text/plain")
+
+    async def debug_timing(self, request: web.Request) -> web.Response:
+        """Served-stack profiling: where the step thread's wall time goes
+        (device dispatch vs host scheduling vs idle) and how long request
+        submissions wait on the engine lock. Counters are cumulative and
+        monotonic — profilers snapshot before/after and subtract (an
+        in-place reset would race the step thread's unlocked accumulates
+        and could be silently lost)."""
+        eng = self.async_engine.engine
+        return web.json_response({
+            "engine": dict(eng.timing),
+            "loop": dict(self.async_engine.loop_timing),
+        })
 
     async def sleep(self, request: web.Request) -> web.Response:
         level = int(request.query.get("level", "1"))
@@ -910,6 +1030,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-loras", type=int, default=0,
                    help="runtime LoRA adapter slots (0 disables LoRA)")
     p.add_argument("--max-lora-rank", type=int, default=8)
+    p.add_argument("--distributed", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="multi-host bootstrap via jax.distributed from the "
+                        "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/"
+                        "JAX_PROCESS_ID env the multi-host statefulset "
+                        "exports; auto = initialize iff >1 process named")
+    p.add_argument("--compilation-cache-dir",
+                   default="/tmp/vllm-tpu-xla-cache",
+                   help="persistent XLA compilation cache: --warmup costs "
+                        "its 20-40s-per-program compiles ONCE per "
+                        "(model, bucket-set); every later boot reloads "
+                        "them in seconds. In k8s, mount a PVC here "
+                        "(empty string disables)")
     return p
 
 
@@ -978,6 +1111,23 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
 
 def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
+    # multi-host bootstrap BEFORE any JAX backend touch: the helm multi-host
+    # statefulset exports JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/
+    # JAX_PROCESS_ID per pod (parallel/distributed.py consumes them); after
+    # this, jax.devices() spans every host in the slice and the engine's
+    # mesh/pjit shardings cover them
+    from ..parallel.distributed import maybe_initialize
+
+    maybe_initialize(args.distributed)
+    if args.compilation_cache_dir:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir", args.compilation_cache_dir
+        )
+        # the serving program set is all multi-second compiles; cache
+        # everything that costs more than a second
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     config = engine_config_from_args(args)
     logger.info("starting engine for model=%s on %s:%d",
                 args.model, args.host, args.port)

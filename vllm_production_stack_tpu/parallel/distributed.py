@@ -1,0 +1,235 @@
+"""Multi-host process bootstrap: the executable half of the helm env contract.
+
+The multi-host StatefulSet template (helm/templates/statefulset-multihost.yaml)
+exports `JAX_COORDINATOR_ADDRESS` / `JAX_NUM_PROCESSES` / `JAX_PROCESS_ID`
+into every pod of a slice — one engine process per TPU host, pod 0 doubling
+as the coordinator. This module consumes that contract: `maybe_initialize()`
+turns it into a live `jax.distributed` service so `jax.devices()` spans every
+host's chips and one GSPMD mesh (parallel/mesh.py) can cover a v5e-16's four
+hosts. Reference equivalent: the RayCluster head gating on EXPECTED_NODES
+before launching vLLM with pipeline parallelism
+(/root/reference/helm/templates/ray-cluster.yaml:44-46,556-566) — here the
+coordination service is JAX's own, not Ray.
+
+Also provides the multi-PROCESS dryrun used by `__graft_entry__.
+dryrun_multichip`: N real OS processes, each owning one virtual CPU device,
+form one mesh through this exact code path and run a collective + a sharded
+model forward — validating the statefulset contract end-to-end without TPU
+hardware (`python -m vllm_production_stack_tpu.parallel.distributed --worker`
+is the per-process entry).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+ENV_COORDINATOR = "JAX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_PROCESS_ID = "JAX_PROCESS_ID"
+
+
+def distributed_env() -> tuple[str, int, int] | None:
+    """(coordinator_address, num_processes, process_id) from the helm env
+    contract, or None when the pod is not part of a multi-host slice."""
+    addr = os.environ.get(ENV_COORDINATOR)
+    if not addr:
+        return None
+    try:
+        n = int(os.environ.get(ENV_NUM_PROCESSES, "1"))
+        pid = int(os.environ.get(ENV_PROCESS_ID, "0"))
+    except ValueError as e:
+        raise ValueError(
+            f"malformed {ENV_NUM_PROCESSES}/{ENV_PROCESS_ID}: {e}"
+        ) from e
+    if not 0 <= pid < max(n, 1):
+        raise ValueError(
+            f"{ENV_PROCESS_ID}={pid} out of range for "
+            f"{ENV_NUM_PROCESSES}={n}"
+        )
+    return addr, n, pid
+
+
+def maybe_initialize(mode: str = "auto") -> bool:
+    """Call `jax.distributed.initialize` from the env contract.
+
+    mode: "auto" initializes iff the contract names >1 process; "on"
+    requires the contract (raises if absent); "off" never initializes.
+    Must run before the first JAX backend touch. Returns True when the
+    distributed service was started."""
+    if mode == "off":
+        return False
+    env = distributed_env()
+    if env is None or env[1] <= 1:
+        if mode == "on":
+            raise RuntimeError(
+                f"--distributed on, but {ENV_COORDINATOR} is unset (or "
+                f"{ENV_NUM_PROCESSES} <= 1); the multi-host statefulset "
+                "exports these — see helm/templates/statefulset-multihost.yaml"
+            )
+        return False
+    addr, n, pid = env
+    import jax
+
+    logger.info(
+        "initializing jax.distributed: coordinator=%s processes=%d "
+        "process_id=%d", addr, n, pid,
+    )
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=n, process_id=pid
+    )
+    logger.info(
+        "distributed runtime up: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+    return True
+
+
+# -- multi-process dryrun ----------------------------------------------------
+
+
+def _worker() -> None:
+    """One process of the multi-process dryrun (spawned with the helm env
+    contract set): initialize, form a dp mesh spanning every process, run a
+    cross-process collective and a dp-sharded model forward."""
+    import numpy as np
+
+    ok = maybe_initialize("on")
+    assert ok
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = jax.process_count()
+    pid = jax.process_index()
+    assert len(jax.devices()) == n * jax.local_device_count()
+
+    from ..engine.config import ModelConfig
+    from ..models import llama
+    from . import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh(data_parallel_size=jax.device_count())
+
+    # 1) collective across PROCESS boundaries: global sum of per-process
+    # contributions through the mesh
+    local = np.full((jax.local_device_count(), 1), pid + 1, np.float32)
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(mesh_lib.DP_AXIS, None)), local
+    )
+    total = jax.jit(jnp.sum)(garr)
+    # every process holds the replicated global result
+    want = sum(p + 1 for p in range(n)) * (jax.device_count() // n)
+    assert float(total) == want, (float(total), want)
+
+    # 2) dp-sharded model forward: identical params on every process (same
+    # PRNGKey), batch rows sharded one per device across processes
+    cfg = ModelConfig(
+        model="dryrun-mp-llama", vocab_size=128, hidden_size=32,
+        intermediate_size=64, num_layers=2, num_heads=2, num_kv_heads=2,
+        head_dim=16, max_model_len=32, dtype="float32",
+    )
+    params = jax.jit(llama.init_params, static_argnums=0)(
+        cfg, jax.random.PRNGKey(0)
+    )
+    t = 8
+    rows_per_proc = jax.device_count() // n
+    rng = np.random.RandomState(100 + pid)
+    ids_local = rng.randint(1, cfg.vocab_size, size=(rows_per_proc, t))
+    ids = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(mesh_lib.DP_AXIS, None)),
+        ids_local.astype(np.int32),
+    )
+    lens = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(mesh_lib.DP_AXIS)),
+        np.full((rows_per_proc,), t, np.int32),
+    )
+
+    @jax.jit
+    def fwd(p, ids, lens):
+        vecs = llama.embed_encode(cfg, p, ids, lens)
+        return llama.compute_logits(cfg, p, vecs)
+
+    logits = fwd(params, ids, lens)
+    jax.block_until_ready(logits)
+    for shard in logits.addressable_shards:
+        assert np.all(np.isfinite(np.asarray(shard.data)))
+    print(f"MP_DRYRUN_OK process={pid}/{n}", flush=True)
+
+
+def run_multiprocess_dryrun(n_processes: int = 2, timeout_s: float = 300.0):
+    """Spawn n real OS processes that form ONE mesh via the helm env
+    contract (each process = one TPU host stand-in with 1 CPU device).
+    Raises on any failure; returns the per-process outputs."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    # workers must import this package regardless of the caller's cwd
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    procs = []
+    for pid in range(n_processes):
+        env = dict(os.environ)
+        env.update({
+            ENV_COORDINATOR: f"127.0.0.1:{port}",
+            ENV_NUM_PROCESSES: str(n_processes),
+            ENV_PROCESS_ID: str(pid),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PYTHONPATH": pkg_root + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m",
+             "vllm_production_stack_tpu.parallel.distributed", "--worker"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    outputs = []
+    failed = []
+    for pid, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+            failed.append((pid, "timeout", out))
+            continue
+        outputs.append(out)
+        if proc.returncode != 0 or "MP_DRYRUN_OK" not in out:
+            failed.append((pid, f"rc={proc.returncode}", out))
+    if failed:
+        detail = "\n".join(
+            f"--- process {pid} ({why}):\n{out[-2000:]}"
+            for pid, why, out in failed
+        )
+        raise RuntimeError(
+            f"multi-process dryrun failed in {len(failed)}/{n_processes} "
+            f"processes:\n{detail}"
+        )
+    return outputs
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--worker", action="store_true",
+                   help="run as one process of the multi-process dryrun")
+    p.add_argument("--processes", type=int, default=2)
+    args = p.parse_args()
+    if args.worker:
+        _worker()
+    else:
+        run_multiprocess_dryrun(args.processes)
+        print(f"multi-process dryrun OK ({args.processes} processes)")
+
+
+if __name__ == "__main__":
+    main()
