@@ -111,6 +111,28 @@ class CompletionRequest(OpenAIModel):
         )
 
 
+class ScoreRequest(OpenAIModel):
+    """vLLM /v1/score shape (the reference router proxies it to its engines,
+    main_router.py:50-246): text_1 x text_2 similarity. One-vs-many when
+    text_1 is a single string, elementwise when both are equal-length
+    lists."""
+
+    model: str
+    text_1: str | list[str]
+    text_2: str | list[str]
+
+
+class RerankRequest(OpenAIModel):
+    """Jina/Cohere-style rerank shape served by vLLM engines
+    (/v1/rerank): rank `documents` by relevance to `query`."""
+
+    model: str
+    query: str
+    documents: list[str]
+    top_n: int | None = None
+    return_documents: bool = True
+
+
 class UsageInfo(OpenAIModel):
     prompt_tokens: int = 0
     completion_tokens: int = 0
