@@ -1,0 +1,315 @@
+"""Live-stack north-star benchmark: router + engine as REAL processes.
+
+This is the honest version of bench_northstar: the same multi-round-QA
+workload (BASELINE.md; reference benchmarks/multi-round-qa/run.sh:14-18),
+but driven over HTTP through the real router and the real engine server —
+request admission, tokenization, SSE streaming, and the router proxy hop
+are all inside the measurement, exactly as a user would see them.
+
+Token calibration: the llama presets have no vocabulary files (zero-egress
+image), so the engine serves with the byte fallback tokenizer — one ASCII
+character is one token. The harness therefore builds prompts from ASCII
+payloads whose CHARACTER counts equal bench_northstar's token counts
+(system prompt 1000, questions 250-650, answers capped at 100 history
+chars/round), making served and in-process runs like-for-like.
+
+Run standalone:  python bench_livestack.py
+From bench.py:   run_livestack() — the driver-captured headline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import socket
+import string
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+ENGINE_FLAGS = [
+    "--model", "llama-1b",
+    "--kv-cache-dtype", "fp8",
+    "--num-blocks", "8750",
+    "--max-model-len", "6144",
+    "--max-num-seqs", "20",
+    "--max-num-batched-tokens", "1024",
+    "--prefill-buckets", "512,1024",
+    "--decode-buckets", "20",
+    "--decode-window", "16",
+    "--warmup",
+]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_health(url: str, timeout_s: float) -> None:
+    import urllib.request
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/health", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            pass
+        time.sleep(2.0)
+    raise TimeoutError(f"{url} not healthy after {timeout_s}s")
+
+
+def ascii_filler(n_chars: int, seed: int) -> str:
+    """Exactly n_chars of printable ASCII => n_chars byte-tokenizer tokens."""
+    rng = np.random.RandomState(seed)
+    alphabet = np.frombuffer(
+        (string.ascii_letters + string.digits + "     ").encode(), dtype=np.uint8
+    )
+    return rng.choice(alphabet, size=max(1, n_chars)).tobytes().decode()
+
+
+async def _drive(
+    base_url: str,
+    model: str,
+    users: int,
+    rounds: int,
+    answer_tokens: int,
+    sys_tokens: int,
+    ramp_gap_s: float,
+    q_range: tuple[int, int],
+    seed: int,
+) -> dict:
+    import aiohttp
+
+    sys_prompt = ascii_filler(sys_tokens, seed=seed)
+    rng = np.random.RandomState(seed + 1)
+    q_lens = rng.randint(q_range[0], q_range[1], size=(users, rounds))
+
+    ttfts: list[float] = []
+    latencies: list[float] = []
+    gen_tokens = [0]
+    errors: list[str] = []
+    final_history_tokens: list[int] = []
+
+    async def one_user(u: int, session: aiohttp.ClientSession) -> None:
+        await asyncio.sleep(u * ramp_gap_s)
+        history = sys_prompt
+        for r in range(rounds):
+            history += ascii_filler(int(q_lens[u][r]), seed=seed + 7919 * u + r)
+            body = {
+                "model": model,
+                "prompt": history,
+                "max_tokens": answer_tokens,
+                "temperature": 0.0,
+                "ignore_eos": True,
+                "stream": True,
+                "stream_options": {"include_usage": True},
+            }
+            t0 = time.perf_counter()
+            first = None
+            completion = 0
+            try:
+                async with session.post(
+                    base_url + "/v1/completions", json=body
+                ) as resp:
+                    if resp.status != 200:
+                        errors.append(f"HTTP {resp.status}")
+                        return
+                    async for raw in resp.content:
+                        line = raw.decode().strip()
+                        if not line.startswith("data: "):
+                            continue
+                        payload = line[len("data: "):]
+                        if payload == "[DONE]":
+                            break
+                        chunk = json.loads(payload)
+                        if chunk.get("error"):
+                            errors.append(str(chunk["error"])[:120])
+                            return
+                        if chunk.get("choices") and first is None:
+                            ch = chunk["choices"][0]
+                            if ch.get("text") is not None or ch.get(
+                                "finish_reason"
+                            ):
+                                first = time.perf_counter()
+                                ttfts.append(first - t0)
+                        if chunk.get("usage"):
+                            completion = chunk["usage"].get(
+                                "completion_tokens", 0
+                            )
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                errors.append(f"{type(e).__name__}: {e}")
+                return
+            latencies.append(time.perf_counter() - t0)
+            gen_tokens[0] += completion or answer_tokens
+            # history grows by the ROUND's answer budget, matching the
+            # in-process northstar (append the generated ids); the decoded
+            # random-byte text re-encodes at a different length, so append
+            # a deterministic 100-char stand-in instead
+            history += ascii_filler(answer_tokens, seed=seed + 104729 * u + r)
+        final_history_tokens.append(len(history))
+
+    timeout = aiohttp.ClientTimeout(total=600)
+    t_start = time.perf_counter()
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        await asyncio.gather(*(one_user(u, session) for u in range(users)))
+    elapsed = time.perf_counter() - t_start
+
+    ttft_arr = np.array(ttfts) if ttfts else np.array([float("nan")])
+    return {
+        "requests": len(latencies),
+        "errors": len(errors),
+        "error_samples": errors[:5],
+        "elapsed_s": round(elapsed, 3),
+        "req_per_s": round(len(latencies) / elapsed, 3),
+        "gen_tok_s": round(gen_tokens[0] / elapsed, 1),
+        "ttft_p50_s": round(float(np.percentile(ttft_arr, 50)), 3),
+        "ttft_p90_s": round(float(np.percentile(ttft_arr, 90)), 3),
+        "ttft_p99_s": round(float(np.percentile(ttft_arr, 99)), 3),
+        "latency_p50_s": round(
+            float(np.percentile(latencies, 50)), 3
+        ) if latencies else None,
+        "avg_final_history_tokens": int(
+            np.mean(final_history_tokens)
+        ) if final_history_tokens else 0,
+    }
+
+
+def _fetch_json(url: str) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def run_livestack(
+    model: str = "llama-1b",
+    users: int = 20,
+    rounds: int = 6,
+    answer_tokens: int = 100,
+    sys_tokens: int = 1000,
+    ramp_gap_s: float = 0.25,
+    q_range: tuple[int, int] = (250, 650),
+    seed: int = 0,
+    warmup_wave: bool = True,
+    engine_flags: list[str] | None = None,
+    keep_logs: str | None = None,
+) -> dict:
+    """Launch engine + router as subprocesses, drive the north-star
+    workload over HTTP, return the summary + engine-side decomposition."""
+    engine_port, router_port = _free_port(), _free_port()
+    env = dict(os.environ)
+    log_dir = keep_logs or "/tmp/livestack"
+    os.makedirs(log_dir, exist_ok=True)
+    engine_log = open(os.path.join(log_dir, "engine.log"), "w")
+    router_log = open(os.path.join(log_dir, "router.log"), "w")
+    engine = subprocess.Popen(
+        [sys.executable, "-m", "vllm_production_stack_tpu.engine.server",
+         "--port", str(engine_port), *(engine_flags or ENGINE_FLAGS)],
+        cwd=REPO, env=env, stdout=engine_log, stderr=subprocess.STDOUT,
+    )
+    router = None
+    try:
+        # warmup compiles the full serving program set (many XLA programs)
+        _wait_health(f"http://127.0.0.1:{engine_port}", timeout_s=2400)
+        router = subprocess.Popen(
+            [sys.executable, "-m", "vllm_production_stack_tpu.router.app",
+             "--port", str(router_port),
+             "--service-discovery", "static",
+             "--static-backends", f"http://127.0.0.1:{engine_port}",
+             "--static-models", model,
+             "--routing-logic", "prefixaware"],
+            cwd=REPO, env=env, stdout=router_log, stderr=subprocess.STDOUT,
+        )
+        _wait_health(f"http://127.0.0.1:{router_port}", timeout_s=120)
+        url = f"http://127.0.0.1:{router_port}"
+
+        if warmup_wave:
+            # one traffic wave with DIFFERENT prompt content: any program
+            # key the --warmup ladder missed compiles here, then the
+            # prefix cache outcome matches steady-state (the measured wave
+            # computes its own fresh KV, reusing only in-wave history)
+            asyncio.run(_drive(
+                url, model, users, rounds, answer_tokens, sys_tokens,
+                ramp_gap_s, q_range, seed=seed + 555_000,
+            ))
+        # counters are cumulative: snapshot before/after and subtract (an
+        # in-place reset would race the step thread's accumulates)
+        t_before = _fetch_json(f"http://127.0.0.1:{engine_port}/debug/timing")
+        summary = asyncio.run(_drive(
+            url, model, users, rounds, answer_tokens, sys_tokens,
+            ramp_gap_s, q_range, seed=seed,
+        ))
+        t_after = _fetch_json(f"http://127.0.0.1:{engine_port}/debug/timing")
+        eng_t = {
+            k: t_after["engine"][k] - t_before["engine"][k]
+            for k in t_after["engine"]
+        }
+        loop_t = {
+            k: t_after["loop"][k] - t_before["loop"][k]
+            for k in t_after["loop"]
+        }
+        busy = loop_t["busy_s"]
+        summary["engine_profile"] = {
+            "steps": loop_t["steps"],
+            "busy_s": round(busy, 2),
+            "idle_s": round(loop_t["idle_s"], 2),
+            "busy_share_of_elapsed": round(
+                busy / summary["elapsed_s"], 3
+            ) if summary["elapsed_s"] else None,
+            "submit_lock_wait_s": round(loop_t["submit_lock_wait_s"], 2),
+            "submits": loop_t["submits"],
+            "sched_s": round(eng_t["sched_s"], 2),
+            "post_s": round(eng_t["post_s"], 2),
+            "prefill_s": round(eng_t["prefill_s"], 2),
+            "prefill_n": eng_t["prefill_n"],
+            "prefill_tokens": eng_t["prefill_tokens"],
+            "decode_s": round(eng_t["decode_s"], 2),
+            "decode_n": eng_t["decode_n"],
+            "decode_tokens": eng_t["decode_tokens"],
+        }
+        summary["users"] = users
+        summary["rounds"] = rounds
+        summary["model"] = model
+        summary["kv_dtype"] = "fp8"
+        return summary
+    finally:
+        for proc in (router, engine):
+            if proc is not None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in (router, engine):
+            if proc is not None:
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        engine_log.close()
+        router_log.close()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--users", type=int, default=20)
+    p.add_argument("--rounds", type=int, default=6)
+    p.add_argument("--no-warmup-wave", action="store_true")
+    p.add_argument("--keep-logs", default=None)
+    args = p.parse_args()
+    out = run_livestack(
+        users=args.users, rounds=args.rounds,
+        warmup_wave=not args.no_warmup_wave, keep_logs=args.keep_logs,
+    )
+    print(json.dumps({"livestack": out}))
+
+
+if __name__ == "__main__":
+    main()

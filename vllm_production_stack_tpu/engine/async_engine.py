@@ -37,6 +37,13 @@ class AsyncEngine:
         self._stop = False
         self._thread: threading.Thread | None = None
         self._step_error: Exception | None = None
+        # served-stack profiling (exposed via /debug/timing): where the step
+        # thread's wall time goes, and how long submissions wait on the
+        # engine lock behind it
+        self.loop_timing = {
+            "steps": 0, "busy_s": 0.0, "idle_s": 0.0,
+            "submits": 0, "submit_lock_wait_s": 0.0,
+        }
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -73,7 +80,9 @@ class AsyncEngine:
         )
 
     def _step_loop(self) -> None:
+        lt = self.loop_timing
         while not self._stop:
+            t0 = time.perf_counter()
             try:
                 with self._lock:
                     has_work = (
@@ -85,11 +94,16 @@ class AsyncEngine:
                 self._step_error = e
                 self._fail_all(e)
                 return
+            if has_work:
+                lt["steps"] += 1
+                lt["busy_s"] += time.perf_counter() - t0
             for out in outputs:
                 self._dispatch(out)
             if not has_work:
+                t1 = time.perf_counter()
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
+                lt["idle_s"] += time.perf_counter() - t1
 
     def _dispatch(self, out: RequestOutput) -> None:
         q = self._queues.get(out.request_id)
@@ -116,7 +130,11 @@ class AsyncEngine:
         """Runs in an executor: the step thread may hold the lock for a full
         device step (or a 10-40s first compile) — never block the event loop
         on it."""
-        with self._lock:
+        t0 = time.perf_counter()
+        self._lock.acquire()
+        self.loop_timing["submits"] += 1
+        self.loop_timing["submit_lock_wait_s"] += time.perf_counter() - t0
+        try:
             if self.engine.is_sleeping:
                 raise EngineSleepingError(
                     "engine is sleeping; wake it before sending requests"
@@ -136,6 +154,8 @@ class AsyncEngine:
                 lora_name=lora_name,
             )
             self._queues[rid] = q
+        finally:
+            self._lock.release()
         self._wake.set()
         return rid
 

@@ -17,7 +17,7 @@ from ..utils.tokenizer import IncrementalDetokenizer, TokenizerWrapper
 from .config import EngineConfig
 from .model_runner import ModelRunner
 from .request import Request, RequestOutput, RequestStatus, SamplingParams
-from .scheduler import Scheduler
+from .scheduler import PrefillWork, Scheduler
 
 logger = logging.getLogger(__name__)
 
@@ -164,6 +164,13 @@ class LLMEngine:
         self._req_counter = itertools.count()
         self._prompt_tokens = 0
         self._generation_tokens = 0
+        # step-phase wall-time decomposition (served-stack profiling; the
+        # async server exposes this via /debug/timing)
+        self.timing: dict[str, float | int] = {
+            "sched_s": 0.0, "post_s": 0.0,
+            "prefill_s": 0.0, "prefill_n": 0, "prefill_tokens": 0,
+            "decode_s": 0.0, "decode_n": 0, "decode_tokens": 0,
+        }
         # model_fingerprint (computed above, before the KV tiers): same
         # config + same checkpoint (or same random seed) => same KV bytes
         # for same tokens. KV adoption (disaggregated prefill) refuses
@@ -556,7 +563,10 @@ class LLMEngine:
 
     def step(self) -> list[RequestOutput]:
         """Schedule + execute one device step; returns per-request deltas."""
+        t0 = time.perf_counter()
         work = self.scheduler.schedule()
+        t1 = time.perf_counter()
+        self.timing["sched_s"] += t1 - t0
         outputs: list[RequestOutput] = []
         # requests the scheduler terminated outside a step still need a
         # terminal output or streaming clients would hang forever
@@ -566,8 +576,22 @@ class LLMEngine:
             self._drop_finished(outputs)
             return outputs
         sampled = self.runner.execute(work)
+        t2 = time.perf_counter()
+        kind = "prefill" if isinstance(work, PrefillWork) else "decode"
+        self.timing[kind + "_s"] += t2 - t1
+        self.timing[kind + "_n"] += 1
         lp_rows = self.runner.last_logprobs  # parallel to sampled rows
         results = self.scheduler.postprocess(work, sampled)
+        self.timing[kind + "_tokens"] += (
+            # chunk tokens PROCESSED (mid-prompt chunks emit none)
+            sum(len(t) for t in work.token_ids)
+            if kind == "prefill"
+            # tokens actually ACCEPTED — exact for both the fused window
+            # (mid-window stops discard the tail) and spec-decode verify
+            # (1..k+1 accepted per row)
+            else sum(len(toks) for _, toks in results)
+        )
+        self.timing["post_s"] += time.perf_counter() - t2
 
         for row_i, (req, toks) in enumerate(results):
             if not toks:  # mid-prompt prefill chunk: progress, no tokens
