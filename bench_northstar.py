@@ -78,6 +78,7 @@ def run_northstar(
     q_range: tuple[int, int] = (250, 650),
     block_size: int = 16,
     attention_backend: str = "auto",
+    quantization: str | None = None,
 ) -> dict:
     from vllm_production_stack_tpu.engine.config import (
         CacheConfig,
@@ -92,6 +93,7 @@ def run_northstar(
     model_cfg = resolve_model_config(
         model, max_model_len=max_model_len,
         dtype=None if model == "tiny-llama" else "bfloat16",
+        quantization=quantization,
     )
     config = EngineConfig(
         model=model_cfg,
@@ -248,6 +250,7 @@ def run_northstar(
         ),
         "kv_blocks": engine.config.cache.num_blocks,
         "kv_dtype": kv_cache_dtype,
+        "quantization": quantization,
     }
 
 
@@ -263,12 +266,13 @@ def main() -> None:
     p.add_argument("--num-blocks", type=int, default=8750)
     p.add_argument("--max-model-len", type=int, default=6144)
     p.add_argument("--kv-cache-dtype", default="fp8")
+    p.add_argument("--quantization", default=None, choices=[None, "int8"])
     args = p.parse_args()
     print(json.dumps({"northstar": run_northstar(
         model=args.model, users=args.users, rounds=args.rounds,
         block_size=args.block_size, attention_backend=args.attention_backend,
         num_blocks=args.num_blocks, max_model_len=args.max_model_len,
-        kv_cache_dtype=args.kv_cache_dtype,
+        kv_cache_dtype=args.kv_cache_dtype, quantization=args.quantization,
     )}))
 
 

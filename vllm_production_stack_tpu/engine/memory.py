@@ -40,6 +40,10 @@ def param_bytes(cfg: ModelConfig, tp: int = 1, pp: int = 1) -> int:
     """Per-device bytes of the stacked Llama param tree (models/llama.py
     init_params) under tensor parallelism `tp` and pipeline stages `pp`
     (per-layer leaves shard their L axis over pp, parallel/sharding.py)."""
+    if cfg.quantization:
+        from ..models.quantization import quantized_param_bytes
+
+        return quantized_param_bytes(cfg, tp, pp)
     h, hd = cfg.hidden_size, cfg.head_dim
     nh, nkv, it, L = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size, cfg.num_layers
     attn = h * nh * hd + 2 * h * nkv * hd + nh * hd * h

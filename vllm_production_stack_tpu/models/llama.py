@@ -31,7 +31,10 @@ from ..ops.attention import (
     write_kv_pages,
     write_kv_pages_blockwise,
 )
-from ..ops.paged_attention_pallas import paged_decode_attention
+from ..ops.paged_attention_pallas import (
+    paged_decode_attention,
+    paged_decode_attention_sharded,
+)
 
 
 def _dtype(cfg: ModelConfig):
@@ -105,6 +108,16 @@ def rms_norm(
     if add_one:
         return (normed * (1.0 + weight.astype(jnp.float32))).astype(dt)
     return normed.astype(dt) * weight
+
+
+def _mm(x: jax.Array, w) -> jax.Array:
+    """x @ w where w may be an int8 weight-only quantized leaf
+    ({"q": int8 (…, in, out), "s": f32 (…, 1, out)},
+    models/quantization.py). The HBM read is the int8 tensor; the cast and
+    per-channel rescale fuse into the matmul epilogue."""
+    if isinstance(w, dict):
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
 
 
 def _activation(cfg: ModelConfig):
@@ -220,14 +233,14 @@ def _layer_body(
         lscale = lora["scale"][lora_idx]
 
         def proj(xin, w, name):
-            out = xin @ w
+            out = _mm(xin, w)
             if name in lora:
                 out += _lora_delta(xin, lora[name], lora_idx, lscale)
             return out
     else:
 
         def proj(xin, w, name):
-            return xin @ w
+            return _mm(xin, w)
 
     res = x
     x = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
@@ -398,6 +411,7 @@ def decode_window_step(
     lora: dict | None = None,  # stacked adapter tree (init_lora_params)
     lora_idx: jax.Array | None = None,  # (B,) adapter slot per row
     hists: tuple | None = None,  # per-layer pre-gathered (hist_k, hist_v)
+    mesh=None,  # required for the pallas backend on a >1-device mesh
 ) -> tuple[jax.Array, jax.Array]:
     """One decode iteration inside a fused window: reads the pool, writes this
     token's K/V into `staged` (not the pool — the pool stays loop-invariant so
@@ -439,6 +453,14 @@ def decode_window_step(
                     q, kv_caches[i], block_tables, hist_mask,
                     staged[i, 0], staged[i, 1], staged_mask, scale=hd**-0.5,
                 )
+            if mesh is not None and mesh.size > 1:
+                # pallas_call has no GSPMD partition rule — shard_map over
+                # (dp, tp) places one kernel instance per device
+                return paged_decode_attention_sharded(
+                    mesh, q[:, 0], kv_caches[i], block_tables, hist_len,
+                    staged[i, 0], staged[i, 1], step_k, scale=hd**-0.5,
+                    interpret=backend == "pallas_interpret",
+                )[:, None]
             return paged_decode_attention(
                 q[:, 0], kv_caches[i], block_tables, hist_len,
                 staged[i, 0], staged[i, 1], step_k, scale=hd**-0.5,
@@ -608,5 +630,6 @@ def forward_context_parallel(
 
 def compute_logits(cfg: ModelConfig, params: dict, hidden: jax.Array) -> jax.Array:
     """hidden: (N, h) -> logits (N, vocab) in float32."""
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return (hidden @ head).astype(jnp.float32)
+    if cfg.tie_word_embeddings:
+        return (hidden @ params["embed"].T).astype(jnp.float32)
+    return _mm(hidden, params["lm_head"]).astype(jnp.float32)

@@ -95,6 +95,7 @@ def resolve_model_config(
     model: str,
     max_model_len: int | None = None,
     dtype: str | None = None,
+    quantization: str | None = None,
 ) -> ModelConfig:
     """model: a preset name, or a local HF checkpoint dir (config.json)."""
     if model in PRESETS:
@@ -111,6 +112,8 @@ def resolve_model_config(
         kw["max_model_len"] = max_model_len
     if dtype is not None:
         kw["dtype"] = dtype
+    if quantization is not None:
+        kw["quantization"] = quantization
     kw.setdefault("dtype", "bfloat16")
     return ModelConfig(**kw)
 
