@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import functools
 import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
 import jax
@@ -28,6 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import llama
 from ..ops.attention import gather_pages
+from ..utils.logging import init_logger
 from ..parallel import mesh as mesh_lib
 from ..parallel.sharding import (
     kv_cache_spec,
@@ -38,7 +41,7 @@ from .config import EngineConfig
 from .sampling import SUPPRESS_IDS, sample, suppress_stop_tokens
 from .scheduler import DecodeWork, PrefillWork, ScheduleOutput, VerifyWork
 
-logger = logging.getLogger(__name__)
+logger = init_logger(__name__)
 
 # top-N alternatives collected when a batch contains logprobs requests —
 # static (one extra compiled variant per program, lazily); requests asking
@@ -113,23 +116,27 @@ class ModelRunner:
         )
         self.max_blocks = config.cache.max_blocks_per_seq(cfg.max_model_len)
 
-        param_shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), llama_param_specs(cfg)
-        )
+        param_shardings = self._param_shardings()
         if params is None and cfg.checkpoint:
             from ..models.loader import load_checkpoint_params
 
             params = load_checkpoint_params(cfg)
+            if cfg.quantization:
+                # host-side (numpy): the device never holds the bf16 tree
+                from ..models.quantization import quantize_params
+
+                params = quantize_params(cfg, params)
         self._random_weights = params is None
         if params is None:
-            logger.info("initializing random weights for %s", cfg.model)
+            logger.info(
+                "initializing random weights for %s%s", cfg.model,
+                f" ({cfg.quantization} weight-only)" if cfg.quantization
+                else "",
+            )
             # one compiled program materializing the whole tree directly into
             # its sharded HBM layout (eager per-weight RNG dispatches are
             # painfully slow through remote-device tunnels)
-            init_fn = jax.jit(
-                llama.init_params, static_argnums=0, out_shardings=param_shardings
-            )
-            self.params = init_fn(cfg, jax.random.PRNGKey(config.seed))
+            self.params = self._init_device_params(param_shardings)
         else:
             self.params = jax.tree.map(jax.device_put, params, param_shardings)
         kv_sharding = NamedSharding(self.mesh, kv_cache_spec())
@@ -223,6 +230,29 @@ class ModelRunner:
         self._upload_block_fn = None
         self._fetch_block_fn = None
         self._embed_fn = None
+        # -- compile-stall avoidance (the measured live-serving collapse
+        # mode: a first-seen (rows × chunk × width) program key froze
+        # serving 30-60s mid-traffic). The runner tracks which program keys
+        # are compiled; a miss PADS UP to an already-compiled dominating
+        # program (more padding = identical results, bounded extra compute)
+        # and hands the exact key to a background thread that AOT-compiles
+        # it (.lower().compile() — populates jax's in-process+persistent
+        # caches without executing), so the NEXT hit runs specialized.
+        self._compiled_keys: set[tuple] = set()
+        self._aot_exec: dict[tuple, Any] = {}
+        self._bg_inflight: set[tuple] = set()
+        self._bg_lock = threading.Lock()
+        self._bg_executor: ThreadPoolExecutor | None = None
+        self.compile_fallbacks = 0  # profiling: pad-up substitutions taken
+        self.bg_compiles = 0  # profiling: programs compiled off the hot path
+        # warmup disables this so every wave compiles its EXACT program
+        self.fallback_enabled = True
+        # when set (AsyncEngine wires it), background compiles WAIT for the
+        # engine to go idle: on remote-device links the compile service
+        # contends with dispatch, so compiling during traffic steals the
+        # serving time the background thread exists to protect (measured:
+        # ~10x prefill dispatch inflation with compiles in flight)
+        self.idle_check = None  # Callable[[], bool] | None
 
     def _resolve_attention_backend(self) -> str:
         """'auto' → the measured winner for the pool's block size.
@@ -268,10 +298,28 @@ class ModelRunner:
                 "'auto', 'xla', 'pallas', 'pallas_interpret'"
             )
         if backend.startswith("pallas") and self.mesh.size > 1:
-            raise ValueError(
-                "attention_backend='pallas' supports single-device meshes "
-                "only (no GSPMD partition rule for pallas_call)"
-            )
+            # shard_map places kernel instances per device over (dp, tp) —
+            # the axes decode attention parallelizes over with no
+            # collective. pp/sp/ep shard things the kernel can't split
+            # (the pool's block axis, the sequence axis, experts).
+            par = self.config.parallel
+            if (
+                par.pipeline_parallel_size > 1
+                or par.sequence_parallel_size > 1
+                or par.expert_parallel_size > 1
+            ):
+                raise ValueError(
+                    "attention_backend='pallas' supports dp/tp meshes only "
+                    "(pp/sp/ep shard axes the decode kernel cannot split)"
+                )
+            tp = par.tensor_parallel_size
+            if self.config.model.num_heads % tp or (
+                self.config.model.num_kv_heads % tp
+            ):
+                raise ValueError(
+                    f"attention_backend='pallas' under tp={tp} needs "
+                    "num_heads and num_kv_heads divisible by tp"
+                )
         # quantized (fp8) pools are supported: the kernel casts pages to
         # f32 as they stream into VMEM (Mosaic handles f8e4m3 loads on
         # v5e), same upconvert the XLA path does — pinned by
@@ -523,6 +571,7 @@ class ModelRunner:
                     block_tables, staged, k, positions0,
                     backend=self._attention_backend,
                     lora=lora_params, lora_idx=lora_idx, hists=hists,
+                    mesh=self.mesh,
                 )
                 logits = llama.compute_logits(cfg, params, hidden)
                 if want_min_tokens:
@@ -679,6 +728,20 @@ class ModelRunner:
         b_pad = self._batch_bucket(b)
         t = max(len(row) for row in work.token_ids)
         t_pad = sched.bucket_for(t, sched.prefill_buckets)
+        want_lp = any(
+            work.sample[i] and req.sampling.logprobs is not None
+            for i, req in enumerate(work.requests)
+        )
+        want_mt = any(r.sampling.min_tokens > 0 for r in work.requests)
+        nb = self._width_bucket(
+            max((len(r.block_table) for r in work.requests), default=1)
+        )
+        # a first-seen program key pads up to an already-compiled shape
+        # instead of stalling serving on a synchronous XLA compile
+        aot_key = self._pick_prefill_shape(
+            b_pad, t_pad, nb, want_lp, want_mt
+        )
+        _, b_pad, t_pad, nb, _lp, use_mt = aot_key
 
         token_ids = np.zeros((b_pad, t_pad), np.int32)
         positions = np.zeros((b_pad, t_pad), np.int32)
@@ -722,24 +785,22 @@ class ModelRunner:
             seeds[i] = s.seed
             counts[i] = len(req.output_token_ids)
         block_tables = self._block_table_array(
-            [r.block_table for r in work.requests], pad_to=b_pad
+            [r.block_table for r in work.requests], pad_to=b_pad, width=nb
         )
         lora_idx = np.zeros(b_pad, np.int32)
         for i, req in enumerate(work.requests):
             lora_idx[i] = req.lora_index
-        want_lp = any(
-            work.sample[i] and req.sampling.logprobs is not None
-            for i, req in enumerate(work.requests)
-        )
         min_toks, stop_ids_arr = self._stop_id_arrays(work.requests, b_pad)
-        want_mt = bool(min_toks.any())
         tokens, lp = self._run(
             token_ids, positions, block_tables,
             slots.reshape(-1) if slots is not None else np.zeros(1, np.int32),
             context_lens, chunk_lens, write_ids, start_off, lora_idx,
             sample_rows, temps, top_ps, top_ks, seeds=seeds, counts=counts,
             min_toks=min_toks, stop_ids_arr=stop_ids_arr,
-            want_logprobs=want_lp, want_min_tokens=want_mt,
+            # use_mt may exceed want_mt (an mt=True program serves mt=False
+            # batches: suppression is a no-op at min_toks=0)
+            want_logprobs=want_lp, want_min_tokens=use_mt,
+            aot_key=aot_key,
         )
         if lp is None:
             self.last_logprobs = None
@@ -765,13 +826,25 @@ class ModelRunner:
         sched = self.config.scheduler
         b = len(work.requests)
         b_pad = sched.bucket_for(b, sched.decode_buckets)
+        want_lp = any(
+            r.sampling.logprobs is not None for r in work.requests
+        )
+        want_mt = any(r.sampling.min_tokens > 0 for r in work.requests)
+        nb = self._width_bucket(
+            max((len(r.block_table) for r in work.requests), default=1)
+        )
+        # never stall a decode window on a first-seen program key
+        aot_key = self._pick_decode_shape(
+            b_pad, nb, work.window, want_lp, want_mt
+        )
+        _, b_pad, nb, _w, _lp, use_mt = aot_key
 
         first_tokens = np.zeros(b_pad, np.int32)
         first_tokens[:b] = work.token_ids
         positions0 = np.zeros(b_pad, np.int32)
         positions0[:b] = work.positions
         block_tables = self._block_table_array(
-            [r.block_table for r in work.requests], pad_to=b_pad
+            [r.block_table for r in work.requests], pad_to=b_pad, width=nb
         )
         temps = [r.sampling.temperature for r in work.requests] + [0.0] * (b_pad - b)
         top_ps = [r.sampling.top_p for r in work.requests] + [1.0] * (b_pad - b)
@@ -785,15 +858,8 @@ class ModelRunner:
         lora_idx = np.zeros(b_pad, np.int32)
         for i, req in enumerate(work.requests):
             lora_idx[i] = req.lora_index
-        want_lp = any(
-            r.sampling.logprobs is not None for r in work.requests
-        )
         min_toks, stop_ids_arr = self._stop_id_arrays(work.requests, b_pad)
-        want_mt = bool(min_toks.any())
-        result = self._decode_window_fn(
-            self.params,
-            self.lora_params,
-            self.kv_caches,
+        dyn_args = (
             self._put(first_tokens, self._batch1),
             self._put(positions0, self._batch1),
             self._put(block_tables, self._batch2),
@@ -801,16 +867,29 @@ class ModelRunner:
             self._put(np.asarray(temps, np.float32), self._batch1),
             self._put(np.asarray(top_ps, np.float32), self._batch1),
             self._put(np.asarray(top_ks, np.int32), self._batch1),
-            step_key,
+            self._put(step_key, self._rep),
             self._put(seed_vals, self._batch1),
             self._put(has_seed, self._batch1),
             self._put(np.asarray(counts, np.int32), self._batch1),
             self._put(min_toks, self._batch1),
             self._put(stop_ids_arr, self._batch2),
-            window=work.window,
-            want_logprobs=want_lp,
-            want_min_tokens=want_mt,
         )
+        aot = self._aot_exec.get(aot_key)
+        if aot is not None:
+            result = aot(
+                self.params, self.lora_params, self.kv_caches, *dyn_args
+            )
+        else:
+            result = self._decode_window_fn(
+                self.params,
+                self.lora_params,
+                self.kv_caches,
+                *dyn_args,
+                window=work.window,
+                want_logprobs=want_lp,
+                want_min_tokens=use_mt,
+            )
+            self._note_compiled(aot_key)
         if want_lp:
             self.kv_caches, tokens, (lp_w, top_lp_w, top_id_w) = result
             lp_w = np.asarray(jax.device_get(lp_w))
@@ -844,7 +923,7 @@ class ModelRunner:
         self, token_ids, positions, block_tables, slots, context_lens,
         chunk_lens, write_ids, start_off, lora_idx, sample_rows, temps,
         top_ps, top_ks, seeds, counts, min_toks, stop_ids_arr,
-        want_logprobs=False, want_min_tokens=False,
+        want_logprobs=False, want_min_tokens=False, aot_key=None,
     ):
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
@@ -856,10 +935,7 @@ class ModelRunner:
         )
         # sp shards the chunk axis; dp-only meshes leave T unsharded
         tok_sh = self._seq2 if self._sp > 1 else self._batch2
-        result = self._step_fn(
-            self.params,
-            self.lora_params,
-            self.kv_caches,
+        dyn_args = (
             self._put(token_ids, tok_sh),
             self._put(positions, tok_sh),
             self._put(block_tables, self._batch2),
@@ -875,15 +951,29 @@ class ModelRunner:
             self._put(np.asarray(temps, np.float32), self._batch1),
             self._put(np.asarray(top_ps, np.float32), self._batch1),
             self._put(np.asarray(top_ks, np.int32), self._batch1),
-            step_key,
+            self._put(step_key, self._rep),
             self._put(seed_vals, self._batch1),
             self._put(has_seed, self._batch1),
             self._put(np.asarray(counts, np.int32), self._batch1),
             self._put(min_toks, self._batch1),
             self._put(stop_ids_arr, self._batch2),
-            want_logprobs=want_logprobs,
-            want_min_tokens=want_min_tokens,
         )
+        aot = self._aot_exec.get(aot_key) if aot_key is not None else None
+        if aot is not None:
+            result = aot(
+                self.params, self.lora_params, self.kv_caches, *dyn_args
+            )
+        else:
+            result = self._step_fn(
+                self.params,
+                self.lora_params,
+                self.kv_caches,
+                *dyn_args,
+                want_logprobs=want_logprobs,
+                want_min_tokens=want_min_tokens,
+            )
+            if aot_key is not None:
+                self._note_compiled(aot_key)
         if want_logprobs:
             self.kv_caches, tokens, lp = result
             lp = tuple(np.asarray(jax.device_get(x)) for x in lp)
@@ -922,6 +1012,291 @@ class ModelRunner:
                 stop_ids[i, j] = tid
         return min_toks, stop_ids
 
+    # -- compile-stall avoidance -------------------------------------------
+    #
+    # The measured live-serving collapse mode (ROUND3.md): traffic's first
+    # hit on a new (rows × chunk × width) program key froze serving for a
+    # 30-60s XLA compile while queued work starved, and the warmup ladder
+    # cannot enumerate the full key crossproduct in reasonable boot time.
+    # Structural fix: a program-key MISS never compiles on the hot path
+    # when any already-compiled program DOMINATES the needed shape (every
+    # axis >= needed) — padding further up is semantically identical, just
+    # more compute — and the exact program is AOT-compiled concurrently on
+    # a background thread (jit.lower().compile() traces/compiles without
+    # executing; XLA compiles release the GIL, so serving dispatches
+    # continue). Once ready, the next hit dispatches the specialized
+    # executable. Serving therefore starts after warming only a COARSE
+    # shape lattice and migrates to exact programs under live traffic with
+    # zero stalls.
+
+    @property
+    def _dynamic_programs_ok(self) -> bool:
+        # the sp prefill path has its own step fn and shardings; the
+        # fallback machinery covers the common paged path
+        return self._sp == 1
+
+    def _sds(self, shape, dtype, sharding):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+    def _aval_tree(self, tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=x.sharding
+            ),
+            tree,
+        )
+
+    def _pick_prefill_shape(
+        self, b_pad: int, t_pad: int, nb: int, want_lp: bool, want_mt: bool
+    ) -> tuple:
+        """The program KEY to dispatch with: exact when that program is
+        compiled (or nothing compiled dominates it — cold start compiles
+        synchronously); otherwise the cheapest compiled dominating key,
+        with the exact program queued for background compile.
+
+        Dominance: every shape axis >= needed; want_logprobs must match
+        exactly (it changes the output structure); a want_min_tokens=True
+        program dominates False (suppression is a no-op at min_toks=0)."""
+        key = ("prefill", b_pad, t_pad, nb, want_lp, want_mt)
+        if not self._dynamic_programs_ok or not self.fallback_enabled:
+            return key
+        with self._bg_lock:
+            if key in self._compiled_keys:
+                return key
+            candidates = [
+                k for k in self._compiled_keys
+                if k[0] == "prefill" and k[4] == want_lp and k[5] >= want_mt
+                and k[1] >= b_pad and k[2] >= t_pad and k[3] >= nb
+            ]
+        if not candidates:
+            return key
+        self.compile_fallbacks += 1
+        self._bg_compile(key)
+        return min(candidates, key=lambda k: (k[1] * k[2], k[3], k[5]))
+
+    def _pick_decode_shape(
+        self, b_pad: int, nb: int, window: int, want_lp: bool, want_mt: bool
+    ) -> tuple:
+        """Like _pick_prefill_shape for the fused decode window. `window`
+        is never substituted: it is semantic (tokens generated, pool blocks
+        the scheduler reserved) — a larger window would scatter past the
+        reserved blocks."""
+        key = ("decode", b_pad, nb, window, want_lp, want_mt)
+        if not self._dynamic_programs_ok or not self.fallback_enabled:
+            return key
+        with self._bg_lock:
+            if key in self._compiled_keys:
+                return key
+            candidates = [
+                k for k in self._compiled_keys
+                if k[0] == "decode" and k[3] == window
+                and k[4] == want_lp and k[5] >= want_mt
+                and k[1] >= b_pad and k[2] >= nb
+            ]
+        if not candidates:
+            return key
+        self.compile_fallbacks += 1
+        self._bg_compile(key)
+        return min(candidates, key=lambda k: (k[1], k[2], k[5]))
+
+    def _note_compiled(self, key: tuple) -> None:
+        with self._bg_lock:
+            self._compiled_keys.add(key)
+
+    def _bg_compile(self, key: tuple) -> None:
+        with self._bg_lock:
+            if key in self._bg_inflight or key in self._compiled_keys:
+                return
+            self._bg_inflight.add(key)
+            if self._bg_executor is None:
+                self._bg_executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="xla-bg-compile"
+                )
+        self._bg_executor.submit(self._bg_compile_job, key)
+
+    def shutdown(self) -> None:
+        """Cancel queued background compiles — each is a 30-60s XLA compile
+        behind an idle-gate sleep, and concurrent.futures' atexit hook
+        would otherwise drain them all before the interpreter can exit."""
+        with self._bg_lock:
+            ex, self._bg_executor = self._bg_executor, None
+        if ex is not None:
+            ex.shutdown(wait=False, cancel_futures=True)
+
+    def _bg_compile_job(self, key: tuple) -> None:
+        try:
+            # idle gate: wait (bounded) for a traffic lull before compiling.
+            # On remote-device links the compile service contends with
+            # dispatch — compiling during traffic steals the serving time
+            # this thread exists to protect (measured ~10x prefill dispatch
+            # inflation with compiles in flight)
+            idle = self.idle_check
+            if idle is not None:
+                import time as _time
+
+                deadline = _time.monotonic() + 600.0
+                while not idle():
+                    if _time.monotonic() > deadline:
+                        return  # still busy; the key stays un-compiled and
+                        # the fallback keeps absorbing it
+                    _time.sleep(0.25)
+            if self._compile_key_now(key):
+                self.bg_compiles += 1
+                logger.info(
+                    "background-compiled %s program %s", key[0], key[1:]
+                )
+        except Exception:
+            logger.exception("background compile failed for %s", key)
+        finally:
+            with self._bg_lock:
+                self._bg_inflight.discard(key)
+
+    def _compile_key_now(self, key: tuple) -> bool:
+        """AOT-compile one program key (.lower().compile() — traces and
+        compiles WITHOUT executing: no tokens, no pool writes, no pool
+        capacity requirement). Returns True when a new executable landed."""
+        with self._bg_lock:
+            if key in self._compiled_keys:
+                return False
+        if self._sleeping_params_host is not None or self.kv_caches is None:
+            return False  # parked; avals unavailable — compiles lazily later
+        # avals, not live arrays: the step thread donates kv_caches every
+        # dispatch, and lowering must not race buffer invalidation
+        params_av = self._aval_tree(self.params)
+        lora_av = (
+            self._aval_tree(self.lora_params) if self._use_lora else None
+        )
+        kv_av = self._aval_tree(self.kv_caches)
+        if key[0] == "prefill":
+            _, b, t, nb, want_lp, want_mt = key
+            lowered = self._step_fn.lower(
+                params_av, lora_av, kv_av,
+                *self._prefill_avals(b, t, nb),
+                want_logprobs=want_lp, want_min_tokens=want_mt,
+            )
+        else:
+            _, b, nb, window, want_lp, want_mt = key
+            lowered = self._decode_window_fn.lower(
+                params_av, lora_av, kv_av,
+                *self._decode_avals(b, nb),
+                window=window, want_logprobs=want_lp,
+                want_min_tokens=want_mt,
+            )
+        compiled = lowered.compile()
+        with self._bg_lock:
+            self._aot_exec[key] = compiled
+            self._compiled_keys.add(key)
+        return True
+
+    def precompile_dominating(self) -> int:
+        """Compile the DOMINATING program lattice directly: full batch ×
+        each chunk bucket × the TOP width bucket for prefill, plus the top
+        decode bucket × top width × each pow2 window. Shapes are virtual
+        (no execution), so this works regardless of pool-vs-max_model_len
+        sizing. Afterwards every finer program key has a pad-up fallback —
+        serving cannot stall on a synchronous compile. This is the
+        engine's warmup(scope=\"coarse\")."""
+        if not self._dynamic_programs_ok:
+            return 0
+        sched = self.config.scheduler
+        top_w = self._width_bucket(self.max_blocks)
+        b_top = self._batch_bucket(sched.max_num_seqs)
+        t_top = max(sched.prefill_buckets)
+        n = 0
+        for t in sorted(set(sched.prefill_buckets)):
+            if self._compile_key_now(("prefill", b_top, t, top_w,
+                                      False, False)):
+                n += 1
+        # the pow2 ROWS ladder at (top chunk, top width): rows are the
+        # expensive padding axis (each padded row computes t_pad tokens of
+        # dense FLOPs), so a few extra programs here turn the worst-case
+        # fallback from "jump to full batch" (up to max_num_seqs/rows x
+        # compute) into "pad width only" (~1.2x HBM)
+        b = 1
+        while b < b_top:
+            if self._compile_key_now(("prefill", b, t_top, top_w,
+                                      False, False)):
+                n += 1
+            b *= 2
+        top_window = 1
+        w = 1
+        while w <= sched.decode_window:
+            top_window = w
+            for d in sorted(set(sched.decode_buckets)):
+                if d > sched.max_num_seqs:
+                    continue  # unreachable batch bucket
+                if self._compile_key_now(("decode", d, top_w, w,
+                                          False, False)):
+                    n += 1
+            w *= 2
+        # min_tokens variants at the top shapes: an mt=True program
+        # DOMINATES mt=False (suppression no-ops at min_toks=0), so these
+        # two keep even min_tokens traffic stall-free after a coarse boot
+        d_top = max(
+            (d for d in sched.decode_buckets if d <= sched.max_num_seqs),
+            default=min(sched.decode_buckets),
+        )
+        for key in (
+            ("prefill", b_top, t_top, top_w, False, True),
+            ("decode", d_top, top_w, top_window, False, True),
+        ):
+            if self._compile_key_now(key):
+                n += 1
+        logger.info("precompiled %d dominating programs", n)
+        return n
+
+    def _prefill_avals(self, b: int, t: int, nb: int):
+        """ShapeDtypeStructs mirroring _run's dynamic args for one prefill
+        shape — MUST stay in lockstep with the _step_fn call in _run."""
+        bs = self.config.cache.block_size
+        nbw = (t - 1) // bs + 2
+        i32, f32 = jnp.int32, jnp.float32
+        b1, b2, rep = self._batch1, self._batch2, self._rep
+        s = self._sds
+        return (
+            s((b, t), i32, b2),       # token_ids
+            s((b, t), i32, b2),       # positions
+            s((b, nb), i32, b2),      # block_tables
+            s((1,), i32, rep),        # slots placeholder (paged path)
+            s((b,), i32, b1),         # context_lens
+            s((b,), i32, b1),         # chunk_lens
+            s((b, nbw), i32, b2),     # write_ids
+            s((b,), i32, b1),         # start_off
+            s((b,), i32, b1) if self._use_lora else None,  # lora_idx
+            s((b,), i32, b1),         # sample_rows
+            s((b,), f32, b1),         # temperature
+            s((b,), f32, b1),         # top_p
+            s((b,), i32, b1),         # top_k
+            s(self._rng.shape, self._rng.dtype, rep),  # rng
+            s((b,), jnp.uint32, b1),  # seeds
+            s((b,), jnp.bool_, b1),   # has_seed
+            s((b,), i32, b1),         # counts
+            s((b,), i32, b1),         # min_toks
+            s((b, SUPPRESS_IDS), i32, b2),  # stop_ids
+        )
+
+    def _decode_avals(self, b: int, nb: int):
+        """ShapeDtypeStructs mirroring _execute_decode's dynamic args —
+        MUST stay in lockstep with the _decode_window_fn call."""
+        i32, f32 = jnp.int32, jnp.float32
+        b1, b2, rep = self._batch1, self._batch2, self._rep
+        s = self._sds
+        return (
+            s((b,), i32, b1),         # first_tokens
+            s((b,), i32, b1),         # positions0
+            s((b, nb), i32, b2),      # block_tables
+            s((b,), i32, b1) if self._use_lora else None,  # lora_idx
+            s((b,), f32, b1),         # temperature
+            s((b,), f32, b1),         # top_p
+            s((b,), i32, b1),         # top_k
+            s(self._rng.shape, self._rng.dtype, rep),  # base_key
+            s((b,), jnp.uint32, b1),  # seeds
+            s((b,), jnp.bool_, b1),   # has_seed
+            s((b,), i32, b1),         # counts0
+            s((b,), i32, b1),         # min_toks
+            s((b, SUPPRESS_IDS), i32, b2),  # stop_ids
+        )
+
     @staticmethod
     def _pow2(n: int) -> int:
         """Next power of two — bounds compiled program count to log2 sizes."""
@@ -938,28 +1313,32 @@ class ModelRunner:
         (dp=1 meshes take the same path, so there is one path to test)."""
         return jax.device_put(x, sharding)
 
+    def _width_bucket(self, longest: int) -> int:
+        """Block-table width bucket for the widest table in a batch: pow2
+        with a configurable FLOOR (default 64 blocks ≈ 1k tokens): every
+        width is its own compiled program, and the fine-grained ladder
+        below the floor bought little (short-context gathers are cheap to
+        pad) while costing a compile per boundary crossing. Benches with
+        exactly-warmed shapes set width_floor_blocks=1."""
+        floor = self.config.scheduler.width_floor_blocks
+        return max(1, min(max(floor, self._pow2(longest)), self.max_blocks))
+
     def _block_table_array(
-        self, tables: list[list[int]], pad_to: int | None = None
+        self,
+        tables: list[list[int]],
+        pad_to: int | None = None,
+        width: int | None = None,
     ) -> np.ndarray:
         """(B, nb) table where nb is the *bucketed max blocks in use* — not
         max_model_len/block_size. The gathered context is nb*block_size wide,
         so sizing nb to the batch's real context (round-1 weak #2: the full
         max-len gather per layer per step was the dominant waste) cuts HBM
         traffic by max_model_len/actual_len; power-of-two nb keeps the
-        compiled-program set logarithmic."""
+        compiled-program set logarithmic. `width` overrides the bucket (the
+        compile-fallback path pads to an already-compiled width)."""
         b = pad_to or len(tables)
         longest = max((len(t) for t in tables), default=1)
-        # pow2 with a configurable FLOOR (default 64 blocks ≈ 1k tokens):
-        # every width is its own compiled program, and the fine-grained
-        # ladder below the floor bought little (short-context gathers are
-        # cheap to pad) while costing a 30-60s mid-serving compile stall
-        # each time a batch first crossed a width boundary — the measured
-        # live-stack collapse mode. The floor turns those widths into ONE
-        # program; the ladder above it stays logarithmic. Benches with
-        # exactly-warmed shapes set width_floor_blocks=1.
-        floor = self.config.scheduler.width_floor_blocks
-        nb = min(max(floor, self._pow2(longest)), self.max_blocks)
-        nb = max(nb, 1)
+        nb = width if width is not None else self._width_bucket(longest)
         arr = np.zeros((b, nb), np.int32)  # 0 = null page
         for i, tbl in enumerate(tables):
             arr[i, : len(tbl)] = tbl
@@ -1140,18 +1519,41 @@ class ModelRunner:
         # drop the KV pool too; sleeping engines are drained by the router
         self.kv_caches = None
 
+    def _param_shardings(self):
+        """NamedSharding tree for the (possibly quantized) param tree."""
+        cfg = self.config.model
+        specs = llama_param_specs(cfg)
+        if cfg.quantization:
+            from ..models.quantization import quantize_specs
+
+            specs = quantize_specs(cfg, specs)
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs)
+
+    def _init_device_params(self, shardings):
+        """Random-init (and quantize, when configured) in ONE compiled
+        program straight into the sharded HBM layout — XLA frees each bf16
+        leaf as soon as its int8 twin exists, so the peak stays near the
+        int8 tree, never the full bf16 tree."""
+        cfg = self.config.model
+
+        def build(c, key):
+            p = llama.init_params(c, key)
+            if c.quantization:
+                from ..models.quantization import quantize_params
+
+                p = quantize_params(c, p)
+            return p
+
+        init_fn = jax.jit(build, static_argnums=0, out_shardings=shardings)
+        return init_fn(cfg, jax.random.PRNGKey(self.config.seed))
+
     def wake(self) -> None:
         if not self.is_sleeping:
             return
         cfg = self.config
-        param_shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), llama_param_specs(cfg.model)
-        )
+        param_shardings = self._param_shardings()
         if isinstance(self._sleeping_params_host, str):  # discarded
-            init_fn = jax.jit(
-                llama.init_params, static_argnums=0, out_shardings=param_shardings
-            )
-            self.params = init_fn(cfg.model, jax.random.PRNGKey(cfg.seed))
+            self.params = self._init_device_params(param_shardings)
         else:
             self.params = jax.tree.map(
                 jax.device_put, self._sleeping_params_host, param_shardings

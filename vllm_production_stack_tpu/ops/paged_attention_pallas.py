@@ -320,6 +320,60 @@ def hist_decode_attention(
     )(hist_len, jnp.reshape(step_k, (1,)), q, hist_k, hist_v, staged_k, staged_v)
 
 
+def paged_decode_attention_sharded(
+    mesh,
+    q: jax.Array,  # (B, nh, D) — batch sharded over dp, heads over tp
+    kv: jax.Array,  # (2, num_blocks, bs, kvh, D) — kv heads over tp
+    block_tables: jax.Array,  # (B, nb)
+    hist_len: jax.Array,  # (B,)
+    staged_k: jax.Array,  # (W, B, kvh, D)
+    staged_v: jax.Array,  # (W, B, kvh, D)
+    step_k: jax.Array,
+    *,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """The kernel under tensor/data parallelism: pallas_call has no GSPMD
+    partition rule, so shard_map places one kernel instance per device —
+    each computes its own tp-shard of the heads (KV heads shard cleanly:
+    q head g attends kv head g//q_per_kv, and megatron sharding keeps whole
+    GQA groups per shard) over its own dp-shard of the rows. No collective
+    is needed: decode attention is embarrassingly parallel over (row, head)
+    once KV pages are head-sharded, which is exactly kv_cache_spec's layout
+    (parallel/sharding.py — 'each chip only ever touches its own heads'
+    pages')."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DP_AXIS, TP_AXIS
+
+    tp = mesh.shape[TP_AXIS]
+    nh, kvh = q.shape[1], kv.shape[3]
+    if nh % tp or kvh % tp:
+        raise ValueError(
+            f"pallas under tp={tp} needs heads divisible by tp "
+            f"(num_heads={nh}, num_kv_heads={kvh})"
+        )
+    fn = shard_map(
+        functools.partial(
+            paged_decode_attention, scale=scale, interpret=interpret
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(DP_AXIS, TP_AXIS, None),  # q
+            P(None, None, None, TP_AXIS, None),  # kv pool
+            P(DP_AXIS, None),  # block tables
+            P(DP_AXIS),  # hist_len
+            P(None, DP_AXIS, TP_AXIS, None),  # staged k
+            P(None, DP_AXIS, TP_AXIS, None),  # staged v
+            P(),  # step_k scalar
+        ),
+        out_specs=P(DP_AXIS, TP_AXIS, None),
+        check_rep=False,
+    )
+    return fn(q, kv, block_tables, hist_len, staged_k, staged_v, step_k)
+
+
 @functools.partial(jax.jit, static_argnames=("scale", "interpret"))
 def paged_decode_attention(
     q: jax.Array,  # (B, nh, D) — decode queries, one token per row
