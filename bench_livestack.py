@@ -236,13 +236,25 @@ def run_livestack(
 
         if warmup_wave:
             # one traffic wave with DIFFERENT prompt content: any program
-            # key the --warmup ladder missed compiles here, then the
-            # prefix cache outcome matches steady-state (the measured wave
+            # key the --warmup ladder missed is DISCOVERED here (the
+            # runner pads up and queues the exact key), then the prefix
+            # cache outcome matches steady-state (the measured wave
             # computes its own fresh KV, reusing only in-wave history)
             asyncio.run(_drive(
                 url, model, users, rounds, answer_tokens, sys_tokens,
                 ramp_gap_s, q_range, seed=seed + 555_000,
             ))
+            # let the idle-gated background compiles drain so the measured
+            # wave dispatches exact programs (compiles contend with
+            # dispatch over remote-device links; the gate defers them to
+            # this gap)
+            for _ in range(240):
+                progs = _fetch_json(
+                    f"http://127.0.0.1:{engine_port}/debug/timing"
+                ).get("programs", {})
+                if not progs.get("bg_pending", 0):
+                    break
+                time.sleep(5)
         # counters are cumulative: snapshot before/after and subtract (an
         # in-place reset would race the step thread's accumulates)
         t_before = _fetch_json(f"http://127.0.0.1:{engine_port}/debug/timing")
@@ -251,6 +263,7 @@ def run_livestack(
             ramp_gap_s, q_range, seed=seed,
         ))
         t_after = _fetch_json(f"http://127.0.0.1:{engine_port}/debug/timing")
+        programs = t_after.get("programs", {})
         eng_t = {
             k: t_after["engine"][k] - t_before["engine"][k]
             for k in t_after["engine"]
@@ -267,7 +280,7 @@ def run_livestack(
             "busy_share_of_elapsed": round(
                 busy / summary["elapsed_s"], 3
             ) if summary["elapsed_s"] else None,
-            "submit_lock_wait_s": round(loop_t["submit_lock_wait_s"], 2),
+            "submit_s": round(loop_t.get("submit_s", 0.0), 2),
             "submits": loop_t["submits"],
             "sched_s": round(eng_t["sched_s"], 2),
             "post_s": round(eng_t["post_s"], 2),
@@ -277,6 +290,9 @@ def run_livestack(
             "decode_s": round(eng_t["decode_s"], 2),
             "decode_n": eng_t["decode_n"],
             "decode_tokens": eng_t["decode_tokens"],
+            "compile_fallbacks": programs.get("compile_fallbacks"),
+            "bg_compiles": programs.get("bg_compiles"),
+            "compiled_keys": programs.get("compiled_keys"),
         }
         summary["users"] = users
         summary["rounds"] = rounds

@@ -298,3 +298,114 @@ def test_width_floor_blocks_config():
     # the ladder still grows past the floor and caps at max_blocks (256)
     wide = [list(range(1, 201))]  # 200 blocks
     assert runner(64)._block_table_array(wide).shape[1] == 256
+
+
+def test_compile_fallback_pads_up_to_warm_program():
+    """A first-seen (rows x chunk x width) program key must NOT compile on
+    the hot path when a compiled program dominates it: the runner pads up
+    (identical results) and backgrounds the exact compile — the structural
+    fix for the live-serving compile-stall collapse (ROUND3.md)."""
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    cfg = ModelConfig.tiny()
+    base = EngineConfig(
+        model=cfg,
+        cache=CacheConfig(block_size=8, num_blocks=128),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=128,
+            decode_buckets=(2, 4), prefill_buckets=(32, 128),
+            decode_window=4, width_floor_blocks=1,
+        ),
+    )
+    prompts = [
+        list(np.random.RandomState(i).randint(1, cfg.vocab_size, size=9))
+        for i in range(1)
+    ]
+    sampling = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+
+    control = LLMEngine(base)
+    want = [o["token_ids"] for o in control.generate(prompts, sampling)]
+
+    engine = LLMEngine(base)
+    # warm ONE coarse program: full batch, big chunk, wide tables
+    warm_prompts = [
+        list(np.random.RandomState(50 + i).randint(
+            1, cfg.vocab_size, size=100
+        ))
+        for i in range(4)
+    ]
+    engine.generate(warm_prompts, sampling)
+    warmed_keys = set(engine.runner._compiled_keys)
+    assert any(k[0] == "prefill" for k in warmed_keys)
+    before = engine.runner.compile_fallbacks
+
+    # a small request whose exact key was never compiled: must pad up to
+    # the warm coarse program, not compile a new one synchronously
+    got = [o["token_ids"] for o in engine.generate(prompts, sampling)]
+    assert got == want
+    assert engine.runner.compile_fallbacks > before
+    # and the exact programs eventually land via the background thread
+    ex = engine.runner._bg_executor
+    if ex is not None:
+        ex.shutdown(wait=True)
+    assert engine.runner.bg_compiles > 0
+    # once background-compiled, the same request dispatches the exact
+    # (AOT) program with no fallback and identical output
+    engine.scheduler.pool.clear_prefix_cache()
+    before = engine.runner.compile_fallbacks
+    got2 = [o["token_ids"] for o in engine.generate(prompts, sampling)]
+    assert got2 == want
+    assert any(k in engine.runner._aot_exec for k in
+               engine.runner._compiled_keys)
+
+
+def test_coarse_warmup_precompiles_dominating_lattice():
+    """warmup(scope='coarse') AOT-compiles the dominating programs without
+    generating tokens — afterwards EVERY runtime shape has a fallback, even
+    widths the pool could never physically reach with real requests."""
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    cfg = ModelConfig.tiny()
+    engine = LLMEngine(EngineConfig(
+        model=cfg,
+        # pool far smaller than max_num_seqs * max_model_len: the
+        # generate-based coarse pass could never reach the top width
+        cache=CacheConfig(block_size=8, num_blocks=24),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=64,
+            decode_buckets=(2, 4), prefill_buckets=(32, 64),
+            decode_window=4, width_floor_blocks=1,
+        ),
+    ))
+    n = engine.warmup(scope="coarse")
+    assert n > 0
+    keys = set(engine.runner._compiled_keys)
+    top_w = engine.runner._width_bucket(engine.runner.max_blocks)
+    b_top = engine.runner._batch_bucket(4)
+    # every chunk bucket exists at full batch and TOP width
+    for t in (32, 64):
+        assert ("prefill", b_top, t, top_w, False, False) in keys
+    # every pow2 window exists at the top decode bucket and TOP width
+    for w in (1, 2, 4):
+        assert ("decode", 4, top_w, w, False, False) in keys
+    assert engine.scheduler.pool.stats.queries == 0  # no tokens generated
+    # zero generation happened; pool is untouched and serving works
+    before = engine.runner.compile_fallbacks
+    out = engine.generate(
+        [list(np.random.RandomState(1).randint(1, cfg.vocab_size, size=12))],
+        SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True),
+    )
+    assert len(out[0]["token_ids"]) == 4
+    assert engine.runner.compile_fallbacks > before  # padded up, no stall

@@ -358,3 +358,57 @@ def test_unknown_model_404(srv):
         return r.status
 
     assert run_with_client(srv, go) == 404
+
+
+def test_step_loop_recovers_from_transient_fault():
+    """A transient device fault (e.g. a dropped remote-compile connection)
+    fails the in-flight requests but must NOT brick the engine — the step
+    loop aborts in-flight work and keeps serving (self-healing; the
+    reference leans on k8s restarts for this)."""
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.async_engine import AsyncEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    engine = LLMEngine(EngineConfig.tiny())
+    async_engine = AsyncEngine(engine)
+    inner = engine.runner.execute
+    state = {"fail_next": 1}
+
+    def flaky_execute(work):
+        if state["fail_next"] > 0:
+            state["fail_next"] -= 1
+            raise RuntimeError("INTERNAL: transient tunnel fault")
+        return inner(work)
+
+    engine.runner.execute = flaky_execute
+
+    async def go():
+        async_engine.start(asyncio.get_running_loop())
+        try:
+            # first request hits the injected fault -> terminal error output
+            outs = []
+            async for out in async_engine.generate(
+                prompt_token_ids=[1, 2, 3, 4],
+                sampling=SamplingParams(max_tokens=4, temperature=0.0,
+                                        ignore_eos=True),
+            ):
+                outs.append(out)
+            assert outs[-1].finish_reason == "error"
+            assert async_engine.is_healthy  # recovered, not dead
+            # second request must serve normally
+            toks = []
+            async for out in async_engine.generate(
+                prompt_token_ids=[5, 6, 7, 8],
+                sampling=SamplingParams(max_tokens=4, temperature=0.0,
+                                        ignore_eos=True),
+            ):
+                toks.extend(out.new_token_ids)
+            return toks
+        finally:
+            async_engine.shutdown()
+
+    toks = asyncio.run(go())
+    assert len(toks) == 4
+    assert engine.scheduler.pool.num_free == engine.scheduler.pool.num_usable \
+        or not engine.scheduler.has_unfinished()

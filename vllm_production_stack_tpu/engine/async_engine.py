@@ -10,9 +10,11 @@ always talks to over HTTP (request.py:99-105).
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import threading
 import time
+from collections import deque
 from collections.abc import AsyncIterator
 
 from .engine import LLMEngine
@@ -32,6 +34,15 @@ class AsyncEngine:
         self.engine = engine
         self._lock = threading.Lock()
         self._queues: dict[str, asyncio.Queue[RequestOutput]] = {}
+        # deferred admissions: (rid, token_ids, sampling, lora_name).
+        # Submissions NEVER take the engine lock — on a busy engine the step
+        # thread holds it nearly continuously (a full device step each
+        # time), and Python locks aren't fair, so a contending submit sat
+        # behind multiple steps (measured: 1.7s mean submit wait under the
+        # north-star load). The step thread drains this queue at the top of
+        # every iteration instead.
+        self._pending: deque = deque()
+        self._pending_lock = threading.Lock()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._wake = threading.Event()
         self._stop = False
@@ -42,7 +53,7 @@ class AsyncEngine:
         # engine lock behind it
         self.loop_timing = {
             "steps": 0, "busy_s": 0.0, "idle_s": 0.0,
-            "submits": 0, "submit_lock_wait_s": 0.0,
+            "submits": 0, "submit_s": 0.0,  # tokenize+validate+queue time
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -52,6 +63,14 @@ class AsyncEngine:
             self.shutdown()  # restartable (server rebuilt around one engine)
         self._loop = loop
         self._stop = False
+        # background program compiles defer to traffic (model_runner
+        # _bg_compile_job): compile only when nothing is queued or running
+        runner = getattr(self.engine, "runner", None)
+        if runner is not None:
+            runner.idle_check = lambda: (
+                not self.engine.scheduler.has_unfinished()
+                and not self._pending
+            )
         self._thread = threading.Thread(
             target=self._step_loop, name="engine-step", daemon=True
         )
@@ -62,6 +81,9 @@ class AsyncEngine:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        runner = getattr(self.engine, "runner", None)
+        if runner is not None and hasattr(runner, "shutdown"):
+            runner.shutdown()  # cancel queued background compiles
         host_tier = getattr(self.engine, "host_tier", None)
         remote = getattr(self.engine, "remote_tier", None)
         if host_tier is not None:
@@ -79,21 +101,55 @@ class AsyncEngine:
             and self._step_error is None
         )
 
+    # consecutive step failures tolerated before the engine reports dead:
+    # transient device faults (a dropped remote-compile connection, a
+    # preempted dispatch) fail the in-flight requests but must not brick
+    # the server — the reference stack gets this resilience from k8s
+    # restart + readiness probes; a self-healing step loop is strictly
+    # better (no pod churn, warm compile caches survive)
+    MAX_CONSECUTIVE_STEP_FAILURES = 3
+
     def _step_loop(self) -> None:
         lt = self.loop_timing
+        failures = 0
         while not self._stop:
             t0 = time.perf_counter()
             try:
                 with self._lock:
+                    self._drain_pending()
                     has_work = (
                         not self.engine.is_sleeping and self.engine.has_unfinished()
                     )
                     outputs = self.engine.step() if has_work else []
-            except Exception as e:  # surface to /health, fail queued requests
-                logger.exception("engine step failed")
-                self._step_error = e
-                self._fail_all(e)
-                return
+                failures = 0
+            except Exception as e:
+                failures += 1
+                if failures >= self.MAX_CONSECUTIVE_STEP_FAILURES:
+                    # persistent fault: surface to /health, fail everything
+                    logger.exception(
+                        "engine step failed %d times consecutively; "
+                        "marking engine dead", failures,
+                    )
+                    self._step_error = e
+                    self._fail_all(e)
+                    return
+                # transient fault: the failed step may have left requests
+                # half-executed — abort ALL in-flight work (clients get a
+                # terminal error output), then keep serving new requests
+                logger.exception(
+                    "engine step failed (attempt %d/%d); aborting in-flight "
+                    "requests and continuing",
+                    failures, self.MAX_CONSECUTIVE_STEP_FAILURES,
+                )
+                try:
+                    with self._lock:
+                        self._abort_all_inflight(e)
+                except Exception:
+                    logger.exception("in-flight abort failed; engine dead")
+                    self._step_error = e
+                    self._fail_all(e)
+                    return
+                continue
             if has_work:
                 lt["steps"] += 1
                 lt["busy_s"] += time.perf_counter() - t0
@@ -104,6 +160,75 @@ class AsyncEngine:
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
                 lt["idle_s"] += time.perf_counter() - t1
+
+    def _drain_pending(self) -> None:
+        """Admit queued submissions (caller holds the engine lock). The
+        per-item work is trivial (token ids precomputed, validation done at
+        submit time); a failure here is a race (e.g. LoRA unloaded after
+        validation) and fails that request's stream, never the loop."""
+        while True:
+            with self._pending_lock:
+                if not self._pending:
+                    return
+                rid, token_ids, sampling, lora_name = self._pending.popleft()
+            if rid not in self._queues:
+                continue  # consumer vanished (disconnect/abort) pre-admission
+            if self.engine.is_sleeping:
+                # raced sleep(): a silent hang (admitted but never stepped)
+                # becomes the same deterministic error the submit-time check
+                # gives
+                q = self._queues.get(rid)
+                if q is not None and self._loop is not None:
+                    out = RequestOutput(
+                        request_id=rid, new_token_ids=[], finished=True,
+                        finish_reason="error",
+                    )
+                    out.text_delta = (
+                        "engine error: engine is sleeping; wake it before "
+                        "sending requests"
+                    )
+                    self._loop.call_soon_threadsafe(q.put_nowait, out)
+                continue
+            try:
+                self.engine.add_request(
+                    request_id=rid,
+                    prompt_token_ids=token_ids,
+                    sampling=sampling,
+                    lora_name=lora_name,
+                )
+            except Exception as e:
+                logger.warning("deferred admission failed for %s: %s", rid, e)
+                q = self._queues.get(rid)
+                if q is not None and self._loop is not None:
+                    out = RequestOutput(
+                        request_id=rid, new_token_ids=[], finished=True,
+                        finish_reason="error",
+                    )
+                    out.text_delta = f"engine error: {e}"
+                    self._loop.call_soon_threadsafe(q.put_nowait, out)
+
+    def _abort_all_inflight(self, exc: Exception) -> None:
+        """Terminal-error every queued request and reap its engine state
+        (caller holds the engine lock)."""
+        with self._pending_lock:
+            # unadmitted requests die here too — leaving them pending would
+            # re-admit them (rid still in _queues until the event loop runs)
+            # and decode to max_tokens into a stream that already ended.
+            # Snapshot _queues under the SAME lock _submit inserts under, so
+            # a submission racing this abort either fully precedes it (and
+            # dies here) or fully follows it (and survives to be admitted)
+            self._pending.clear()
+            rids = list(self._queues)
+        for rid in rids:
+            self.engine.abort_request(rid)
+            q = self._queues.pop(rid, None)
+            if q is not None and self._loop is not None:
+                out = RequestOutput(
+                    request_id=rid, new_token_ids=[], finished=True,
+                    finish_reason="error",
+                )
+                out.text_delta = f"engine error: {exc}"
+                self._loop.call_soon_threadsafe(q.put_nowait, out)
 
     def _dispatch(self, out: RequestOutput) -> None:
         q = self._queues.get(out.request_id)
@@ -124,38 +249,42 @@ class AsyncEngine:
 
     # -- serving API -------------------------------------------------------
 
+    _rid_counter = itertools.count()
+
     def _submit(
         self, request_id, prompt, prompt_token_ids, sampling, q, lora_name=None
     ) -> str:
-        """Runs in an executor: the step thread may hold the lock for a full
-        device step (or a 10-40s first compile) — never block the event loop
-        on it."""
+        """Runs in an executor. Deliberately LOCK-FREE: tokenization +
+        validation need no engine state mutation, and admission is deferred
+        to the step thread via the pending queue — a submit contending for
+        the engine lock used to wait out whole device steps (unfair lock +
+        near-100% hold time = 1.7s mean TTFT tax under load)."""
         t0 = time.perf_counter()
-        self._lock.acquire()
-        self.loop_timing["submits"] += 1
-        self.loop_timing["submit_lock_wait_s"] += time.perf_counter() - t0
-        try:
-            if self.engine.is_sleeping:
-                raise EngineSleepingError(
-                    "engine is sleeping; wake it before sending requests"
-                )
-            if request_id is not None and (
-                request_id in self._queues or self.engine.has_request(request_id)
-            ):
-                # client-supplied ids (X-Request-Id) must not collide with an
-                # in-flight request: colliding ids would cross-wire output
-                # queues and prefix-cache hash chains
-                request_id = f"{request_id}-{id(q) & 0xFFFFFF:x}"
-            rid = self.engine.add_request(
-                request_id=request_id,
-                prompt=prompt,
-                prompt_token_ids=prompt_token_ids,
-                sampling=sampling,
-                lora_name=lora_name,
+        if self.engine.is_sleeping:
+            raise EngineSleepingError(
+                "engine is sleeping; wake it before sending requests"
             )
+        if prompt_token_ids is None:
+            if prompt is None:
+                raise ValueError("need prompt or prompt_token_ids")
+            prompt_token_ids = self.engine.tokenizer.encode(prompt)
+        # synchronous 4xx for invalid requests, even with deferred admission
+        self.engine.validate_new_request(prompt_token_ids, lora_name)
+        with self._pending_lock:
+            # check + insert must be atomic vs concurrent submits: two
+            # requests sharing an X-Request-Id would otherwise both pass
+            # the check and cross-wire their output queues
+            if request_id is not None and (
+                request_id in self._queues
+                or self.engine.has_request(request_id)
+            ):
+                request_id = f"{request_id}-{id(q) & 0xFFFFFF:x}"
+            rid = request_id or f"req-a{next(self._rid_counter)}"
             self._queues[rid] = q
-        finally:
-            self._lock.release()
+            self._pending.append((rid, list(prompt_token_ids), sampling,
+                                  lora_name))
+        self.loop_timing["submits"] += 1
+        self.loop_timing["submit_s"] += time.perf_counter() - t0
         self._wake.set()
         return rid
 
@@ -192,6 +321,12 @@ class AsyncEngine:
                 loop.run_in_executor(None, self._abort_sync, rid)
 
     def _abort_sync(self, request_id: str) -> bool:
+        with self._pending_lock:
+            # not yet admitted: dropping the pending entry is the abort
+            for item in self._pending:
+                if item[0] == request_id:
+                    self._pending.remove(item)
+                    return True
         with self._lock:
             return self.engine.abort_request(request_id)
 
@@ -228,7 +363,9 @@ class AsyncEngine:
         deadline = time.monotonic() + 30.0
         while True:
             with self._lock:
-                if not self.engine.scheduler.has_unfinished():
+                with self._pending_lock:
+                    pending = bool(self._pending)
+                if not pending and not self.engine.scheduler.has_unfinished():
                     self.engine.sleep(level)
                     return
             if time.monotonic() > deadline:

@@ -657,6 +657,12 @@ class EngineServer:
         return web.json_response({
             "engine": dict(eng.timing),
             "loop": dict(self.async_engine.loop_timing),
+            "programs": {
+                "compile_fallbacks": eng.runner.compile_fallbacks,
+                "bg_compiles": eng.runner.bg_compiles,
+                "compiled_keys": len(eng.runner._compiled_keys),
+                "bg_pending": len(eng.runner._bg_inflight),
+            },
         })
 
     async def sleep(self, request: web.Request) -> web.Response:
@@ -1015,6 +1021,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "many tokens by prompt lookup and verify them in "
                         "one dispatch (greedy requests only; 0 disables)")
     p.add_argument("--speculative-min-ngram", type=int, default=2)
+    p.add_argument("--quantization", default=None,
+                   choices=[None, "int8"],
+                   help="weight-only quantization: int8 stores every linear "
+                        "weight as int8 + per-output-channel scales (half "
+                        "the weight HBM — how an 8B-class model fits one "
+                        "16 GiB v5e chip)")
     p.add_argument("--kv-cache-dtype", default="auto",
                    choices=["auto", "fp8"],
                    help="KV pool storage dtype: fp8 (float8_e4m3fn) halves "
@@ -1027,6 +1039,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="compile the prefill/decode bucket programs before "
                         "accepting traffic (first requests otherwise stall "
                         "on 10-40s XLA compiles)")
+    p.add_argument("--warmup-scope", default="full",
+                   choices=["full", "coarse"],
+                   help="full: the whole bucket ladder (deterministic "
+                        "steady-state perf; tens of minutes cold, seconds "
+                        "with a warm --compilation-cache-dir). coarse: only "
+                        "the dominating shape lattice (minutes) — finer "
+                        "programs pad up and compile in the background "
+                        "with zero serving stalls")
     p.add_argument("--max-loras", type=int, default=0,
                    help="runtime LoRA adapter slots (0 disables LoRA)")
     p.add_argument("--max-lora-rank", type=int, default=8)
@@ -1047,7 +1067,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
-    model_cfg = resolve_model_config(args.model, args.max_model_len, args.dtype)
+    model_cfg = resolve_model_config(
+        args.model, args.max_model_len, args.dtype,
+        quantization=getattr(args, "quantization", None),
+    )
     if getattr(args, "decode_buckets", ""):
         # sorted: bucket_for scans in tuple order for the first bucket >= n,
         # so an unordered list would silently pad everything to the first
@@ -1133,8 +1156,10 @@ def main(argv: list[str] | None = None) -> None:
                 args.model, args.host, args.port)
     engine = LLMEngine(config)
     if args.warmup:
-        logger.info("warming serving buckets (compiles every program)...")
-        engine.warmup()
+        logger.info(
+            "warming serving buckets (%s scope)...", args.warmup_scope
+        )
+        engine.warmup(scope=args.warmup_scope)
     server = EngineServer(engine, served_model_name=args.served_model_name)
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)
