@@ -715,3 +715,57 @@ def test_manager_run_watch_loop_and_leadership_loss():
         del rival
 
     _with_fake_k8s(go)
+
+
+def test_sample_crs_reconcile_into_expected_objects():
+    """The shipped operator/samples/ CRs (what the kind CI applies) must
+    reconcile into exactly the objects the workflow asserts on — pinning
+    the sample schemas against the builders so CI can't drift."""
+    import yaml as _yaml
+
+    samples = {}
+    for fn in (
+        "tpuruntime-sample", "tpurouter-sample", "cacheserver-sample",
+        "loraadapter-sample",
+    ):
+        with open(f"operator/samples/{fn}.yaml") as f:
+            cr = _yaml.safe_load(f)
+        cr["metadata"]["uid"] = f"uid-{fn}"
+        samples[cr["kind"]] = cr
+
+    async def go(fake, client):
+        await client.create(
+            client.crs("tpuruntimes"), copy.deepcopy(samples["TPURuntime"])
+        )
+        await client.create(
+            client.crs("tpurouters"), copy.deepcopy(samples["TPURouter"])
+        )
+        await client.create(
+            client.crs("cacheservers"), copy.deepcopy(samples["CacheServer"])
+        )
+        await client.create(
+            client.crs("loraadapters"), copy.deepcopy(samples["LoraAdapter"])
+        )
+        mgr = OperatorManager(client)
+        try:
+            await mgr.reconcile_all()
+        finally:
+            await mgr.http.close()
+        # the names the kind workflow (.github/workflows/helm-functional.yml
+        # operator-e2e job) waits for:
+        for name in (
+            "sample-runtime-engine", "sample-router-router",
+            "sample-cache-kv-store", "sample-cache-kv-controller",
+        ):
+            assert await client.get(client.deployments(name)), name
+        # engine env override (CPU CI) must land in the pod template
+        eng = await client.get(client.deployments("sample-runtime-engine"))
+        env = eng["spec"]["template"]["spec"]["containers"][0].get("env", [])
+        assert {"name": "JAX_PLATFORMS", "value": "cpu"} in env
+        # finalizer installed on the LoraAdapter (workflow greps for it)
+        lora = await client.get(client.crs("loraadapters", "sample-adapter"))
+        assert any(
+            "lora" in f for f in lora["metadata"].get("finalizers", [])
+        )
+
+    _with_fake_k8s(go)
