@@ -249,9 +249,15 @@ def run_livestack(
             # dispatch over remote-device links; the gate defers them to
             # this gap)
             for _ in range(240):
-                progs = _fetch_json(
-                    f"http://127.0.0.1:{engine_port}/debug/timing"
-                ).get("programs", {})
+                try:
+                    progs = _fetch_json(
+                        f"http://127.0.0.1:{engine_port}/debug/timing"
+                    ).get("programs", {})
+                except Exception:
+                    # program tracing holds the GIL in bursts — a slow
+                    # poll must not kill the whole measurement
+                    time.sleep(5)
+                    continue
                 if not progs.get("bg_pending", 0):
                     break
                 time.sleep(5)
