@@ -1,0 +1,123 @@
+"""Device-path KV transfer (engine/kv_device_transfer.py): prefill-role →
+decode-role pools over jax device-to-device copies, no host staging —
+the TPU-native NIXL (VERDICT r3 missing #2). Bit-identical adoption is
+the contract: the decode engine must continue EXACTLY as if it had
+computed the KV itself."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from vllm_production_stack_tpu.engine.config import (
+    CacheConfig, EngineConfig, ModelConfig, ParallelConfig, SchedulerConfig,
+)
+from vllm_production_stack_tpu.engine.engine import LLMEngine
+from vllm_production_stack_tpu.engine.kv_device_transfer import ship_kv_device
+from vllm_production_stack_tpu.engine.request import SamplingParams
+from vllm_production_stack_tpu.parallel import mesh as mesh_lib
+
+
+def _engine(devices=None, tp=1, dp=1, block_size=8, num_blocks=64):
+    cfg = ModelConfig.tiny(num_heads=4, num_kv_heads=2)
+    mesh = (
+        mesh_lib.make_mesh(tp, dp, devices=devices)
+        if devices is not None else None
+    )
+    return LLMEngine(
+        EngineConfig(
+            model=cfg,
+            cache=CacheConfig(block_size=block_size, num_blocks=num_blocks),
+            scheduler=SchedulerConfig(
+                max_num_seqs=2, max_num_batched_tokens=64,
+                decode_buckets=(2,), prefill_buckets=(32, 64),
+                decode_window=4,
+            ),
+            parallel=ParallelConfig(
+                tensor_parallel_size=tp, data_parallel_size=dp
+            ),
+        ),
+        mesh=mesh,
+    )
+
+
+def _greedy(n):
+    return SamplingParams(max_tokens=n, temperature=0.0, ignore_eos=True)
+
+
+def test_device_ship_bit_identical_continuation():
+    """Prefill on engine A, device-ship to engine B on DISJOINT devices:
+    B's continuation must match A's exactly, with a prefix-cache hit."""
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs 2 virtual devices")
+    eng_a = _engine(devices=[devs[0]])
+    eng_b = _engine(devices=[devs[1]])
+
+    prompt = list(np.random.RandomState(0).randint(1, 512, size=24))
+    # A runs the router's prefill phase (max_tokens=1) + its continuation
+    first = eng_a.generate([prompt], _greedy(1))[0]["token_ids"]
+    want = eng_a.generate([prompt], _greedy(6))[0]["token_ids"]
+
+    n = ship_kv_device(eng_a, eng_b, prompt)
+    assert n == 24 // 8  # all full blocks shipped
+    assert eng_b.kv_lookup(token_ids=prompt) == 24
+    hits0 = eng_b.stats().prefix_cache_hits
+    got = eng_b.generate([prompt], _greedy(6))[0]["token_ids"]
+    assert got == want
+    assert got[:1] == first
+    assert eng_b.stats().prefix_cache_hits > hits0
+
+
+def test_device_ship_under_tp2():
+    """tp-sharded pools on both sides: heads stay sharded through the
+    transfer."""
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 virtual devices")
+    eng_a = _engine(devices=devs[:2], tp=2)
+    eng_b = _engine(devices=devs[2:4], tp=2)
+    prompt = list(np.random.RandomState(1).randint(1, 512, size=16))
+    eng_a.generate([prompt], _greedy(1))
+    want = eng_a.generate([prompt], _greedy(5))[0]["token_ids"]
+    assert ship_kv_device(eng_a, eng_b, prompt) == 2
+    got = eng_b.generate([prompt], _greedy(5))[0]["token_ids"]
+    assert got == want
+
+
+def test_device_ship_guards():
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs 2 virtual devices")
+    eng_a = _engine(devices=[devs[0]])
+    prompt = list(np.random.RandomState(2).randint(1, 512, size=24))
+    eng_a.generate([prompt], _greedy(1))
+
+    # fingerprint mismatch refused before any transfer
+    cfg_other = ModelConfig.tiny(num_heads=4, num_kv_heads=2)
+    other = LLMEngine(
+        EngineConfig(
+            model=cfg_other,
+            cache=CacheConfig(block_size=8, num_blocks=64),
+            scheduler=SchedulerConfig(
+                max_num_seqs=2, max_num_batched_tokens=64,
+                decode_buckets=(2,), prefill_buckets=(32, 64),
+                decode_window=4,
+            ),
+            seed=99,  # different weights => different fingerprint
+        ),
+        mesh=mesh_lib.make_mesh(1, 1, devices=[devs[1]]),
+    )
+    with pytest.raises(ValueError, match="fingerprint"):
+        ship_kv_device(eng_a, other, prompt)
+
+    # nothing resident: 0 adopted, no error
+    eng_b = _engine(devices=[devs[1]])
+    assert ship_kv_device(
+        eng_a, eng_b, list(np.random.RandomState(9).randint(1, 512, size=24))
+    ) == 0
+
+    # full destination pool degrades to partial/zero adoption
+    tiny_b = _engine(devices=[devs[1]], num_blocks=3)
+    n = ship_kv_device(eng_a, tiny_b, prompt)
+    assert 0 <= n <= 2

@@ -249,6 +249,52 @@ class KVBlockPool:
             matched.append(blk)
         return matched
 
+    # -- adoption staging (KV transfer, both transports) -------------------
+
+    def stage_adoption(self, hashes: list[int]):
+        """Allocate destination blocks for the non-resident members of a
+        shipped hash run. Returns (staged, pinned): staged = [(hash, blk)]
+        to fill and commit; pinned = already-resident blocks REF-PINNED for
+        the duration — without the pin, a later allocate() in this same
+        staging could evict a resident chain member, leaving the freshly
+        adopted blocks unreachable behind a chain hole. Call exactly one of
+        commit_adoption/abort_adoption afterwards. The ONE definition of
+        adoption bookkeeping shared by the host-staged HTTP path
+        (kv_transfer.import_blocks) and the device path
+        (kv_device_transfer.ship_kv_device)."""
+        staged: list[tuple[int, int]] = []
+        pinned: list[int] = []
+        for h in hashes:
+            existing = self._hash_to_block.get(h)
+            if existing is not None:
+                self._acquire(existing)
+                pinned.append(existing)
+                continue
+            blk = self.allocate()
+            if blk is None:
+                break
+            staged.append((h, blk))
+        return staged, pinned
+
+    def commit_adoption(
+        self, staged: list[tuple[int, int]], pinned: list[int]
+    ) -> None:
+        """Register filled blocks as content-addressable evictable cache."""
+        for h, blk in staged:
+            self._hash_to_block[h] = blk
+            self._block_to_hash[blk] = h
+            self.free_block(blk)  # park: refcount 0, addressable
+        for blk in pinned:
+            self.free_block(blk)
+
+    def abort_adoption(
+        self, staged: list[tuple[int, int]], pinned: list[int]
+    ) -> None:
+        for _, blk in staged:
+            self.free_block(blk)
+        for blk in pinned:
+            self.free_block(blk)
+
     def _reload_from_host(self, h: int) -> int | None:
         """Host-tier continuation of a prefix match: allocate an HBM block and
         upload hash h's offloaded pages into it."""

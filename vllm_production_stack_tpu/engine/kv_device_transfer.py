@@ -1,0 +1,162 @@
+"""Device-path KV transfer: prefill-role → decode-role pools over ICI/DCN.
+
+The reference ships KV GPU-direct through NIXL sender/receiver pairs over
+UCX (deployment-vllm-multi.yaml:267-305; values-16-disagg-prefill.yaml).
+The TPU-native answer needs no custom transport stack: KV pages are
+jax.Arrays, and `jax.device_put` onto a sharding over a DIFFERENT device
+set lowers to direct device-to-device copies — the XLA runtime moves bytes
+over ICI within a slice and DCN across slices, exactly where NIXL/UCX sit
+in the reference. No host staging, no serialization.
+
+This module implements that path behind the SAME content-addressed
+export/adopt bookkeeping as the host-staged HTTP path (kv_transfer.py):
+chain hashes identify blocks, the fingerprint gate refuses foreign
+weights, partial adoption degrades to recompute. Only the byte transport
+changes — so the router's 2-phase PD orchestration cannot tell them apart.
+
+Per transfer: ONE gather dispatch on the source mesh (compact the chain's
+pages, per layer), one cross-mesh device_put (the actual ICI/DCN hop), one
+scatter dispatch on the target mesh (drop the pages into the target pool's
+free blocks). Gather/scatter pad the block-count to a pow2 bucket
+(compile-count discipline); padding slots route to the reserved null page
+0 on the target side, so oversized buckets are harmless.
+
+Same-process engines (the dryrun and single-host PD case) use it as-is;
+multi-host PD runs the two engines in one jax.distributed runtime
+(parallel/distributed.py) where device_put spans hosts over DCN.
+Design doc: docs/05-disaggregated-prefill.md. The host-staged HTTP path
+remains the cross-cluster / cross-runtime fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_blocks(kv_caches, moved, dst_idx):
+    """Write the shipped pages into the (donated) target pool. dst_idx
+    padding points at block 0 — the reserved null page, overwritten
+    harmlessly."""
+    return tuple(
+        leaf.at[:, dst_idx].set(m.astype(leaf.dtype))
+        for leaf, m in zip(kv_caches, moved)
+    )
+
+
+@jax.jit
+def _gather_blocks(kv_caches, src_idx):
+    """Compact the chain's pages out of the source pool: per-layer
+    (2, n_pad, bs, kvh, D)."""
+    return tuple(leaf[:, src_idx] for leaf in kv_caches)
+
+
+def ship_kv_device(
+    src_engine,
+    dst_engine,
+    token_ids: list[int],
+    lora_name: str | None = None,
+) -> int:
+    """Ship the prompt's resident KV blocks from src_engine's pool into
+    dst_engine's pool device-to-device. Returns blocks adopted (0 when
+    nothing is resident or the destination pool is full — the decode
+    engine recomputes, same degradation contract as the HTTP path).
+
+    LOCKING CONTRACT: the caller must hold BOTH engines' step locks (or
+    otherwise quiesce their step loops) for the duration — the scatter
+    donates and reassigns dst_engine.runner.kv_caches, and a concurrent
+    decode step's own donation would race it. This matches the HTTP
+    path's discipline, where every KVTransfer method runs under
+    AsyncEngine._lock (async_engine.py kv_import/kv_export)."""
+    if src_engine.model_fingerprint != dst_engine.model_fingerprint:
+        raise ValueError(
+            f"KV fingerprint mismatch: sender "
+            f"{src_engine.model_fingerprint!r} != receiver "
+            f"{dst_engine.model_fingerprint!r} — refusing foreign KV"
+        )
+    src_pool = src_engine.scheduler.pool
+    dst_pool = dst_engine.scheduler.pool
+    root = src_engine._cache_root(lora_name)
+
+    # chain walk on the source (same identity rule as kv_transfer.py)
+    hashes: list[int] = []
+    src_blocks: list[int] = []
+    for h in src_pool._chain(list(token_ids), root):
+        blk = src_pool._hash_to_block.get(h)
+        if blk is None:
+            break
+        hashes.append(h)
+        src_blocks.append(blk)
+    if not hashes:
+        return 0
+
+    # allocate on the destination — staging/commit bookkeeping is the
+    # pool's shared definition (kv_cache.stage_adoption: pins resident
+    # chain members so this staging's allocations cannot evict them)
+    src_by_hash = dict(zip(hashes, src_blocks))
+    staged, pinned = dst_pool.stage_adoption(hashes)
+    if not staged:
+        dst_pool.abort_adoption(staged, pinned)
+        return 0
+
+    n_pad = _pow2(len(staged))
+    # padding: source side re-reads its first block (cheap, discarded),
+    # destination side targets the reserved null page 0
+    src_idx = np.full(n_pad, src_by_hash[staged[0][0]], np.int32)
+    dst_idx = np.zeros(n_pad, np.int32)
+    for i, (h, dblk) in enumerate(staged):
+        src_idx[i] = src_by_hash[h]
+        dst_idx[i] = dblk
+
+    try:
+        gathered = _gather_blocks(
+            src_engine.runner.kv_caches,
+            jax.device_put(
+                src_idx,
+                NamedSharding(src_engine.runner.mesh, P()),
+            ),
+        )
+        # (scatter below donates + reassigns dst kv_caches — see the
+        # locking contract in this function's docstring)
+        # THE transfer: cross-mesh device_put — ICI/DCN, no host staging.
+        # KV heads stay tp-sharded on the target (each target chip receives
+        # only its heads' bytes); the small block axis is not pp-sharded
+        # (the compacted run is tiny relative to the pool)
+        from ..parallel import mesh as mesh_lib
+
+        dst_sharding = NamedSharding(
+            dst_engine.runner.mesh,
+            P(None, None, None, mesh_lib.TP_AXIS, None),
+        )
+        moved = tuple(
+            jax.device_put(g, dst_sharding) for g in gathered
+        )
+        dst_engine.runner.kv_caches = _scatter_blocks(
+            dst_engine.runner.kv_caches,
+            moved,
+            jax.device_put(
+                dst_idx,
+                NamedSharding(dst_engine.runner.mesh, P()),
+            ),
+        )
+    except Exception:
+        dst_pool.abort_adoption(staged, pinned)
+        raise
+    dst_pool.commit_adoption(staged, pinned)
+    logger.info(
+        "device-shipped %d KV blocks (%d offered) prefill→decode",
+        len(staged), len(hashes),
+    )
+    return len(staged)

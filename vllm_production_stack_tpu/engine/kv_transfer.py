@@ -249,34 +249,26 @@ class KVTransfer:
             )
         # allocate + upload the whole group in ONE device dispatch
         # (upload_blocks): per-block uploads cost a dispatch round trip
-        # each, which dominates PD transfer on high-RTT device links
-        adopt: list[tuple[int, int, np.ndarray]] = []  # (hash, blk, data)
-        for h, data in zip(hashes, blocks):
-            if h in self.pool._hash_to_block:
-                continue
-            blk = self.pool.allocate()
-            if blk is None:
-                break
-            adopt.append((h, blk, data))
-        if not adopt:
+        # each, which dominates PD transfer on high-RTT device links.
+        # Staging/commit bookkeeping is the pool's shared definition
+        # (kv_cache.stage_adoption — also used by the device path)
+        by_hash = {h: d for h, d in zip(hashes, blocks)}
+        staged, pinned = self.pool.stage_adoption(hashes)
+        if not staged:
+            self.pool.abort_adoption(staged, pinned)
             return 0
         try:
             upload_many = getattr(self.runner, "upload_blocks", None)
             if upload_many is not None:
                 upload_many(
-                    [blk for _, blk, _ in adopt],
-                    np.stack([d for _, _, d in adopt]),
+                    [blk for _, blk in staged],
+                    np.stack([by_hash[h] for h, _ in staged]),
                 )
             else:
-                for _, blk, data in adopt:
-                    self.runner.upload_block(blk, data)
+                for h, blk in staged:
+                    self.runner.upload_block(blk, by_hash[h])
         except Exception:
-            for _, blk, _ in adopt:  # don't leak the blocks on failure
-                self.pool.free_block(blk)
+            self.pool.abort_adoption(staged, pinned)
             raise
-        for h, blk, _ in adopt:
-            self.pool._hash_to_block[h] = blk
-            self.pool._block_to_hash[blk] = h
-            # park as an evictable cached block (refcount 0, addressable)
-            self.pool.free_block(blk)
-        return len(adopt)
+        self.pool.commit_adoption(staged, pinned)
+        return len(staged)
