@@ -253,9 +253,15 @@ def run_livestack(
                     progs = _fetch_json(
                         f"http://127.0.0.1:{engine_port}/debug/timing"
                     ).get("programs", {})
-                except Exception:
+                except Exception as e:
                     # program tracing holds the GIL in bursts — a slow
-                    # poll must not kill the whole measurement
+                    # poll must not kill the measurement; a DEAD engine
+                    # (connection refused) must fail fast, not mask itself
+                    # for 20 minutes
+                    if isinstance(
+                        getattr(e, "reason", e), ConnectionRefusedError
+                    ):
+                        raise
                     time.sleep(5)
                     continue
                 if not progs.get("bg_pending", 0):

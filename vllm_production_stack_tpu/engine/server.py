@@ -559,12 +559,12 @@ class EngineServer:
                 if out.finish_reason == "error":
                     await send({"error": {"message": out.text_delta}})
                     break
-                # with logprobs on, token-bearing chunks must go out even
-                # when detok held their text back (multi-byte sequences) —
-                # the per-token entries ride the chunk
-                if out.text_delta or out.finished or (
-                    sampling.logprobs is not None and out.new_token_ids
-                ):
+                # every token-bearing step emits a chunk, even when detok
+                # held the text back (multi-byte sequences, or ids outside
+                # the text vocabulary) — vLLM streams the same way, and
+                # first-token latency is only observable if the first
+                # token's chunk actually goes out
+                if out.new_token_ids or out.text_delta or out.finished:
                     delta = (
                         {"content": out.text_delta}
                         if chat
