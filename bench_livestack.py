@@ -201,7 +201,7 @@ def run_livestack(
     ramp_gap_s: float = 0.25,
     q_range: tuple[int, int] = (250, 650),
     seed: int = 0,
-    warmup_wave: bool = True,
+    warmup_waves: int = 2,
     engine_flags: list[str] | None = None,
     keep_logs: str | None = None,
 ) -> dict:
@@ -234,15 +234,17 @@ def run_livestack(
         _wait_health(f"http://127.0.0.1:{router_port}", timeout_s=120)
         url = f"http://127.0.0.1:{router_port}"
 
-        if warmup_wave:
-            # one traffic wave with DIFFERENT prompt content: any program
-            # key the --warmup ladder missed is DISCOVERED here (the
-            # runner pads up and queues the exact key), then the prefix
-            # cache outcome matches steady-state (the measured wave
-            # computes its own fresh KV, reusing only in-wave history)
+        for wv in range(warmup_waves):
+            # traffic waves with DIFFERENT prompt content: program keys the
+            # --warmup ladder missed are DISCOVERED here (the runner pads
+            # up and queues the exact keys), and each inter-wave drain
+            # compiles them — wave N+1 then runs mostly-exact programs and
+            # discovers the residue. The prefix-cache outcome matches
+            # steady-state (the measured wave computes its own fresh KV,
+            # reusing only in-wave history).
             asyncio.run(_drive(
                 url, model, users, rounds, answer_tokens, sys_tokens,
-                ramp_gap_s, q_range, seed=seed + 555_000,
+                ramp_gap_s, q_range, seed=seed + 555_000 + 77 * wv,
             ))
             # let the idle-gated background compiles drain so the measured
             # wave dispatches exact programs (compiles contend with
@@ -334,7 +336,8 @@ def main() -> None:
     args = p.parse_args()
     out = run_livestack(
         users=args.users, rounds=args.rounds,
-        warmup_wave=not args.no_warmup_wave, keep_logs=args.keep_logs,
+        warmup_waves=0 if args.no_warmup_wave else 2,
+        keep_logs=args.keep_logs,
     )
     print(json.dumps({"livestack": out}))
 

@@ -223,6 +223,17 @@ def run_northstar(
     d_q = stats.prefix_cache_queries - stats0.prefix_cache_queries
     d_h = stats.prefix_cache_hits - stats0.prefix_cache_hits
     rtt_ms = measure_dispatch_rtt_ms()
+    kv_blocks = engine.config.cache.num_blocks
+    # free the chip before returning: the timed_execute closure forms a
+    # reference CYCLE through the runner (runner -> instance attr ->
+    # closure -> bound inner_execute -> runner) that refcounting cannot
+    # break — without this, the engine's weights + pool stay in HBM and
+    # the caller's next engine OOMs
+    del engine.runner.execute  # restores the class method
+    del engine, inner_execute, timed_execute
+    import gc
+
+    gc.collect()
     return {
         "model": model,
         "users": users,
@@ -248,7 +259,7 @@ def run_northstar(
             (phase["prefill_n"] + phase["decode_n"]) * rtt_ms / 1000.0
             / max(phase["prefill_s"] + phase["decode_s"], 1e-9), 3,
         ),
-        "kv_blocks": engine.config.cache.num_blocks,
+        "kv_blocks": kv_blocks,
         "kv_dtype": kv_cache_dtype,
         "quantization": quantization,
     }
