@@ -588,3 +588,32 @@ def test_transcription_multipart_proxy():
             assert r.status == 404
 
     asyncio.run(go())
+
+
+def test_semantic_cache_engine_embedder():
+    """--semantic-cache-dir engine: the router embeds through a backend's
+    /v1/embeddings (real model vectors, no sentence-transformers) — an
+    identical repeat must hit; the fake engine's embeddings are
+    deterministic per input."""
+    async def go():
+        async with router_rig(
+            n_engines=1,
+            router_args=[
+                "--feature-gates", "SemanticCache=true",
+                "--semantic-cache-dir", "engine",
+                "--semantic-cache-threshold", "0.99",
+            ],
+        ) as (client, engines, _):
+            body = chat_body("the exact same question", stream=False)
+            r1 = await client.post("/v1/chat/completions", json=body)
+            assert r1.status == 200
+            d1 = await r1.json()
+            assert not d1.get("cached")
+            r2 = await client.post("/v1/chat/completions", json=body)
+            d2 = await r2.json()
+            assert d2.get("cached") is True
+            assert d2["similarity"] >= 0.99
+            # only the first request reached the engine's completion path
+            assert engines[0].total_requests == 1
+
+    asyncio.run(go())

@@ -311,7 +311,8 @@ def build_app(args) -> web.Application:
         from .semantic_cache import SemanticCache
 
         state.semantic_cache = SemanticCache(
-            args.semantic_cache_dir, args.semantic_cache_threshold
+            args.semantic_cache_dir, args.semantic_cache_threshold,
+            state=state,
         )
     if state.feature_gates.enabled("PIIDetection"):
         from .pii import PIIMiddleware, make_analyzer

@@ -97,7 +97,10 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--batch-db", default="/tmp/tpu_router_batch.sqlite")
     x.add_argument(
         "--semantic-cache-dir", default=None,
-        help="embedding model dir for the semantic cache (gate SemanticCache)",
+        help="semantic-cache embedder: a sentence-transformers model dir; "
+             "'engine' to embed through a backend's /v1/embeddings (REAL "
+             "model vectors, zero extra deps); 'hashing' for the "
+             "lexical bag-of-words fallback (gate SemanticCache)",
     )
     x.add_argument("--semantic-cache-threshold", type=float, default=0.9)
     return p

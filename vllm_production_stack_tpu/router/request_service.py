@@ -301,7 +301,9 @@ class RequestService:
                 await resp.write_eof()
                 if cacheable and upstream.status == 200:
                     try:
-                        self.state.semantic_cache.store(body, json.loads(bytes(full)))
+                        await self.state.semantic_cache.store(
+                            body, json.loads(bytes(full))
+                        )
                     except (json.JSONDecodeError, UnicodeDecodeError):
                         pass
                 return resp

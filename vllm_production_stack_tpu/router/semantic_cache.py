@@ -64,9 +64,57 @@ class HashingEmbedder:
         return v
 
 
-def _load_embedder(model_dir: str):
+class EngineEmbedder:
+    """REAL semantic vectors with zero extra dependencies: embed through a
+    serving engine's /v1/embeddings (last-token-pooled hidden states,
+    engine/server.py). The reference needs sentence-transformers + FAISS in
+    the router image for this; the TPU stack's engines ARE an embedding
+    service, so `--semantic-cache-dir engine` borrows the model itself.
+    Costs one engine round trip per lookup/store — gate-enabled operators
+    are trading a little engine time for completion-cache hits."""
+
+    def __init__(self, state):
+        self.state = state
+
+    async def encode_async(self, text: str, model: str | None) -> np.ndarray:
+        eps = [
+            e for e in self.state.discovery.endpoints()
+            if e.healthy and not e.sleeping
+            and (not model or e.has_model(model) or not e.model_names)
+        ]
+        if not eps:
+            raise LookupError("no engine available to embed")
+        if not model:
+            if not eps[0].model_names:
+                # scrape window: model list not known yet — clean miss, not
+                # an IndexError masquerading as an embed failure
+                raise LookupError("no model name known yet for embedding")
+            model = eps[0].model_names[0]
+        import aiohttp
+
+        session = self.state.request_service.session
+        async with session.post(
+            eps[0].url + "/v1/embeddings",
+            # bound the embed cost; the TAIL carries the newest turns,
+            # which dominate similarity for conversation caching
+            json={"model": model, "input": text[-4000:]},
+            timeout=aiohttp.ClientTimeout(total=10),
+        ) as resp:
+            if resp.status != 200:
+                raise LookupError(f"embedding backend returned {resp.status}")
+            data = await resp.json()
+        return np.asarray(data["data"][0]["embedding"], dtype=np.float32)
+
+
+def _load_embedder(model_dir: str, state=None):
     if model_dir in ("hashing", "builtin"):
         return HashingEmbedder()
+    if model_dir == "engine":
+        if state is None:
+            raise ValueError(
+                "--semantic-cache-dir engine needs the router state"
+            )
+        return EngineEmbedder(state)
     try:
         from sentence_transformers import SentenceTransformer
 
@@ -80,13 +128,55 @@ def _load_embedder(model_dir: str):
 
 
 class SemanticCache:
-    def __init__(self, model_dir: str, threshold: float = 0.9, embedder=None):
+    def __init__(
+        self, model_dir: str, threshold: float = 0.9, embedder=None,
+        state=None,
+    ):
         self.threshold = threshold
-        self.embedder = embedder or _load_embedder(model_dir)
-        probe = np.asarray(self.embedder.encode("probe"), dtype=np.float32)
-        self.index = NumpyIndex(probe.ravel().shape[0])
+        self.embedder = embedder or _load_embedder(model_dir, state=state)
+        # index dimension discovered from the first vector (async embedders
+        # can't be probed at construction time)
+        self.index: NumpyIndex | None = None
+        if not hasattr(self.embedder, "encode_async"):
+            probe = np.asarray(
+                self.embedder.encode("probe"), dtype=np.float32
+            )
+            self.index = NumpyIndex(probe.ravel().shape[0])
         self.hits = 0
         self.lookups = 0
+        self._recent: dict = {}  # (model, text) -> vec, bounded at 64
+
+    async def _encode(self, text: str, model: str | None) -> np.ndarray:
+        # miss-path memo: a cache miss embeds in lookup() and would embed
+        # the SAME text again in store() — with the engine embedder that
+        # is a second full round trip per uncached request
+        key = (model, text)
+        cached = self._recent.get(key)
+        if cached is not None:
+            return cached
+        if hasattr(self.embedder, "encode_async"):
+            vec = await self.embedder.encode_async(text, model)
+        else:
+            vec = np.asarray(self.embedder.encode(text))
+        self._recent[key] = vec
+        while len(self._recent) > 64:
+            self._recent.pop(next(iter(self._recent)))
+        return vec
+
+    def _ensure_index(self, vec: np.ndarray) -> bool:
+        """Returns False when the vector cannot enter this index (dimension
+        mismatch — e.g. a multi-model fleet where models have different
+        hidden sizes); callers treat that as a miss, never an error."""
+        if self.index is None:
+            self.index = NumpyIndex(vec.ravel().shape[0])
+        if vec.ravel().shape[0] != self.index.dim:
+            logger.warning(
+                "semantic-cache embedding dim %d != index dim %d; "
+                "skipping (multi-model fleet?)",
+                vec.ravel().shape[0], self.index.dim,
+            )
+            return False
+        return True
 
     @staticmethod
     def _text_of(body: dict) -> str:
@@ -108,7 +198,13 @@ class SemanticCache:
         if body.get("stream"):
             return None
         self.lookups += 1
-        vec = np.asarray(self.embedder.encode(self._text_of(body)))
+        try:
+            vec = await self._encode(self._text_of(body), body.get("model"))
+        except Exception as e:  # embed backend down => cache miss, not 500
+            logger.warning("semantic-cache embed failed on lookup: %s", e)
+            return None
+        if not self._ensure_index(vec):
+            return None
         sim, payload = self.index.search(vec)
         if payload is None or sim < self.threshold:
             return None
@@ -120,8 +216,14 @@ class SemanticCache:
         cached["similarity"] = round(sim, 4)
         return web.json_response(cached)
 
-    def store(self, body: dict, response: dict) -> None:
-        vec = np.asarray(self.embedder.encode(self._text_of(body)))
+    async def store(self, body: dict, response: dict) -> None:
+        try:
+            vec = await self._encode(self._text_of(body), body.get("model"))
+        except Exception as e:  # embed backend down => skip caching
+            logger.warning("semantic-cache embed failed on store: %s", e)
+            return
+        if not self._ensure_index(vec):
+            return
         self.index.add(
             vec,
             {

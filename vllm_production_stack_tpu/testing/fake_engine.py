@@ -181,6 +181,32 @@ class FakeEngine:
             }
         )
 
+    async def h_embeddings(self, request: web.Request) -> web.Response:
+        """Deterministic per-input embedding (the real engine's
+        /v1/embeddings shape) — identical inputs get identical vectors, so
+        the router's engine-backed semantic cache is testable."""
+        import hashlib
+
+        import numpy as np
+
+        body = await request.json()
+        raw = body.get("input", "")
+        inputs = raw if isinstance(raw, list) else [raw]
+        data = []
+        for i, text in enumerate(inputs):
+            seed = int.from_bytes(
+                hashlib.sha256(str(text).encode()).digest()[:4], "little"
+            )
+            v = np.random.RandomState(seed).randn(64).astype(np.float32)
+            v /= np.linalg.norm(v)
+            data.append({"object": "embedding", "index": i,
+                         "embedding": [float(x) for x in v]})
+        return web.json_response({
+            "object": "list", "model": body.get("model", self.model),
+            "data": data,
+            "usage": {"prompt_tokens": 1, "total_tokens": 1},
+        })
+
     async def h_metrics(self, request: web.Request) -> web.Response:
         label = f'{{model_name="{self.model}"}}'
         lines = [
@@ -218,6 +244,7 @@ class FakeEngine:
         app.router.add_post("/v1/chat/completions", self.h_completion)
         app.router.add_post("/v1/completions", self.h_completion)
         app.router.add_post("/v1/audio/transcriptions", self.h_transcription)
+        app.router.add_post("/v1/embeddings", self.h_embeddings)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_get("/health", self.h_health)
         app.router.add_post("/sleep", self.h_sleep)
