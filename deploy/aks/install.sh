@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# AKS functional deployment (reference: deployment_on_cloud/azure).
+#
+# TPUs are a Google Cloud product — there are no TPU nodes on Azure. This
+# deploys the CONTROL PLANE plus CPU-mode engines (JAX_PLATFORMS=cpu,
+# debug-class models) for router/operator/cache development on Azure
+# infrastructure. Production TPU serving runs on GKE
+# (deploy/gke, deploy/terraform).
+#
+#   RG=tpu-stack-rg CLUSTER=tpu-stack-dev ./deploy/aks/install.sh
+set -euo pipefail
+
+RG="${RG:-tpu-stack-rg}"
+CLUSTER="${CLUSTER:-tpu-stack-dev}"
+LOCATION="${LOCATION:-westus2}"
+NODES="${NODES:-2}"
+VALUES="${VALUES:-helm/examples/values-01-minimal.yaml}"
+
+az group create --name "$RG" --location "$LOCATION"
+az aks create --resource-group "$RG" --name "$CLUSTER" \
+  --node-count "$NODES" --node-vm-size Standard_D4s_v5 \
+  --generate-ssh-keys
+az aks get-credentials --resource-group "$RG" --name "$CLUSTER"
+
+kubectl apply -f operator/crds/
+helm install stack ./helm -f "$VALUES" \
+  --set 'servingEngineSpec.modelSpec[0].requestTPU=0' \
+  --set 'servingEngineSpec.modelSpec[0].tpuAccelerator=' \
+  --set 'servingEngineSpec.modelSpec[0].env[0].name=JAX_PLATFORMS' \
+  --set 'servingEngineSpec.modelSpec[0].env[0].value=cpu'
+
+echo "Functional stack installing on AKS (CPU engines)."
+echo "Verify: kubectl port-forward svc/stack-router 8000:80 &"
+echo "        curl -s localhost:8000/v1/models"

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# EKS functional deployment (reference: deployment_on_cloud/aws).
+#
+# TPUs are a Google Cloud product — there are no TPU nodes on AWS. What
+# this script deploys on EKS is the CONTROL PLANE (router, operator, KV
+# store) plus CPU-mode engines (JAX_PLATFORMS=cpu, debug-class models) —
+# the same functional shape the reference's OPT125_CPU example serves,
+# useful for router/operator/cache development and CI on AWS
+# infrastructure. Production TPU serving runs on GKE
+# (deploy/gke, deploy/terraform).
+#
+#   CLUSTER=tpu-stack-dev REGION=us-west-2 ./deploy/eks/install.sh
+set -euo pipefail
+
+CLUSTER="${CLUSTER:-tpu-stack-dev}"
+REGION="${REGION:-us-west-2}"
+NODES="${NODES:-2}"
+VALUES="${VALUES:-helm/examples/values-01-minimal.yaml}"
+
+command -v eksctl >/dev/null || {
+  echo "eksctl required: https://eksctl.io"; exit 1; }
+
+eksctl create cluster \
+  --name "$CLUSTER" --region "$REGION" \
+  --nodes "$NODES" --node-type m6i.xlarge
+
+kubectl apply -f operator/crds/
+helm install stack ./helm -f "$VALUES" \
+  --set 'servingEngineSpec.modelSpec[0].requestTPU=0' \
+  --set 'servingEngineSpec.modelSpec[0].tpuAccelerator=' \
+  --set 'servingEngineSpec.modelSpec[0].env[0].name=JAX_PLATFORMS' \
+  --set 'servingEngineSpec.modelSpec[0].env[0].value=cpu'
+
+echo "Functional stack installing on EKS (CPU engines)."
+echo "Verify: kubectl port-forward svc/stack-router 8000:80 &"
+echo "        curl -s localhost:8000/v1/models"

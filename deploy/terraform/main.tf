@@ -1,0 +1,83 @@
+# GKE cluster + TPU v5e node pool + the stack, as Terraform (the
+# reference ships terraform for its GPU clusters; this is the TPU-native
+# equivalent — google.com/tpu resources and TPU topology selectors).
+#
+#   terraform init
+#   terraform apply -var project=my-proj -var zone=us-west4-a
+#   terraform output -raw kubeconfig_cmd | bash
+#   helm install stack ../../helm -f ../../helm/examples/values-01-minimal.yaml
+
+terraform {
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+  }
+}
+
+variable "project" { type = string }
+variable "zone" {
+  type    = string
+  default = "us-west4-a"
+}
+variable "cluster_name" {
+  type    = string
+  default = "tpu-stack"
+}
+variable "tpu_machine_type" {
+  type    = string
+  default = "ct5lp-hightpu-1t" # one v5e chip per node
+}
+variable "tpu_topology" {
+  type    = string
+  default = "1x1" # 2x4 = v5e-8 single-host; 4x4 = v5e-16 multi-host
+}
+variable "tpu_node_count" {
+  type    = number
+  default = 1
+}
+
+provider "google" {
+  project = var.project
+  zone    = var.zone
+}
+
+resource "google_container_cluster" "stack" {
+  name               = var.cluster_name
+  location           = var.zone
+  initial_node_count = 1
+
+  node_config {
+    machine_type = "e2-standard-4" # control plane / router / operator pool
+  }
+
+  release_channel {
+    channel = "RAPID" # TPU machine families track the rapid channel
+  }
+
+  deletion_protection = false
+}
+
+resource "google_container_node_pool" "tpu" {
+  name       = "tpu-pool"
+  cluster    = google_container_cluster.stack.name
+  location   = var.zone
+  node_count = var.tpu_node_count
+
+  node_config {
+    machine_type = var.tpu_machine_type
+    # GKE derives google.com/tpu allocatable + the
+    # cloud.google.com/gke-tpu-accelerator / gke-tpu-topology labels the
+    # helm chart's nodeSelectors target (templates/deployment-engine.yaml)
+  }
+
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = var.tpu_topology
+  }
+}
+
+output "kubeconfig_cmd" {
+  value = "gcloud container clusters get-credentials ${var.cluster_name} --project ${var.project} --zone ${var.zone}"
+}

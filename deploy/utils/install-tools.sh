@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Workstation/CI tool bootstrap (reference: utils/install-{kubectl,helm,
+# minikube-cluster,kind-cluster}.sh — one installer per tool; collapsed
+# here into one idempotent script with per-tool flags).
+#
+#   ./deploy/utils/install-tools.sh kubectl helm kind
+#   ./deploy/utils/install-tools.sh all
+set -euo pipefail
+
+ARCH="$(uname -m | sed 's/x86_64/amd64/;s/aarch64/arm64/')"
+OS="$(uname -s | tr '[:upper:]' '[:lower:]')"
+BIN="${BIN_DIR:-/usr/local/bin}"
+
+want() { [[ " $* " == *" all "* ]] || [[ " $* " == *" $1 "* ]]; }
+
+install_kubectl() {
+  command -v kubectl >/dev/null && { echo "kubectl present"; return; }
+  v="$(curl -fsSL https://dl.k8s.io/release/stable.txt)"
+  curl -fsSL -o "$BIN/kubectl" \
+    "https://dl.k8s.io/release/$v/bin/$OS/$ARCH/kubectl"
+  chmod +x "$BIN/kubectl"
+}
+
+install_helm() {
+  command -v helm >/dev/null && { echo "helm present"; return; }
+  curl -fsSL https://raw.githubusercontent.com/helm/helm/main/scripts/get-helm-3 | bash
+}
+
+install_kind() {
+  command -v kind >/dev/null && { echo "kind present"; return; }
+  curl -fsSL -o "$BIN/kind" \
+    "https://kind.sigs.k8s.io/dl/latest/kind-$OS-$ARCH"
+  chmod +x "$BIN/kind"
+}
+
+install_minikube() {
+  command -v minikube >/dev/null && { echo "minikube present"; return; }
+  curl -fsSL -o "$BIN/minikube" \
+    "https://storage.googleapis.com/minikube/releases/latest/minikube-$OS-$ARCH"
+  chmod +x "$BIN/minikube"
+}
+
+install_gcloud() {
+  command -v gcloud >/dev/null && { echo "gcloud present"; return; }
+  echo "install the Google Cloud SDK: https://cloud.google.com/sdk/docs/install"
+  exit 1
+}
+
+for tool in kubectl helm kind minikube gcloud; do
+  if want "$tool" "$@"; then "install_$tool"; fi
+done
+echo "done."
