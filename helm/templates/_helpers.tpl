@@ -37,6 +37,10 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--dtype"
 - {{ .dtype | quote }}
 {{- end }}
+{{- if .quantization }}
+- "--quantization"
+- {{ .quantization | quote }}
+{{- end }}
 {{- if .tensorParallelSize }}
 - "--tensor-parallel-size"
 - {{ .tensorParallelSize | quote }}

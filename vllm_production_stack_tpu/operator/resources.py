@@ -53,6 +53,8 @@ def engine_args(spec: dict) -> list[str]:
         args += ["--max-model-len", str(model["maxModelLen"])]
     if model.get("dtype"):
         args += ["--dtype", model["dtype"]]
+    if model.get("quantization"):
+        args += ["--quantization", str(model["quantization"])]
     if tpu.get("tensorParallelSize"):
         args += ["--tensor-parallel-size", str(tpu["tensorParallelSize"])]
     if tpu.get("maxNumSeqs"):
