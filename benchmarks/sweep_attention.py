@@ -62,7 +62,7 @@ def time_fn(fn, q, *args) -> float:
 
 def bench_config(
     batch: int, ctx: int, block_size: int, nh: int, kvh: int, d: int,
-    window: int = 16, dtype=jnp.bfloat16,
+    window: int = 16, dtype=jnp.bfloat16, kv_dtype=None,
 ) -> dict:
     from vllm_production_stack_tpu.ops.attention import (
         paged_attention_with_staged,
@@ -75,17 +75,20 @@ def bench_config(
     nb = ctx // block_size
     num_blocks = batch * nb + 2
     scale = d ** -0.5
+    # fp8 pools: pages + staged window store in the pool dtype, queries
+    # stay bf16 — matching the engine's fused-window layout
+    kvd = kv_dtype if kv_dtype is not None else dtype
 
     q = jnp.asarray(rng.randn(batch, nh, d), dtype)
     kv = jnp.asarray(
-        rng.randn(2, num_blocks, block_size, kvh, d), dtype
+        rng.randn(2, num_blocks, block_size, kvh, d), kvd
     )
     tables = jnp.asarray(
         rng.randint(1, num_blocks, size=(batch, nb)), jnp.int32
     )
     hist_len = jnp.full((batch,), ctx, jnp.int32)
-    staged_k = jnp.asarray(rng.randn(window, batch, kvh, d), dtype)
-    staged_v = jnp.asarray(rng.randn(window, batch, kvh, d), dtype)
+    staged_k = jnp.asarray(rng.randn(window, batch, kvh, d), kvd)
+    staged_v = jnp.asarray(rng.randn(window, batch, kvh, d), kvd)
     step_k = jnp.int32(window - 1)
     hist_mask = jnp.ones((batch, ctx), bool)
     staged_mask = jnp.ones((window,), bool)
@@ -106,6 +109,7 @@ def bench_config(
     )
     return {
         "batch": batch, "ctx": ctx, "block_size": block_size,
+        "kv_dtype": jnp.dtype(kvd).name,
         "pallas_ms": round(pallas_ms, 3), "xla_ms": round(xla_ms, 3),
         "winner": "pallas" if pallas_ms < xla_ms else "xla",
         "ratio": round(pallas_ms / xla_ms, 2),
@@ -113,8 +117,14 @@ def bench_config(
 
 
 def main() -> None:
+    import ml_dtypes
+
     p = argparse.ArgumentParser()
     p.add_argument("--quick", action="store_true")
+    p.add_argument("--fp8", action="store_true",
+                   help="fp8 (e4m3) KV pool rows — the north-star pool "
+                        "config (VERDICT r3 #5: auto must have fp8 "
+                        "measurements)")
     args = p.parse_args()
     # llama-1b decode head shape
     nh, kvh, d = 32, 8, 64
@@ -124,8 +134,11 @@ def main() -> None:
     ]
     if not args.quick:
         configs += [(64, 1024, 16), (64, 1024, 64), (64, 4096, 64)]
+    kvd = jnp.dtype(ml_dtypes.float8_e4m3fn) if args.fp8 else None
     for batch, ctx, bs in configs:
-        print(json.dumps(bench_config(batch, ctx, bs, nh, kvh, d)), flush=True)
+        print(json.dumps(bench_config(
+            batch, ctx, bs, nh, kvh, d, kv_dtype=kvd
+        )), flush=True)
 
 
 if __name__ == "__main__":
