@@ -177,6 +177,11 @@ def run_multiprocess_dryrun(n_processes: int = 2, timeout_s: float = 300.0):
     procs = []
     for pid in range(n_processes):
         env = dict(os.environ)
+        # a TPU-plugin sitecustomize (keyed on PALLAS_AXON_POOL_IPS in the
+        # dev image) must NOT register in the CPU workers: with the remote
+        # device service unreachable, plugin registration hangs the worker
+        # before jax.distributed ever initializes
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         env.update({
             ENV_COORDINATOR: f"127.0.0.1:{port}",
             ENV_NUM_PROCESSES: str(n_processes),
