@@ -111,21 +111,24 @@ def _run_phase(section: str, argv: list[str], timeout_s: float,
 
 
 def _kill_group(proc: subprocess.Popen) -> None:
-    """SIGTERM then SIGKILL the phase's whole process group — engine and
-    router grandchildren included (they hold the TPU grant)."""
+    """SIGTERM, a short grace, then ALWAYS SIGKILL the phase's whole
+    process group — engine and router grandchildren included (they hold
+    the TPU grant). The direct child dying is NOT enough to stop: a
+    grandchild wedged in a native compile ignores SIGTERM and would
+    otherwise keep the single-grant tunnel and the stdout pipe."""
     import signal
 
-    for sig, grace in ((signal.SIGTERM, 10.0), (signal.SIGKILL, None)):
-        try:
-            os.killpg(proc.pid, sig)
-        except (ProcessLookupError, PermissionError):
-            return
-        if grace is not None:
-            deadline = time.monotonic() + grace
-            while time.monotonic() < deadline:
-                if proc.poll() is not None:
-                    return
-                time.sleep(0.5)
+    try:
+        os.killpg(proc.pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        return
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and proc.poll() is None:
+        time.sleep(0.5)
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
 
 
 def _parse_phase_json(out: str, rc: int, key: str | None) -> dict:
@@ -209,12 +212,9 @@ def run_microbench() -> dict:
 def _phase_micro_main() -> None:
     """Subprocess entry: enable the persistent compile cache, run the
     microbench, print its JSON."""
-    import jax
+    from bench_livestack import enable_persistent_cache
 
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ.get("BENCH_XLA_CACHE",
-                                     "/tmp/vllm-tpu-xla-cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    enable_persistent_cache()
     print(json.dumps({"microbench": run_microbench()}), flush=True)
 
 

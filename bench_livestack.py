@@ -59,6 +59,17 @@ XLA_CACHE_DIR = os.environ.get(
     "BENCH_XLA_CACHE", "/tmp/vllm-tpu-xla-cache"
 )
 
+def enable_persistent_cache() -> None:
+    """Point THIS process's JAX at the shared persistent compile cache —
+    the one helper every in-process bench phase uses, so the cache
+    location/threshold can never drift between phases (each would
+    otherwise compile cold over the tunnel, 20-40s per program)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", XLA_CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 ENGINE_FLAGS = [
     "--model", "llama-1b",
     "--kv-cache-dtype", "fp8",

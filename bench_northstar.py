@@ -267,20 +267,15 @@ def run_northstar(
 
 def main() -> None:
     import argparse
-    import os
 
     # the CLI path runs on the real chip (driver bench phases): reuse the
     # persistent compile cache so repeat rounds reload instead of paying
     # 20-40s per program over the tunnel. NOT set for library callers —
     # tests run on the CPU backend, where AOT cache reload segfaults
     # (tests/conftest.py note).
-    import jax
+    from bench_livestack import enable_persistent_cache
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("BENCH_XLA_CACHE", "/tmp/vllm-tpu-xla-cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    enable_persistent_cache()
 
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--model", default="llama-1b")

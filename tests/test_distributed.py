@@ -62,3 +62,16 @@ def test_multiprocess_dryrun_two_processes():
     outs = dist.run_multiprocess_dryrun(2, timeout_s=600)
     assert len(outs) == 2
     assert all("MP_DRYRUN_OK" in o for o in outs)
+
+
+def test_multiprocess_pd_dryrun_ships_kv_across_processes():
+    """VERDICT r4 #5: prefill and decode engines in DIFFERENT
+    jax.distributed processes; ship_kv_device_crossproc moves the pages
+    via the cooperative shard-flip program (the DCN path); the worker
+    itself asserts adoption, a prefix-cache hit on the continuation, and
+    token-identical output vs a from-scratch oracle engine."""
+    outs = dist.run_multiprocess_pd_dryrun(timeout_s=600)
+    assert len(outs) == 2
+    joined = "\n".join(outs)
+    assert "PD_DRYRUN_OK role=prefill" in joined
+    assert "PD_DRYRUN_OK adopted=" in joined

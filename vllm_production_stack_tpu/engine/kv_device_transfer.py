@@ -160,3 +160,186 @@ def ship_kv_device(
         len(staged), len(hashes),
     )
     return len(staged)
+
+
+def ship_kv_device_crossproc(
+    engine,
+    role: str,  # "prefill" (source) | "decode" (destination)
+    token_ids: list[int],
+    lora_name: str | None = None,
+) -> int:
+    """Cross-PROCESS device-path KV ship: the multi-host PD deployment
+    shape, where the prefill and decode engines live in DIFFERENT
+    `jax.distributed` processes (different pods/hosts). BOTH processes
+    call this with the same token_ids — multi-controller JAX is SPMD, so
+    the byte movement is one cooperative jitted program over a union mesh
+    of [source device(s), destination device(s)]: a shard flip along the
+    mesh axis, which GSPMD lowers to a device-to-device collective permute
+    — ICI within a slice, DCN across slices. This is where the reference's
+    NIXL sender/receiver pair sits (deployment-vllm-multi.yaml:267-305);
+    here the transport is the XLA runtime itself, no host staging.
+
+    Control-plane handshake (host-side, small ints only): both sides walk
+    the SAME chain hashes from token_ids (deterministic); the source
+    publishes how many are resident, the destination stages that prefix
+    and publishes which chain positions it allocated; both then build the
+    padded index arrays and enter the cooperative transfer. Returns blocks
+    adopted on the decode side (always 0 on the prefill side).
+
+    Same degradation contract as ship_kv_device: nothing resident or a
+    full destination pool → 0 adopted, decode recomputes."""
+    import hashlib
+
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh
+
+    pool = engine.scheduler.pool
+    root = engine._cache_root(lora_name)
+    is_src = role == "prefill"
+    if role not in ("prefill", "decode"):
+        raise ValueError(f"role must be prefill|decode, got {role!r}")
+    if jax.process_count() != 2:
+        # >2 processes (e.g. several decode hosts) needs a pairwise
+        # rendezvous so only ONE destination stages/joins the transfer —
+        # raising here beats deadlocking the distributed runtime
+        # mid-collective with every decode host staged at once
+        raise NotImplementedError(
+            "ship_kv_device_crossproc is a 2-process (one prefill, one "
+            f"decode) shape; got {jax.process_count()} processes"
+        )
+
+    # fingerprint gate across processes: publish a fixed-size digest
+    fp = hashlib.sha256(
+        engine.model_fingerprint.encode()
+    ).digest()
+    fp_arr = np.frombuffer(fp, np.uint8).astype(np.int32)
+    all_fp = multihost_utils.process_allgather(fp_arr)
+    if not (all_fp == all_fp[0]).all():
+        raise ValueError(
+            "KV fingerprint mismatch across PD processes — refusing "
+            "foreign KV"
+        )
+
+    # both sides derive the identical chain; the source counts residency
+    chain = list(pool._chain(list(token_ids), root))
+    n_src = 0
+    if is_src:
+        for h in chain:
+            if pool._hash_to_block.get(h) is None:
+                break
+            n_src += 1
+    counts = multihost_utils.process_allgather(
+        np.asarray([n_src], np.int64)
+    )
+    n_avail = int(counts.max())  # only the source published non-zero
+
+    staged, pinned = [], []
+    max_slots = max(1, len(chain))
+    picked = np.full(max_slots, -1, np.int64)  # chain positions staged
+    if not is_src and n_avail:
+        pos_by_hash = {h: i for i, h in enumerate(chain[:n_avail])}
+        staged, pinned = pool.stage_adoption(chain[:n_avail])
+        for i, (h, _blk) in enumerate(staged):
+            picked[i] = pos_by_hash[h]
+    all_picked = multihost_utils.process_allgather(picked)
+    # the destination's row is the one with staged entries
+    dst_picked = picked if not is_src else all_picked[
+        int(np.argmax((all_picked >= 0).sum(axis=1)))
+    ]
+    ship_pos = dst_picked[dst_picked >= 0].astype(np.int64)
+    n_ship = len(ship_pos)
+    if n_ship == 0:
+        if staged:
+            pool.abort_adoption(staged, pinned)
+        # cooperative exit on both sides — no transfer program to run
+        multihost_utils.sync_global_devices("kv-pd-ship-empty")
+        return 0
+
+    n_pad = _pow2(n_ship)
+    try:
+        kv_caches = engine.runner.kv_caches
+        l_layers = len(kv_caches)
+        leaf_shape = kv_caches[0].shape  # (2, num_blocks, bs, kvh, D)
+        bs, kvh, d = leaf_shape[2], leaf_shape[3], leaf_shape[4]
+
+        # union mesh ordered [source device, destination device]: the
+        # source's process index is the counts row that published
+        # residency; single-device-per-role for now (the single-process
+        # ship_kv_device covers tp-sharded pools; generalizing this path
+        # adds a second mesh axis sharding kvh)
+        src_pid = int(np.argmax(counts[:, 0]))
+        by_proc: dict[int, list] = {}
+        for dv in jax.devices():
+            by_proc.setdefault(dv.process_index, []).append(dv)
+        dst_pid = next(p for p in sorted(by_proc) if p != src_pid)
+        mesh_u = Mesh(
+            np.asarray([by_proc[src_pid][0], by_proc[dst_pid][0]]), ("pd",)
+        )
+        sh = NamedSharding(mesh_u, P("pd"))
+
+        # local payload stays ON DEVICE end to end: the source compacts
+        # its pages, the destination contributes a zero placeholder;
+        # make_array_from_single_device_arrays assembles the global view
+        # from the committed per-process buffers without a host copy
+        if is_src:
+            src_idx = np.zeros(n_pad, np.int32)
+            for i, p in enumerate(ship_pos):
+                src_idx[i] = pool._hash_to_block[chain[int(p)]]
+            if n_ship < n_pad:
+                src_idx[n_ship:] = src_idx[0]  # cheap re-read, discarded
+            gathered = _gather_blocks(
+                kv_caches,
+                jax.device_put(
+                    src_idx, NamedSharding(engine.runner.mesh, P()),
+                ),
+            )
+            payload_local = jnp.stack(gathered)[None]
+        else:
+            payload_local = jnp.zeros(
+                (1, l_layers, 2, n_pad, bs, kvh, d), kv_caches[0].dtype
+            )
+        my_dev = by_proc[jax.process_index()][0]
+        payload_local = jax.device_put(payload_local, my_dev)
+        global_arr = jax.make_array_from_single_device_arrays(
+            (2, *payload_local.shape[1:]), sh, [payload_local]
+        )
+        # THE transfer: shard flip == collective permute over ICI/DCN
+        shipped = jax.jit(
+            lambda x: jnp.flip(x, axis=0), out_shardings=sh
+        )(global_arr)
+        jax.block_until_ready(shipped)
+
+        if not is_src:
+            # the local shard now holds the source's bytes, already on
+            # this process's device — scatter straight into the pool
+            payload = shipped.addressable_shards[0].data[0]  # (L, 2, ...)
+            dst_idx = np.zeros(n_pad, np.int32)
+            for i, (_h, dblk) in enumerate(staged):
+                dst_idx[i] = dblk
+            moved = tuple(
+                jax.device_put(
+                    payload[i],
+                    NamedSharding(engine.runner.mesh, P()),
+                )
+                for i in range(l_layers)
+            )
+            engine.runner.kv_caches = _scatter_blocks(
+                engine.runner.kv_caches,
+                moved,
+                jax.device_put(
+                    dst_idx, NamedSharding(engine.runner.mesh, P()),
+                ),
+            )
+    except Exception:
+        if staged:
+            pool.abort_adoption(staged, pinned)
+        raise
+    if not is_src:
+        pool.commit_adoption(staged, pinned)
+        logger.info(
+            "cross-process device-shipped %d KV blocks (%d offered) "
+            "prefill→decode", len(staged), n_avail,
+        )
+        return len(staged)
+    return 0

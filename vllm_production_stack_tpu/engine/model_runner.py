@@ -74,6 +74,36 @@ def resolve_auto_attention_backend(
     return "xla"
 
 
+def resolve_auto_prefill_backend(
+    *, block_size: int, max_model_len: int, platform: str,
+    heads_divisible: bool,
+) -> str:
+    """The 'auto' PREFILL-attention choice, independent of decode: the
+    paged flash-prefill kernel (ops/paged_attention_pallas.py:
+    paged_prefill_attention) streams pool pages HBM→VMEM with a resident
+    query tile and never materializes the gathered (B, S, kvH, D) history
+    OR the (B, T, S) mask.
+
+    Gate: PROVISIONAL, mirroring the decode sweep's shape (the same
+    page-DMA-size argument applies: 16-token pages make the per-page
+    DMAs/matmuls too small, while the XLA gather's cost tracks gathered
+    bytes — which prefill pays per chunk, so long contexts favor the
+    kernel). block_size >= 32 AND max_model_len >= 4096 on a real TPU,
+    heads divisible across tp (mesh is allowed: the serving path wraps in
+    shard_map over (dp, tp) when mesh.size > 1). Run
+    benchmarks/sweep_attention.py --prefill on the chip to validate or
+    tighten; until that sweep lands in this docstring the explicit
+    'xla'/'pallas' knobs are the source of truth for perf work."""
+    if (
+        block_size >= 32
+        and max_model_len >= 4096
+        and platform == "tpu"
+        and heads_divisible
+    ):
+        return "pallas"
+    return "xla"
+
+
 def _collect_logprobs(logits: jax.Array, tokens: jax.Array):
     """(chosen_lp (S,), top_lp (S, N), top_id (S, N)) from (S, V) logits."""
     lp = jax.nn.log_softmax(logits, axis=-1)
@@ -210,6 +240,7 @@ class ModelRunner:
                 "the ep axis would only replicate dense compute"
             )
         self._attention_backend = self._resolve_attention_backend()
+        self._prefill_backend = self._resolve_prefill_backend()
         self._hoist_budget = self._compute_hoist_budget()
         self._step_fn = (
             self._build_sp_step_fn() if self._sp > 1 else self._build_step_fn()
@@ -326,6 +357,40 @@ class ModelRunner:
         # tests/test_pallas_attention.py::test_pallas_fp8_pool_numerics
         return backend
 
+    def _resolve_prefill_backend(self) -> str:
+        """Prefill attention backend, resolved independently of decode
+        (resolve_auto_prefill_backend has the gate rationale). The sp path
+        (ring attention) ignores this — it has its own sharded prefill."""
+        par = self.config.parallel
+        tp = par.tensor_parallel_size
+        heads_ok = (
+            self.config.model.num_heads % tp == 0
+            and self.config.model.num_kv_heads % tp == 0
+            and par.sequence_parallel_size == 1
+            and par.pipeline_parallel_size == 1
+            and par.expert_parallel_size == 1
+        )
+        backend = self.config.prefill_attention_backend
+        if backend == "auto":
+            return resolve_auto_prefill_backend(
+                block_size=self.config.cache.block_size,
+                max_model_len=self.config.model.max_model_len,
+                platform=jax.devices()[0].platform,
+                heads_divisible=heads_ok,
+            )
+        if backend not in ("xla", "pallas", "pallas_interpret"):
+            raise ValueError(
+                f"unknown prefill_attention_backend {backend!r}; expected "
+                "one of 'auto', 'xla', 'pallas', 'pallas_interpret'"
+            )
+        if backend.startswith("pallas") and self.mesh.size > 1 and not heads_ok:
+            raise ValueError(
+                f"prefill_attention_backend='pallas' under tp={tp} needs "
+                "num_heads and num_kv_heads divisible by tp, and a dp/tp "
+                "mesh (pp/sp/ep shard axes the kernel cannot split)"
+            )
+        return backend
+
     def _compute_hoist_budget(self) -> int:
         """Per-device HBM headroom (bytes) available for hoisting the decode
         window's loop-invariant history gather out of the loop (one
@@ -417,6 +482,8 @@ class ModelRunner:
                     "start_off": start_off,
                     "chunk_lens": chunk_lens,
                 },
+                backend=self._prefill_backend,
+                mesh=self.mesh,
             )
             flat = hidden.reshape(-1, hidden.shape[-1])
             picked = flat[sample_rows]  # (num_samples, h)

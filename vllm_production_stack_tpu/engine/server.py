@@ -1027,6 +1027,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "weight as int8 + per-output-channel scales (half "
                         "the weight HBM — how an 8B-class model fits one "
                         "16 GiB v5e chip)")
+    p.add_argument("--attention-backend", default="auto",
+                   choices=["auto", "xla", "pallas", "pallas_interpret"],
+                   help="decode attention: auto picks the measured winner "
+                        "for the pool's block size (the Pallas paged-decode "
+                        "kernel at >=32-token pages with long context, XLA "
+                        "staged attention otherwise)")
+    p.add_argument("--prefill-attention-backend", default="auto",
+                   choices=["auto", "xla", "pallas", "pallas_interpret"],
+                   help="prefill/chunked-prefill attention, independent of "
+                        "decode: pallas streams pool pages through the "
+                        "paged flash-prefill kernel (no gather, no "
+                        "(B,T,S) mask); auto gates on block size + context")
     p.add_argument("--kv-cache-dtype", default="auto",
                    choices=["auto", "fp8"],
                    help="KV pool storage dtype: fp8 (float8_e4m3fn) halves "
@@ -1129,6 +1141,10 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             max_loras=args.max_loras, max_lora_rank=args.max_lora_rank
         ),
         seed=args.seed,
+        attention_backend=getattr(args, "attention_backend", "auto"),
+        prefill_attention_backend=getattr(
+            args, "prefill_attention_backend", "auto"
+        ),
     )
 
 

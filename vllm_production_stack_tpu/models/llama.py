@@ -34,6 +34,8 @@ from ..ops.attention import (
 from ..ops.paged_attention_pallas import (
     paged_decode_attention,
     paged_decode_attention_sharded,
+    paged_prefill_attention,
+    paged_prefill_attention_sharded,
 )
 
 
@@ -317,10 +319,13 @@ def _layer(
     positions: jax.Array,
     block_tables: jax.Array,
     slot_mapping: jax.Array,
-    mask: jax.Array,
+    mask: jax.Array | None,
     lora: dict | None = None,
     lora_idx: jax.Array | None = None,
     write_blocks: dict | None = None,  # blockwise-write inputs (see forward)
+    pallas_prefill: dict | None = None,  # {"context_lens", "chunk_start",
+    #   "interpret", "mesh"} — route attention through the paged
+    #   flash-prefill kernel instead of the XLA gather (mask is None then)
 ) -> tuple[jax.Array, jax.Array]:
     b, t = x.shape[0], x.shape[1]
     hd, nkv = cfg.head_dim, cfg.num_kv_heads
@@ -336,6 +341,21 @@ def _layer(
             kv_layer = write_kv_pages(
                 kv_layer, k.reshape(b * t, nkv, hd),
                 v.reshape(b * t, nkv, hd), slot_mapping,
+            )
+        if pallas_prefill is not None:
+            mesh = pallas_prefill["mesh"]
+            if mesh is not None and mesh.size > 1:
+                return paged_prefill_attention_sharded(
+                    mesh, q, kv_layer, block_tables,
+                    pallas_prefill["context_lens"],
+                    pallas_prefill["chunk_start"], scale=hd**-0.5,
+                    interpret=pallas_prefill["interpret"],
+                )
+            return paged_prefill_attention(
+                q, kv_layer, block_tables,
+                pallas_prefill["context_lens"],
+                pallas_prefill["chunk_start"], scale=hd**-0.5,
+                interpret=pallas_prefill["interpret"],
             )
         return paged_attention_xla(
             q, kv_layer, block_tables, mask, scale=hd**-0.5
@@ -361,13 +381,30 @@ def forward(
     #   K/V commits via the page-granular read-modify-write
     #   (ops/attention.py:write_kv_pages_blockwise) instead of the per-token
     #   row scatter; the serving prefill path passes this
+    backend: str = "xla",  # "xla" | "pallas" | "pallas_interpret" — prefill
+    #   attention path; pallas streams pool pages through the paged
+    #   flash-prefill kernel and never builds the (B, T, S) mask
+    mesh=None,  # required for the pallas backend on a >1-device mesh
 ) -> tuple[jax.Array, jax.Array]:
     """One model step over a token batch. Prefill is (B=1, T=chunk); decode is
     (B=batch, T=1). Returns (hidden (B,T,h), updated kv_caches)."""
     x = _embed(cfg, params, token_ids)
-    # layer-invariant attention mask, built once and reused by every layer
-    s_ctx = block_tables.shape[1] * kv_caches[0].shape[2]
-    mask = causal_page_mask(positions, context_lens, s_ctx)
+    if backend.startswith("pallas"):
+        # the kernel masks from scalars alone — the scheduler feeds chunks
+        # with contiguous positions (scheduler.py: range(start, start+len)),
+        # so chunk_start is the first column. No (B, T, S) mask exists.
+        mask = None
+        pallas_prefill = {
+            "context_lens": context_lens,
+            "chunk_start": positions[:, 0],
+            "interpret": backend == "pallas_interpret",
+            "mesh": mesh,
+        }
+    else:
+        # layer-invariant attention mask, built once, reused by every layer
+        s_ctx = block_tables.shape[1] * kv_caches[0].shape[2]
+        mask = causal_page_mask(positions, context_lens, s_ctx)
+        pallas_prefill = None
 
     # unrolled layer loop (params stay stacked; each layer slices statically).
     # Unrolling instead of lax.scan lets each per-layer KV leaf alias its
@@ -379,6 +416,7 @@ def forward(
         x, layer_kv = _layer(
             cfg, lp, kv_caches[i], x, positions, block_tables, slot_mapping,
             mask, _lora_layer_slice(lora, i), lora_idx, write_blocks,
+            pallas_prefill,
         )
         new_kv.append(layer_kv)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)

@@ -252,6 +252,218 @@ def _hist_kernel(
         out_ref[0] = (acc_ref[:] / l_ref[:]).astype(out_ref.dtype)
 
 
+# --------------------------------------------------------------------------
+# Paged PREFILL (chunked-prefill-aware) flash attention
+# --------------------------------------------------------------------------
+
+# query-tile length: q rows resident in VMEM across the page stream. 256
+# keeps (nh, Tt, D) q + f32 (nh*Tt, D) acc under ~3 MB for llama head
+# shapes, leaving room for double-buffered page DMAs
+PREFILL_Q_TILE = 256
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    tables_ref,  # (B, nb) int32 — page id per (row, page-slot)
+    ctx_ref,  # (B,) int32 — resident tokens AFTER this chunk (incl. chunk)
+    start_ref,  # (B,) int32 — logical position of the chunk's first token
+    # pipeline inputs
+    q_ref,  # (1, nh, Tt, D) — head-major so per-head slices are static 2D
+    kv_ref,  # (2, 1, bs, kvh, D) — this grid step's pool page
+    # output
+    out_ref,  # (1, nh, Tt, D)
+    # scratch
+    m_ref,  # (nh*Tt, 1) f32 running max, head-major rows
+    l_ref,  # (nh*Tt, 1) f32 running denominator
+    acc_ref,  # (nh*Tt, D) f32 running numerator
+    *,
+    scale: float,
+    block_size: int,
+    num_kv_heads: int,
+):
+    """Flash prefill over the paged pool: the page id for each grid step
+    comes from the scalar-prefetched block table (the gather IS the
+    pipeline's index_map — same trick as _decode_kernel), the query tile
+    stays in VMEM, and causality is computed from iotas alone: the serving
+    scheduler feeds chunks with CONTIGUOUS positions (scheduler.py
+    work.positions = range(start, start+len)), so q position = chunk_start
+    + tile offset + row. Chunked prefill needs no special casing — resident
+    pages hold earlier chunks AND this chunk's freshly-written KV (forward
+    writes before attending), and `pos_k <= pos_q` masks the not-yet-valid
+    tail of the chunk's own pages."""
+    b = pl.program_id(0)
+    qt = pl.program_id(1)
+    j = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+
+    nh, tt, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    qpk = nh // num_kv_heads
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # (Tt, bs) mask — identical for every head, built once per grid step
+    q_pos = (
+        start_ref[b]
+        + qt * tt
+        + jax.lax.broadcasted_iota(jnp.int32, (tt, block_size), 0)
+    )
+    pos_k = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (tt, block_size), 1
+    )
+    valid = (pos_k < ctx_ref[b]) & (pos_k <= q_pos)
+
+    # skip pages fully outside this tile's attendable range: beyond the
+    # row's residency, or entirely after the tile's last query position.
+    # The DMA still lands (static block spec) but the 2*nh dots don't run
+    page_live = (j * block_size < ctx_ref[b]) & (
+        j * block_size <= start_ref[b] + qt * tt + tt - 1
+    )
+
+    @pl.when(page_live)
+    def _():
+        k_page = kv_ref[0, 0].astype(jnp.float32)  # (bs, kvh, D)
+        v_page = kv_ref[1, 0].astype(jnp.float32)
+        for h in range(nh):
+            g = h // qpk
+            q_h = q_ref[0, h].astype(jnp.float32)  # (Tt, D)
+            scores = jax.lax.dot_general(
+                q_h, k_page[:, g, :],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (Tt, bs)
+            scores = jnp.where(valid, scores * scale, NEG_INF)
+            r0, r1 = h * tt, (h + 1) * tt
+            m_prev, l_prev = m_ref[r0:r1], l_ref[r0:r1]
+            m_cur = jnp.max(scores, axis=1, keepdims=True)  # (Tt, 1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(scores - m_new)  # (Tt, bs)
+            l_ref[r0:r1] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+            m_ref[r0:r1] = m_new
+            acc_ref[r0:r1] = acc_ref[r0:r1] * alpha + jax.lax.dot(
+                p, v_page[:, g, :], preferred_element_type=jnp.float32
+            )
+
+    @pl.when(j == num_pages - 1)
+    def _():
+        for h in range(nh):
+            r0, r1 = h * tt, (h + 1) * tt
+            # padding rows attend nothing (ctx 0) — l stays 0; the max
+            # keeps them finite (their outputs are never read)
+            out_ref[0, h] = (
+                acc_ref[r0:r1] / jnp.maximum(l_ref[r0:r1], 1e-30)
+            ).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_prefill_attention(
+    q: jax.Array,  # (B, T, nh, D) — the chunk's queries
+    kv: jax.Array,  # (2, num_blocks, bs, kvh, D) — pool, chunk KV already in
+    block_tables: jax.Array,  # (B, nb) int32
+    context_lens: jax.Array,  # (B,) int32 — resident incl. this chunk
+    chunk_start: jax.Array,  # (B,) int32 — logical position of q[:, 0]
+    *,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Complete chunked-prefill attention (resident pages + the chunk's own
+    freshly-written pages) without materializing the per-row gather OR the
+    (B, T, S) mask the XLA path builds. Returns (B, T, nh, D).
+
+    Reference parity: covers what the reference's engine images get from
+    vLLM's CUDA flash-prefill over paged KV; SURVEY §7.1 names paged
+    attention kernels as the TPU-native hard part."""
+    b, t, nh, d = q.shape
+    kvh, bs = kv.shape[3], kv.shape[2]
+    nb = block_tables.shape[1]
+    tt = min(t, PREFILL_Q_TILE)
+    assert t % tt == 0, (t, tt)  # T is a power-of-two bucket
+
+    # head-major q so the kernel's per-head slices are static 2D views
+    q_hm = q.transpose(0, 2, 1, 3)  # (B, nh, T, D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # tables, context_lens, chunk_start
+        grid=(b, t // tt, nb),
+        in_specs=[
+            pl.BlockSpec((1, nh, tt, d), lambda i, qt, j, tb, c, st: (i, 0, qt, 0)),
+            # the paged "gather": page id for grid step (i, qt, j) comes
+            # straight from the prefetched block table
+            pl.BlockSpec(
+                (2, 1, bs, kvh, d),
+                lambda i, qt, j, tb, c, st: (0, tb[i, j], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, nh, tt, d), lambda i, qt, j, tb, c, st: (i, 0, qt, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((nh * tt, 1), jnp.float32),
+            pltpu.VMEM((nh * tt, 1), jnp.float32),
+            pltpu.VMEM((nh * tt, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel, scale=scale, block_size=bs, num_kv_heads=kvh
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nh, t, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, context_lens, chunk_start, q_hm, kv)
+    return out.transpose(0, 2, 1, 3)  # back to (B, T, nh, D)
+
+
+def paged_prefill_attention_sharded(
+    mesh,
+    q: jax.Array,  # (B, T, nh, D) — batch over dp, heads over tp
+    kv: jax.Array,  # (2, num_blocks, bs, kvh, D) — kv heads over tp
+    block_tables: jax.Array,  # (B, nb)
+    context_lens: jax.Array,  # (B,)
+    chunk_start: jax.Array,  # (B,)
+    *,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """The prefill kernel under tensor/data parallelism — same shard_map
+    placement as paged_decode_attention_sharded: prefill attention is
+    embarrassingly parallel over (row, head) once KV pages are head-sharded
+    (kv_cache_spec's layout), so no collective is needed."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DP_AXIS, TP_AXIS
+
+    tp = mesh.shape[TP_AXIS]
+    nh, kvh = q.shape[2], kv.shape[3]
+    if nh % tp or kvh % tp:
+        raise ValueError(
+            f"pallas prefill under tp={tp} needs heads divisible by tp "
+            f"(num_heads={nh}, num_kv_heads={kvh})"
+        )
+    fn = shard_map(
+        functools.partial(
+            paged_prefill_attention, scale=scale, interpret=interpret
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(DP_AXIS, None, TP_AXIS, None),  # q
+            P(None, None, None, TP_AXIS, None),  # kv pool
+            P(DP_AXIS, None),  # block tables
+            P(DP_AXIS),  # context_lens
+            P(DP_AXIS),  # chunk_start
+        ),
+        out_specs=P(DP_AXIS, None, TP_AXIS, None),
+        check_rep=False,
+    )
+    return fn(q, kv, block_tables, context_lens, chunk_start)
+
+
 HIST_CHUNK = 512
 
 
