@@ -1,0 +1,158 @@
+"""EPP against a REAL Envoy (VERDICT r4 #8): drive the ext-proc stream
+through the actual config in gateway/configs/envoy-demo.yaml and assert the
+destination-header routing end to end — client → envoy listener →
+ext_proc(EPP) → ORIGINAL_DST cluster → fake engine.
+
+Skips when no `envoy` binary is on PATH (this image has none); the
+gateway-envoy-e2e CI workflow installs one (func-e) and runs this test on
+every push, which is where the assertion actually bites. The rendered
+config IS the shipped demo file with live ports substituted, so the test
+pins the artifact users copy."""
+
+import asyncio
+import json
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from aiohttp.test_utils import TestServer
+
+from vllm_production_stack_tpu.testing.fake_engine import FakeEngine
+
+REPO = Path(__file__).resolve().parent.parent
+
+needs_envoy = pytest.mark.skipif(
+    shutil.which("envoy") is None, reason="no envoy binary on PATH"
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_port(port: int, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.3)
+    raise TimeoutError(f"port {port} never opened")
+
+
+@needs_envoy
+def test_envoy_ext_proc_routes_on_epp_header(tmp_path):
+    async def go():
+        engines, servers = [], []
+        for _ in range(2):
+            eng = FakeEngine(model="fake-model", tokens_per_sec=5000)
+            srv = TestServer(eng.build_app())
+            await srv.start_server()
+            engines.append(eng)
+            servers.append(srv)
+
+        epp_port = _free_port()
+        listener_port = _free_port()
+        admin_port = _free_port()
+        backends = ",".join(
+            f"http://127.0.0.1:{s.port}" for s in servers
+        )
+        epp = subprocess.Popen(
+            [sys.executable, "-m", "vllm_production_stack_tpu.gateway.epp",
+             "--port", str(epp_port),
+             "--routing-policy", "prefixaware",
+             "--static-backends", backends,
+             "--static-models", "fake-model;fake-model"],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        # the SHIPPED demo config with live ports — drift between docs and
+        # test is impossible
+        cfg = (REPO / "gateway/configs/envoy-demo.yaml").read_text()
+        cfg = cfg.replace("port_value: 9002", f"port_value: {epp_port}")
+        cfg = cfg.replace("port_value: 10000", f"port_value: {listener_port}")
+        cfg = cfg.replace("port_value: 9901", f"port_value: {admin_port}")
+        cfg_path = tmp_path / "envoy.yaml"
+        cfg_path.write_text(cfg)
+        envoy = subprocess.Popen(
+            ["envoy", "-c", str(cfg_path), "--base-id",
+             str(listener_port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, _wait_port, epp_port
+            )
+            await asyncio.get_running_loop().run_in_executor(
+                None, _wait_port, listener_port
+            )
+
+            import aiohttp
+
+            # two distinct long shared prefixes: prefixaware must pin each
+            # prefix's requests to one engine
+            prefixes = ["alpha " * 40, "beta " * 40]
+            sent = 0
+            async with aiohttp.ClientSession() as session:
+                for rep in range(3):
+                    for pfx in prefixes:
+                        async with session.post(
+                            f"http://127.0.0.1:{listener_port}"
+                            "/v1/completions",
+                            json={"model": "fake-model",
+                                  "prompt": pfx + f"q{rep}",
+                                  "max_tokens": 4},
+                            timeout=aiohttp.ClientTimeout(total=30),
+                        ) as resp:
+                            assert resp.status == 200, await resp.text()
+                            out = await resp.json()
+                            assert out["choices"][0]["text"]
+                            sent += 1
+
+            total = sum(e.total_requests for e in engines)
+            assert total == sent, (total, sent)
+            # stickiness: every request carrying prefix P landed on ONE
+            # engine (the reference's test-routing.py acceptance shape)
+            for pfx in prefixes:
+                hit = [
+                    i for i, e in enumerate(engines)
+                    if any(
+                        pfx in json.dumps(r.get("body", {}))
+                        for r in e.seen_request_log
+                    )
+                ]
+                assert len(hit) == 1, f"prefix split across engines: {hit}"
+            return True
+        finally:
+            for proc in (envoy, epp):
+                proc.send_signal(signal.SIGTERM)
+            for proc in (envoy, epp):
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            for s in servers:
+                await s.close()
+
+    assert asyncio.run(go())
+
+
+def test_demo_config_pins_header_and_modes():
+    """The shipped demo config must keep the contract the EPP implements:
+    BUFFERED request body (the EPP routes on the complete JSON) and the
+    destination header the ORIGINAL_DST cluster reads. Runs WITHOUT envoy —
+    config drift fails everywhere, not just in CI."""
+    cfg = (REPO / "gateway/configs/envoy-demo.yaml").read_text()
+    assert "request_body_mode: BUFFERED" in cfg
+    assert "http_header_name: x-gateway-destination-endpoint" in cfg
+    assert "use_http_header: true" in cfg
+    assert "failure_mode_allow: false" in cfg

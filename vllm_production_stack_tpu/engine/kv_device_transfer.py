@@ -250,13 +250,28 @@ def ship_kv_device_crossproc(
     ship_pos = dst_picked[dst_picked >= 0].astype(np.int64)
     n_ship = len(ship_pos)
     if n_ship == 0:
-        if staged:
+        # unconditional: stage_adoption can pin already-resident chain
+        # members (refcount+1) while returning staged=[] — skipping the
+        # abort would leak those pins and make the blocks unevictable
+        if staged or pinned:
             pool.abort_adoption(staged, pinned)
         # cooperative exit on both sides — no transfer program to run
         multihost_utils.sync_global_devices("kv-pd-ship-empty")
         return 0
 
     n_pad = _pow2(n_ship)
+
+    # ---- local preparation, allowed to fail one-sided --------------------
+    # Everything that can raise asymmetrically (device OOM in the gather,
+    # a chain block evicted between the residency count and src_idx
+    # construction, ...) happens BEFORE the go/no-go barrier below. After
+    # the barrier both sides are inside the same collective, where a
+    # failure is fate-shared — one side raising while the peer sits in
+    # block_until_ready would otherwise hang the peer until an external
+    # timeout with the real error invisible.
+    prep_err: Exception | None = None
+    payload_local = None
+    sh = None
     try:
         kv_caches = engine.runner.kv_caches
         l_layers = len(kv_caches)
@@ -301,6 +316,26 @@ def ship_kv_device_crossproc(
             )
         my_dev = by_proc[jax.process_index()][0]
         payload_local = jax.device_put(payload_local, my_dev)
+        jax.block_until_ready(payload_local)
+    except Exception as e:  # noqa: BLE001 — published to the peer below
+        prep_err = e
+
+    # go/no-go barrier: both sides publish readiness; either side failing
+    # aborts BOTH cleanly before anyone enters the collective
+    ready = multihost_utils.process_allgather(
+        np.asarray([0 if prep_err is not None else 1], np.int64)
+    )
+    if not bool(ready.min()):
+        if staged or pinned:
+            pool.abort_adoption(staged, pinned)
+        if prep_err is not None:
+            raise prep_err
+        logger.warning(
+            "cross-process KV ship aborted: peer failed preparation"
+        )
+        return 0
+
+    try:
         global_arr = jax.make_array_from_single_device_arrays(
             (2, *payload_local.shape[1:]), sh, [payload_local]
         )
@@ -332,7 +367,7 @@ def ship_kv_device_crossproc(
                 ),
             )
     except Exception:
-        if staged:
+        if staged or pinned:
             pool.abort_adoption(staged, pinned)
         raise
     if not is_src:

@@ -235,6 +235,20 @@ def _pd_worker() -> None:
         oracle = LLMEngine(config, mesh=local_mesh)
         want = oracle.generate([prompt], sampling)[0]["token_ids"]
         assert out == want, (out, want)
+    # re-ship the SAME prompt: everything is already resident on the
+    # decode side, so adoption must be 0 AND must not leak the pins
+    # stage_adoption takes on resident chain members (the empty-ship
+    # abort path — a leak makes blocks unevictable over repeat ships)
+    refs_before = dict(engine.scheduler.pool._ref)
+    adopted2 = ship_kv_device_crossproc(
+        engine, role="prefill" if pid == 0 else "decode", token_ids=prompt,
+    )
+    if pid == 1:
+        assert adopted2 == 0, adopted2
+        assert engine.scheduler.pool._ref == refs_before, (
+            "re-ship leaked block pins",
+            refs_before, engine.scheduler.pool._ref,
+        )
         print(
             f"PD_DRYRUN_OK adopted={adopted} continuation={out[:4]}...",
             flush=True,
