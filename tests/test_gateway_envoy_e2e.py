@@ -26,28 +26,13 @@ from aiohttp.test_utils import TestServer
 
 from vllm_production_stack_tpu.testing.fake_engine import FakeEngine
 
+from netutil import free_port as _free_port, wait_port as _wait_port
+
 REPO = Path(__file__).resolve().parent.parent
 
 needs_envoy = pytest.mark.skipif(
     shutil.which("envoy") is None, reason="no envoy binary on PATH"
 )
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _wait_port(port: int, timeout: float = 30.0) -> None:
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        try:
-            with socket.create_connection(("127.0.0.1", port), timeout=1):
-                return
-        except OSError:
-            time.sleep(0.3)
-    raise TimeoutError(f"port {port} never opened")
 
 
 @needs_envoy

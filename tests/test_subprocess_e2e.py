@@ -19,10 +19,7 @@ import pytest
 REPO = __import__("pathlib").Path(__file__).resolve().parent.parent
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from netutil import free_port as _free_port
 
 
 def _wait_http(url: str, timeout: float = 30.0) -> None:

@@ -1,0 +1,268 @@
+"""OpenAI tool calling (engine/tool_calls.py + server wiring).
+
+Reference parity: vLLM's tool-enabled serving (`--tool-call-parser
+hermes` class, reference tutorials/13-tool-enabled-installation.md). The
+parser/renderer are pinned directly; the server paths are driven through
+the real aiohttp app with a scripted generation stream (a random-weight
+model cannot be prompted into emitting tool-call markup, so the script
+IS the model output — everything from the HTTP boundary to the SSE
+framing is real).
+"""
+
+import asyncio
+import json
+from types import SimpleNamespace
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vllm_production_stack_tpu.engine.tool_calls import (
+    ToolCallStreamParser,
+    parse_tool_calls,
+    render_messages,
+)
+
+WEATHER_TOOL = {
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Look up current weather",
+        "parameters": {
+            "type": "object",
+            "properties": {"city": {"type": "string"}},
+            "required": ["city"],
+        },
+    },
+}
+
+CALL_BLOCK = (
+    '<tool_call>{"name": "get_weather", "arguments": {"city": "Paris"}}'
+    "</tool_call>"
+)
+
+
+def test_parse_single_call_with_content():
+    content, calls = parse_tool_calls("Let me check. " + CALL_BLOCK)
+    assert content == "Let me check."
+    assert len(calls) == 1
+    assert calls[0]["type"] == "function"
+    assert calls[0]["function"]["name"] == "get_weather"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Paris"}
+    assert calls[0]["id"].startswith("call_")
+
+
+def test_parse_multiple_calls_and_no_content():
+    text = CALL_BLOCK + '<tool_call>{"name": "b", "arguments": "{}"}</tool_call>'
+    content, calls = parse_tool_calls(text)
+    assert content is None
+    assert [c["function"]["name"] for c in calls] == ["get_weather", "b"]
+
+
+def test_parse_malformed_block_degrades_to_text():
+    text = "<tool_call>not json</tool_call> after"
+    content, calls = parse_tool_calls(text)
+    assert calls == []
+    assert "not json" in content and "after" in content
+
+
+def test_render_injects_tools_and_roundtrips_history():
+    messages = [
+        {"role": "system", "content": "Be helpful."},
+        {"role": "user", "content": "Weather in Paris?"},
+        {"role": "assistant", "content": None, "tool_calls": [{
+            "id": "call_1", "type": "function",
+            "function": {"name": "get_weather",
+                         "arguments": '{"city": "Paris"}'},
+        }]},
+        {"role": "tool", "tool_call_id": "call_1", "content": "22C sunny"},
+    ]
+    out = render_messages(messages, [WEATHER_TOOL], "auto")
+    assert out[0]["role"] == "system"
+    assert "get_weather" in out[0]["content"]  # schema advertised
+    assert "Be helpful." in out[0]["content"]  # original system kept
+    assert "<tool_call>" in out[2]["content"]  # assistant call re-rendered
+    assert out[3]["role"] == "user"  # tool result templated as plain turn
+    assert "22C sunny" in out[3]["content"]
+    # every message is plain-content after rendering (any template works)
+    assert all(isinstance(m["content"], str) for m in out)
+
+
+def test_render_handles_content_parts_arrays():
+    """OpenAI clients send content as parts arrays; the renderer must
+    flatten them, not crash concatenating list+str (found by review)."""
+    messages = [
+        {"role": "system",
+         "content": [{"type": "text", "text": "Be helpful."}]},
+        {"role": "user",
+         "content": [{"type": "text", "text": "Weather in "},
+                     {"type": "text", "text": "Paris?"}]},
+        {"role": "assistant",
+         "content": [{"type": "text", "text": "on it"}],
+         "tool_calls": [{"id": "c", "type": "function",
+                         "function": {"name": "get_weather",
+                                      "arguments": "{}"}}]},
+    ]
+    out = render_messages(messages, [WEATHER_TOOL], "auto")
+    assert out[0]["content"].startswith("Be helpful.")
+    assert "get_weather" in out[0]["content"]
+    assert out[1]["content"] == "Weather in Paris?"
+    assert "on it" in out[2]["content"] and "<tool_call>" in out[2]["content"]
+
+
+def test_render_tool_choice_variants():
+    msgs = [{"role": "user", "content": "hi"}]
+    none_out = render_messages(msgs, None, "none")
+    assert none_out == [{"role": "user", "content": "hi"}]
+    req = render_messages(msgs, [WEATHER_TOOL], "required")
+    assert "MUST call at least one" in req[0]["content"]
+    named = render_messages(
+        msgs, [WEATHER_TOOL],
+        {"type": "function", "function": {"name": "get_weather"}},
+    )
+    assert 'MUST call the tool named "get_weather"' in named[0]["content"]
+
+
+def test_stream_parser_holds_partial_tag_and_splits():
+    p = ToolCallStreamParser()
+    assert p.feed("Sure, ") == "Sure, "
+    # "<tool" might be the start of a block: held back
+    assert p.feed("one sec <tool") == "one sec "
+    # ...it was: the whole block is swallowed into a call
+    assert p.feed('_call>{"name": "get_weather", "arguments": {}}') == ""
+    assert p.feed("</tool_call> done") == " done"
+    tail, calls = p.finish()
+    assert tail == ""
+    assert len(calls) == 1 and calls[0]["function"]["name"] == "get_weather"
+
+
+def test_stream_parser_releases_false_alarm_and_unterminated():
+    p = ToolCallStreamParser()
+    assert p.feed("a <toolbox") == "a <toolbox"  # not a block after all
+    p2 = ToolCallStreamParser()
+    assert p2.feed("x <tool_call>{\"name\"") == "x "
+    tail, calls = p2.finish()  # model never closed the block
+    assert tail.startswith("<tool_call>")
+    assert calls == []
+
+
+# -- server wiring over the real aiohttp app --------------------------------
+
+
+@pytest.fixture(scope="module")
+def srv():
+    from vllm_production_stack_tpu.engine.config import EngineConfig
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.server import EngineServer
+
+    engine = LLMEngine(EngineConfig.tiny())
+    return EngineServer(engine, served_model_name="tiny-llama")
+
+
+def _scripted_generate(deltas):
+    async def generate(**kw):
+        for i, d in enumerate(deltas):
+            last = i == len(deltas) - 1
+            yield SimpleNamespace(
+                text_delta=d, new_token_ids=[i], new_logprobs=None,
+                finish_reason="stop" if last else None, finished=last,
+                num_prompt_tokens=7, num_output_tokens=i + 1,
+            )
+
+    return generate
+
+
+def _with_client(srv, coro_fn):
+    async def runner():
+        client = TestClient(TestServer(srv.build_app()))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def test_chat_tool_call_nonstream(srv, monkeypatch):
+    monkeypatch.setattr(
+        srv.async_engine, "generate",
+        _scripted_generate(["Checking. ", "<tool_call>",
+                            '{"name": "get_weather", '
+                            '"arguments": {"city": "Paris"}}',
+                            "</tool_call>"]),
+    )
+
+    async def go(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "Weather in Paris?"}],
+            "tools": [WEATHER_TOOL],
+        })
+        return r.status, await r.json()
+
+    status, out = _with_client(srv, go)
+    assert status == 200
+    choice = out["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    msg = choice["message"]
+    assert msg["content"] == "Checking."
+    assert msg["tool_calls"][0]["function"]["name"] == "get_weather"
+    assert json.loads(msg["tool_calls"][0]["function"]["arguments"]) == {
+        "city": "Paris"
+    }
+
+
+def test_chat_tool_call_streaming(srv, monkeypatch):
+    monkeypatch.setattr(
+        srv.async_engine, "generate",
+        _scripted_generate(["Look", "ing. <tool_c",
+                            'all>{"name": "get_weather", "arguments": {}}',
+                            "</tool_call>"]),
+    )
+
+    async def go(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "hi"}],
+            "tools": [WEATHER_TOOL],
+            "stream": True,
+        })
+        assert r.status == 200
+        chunks = []
+        async for raw in r.content:
+            line = raw.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                chunks.append(json.loads(line[6:]))
+        return chunks
+
+    chunks = _with_client(srv, go)
+    deltas = [c["choices"][0]["delta"] for c in chunks if c["choices"]]
+    visible = "".join(d.get("content") or "" for d in deltas)
+    assert visible == "Looking. "  # markup never reached the wire
+    tool_deltas = [d for d in deltas if d.get("tool_calls")]
+    assert len(tool_deltas) == 1
+    assert tool_deltas[0]["tool_calls"][0]["function"]["name"] == "get_weather"
+    finishes = [c["choices"][0].get("finish_reason") for c in chunks
+                if c["choices"]]
+    assert "tool_calls" in finishes
+
+
+def test_chat_without_tools_unchanged(srv, monkeypatch):
+    """No tools in the request: the scripted markup streams through
+    verbatim — parsing must be strictly opt-in."""
+    monkeypatch.setattr(
+        srv.async_engine, "generate",
+        _scripted_generate(["plain <tool_call> text"]),
+    )
+
+    async def go(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "hi"}],
+        })
+        return (await r.json())["choices"][0]
+
+    choice = _with_client(srv, go)
+    assert choice["message"]["content"] == "plain <tool_call> text"
+    assert "tool_calls" not in choice["message"]
+    assert choice["finish_reason"] == "stop"

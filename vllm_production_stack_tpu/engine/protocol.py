@@ -39,6 +39,10 @@ class EmbeddingRequest(OpenAIModel):
 class ChatCompletionRequest(OpenAIModel):
     model: str
     messages: list[ChatMessage]
+    # OpenAI tool calling (engine/tool_calls.py — Hermes-style convention;
+    # tool_choice: "auto" | "none" | "required" | {"type":"function",...})
+    tools: list[dict] | None = None
+    tool_choice: str | dict | None = None
     max_tokens: int | None = None
     max_completion_tokens: int | None = None
     temperature: float = 1.0

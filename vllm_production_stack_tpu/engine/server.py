@@ -168,9 +168,17 @@ class EngineServer:
         if err := self._check_model(body.model):
             return err
         lora_name = body.model if body.model in self.lora_adapters else None
-        prompt = self.async_engine.chat_prompt(
-            [m.model_dump() for m in body.messages]
-        )
+        messages = [m.model_dump() for m in body.messages]
+        use_tools = bool(body.tools) and body.tool_choice != "none"
+        if use_tools or any(
+            m.get("role") == "tool" or m.get("tool_calls") for m in messages
+        ):
+            from .tool_calls import render_messages
+
+            messages = render_messages(
+                messages, body.tools if use_tools else None, body.tool_choice
+            )
+        prompt = self.async_engine.chat_prompt(messages)
         sampling = body.sampling(DEFAULT_MAX_TOKENS)
         if err := self._check_logprobs(sampling):
             return err
@@ -178,10 +186,11 @@ class EngineServer:
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=True,
-                lora_name=lora_name,
+                lora_name=lora_name, parse_tools=use_tools,
             )
         return await self._complete(
-            rid, prompt, sampling, chat=True, lora_name=lora_name
+            rid, prompt, sampling, chat=True, lora_name=lora_name,
+            parse_tools=use_tools,
         )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
@@ -467,7 +476,7 @@ class EngineServer:
 
     async def _complete(
         self, rid, prompt, sampling, *, chat: bool, prompt_ids=None,
-        lora_name=None,
+        lora_name=None, parse_tools: bool = False,
     ) -> web.Response:
         text = ""
         token_ids: list[int] = []
@@ -495,9 +504,18 @@ class EngineServer:
             return error(500, text, "internal_error")
         created = int(time.time())
         if chat:
+            message = {"role": "assistant", "content": text}
+            if parse_tools:
+                from .tool_calls import parse_tool_calls
+
+                content, calls = parse_tool_calls(text)
+                if calls:
+                    message = {"role": "assistant", "content": content,
+                               "tool_calls": calls}
+                    finish_reason = "tool_calls"
             choice = {
                 "index": 0,
-                "message": {"role": "assistant", "content": text},
+                "message": message,
                 "finish_reason": finish_reason,
             }
             if sampling.logprobs is not None:
@@ -525,7 +543,7 @@ class EngineServer:
 
     async def _stream(
         self, request, rid, prompt, sampling, body, *, chat: bool,
-        prompt_ids=None, lora_name=None,
+        prompt_ids=None, lora_name=None, parse_tools: bool = False,
     ) -> web.StreamResponse:
         if self.async_engine.is_sleeping:
             return error(503, "engine is sleeping", "service_unavailable")
@@ -547,6 +565,15 @@ class EngineServer:
             await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
 
         lp_off = 0  # running text offset for completions logprobs
+        # tool-call splitting: visible text streams as usual; text inside
+        # (or possibly starting) a <tool_call> block is held back and the
+        # parsed calls go out in a final delta with finish_reason
+        # "tool_calls" — the streaming contract OpenAI clients implement
+        tool_parser = None
+        if parse_tools and chat:
+            from .tool_calls import ToolCallStreamParser
+
+            tool_parser = ToolCallStreamParser()
         if chat:  # role preamble chunk
             await send(self._chunk(rid, obj, created, {"role": "assistant"}, None))
         try:
@@ -565,10 +592,41 @@ class EngineServer:
                 # first-token latency is only observable if the first
                 # token's chunk actually goes out
                 if out.new_token_ids or out.text_delta or out.finished:
+                    text_delta = out.text_delta
+                    if tool_parser is not None:
+                        text_delta = tool_parser.feed(text_delta)
+                        if out.finished:
+                            tail, calls = tool_parser.finish()
+                            text_delta += tail
+                            if calls:
+                                chunk = self._chunk(
+                                    rid, obj, created,
+                                    {"content": text_delta or None,
+                                     "tool_calls": [
+                                         {**c, "index": i}
+                                         for i, c in enumerate(calls)
+                                     ]},
+                                    "tool_calls",
+                                )
+                                # the final step's logprobs ride this chunk
+                                # like any other (the non-stream path
+                                # returns the complete set)
+                                if sampling.logprobs is not None and (
+                                    out.new_logprobs
+                                ):
+                                    chunk["choices"][0]["logprobs"] = (
+                                        self._chat_logprobs(
+                                            out.new_token_ids,
+                                            out.new_logprobs,
+                                            sampling.logprobs,
+                                        )
+                                    )
+                                await send(chunk)
+                                continue
                     delta = (
-                        {"content": out.text_delta}
+                        {"content": text_delta}
                         if chat
-                        else out.text_delta
+                        else text_delta
                     )
                     chunk = self._chunk(
                         rid, obj, created, delta,

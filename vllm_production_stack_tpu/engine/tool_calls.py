@@ -1,0 +1,219 @@
+"""OpenAI tool calling (function calling) for the engine server.
+
+Reference parity: vLLM engines serve `tools`/`tool_choice` via per-model
+tool parsers (`--enable-auto-tool-choice --tool-call-parser hermes` in the
+reference's tool-enabled install, tutorials/13-tool-enabled-installation.md);
+the router proxies the surface untouched. Here the engine implements the
+Hermes-style convention directly — the most widely fine-tuned format and
+the one vLLM's default parser family targets:
+
+- tool definitions are injected as a system block listing JSON schemas;
+- the model emits calls as `<tool_call>{"name": ..., "arguments": {...}}
+  </tool_call>` blocks;
+- assistant tool_calls / tool-result messages in the history are rendered
+  back into the same textual convention, so multi-turn tool use works
+  through ANY chat template (HF template or the byte fallback — the
+  rendering happens before apply_chat_template and uses plain content).
+
+Prompt-level steering only: `tool_choice="required"` / a named function
+instructs the model but cannot grammar-constrain sampling — same
+best-effort contract as vLLM without guided decoding.
+
+The streaming parser holds back any text that could be the start of a
+`<tool_call>` tag so clients never see half-emitted markup, and releases
+it verbatim when it turns out not to be a call.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import uuid
+
+TOOL_OPEN = "<tool_call>"
+TOOL_CLOSE = "</tool_call>"
+
+_BLOCK_RE = re.compile(
+    re.escape(TOOL_OPEN) + r"\s*(.*?)\s*" + re.escape(TOOL_CLOSE),
+    re.DOTALL,
+)
+
+
+def call_id() -> str:
+    return "call_" + uuid.uuid4().hex[:24]
+
+
+def _content_str(content) -> str:
+    """Flatten OpenAI content (str | parts array | None) to plain text —
+    clients routinely send [{"type": "text", "text": ...}, ...] and the
+    renderer must never concatenate a list into a template string."""
+    if content is None:
+        return ""
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        parts = []
+        for p in content:
+            if isinstance(p, dict) and p.get("type") == "text":
+                parts.append(str(p.get("text", "")))
+            elif isinstance(p, str):
+                parts.append(p)
+        return "".join(parts)
+    return str(content)
+
+
+def tools_system_block(tools: list[dict], tool_choice) -> str:
+    """The system-prompt block advertising the tools and the required
+    output convention."""
+    specs = []
+    for t in tools:
+        fn = t.get("function", t) or {}
+        specs.append(json.dumps({
+            "name": fn.get("name"),
+            "description": fn.get("description", ""),
+            "parameters": fn.get("parameters", {}),
+        }, ensure_ascii=False))
+    lines = [
+        "You have access to the following tools:",
+        *specs,
+        "To call a tool, respond with exactly:",
+        f'{TOOL_OPEN}{{"name": "<tool-name>", "arguments": {{...}}}}'
+        f"{TOOL_CLOSE}",
+        "Emit one block per call. Use valid JSON inside the block.",
+    ]
+    if tool_choice == "required":
+        lines.append("You MUST call at least one tool before answering.")
+    elif isinstance(tool_choice, dict):
+        name = (tool_choice.get("function") or {}).get("name")
+        if name:
+            lines.append(f'You MUST call the tool named "{name}".')
+    return "\n".join(lines)
+
+
+def render_messages(messages: list[dict], tools: list[dict] | None,
+                    tool_choice) -> list[dict]:
+    """Template-agnostic pre-render: inject the tools block and convert
+    tool-role / assistant-tool_calls messages into plain content so any
+    chat template (HF or byte fallback) can format the conversation."""
+    out: list[dict] = []
+    for m in messages:
+        role = m.get("role")
+        if role == "assistant" and m.get("tool_calls"):
+            parts = [_content_str(m.get("content"))]
+            for tc in m["tool_calls"]:
+                fn = tc.get("function", {})
+                args = fn.get("arguments", "{}")
+                if not isinstance(args, str):
+                    args = json.dumps(args, ensure_ascii=False)
+                parts.append(
+                    f'{TOOL_OPEN}{{"name": {json.dumps(fn.get("name"))}, '
+                    f'"arguments": {args}}}{TOOL_CLOSE}'
+                )
+            out.append({"role": "assistant",
+                        "content": "\n".join(p for p in parts if p)})
+        elif role == "tool":
+            body = _content_str(m.get("content"))
+            name = m.get("name") or m.get("tool_call_id") or "tool"
+            out.append({
+                "role": "user",
+                "content": f"<tool_response name={json.dumps(str(name))}>\n"
+                           f"{body}\n</tool_response>",
+            })
+        else:
+            out.append({"role": role, "content": _content_str(m.get("content"))})
+    if tools and tool_choice != "none":
+        block = tools_system_block(tools, tool_choice)
+        if out and out[0]["role"] == "system":
+            out[0] = {"role": "system",
+                      "content": _content_str(out[0]["content"])
+                      + "\n\n" + block}
+        else:
+            out.insert(0, {"role": "system", "content": block})
+    return out
+
+
+def parse_tool_calls(text: str) -> tuple[str | None, list[dict]]:
+    """(content, tool_calls) from a complete generation. Content outside
+    the blocks survives (None when empty); malformed JSON inside a block
+    degrades to text rather than a fake call."""
+    calls: list[dict] = []
+
+    def _try(block: str) -> bool:
+        try:
+            obj = json.loads(block)
+        except json.JSONDecodeError:
+            return False
+        if not isinstance(obj, dict) or "name" not in obj:
+            return False
+        args = obj.get("arguments", {})
+        if not isinstance(args, str):
+            args = json.dumps(args, ensure_ascii=False)
+        calls.append({
+            "id": call_id(),
+            "type": "function",
+            "function": {"name": str(obj["name"]), "arguments": args},
+        })
+        return True
+
+    remainder: list[str] = []
+    pos = 0
+    for m in _BLOCK_RE.finditer(text):
+        remainder.append(text[pos:m.start()])
+        if not _try(m.group(1)):
+            remainder.append(m.group(0))  # malformed: keep as visible text
+        pos = m.end()
+    remainder.append(text[pos:])
+    content = "".join(remainder).strip()
+    return (content or None), calls
+
+
+class ToolCallStreamParser:
+    """Incremental splitter for SSE: feed() returns the text that is safe
+    to show the user NOW; anything that might be (part of) a tool-call
+    block is held until it resolves. finish() flushes and parses."""
+
+    def __init__(self):
+        self._buf = ""
+        self._calls: list[dict] = []
+
+    def feed(self, delta: str) -> str:
+        self._buf += delta
+        visible: list[str] = []
+        while True:
+            i = self._buf.find(TOOL_OPEN)
+            if i >= 0:
+                visible.append(self._buf[:i])
+                j = self._buf.find(TOOL_CLOSE, i)
+                if j < 0:
+                    self._buf = self._buf[i:]  # inside a block: hold
+                    break
+                block = self._buf[i + len(TOOL_OPEN):j].strip()
+                content, calls = parse_tool_calls(
+                    TOOL_OPEN + block + TOOL_CLOSE
+                )
+                if calls:
+                    self._calls.extend(calls)
+                elif content:
+                    visible.append(content)
+                self._buf = self._buf[j + len(TOOL_CLOSE):]
+                continue
+            # no full opener: hold back only a tail that could grow into one
+            keep = 0
+            for k in range(1, min(len(TOOL_OPEN), len(self._buf)) + 1):
+                if TOOL_OPEN.startswith(self._buf[-k:]):
+                    keep = k
+            if keep:
+                visible.append(self._buf[:-keep])
+                self._buf = self._buf[-keep:]
+            else:
+                visible.append(self._buf)
+                self._buf = ""
+            break
+        return "".join(visible)
+
+    def finish(self) -> tuple[str, list[dict]]:
+        """(trailing visible text, all calls) — an unterminated block at
+        EOS is released as text (the model never closed it)."""
+        tail = self._buf
+        self._buf = ""
+        return tail, self._calls
