@@ -218,11 +218,56 @@ def _phase_micro_main() -> None:
     print(json.dumps({"microbench": run_microbench()}), flush=True)
 
 
+def _phase_preflight_main() -> None:
+    """Subprocess entry: touch the chip with one trivial dispatch. The
+    tunnel has been observed to wedge for HOURS after a killed bench
+    (grants hang in jax init) — when that happens every phase would eat
+    its full timeout; this makes the failure mode one cheap, explicit
+    section instead."""
+    t0 = time.monotonic()
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    val = int(jax.jit(lambda a: a + 1)(jnp.int32(41)))
+    assert val == 42, val
+    print(json.dumps({"preflight": {
+        "platform": dev.platform,
+        "device": str(dev),
+        "first_dispatch_s": round(time.monotonic() - t0, 1),
+    }}), flush=True)
+
+
 def main() -> None:
     if "--phase" in sys.argv:
         phase = sys.argv[sys.argv.index("--phase") + 1]
-        assert phase == "micro", phase
-        _phase_micro_main()
+        if phase == "preflight":
+            _phase_preflight_main()
+        else:
+            assert phase == "micro", phase
+            _phase_micro_main()
+        return
+
+    # 0) chip preflight: one trivial dispatch. A wedged tunnel fails HERE
+    # in minutes with an explicit section; the heavy phases are then
+    # reported skipped instead of serially eating their timeouts
+    preflight = _run_phase(
+        "preflight", ["bench.py", "--phase", "preflight"],
+        timeout_s=420, key="preflight", min_needed_s=60.0,
+    )
+    if preflight.get("error"):
+        for section in ("microbench", "livestack", "northstar", "int8_8b"):
+            _emit(section, {"skipped": "chip preflight failed "
+                                       "(tunnel wedged or no device)"})
+        print(json.dumps({
+            "metric": "served_northstar_throughput",
+            "value": 0.0,
+            "unit": "req/s",
+            "vs_baseline": 0.0,
+            "error": "chip preflight failed — no TPU dispatch possible",
+            "preflight": preflight,
+            "total_elapsed_s": round(time.monotonic() - _t_start, 1),
+        }), flush=True)
         return
 
     # 1) cheap + fast: guarantees the tail is never empty

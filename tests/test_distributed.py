@@ -75,3 +75,13 @@ def test_multiprocess_pd_dryrun_ships_kv_across_processes():
     joined = "\n".join(outs)
     assert "PD_DRYRUN_OK role=prefill" in joined
     assert "PD_DRYRUN_OK adopted=" in joined
+
+
+def test_multiprocess_pd_dryrun_tp2_roles():
+    """Each PD role spans a tp=2 mesh (2 devices per process): the ship
+    moves each kvh chunk over its own pairwise flip and reassembles into
+    the destination pool's own sharding. Same oracle assertion inside the
+    worker as the tp=1 shape."""
+    outs = dist.run_multiprocess_pd_dryrun(timeout_s=600, tp=2)
+    joined = "\n".join(outs)
+    assert "PD_DRYRUN_OK adopted=" in joined

@@ -36,6 +36,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..parallel import mesh as mesh_lib
 from ..utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -61,6 +62,17 @@ def _gather_blocks(kv_caches, src_idx):
     """Compact the chain's pages out of the source pool: per-layer
     (2, n_pad, bs, kvh, D)."""
     return tuple(leaf[:, src_idx] for leaf in kv_caches)
+
+
+@functools.lru_cache(maxsize=128)
+def _flip_program(sharding):
+    """Compiled pairwise shard flip for one (mesh, spec) — cached so
+    repeated ships on the serving path reuse the program instead of
+    recompiling the DCN collective per chunk per call (NamedSharding is
+    hashable; jit then caches per input aval under it)."""
+    import jax.numpy as jnp
+
+    return jax.jit(lambda x: jnp.flip(x, axis=0), out_shardings=sharding)
 
 
 def ship_kv_device(
@@ -265,38 +277,66 @@ def ship_kv_device_crossproc(
     # Everything that can raise asymmetrically (device OOM in the gather,
     # a chain block evicted between the residency count and src_idx
     # construction, ...) happens BEFORE the go/no-go barrier below. After
-    # the barrier both sides are inside the same collective, where a
+    # the barrier both sides are inside the same collectives, where a
     # failure is fate-shared — one side raising while the peer sits in
     # block_until_ready would otherwise hang the peer until an external
     # timeout with the real error invisible.
     prep_err: Exception | None = None
-    payload_local = None
-    sh = None
+    pieces: list = []  # canonical kvh-chunk order; pieces[j] = chunk j
+    n_shard = 1
+    src_devs = dst_devs = None
     try:
         kv_caches = engine.runner.kv_caches
         l_layers = len(kv_caches)
         leaf_shape = kv_caches[0].shape  # (2, num_blocks, bs, kvh, D)
         bs, kvh, d = leaf_shape[2], leaf_shape[3], leaf_shape[4]
 
-        # union mesh ordered [source device, destination device]: the
-        # source's process index is the counts row that published
-        # residency; single-device-per-role for now (the single-process
-        # ship_kv_device covers tp-sharded pools; generalizing this path
-        # adds a second mesh axis sharding kvh)
+        # canonical per-role device rows (sorted by id so BOTH processes
+        # build identical union meshes): the source's process index is the
+        # counts row that published residency
         src_pid = int(np.argmax(counts[:, 0]))
         by_proc: dict[int, list] = {}
-        for dv in jax.devices():
+        for dv in sorted(jax.devices(), key=lambda dv: dv.id):
             by_proc.setdefault(dv.process_index, []).append(dv)
         dst_pid = next(p for p in sorted(by_proc) if p != src_pid)
-        mesh_u = Mesh(
-            np.asarray([by_proc[src_pid][0], by_proc[dst_pid][0]]), ("pd",)
-        )
-        sh = NamedSharding(mesh_u, P("pd"))
+        src_devs, dst_devs = by_proc[src_pid], by_proc[dst_pid]
+        if len(src_devs) != len(dst_devs):
+            raise NotImplementedError(
+                "PD roles must span equally many devices (src "
+                f"{len(src_devs)} vs dst {len(dst_devs)}); asymmetric "
+                "tp needs a resharding hop"
+            )
+        n_shard = len(src_devs)
+        # the chunking below splits kvh into n_shard pieces and reassembles
+        # into the destination pool's sharding — only valid when the
+        # engine mesh is pure-TP over exactly these devices (dp/pp/sp/ep
+        # shard axes the pairwise flips don't model; a dp=2/tp=1 mesh
+        # would pass the device-count check but keep kvh whole)
+        mesh_shape = dict(engine.runner.mesh.shape)
+        tp_size = mesh_shape.get(mesh_lib.TP_AXIS, 1)
+        others = 1
+        for ax, size in mesh_shape.items():
+            if ax != mesh_lib.TP_AXIS:
+                others *= size
+        if tp_size != n_shard or others != 1:
+            raise NotImplementedError(
+                f"cross-process ship needs a pure-tp engine mesh with "
+                f"tp == local devices (got mesh {mesh_shape} over "
+                f"{n_shard} devices)"
+            )
+        if kvh % n_shard:
+            raise NotImplementedError(
+                f"kv heads ({kvh}) must divide over {n_shard} devices"
+            )
+        kvh_local = kvh // n_shard
+        piece_shape = (l_layers, 2, n_pad, bs, kvh_local, d)
 
         # local payload stays ON DEVICE end to end: the source compacts
-        # its pages, the destination contributes a zero placeholder;
-        # make_array_from_single_device_arrays assembles the global view
-        # from the committed per-process buffers without a host copy
+        # its pages (one gather dispatch on its own mesh), then ONE
+        # resharding device_put lays kvh chunk j onto canonical device j —
+        # correct for any engine mesh ordering or gather-output sharding
+        # (GSPMD may well replicate the gather's output)
+        my_canon = src_devs if is_src else dst_devs
         if is_src:
             src_idx = np.zeros(n_pad, np.int32)
             for i, p in enumerate(ship_pos):
@@ -309,19 +349,30 @@ def ship_kv_device_crossproc(
                     src_idx, NamedSharding(engine.runner.mesh, P()),
                 ),
             )
-            payload_local = jnp.stack(gathered)[None]
-        else:
-            payload_local = jnp.zeros(
-                (1, l_layers, 2, n_pad, bs, kvh, d), kv_caches[0].dtype
+            canon_mesh = Mesh(np.asarray(my_canon), ("canon",))
+            stacked = jax.device_put(
+                jnp.stack(gathered),  # (L, 2, n_pad, bs, kvh, D)
+                NamedSharding(
+                    canon_mesh, P(None, None, None, None, "canon", None)
+                ),
             )
-        my_dev = by_proc[jax.process_index()][0]
-        payload_local = jax.device_put(payload_local, my_dev)
-        jax.block_until_ready(payload_local)
+            by_dev = {
+                s.device: s.data for s in stacked.addressable_shards
+            }
+            pieces = [by_dev[my_canon[j]] for j in range(n_shard)]
+        else:
+            pieces = [
+                jax.device_put(
+                    jnp.zeros(piece_shape, kv_caches[0].dtype), my_canon[j]
+                )
+                for j in range(n_shard)
+            ]
+        jax.block_until_ready(pieces)
     except Exception as e:  # noqa: BLE001 — published to the peer below
         prep_err = e
 
     # go/no-go barrier: both sides publish readiness; either side failing
-    # aborts BOTH cleanly before anyone enters the collective
+    # aborts BOTH cleanly before anyone enters the collectives
     ready = multihost_utils.process_allgather(
         np.asarray([0 if prep_err is not None else 1], np.int64)
     )
@@ -336,32 +387,57 @@ def ship_kv_device_crossproc(
         return 0
 
     try:
-        global_arr = jax.make_array_from_single_device_arrays(
-            (2, *payload_local.shape[1:]), sh, [payload_local]
+        # THE transfer: one pairwise shard flip per kvh chunk — each is a
+        # collective permute between src_devs[j] and dst_devs[j] over
+        # ICI/DCN. Both processes iterate the same dispatch loop (SPMD),
+        # so the cooperative programs always line up; all flips dispatch
+        # BEFORE the single block so the runtime overlaps the transfers.
+        shipped_all: list = []
+        for j in range(n_shard):
+            mesh_j = Mesh(np.asarray([src_devs[j], dst_devs[j]]), ("pd",))
+            sh_j = NamedSharding(mesh_j, P("pd"))
+            local = pieces[j][None]  # (1, L, 2, n_pad, bs, kvh_local, D)
+            garr = jax.make_array_from_single_device_arrays(
+                (2, *local.shape[1:]), sh_j, [local]
+            )
+            shipped_all.append(_flip_program(sh_j)(garr))
+        jax.block_until_ready(shipped_all)
+        recv = (
+            []
+            if is_src
+            else [s.addressable_shards[0].data[0] for s in shipped_all]
         )
-        # THE transfer: shard flip == collective permute over ICI/DCN
-        shipped = jax.jit(
-            lambda x: jnp.flip(x, axis=0), out_shardings=sh
-        )(global_arr)
-        jax.block_until_ready(shipped)
 
         if not is_src:
-            # the local shard now holds the source's bytes, already on
-            # this process's device — scatter straight into the pool
-            payload = shipped.addressable_shards[0].data[0]  # (L, 2, ...)
+            # chunk j sits on dst canonical device j. Assemble each
+            # layer's global (2, n_pad, bs, kvh, D) array directly from
+            # the single-device pieces, committed to the device the POOL's
+            # own sharding keeps that kvh chunk on (mapped via
+            # shard.index, so any mesh ordering works; a concatenate of
+            # differently-committed arrays would be rejected by jax).
+            kv_sh = NamedSharding(
+                engine.runner.mesh,
+                P(None, None, None, mesh_lib.TP_AXIS, None),
+            )
+            chunk_dev = {}
+            for s in engine.runner.kv_caches[0].addressable_shards:
+                sl = s.index[3]
+                chunk_dev[(sl.start or 0) // kvh_local] = s.device
             dst_idx = np.zeros(n_pad, np.int32)
             for i, (_h, dblk) in enumerate(staged):
                 dst_idx[i] = dblk
-            moved = tuple(
-                jax.device_put(
-                    payload[i],
-                    NamedSharding(engine.runner.mesh, P()),
-                )
-                for i in range(l_layers)
-            )
+            moved = []
+            for layer in range(l_layers):
+                arrs = [
+                    jax.device_put(recv[j][layer], chunk_dev[j])
+                    for j in range(n_shard)
+                ]
+                moved.append(jax.make_array_from_single_device_arrays(
+                    (2, n_pad, bs, kvh, d), kv_sh, arrs
+                ))
             engine.runner.kv_caches = _scatter_blocks(
                 engine.runner.kv_caches,
-                moved,
+                tuple(moved),
                 jax.device_put(
                     dst_idx, NamedSharding(engine.runner.mesh, P()),
                 ),
