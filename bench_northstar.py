@@ -78,6 +78,7 @@ def run_northstar(
     q_range: tuple[int, int] = (250, 650),
     block_size: int = 16,
     attention_backend: str = "auto",
+    prefill_attention_backend: str = "auto",
     quantization: str | None = None,
 ) -> dict:
     from vllm_production_stack_tpu.engine.config import (
@@ -117,6 +118,7 @@ def run_northstar(
             width_floor_blocks=1,
         ),
         attention_backend=attention_backend,
+        prefill_attention_backend=prefill_attention_backend,
     )
     engine = LLMEngine(config)
     sampling = SamplingParams(max_tokens=answer_tokens, temperature=0.0,
@@ -283,6 +285,7 @@ def main() -> None:
     p.add_argument("--rounds", type=int, default=6)
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--attention-backend", default="auto")
+    p.add_argument("--prefill-attention-backend", default="auto")
     p.add_argument("--num-blocks", type=int, default=8750)
     p.add_argument("--max-model-len", type=int, default=6144)
     p.add_argument("--kv-cache-dtype", default="fp8")
@@ -291,6 +294,7 @@ def main() -> None:
     print(json.dumps({"northstar": run_northstar(
         model=args.model, users=args.users, rounds=args.rounds,
         block_size=args.block_size, attention_backend=args.attention_backend,
+        prefill_attention_backend=args.prefill_attention_backend,
         num_blocks=args.num_blocks, max_model_len=args.max_model_len,
         kv_cache_dtype=args.kv_cache_dtype, quantization=args.quantization,
     )}))

@@ -333,19 +333,18 @@ def test_engine_chunked_prefill_pallas_backend_matches_xla():
 
 
 def test_auto_prefill_backend_policy_gates():
-    """The provisional prefill 'auto' gate: >=32-token pages + long-context
-    engine on a real TPU with tp-divisible heads."""
+    """Prefill 'auto' is XLA-only until the kernel's on-chip sweep lands —
+    auto must only pick measured winners (the explicit 'pallas' knob is
+    the opt-in; parity is pinned above, perf is not yet)."""
     from vllm_production_stack_tpu.engine.model_runner import (
         resolve_auto_prefill_backend as auto,
     )
 
     base = dict(block_size=32, max_model_len=8192, platform="tpu",
                 heads_divisible=True)
-    assert auto(**base) == "pallas"
+    assert auto(**base) == "xla"  # flip with the sweep table in hand
     assert auto(**{**base, "block_size": 16}) == "xla"
-    assert auto(**{**base, "max_model_len": 2048}) == "xla"
     assert auto(**{**base, "platform": "cpu"}) == "xla"
-    assert auto(**{**base, "heads_divisible": False}) == "xla"
 
 
 def test_auto_backend_policy_gates():

@@ -383,3 +383,20 @@ def test_pii_analyzer_selection():
     if not has_presidio:
         with pytest.raises(RuntimeError, match="presidio-analyzer"):
             make_analyzer("presidio")
+
+
+def test_raise_fd_limit_is_safe_and_monotonic():
+    """raise_fd_limit never lowers the soft limit and never raises (ref
+    utils.py:132-147 set_ulimit parity — the proxy holds 2 sockets per
+    in-flight stream)."""
+    import resource
+
+    from vllm_production_stack_tpu.utils.system import raise_fd_limit
+
+    soft_before, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    out = raise_fd_limit()
+    soft_after, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    assert soft_after >= soft_before
+    assert out in (-1, soft_after)
+    # idempotent
+    assert raise_fd_limit() in (-1, soft_after)

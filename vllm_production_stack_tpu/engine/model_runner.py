@@ -84,23 +84,22 @@ def resolve_auto_prefill_backend(
     query tile and never materializes the gathered (B, S, kvH, D) history
     OR the (B, T, S) mask.
 
-    Gate: PROVISIONAL, mirroring the decode sweep's shape (the same
-    page-DMA-size argument applies: 16-token pages make the per-page
-    DMAs/matmuls too small, while the XLA gather's cost tracks gathered
-    bytes — which prefill pays per chunk, so long contexts favor the
-    kernel). block_size >= 32 AND max_model_len >= 4096 on a real TPU,
-    heads divisible across tp (mesh is allowed: the serving path wraps in
-    shard_map over (dp, tp) when mesh.size > 1). Run
-    benchmarks/sweep_attention.py --prefill on the chip to validate or
-    tighten; until that sweep lands in this docstring the explicit
-    'xla'/'pallas' knobs are the source of truth for perf work."""
-    if (
-        block_size >= 32
-        and max_model_len >= 4096
-        and platform == "tpu"
-        and heads_divisible
-    ):
-        return "pallas"
+    Gate: 'auto' returns XLA until the kernel's on-chip sweep lands —
+    auto must only ever pick MEASURED winners (the decode gate's
+    discipline), and the chip was unreachable when the kernel shipped
+    (ROUND5.md hardware caveat). The expected winning regime mirrors
+    decode's (the same page-DMA-size argument applies: 16-token pages
+    make per-page DMAs/matmuls too small, while the XLA gather's cost
+    tracks gathered bytes — paid per CHUNK in prefill, so long contexts
+    should favor the kernel strongly). To enable: run
+    benchmarks/sweep_attention.py --prefill on a chip, paste the table
+    here, and gate like the decode predicate. Until then the explicit
+    'pallas' knob is the opt-in (parity is pinned by
+    tests/test_pallas_attention.py; only perf is unmeasured).
+    heads_divisible is still threaded so the eventual gate composes with
+    tp meshes the same way the explicit knob's checks do."""
+    del block_size, max_model_len, heads_divisible  # used once measured
+    del platform
     return "xla"
 
 

@@ -1214,7 +1214,9 @@ def main(argv: list[str] | None = None) -> None:
     # this, jax.devices() spans every host in the slice and the engine's
     # mesh/pjit shardings cover them
     from ..parallel.distributed import maybe_initialize
+    from ..utils.system import raise_fd_limit
 
+    raise_fd_limit()
     maybe_initialize(args.distributed)
     if args.compilation_cache_dir:
         import jax

@@ -375,7 +375,12 @@ async def _log_stats_loop(state: RouterState, interval: float) -> None:
 
 def main(argv: list[str] | None = None) -> None:
     args = parse_args(argv)
+    from ..utils.system import raise_fd_limit
     from .tracing import init_otel, init_sentry
+
+    # one upstream + one downstream socket per in-flight stream: the 1024
+    # default exhausts far below serving concurrency (ref utils.py:132-147)
+    raise_fd_limit()
 
     # process-global, once: re-init per build_app would stack OTel
     # providers/export threads (build_app runs per-test in the suite)

@@ -1,0 +1,40 @@
+"""Process-level system knobs.
+
+Reference parity: the router raises its file-descriptor soft limit to the
+hard limit at startup (utils.py:132-147 `set_ulimit`) — a proxy holding
+one upstream + one downstream socket per in-flight streaming request
+exhausts the usual 1024 default long before it exhausts CPU.
+"""
+
+from __future__ import annotations
+
+from .logging import init_logger
+
+logger = init_logger(__name__)
+
+
+def raise_fd_limit(target: int = 65535) -> int:
+    """Raise RLIMIT_NOFILE's soft limit toward min(target, hard limit).
+    Returns the resulting soft limit; never raises (serving with the old
+    limit beats dying at boot on a locked-down kernel)."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        want = min(target, hard) if hard != resource.RLIM_INFINITY else target
+        # some kernels report an infinite hard limit while the real
+        # ceiling sits lower (macOS kern.maxfilesperproc class) — step
+        # down instead of giving up, any raise beats the 1024 default
+        while want > soft:
+            try:
+                resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+                logger.info(
+                    "raised RLIMIT_NOFILE soft limit %d -> %d", soft, want
+                )
+                return want
+            except (ValueError, OSError):
+                want //= 2
+        return soft
+    except (ImportError, ValueError, OSError) as e:
+        logger.warning("could not raise fd limit: %s", e)
+        return -1
