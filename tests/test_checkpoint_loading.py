@@ -136,3 +136,48 @@ def test_engine_serves_checkpoint_greedy_matches_hf(tmp_path):
             pad_token_id=0, eos_token_id=None,
         )[0, len(prompt):].tolist()
     assert out["token_ids"] == hf_out
+
+
+def test_llama31_rope_scaling_checkpoint_end_to_end(tmp_path):
+    """A Llama-3.1-shaped checkpoint (rope_scaling rope_type=llama3 in
+    config.json — the reference's headline model ships exactly this):
+    resolve_model_config must parse the scaling fields and the loaded
+    model's logits must match HF, which applies the scaled frequencies.
+    Unknown scaling types must be a hard error, not a silent no-op."""
+    import json
+
+    torch.manual_seed(77)
+    hf_cfg = HFLlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=10000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False, torch_dtype="float32",
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+    )
+    model = LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg = resolve_model_config(str(tmp_path), max_model_len=256,
+                               dtype="float32")
+    assert cfg.rope_scaling == ("llama3", 8.0, 1.0, 4.0, 64)
+    params = load_checkpoint_params(cfg)
+    tokens = list(np.random.RandomState(5).randint(0, 512, size=40))
+    ours = _jax_prefill_logits(cfg, params, tokens)
+    with torch.no_grad():
+        theirs = model(torch.tensor([tokens])).logits[0].numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # unknown type: refuse (silently wrong positions are the failure
+    # mode this feature exists to close)
+    cfg_path = tmp_path / "config.json"
+    raw = json.loads(cfg_path.read_text())
+    raw["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
+    cfg_path.write_text(json.dumps(raw))
+    with pytest.raises(ValueError, match="rope_scaling"):
+        resolve_model_config(str(tmp_path), max_model_len=256,
+                             dtype="float32")

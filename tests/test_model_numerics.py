@@ -24,6 +24,16 @@ def make_cfg():
 
 
 def hf_model_from_params(cfg: ModelConfig, params):
+    rope_scaling = None
+    if cfg.rope_scaling_type is not None:
+        rope_scaling = {
+            "rope_type": cfg.rope_scaling_type,
+            "factor": cfg.rope_scaling_factor,
+            "low_freq_factor": cfg.rope_low_freq_factor,
+            "high_freq_factor": cfg.rope_high_freq_factor,
+            "original_max_position_embeddings":
+                cfg.rope_original_max_position,
+        }
     hf_cfg = HFLlamaConfig(
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size,
@@ -33,6 +43,7 @@ def hf_model_from_params(cfg: ModelConfig, params):
         num_key_value_heads=cfg.num_kv_heads,
         head_dim=cfg.head_dim,
         rope_theta=cfg.rope_theta,
+        rope_scaling=rope_scaling,
         rms_norm_eps=cfg.rms_norm_eps,
         max_position_embeddings=cfg.max_model_len,
         tie_word_embeddings=cfg.tie_word_embeddings,
@@ -105,6 +116,35 @@ def test_prefill_logits_match_hf():
         theirs = hf(torch.tensor([tokens])).logits[0].numpy()
 
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_prefill_logits_match_hf_with_llama3_rope_scaling():
+    """Llama-3.1-class rope_scaling (rope_type=llama3, the reference's
+    headline checkpoint ships it): our piecewise frequency rescale must
+    match HF transformers' _compute_llama3_parameters exactly — silently
+    ignoring it (the pre-round-5 behavior) serves wrong long-range
+    positions. The band parameters are scaled to the tiny context so all
+    three regimes (unscaled / smoothed / divided) are exercised."""
+    cfg = ModelConfig.tiny(
+        rope_scaling_type="llama3",
+        rope_scaling_factor=8.0,
+        rope_low_freq_factor=1.0,
+        rope_high_freq_factor=4.0,
+        rope_original_max_position=64,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(2))
+    hf = hf_model_from_params(cfg, params)
+    tokens = list(np.random.RandomState(2).randint(0, cfg.vocab_size, size=33))
+
+    ours, _, _ = run_jax_prefill(cfg, params, tokens)
+    with torch.no_grad():
+        theirs = hf(torch.tensor([tokens])).logits[0].numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # and the scaling must actually CHANGE the logits vs vanilla rope
+    # (guards against both sides silently no-opping)
+    vanilla, _, _ = run_jax_prefill(ModelConfig.tiny(), params, tokens)
+    assert np.abs(ours - vanilla).max() > 1e-3
 
 
 def test_paged_decode_matches_full_prefill():

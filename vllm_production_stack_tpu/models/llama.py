@@ -252,8 +252,10 @@ def _layer_body(
     v = proj(x, ap["wv"], "v_proj")
     if cfg.attention_bias:
         q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
-    q = apply_rope(q.reshape(b, t, nh, hd), positions, cfg.rope_theta)
-    k = apply_rope(k.reshape(b, t, nkv, hd), positions, cfg.rope_theta)
+    q = apply_rope(q.reshape(b, t, nh, hd), positions, cfg.rope_theta,
+                   scaling=cfg.rope_scaling)
+    k = apply_rope(k.reshape(b, t, nkv, hd), positions, cfg.rope_theta,
+                   scaling=cfg.rope_scaling)
     v = v.reshape(b, t, nkv, hd)
 
     attn = attend(q, k, v).reshape(b, t, nh * hd)

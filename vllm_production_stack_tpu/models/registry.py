@@ -142,9 +142,33 @@ def _from_hf_config(path: str) -> dict:
         if arch == "gemma"
         else {}
     )
+    # RoPE scaling (Llama-3.1-class checkpoints — the reference's headline
+    # model ships rope_scaling rope_type=llama3): silently ignoring it
+    # would serve subtly wrong long-range positions, so unknown types are
+    # a hard error, not a warning
+    rs = hf.get("rope_scaling") or {}
+    rs_type = rs.get("rope_type") or rs.get("type")
+    if rs_type in (None, "default"):
+        scaling = {}
+    elif rs_type in ("llama3", "linear"):
+        scaling = dict(
+            rope_scaling_type=rs_type,
+            rope_scaling_factor=float(rs.get("factor", 1.0)),
+            rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+            rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+            rope_original_max_position=int(
+                rs.get("original_max_position_embeddings", 8192)
+            ),
+        )
+    else:
+        raise ValueError(
+            f"unsupported rope_scaling type {rs_type!r} in {path} "
+            "(supported: llama3, linear)"
+        )
     return dict(
         **moe,
         **gemma,
+        **scaling,
         model=path,
         architecture=arch,
         vocab_size=hf["vocab_size"],

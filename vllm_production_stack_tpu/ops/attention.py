@@ -28,17 +28,49 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _scale_inv_freq(inv_freq: jax.Array, scaling: tuple) -> jax.Array:
+    """RoPE frequency scaling (HF rope_scaling semantics).
+
+    "linear": positional interpolation — every frequency divided by the
+    factor. "llama3" (transformers modeling_rope_utils
+    _compute_llama3_parameters, the Llama-3.1 recipe): frequencies whose
+    wavelength exceeds original_max_position/low_freq_factor are divided
+    by the factor, wavelengths under original_max_position/
+    high_freq_factor stay unscaled, and the band between interpolates
+    smoothly — long-range position signal compresses while local
+    ordering stays exact."""
+    kind, factor, low, high, orig = scaling
+    if kind == "linear":
+        return inv_freq / factor
+    if kind != "llama3":
+        raise ValueError(f"unsupported rope_scaling type {kind!r}")
+    low_wl = orig / low
+    high_wl = orig / high
+    wavelen = 2.0 * jnp.pi / inv_freq
+    smooth = (orig / wavelen - low) / (high - low)
+    smoothed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    return jnp.where(
+        wavelen > low_wl,
+        inv_freq / factor,
+        jnp.where(wavelen < high_wl, inv_freq, smoothed),
+    )
+
+
 def apply_rope(
-    x: jax.Array, positions: jax.Array, theta: float = 10000.0
+    x: jax.Array, positions: jax.Array, theta: float = 10000.0,
+    scaling: tuple | None = None,
 ) -> jax.Array:
     """Rotary position embedding, non-interleaved (HF Llama convention).
 
-    x: (..., T, heads, head_dim), positions: (..., T) int32.
+    x: (..., T, heads, head_dim), positions: (..., T) int32;
+    scaling: ModelConfig.rope_scaling tuple (llama3 / linear) or None.
     """
     head_dim = x.shape[-1]
     half = head_dim // 2
     freqs = jnp.arange(half, dtype=jnp.float32) / half
     inv_freq = theta**-freqs  # (half,)
+    if scaling is not None:
+        inv_freq = _scale_inv_freq(inv_freq, scaling)
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., T, half)
     cos = jnp.cos(angles)[..., None, :]  # (..., T, 1, half)
     sin = jnp.sin(angles)[..., None, :]
