@@ -277,10 +277,12 @@ def test_request_while_sleeping_rejected_and_engine_survives(srv):
 def test_bad_requests(srv):
     async def go(client):
         r1 = await client.post("/v1/chat/completions", json={"model": "tiny-llama"})
+        # n is supported up to MAX_N_CHOICES since round 5 — out-of-range
+        # still rejects
         r2 = await client.post(
             "/v1/chat/completions",
             json={"model": "tiny-llama", "messages": [{"role": "user", "content": "x"}],
-                  "n": 3},
+                  "n": 99},
         )
         return r1.status, r2.status
 
@@ -412,3 +414,107 @@ def test_step_loop_recovers_from_transient_fault():
     assert len(toks) == 4
     assert engine.scheduler.pool.num_free == engine.scheduler.pool.num_usable \
         or not engine.scheduler.has_unfinished()
+
+
+def test_n_choices_nonstream(srv):
+    """n>1 parallel sampling: one engine request per choice (prefix cache
+    dedups the prompt), choices indexed 0..n-1, prompt tokens counted
+    once, completion tokens summed (OpenAI/vLLM n semantics)."""
+    async def go(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "tiny-llama", "max_tokens": 6, "temperature": 0.0,
+            "ignore_eos": True, "n": 3,
+            "messages": [{"role": "user", "content": "count"}],
+        })
+        return r.status, await r.json()
+
+    status, out = run_with_client(srv, go)
+    assert status == 200
+    assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+    # greedy: every choice identical
+    texts = {c["message"]["content"] for c in out["choices"]}
+    assert len(texts) == 1
+    assert out["usage"]["completion_tokens"] == 18
+    # bounds
+    async def bad(client):
+        r0 = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "x", "n": 0})
+        r9 = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "x", "n": 9})
+        return r0.status, r9.status
+
+    assert run_with_client(srv, bad) == (400, 400)
+
+
+def test_n_choices_seeded_sampling_distinct(srv):
+    """An explicit seed with n>1 derives seed+i per choice: deterministic
+    ACROSS requests, distinct WITHIN one."""
+    async def go(client):
+        body = {
+            "model": "tiny-llama", "prompt": [7, 8, 9], "max_tokens": 8,
+            "temperature": 1.0, "seed": 42, "ignore_eos": True, "n": 2,
+        }
+        r1 = await (await client.post("/v1/completions", json=body)).json()
+        r2 = await (await client.post("/v1/completions", json=body)).json()
+        return r1, r2
+
+    r1, r2 = run_with_client(srv, go)
+    t1 = [c["text"] for c in r1["choices"]]
+    t2 = [c["text"] for c in r2["choices"]]
+    assert t1 == t2  # deterministic across requests
+    assert t1[0] != t1[1]  # distinct within one
+
+
+def test_n_choices_streaming(srv):
+    """n>1 streaming interleaves chunks tagged with their choice index;
+    every choice reaches a finish_reason and usage sums the tokens."""
+    async def go(client):
+        r = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": [5, 6], "max_tokens": 5,
+            "temperature": 0.0, "ignore_eos": True, "n": 2,
+            "stream": True, "stream_options": {"include_usage": True},
+        })
+        assert r.status == 200
+        chunks = []
+        async for raw in r.content:
+            line = raw.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                chunks.append(json.loads(line[6:]))
+        return chunks
+
+    chunks = run_with_client(srv, go)
+    seen = {c["choices"][0]["index"] for c in chunks if c["choices"]}
+    assert seen == {0, 1}
+    finishes = [
+        (c["choices"][0]["index"], c["choices"][0]["finish_reason"])
+        for c in chunks if c["choices"] and c["choices"][0]["finish_reason"]
+    ]
+    assert dict(finishes) == {0: "length", 1: "length"}
+    assert chunks[-1]["usage"]["completion_tokens"] == 10
+
+
+def test_n_choices_streaming_completions_logprobs(srv):
+    """Streamed /v1/completions logprobs must arrive for EVERY choice
+    under n>1, with per-choice text offsets (the unified stream path —
+    a diverged n>1 copy once dropped these entirely)."""
+    async def go(client):
+        r = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": [3, 4], "max_tokens": 4,
+            "temperature": 0.0, "ignore_eos": True, "n": 2, "logprobs": 2,
+            "stream": True,
+        })
+        assert r.status == 200
+        per_choice = {0: [], 1: []}
+        async for raw in r.content:
+            line = raw.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                c = json.loads(line[6:])
+                if c.get("choices") and c["choices"][0].get("logprobs"):
+                    ch = c["choices"][0]
+                    per_choice[ch["index"]].append(ch["logprobs"])
+        return per_choice
+
+    per_choice = run_with_client(srv, go)
+    for i in (0, 1):
+        toks = [t for lp in per_choice[i] for t in lp["tokens"]]
+        assert len(toks) == 4, (i, per_choice[i])

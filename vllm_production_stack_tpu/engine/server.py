@@ -51,6 +51,10 @@ from .protocol import (
 logger = init_logger(__name__)
 
 DEFAULT_MAX_TOKENS = 256
+# n (parallel sampling) cap: each choice is its own engine request (the
+# prefix cache dedups the shared prompt), so the cost model is the same as
+# the scheduler's per-request admission — the cap just bounds fan-out
+MAX_N_CHOICES = 8
 
 
 def error(status: int, message: str, type_: str = "invalid_request_error"):
@@ -163,8 +167,8 @@ class EngineServer:
             body = ChatCompletionRequest.model_validate(await request.json())
         except (ValidationError, json.JSONDecodeError) as e:
             return error(400, f"invalid request: {e}")
-        if body.n != 1:
-            return error(400, "n>1 is not supported")
+        if not 1 <= body.n <= MAX_N_CHOICES:
+            return error(400, f"n must be between 1 and {MAX_N_CHOICES}")
         if err := self._check_model(body.model):
             return err
         lora_name = body.model if body.model in self.lora_adapters else None
@@ -186,11 +190,11 @@ class EngineServer:
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=True,
-                lora_name=lora_name, parse_tools=use_tools,
+                lora_name=lora_name, parse_tools=use_tools, n=body.n,
             )
         return await self._complete(
             rid, prompt, sampling, chat=True, lora_name=lora_name,
-            parse_tools=use_tools,
+            parse_tools=use_tools, n=body.n,
         )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
@@ -198,8 +202,8 @@ class EngineServer:
             body = CompletionRequest.model_validate(await request.json())
         except (ValidationError, json.JSONDecodeError) as e:
             return error(400, f"invalid request: {e}")
-        if body.n != 1:
-            return error(400, "n>1 is not supported")
+        if not 1 <= body.n <= MAX_N_CHOICES:
+            return error(400, f"n must be between 1 and {MAX_N_CHOICES}")
         if err := self._check_model(body.model):
             return err
         lora_name = body.model if body.model in self.lora_adapters else None
@@ -213,11 +217,11 @@ class EngineServer:
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=False,
-                prompt_ids=prompt_ids, lora_name=lora_name,
+                prompt_ids=prompt_ids, lora_name=lora_name, n=body.n,
             )
         return await self._complete(
             rid, prompt, sampling, chat=False, prompt_ids=prompt_ids,
-            lora_name=lora_name,
+            lora_name=lora_name, n=body.n,
         )
 
     async def embeddings(self, request: web.Request) -> web.Response:
@@ -474,77 +478,130 @@ class EngineServer:
             "text_offset": text_offset,
         }, off
 
-    async def _complete(
-        self, rid, prompt, sampling, *, chat: bool, prompt_ids=None,
-        lora_name=None, parse_tools: bool = False,
-    ) -> web.Response:
+    @staticmethod
+    def _nth_sampling(sampling, i: int):
+        """Per-choice sampling for n>1: an explicit seed derives seed+i
+        (deterministic-but-distinct choices, vLLM's convention); without a
+        seed the engine's RNG stream already decorrelates requests."""
+        if i == 0 or sampling.seed is None:
+            return sampling
+        import dataclasses
+
+        return dataclasses.replace(sampling, seed=sampling.seed + i)
+
+    async def _run_single(self, rid, prompt, sampling, prompt_ids, lora_name):
+        """One full generation; returns the accumulated result dict."""
         text = ""
         token_ids: list[int] = []
         lp_entries: list = []
         finish_reason = None
         n_prompt = 0
-        try:
-            async for out in self.async_engine.generate(
-                prompt=prompt, prompt_token_ids=prompt_ids,
-                sampling=sampling, request_id=rid, lora_name=lora_name,
-            ):
-                text += out.text_delta
-                token_ids.extend(out.new_token_ids)
-                if out.new_logprobs:
-                    lp_entries.extend(out.new_logprobs)
-                finish_reason = out.finish_reason
-                n_prompt = out.num_prompt_tokens
-        except ValueError as e:
-            return error(400, str(e))
-        except EngineSleepingError as e:
-            return error(503, str(e), "service_unavailable")
-        except RuntimeError as e:
-            return error(500, str(e), "internal_error")
-        if finish_reason == "error":
-            return error(500, text, "internal_error")
-        created = int(time.time())
-        if chat:
-            message = {"role": "assistant", "content": text}
-            if parse_tools:
-                from .tool_calls import parse_tool_calls
+        async for out in self.async_engine.generate(
+            prompt=prompt, prompt_token_ids=prompt_ids,
+            sampling=sampling, request_id=rid, lora_name=lora_name,
+        ):
+            text += out.text_delta
+            token_ids.extend(out.new_token_ids)
+            if out.new_logprobs:
+                lp_entries.extend(out.new_logprobs)
+            finish_reason = out.finish_reason
+            n_prompt = out.num_prompt_tokens
+        return {
+            "text": text, "token_ids": token_ids, "lp": lp_entries,
+            "finish_reason": finish_reason, "n_prompt": n_prompt,
+        }
 
-                content, calls = parse_tool_calls(text)
-                if calls:
-                    message = {"role": "assistant", "content": content,
-                               "tool_calls": calls}
-                    finish_reason = "tool_calls"
-            choice = {
-                "index": 0,
-                "message": message,
-                "finish_reason": finish_reason,
-            }
-            if sampling.logprobs is not None:
-                choice["logprobs"] = self._chat_logprobs(
-                    token_ids, lp_entries, sampling.logprobs
-                )
-            obj = "chat.completion"
-        else:
-            choice = {"index": 0, "text": text, "finish_reason": finish_reason}
-            if sampling.logprobs is not None:
-                choice["logprobs"], _ = self._completion_logprobs(
-                    token_ids, lp_entries, sampling.logprobs
-                )
-            obj = "text_completion"
+    async def _complete(
+        self, rid, prompt, sampling, *, chat: bool, prompt_ids=None,
+        lora_name=None, parse_tools: bool = False, n: int = 1,
+    ) -> web.Response:
+        # n>1: concurrent submissions — continuous batching runs them in
+        # one batch and the prefix cache dedups the shared prompt, so the
+        # marginal cost per extra choice is its decode tokens only.
+        # Tasks (not bare gather): the first failure CANCELS the siblings
+        # — cancellation triggers generate()'s abort, freeing their KV
+        # blocks instead of decoding to max_tokens for a doomed response
+        tasks = [
+            asyncio.ensure_future(self._run_single(
+                rid if i == 0 else f"{rid}-{i}", prompt,
+                self._nth_sampling(sampling, i), prompt_ids, lora_name,
+            ))
+            for i in range(n)
+        ]
+        try:
+            runs = await asyncio.gather(*tasks)
+        except (ValueError, EngineSleepingError, RuntimeError) as e:
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            if isinstance(e, ValueError):
+                return error(400, str(e))
+            if isinstance(e, EngineSleepingError):
+                return error(503, str(e), "service_unavailable")
+            return error(500, str(e), "internal_error")
+        for r in runs:
+            if r["finish_reason"] == "error":
+                return error(500, r["text"], "internal_error")
+        created = int(time.time())
+        choices = []
+        for i, r in enumerate(runs):
+            finish_reason = r["finish_reason"]
+            if chat:
+                message = {"role": "assistant", "content": r["text"]}
+                if parse_tools:
+                    from .tool_calls import parse_tool_calls
+
+                    content, calls = parse_tool_calls(r["text"])
+                    if calls:
+                        message = {"role": "assistant", "content": content,
+                                   "tool_calls": calls}
+                        finish_reason = "tool_calls"
+                choice = {
+                    "index": i,
+                    "message": message,
+                    "finish_reason": finish_reason,
+                }
+                if sampling.logprobs is not None:
+                    choice["logprobs"] = self._chat_logprobs(
+                        r["token_ids"], r["lp"], sampling.logprobs
+                    )
+            else:
+                choice = {"index": i, "text": r["text"],
+                          "finish_reason": finish_reason}
+                if sampling.logprobs is not None:
+                    choice["logprobs"], _ = self._completion_logprobs(
+                        r["token_ids"], r["lp"], sampling.logprobs
+                    )
+            choices.append(choice)
         return web.json_response(
             {
                 "id": rid,
-                "object": obj,
+                "object": "chat.completion" if chat else "text_completion",
                 "created": created,
                 "model": self.model_name,
-                "choices": [choice],
-                "usage": usage(n_prompt, len(token_ids)),
+                "choices": choices,
+                # prompt counted once; completion tokens sum over choices
+                "usage": usage(
+                    runs[0]["n_prompt"],
+                    sum(len(r["token_ids"]) for r in runs),
+                ),
             }
         )
 
     async def _stream(
         self, request, rid, prompt, sampling, body, *, chat: bool,
         prompt_ids=None, lora_name=None, parse_tools: bool = False,
+        n: int = 1,
     ) -> web.StreamResponse:
+        """SSE streaming for 1..n choices — ONE implementation (n=1 is a
+        single pump), so single- and parallel-sampling semantics can never
+        diverge. Each choice is its own engine request; chunks interleave
+        on the wire tagged with their choice index (the OpenAI n>1 stream
+        contract). Tool-call splitting and logprobs run per choice; every
+        token-bearing step emits a chunk, even when detok held the text
+        back — first-token latency is only observable if the first token's
+        chunk actually goes out."""
         if self.async_engine.is_sleeping:
             return error(503, "engine is sleeping", "service_unavailable")
         resp = web.StreamResponse(
@@ -558,122 +615,129 @@ class EngineServer:
         await resp.prepare(request)
         created = int(time.time())
         obj = "chat.completion.chunk" if chat else "text_completion"
-        include_usage = bool(body.stream_options and body.stream_options.include_usage)
-        n_prompt = n_out = 0
+        include_usage = bool(
+            body.stream_options and body.stream_options.include_usage
+        )
+        rids = [rid if i == 0 else f"{rid}-{i}" for i in range(n)]
+        queue: asyncio.Queue = asyncio.Queue()
+
+        async def pump(i: int) -> None:
+            try:
+                async for out in self.async_engine.generate(
+                    prompt=prompt, prompt_token_ids=prompt_ids,
+                    sampling=self._nth_sampling(sampling, i),
+                    request_id=rids[i], lora_name=lora_name,
+                ):
+                    await queue.put((i, out))
+            except Exception as e:
+                # invalid prompt (too long) or raced into sleep/death
+                # after the SSE headers went out: delivered as an error
+                # event by the consumer, then DONE
+                await queue.put((i, e))
+            await queue.put((i, None))
+
+        tasks = [asyncio.ensure_future(pump(i)) for i in range(n)]
+        from .tool_calls import ToolCallStreamParser
+
+        parsers = [
+            ToolCallStreamParser() if parse_tools and chat else None
+            for _ in range(n)
+        ]
+        n_prompt = 0
+        n_out_total = 0
+        lp_offs = [0] * n  # per-choice text offsets (completions logprobs)
+        live = n
 
         async def send(payload: dict) -> None:
             await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
 
-        lp_off = 0  # running text offset for completions logprobs
-        # tool-call splitting: visible text streams as usual; text inside
-        # (or possibly starting) a <tool_call> block is held back and the
-        # parsed calls go out in a final delta with finish_reason
-        # "tool_calls" — the streaming contract OpenAI clients implement
-        tool_parser = None
-        if parse_tools and chat:
-            from .tool_calls import ToolCallStreamParser
-
-            tool_parser = ToolCallStreamParser()
-        if chat:  # role preamble chunk
-            await send(self._chunk(rid, obj, created, {"role": "assistant"}, None))
         try:
-            async for out in self.async_engine.generate(
-                prompt=prompt, prompt_token_ids=prompt_ids,
-                sampling=sampling, request_id=rid, lora_name=lora_name,
-            ):
+            if chat:  # role preamble chunk per choice
+                for i in range(n):
+                    await send(self._chunk(
+                        rid, obj, created, {"role": "assistant"}, None,
+                        index=i,
+                    ))
+            while live:
+                i, out = await queue.get()
+                if out is None:
+                    live -= 1
+                    continue
+                if isinstance(out, Exception):
+                    await send({"error": {"message": str(out)}})
+                    continue
                 n_prompt = out.num_prompt_tokens
-                n_out = out.num_output_tokens
+                n_out_total += len(out.new_token_ids)
                 if out.finish_reason == "error":
                     await send({"error": {"message": out.text_delta}})
-                    break
-                # every token-bearing step emits a chunk, even when detok
-                # held the text back (multi-byte sequences, or ids outside
-                # the text vocabulary) — vLLM streams the same way, and
-                # first-token latency is only observable if the first
-                # token's chunk actually goes out
-                if out.new_token_ids or out.text_delta or out.finished:
-                    text_delta = out.text_delta
-                    if tool_parser is not None:
-                        text_delta = tool_parser.feed(text_delta)
-                        if out.finished:
-                            tail, calls = tool_parser.finish()
-                            text_delta += tail
-                            if calls:
-                                chunk = self._chunk(
-                                    rid, obj, created,
-                                    {"content": text_delta or None,
-                                     "tool_calls": [
-                                         {**c, "index": i}
-                                         for i, c in enumerate(calls)
-                                     ]},
-                                    "tool_calls",
-                                )
-                                # the final step's logprobs ride this chunk
-                                # like any other (the non-stream path
-                                # returns the complete set)
-                                if sampling.logprobs is not None and (
-                                    out.new_logprobs
-                                ):
-                                    chunk["choices"][0]["logprobs"] = (
-                                        self._chat_logprobs(
-                                            out.new_token_ids,
-                                            out.new_logprobs,
-                                            sampling.logprobs,
-                                        )
-                                    )
-                                await send(chunk)
-                                continue
-                    delta = (
-                        {"content": text_delta}
-                        if chat
-                        else text_delta
-                    )
-                    chunk = self._chunk(
-                        rid, obj, created, delta,
-                        out.finish_reason if out.finished else None,
-                    )
-                    if sampling.logprobs is not None and out.new_logprobs:
-                        if chat:
-                            chunk["choices"][0]["logprobs"] = (
-                                self._chat_logprobs(
-                                    out.new_token_ids, out.new_logprobs,
-                                    sampling.logprobs,
-                                )
+                    continue
+                if not (out.new_token_ids or out.text_delta or out.finished):
+                    continue
+                text_delta = out.text_delta
+                finish = out.finish_reason if out.finished else None
+                extra_delta = None
+                if parsers[i] is not None:
+                    text_delta = parsers[i].feed(text_delta)
+                    if out.finished:
+                        tail, calls = parsers[i].finish()
+                        text_delta += tail
+                        if calls:
+                            extra_delta = {
+                                "content": text_delta or None,
+                                "tool_calls": [
+                                    {**c, "index": ci}
+                                    for ci, c in enumerate(calls)
+                                ],
+                            }
+                            finish = "tool_calls"
+                delta = (
+                    extra_delta
+                    if extra_delta is not None
+                    else ({"content": text_delta} if chat else text_delta)
+                )
+                chunk = self._chunk(rid, obj, created, delta, finish, index=i)
+                if sampling.logprobs is not None and out.new_logprobs:
+                    if chat:
+                        chunk["choices"][0]["logprobs"] = self._chat_logprobs(
+                            out.new_token_ids, out.new_logprobs,
+                            sampling.logprobs,
+                        )
+                    else:
+                        chunk["choices"][0]["logprobs"], lp_offs[i] = (
+                            self._completion_logprobs(
+                                out.new_token_ids, out.new_logprobs,
+                                sampling.logprobs, lp_offs[i],
                             )
-                        else:
-                            chunk["choices"][0]["logprobs"], lp_off = (
-                                self._completion_logprobs(
-                                    out.new_token_ids, out.new_logprobs,
-                                    sampling.logprobs, lp_off,
-                                )
-                            )
-                    await send(chunk)
+                        )
+                await send(chunk)
         except ConnectionResetError:
-            await self.async_engine.abort(rid)
+            for r in rids:
+                await self.async_engine.abort(r)
             return resp
-        except (ValueError, RuntimeError) as e:
-            # invalid prompt (too long) or raced into sleep/death after the
-            # SSE headers went out: deliver the error as an event, then DONE
-            await send({"error": {"message": str(e)}})
+        finally:
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
         if include_usage:
             final = self._chunk(rid, obj, created, None, None)
             final["choices"] = []
-            final["usage"] = usage(n_prompt, n_out)
+            final["usage"] = usage(n_prompt, n_out_total)
             await send(final)
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
         return resp
 
-    def _chunk(self, rid, obj, created, delta, finish_reason) -> dict:
+    def _chunk(self, rid, obj, created, delta, finish_reason,
+               index: int = 0) -> dict:
         if obj == "chat.completion.chunk":
             choice = {
-                "index": 0,
+                "index": index,
                 "delta": delta if delta is not None else {},
                 "finish_reason": finish_reason,
             }
         else:
             choice = {
-                "index": 0,
+                "index": index,
                 "text": delta if isinstance(delta, str) else "",
                 "finish_reason": finish_reason,
             }
