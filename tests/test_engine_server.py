@@ -518,3 +518,42 @@ def test_n_choices_streaming_completions_logprobs(srv):
     for i in (0, 1):
         toks = [t for lp in per_choice[i] for t in lp["tokens"]]
         assert len(toks) == 4, (i, per_choice[i])
+
+
+def test_stop_token_ids_api(srv):
+    """vLLM-compatible stop_token_ids through the OpenAI surface: run
+    greedy once to learn token 2's continuation, then re-run with that
+    token as a stop id — generation must cut there with finish 'stop'."""
+    async def go(client):
+        base = await (await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": [11, 12, 13],
+            "max_tokens": 6, "temperature": 0.0, "ignore_eos": True,
+            "logprobs": 0,
+        })).json()
+        # chosen ids ride the logprobs echo: token_repr strings are not
+        # invertible, so re-derive ids from a second run via stop at the
+        # 3rd generated token
+        return base
+
+    base = run_with_client(srv, go)
+    assert base["usage"]["completion_tokens"] == 6
+
+    # find the actual generated ids engine-side for a stable stop target
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    ids = srv.async_engine.engine.generate(
+        [[11, 12, 13]],
+        SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True),
+    )[0]["token_ids"]
+    target = ids[2]
+
+    async def go2(client):
+        return await (await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": [11, 12, 13],
+            "max_tokens": 6, "temperature": 0.0,
+            "stop_token_ids": [int(target)],
+        })).json()
+
+    out = run_with_client(srv, go2)
+    assert out["choices"][0]["finish_reason"] == "stop"
+    assert out["usage"]["completion_tokens"] <= 3

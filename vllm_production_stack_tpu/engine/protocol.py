@@ -52,6 +52,7 @@ class ChatCompletionRequest(OpenAIModel):
     stream: bool = False
     stream_options: StreamOptions | None = None
     stop: str | list[str] | None = None
+    stop_token_ids: list[int] | None = None  # extension (vLLM-compatible)
     seed: int | None = None
     user: str | None = None
     ignore_eos: bool = False  # extension (benchmark harnesses rely on it)
@@ -71,6 +72,7 @@ class ChatCompletionRequest(OpenAIModel):
             top_p=self.top_p,
             top_k=self.top_k,
             stop=tuple(stop),
+            stop_token_ids=tuple(self.stop_token_ids or ()),
             seed=self.seed,
             ignore_eos=self.ignore_eos,
             min_tokens=self.min_tokens,
@@ -91,6 +93,7 @@ class CompletionRequest(OpenAIModel):
     stream: bool = False
     stream_options: StreamOptions | None = None
     stop: str | list[str] | None = None
+    stop_token_ids: list[int] | None = None  # extension (vLLM-compatible)
     seed: int | None = None
     echo: bool = False
     user: str | None = None
@@ -108,6 +111,7 @@ class CompletionRequest(OpenAIModel):
             top_p=self.top_p,
             top_k=self.top_k,
             stop=tuple(stop),
+            stop_token_ids=tuple(self.stop_token_ids or ()),
             seed=self.seed,
             ignore_eos=self.ignore_eos,
             min_tokens=self.min_tokens,

@@ -479,6 +479,24 @@ class EngineServer:
         }, off
 
     @staticmethod
+    def _choice_rids(rid: str, n: int) -> list[str]:
+        """Per-choice engine request ids — ONE derivation shared by the
+        stream and non-stream paths (cleanup/log correlation key on it)."""
+        return [rid if i == 0 else f"{rid}-{i}" for i in range(n)]
+
+    async def _tokenize_once_for_fanout(self, prompt, prompt_ids, n):
+        """n>1 submits the same prompt n times — encode it ONCE here and
+        hand every choice the ids (tokenization of a multi-KB rendered
+        chat prompt is the expensive part of _submit)."""
+        if n > 1 and prompt is not None and prompt_ids is None:
+            loop = asyncio.get_running_loop()
+            prompt_ids = await loop.run_in_executor(
+                None, self.async_engine.tokenize, prompt
+            )
+            prompt = None
+        return prompt, prompt_ids
+
+    @staticmethod
     def _nth_sampling(sampling, i: int):
         """Per-choice sampling for n>1: an explicit seed derives seed+i
         (deterministic-but-distinct choices, vLLM's convention); without a
@@ -521,12 +539,15 @@ class EngineServer:
         # Tasks (not bare gather): the first failure CANCELS the siblings
         # — cancellation triggers generate()'s abort, freeing their KV
         # blocks instead of decoding to max_tokens for a doomed response
+        prompt, prompt_ids = await self._tokenize_once_for_fanout(
+            prompt, prompt_ids, n
+        )
         tasks = [
             asyncio.ensure_future(self._run_single(
-                rid if i == 0 else f"{rid}-{i}", prompt,
+                crid, prompt,
                 self._nth_sampling(sampling, i), prompt_ids, lora_name,
             ))
-            for i in range(n)
+            for i, crid in enumerate(self._choice_rids(rid, n))
         ]
         try:
             runs = await asyncio.gather(*tasks)
@@ -618,7 +639,10 @@ class EngineServer:
         include_usage = bool(
             body.stream_options and body.stream_options.include_usage
         )
-        rids = [rid if i == 0 else f"{rid}-{i}" for i in range(n)]
+        prompt, prompt_ids = await self._tokenize_once_for_fanout(
+            prompt, prompt_ids, n
+        )
+        rids = self._choice_rids(rid, n)
         queue: asyncio.Queue = asyncio.Queue()
 
         async def pump(i: int) -> None:
@@ -647,6 +671,8 @@ class EngineServer:
         n_out_total = 0
         lp_offs = [0] * n  # per-choice text offsets (completions logprobs)
         live = n
+        sent_errors: set[str] = set()  # a request-wide failure (same
+        # exception from every pump) emits ONE error event, not n copies
 
         async def send(payload: dict) -> None:
             await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
@@ -664,7 +690,9 @@ class EngineServer:
                     live -= 1
                     continue
                 if isinstance(out, Exception):
-                    await send({"error": {"message": str(out)}})
+                    if str(out) not in sent_errors:
+                        sent_errors.add(str(out))
+                        await send({"error": {"message": str(out)}})
                     continue
                 n_prompt = out.num_prompt_tokens
                 n_out_total += len(out.new_token_ids)
@@ -711,8 +739,10 @@ class EngineServer:
                         )
                 await send(chunk)
         except ConnectionResetError:
-            for r in rids:
-                await self.async_engine.abort(r)
+            # no abort-by-name here: _submit renames colliding request ids,
+            # so abort(rids[i]) could kill a DIFFERENT live request that
+            # owns that name. The finally-cancel below reaches generate()'s
+            # own cleanup, which aborts under the TRUE engine-side id.
             return resp
         finally:
             for t in tasks:
