@@ -557,3 +557,36 @@ def test_stop_token_ids_api(srv):
     out = run_with_client(srv, go2)
     assert out["choices"][0]["finish_reason"] == "stop"
     assert out["usage"]["completion_tokens"] <= 3
+
+
+def test_echo_completions(srv):
+    """echo=True prefixes the prompt to each choice (previously it was
+    silently ignored — a quiet API lie); echo+logprobs refuses (prompt
+    logprobs are not computed)."""
+    async def go(client):
+        ns = await (await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "abc", "max_tokens": 3,
+            "temperature": 0.0, "ignore_eos": True, "echo": True,
+        })).json()
+        st = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "abc", "max_tokens": 3,
+            "temperature": 0.0, "ignore_eos": True, "echo": True,
+            "stream": True,
+        })
+        first_text = None
+        async for raw in st.content:
+            line = raw.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                c = json.loads(line[6:])
+                if c.get("choices") and first_text is None:
+                    first_text = c["choices"][0]["text"]
+        bad = await client.post("/v1/completions", json={
+            "model": "tiny-llama", "prompt": "abc", "max_tokens": 2,
+            "echo": True, "logprobs": 2,
+        })
+        return ns, first_text, bad.status
+
+    ns, first_text, bad = run_with_client(srv, go)
+    assert ns["choices"][0]["text"].startswith("abc")
+    assert first_text == "abc"  # stream leads with the echoed prompt
+    assert bad == 400

@@ -213,15 +213,32 @@ class EngineServer:
         sampling = body.sampling(DEFAULT_MAX_TOKENS)
         if err := self._check_logprobs(sampling):
             return err
+        # echo: the prompt text precedes the completion (vLLM/OpenAI
+        # legacy semantics). Prompt LOGPROBS under echo would need a
+        # scoring forward pass — refuse rather than silently omit
+        echo_text = None
+        if body.echo:
+            if sampling.logprobs is not None:
+                return error(
+                    400, "echo with logprobs is not supported "
+                    "(prompt logprobs are not computed)",
+                )
+            echo_text = (
+                prompt if prompt is not None
+                else await asyncio.get_running_loop().run_in_executor(
+                    None, self.async_engine.detokenize, prompt_ids
+                )
+            )
         rid = request.headers.get("X-Request-Id") or random_id("cmpl")
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=False,
                 prompt_ids=prompt_ids, lora_name=lora_name, n=body.n,
+                echo_text=echo_text,
             )
         return await self._complete(
             rid, prompt, sampling, chat=False, prompt_ids=prompt_ids,
-            lora_name=lora_name, n=body.n,
+            lora_name=lora_name, n=body.n, echo_text=echo_text,
         )
 
     async def embeddings(self, request: web.Request) -> web.Response:
@@ -419,6 +436,9 @@ class EngineServer:
                 f"min_tokens ({sampling.min_tokens}) cannot exceed "
                 f"max_tokens ({sampling.max_tokens})",
             )
+        # linear-scanned per accepted token on the step thread — bound it
+        if len(sampling.stop_token_ids) > 64:
+            return error(400, "stop_token_ids supports at most 64 ids")
         return None
 
     def _tok_entry(self, tid: int) -> tuple[str, list[int]]:
@@ -532,6 +552,7 @@ class EngineServer:
     async def _complete(
         self, rid, prompt, sampling, *, chat: bool, prompt_ids=None,
         lora_name=None, parse_tools: bool = False, n: int = 1,
+        echo_text: str | None = None,
     ) -> web.Response:
         # n>1: concurrent submissions — continuous batching runs them in
         # one batch and the prefix cache dedups the shared prompt, so the
@@ -588,7 +609,8 @@ class EngineServer:
                         r["token_ids"], r["lp"], sampling.logprobs
                     )
             else:
-                choice = {"index": i, "text": r["text"],
+                choice = {"index": i,
+                          "text": (echo_text or "") + r["text"],
                           "finish_reason": finish_reason}
                 if sampling.logprobs is not None:
                     choice["logprobs"], _ = self._completion_logprobs(
@@ -613,7 +635,7 @@ class EngineServer:
     async def _stream(
         self, request, rid, prompt, sampling, body, *, chat: bool,
         prompt_ids=None, lora_name=None, parse_tools: bool = False,
-        n: int = 1,
+        n: int = 1, echo_text: str | None = None,
     ) -> web.StreamResponse:
         """SSE streaming for 1..n choices — ONE implementation (n=1 is a
         single pump), so single- and parallel-sampling semantics can never
@@ -684,6 +706,13 @@ class EngineServer:
                         rid, obj, created, {"role": "assistant"}, None,
                         index=i,
                     ))
+            elif echo_text:
+                # echo: the prompt leads each choice's stream (vLLM
+                # streams the same way — one prompt chunk, then deltas)
+                for i in range(n):
+                    await send(self._chunk(
+                        rid, obj, created, echo_text, None, index=i,
+                    ))
             while live:
                 i, out = await queue.get()
                 if out is None:
@@ -697,7 +726,11 @@ class EngineServer:
                 n_prompt = out.num_prompt_tokens
                 n_out_total += len(out.new_token_ids)
                 if out.finish_reason == "error":
-                    await send({"error": {"message": out.text_delta}})
+                    # same dedup as pump exceptions: a step-thread death
+                    # stamps the identical message into every choice
+                    if out.text_delta not in sent_errors:
+                        sent_errors.add(out.text_delta)
+                        await send({"error": {"message": out.text_delta}})
                     continue
                 if not (out.new_token_ids or out.text_delta or out.finished):
                     continue
