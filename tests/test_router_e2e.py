@@ -617,3 +617,48 @@ def test_semantic_cache_engine_embedder():
             assert engines[0].total_requests == 1
 
     asyncio.run(go())
+
+
+def test_failover_dead_backend_before_first_byte():
+    """A backend that refuses connections costs one reconnect, not a
+    failed request: the proxy raises pre-byte, the router drops the dead
+    endpoint from the candidate set and re-picks. Every request lands 200
+    on the live engine; a set of ONLY dead backends still 502s."""
+    import socket as _socket
+
+    async def go():
+        # a bound-but-never-listening socket held OPEN for the test's
+        # duration: connects get ECONNREFUSED deterministically (a
+        # bind-then-close port could be re-claimed by a parallel test)
+        hold = _socket.socket()
+        hold.bind(("127.0.0.1", 0))
+        dead_port = hold.getsockname()[1]
+        async with router_rig(
+            1, router_args=("--routing-logic", "roundrobin"),
+        ) as (client, engines, servers):
+            # splice the dead endpoint into the live discovery set
+            state = client.app["state"]
+            eps = state.discovery.endpoints()
+            from vllm_production_stack_tpu.router.discovery import Endpoint
+
+            dead = Endpoint(url=f"http://127.0.0.1:{dead_port}",
+                            model_names=["fake-model"])
+            state.discovery.endpoints = lambda: [dead] + eps
+
+            results = []
+            for i in range(6):  # roundrobin alternates onto the dead one
+                r = await client.post("/v1/chat/completions",
+                                      json=chat_body(f"q{i}"))
+                results.append(r.status)
+            served = sum(e.total_requests for e in engines)
+
+            # all-dead: no candidates left -> 502
+            state.discovery.endpoints = lambda: [dead]
+            r = await client.post("/v1/chat/completions", json=chat_body())
+            hold.close()
+            return results, served, r.status
+
+    results, served, all_dead_status = asyncio.run(go())
+    assert results == [200] * 6, results
+    assert served == 6
+    assert all_dead_status == 502

@@ -21,6 +21,17 @@ from aiohttp import web
 from ..utils.logging import init_logger
 from .routing import DisaggregatedPrefillPolicy, RoutingContext, qps_min_url
 
+
+class UpstreamConnectError(Exception):
+    """The engine was unreachable BEFORE any byte reached the client —
+    the request is safely retryable on another endpoint (nothing was
+    streamed, nothing was committed)."""
+
+    def __init__(self, url: str, cause: Exception):
+        super().__init__(f"{url}: {cause}")
+        self.url = url
+        self.cause = cause
+
 logger = init_logger(__name__)
 
 # hop-by-hop headers must not be forwarded either direction
@@ -129,22 +140,64 @@ class RequestService:
         if isinstance(self.state.policy, DisaggregatedPrefillPolicy):
             return await self._route_disaggregated(request, body, eps, request_id)
 
-        ctx = RoutingContext(
-            endpoints=eps,
-            engine_stats=self.state.engine_scraper.get_engine_stats(),
-            request_stats=self.state.request_monitor.get_request_stats(),
-            headers=dict(request.headers),
-            body=body,
-        )
-        try:
-            url = await self.state.policy.route(ctx)
-        except LookupError as e:
-            return web.json_response(
-                {"error": {"message": str(e), "type": "service_unavailable"}},
-                status=503,
+        # pre-byte failover (reference behavior is a hard 502; here a dead
+        # pod costs one reconnect instead of a failed request): an endpoint
+        # that refuses the CONNECTION is dropped from the candidate set and
+        # the pick reruns, as long as nothing was streamed to the client
+        candidates = list(eps)
+        last_err: UpstreamConnectError | None = None
+        # each failed attempt evicts its endpoint, so len(eps) attempts
+        # guarantee every live candidate gets a chance before 502 (a fixed
+        # small cap could exhaust on the dead ones during a rolling restart
+        # while healthy engines remain)
+        same_url_retried: set[str] = set()
+        attempts = 0
+        while candidates and attempts < len(eps) + 1:
+            attempts += 1
+            ctx = RoutingContext(
+                endpoints=candidates,
+                engine_stats=self.state.engine_scraper.get_engine_stats(),
+                request_stats=self.state.request_monitor.get_request_stats(),
+                headers=dict(request.headers),
+                body=body,
             )
-        logger.info("Routing request %s to %s at %f", request_id, url, time.time())
-        return await self._proxy_stream(request, body, url, request_id)
+            try:
+                url = await self.state.policy.route(ctx)
+            except LookupError as e:
+                return web.json_response(
+                    {"error": {"message": str(e), "type": "service_unavailable"}},
+                    status=503,
+                )
+            logger.info(
+                "Routing request %s to %s at %f", request_id, url, time.time()
+            )
+            try:
+                return await self._proxy_stream(request, body, url, request_id)
+            except UpstreamConnectError as e:
+                last_err = e
+                if (
+                    isinstance(e.cause, aiohttp.ServerDisconnectedError)
+                    and url not in same_url_retried
+                ):
+                    # a stale pooled keep-alive the engine idle-closed is
+                    # NOT a dead engine: reconnect to the SAME endpoint
+                    # once (evicting it would break session/prefix
+                    # affinity onto a cold KV cache)
+                    same_url_retried.add(url)
+                    logger.info(
+                        "stale connection to %s for %s — reconnecting",
+                        url, request_id,
+                    )
+                    continue
+                candidates = [c for c in candidates if c.url != url]
+                logger.warning(
+                    "engine %s refused connection for %s — failing over "
+                    "(%d candidates left)", url, request_id, len(candidates),
+                )
+        return web.json_response(
+            {"error": {"message": f"engine unreachable: {last_err}"}},
+            status=502,
+        )
 
     async def route_multipart_request(
         self, request: web.Request
@@ -267,6 +320,7 @@ class RequestService:
         mon = self.state.request_monitor
         data = json.dumps(body).encode()
         mon.on_new_request(backend_url, request_id, time.time())
+        pre_byte_raise = False
         cacheable = (
             self.state.semantic_cache is not None
             and request.path == "/v1/chat/completions"
@@ -309,9 +363,10 @@ class RequestService:
                 return resp
         except aiohttp.ClientError as e:
             if resp is None or not resp.prepared:
-                return web.json_response(
-                    {"error": {"message": f"engine unreachable: {e}"}}, status=502
-                )
+                # nothing reached the client: the caller can fail over to
+                # another endpoint (route_general_request's retry loop)
+                pre_byte_raise = True
+                raise UpstreamConnectError(backend_url, e) from e
             # headers (and possibly chunks) already went out — the only honest
             # signal left is severing the connection so the client sees a
             # truncated transfer instead of a clean end
@@ -327,7 +382,7 @@ class RequestService:
             return resp
         finally:
             mon.on_request_complete(backend_url, request_id, time.time())
-            if self.state.callbacks is not None:
+            if self.state.callbacks is not None and not pre_byte_raise:
                 await self.state.callbacks.post_request(request, bytes(full))
 
     # -- disaggregated prefill --------------------------------------------
@@ -418,7 +473,17 @@ class RequestService:
                 "PD KV transfer failed (%s); decode will recompute", e
             )
         logger.info("Routing request %s to %s at %f", request_id, decode_url, time.time())
-        return await self._proxy_stream(request, body, decode_url, request_id)
+        try:
+            return await self._proxy_stream(
+                request, body, decode_url, request_id
+            )
+        except UpstreamConnectError as e:
+            # the shipped KV lives on THIS decode engine — a blind retry
+            # elsewhere would silently recompute; surface the failure
+            return web.json_response(
+                {"error": {"message": f"decode engine unreachable: {e}"}},
+                status=502,
+            )
 
     # -- sleep / wake control ---------------------------------------------
 
