@@ -662,3 +662,54 @@ def test_failover_dead_backend_before_first_byte():
     assert results == [200] * 6, results
     assert served == 6
     assert all_dead_status == 502
+
+
+def test_multipart_failover_rebuilds_form():
+    """Multipart failover must resend IDENTICAL bytes on the retry: file
+    fields buffer once and the form rebuilds per attempt (FormData is
+    single-use and FileField.read() drains)."""
+    import socket as _socket
+
+    async def go():
+        hold = _socket.socket()
+        hold.bind(("127.0.0.1", 0))
+        dead_port = hold.getsockname()[1]
+        async with router_rig(
+            1, labels=["transcription"],
+            router_args=("--routing-logic", "roundrobin"),
+        ) as (client, engines, servers):
+            state = client.app["state"]
+            eps = state.discovery.endpoints()
+            from vllm_production_stack_tpu.router.discovery import Endpoint
+
+            dead = Endpoint(url=f"http://127.0.0.1:{dead_port}",
+                            model_names=["fake-model"],
+                            model_label="transcription")
+            state.discovery.endpoints = lambda: [dead] + eps
+
+            import aiohttp as _aiohttp
+
+            payload = b"RIFFfakewav" * 50
+            statuses = []
+            for i in range(4):
+                fd = _aiohttp.FormData()
+                fd.add_field("file", payload, filename="a.wav",
+                             content_type="audio/wav")
+                fd.add_field("model", "fake-model")
+                r = await client.post("/v1/audio/transcriptions", data=fd)
+                statuses.append(r.status)
+            # BYTE-level check: every served request carried the FULL
+            # buffered payload (a drained file field on the failover
+            # attempt — the exact bug the buffering prevents — would log
+            # bytes=0 here)
+            seen = [
+                rec for e in engines for rec in e.seen_request_log
+                if rec.get("path", "").endswith("transcriptions")
+            ]
+            hold.close()
+            return statuses, seen, len(payload)
+
+    statuses, seen, want_bytes = asyncio.run(go())
+    assert statuses == [200] * 4, statuses
+    assert len(seen) == 4
+    assert all(rec["bytes"] == want_bytes for rec in seen), seen

@@ -144,56 +144,82 @@ class RequestService:
         # pod costs one reconnect instead of a failed request): an endpoint
         # that refuses the CONNECTION is dropped from the candidate set and
         # the pick reruns, as long as nothing was streamed to the client
+        async def on_exhausted():
+            # callbacks pairing survives the all-dead path: pre_request
+            # ran, so a plugin doing in-flight accounting / audit-close /
+            # rate-limit release still sees its post_request (empty body,
+            # the pre-failover 502 behavior)
+            if self.state.callbacks is not None:
+                await self.state.callbacks.post_request(request, b"")
+
+        return await self._with_failover(
+            eps, request, request_id, body,
+            lambda url: self._proxy_stream(request, body, url, request_id),
+            on_exhausted=on_exhausted,
+        )
+
+
+    async def _with_failover(self, eps, request, request_id, ctx_body,
+                             attempt, on_exhausted=None):
+        """Pre-byte failover driver shared by the JSON and multipart proxy
+        paths: run `attempt(url)` against the policy's pick; a retry-safe
+        connection failure (UpstreamConnectError) either reconnects to the
+        SAME endpoint once (stale pooled keep-alive the engine idle-closed
+        — evicting would break session/prefix affinity) or evicts it from
+        the candidate set and re-picks. Budget 2*len(eps)+1 covers the
+        worst case of one stale-reconnect plus one eviction per endpoint.
+        A SECOND stale close from the same endpoint stops the failover:
+        the server is accepting-then-closing, so the request may have been
+        processed and a cross-endpoint resend could double-execute it.
+
+        Endpoint-health memory deliberately stays in discovery (its
+        periodic /health probes drop dead pods within one interval); this
+        loop only shields the requests that race that window."""
         candidates = list(eps)
         last_err: UpstreamConnectError | None = None
-        # each failed attempt evicts its endpoint, so len(eps) attempts
-        # guarantee every live candidate gets a chance before 502 (a fixed
-        # small cap could exhaust on the dead ones during a rolling restart
-        # while healthy engines remain)
         same_url_retried: set[str] = set()
         attempts = 0
-        while candidates and attempts < len(eps) + 1:
+        budget = 2 * len(eps) + 1
+        while candidates and attempts < budget:
             attempts += 1
             ctx = RoutingContext(
                 endpoints=candidates,
                 engine_stats=self.state.engine_scraper.get_engine_stats(),
                 request_stats=self.state.request_monitor.get_request_stats(),
                 headers=dict(request.headers),
-                body=body,
+                body=ctx_body,
             )
             try:
                 url = await self.state.policy.route(ctx)
             except LookupError as e:
                 return web.json_response(
-                    {"error": {"message": str(e), "type": "service_unavailable"}},
+                    {"error": {"message": str(e),
+                               "type": "service_unavailable"}},
                     status=503,
                 )
             logger.info(
                 "Routing request %s to %s at %f", request_id, url, time.time()
             )
             try:
-                return await self._proxy_stream(request, body, url, request_id)
+                return await attempt(url)
             except UpstreamConnectError as e:
                 last_err = e
-                if (
-                    isinstance(e.cause, aiohttp.ServerDisconnectedError)
-                    and url not in same_url_retried
-                ):
-                    # a stale pooled keep-alive the engine idle-closed is
-                    # NOT a dead engine: reconnect to the SAME endpoint
-                    # once (evicting it would break session/prefix
-                    # affinity onto a cold KV cache)
-                    same_url_retried.add(url)
-                    logger.info(
-                        "stale connection to %s for %s — reconnecting",
-                        url, request_id,
-                    )
-                    continue
+                if isinstance(e.cause, aiohttp.ServerDisconnectedError):
+                    if url not in same_url_retried:
+                        same_url_retried.add(url)
+                        logger.info(
+                            "stale connection to %s for %s — reconnecting",
+                            url, request_id,
+                        )
+                        continue
+                    break  # repeated accept-then-close: don't resend
                 candidates = [c for c in candidates if c.url != url]
                 logger.warning(
                     "engine %s refused connection for %s — failing over "
                     "(%d candidates left)", url, request_id, len(candidates),
                 )
+        if on_exhausted is not None:
+            await on_exhausted()
         return web.json_response(
             {"error": {"message": f"engine unreachable: {last_err}"}},
             status=502,
@@ -235,37 +261,17 @@ class RequestService:
                 },
                 status=404,
             )
-        ctx = RoutingContext(
-            endpoints=eps,
-            engine_stats=self.state.engine_scraper.get_engine_stats(),
-            request_stats=self.state.request_monitor.get_request_stats(),
-            headers=dict(request.headers),
-            body={"model": model},
-        )
-        try:
-            url = await self.state.policy.route(ctx)
-        except LookupError as e:
-            return web.json_response(
-                {"error": {"message": str(e), "type": "service_unavailable"}},
-                status=503,
-            )
-        logger.info(
-            "Routing request %s to %s at %f", request_id, url, time.time()
-        )
-
-        fd = aiohttp.FormData()
+        # buffer file fields ONCE: FormData is single-use, and a failover
+        # retry must resend identical bytes (FileField.read() drains)
+        fields = []
         for key, value in form.items():
             if isinstance(value, web.FileField):
-                fd.add_field(
-                    key,
-                    value.file.read(),
-                    filename=value.filename,
-                    content_type=value.content_type,
-                )
+                fields.append((key, value.file.read(), value.filename,
+                               value.content_type))
             elif key == "model":
-                fd.add_field(key, model or "")  # alias-resolved name
+                fields.append((key, model or "", None, None))
             else:
-                fd.add_field(key, value)
+                fields.append((key, value, None, None))
         # the original Content-Type names the OLD boundary — aiohttp sets the
         # fresh one for the rebuilt form
         headers = {
@@ -274,41 +280,83 @@ class RequestService:
             if k.lower() != "content-type"
         }
         mon = self.state.request_monitor
-        mon.on_new_request(url, request_id, time.time())
-        resp: web.StreamResponse | None = None
-        try:
-            async with self.session.post(
-                url + request.path,
-                data=fd,
-                headers=headers,
-                timeout=aiohttp.ClientTimeout(total=300),
-            ) as upstream:
-                resp = web.StreamResponse(status=upstream.status)
-                for k, v in upstream.headers.items():
-                    if k.lower() not in _HOP_HEADERS:
-                        resp.headers[k] = v
-                resp.headers["X-Request-Id"] = request_id
-                await resp.prepare(request)
-                first = True
-                async for chunk in upstream.content.iter_any():
-                    if first:
-                        first = False
-                        mon.on_first_token(url, request_id, time.time())
-                    await resp.write(chunk)
-                await resp.write_eof()
+
+        async def attempt(url: str) -> web.StreamResponse:
+            # fresh FormData per attempt from the buffered fields — the
+            # object is single-use and a retry must resend identical bytes
+            fd = aiohttp.FormData()
+            for key, payload, filename, ctype in fields:
+                if filename is not None:
+                    fd.add_field(key, payload, filename=filename,
+                                 content_type=ctype)
+                else:
+                    fd.add_field(key, payload)
+            mon.on_new_request(url, request_id, time.time())
+            resp: web.StreamResponse | None = None
+            try:
+                async with self.session.post(
+                    url + request.path,
+                    data=fd,
+                    headers=headers,
+                    timeout=aiohttp.ClientTimeout(total=300),
+                ) as upstream:
+                    resp = web.StreamResponse(status=upstream.status)
+                    for k, v in upstream.headers.items():
+                        if k.lower() not in _HOP_HEADERS:
+                            resp.headers[k] = v
+                    resp.headers["X-Request-Id"] = request_id
+                    await resp.prepare(request)
+                    first = True
+                    async for chunk in upstream.content.iter_any():
+                        if first:
+                            first = False
+                            mon.on_first_token(url, request_id, time.time())
+                        await resp.write(chunk)
+                    await resp.write_eof()
+                    return resp
+            except (aiohttp.ClientConnectorError,
+                    aiohttp.ServerDisconnectedError) as e:
+                if resp is None or not resp.prepared:
+                    # connection never carried the request (or a stale
+                    # keep-alive closed before headers): retry-safe
+                    raise UpstreamConnectError(url, e) from e
+                resp.force_close()
+                if request.transport is not None:
+                    request.transport.close()
                 return resp
-        except aiohttp.ClientError as e:
-            if resp is None or not resp.prepared:
-                return web.json_response(
-                    {"error": {"message": f"engine unreachable: {e}"}},
-                    status=502,
-                )
-            resp.force_close()
-            if request.transport is not None:
-                request.transport.close()
-            return resp
-        finally:
-            mon.on_request_complete(url, request_id, time.time())
+            except aiohttp.ClientError as e:
+                # the upload may have been RECEIVED (e.g. the engine died
+                # mid-processing): never resend non-idempotent work
+                if resp is None or not resp.prepared:
+                    return web.json_response(
+                        {"error": {"message": f"engine error: {e}"}},
+                        status=502,
+                    )
+                resp.force_close()
+                if request.transport is not None:
+                    request.transport.close()
+                return resp
+            finally:
+                mon.on_request_complete(url, request_id, time.time())
+
+        return await self._with_failover(
+            eps, request, request_id, {"model": model}, attempt,
+        )
+
+
+    @staticmethod
+    async def _sever(request, resp, backend_url, request_id, e):
+        """Headers (and possibly chunks) already went out — the only
+        honest signal left is severing the connection so the client sees
+        a truncated transfer instead of a clean end."""
+        logger.warning(
+            "engine %s died mid-stream for request %s: %s",
+            backend_url, request_id, e,
+        )
+        resp.force_close()
+        if request.transport is not None:
+            request.transport.close()
+        return resp
 
     async def _proxy_stream(
         self,
@@ -361,25 +409,27 @@ class RequestService:
                     except (json.JSONDecodeError, UnicodeDecodeError):
                         pass
                 return resp
-        except aiohttp.ClientError as e:
+        except (aiohttp.ClientConnectorError,
+                aiohttp.ServerDisconnectedError) as e:
             if resp is None or not resp.prepared:
-                # nothing reached the client: the caller can fail over to
-                # another endpoint (route_general_request's retry loop)
+                # the connection never carried the request (refused /
+                # unreachable / stale keep-alive closed before headers):
+                # nothing reached client OR engine — the caller can fail
+                # over safely (_with_failover)
                 pre_byte_raise = True
                 raise UpstreamConnectError(backend_url, e) from e
-            # headers (and possibly chunks) already went out — the only honest
-            # signal left is severing the connection so the client sees a
-            # truncated transfer instead of a clean end
-            logger.warning(
-                "engine %s died mid-stream for request %s: %s",
-                backend_url,
-                request_id,
-                e,
-            )
-            resp.force_close()
-            if request.transport is not None:
-                request.transport.close()
-            return resp
+            return await self._sever(request, resp, backend_url,
+                                     request_id, e)
+        except aiohttp.ClientError as e:
+            if resp is None or not resp.prepared:
+                # the request MAY have been received and processed (engine
+                # died mid-inference before sending headers): a resend
+                # could double-execute non-idempotent work — fail honestly
+                return web.json_response(
+                    {"error": {"message": f"engine error: {e}"}}, status=502
+                )
+            return await self._sever(request, resp, backend_url,
+                                     request_id, e)
         finally:
             mon.on_request_complete(backend_url, request_id, time.time())
             if self.state.callbacks is not None and not pre_byte_raise:
