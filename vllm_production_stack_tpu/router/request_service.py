@@ -192,6 +192,8 @@ class RequestService:
             try:
                 url = await self.state.policy.route(ctx)
             except LookupError as e:
+                if on_exhausted is not None:
+                    await on_exhausted()  # callbacks pairing (see below)
                 return web.json_response(
                     {"error": {"message": str(e),
                                "type": "service_unavailable"}},
@@ -315,15 +317,13 @@ class RequestService:
                     await resp.write_eof()
                     return resp
             except (aiohttp.ClientConnectorError,
+                    aiohttp.ConnectionTimeoutError,
                     aiohttp.ServerDisconnectedError) as e:
                 if resp is None or not resp.prepared:
                     # connection never carried the request (or a stale
                     # keep-alive closed before headers): retry-safe
                     raise UpstreamConnectError(url, e) from e
-                resp.force_close()
-                if request.transport is not None:
-                    request.transport.close()
-                return resp
+                return await self._sever(request, resp, url, request_id, e)
             except aiohttp.ClientError as e:
                 # the upload may have been RECEIVED (e.g. the engine died
                 # mid-processing): never resend non-idempotent work
@@ -332,10 +332,7 @@ class RequestService:
                         {"error": {"message": f"engine error: {e}"}},
                         status=502,
                     )
-                resp.force_close()
-                if request.transport is not None:
-                    request.transport.close()
-                return resp
+                return await self._sever(request, resp, url, request_id, e)
             finally:
                 mon.on_request_complete(url, request_id, time.time())
 
@@ -410,10 +407,12 @@ class RequestService:
                         pass
                 return resp
         except (aiohttp.ClientConnectorError,
+                aiohttp.ConnectionTimeoutError,
                 aiohttp.ServerDisconnectedError) as e:
             if resp is None or not resp.prepared:
                 # the connection never carried the request (refused /
-                # unreachable / stale keep-alive closed before headers):
+                # timed out during CONNECT / unreachable / stale
+                # keep-alive closed before headers):
                 # nothing reached client OR engine — the caller can fail
                 # over safely (_with_failover)
                 pre_byte_raise = True
