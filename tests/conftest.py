@@ -44,6 +44,7 @@ SLOW_TESTS = {
     "test_distributed.py::test_multiprocess_pd_dryrun_tp2_roles",
     "test_spec_decode.py::test_spec_engine_matches_plain_greedy",
     "test_sharding.py::test_engine_e2e_on_pp_mesh",
+    "test_sharding.py::test_qwen3_qk_norm_engine_tp2_matches_tp1",
     "test_disagg_prefill.py::test_streamed_pull_8k_prompt_overlaps_decode",
     "test_engine.py::test_compile_fallback_pads_up_to_warm_program",
     "test_pallas_attention.py::test_engine_chunked_prefill_pallas_backend_matches_xla",

@@ -181,3 +181,76 @@ def test_llama31_rope_scaling_checkpoint_end_to_end(tmp_path):
     with pytest.raises(ValueError, match="rope_scaling"):
         resolve_model_config(str(tmp_path), max_model_len=256,
                              dtype="float32")
+
+
+def test_qwen3_checkpoint_qk_norm(tmp_path):
+    """Qwen3: per-head QK RMSNorm before rope (no attention bias). The
+    loaded model's logits must match HF Qwen3ForCausalLM — a missing or
+    misplaced q_norm/k_norm diverges immediately."""
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    torch.manual_seed(55)
+    hf_cfg = Qwen3Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=10000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        torch_dtype="float32",
+    )
+    model = Qwen3ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg = resolve_model_config(str(tmp_path), max_model_len=256,
+                               dtype="float32")
+    assert cfg.architecture == "qwen3" and cfg.qk_norm
+    assert not cfg.attention_bias
+    params = load_checkpoint_params(cfg)
+    tokens = list(np.random.RandomState(8).randint(0, 512, size=37))
+    ours = _jax_prefill_logits(cfg, params, tokens)
+    with torch.no_grad():
+        theirs = model(torch.tensor([tokens])).logits[0].numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen3_engine_greedy_matches_hf(tmp_path):
+    """The ENGINE path (chunked prefill + fused decode window) through a
+    qwen3 checkpoint: greedy ids equal HF generate — qk_norm must apply
+    identically in the decode window, not just prefill."""
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    torch.manual_seed(56)
+    hf_cfg = Qwen3Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=10000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        torch_dtype="float32",
+    )
+    model = Qwen3ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    cfg = resolve_model_config(str(tmp_path), max_model_len=256,
+                               dtype="float32")
+    engine = LLMEngine(EngineConfig(
+        model=cfg,
+        cache=CacheConfig(block_size=8, num_blocks=64),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, max_num_batched_tokens=64,
+            prefill_buckets=(32, 64), decode_buckets=(2,), decode_window=4,
+        ),
+    ))
+    prompt = [int(x) for x in np.random.RandomState(9).randint(0, 512, 30)]
+    got = engine.generate(
+        [prompt], SamplingParams(max_tokens=8, temperature=0.0,
+                                 ignore_eos=True),
+    )[0]["token_ids"]
+    with torch.no_grad():
+        want = model.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+        )[0][len(prompt):].tolist()
+    assert got == want, (got, want)

@@ -3,6 +3,7 @@ must match the param tree structurally, and a TP-sharded forward must
 reproduce single-device logits (XLA inserts the collectives)."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -162,3 +163,43 @@ def test_engine_e2e_on_pp_mesh():
     ref_out = build(tp=1, dp=1, pp=1).generate(prompts, sampling)
     for a, b in zip(pp_out, ref_out):
         assert a["token_ids"] == b["token_ids"]
+
+
+def test_qwen3_qk_norm_engine_tp2_matches_tp1():
+    """qk_norm weights replicate over tp (head-invariant head_dim
+    vectors): a tp=2 engine must reproduce the single-device greedy ids.
+    Pins the sharding spec for the q_norm/k_norm leaves."""
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ModelConfig, ParallelConfig,
+        SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+    from vllm_production_stack_tpu.parallel import mesh as mesh_lib
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    cfg = ModelConfig.tiny(architecture="qwen3", qk_norm=True)
+    base = EngineConfig(
+        model=cfg,
+        cache=CacheConfig(block_size=8, num_blocks=64),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, max_num_batched_tokens=64,
+            decode_buckets=(2,), prefill_buckets=(32, 64), decode_window=4,
+        ),
+    )
+    prompts = [
+        list(np.random.RandomState(i).randint(1, cfg.vocab_size, size=20))
+        for i in range(2)
+    ]
+    sampling = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    ref = [o["token_ids"] for o in LLMEngine(base).generate(prompts, sampling)]
+    tp_eng = LLMEngine(
+        base.replace(parallel=ParallelConfig(tensor_parallel_size=2)),
+        mesh=mesh_lib.make_mesh(tensor_parallel_size=2,
+                                devices=jax.devices()[:2]),
+    )
+    got = [o["token_ids"] for o in tp_eng.generate(prompts, sampling)]
+    assert got == ref

@@ -91,6 +91,9 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> dict:
         params["layers"]["attn"]["bq"] = jnp.zeros((L, nh * hd), dt)
         params["layers"]["attn"]["bk"] = jnp.zeros((L, nkv * hd), dt)
         params["layers"]["attn"]["bv"] = jnp.zeros((L, nkv * hd), dt)
+    if cfg.qk_norm:
+        params["layers"]["attn"]["q_norm"] = jnp.ones((L, hd), dt)
+        params["layers"]["attn"]["k_norm"] = jnp.ones((L, hd), dt)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = w(next(keys), h, cfg.vocab_size, scale=0.02)
     return params
@@ -252,10 +255,14 @@ def _layer_body(
     v = proj(x, ap["wv"], "v_proj")
     if cfg.attention_bias:
         q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
-    q = apply_rope(q.reshape(b, t, nh, hd), positions, cfg.rope_theta,
-                   scaling=cfg.rope_scaling)
-    k = apply_rope(k.reshape(b, t, nkv, hd), positions, cfg.rope_theta,
-                   scaling=cfg.rope_scaling)
+    q = q.reshape(b, t, nh, hd)
+    k = k.reshape(b, t, nkv, hd)
+    if cfg.qk_norm:
+        # qwen3: per-head RMSNorm on q/k BEFORE rope (HF Qwen3Attention)
+        q = rms_norm(q, ap["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, ap["k_norm"], cfg.rms_norm_eps)
+    q = apply_rope(q, positions, cfg.rope_theta, scaling=cfg.rope_scaling)
+    k = apply_rope(k, positions, cfg.rope_theta, scaling=cfg.rope_scaling)
     v = v.reshape(b, t, nkv, hd)
 
     attn = attend(q, k, v).reshape(b, t, nh * hd)

@@ -133,6 +133,11 @@ def load_checkpoint_params(cfg: ModelConfig) -> dict:
         params["layers"]["attn"]["bq"] = stack(p + "self_attn.q_proj.bias", vec)
         params["layers"]["attn"]["bk"] = stack(p + "self_attn.k_proj.bias", vec)
         params["layers"]["attn"]["bv"] = stack(p + "self_attn.v_proj.bias", vec)
+    if cfg.qk_norm:
+        params["layers"]["attn"]["q_norm"] = stack(
+            p + "self_attn.q_norm.weight", vec)
+        params["layers"]["attn"]["k_norm"] = stack(
+            p + "self_attn.k_norm.weight", vec)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = mat("lm_head.weight")
     logger.info(

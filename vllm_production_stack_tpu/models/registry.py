@@ -54,6 +54,12 @@ PRESETS: dict[str, dict] = {
         max_model_len=8192, rope_theta=1000000.0, attention_bias=True,
         architecture="qwen2",
     ),
+    "qwen3-8b": dict(
+        vocab_size=151936, hidden_size=4096, intermediate_size=12288,
+        num_layers=36, num_heads=32, num_kv_heads=8, head_dim=128,
+        max_model_len=8192, rope_theta=1000000.0, architecture="qwen3",
+        qk_norm=True,
+    ),
     "tiny-gemma": dict(
         vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
         num_heads=4, num_kv_heads=2, head_dim=24, max_model_len=256,
@@ -86,6 +92,7 @@ _ARCH_MAP = {
     "LlamaForCausalLM": "llama",
     "MistralForCausalLM": "llama",
     "Qwen2ForCausalLM": "qwen2",
+    "Qwen3ForCausalLM": "qwen3",
     "MixtralForCausalLM": "mixtral",
     "GemmaForCausalLM": "gemma",
 }
@@ -142,6 +149,7 @@ def _from_hf_config(path: str) -> dict:
         if arch == "gemma"
         else {}
     )
+    qwen3 = dict(qk_norm=True) if arch == "qwen3" else {}
     # RoPE scaling (Llama-3.1-class checkpoints — the reference's headline
     # model ships rope_scaling rope_type=llama3): silently ignoring it
     # would serve subtly wrong long-range positions, so unknown types are
@@ -168,6 +176,7 @@ def _from_hf_config(path: str) -> dict:
     return dict(
         **moe,
         **gemma,
+        **qwen3,
         **scaling,
         model=path,
         architecture=arch,

@@ -47,6 +47,14 @@ def llama_param_specs(cfg: ModelConfig) -> dict:
             "bk": P(PP_AXIS, TP_AXIS),
             "bv": P(PP_AXIS, TP_AXIS),
         }
+    if cfg.qk_norm:
+        # [L, head_dim] — per-head norm weights are head-invariant, so
+        # they replicate across tp (every shard's heads use the same
+        # head_dim vector)
+        attn |= {
+            "q_norm": P(PP_AXIS, None),
+            "k_norm": P(PP_AXIS, None),
+        }
     if cfg.num_experts:
         # Mixtral MoE: expert axis over ep (each device holds E/ep experts —
         # the reason ep exists: 8x7B expert weights don't fit one chip),

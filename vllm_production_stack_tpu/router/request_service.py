@@ -97,6 +97,15 @@ class RequestService:
 
     # -- the proxy ---------------------------------------------------------
 
+    async def _pair_callbacks(self, request) -> None:
+        """post_request pairing for error returns that happen AFTER
+        pre_request ran but BEFORE a successful proxy attempt completed —
+        plugins doing in-flight accounting / audit-close / rate-limit slot
+        release rely on exactly one post_request per pre_request (empty
+        body, matching the long-standing 502-path behavior)."""
+        if self.state.callbacks is not None:
+            await self.state.callbacks.post_request(request, b"")
+
     async def route_openai_request(self, request: web.Request) -> web.StreamResponse:
         """Generic /v1/* proxy with routing."""
         if request.content_type == "multipart/form-data":
@@ -127,6 +136,7 @@ class RequestService:
             body = {**body, "model": model}
         eps = self._eligible_endpoints(model)
         if not eps:
+            await self._pair_callbacks(request)
             return web.json_response(
                 {
                     "error": {
@@ -145,12 +155,7 @@ class RequestService:
         # that refuses the CONNECTION is dropped from the candidate set and
         # the pick reruns, as long as nothing was streamed to the client
         async def on_exhausted():
-            # callbacks pairing survives the all-dead path: pre_request
-            # ran, so a plugin doing in-flight accounting / audit-close /
-            # rate-limit release still sees its post_request (empty body,
-            # the pre-failover 502 behavior)
-            if self.state.callbacks is not None:
-                await self.state.callbacks.post_request(request, b"")
+            await self._pair_callbacks(request)
 
         return await self._with_failover(
             eps, request, request_id, body,
@@ -449,6 +454,7 @@ class RequestService:
         policy: DisaggregatedPrefillPolicy = self.state.policy
         prefill_eps, decode_eps = policy.pools(eps)
         if not prefill_eps or not decode_eps:
+            await self._pair_callbacks(request)
             return web.json_response(
                 {"error": {"message": "prefill/decode pools are not both available"}},
                 status=503,
@@ -467,11 +473,13 @@ class RequestService:
             ) as resp:
                 await resp.read()
                 if resp.status != 200:
+                    await self._pair_callbacks(request)
                     return web.json_response(
                         {"error": {"message": f"prefill engine returned {resp.status}"}},
                         status=502,
                     )
         except aiohttp.ClientError as e:
+            await self._pair_callbacks(request)
             return web.json_response(
                 {"error": {"message": f"prefill engine unreachable: {e}"}},
                 status=502,
@@ -529,6 +537,7 @@ class RequestService:
         except UpstreamConnectError as e:
             # the shipped KV lives on THIS decode engine — a blind retry
             # elsewhere would silently recompute; surface the failure
+            await self._pair_callbacks(request)
             return web.json_response(
                 {"error": {"message": f"decode engine unreachable: {e}"}},
                 status=502,
