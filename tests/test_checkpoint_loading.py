@@ -254,3 +254,74 @@ def test_qwen3_engine_greedy_matches_hf(tmp_path):
             torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
         )[0][len(prompt):].tolist()
     assert got == want, (got, want)
+
+
+def test_mistral_sliding_window_checkpoint(tmp_path):
+    """Mistral-7B-v0.1-class sliding-window attention: a checkpoint with
+    sliding_window set must serve WINDOWED attention — both prefill logits
+    (vs HF eager, which masks beyond the window) and the engine's fused
+    decode window. A tiny window (8) against a 40-token prompt makes full
+    attention diverge immediately, so this fails loudly if the window is
+    silently dropped (the pre-round-5 behavior)."""
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(66)
+    hf_cfg = MistralConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=10000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        sliding_window=8, torch_dtype="float32",
+        attn_implementation="eager",
+    )
+    model = MistralForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg = resolve_model_config(str(tmp_path), max_model_len=256,
+                               dtype="float32")
+    assert cfg.sliding_window == 8 and cfg.sliding_window_pattern == 1
+    params = load_checkpoint_params(cfg)
+    tokens = list(np.random.RandomState(12).randint(0, 512, size=40))
+    ours = _jax_prefill_logits(cfg, params, tokens)
+    with torch.no_grad():
+        theirs = model(torch.tensor([tokens])).logits[0].numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    # engine path: greedy ids through chunked prefill + fused decode
+    # window (decode_window=4 < sliding_window=8, the soundness condition
+    # the engine asserts)
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    engine = LLMEngine(EngineConfig(
+        model=cfg,
+        cache=CacheConfig(block_size=8, num_blocks=64),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, max_num_batched_tokens=32,
+            prefill_buckets=(16, 32), decode_buckets=(2,), decode_window=4,
+        ),
+    ))
+    got = engine.generate(
+        [tokens], SamplingParams(max_tokens=8, temperature=0.0,
+                                 ignore_eos=True),
+    )[0]["token_ids"]
+    with torch.no_grad():
+        want = model.generate(
+            torch.tensor([tokens]), max_new_tokens=8, do_sample=False,
+        )[0][len(tokens):].tolist()
+    assert got == want, (got, want)
+
+    # window > decode_window is enforced
+    with pytest.raises(ValueError, match="sliding_window"):
+        LLMEngine(EngineConfig(
+            model=cfg,
+            cache=CacheConfig(block_size=8, num_blocks=64),
+            scheduler=SchedulerConfig(
+                max_num_seqs=2, max_num_batched_tokens=32,
+                prefill_buckets=(16, 32), decode_buckets=(2,),
+                decode_window=8,
+            ),
+        ))

@@ -61,6 +61,22 @@ class LLMEngine:
         mesh=None,
         tokenizer: TokenizerWrapper | None = None,
     ):
+        if config.model.any_sliding:
+            # the fused decode window's staged slots are globally
+            # attendable — sound only while every staged position is
+            # within the sliding window; sp ring prefill has no window
+            # masking
+            if config.model.sliding_window <= config.scheduler.decode_window:
+                raise ValueError(
+                    f"sliding_window ({config.model.sliding_window}) must "
+                    f"exceed decode_window "
+                    f"({config.scheduler.decode_window})"
+                )
+            if config.parallel.sequence_parallel_size > 1:
+                raise ValueError(
+                    "sequence parallelism does not support sliding-window "
+                    "models yet"
+                )
         if config.cache.num_blocks is None:
             from dataclasses import replace
 

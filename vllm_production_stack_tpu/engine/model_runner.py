@@ -314,6 +314,15 @@ class ModelRunner:
         partition rule for pallas_call; wrap in shard_map before enabling
         under tp>1); CPU tests pin numerics via interpret mode."""
         backend = self.config.attention_backend
+        if self.config.model.any_sliding:
+            # sliding-window models (Mistral-v0.1, Gemma-2 class): the
+            # Pallas kernels have no window masking yet — XLA only
+            if backend in ("pallas", "pallas_interpret"):
+                raise ValueError(
+                    "attention_backend='pallas' does not support "
+                    "sliding-window models; use 'xla'"
+                )
+            return "xla"
         if backend == "auto":
             return resolve_auto_attention_backend(
                 block_size=self.config.cache.block_size,
@@ -370,6 +379,13 @@ class ModelRunner:
             and par.expert_parallel_size == 1
         )
         backend = self.config.prefill_attention_backend
+        if self.config.model.any_sliding:
+            if backend in ("pallas", "pallas_interpret"):
+                raise ValueError(
+                    "prefill_attention_backend='pallas' does not support "
+                    "sliding-window models; use 'xla'"
+                )
+            return "xla"
         if backend == "auto":
             return resolve_auto_prefill_backend(
                 block_size=self.config.cache.block_size,

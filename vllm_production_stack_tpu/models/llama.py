@@ -402,7 +402,13 @@ def forward(
         # the kernel masks from scalars alone — the scheduler feeds chunks
         # with contiguous positions (scheduler.py: range(start, start+len)),
         # so chunk_start is the first column. No (B, T, S) mask exists.
+        if cfg.any_sliding:
+            raise NotImplementedError(
+                "pallas prefill does not support sliding-window models "
+                "(the runner gates these to the XLA backend)"
+            )
         mask = None
+        mask_local = None
         pallas_prefill = {
             "context_lens": context_lens,
             "chunk_start": positions[:, 0],
@@ -410,9 +416,17 @@ def forward(
             "mesh": mesh,
         }
     else:
-        # layer-invariant attention mask, built once, reused by every layer
+        # attention masks, built once per WINDOW KIND and reused by every
+        # layer of that kind (full everywhere; plus the sliding variant
+        # for Mistral-v0.1 / Gemma-2 class models)
         s_ctx = block_tables.shape[1] * kv_caches[0].shape[2]
         mask = causal_page_mask(positions, context_lens, s_ctx)
+        mask_local = (
+            causal_page_mask(positions, context_lens, s_ctx,
+                             window=cfg.sliding_window)
+            if cfg.any_sliding
+            else None
+        )
         pallas_prefill = None
 
     # unrolled layer loop (params stay stacked; each layer slices statically).
@@ -424,7 +438,8 @@ def forward(
         lp = jax.tree.map(lambda a: a[i], params["layers"])
         x, layer_kv = _layer(
             cfg, lp, kv_caches[i], x, positions, block_tables, slot_mapping,
-            mask, _lora_layer_slice(lora, i), lora_idx, write_blocks,
+            mask_local if cfg.layer_sliding(i) else mask,
+            _lora_layer_slice(lora, i), lora_idx, write_blocks,
             pallas_prefill,
         )
         new_kv.append(layer_kv)
@@ -478,9 +493,18 @@ def decode_window_step(
             if hists is not None
             else block_tables.shape[1] * kv_caches[0].shape[2]
         )
-        hist_mask = (
-            jnp.arange(s_ctx, dtype=jnp.int32)[None, :] < hist_len[:, None]
-        )
+        arange = jnp.arange(s_ctx, dtype=jnp.int32)[None, :]
+        hist_mask = arange < hist_len[:, None]
+        hist_mask_local = None
+        if cfg.any_sliding:
+            # sliding layers: the query at `positions` sees only pool
+            # history within the window. Staged slots stay globally
+            # attendable — they are the most recent `decode_window`
+            # positions, always inside any real sliding window (asserted
+            # at engine init: sliding_window > decode_window)
+            hist_mask_local = hist_mask & (
+                arange > (positions - cfg.sliding_window)[:, None]
+            )
 
     for i in range(cfg.num_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
@@ -490,14 +514,19 @@ def decode_window_step(
             staged = staged.at[i, 0, step_k].set(k[:, 0].astype(staged.dtype))
             staged = staged.at[i, 1, step_k].set(v[:, 0].astype(staged.dtype))
             if backend == "xla":
+                h_mask = (
+                    hist_mask_local
+                    if cfg.layer_sliding(i)
+                    else hist_mask
+                )
                 if hists is not None:
                     return attention_with_hist(
-                        q, hists[i][0], hists[i][1], hist_mask,
+                        q, hists[i][0], hists[i][1], h_mask,
                         staged[i, 0], staged[i, 1], staged_mask,
                         scale=hd**-0.5,
                     )
                 return paged_attention_with_staged(
-                    q, kv_caches[i], block_tables, hist_mask,
+                    q, kv_caches[i], block_tables, h_mask,
                     staged[i, 0], staged[i, 1], staged_mask, scale=hd**-0.5,
                 )
             if mesh is not None and mesh.size > 1:
@@ -552,13 +581,19 @@ def embed_encode(
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
     x = _embed(cfg, params, token_ids)
     mask = causal_page_mask(positions, lengths, t)  # (B, T, T)
+    mask_local = (
+        causal_page_mask(positions, lengths, t, window=cfg.sliding_window)
+        if cfg.any_sliding
+        else None
+    )
 
     for i in range(cfg.num_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
+        m = mask_local if cfg.layer_sliding(i) else mask
 
-        def attend(q, k, v):
+        def attend(q, k, v, m=m):
             return masked_attention(
-                q, k, v, mask, scale=cfg.head_dim**-0.5
+                q, k, v, m, scale=cfg.head_dim**-0.5
             )
 
         x = _layer_body(cfg, lp, x, positions, attend)

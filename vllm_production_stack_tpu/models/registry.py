@@ -150,6 +150,13 @@ def _from_hf_config(path: str) -> dict:
         else {}
     )
     qwen3 = dict(qk_norm=True) if arch == "qwen3" else {}
+    # sliding-window attention: Mistral-7B-v0.1 sets sliding_window=4096
+    # on every layer (v0.2+ configs carry null). Silently serving full
+    # attention would give wrong numerics past the window.
+    sw = {}
+    if "MistralForCausalLM" in archs and hf.get("sliding_window"):
+        sw = dict(sliding_window=int(hf["sliding_window"]),
+                  sliding_window_pattern=1)
     # RoPE scaling (Llama-3.1-class checkpoints — the reference's headline
     # model ships rope_scaling rope_type=llama3): silently ignoring it
     # would serve subtly wrong long-range positions, so unknown types are
@@ -177,6 +184,7 @@ def _from_hf_config(path: str) -> dict:
         **moe,
         **gemma,
         **qwen3,
+        **sw,
         **scaling,
         model=path,
         architecture=arch,

@@ -159,18 +159,24 @@ def gather_pages(kv: jax.Array, block_tables: jax.Array) -> tuple[jax.Array, jax
 
 
 def causal_page_mask(
-    q_positions: jax.Array, context_lens: jax.Array, s: int
+    q_positions: jax.Array, context_lens: jax.Array, s: int,
+    window: int = 0,
 ) -> jax.Array:
     """(B, T, S) mask: gathered-context position j is attendable by the query
-    at logical position p iff j < context_len and j <= p. Layer-invariant —
-    build it once per step and reuse across the layer scan.
+    at logical position p iff j < context_len and j <= p — and, when
+    `window` > 0 (sliding-window attention: Mistral-v0.1 all-layer SWA,
+    Gemma-2 alternating layers), additionally j > p - window. Build once
+    per step per window kind and reuse across same-kind layers.
 
     q_positions: (B, T); context_lens: (B,); s: gathered context length.
     """
     ctx_pos = jnp.arange(s, dtype=jnp.int32)[None, :]  # (1, S)
     valid = ctx_pos < context_lens[:, None]  # (B, S)
     causal = ctx_pos[:, None, :] <= q_positions[..., None]  # (B, T, S)
-    return valid[:, None, :] & causal
+    mask = valid[:, None, :] & causal
+    if window > 0:
+        mask &= ctx_pos[:, None, :] > q_positions[..., None] - window
+    return mask
 
 
 # context length above which masked_attention switches to the chunked
