@@ -325,3 +325,65 @@ def test_mistral_sliding_window_checkpoint(tmp_path):
                 decode_window=8,
             ),
         ))
+
+
+def test_gemma2_checkpoint_full_conventions(tmp_path):
+    """Gemma-2: sandwich norms (4 per layer), attention-score + final-logit
+    tanh softcaps, query_pre_attn_scalar scaling, alternating sliding
+    window — all at once against HF eager. Tiny caps/window/scalar are
+    chosen so every mechanism measurably bites."""
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    torch.manual_seed(88)
+    hf_cfg = Gemma2Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=10000.0, rms_norm_eps=1e-6,
+        max_position_embeddings=256, tie_word_embeddings=True,
+        sliding_window=8, query_pre_attn_scalar=13,
+        attn_logit_softcapping=5.0, final_logit_softcapping=3.0,
+        attn_implementation="eager", torch_dtype="float32",
+    )
+    model = Gemma2ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg = resolve_model_config(str(tmp_path), max_model_len=256,
+                               dtype="float32")
+    assert cfg.architecture == "gemma2"
+    assert cfg.sandwich_norms and cfg.rms_norm_add_one
+    assert cfg.attn_logit_softcap == 5.0 and cfg.final_logit_softcap == 3.0
+    assert cfg.query_pre_attn_scalar == 13
+    assert cfg.sliding_window == 8 and cfg.sliding_window_pattern == 2
+    assert cfg.layer_sliding(0) and not cfg.layer_sliding(1)
+
+    params = load_checkpoint_params(cfg)
+    tokens = list(np.random.RandomState(14).randint(0, 512, size=40))
+    ours = _jax_prefill_logits(cfg, params, tokens)
+    with torch.no_grad():
+        theirs = model(torch.tensor([tokens])).logits[0].numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=3e-4, atol=3e-4)
+
+    # engine path: greedy ids through the fused decode window
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    engine = LLMEngine(EngineConfig(
+        model=cfg,
+        cache=CacheConfig(block_size=8, num_blocks=64),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, max_num_batched_tokens=32,
+            prefill_buckets=(16, 32), decode_buckets=(2,), decode_window=4,
+        ),
+    ))
+    got = engine.generate(
+        [tokens], SamplingParams(max_tokens=8, temperature=0.0,
+                                 ignore_eos=True),
+    )[0]["token_ids"]
+    with torch.no_grad():
+        want = model.generate(
+            torch.tensor([tokens]), max_new_tokens=8, do_sample=False,
+        )[0][len(tokens):].tolist()
+    assert got == want, (got, want)

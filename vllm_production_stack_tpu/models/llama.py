@@ -94,6 +94,10 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> dict:
     if cfg.qk_norm:
         params["layers"]["attn"]["q_norm"] = jnp.ones((L, hd), dt)
         params["layers"]["attn"]["k_norm"] = jnp.ones((L, hd), dt)
+    if cfg.sandwich_norms:
+        init = jnp.zeros if cfg.rms_norm_add_one else jnp.ones
+        params["layers"]["attn_out_norm"] = init((L, h), dt)
+        params["layers"]["ffw_out_norm"] = init((L, h), dt)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = w(next(keys), h, cfg.vocab_size, scale=0.02)
     return params
@@ -266,7 +270,12 @@ def _layer_body(
     v = v.reshape(b, t, nkv, hd)
 
     attn = attend(q, k, v).reshape(b, t, nh * hd)
-    x = res + proj(attn, ap["wo"], "o_proj")
+    attn_out = proj(attn, ap["wo"], "o_proj")
+    if cfg.sandwich_norms:
+        # Gemma-2 layout: norm the attention OUTPUT before the residual
+        attn_out = rms_norm(attn_out, lp["attn_out_norm"],
+                            cfg.rms_norm_eps, cfg.rms_norm_add_one)
+    x = res + attn_out
 
     res = x
     x = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
@@ -276,7 +285,11 @@ def _layer_body(
     inner = _activation(cfg)(proj(x, mp["gate"], "gate_proj")) * proj(
         x, mp["up"], "up_proj"
     )
-    return res + proj(inner, mp["down"], "down_proj")
+    mlp_out = proj(inner, mp["down"], "down_proj")
+    if cfg.sandwich_norms:
+        mlp_out = rms_norm(mlp_out, lp["ffw_out_norm"],
+                           cfg.rms_norm_eps, cfg.rms_norm_add_one)
+    return res + mlp_out
 
 
 def _moe_mlp(cfg: ModelConfig, mp: dict, x: jax.Array) -> jax.Array:
@@ -357,17 +370,18 @@ def _layer(
                 return paged_prefill_attention_sharded(
                     mesh, q, kv_layer, block_tables,
                     pallas_prefill["context_lens"],
-                    pallas_prefill["chunk_start"], scale=hd**-0.5,
+                    pallas_prefill["chunk_start"], scale=cfg.attn_scale,
                     interpret=pallas_prefill["interpret"],
                 )
             return paged_prefill_attention(
                 q, kv_layer, block_tables,
                 pallas_prefill["context_lens"],
-                pallas_prefill["chunk_start"], scale=hd**-0.5,
+                pallas_prefill["chunk_start"], scale=cfg.attn_scale,
                 interpret=pallas_prefill["interpret"],
             )
         return paged_attention_xla(
-            q, kv_layer, block_tables, mask, scale=hd**-0.5
+            q, kv_layer, block_tables, mask, scale=cfg.attn_scale,
+            softcap=cfg.attn_logit_softcap,
         )
 
     x = _layer_body(cfg, lp, x, positions, attend, lora, lora_idx)
@@ -523,23 +537,34 @@ def decode_window_step(
                     return attention_with_hist(
                         q, hists[i][0], hists[i][1], h_mask,
                         staged[i, 0], staged[i, 1], staged_mask,
-                        scale=hd**-0.5,
+                        scale=cfg.attn_scale,
+                        softcap=cfg.attn_logit_softcap,
                     )
                 return paged_attention_with_staged(
                     q, kv_caches[i], block_tables, h_mask,
-                    staged[i, 0], staged[i, 1], staged_mask, scale=hd**-0.5,
+                    staged[i, 0], staged[i, 1], staged_mask,
+                    scale=cfg.attn_scale,
+                    softcap=cfg.attn_logit_softcap,
+                )
+            if cfg.any_sliding or cfg.attn_logit_softcap:
+                # self-enforcing invariant (the runner gates these models
+                # to XLA): the decode kernel has no window masking or
+                # softcap — silently wrong numerics otherwise
+                raise NotImplementedError(
+                    "pallas decode does not support sliding-window or "
+                    "softcapped models"
                 )
             if mesh is not None and mesh.size > 1:
                 # pallas_call has no GSPMD partition rule — shard_map over
                 # (dp, tp) places one kernel instance per device
                 return paged_decode_attention_sharded(
                     mesh, q[:, 0], kv_caches[i], block_tables, hist_len,
-                    staged[i, 0], staged[i, 1], step_k, scale=hd**-0.5,
+                    staged[i, 0], staged[i, 1], step_k, scale=cfg.attn_scale,
                     interpret=backend == "pallas_interpret",
                 )[:, None]
             return paged_decode_attention(
                 q[:, 0], kv_caches[i], block_tables, hist_len,
-                staged[i, 0], staged[i, 1], step_k, scale=hd**-0.5,
+                staged[i, 0], staged[i, 1], step_k, scale=cfg.attn_scale,
                 interpret=backend == "pallas_interpret",
             )[:, None]
 
@@ -593,7 +618,8 @@ def embed_encode(
 
         def attend(q, k, v, m=m):
             return masked_attention(
-                q, k, v, m, scale=cfg.head_dim**-0.5
+                q, k, v, m, scale=cfg.attn_scale,
+                softcap=cfg.attn_logit_softcap,
             )
 
         x = _layer_body(cfg, lp, x, positions, attend)
@@ -649,7 +675,7 @@ def forward_sp_prefill(
             hist_k = hist_k.astype(q.dtype)
             hist_v = hist_v.astype(q.dtype)
             out = ring_attention(
-                mesh, q, k, v, positions, kv_valid, scale=hd**-0.5,
+                mesh, q, k, v, positions, kv_valid, scale=cfg.attn_scale,
                 hist_k=hist_k, hist_v=hist_v, hist_len=hist_lens,
             )
             new_kv.append(
@@ -711,7 +737,12 @@ def forward_context_parallel(
 
 
 def compute_logits(cfg: ModelConfig, params: dict, hidden: jax.Array) -> jax.Array:
-    """hidden: (N, h) -> logits (N, vocab) in float32."""
+    """hidden: (N, h) -> logits (N, vocab) in float32 (Gemma-2 applies a
+    final tanh softcap)."""
     if cfg.tie_word_embeddings:
-        return (hidden @ params["embed"].T).astype(jnp.float32)
-    return _mm(hidden, params["lm_head"]).astype(jnp.float32)
+        logits = (hidden @ params["embed"].T).astype(jnp.float32)
+    else:
+        logits = _mm(hidden, params["lm_head"]).astype(jnp.float32)
+    from ..ops.attention import _softcap
+
+    return _softcap(logits, cfg.final_logit_softcap)

@@ -125,7 +125,13 @@ def load_checkpoint_params(cfg: ModelConfig) -> dict:
             },
             mlp_key: mlp,
             "input_norm": stack(p + "input_layernorm.weight", vec),
-            "post_attn_norm": stack(p + "post_attention_layernorm.weight", vec),
+            # Gemma-2 sandwich layout: our pre-MLP norm slot maps to HF
+            # pre_feedforward_layernorm; HF's post_attention_layernorm is
+            # the attention-OUTPUT norm (attn_out_norm below)
+            "post_attn_norm": stack(
+                p + ("pre_feedforward_layernorm.weight"
+                     if cfg.sandwich_norms
+                     else "post_attention_layernorm.weight"), vec),
         },
         "final_norm": vec("model.norm.weight"),
     }
@@ -138,6 +144,11 @@ def load_checkpoint_params(cfg: ModelConfig) -> dict:
             p + "self_attn.q_norm.weight", vec)
         params["layers"]["attn"]["k_norm"] = stack(
             p + "self_attn.k_norm.weight", vec)
+    if cfg.sandwich_norms:
+        params["layers"]["attn_out_norm"] = stack(
+            p + "post_attention_layernorm.weight", vec)
+        params["layers"]["ffw_out_norm"] = stack(
+            p + "post_feedforward_layernorm.weight", vec)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = mat("lm_head.weight")
     logger.info(

@@ -74,6 +74,17 @@ PRESETS: dict[str, dict] = {
         hidden_act="gelu_tanh", rms_norm_add_one=True, scale_embeddings=True,
         tie_word_embeddings=True, rms_norm_eps=1e-6,
     ),
+    "gemma2-9b": dict(
+        vocab_size=256000, hidden_size=3584, intermediate_size=14336,
+        num_layers=42, num_heads=16, num_kv_heads=8, head_dim=256,
+        max_model_len=8192, rope_theta=10000.0, architecture="gemma2",
+        hidden_act="gelu_tanh", rms_norm_add_one=True, scale_embeddings=True,
+        tie_word_embeddings=True, rms_norm_eps=1e-6, sandwich_norms=True,
+        attn_logit_softcap=50.0, final_logit_softcap=30.0,
+        query_pre_attn_scalar=224,  # hidden/heads = 3584/16 (NOT head_dim)
+        sliding_window=4096,
+        sliding_window_pattern=2,
+    ),
     "mixtral-8x7b": dict(
         vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
@@ -95,6 +106,7 @@ _ARCH_MAP = {
     "Qwen3ForCausalLM": "qwen3",
     "MixtralForCausalLM": "mixtral",
     "GemmaForCausalLM": "gemma",
+    "Gemma2ForCausalLM": "gemma2",
 }
 
 
@@ -149,6 +161,18 @@ def _from_hf_config(path: str) -> dict:
         if arch == "gemma"
         else {}
     )
+    if arch == "gemma2":
+        gemma = dict(
+            hidden_act="gelu_tanh", rms_norm_add_one=True,
+            scale_embeddings=True, sandwich_norms=True,
+            attn_logit_softcap=float(hf.get("attn_logit_softcapping") or 0),
+            final_logit_softcap=float(
+                hf.get("final_logit_softcapping") or 0
+            ),
+            query_pre_attn_scalar=int(hf.get("query_pre_attn_scalar") or 0),
+            sliding_window=int(hf.get("sliding_window") or 0),
+            sliding_window_pattern=2,  # HF layer_types: even layers slide
+        )
     qwen3 = dict(qk_norm=True) if arch == "qwen3" else {}
     # sliding-window attention: Mistral-7B-v0.1 sets sliding_window=4096
     # on every layer (v0.2+ configs carry null). Silently serving full
@@ -202,7 +226,9 @@ def _from_hf_config(path: str) -> dict:
         max_model_len=hf.get("max_position_embeddings", 4096),
         # Gemma ties by default and HF omits class-default fields from
         # config.json, so the fallback is architecture-dependent
-        tie_word_embeddings=hf.get("tie_word_embeddings", arch == "gemma"),
+        tie_word_embeddings=hf.get(
+            "tie_word_embeddings", arch in ("gemma", "gemma2")
+        ),
         attention_bias=hf.get("attention_bias", arch == "qwen2"),
         checkpoint=path,
         tokenizer=path,

@@ -186,6 +186,13 @@ def causal_page_mask(
 FLASH_CHUNK = 2048
 
 
+def _softcap(scores: jax.Array, cap: float) -> jax.Array:
+    """Gemma-2 attention-logit softcapping: cap * tanh(scores / cap),
+    applied AFTER the scale, BEFORE the mask (HF eager_attention_forward
+    order)."""
+    return jnp.tanh(scores / cap) * cap if cap else scores
+
+
 def masked_attention(
     q: jax.Array,
     keys: jax.Array,
@@ -193,6 +200,7 @@ def masked_attention(
     mask: jax.Array,
     *,
     scale: float,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """GQA attention over already-contiguous keys/values.
 
@@ -213,7 +221,8 @@ def masked_attention(
             keys = jnp.pad(keys, ((0, 0), (0, pad), (0, 0), (0, 0)))
             values = jnp.pad(values, ((0, 0), (0, pad), (0, 0), (0, 0)))
             mask = jnp.pad(mask, ((0, 0), (0, 0), (0, pad)))
-        return _flash_masked_attention(qg, keys, values, mask, scale=scale)
+        return _flash_masked_attention(qg, keys, values, mask, scale=scale,
+                                       softcap=softcap)
     # scores accumulate in f32 but Q/K stream through the MXU in their native
     # dtype — casting bf16 operands to f32 first would double the HBM traffic
     # of the K read AND fall off the bf16 systolic path (f32 models, i.e. the
@@ -223,6 +232,7 @@ def masked_attention(
         "btkgd,bskd->bkgts", qg, keys, preferred_element_type=jnp.float32
     )
     scores *= scale
+    scores = _softcap(scores, softcap)
     scores = jnp.where(mask[:, None, None], scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
@@ -241,6 +251,7 @@ def _flash_masked_attention(
     mask: jax.Array,  # (B, T, S)
     *,
     scale: float,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Online-softmax over S chunks (lax.scan): peak score memory is one
     (B, kvH, qpk, T, FLASH_CHUNK) block instead of the full S axis. Same
@@ -261,6 +272,7 @@ def _flash_masked_attention(
         scores = jnp.einsum(
             "btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32
         ) * scale
+        scores = _softcap(scores, softcap)
         scores = jnp.where(msk[:, None, None], scores, NEG_INF)
         m_cur = jnp.max(scores, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -291,6 +303,7 @@ def paged_attention_xla(
     mask: jax.Array,
     *,
     scale: float,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Causal attention of queries against the paged KV cache.
 
@@ -305,7 +318,8 @@ def paged_attention_xla(
     returns: (B, T, num_heads, D)
     """
     keys, values = gather_pages(kv, block_tables)  # (B, S, kvH, D)
-    return masked_attention(q, keys, values, mask, scale=scale)
+    return masked_attention(q, keys, values, mask, scale=scale,
+                            softcap=softcap)
 
 
 def paged_attention_with_staged(
@@ -318,6 +332,7 @@ def paged_attention_with_staged(
     staged_mask: jax.Array,
     *,
     scale: float,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Decode-window attention: pooled history + this window's staged KV.
 
@@ -338,7 +353,7 @@ def paged_attention_with_staged(
     hist_k, hist_v = gather_pages(kv, block_tables)  # (B, S, kvH, D)
     return attention_with_hist(
         q, hist_k, hist_v, hist_mask, staged_k, staged_v, staged_mask,
-        scale=scale,
+        scale=scale, softcap=softcap,
     )
 
 
@@ -352,6 +367,7 @@ def attention_with_hist(
     staged_mask: jax.Array,
     *,
     scale: float,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Decode-window attention against ALREADY-CONTIGUOUS history + staged
     window KV. The pool gather that produces hist_k/hist_v is loop-invariant
@@ -382,6 +398,7 @@ def attention_with_hist(
         preferred_element_type=jnp.float32,
     )
     scores = jnp.concatenate([hist_scores, st_scores], axis=-1) * scale
+    scores = _softcap(scores, softcap)
     s = hist_k.shape[1]
     mask = jnp.concatenate(
         [

@@ -80,6 +80,12 @@ def llama_param_specs(cfg: ModelConfig) -> dict:
             mlp_key: mlp,
             "input_norm": P(PP_AXIS, None),
             "post_attn_norm": P(PP_AXIS, None),
+            **(
+                {"attn_out_norm": P(PP_AXIS, None),
+                 "ffw_out_norm": P(PP_AXIS, None)}
+                if cfg.sandwich_norms
+                else {}
+            ),
         },
         "final_norm": P(None),
     }
