@@ -20,3 +20,26 @@ def wait_port(port: int, timeout: float = 30.0) -> None:
         except OSError:
             time.sleep(0.3)
     raise TimeoutError(f"port {port} never opened")
+
+
+def wait_http(url: str, timeout: float = 60.0, proc=None) -> None:
+    """Poll an HTTP endpoint until 200 — failing FAST (with the exit
+    code) if a watched subprocess dies first instead of spinning against
+    a dead port."""
+    import urllib.request
+
+    deadline = time.monotonic() + timeout
+    last: Exception | None = None
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"process exited rc={proc.returncode} before {url} healthy"
+            )
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                if r.status == 200:
+                    return
+        except Exception as e:  # noqa: BLE001 — retried until deadline
+            last = e
+        time.sleep(0.5)
+    raise TimeoutError(f"{url} not up: {last}")

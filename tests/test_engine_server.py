@@ -590,3 +590,86 @@ def test_echo_completions(srv):
     assert ns["choices"][0]["text"].startswith("abc")
     assert first_text == "abc"  # stream leads with the echoed prompt
     assert bad == 400
+
+
+def test_n_choices_stream_disconnect_aborts_all(tmp_path):
+    """Client drops an n=2 stream mid-generation: task cancellation must
+    reach generate()'s cleanup and abort BOTH engine-side requests (no
+    abort-by-derived-name — _submit renames colliding ids), so the engine
+    drains to zero running requests instead of decoding to max_tokens on
+    orphaned KV. Real processes: the abort path crosses the HTTP
+    connection teardown, which the in-process TestClient can't model."""
+    import os
+    import pathlib
+    import re
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    from netutil import free_port, wait_http
+
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    port = free_port()
+    log = open(tmp_path / "engine.log", "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "vllm_production_stack_tpu.engine.server",
+         "--port", str(port), "--model", "tiny-llama",
+         "--max-model-len", "256", "--max-num-seqs", "4",
+         "--max-num-batched-tokens", "64", "--prefill-buckets", "32,64",
+         "--decode-buckets", "4", "--decode-window", "2",
+         "--compilation-cache-dir", ""],
+        cwd=repo, env=env, stdout=log, stderr=subprocess.STDOUT)
+    try:
+        wait_http(f"http://127.0.0.1:{port}/health", timeout=240, proc=proc)
+
+        # raw socket so the disconnect is a hard TCP close mid-stream
+        s = socket.create_connection(("127.0.0.1", port), timeout=30)
+        body = (b'{"model": "tiny-llama", "prompt": [5, 6, 7], '
+                b'"max_tokens": 200, "temperature": 0.0, '
+                b'"ignore_eos": true, "n": 2, "stream": true}')
+        s.sendall(
+            b"POST /v1/completions HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\nContent-Length: "
+            + str(len(body)).encode() + b"\r\n\r\n" + body
+        )
+        # wait for an actual SSE DATA chunk, not just response headers:
+        # the requests must be ADMITTED (running > 0) before the
+        # disconnect, or the drain loop below could observe a transient
+        # pre-admission 0 and pass vacuously / flake
+        buf = b""
+        s.settimeout(60)
+        while b"data: " not in buf:
+            chunk = s.recv(4096)
+            assert chunk, "stream closed before first token"
+            buf += chunk
+        s.close()  # hard disconnect mid-stream
+
+        def running() -> float:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ) as r:
+                text = r.read().decode()
+            m = re.search(
+                r'tpu:num_requests_running\{[^}]*\} ([0-9.]+)', text
+            )
+            return float(m.group(1)) if m else -1.0
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if running() == 0.0:
+                break
+            time.sleep(1)
+        assert running() == 0.0, "engine still decoding orphaned requests"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        log.close()
