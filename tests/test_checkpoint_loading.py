@@ -387,3 +387,73 @@ def test_gemma2_checkpoint_full_conventions(tmp_path):
             torch.tensor([tokens]), max_new_tokens=8, do_sample=False,
         )[0][len(tokens):].tolist()
     assert got == want, (got, want)
+
+
+def test_phi3_checkpoint_fused_weights_and_window(tmp_path):
+    """Phi-3: fused qkv_proj / gate_up_proj split on load (row-stacked
+    q,k,v and gate,up on the HF out axis) plus the all-layer sliding
+    window the mini-4k config ships. Logits and engine greedy must match
+    HF eager; a longrope variant must refuse loudly (unsupported
+    rope_scaling type), not serve wrong positions."""
+    import json
+
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    torch.manual_seed(99)
+    hf_cfg = Phi3Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        rms_norm_eps=1e-5, tie_word_embeddings=False, sliding_window=8,
+        pad_token_id=0, attn_implementation="eager",
+        torch_dtype="float32",
+    )
+    model = Phi3ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg = resolve_model_config(str(tmp_path), max_model_len=256,
+                               dtype="float32")
+    assert cfg.architecture == "phi3"
+    assert cfg.sliding_window == 8 and cfg.sliding_window_pattern == 1
+    params = load_checkpoint_params(cfg)
+    tokens = list(np.random.RandomState(17).randint(0, 512, size=40))
+    ours = _jax_prefill_logits(cfg, params, tokens)
+    with torch.no_grad():
+        theirs = model(torch.tensor([tokens])).logits[0].numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    engine = LLMEngine(EngineConfig(
+        model=cfg,
+        cache=CacheConfig(block_size=8, num_blocks=64),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, max_num_batched_tokens=32,
+            prefill_buckets=(16, 32), decode_buckets=(2,), decode_window=4,
+        ),
+    ))
+    got = engine.generate(
+        [tokens], SamplingParams(max_tokens=8, temperature=0.0,
+                                 ignore_eos=True),
+    )[0]["token_ids"]
+    with torch.no_grad():
+        want = model.generate(
+            torch.tensor([tokens]), max_new_tokens=8, do_sample=False,
+        )[0][len(tokens):].tolist()
+    assert got == want, (got, want)
+
+    # a longrope (128k-class) config refuses instead of serving wrong
+    # long-range positions
+    cfg_path = tmp_path / "config.json"
+    raw = json.loads(cfg_path.read_text())
+    raw["rope_scaling"] = {
+        "type": "longrope", "short_factor": [1.0], "long_factor": [2.0],
+    }
+    cfg_path.write_text(json.dumps(raw))
+    with pytest.raises(ValueError, match="rope_scaling"):
+        resolve_model_config(str(tmp_path), max_model_len=256,
+                             dtype="float32")

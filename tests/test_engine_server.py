@@ -658,7 +658,8 @@ def test_n_choices_stream_disconnect_aborts_all(tmp_path):
             m = re.search(
                 r'tpu:num_requests_running\{[^}]*\} ([0-9.]+)', text
             )
-            return float(m.group(1)) if m else -1.0
+            assert m is not None, "num_requests_running metric missing"
+            return float(m.group(1))
 
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline:

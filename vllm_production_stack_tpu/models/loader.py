@@ -82,6 +82,10 @@ def load_checkpoint_params(cfg: ModelConfig) -> dict:
         return np.stack([kind(fmt.format(i)) for i in range(cfg.num_layers)])
 
     p = "model.layers.{}."
+    if cfg.num_experts and cfg.architecture == "phi3":
+        raise NotImplementedError(
+            "phi3-fused loading with MoE experts is not implemented"
+        )
     if cfg.num_experts:
         # Mixtral: block_sparse_moe.gate is the router; experts' w1/w3/w2
         # are gate/up/down. Expert matrices stack along a leading E axis
@@ -107,22 +111,53 @@ def load_checkpoint_params(cfg: ModelConfig) -> dict:
             "down": estack(ex + "w2.weight"),
         }
         mlp_key = "moe"
-    else:
+    elif cfg.architecture != "phi3":
         mlp = {
             "gate": stack(p + "mlp.gate_proj.weight", mat),
             "up": stack(p + "mlp.up_proj.weight", mat),
             "down": stack(p + "mlp.down_proj.weight", mat),
         }
         mlp_key = "mlp"
+    if cfg.architecture == "phi3":
+        # Phi-3 fuses q/k/v into qkv_proj (row-stacked q, k, v) and
+        # gate/up into gate_up_proj — split on the HF OUT axis (rows)
+        # before the (out, in) -> (in, out) transpose. Each fused tensor
+        # is read from disk ONCE per layer and sliced in memory.
+        nh_rows = cfg.num_heads * cfg.head_dim
+        nkv_rows = cfg.num_kv_heads * cfg.head_dim
+        it = cfg.intermediate_size
+        q_l, k_l, v_l, g_l, u_l = [], [], [], [], []
+        for i in range(cfg.num_layers):
+            qkv = ckpt.get(p.format(i) + "self_attn.qkv_proj.weight")
+            q_l.append(np.ascontiguousarray(qkv[:nh_rows].T).astype(dt))
+            k_l.append(np.ascontiguousarray(
+                qkv[nh_rows:nh_rows + nkv_rows].T).astype(dt))
+            v_l.append(np.ascontiguousarray(
+                qkv[nh_rows + nkv_rows:nh_rows + 2 * nkv_rows].T
+            ).astype(dt))
+            gu_w = ckpt.get(p.format(i) + "mlp.gate_up_proj.weight")
+            g_l.append(np.ascontiguousarray(gu_w[:it].T).astype(dt))
+            u_l.append(np.ascontiguousarray(gu_w[it:2 * it].T).astype(dt))
+        attn_tree = {
+            "wq": np.stack(q_l), "wk": np.stack(k_l), "wv": np.stack(v_l),
+            "wo": stack(p + "self_attn.o_proj.weight", mat),
+        }
+        mlp = {
+            "gate": np.stack(g_l), "up": np.stack(u_l),
+            "down": stack(p + "mlp.down_proj.weight", mat),
+        }
+        mlp_key = "mlp"
+    else:
+        attn_tree = {
+            "wq": stack(p + "self_attn.q_proj.weight", mat),
+            "wk": stack(p + "self_attn.k_proj.weight", mat),
+            "wv": stack(p + "self_attn.v_proj.weight", mat),
+            "wo": stack(p + "self_attn.o_proj.weight", mat),
+        }
     params: dict = {
         "embed": vec("model.embed_tokens.weight"),
         "layers": {
-            "attn": {
-                "wq": stack(p + "self_attn.q_proj.weight", mat),
-                "wk": stack(p + "self_attn.k_proj.weight", mat),
-                "wv": stack(p + "self_attn.v_proj.weight", mat),
-                "wo": stack(p + "self_attn.o_proj.weight", mat),
-            },
+            "attn": attn_tree,
             mlp_key: mlp,
             "input_norm": stack(p + "input_layernorm.weight", vec),
             # Gemma-2 sandwich layout: our pre-MLP norm slot maps to HF

@@ -104,6 +104,7 @@ _ARCH_MAP = {
     "MistralForCausalLM": "llama",
     "Qwen2ForCausalLM": "qwen2",
     "Qwen3ForCausalLM": "qwen3",
+    "Phi3ForCausalLM": "phi3",
     "MixtralForCausalLM": "mixtral",
     "GemmaForCausalLM": "gemma",
     "Gemma2ForCausalLM": "gemma2",
@@ -178,13 +179,24 @@ def _from_hf_config(path: str) -> dict:
     # on every layer (v0.2+ configs carry null). Silently serving full
     # attention would give wrong numerics past the window.
     sw = {}
-    if "MistralForCausalLM" in archs and hf.get("sliding_window"):
+    if (
+        ("MistralForCausalLM" in archs or arch == "phi3")
+        and hf.get("sliding_window")
+    ):
         sw = dict(sliding_window=int(hf["sliding_window"]),
                   sliding_window_pattern=1)
     # RoPE scaling (Llama-3.1-class checkpoints — the reference's headline
     # model ships rope_scaling rope_type=llama3): silently ignoring it
     # would serve subtly wrong long-range positions, so unknown types are
     # a hard error, not a warning
+    # partial rotary (Phi-4-mini class): unimplemented — refusing beats
+    # silently serving full-rotary numerics that diverge from HF
+    prf = hf.get("partial_rotary_factor")
+    if prf is not None and float(prf) != 1.0:
+        raise ValueError(
+            f"unsupported partial_rotary_factor {prf} in {path} "
+            "(only full rotary is implemented)"
+        )
     rs = hf.get("rope_scaling") or {}
     rs_type = rs.get("rope_type") or rs.get("type")
     if rs_type in (None, "default"):
