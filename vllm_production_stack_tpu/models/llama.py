@@ -309,7 +309,11 @@ def _moe_mlp(cfg: ModelConfig, mp: dict, x: jax.Array) -> jax.Array:
     logits = (x @ mp["router"]).astype(jnp.float32)  # (B, T, E)
     probs = jax.nn.softmax(logits, axis=-1)
     topv, topi = jax.lax.top_k(probs, k)
-    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    if cfg.norm_topk_prob:
+        # Mixtral (always) / Qwen3-MoE norm_topk_prob=true: the selected
+        # weights renormalize to sum to 1; otherwise they stay raw
+        # softmax mass (HF Qwen3MoeSparseMoeBlock's "only diff")
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
     # (B, T, E) combine weights: topv scattered back onto the expert axis
     w = jnp.sum(
         jax.nn.one_hot(topi, e, dtype=jnp.float32) * topv[..., None], axis=-2

@@ -103,13 +103,24 @@ def load_checkpoint_params(cfg: ModelConfig) -> dict:
                 ]
             )
 
-        ex = "model.layers.{0}.block_sparse_moe.experts.{1}."
-        mlp = {
-            "router": stack(p + "block_sparse_moe.gate.weight", mat),
-            "gate": estack(ex + "w1.weight"),
-            "up": estack(ex + "w3.weight"),
-            "down": estack(ex + "w2.weight"),
-        }
+        if cfg.architecture == "qwen3moe":
+            # Qwen3-MoE naming: mlp.gate router; experts carry
+            # gate_proj/up_proj/down_proj like dense layers
+            ex = "model.layers.{0}.mlp.experts.{1}."
+            mlp = {
+                "router": stack(p + "mlp.gate.weight", mat),
+                "gate": estack(ex + "gate_proj.weight"),
+                "up": estack(ex + "up_proj.weight"),
+                "down": estack(ex + "down_proj.weight"),
+            }
+        else:
+            ex = "model.layers.{0}.block_sparse_moe.experts.{1}."
+            mlp = {
+                "router": stack(p + "block_sparse_moe.gate.weight", mat),
+                "gate": estack(ex + "w1.weight"),
+                "up": estack(ex + "w3.weight"),
+                "down": estack(ex + "w2.weight"),
+            }
         mlp_key = "moe"
     elif cfg.architecture != "phi3":
         mlp = {

@@ -105,6 +105,7 @@ _ARCH_MAP = {
     "Qwen2ForCausalLM": "qwen2",
     "Qwen3ForCausalLM": "qwen3",
     "Phi3ForCausalLM": "phi3",
+    "Qwen3MoeForCausalLM": "qwen3moe",
     "MixtralForCausalLM": "mixtral",
     "GemmaForCausalLM": "gemma",
     "Gemma2ForCausalLM": "gemma2",
@@ -154,6 +155,24 @@ def _from_hf_config(path: str) -> dict:
         if arch == "mixtral"
         else {}
     )
+    if arch == "qwen3moe":
+        if hf.get("mlp_only_layers") or (hf.get("decoder_sparse_step", 1)
+                                         != 1):
+            raise ValueError(
+                f"qwen3moe with dense layers interleaved is not "
+                f"implemented ({path})"
+            )
+        moe = dict(
+            # HF use_diff serialization omits class-default fields —
+            # fall back to Qwen3MoeConfig's defaults (which are the
+            # published Qwen3-30B-A3B values)
+            num_experts=hf.get("num_experts", 128),
+            num_experts_per_tok=hf.get("num_experts_per_tok", 8),
+            norm_topk_prob=bool(hf.get("norm_topk_prob", False)),
+            # all layers are MoE: the expert inner width IS the
+            # intermediate size our expert tree uses
+            intermediate_size=hf.get("moe_intermediate_size", 768),
+        )
     gemma = (
         dict(
             hidden_act="gelu_tanh", rms_norm_add_one=True,
@@ -174,7 +193,9 @@ def _from_hf_config(path: str) -> dict:
             sliding_window=int(hf.get("sliding_window") or 0),
             sliding_window_pattern=2,  # HF layer_types: even layers slide
         )
-    qwen3 = dict(qk_norm=True) if arch == "qwen3" else {}
+    qwen3 = (
+        dict(qk_norm=True) if arch in ("qwen3", "qwen3moe") else {}
+    )
     # sliding-window attention: Mistral-7B-v0.1 sets sliding_window=4096
     # on every layer (v0.2+ configs carry null). Silently serving full
     # attention would give wrong numerics past the window.
@@ -216,6 +237,11 @@ def _from_hf_config(path: str) -> dict:
             f"unsupported rope_scaling type {rs_type!r} in {path} "
             "(supported: llama3, linear)"
         )
+    # dict() right-most wins: the explicit intermediate_size below would
+    # clobber a MoE-specific expert width, so hoist it first
+    inter = moe.pop("intermediate_size", None)
+    if inter is None:  # lazy: qwen3moe configs may omit the dense field
+        inter = hf["intermediate_size"]
     return dict(
         **moe,
         **gemma,
@@ -226,7 +252,7 @@ def _from_hf_config(path: str) -> dict:
         architecture=arch,
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],
-        intermediate_size=hf["intermediate_size"],
+        intermediate_size=inter,
         num_layers=hf["num_hidden_layers"],
         num_heads=heads,
         num_kv_heads=hf.get("num_key_value_heads", heads),
