@@ -134,3 +134,27 @@ def test_quantized_with_lora_and_sleep_wake():
                                  ignore_eos=True),
     )[0]["token_ids"]
     assert before == after
+
+
+def test_quantized_qwen3_serves():
+    """int8 + qk_norm compose (the qwen3-8b helm example's config): the
+    norm leaves stay unquantized pass-throughs in both the param tree and
+    the sharding spec, and the engine serves greedily."""
+    from vllm_production_stack_tpu.models.quantization import quantize_specs
+    from vllm_production_stack_tpu.parallel.sharding import llama_param_specs
+
+    cfg = _cfg(architecture="qwen3", qk_norm=True)
+    specs = quantize_specs(cfg, llama_param_specs(cfg))
+    assert set(specs["layers"]["attn"]["wq"].keys()) == {"q", "s"}
+    assert not isinstance(specs["layers"]["attn"]["q_norm"], dict)
+
+    engine = LLMEngine(EngineConfig.tiny().replace(model=cfg))
+    attn = engine.runner.params["layers"]["attn"]
+    assert set(attn["wq"].keys()) == {"q", "s"}  # quantized
+    assert not isinstance(attn["q_norm"], dict)  # NOT quantized
+    prompts = [list(np.random.RandomState(3).randint(1, 512, size=24))]
+    out = engine.generate(
+        prompts, SamplingParams(max_tokens=6, temperature=0.0,
+                                ignore_eos=True),
+    )
+    assert len(out[0]["token_ids"]) == 6
