@@ -60,6 +60,8 @@ def test_chat_completion(srv):
     status, body = run_with_client(srv, go)
     assert status == 200
     assert body["object"] == "chat.completion"
+    # OpenAI system_fingerprint = the engine's serving-config identity
+    assert body["system_fingerprint"].startswith("fp_")
     assert body["choices"][0]["finish_reason"] == "length"
     assert body["usage"]["completion_tokens"] == 5
     assert body["usage"]["prompt_tokens"] > 0

@@ -77,6 +77,10 @@ class EngineServer:
         self._session = None  # lazy outbound ClientSession (kv_pull)
         self._tok_repr_cache: dict[int, tuple[str, list[int]]] = {}
         self._start_time = time.time()
+        # OpenAI system_fingerprint: identifies the serving configuration
+        # whose outputs a seed reproduces — our model fingerprint (weights
+        # + seed + kv dtype) is exactly that identity
+        self.system_fingerprint = "fp_" + engine.model_fingerprint[:12]
 
     @property
     def lora_adapters(self) -> dict[str, str]:
@@ -623,6 +627,7 @@ class EngineServer:
                 "object": "chat.completion" if chat else "text_completion",
                 "created": created,
                 "model": self.model_name,
+                "system_fingerprint": self.system_fingerprint,
                 "choices": choices,
                 # prompt counted once; completion tokens sum over choices
                 "usage": usage(
@@ -809,6 +814,7 @@ class EngineServer:
             "object": obj,
             "created": created,
             "model": self.model_name,
+            "system_fingerprint": self.system_fingerprint,
             "choices": [choice],
         }
 
