@@ -457,3 +457,59 @@ def test_phi3_checkpoint_fused_weights_and_window(tmp_path):
     with pytest.raises(ValueError, match="rope_scaling"):
         resolve_model_config(str(tmp_path), max_model_len=256,
                              dtype="float32")
+
+
+def test_olmo2_checkpoint_post_norms_and_flat_qk(tmp_path):
+    """OLMo-2: post-norm-only layout (attention/MLP consume the raw
+    residual stream; only their outputs are normed) and RMSNorm over the
+    FLAT q/k projections before the head reshape. Logits + engine greedy
+    vs HF eager."""
+    from transformers import Olmo2Config, Olmo2ForCausalLM
+
+    torch.manual_seed(111)
+    hf_cfg = Olmo2Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, pad_token_id=0,
+        attn_implementation="eager", torch_dtype="float32",
+    )
+    model = Olmo2ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg = resolve_model_config(str(tmp_path), max_model_len=256,
+                               dtype="float32")
+    assert cfg.architecture == "olmo2"
+    assert cfg.post_norms_only and cfg.qk_norm_flat and not cfg.qk_norm
+    params = load_checkpoint_params(cfg)
+    assert "input_norm" not in params["layers"]
+    assert params["layers"]["attn"]["q_norm"].shape[-1] == 4 * 16  # flat
+    tokens = list(np.random.RandomState(23).randint(0, 512, size=35))
+    ours = _jax_prefill_logits(cfg, params, tokens)
+    with torch.no_grad():
+        theirs = model(torch.tensor([tokens])).logits[0].numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+
+    engine = LLMEngine(EngineConfig(
+        model=cfg,
+        cache=CacheConfig(block_size=8, num_blocks=64),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, max_num_batched_tokens=32,
+            prefill_buckets=(16, 32), decode_buckets=(2,), decode_window=4,
+        ),
+    ))
+    got = engine.generate(
+        [tokens], SamplingParams(max_tokens=8, temperature=0.0,
+                                 ignore_eos=True),
+    )[0]["token_ids"]
+    with torch.no_grad():
+        want = model.generate(
+            torch.tensor([tokens]), max_new_tokens=8, do_sample=False,
+        )[0][len(tokens):].tolist()
+    assert got == want, (got, want)

@@ -248,3 +248,41 @@ def test_sliding_window_mask_and_pattern():
     out_alt, _, _ = run_jax_prefill(cfg_alt, params, tokens)
     assert np.abs(out_alt - out_full).max() > 1e-3  # layer 0 slides
     assert np.abs(out_alt - out_all).max() > 1e-3  # layer 1 stays full
+
+
+def test_rms_norm_orderings_match_hf_in_bf16():
+    """The three RMSNorm weight-multiply orderings differ by ulps in
+    bf16 and each must match its HF reference bitwise: Llama
+    (downcast-then-scale), Gemma add_one and OLMo-2 scale_f32 (both
+    f32-scale-then-downcast)."""
+    import ml_dtypes
+
+    rng = np.random.RandomState(31)
+    x32 = rng.randn(4, 64).astype(np.float32) * 3
+    w32 = (rng.randn(64).astype(np.float32) * 0.5 + 1.0)
+    x_bf = jnp.asarray(x32).astype(jnp.bfloat16)
+    w_bf = jnp.asarray(w32).astype(jnp.bfloat16)
+
+    def torch_ref(scale_f32):
+        xt = torch.from_numpy(x32).to(torch.bfloat16)
+        wt = torch.from_numpy(w32).to(torch.bfloat16)
+        h = xt.to(torch.float32)
+        var = h.pow(2).mean(-1, keepdim=True)
+        h = h * torch.rsqrt(var + 1e-5)
+        if scale_f32:  # Olmo2RMSNorm
+            out = (wt * h).to(torch.bfloat16)
+        else:  # LlamaRMSNorm
+            out = wt * h.to(torch.bfloat16)
+        return out.to(torch.float32).numpy()
+
+    ours_llama = np.asarray(
+        llama.rms_norm(x_bf, w_bf, 1e-5).astype(jnp.float32)
+    )
+    ours_olmo = np.asarray(
+        llama.rms_norm(x_bf, w_bf, 1e-5, scale_f32=True).astype(jnp.float32)
+    )
+    np.testing.assert_array_equal(ours_llama, torch_ref(False))
+    np.testing.assert_array_equal(ours_olmo, torch_ref(True))
+    # the orderings genuinely differ in bf16 (guards against a silent
+    # collapse of the two paths)
+    assert (ours_llama != ours_olmo).any()

@@ -94,28 +94,40 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> dict:
     if cfg.qk_norm:
         params["layers"]["attn"]["q_norm"] = jnp.ones((L, hd), dt)
         params["layers"]["attn"]["k_norm"] = jnp.ones((L, hd), dt)
-    if cfg.sandwich_norms:
+    if cfg.qk_norm_flat:
+        params["layers"]["attn"]["q_norm"] = jnp.ones((L, nh * hd), dt)
+        params["layers"]["attn"]["k_norm"] = jnp.ones((L, nkv * hd), dt)
+    if cfg.sandwich_norms or cfg.post_norms_only:
         init = jnp.zeros if cfg.rms_norm_add_one else jnp.ones
         params["layers"]["attn_out_norm"] = init((L, h), dt)
         params["layers"]["ffw_out_norm"] = init((L, h), dt)
+    if cfg.post_norms_only:
+        # olmo2 carries NO pre-norms at all
+        del params["layers"]["input_norm"]
+        del params["layers"]["post_attn_norm"]
     if not cfg.tie_word_embeddings:
         params["lm_head"] = w(next(keys), h, cfg.vocab_size, scale=0.02)
     return params
 
 
 def rms_norm(
-    x: jax.Array, weight: jax.Array, eps: float, add_one: bool = False
+    x: jax.Array, weight: jax.Array, eps: float, add_one: bool = False,
+    scale_f32: bool = False,
 ) -> jax.Array:
     """Llama convention: normalize, cast to input dtype, scale by weight.
     Gemma (add_one): weights are stored as (w - 1) and the scale by (1 + w)
-    happens in float32 BEFORE the downcast — both match their HF reference
-    bit-for-bit in f32."""
+    happens in float32 BEFORE the downcast. OLMo-2 (scale_f32): plain
+    weights, but the multiply ALSO happens in float32 before the downcast
+    (Olmo2RMSNorm) — in bf16 these orderings differ by ulps, and each
+    matches its HF reference bit-for-bit."""
     dt = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jax.lax.rsqrt(var + eps)
     if add_one:
         return (normed * (1.0 + weight.astype(jnp.float32))).astype(dt)
+    if scale_f32:
+        return (normed * weight.astype(jnp.float32)).astype(dt)
     return normed.astype(dt) * weight
 
 
@@ -252,13 +264,21 @@ def _layer_body(
             return _mm(xin, w)
 
     res = x
-    x = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
+    if not cfg.post_norms_only:
+        x = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps,
+                     cfg.rms_norm_add_one)
     ap = lp["attn"]
     q = proj(x, ap["wq"], "q_proj")
     k = proj(x, ap["wk"], "k_proj")
     v = proj(x, ap["wv"], "v_proj")
     if cfg.attention_bias:
         q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
+    if cfg.qk_norm_flat:
+        # olmo2: RMSNorm over the whole flat projection, pre-reshape
+        q = rms_norm(q, ap["q_norm"], cfg.rms_norm_eps,
+                     scale_f32=cfg.norm_scale_f32)
+        k = rms_norm(k, ap["k_norm"], cfg.rms_norm_eps,
+                     scale_f32=cfg.norm_scale_f32)
     q = q.reshape(b, t, nh, hd)
     k = k.reshape(b, t, nkv, hd)
     if cfg.qk_norm:
@@ -271,14 +291,17 @@ def _layer_body(
 
     attn = attend(q, k, v).reshape(b, t, nh * hd)
     attn_out = proj(attn, ap["wo"], "o_proj")
-    if cfg.sandwich_norms:
-        # Gemma-2 layout: norm the attention OUTPUT before the residual
+    if cfg.sandwich_norms or cfg.post_norms_only:
+        # Gemma-2 / OLMo-2: norm the attention OUTPUT before the residual
         attn_out = rms_norm(attn_out, lp["attn_out_norm"],
-                            cfg.rms_norm_eps, cfg.rms_norm_add_one)
+                            cfg.rms_norm_eps, cfg.rms_norm_add_one,
+                 scale_f32=cfg.norm_scale_f32)
     x = res + attn_out
 
     res = x
-    x = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
+    if not cfg.post_norms_only:
+        x = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps,
+                     cfg.rms_norm_add_one)
     if "moe" in lp:
         return res + _moe_mlp(cfg, lp["moe"], x)
     mp = lp["mlp"]
@@ -286,9 +309,10 @@ def _layer_body(
         x, mp["up"], "up_proj"
     )
     mlp_out = proj(inner, mp["down"], "down_proj")
-    if cfg.sandwich_norms:
+    if cfg.sandwich_norms or cfg.post_norms_only:
         mlp_out = rms_norm(mlp_out, lp["ffw_out_norm"],
-                           cfg.rms_norm_eps, cfg.rms_norm_add_one)
+                           cfg.rms_norm_eps, cfg.rms_norm_add_one,
+                 scale_f32=cfg.norm_scale_f32)
     return res + mlp_out
 
 
@@ -461,7 +485,8 @@ def forward(
             pallas_prefill,
         )
         new_kv.append(layer_kv)
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one,
+                 scale_f32=cfg.norm_scale_f32)
     return x, tuple(new_kv)
 
 
@@ -576,7 +601,8 @@ def decode_window_step(
             cfg, lp, x, positions[:, None], attend,
             _lora_layer_slice(lora, i), lora_idx,
         )
-    x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one,
+                 scale_f32=cfg.norm_scale_f32)
     return x, staged
 
 
@@ -627,7 +653,8 @@ def embed_encode(
             )
 
         x = _layer_body(cfg, lp, x, positions, attend)
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one,
+                 scale_f32=cfg.norm_scale_f32)
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0].astype(jnp.float32)  # (B, h)
@@ -695,7 +722,8 @@ def forward_sp_prefill(
         x = _layer_body(
             cfg, lp, x, positions, attend, _lora_layer_slice(lora, i), lora_idx
         )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one,
+                 scale_f32=cfg.norm_scale_f32)
     return x, tuple(new_kv)
 
 
@@ -736,7 +764,8 @@ def forward_context_parallel(
             )
 
         x = _layer_body(cfg, lp, x, positions, attend)
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_add_one,
+                 scale_f32=cfg.norm_scale_f32)
     return x, jnp.stack(kv_out)
 
 

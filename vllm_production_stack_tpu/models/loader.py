@@ -170,14 +170,23 @@ def load_checkpoint_params(cfg: ModelConfig) -> dict:
         "layers": {
             "attn": attn_tree,
             mlp_key: mlp,
-            "input_norm": stack(p + "input_layernorm.weight", vec),
+            **(
+                {}
+                if cfg.post_norms_only
+                else {"input_norm": stack(p + "input_layernorm.weight",
+                                          vec)}
+            ),
             # Gemma-2 sandwich layout: our pre-MLP norm slot maps to HF
             # pre_feedforward_layernorm; HF's post_attention_layernorm is
             # the attention-OUTPUT norm (attn_out_norm below)
-            "post_attn_norm": stack(
-                p + ("pre_feedforward_layernorm.weight"
-                     if cfg.sandwich_norms
-                     else "post_attention_layernorm.weight"), vec),
+            **(
+                {}
+                if cfg.post_norms_only
+                else {"post_attn_norm": stack(
+                    p + ("pre_feedforward_layernorm.weight"
+                         if cfg.sandwich_norms
+                         else "post_attention_layernorm.weight"), vec)}
+            ),
         },
         "final_norm": vec("model.norm.weight"),
     }
@@ -185,16 +194,17 @@ def load_checkpoint_params(cfg: ModelConfig) -> dict:
         params["layers"]["attn"]["bq"] = stack(p + "self_attn.q_proj.bias", vec)
         params["layers"]["attn"]["bk"] = stack(p + "self_attn.k_proj.bias", vec)
         params["layers"]["attn"]["bv"] = stack(p + "self_attn.v_proj.bias", vec)
-    if cfg.qk_norm:
+    if cfg.qk_norm or cfg.qk_norm_flat:
         params["layers"]["attn"]["q_norm"] = stack(
             p + "self_attn.q_norm.weight", vec)
         params["layers"]["attn"]["k_norm"] = stack(
             p + "self_attn.k_norm.weight", vec)
-    if cfg.sandwich_norms:
+    if cfg.sandwich_norms or cfg.post_norms_only:
         params["layers"]["attn_out_norm"] = stack(
             p + "post_attention_layernorm.weight", vec)
         params["layers"]["ffw_out_norm"] = stack(
             p + "post_feedforward_layernorm.weight", vec)
+
     if not cfg.tie_word_embeddings:
         params["lm_head"] = mat("lm_head.weight")
     logger.info(

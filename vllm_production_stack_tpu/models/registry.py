@@ -106,6 +106,7 @@ _ARCH_MAP = {
     "Qwen3ForCausalLM": "qwen3",
     "Phi3ForCausalLM": "phi3",
     "Qwen3MoeForCausalLM": "qwen3moe",
+    "Olmo2ForCausalLM": "olmo2",
     "MixtralForCausalLM": "mixtral",
     "GemmaForCausalLM": "gemma",
     "Gemma2ForCausalLM": "gemma2",
@@ -193,9 +194,13 @@ def _from_hf_config(path: str) -> dict:
             sliding_window=int(hf.get("sliding_window") or 0),
             sliding_window_pattern=2,  # HF layer_types: even layers slide
         )
-    qwen3 = (
+    # per-architecture norm/attention convention flags
+    arch_flags = (
         dict(qk_norm=True) if arch in ("qwen3", "qwen3moe") else {}
     )
+    if arch == "olmo2":
+        arch_flags = dict(qk_norm_flat=True, post_norms_only=True,
+                          norm_scale_f32=True)
     # sliding-window attention: Mistral-7B-v0.1 sets sliding_window=4096
     # on every layer (v0.2+ configs carry null). Silently serving full
     # attention would give wrong numerics past the window.
@@ -245,7 +250,7 @@ def _from_hf_config(path: str) -> dict:
     return dict(
         **moe,
         **gemma,
-        **qwen3,
+        **arch_flags,
         **sw,
         **scaling,
         model=path,

@@ -47,10 +47,11 @@ def llama_param_specs(cfg: ModelConfig) -> dict:
             "bk": P(PP_AXIS, TP_AXIS),
             "bv": P(PP_AXIS, TP_AXIS),
         }
-    if cfg.qk_norm:
-        # [L, head_dim] — per-head norm weights are head-invariant, so
-        # they replicate across tp (every shard's heads use the same
-        # head_dim vector)
+    if cfg.qk_norm or cfg.qk_norm_flat:
+        # replicated in both scopes: per-head (qwen3, [L, head_dim]) norm
+        # weights are head-invariant, and the flat scope (olmo2,
+        # [L, heads*head_dim]) needs the WHOLE axis for its mean-square —
+        # a tp shard cannot compute it locally, and the vectors are tiny
         attn |= {
             "q_norm": P(PP_AXIS, None),
             "k_norm": P(PP_AXIS, None),
@@ -78,12 +79,16 @@ def llama_param_specs(cfg: ModelConfig) -> dict:
         "layers": {
             "attn": attn,
             mlp_key: mlp,
-            "input_norm": P(PP_AXIS, None),
-            "post_attn_norm": P(PP_AXIS, None),
+            **(
+                {}
+                if cfg.post_norms_only
+                else {"input_norm": P(PP_AXIS, None),
+                      "post_attn_norm": P(PP_AXIS, None)}
+            ),
             **(
                 {"attn_out_norm": P(PP_AXIS, None),
                  "ffw_out_norm": P(PP_AXIS, None)}
-                if cfg.sandwich_norms
+                if cfg.sandwich_norms or cfg.post_norms_only
                 else {}
             ),
         },
