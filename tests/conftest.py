@@ -95,6 +95,14 @@ SLOW_TESTS = {
     "test_benchmarks.py::test_multi_round_qa_against_router",
     "test_model_numerics.py::test_chunked_prefill_matches_full_prefill",
     "test_checkpoint_loading.py::test_engine_serves_checkpoint_greedy_matches_hf",
+    "test_checkpoint_loading.py::test_llama31_rope_scaling_checkpoint_end_to_end",
+    "test_checkpoint_loading.py::test_qwen3_engine_greedy_matches_hf",
+    "test_checkpoint_loading.py::test_mistral_sliding_window_checkpoint",
+    "test_checkpoint_loading.py::test_gemma2_checkpoint_full_conventions",
+    "test_checkpoint_loading.py::test_phi3_checkpoint_fused_weights_and_window",
+    "test_checkpoint_loading.py::test_olmo2_checkpoint_post_norms_and_flat_qk",
+    "test_moe.py::test_qwen3moe_checkpoint_parity",
+    "test_engine_server.py::test_n_choices_stream_disconnect_aborts_all",
     "test_engine.py::test_greedy_batch_matches_solo",
     "test_engine.py::test_byte_tokenizer_text_roundtrip",
     "test_lora.py::test_unload_restores_base",
