@@ -209,13 +209,95 @@ def run_microbench() -> dict:
     }
 
 
+def run_steploop_bench() -> dict:
+    """Decode step-loop attribution (pipelined-engine PR): steps/s and
+    host-sync fraction (sync_s / step wall) for the serial loop vs the
+    two-deep pipelined loop, same model/workload. The pipelined loop
+    dispatches window N+1 against speculatively-advanced state before
+    window N's tokens reach the host, so the host-sync fraction is the
+    direct measure of what the overlap buys. Small wave — the microbench
+    above owns the headline throughput number."""
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+    from vllm_production_stack_tpu.models.registry import resolve_model_config
+
+    import gc
+
+    n_seqs, prompt_len, gen_len = 64, 64, 128
+    model_cfg = resolve_model_config("llama-1b", max_model_len=512,
+                                     dtype="bfloat16")
+    out: dict = {}
+    engine = None
+    for mode, async_on in (("sync", False), ("pipelined", True)):
+        # free the previous mode's weights + KV pool BEFORE building the
+        # next engine — two live pools would OOM the chip
+        del engine
+        gc.collect()
+        config = EngineConfig(
+            model=model_cfg,
+            cache=CacheConfig(block_size=16, num_blocks=None,
+                              hbm_utilization=0.70),
+            scheduler=SchedulerConfig(
+                max_num_seqs=n_seqs,
+                max_num_batched_tokens=n_seqs * prompt_len,
+                decode_buckets=(n_seqs,),
+                prefill_buckets=(prompt_len, n_seqs * prompt_len),
+                decode_window=8,  # many short windows: the step-loop regime
+                width_floor_blocks=1,
+            ),
+            async_scheduling=async_on,
+        )
+        engine = LLMEngine(config)
+        sampling = SamplingParams(max_tokens=gen_len, temperature=0.0,
+                                  ignore_eos=True)
+        prompts = [
+            list(np.random.RandomState(5000 + i).randint(
+                1, model_cfg.vocab_size, size=prompt_len))
+            for i in range(n_seqs)
+        ]
+        engine.generate(prompts, sampling)  # warmup: compile the wave
+        t_before = dict(engine.timing)
+        t0 = time.perf_counter()
+        outs = engine.generate(prompts, sampling)
+        wall = time.perf_counter() - t0
+        gen = sum(len(o["token_ids"]) for o in outs)
+        dt = {k: engine.timing[k] - t_before[k] for k in t_before}
+        out[mode] = {
+            "tok_s": round(gen / wall, 1),
+            "steps_s": round(dt["decode_n"] / wall, 2),
+            "sync_frac": round(dt["sync_s"] / wall, 3),
+            "overlap_frac": round(
+                dt["overlap_s"] / dt["step_wall_s"], 3
+            ) if dt["step_wall_s"] else 0.0,
+            "rollbacks": dt["rollback_n"],
+            "wall_s": round(wall, 3),
+        }
+    if out["sync"]["tok_s"]:
+        out["pipeline_speedup"] = round(
+            out["pipelined"]["tok_s"] / out["sync"]["tok_s"], 3
+        )
+    return out
+
+
 def _phase_micro_main() -> None:
     """Subprocess entry: enable the persistent compile cache, run the
-    microbench, print its JSON."""
+    microbench (+ the step-loop attribution bench), print its JSON."""
     from bench_livestack import enable_persistent_cache
 
     enable_persistent_cache()
-    print(json.dumps({"microbench": run_microbench()}), flush=True)
+    micro = run_microbench()
+    try:
+        micro["steploop"] = run_steploop_bench()
+    except Exception as e:  # never lose the headline number to the rider
+        micro["steploop"] = {"error": str(e)}
+    print(json.dumps({"microbench": micro}), flush=True)
 
 
 def _phase_preflight_main() -> None:

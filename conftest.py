@@ -1,0 +1,23 @@
+"""Root conftest: opt-in xdist parallelism.
+
+pytest.ini used to hardcode `addopts = -n auto`, which made every pytest
+invocation fail to parse on images without pytest-xdist ("unrecognized
+arguments: -n"). The `-n` injection lives here instead, gated on the plugin
+actually being importable and the caller not having chosen a worker count
+(or disabled the plugin with `-p no:xdist`, as the tier-1 command does).
+"""
+
+
+def pytest_load_initial_conftests(early_config, parser, args):
+    try:
+        import xdist  # noqa: F401
+    except ImportError:
+        return
+    for i, a in enumerate(args):
+        if a.startswith("-n") or a.startswith("--numprocesses"):
+            return  # caller picked a worker count
+        if a == "-pno:xdist" or (
+            a == "-p" and i + 1 < len(args) and args[i + 1] == "no:xdist"
+        ):
+            return  # plugin explicitly disabled
+    args[:] = ["-n", "auto", *args]

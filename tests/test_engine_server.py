@@ -376,16 +376,19 @@ def test_step_loop_recovers_from_transient_fault():
 
     engine = LLMEngine(EngineConfig.tiny())
     async_engine = AsyncEngine(engine)
-    inner = engine.runner.execute
+    # execute_async is the dispatch primitive of BOTH step-loop modes (the
+    # serial execute() routes through it), so the injected fault hits the
+    # pipelined path too
+    inner = engine.runner.execute_async
     state = {"fail_next": 1}
 
-    def flaky_execute(work):
+    def flaky_execute_async(work, prev=None):
         if state["fail_next"] > 0:
             state["fail_next"] -= 1
             raise RuntimeError("INTERNAL: transient tunnel fault")
-        return inner(work)
+        return inner(work, prev)
 
-    engine.runner.execute = flaky_execute
+    engine.runner.execute_async = flaky_execute_async
 
     async def go():
         async_engine.start(asyncio.get_running_loop())

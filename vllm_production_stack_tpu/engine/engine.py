@@ -15,9 +15,9 @@ from dataclasses import dataclass
 
 from ..utils.tokenizer import IncrementalDetokenizer, TokenizerWrapper
 from .config import EngineConfig
-from .model_runner import ModelRunner
+from .model_runner import ModelRunner, StepHandle
 from .request import Request, RequestOutput, RequestStatus, SamplingParams
-from .scheduler import PrefillWork, Scheduler
+from .scheduler import DecodeWork, PrefillWork, Scheduler
 
 logger = logging.getLogger(__name__)
 
@@ -36,6 +36,9 @@ class EngineStatsSnapshot:
     num_preemptions: int = 0
     generation_tokens: int = 0
     prompt_tokens: int = 0
+    # pipelined step loop: fraction of step-loop wall time in which host
+    # scheduling/postprocess overlapped an in-flight device step
+    step_overlap_frac: float = 0.0
     host_kv_usage_perc: float = 0.0
     host_kv_offloads: int = 0
     host_kv_reloads: int = 0
@@ -51,6 +54,19 @@ class _RequestState:
     detok: IncrementalDetokenizer | None
     text: str = ""
     pending_text: str = ""
+
+
+@dataclass
+class _InflightStep:
+    """A decode step dispatched to the device but not yet resolved — the
+    unit the pipelined step loop keeps in flight while the host schedules
+    and postprocesses around it."""
+
+    work: DecodeWork
+    handle: StepHandle
+    # set once the handle's results were synced to the host — a step that
+    # faults before this must be restored as the in-flight step
+    resolved: bool = False
 
 
 class LLMEngine:
@@ -181,12 +197,29 @@ class LLMEngine:
         self._prompt_tokens = 0
         self._generation_tokens = 0
         # step-phase wall-time decomposition (served-stack profiling; the
-        # async server exposes this via /debug/timing)
+        # async server exposes this via /debug/timing). dispatch_s = host
+        # time building + enqueueing device work; sync_s = host time
+        # blocked in the per-step D2H result transfer; overlap_s = host
+        # time that ran while a device step was in flight (the pipeline's
+        # win); step_wall_s = total step() wall; rollback_n = speculative
+        # steps discarded because a stop/finish/abort invalidated them.
         self.timing: dict[str, float | int] = {
             "sched_s": 0.0, "post_s": 0.0,
             "prefill_s": 0.0, "prefill_n": 0, "prefill_tokens": 0,
             "decode_s": 0.0, "decode_n": 0, "decode_tokens": 0,
+            "dispatch_s": 0.0, "sync_s": 0.0,
+            "overlap_s": 0.0, "step_wall_s": 0.0, "rollback_n": 0,
         }
+        # two-deep pipelined step loop (config.async_scheduling): dispatch
+        # step N+1 against speculatively-advanced scheduler state before
+        # step N's tokens reach the host. Speculative n-gram decoding needs
+        # resolved token VALUES for its proposer, so it forces the serial
+        # path.
+        self._pipeline = (
+            config.async_scheduling
+            and config.scheduler.num_speculative_tokens == 0
+        )
+        self._inflight: _InflightStep | None = None
         # model_fingerprint (computed above, before the KV tiers): same
         # config + same checkpoint (or same random seed) => same KV bytes
         # for same tokens. KV adoption (disaggregated prefill) refuses
@@ -630,7 +663,144 @@ class LLMEngine:
     # -- stepping ----------------------------------------------------------
 
     def step(self) -> list[RequestOutput]:
-        """Schedule + execute one device step; returns per-request deltas."""
+        """Schedule + execute one device step; returns per-request deltas.
+
+        With async_scheduling (the default) this drives a TWO-DEEP
+        PIPELINE: the step dispatched on the previous call is still
+        executing on device while this call schedules and dispatches the
+        next one against speculatively-advanced request state — decode
+        inputs chain device-side from the in-flight step's output matrix —
+        and only then resolves the previous step (one batched D2H sync),
+        postprocesses it, and reconciles. When the reconcile shows the
+        speculation was wrong (a mid-window stop token, max-tokens finish,
+        stop-string hit, or abort), the just-dispatched step is discarded
+        and rolled back, so the emitted token streams are bitwise identical
+        to the serial loop. Outputs returned by one call therefore belong
+        to the step dispatched on the PREVIOUS call (one step of latency,
+        ~2x decode throughput when host and device times are comparable)."""
+        if not self._pipeline:
+            return self._step_sync()
+        return self._step_pipelined()
+
+    def _step_pipelined(self) -> list[RequestOutput]:
+        t_enter = time.perf_counter()
+        outputs: list[RequestOutput] = []
+        inflight, self._inflight = self._inflight, None
+        try:
+            return self._step_pipelined_inner(inflight, outputs, t_enter)
+        except Exception:
+            if inflight is not None and not inflight.resolved:
+                # the fault hit before the previous step was resolved (e.g.
+                # a transient dispatch failure) — put it back so its
+                # results aren't stranded; the next step (or the async
+                # server's abort-all recovery) reconciles it
+                self._inflight = inflight
+            raise
+
+    def _step_pipelined_inner(
+        self,
+        inflight: _InflightStep | None,
+        outputs: list[RequestOutput],
+        t_enter: float,
+    ) -> list[RequestOutput]:
+        t0 = time.perf_counter()
+        work = self.scheduler.schedule(
+            inflight=inflight.work if inflight else None
+        )
+        t1 = time.perf_counter()
+        self.timing["sched_s"] += t1 - t0
+        # requests the scheduler terminated outside a step (e.g. an
+        # impossible-fit re-admission aborted inside schedule()) still need
+        # a terminal output or streaming clients would hang forever
+        for req in self.scheduler.take_finished_externally():
+            outputs.append(self._make_output(req, [], "", "abort"))
+        nxt: _InflightStep | None = None
+        pre_handle: StepHandle | None = None
+        sync_work = None
+        if isinstance(work, DecodeWork):
+            handle = self.runner.execute_async(
+                work, prev=inflight.handle if inflight else None
+            )
+            self.scheduler.begin_speculative(work)
+            self.timing["dispatch_s"] += time.perf_counter() - t1
+            nxt = _InflightStep(work=work, handle=handle)
+        elif isinstance(work, PrefillWork):
+            # dispatched before resolving the in-flight decode so the host
+            # array building overlaps device execution; resolved below in
+            # this same call (prefill outputs are never speculated on)
+            pre_handle = self.runner.execute_async(work)
+            self.timing["dispatch_s"] += time.perf_counter() - t1
+        elif work is not None:
+            sync_work = work  # verify — unreachable (spec forces serial)
+        if inflight is not None:
+            # everything since step entry ran while the previous step was
+            # still executing on device — the overlap the pipeline buys
+            self.timing["overlap_s"] += time.perf_counter() - t_enter
+            try:
+                self._resolve_decode(inflight, outputs)
+            except Exception:
+                # the previous step's resolve faulted AFTER nxt was
+                # dispatched: roll nxt back too, or its speculative window
+                # would leak (rows stuck one window ahead with their
+                # sampled tokens silently dropped)
+                if nxt is not None:
+                    self.scheduler.rollback_speculative(nxt.work)
+                    nxt.handle.discard()
+                raise
+            if nxt is not None and not self.scheduler.speculation_valid(
+                nxt.work
+            ):
+                # the reconciled state moved out from under the speculative
+                # dispatch: discard it wholesale. The serial re-dispatch on
+                # the next call reproduces the exact token stream (RNG is
+                # rewound by discard()).
+                self.scheduler.rollback_speculative(nxt.work)
+                nxt.handle.discard()
+                self.timing["rollback_n"] += 1
+                nxt = None
+        if pre_handle is not None:
+            t2 = time.perf_counter()
+            rows = pre_handle.resolve()
+            t3 = time.perf_counter()
+            self.timing["sync_s"] += pre_handle.sync_s
+            self.timing["prefill_s"] += t3 - t2
+            self.timing["prefill_n"] += 1
+            self.timing["prefill_tokens"] += sum(
+                len(t) for t in work.token_ids
+            )
+            results = self.scheduler.postprocess(work, rows)
+            self._emit_results(results, pre_handle.logprob_rows, outputs)
+            self.timing["post_s"] += time.perf_counter() - t3
+        elif sync_work is not None:
+            self._execute_sync(sync_work, outputs, time.perf_counter())
+        self._inflight = nxt
+        self.timing["step_wall_s"] += time.perf_counter() - t_enter
+        self._drop_finished(outputs)
+        return outputs
+
+    def _resolve_decode(
+        self, inflight: _InflightStep, outputs: list[RequestOutput]
+    ) -> None:
+        """Resolve the in-flight decode step — the decode hot path's single
+        host sync — then reconcile its real results into the scheduler."""
+        work, handle = inflight.work, inflight.handle
+        t0 = time.perf_counter()
+        rows = handle.resolve()
+        inflight.resolved = True
+        t1 = time.perf_counter()
+        self.timing["sync_s"] += handle.sync_s
+        self.timing["decode_s"] += t1 - t0
+        self.timing["decode_n"] += 1
+        self.scheduler.end_speculative(work)
+        results = self.scheduler.postprocess(work, rows)
+        self.timing["decode_tokens"] += sum(len(t) for _, t in results)
+        self._emit_results(results, handle.logprob_rows, outputs)
+        self.timing["post_s"] += time.perf_counter() - t1
+
+    def _step_sync(self) -> list[RequestOutput]:
+        """The serial fallback loop: schedule → execute → sync →
+        postprocess, one step per call (async_scheduling=False, or
+        speculative decoding enabled)."""
         t0 = time.perf_counter()
         work = self.scheduler.schedule()
         t1 = time.perf_counter()
@@ -643,11 +813,21 @@ class LLMEngine:
         if work is None:
             self._drop_finished(outputs)
             return outputs
+        self._execute_sync(work, outputs, t1)
+        self.timing["step_wall_s"] += time.perf_counter() - t0
+        self._drop_finished(outputs)
+        return outputs
+
+    def _execute_sync(self, work, outputs: list[RequestOutput], t1: float):
         sampled = self.runner.execute(work)
         t2 = time.perf_counter()
         kind = "prefill" if isinstance(work, PrefillWork) else "decode"
         self.timing[kind + "_s"] += t2 - t1
         self.timing[kind + "_n"] += 1
+        self.timing["sync_s"] += self.runner.last_sync_s
+        self.timing["dispatch_s"] += max(
+            0.0, (t2 - t1) - self.runner.last_sync_s
+        )
         lp_rows = self.runner.last_logprobs  # parallel to sampled rows
         results = self.scheduler.postprocess(work, sampled)
         self.timing[kind + "_tokens"] += (
@@ -659,8 +839,12 @@ class LLMEngine:
             # (1..k+1 accepted per row)
             else sum(len(toks) for _, toks in results)
         )
+        self._emit_results(results, lp_rows, outputs)
         self.timing["post_s"] += time.perf_counter() - t2
 
+    def _emit_results(
+        self, results, lp_rows, outputs: list[RequestOutput]
+    ) -> None:
         for row_i, (req, toks) in enumerate(results):
             if not toks:  # mid-prompt prefill chunk: progress, no tokens
                 continue
@@ -721,9 +905,6 @@ class LLMEngine:
                     req, toks, new_text, self._finish_reason(req), new_lp
                 )
             )
-
-        self._drop_finished(outputs)
-        return outputs
 
     def _drop_finished(self, outputs: list[RequestOutput]) -> None:
         for out in outputs:
@@ -831,6 +1012,11 @@ class LLMEngine:
             prefix_cache_hits=pool.stats.hits,
             prefix_cache_queries=pool.stats.queries,
             num_preemptions=self.scheduler.total_preemptions,
+            step_overlap_frac=(
+                self.timing["overlap_s"] / self.timing["step_wall_s"]
+                if self.timing["step_wall_s"] > 0
+                else 0.0
+            ),
             spec_draft_tokens=self.scheduler.spec_proposed_tokens,
             spec_accepted_tokens=self.scheduler.spec_accepted_tokens,
             generation_tokens=self._generation_tokens,

@@ -48,6 +48,10 @@ class EngineMetrics:
         self.host_kv_usage = gauge(
             mc.HOST_KV_USAGE_PERC, "Fraction of host-RAM KV tier in use"
         )
+        self.step_overlap = gauge(
+            mc.STEP_OVERLAP_FRAC,
+            "Fraction of step-loop wall time overlapping device execution",
+        )
         self.host_offloads = counter(
             mc.HOST_KV_OFFLOADS, "KV blocks offloaded HBM to host RAM"
         )
@@ -80,6 +84,7 @@ class EngineMetrics:
         self._bump(self.prefix_queries, "queries", s.prefix_cache_queries)
         self._bump(self.preemptions, "preempt", s.num_preemptions)
         self.host_kv_usage.labels(**lb).set(s.host_kv_usage_perc)
+        self.step_overlap.labels(**lb).set(s.step_overlap_frac)
         self._bump(self.host_offloads, "host_off", s.host_kv_offloads)
         self._bump(self.host_reloads, "host_re", s.host_kv_reloads)
         self._bump(self.remote_stores, "remote_store", s.remote_kv_stores)

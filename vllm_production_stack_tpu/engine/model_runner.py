@@ -20,6 +20,7 @@ from __future__ import annotations
 import functools
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
@@ -101,6 +102,55 @@ def resolve_auto_prefill_backend(
     del block_size, max_model_len, heads_divisible  # used once measured
     del platform
     return "xla"
+
+
+class StepHandle:
+    """One dispatched-but-unresolved device step — the async pipeline's
+    unit of in-flight work (engine/engine.py pipelined step loop).
+
+    Holds the ON-DEVICE sampled-token matrix (and logprob arrays) so the
+    engine can dispatch the NEXT step, chaining decode inputs device-side
+    from `tokens`, before paying the single batched D2H transfer that
+    resolve() performs. discard() is the rollback hook: the device still
+    executes the step, but its results are dropped and the runner's RNG
+    rewinds so the replacement dispatch draws the same step key the serial
+    loop would have."""
+
+    def __init__(self, runner, work, tokens, lp_arrays, rng_before, postproc):
+        self.runner = runner
+        self.work = work
+        self.tokens = tokens  # device array; decode: (B_pad, window)
+        self.lp_arrays = lp_arrays  # tuple of device arrays, or None
+        self.rng_before = rng_before
+        self._postproc = postproc
+        self.logprob_rows: list | None = None
+        self.sync_s = 0.0  # host time blocked in the D2H sync
+        self._rows: list[list[int]] | None = None
+
+    def resolve(self) -> list[list[int]]:
+        """Sync the step's results to the host — exactly ONE jax.device_get
+        covering tokens + every logprob array — and build the per-request
+        token rows. Idempotent: the transfer happens once."""
+        if self._rows is None:
+            t0 = time.perf_counter()
+            if self.lp_arrays is not None:
+                got = jax.device_get((self.tokens, *self.lp_arrays))
+                mat = np.asarray(got[0])
+                lp = tuple(np.asarray(x) for x in got[1:])
+            else:
+                mat = np.asarray(jax.device_get(self.tokens))
+                lp = None
+            self.sync_s = time.perf_counter() - t0
+            self._rows, self.logprob_rows = self._postproc(mat, lp)
+        return self._rows
+
+    def discard(self) -> None:
+        """Roll back this dispatch (speculation invalidated): rewind the
+        runner RNG — valid because nothing else dispatches between a
+        speculative step and its rollback decision — and drop the results."""
+        self.runner._rng = self.rng_before
+        self._rows = []
+        self.logprob_rows = None
 
 
 def _collect_logprobs(logits: jax.Array, tokens: jax.Array):
@@ -254,6 +304,24 @@ class ModelRunner:
         # when the dispatched batch requested them; None otherwise. Read by
         # LLMEngine.step right after execute().
         self.last_logprobs: list | None = None
+        # host time the last execute() spent blocked in its D2H sync (the
+        # engine folds this into the timing decomposition's sync_s)
+        self.last_sync_s = 0.0
+        # async pipeline: splice a chained row's input token from the
+        # previous step's device-resident output matrix (last window
+        # column), falling back to the host-provided token where
+        # chain_idx < 0 — the D2H→H2D round trip the pipeline removes
+        self._chain_fn = jax.jit(
+            lambda prev_toks, host_toks, idx: jnp.where(
+                idx >= 0,
+                jnp.take(
+                    prev_toks[:, -1],
+                    jnp.clip(idx, 0, prev_toks.shape[0] - 1),
+                ),
+                host_toks,
+            ),
+            out_shardings=NamedSharding(self.mesh, P(mesh_lib.DP_AXIS)),
+        )
         self._zero_stop_arrays: dict[int, tuple] = {}
         self._sleeping_params_host: Any | None = None
         self._sleeping_lora_host: Any | None = None
@@ -794,13 +862,32 @@ class ModelRunner:
         (prefill: [[tok]] if work.sample else [[]]; decode: up to `window`
         candidate tokens per request; verify: argmax at every fed
         position)."""
-        if isinstance(work, PrefillWork):
-            return self._execute_prefill(work)
         if isinstance(work, VerifyWork):
+            self.last_sync_s = 0.0  # verify syncs inside _execute_verify
             return self._execute_verify(work)
-        return self._execute_decode(work)
+        handle = self.execute_async(work)
+        rows = handle.resolve()
+        self.last_logprobs = handle.logprob_rows
+        self.last_sync_s = handle.sync_s
+        return rows
 
-    def _execute_prefill(self, work: PrefillWork) -> list[list[int]]:
+    def execute_async(
+        self, work: ScheduleOutput, prev: StepHandle | None = None
+    ) -> StepHandle:
+        """Dispatch one step WITHOUT syncing its results — the async
+        pipeline's entry point. `prev` is the still-unresolved previous
+        decode step; rows whose work.chain_rows entry is >= 0 take their
+        input token from its device-resident output matrix (no host round
+        trip). Resolve the returned handle to get the token rows."""
+        if isinstance(work, PrefillWork):
+            return self._dispatch_prefill(work)
+        if isinstance(work, DecodeWork):
+            return self._dispatch_decode(work, prev)
+        raise TypeError(
+            f"cannot dispatch {type(work).__name__} asynchronously"
+        )
+
+    def _dispatch_prefill(self, work: PrefillWork) -> StepHandle:
         """One dispatch for the whole prefill batch: rows padded to a common
         chunk bucket, batch padded to a power of two. Every row samples at its
         chunk's last token (static shapes); non-sampling rows' tokens are
@@ -873,7 +960,7 @@ class ModelRunner:
         for i, req in enumerate(work.requests):
             lora_idx[i] = req.lora_index
         min_toks, stop_ids_arr = self._stop_id_arrays(work.requests, b_pad)
-        tokens, lp = self._run(
+        tokens_dev, lp_dev, rng_before = self._run(
             token_ids, positions, block_tables,
             slots.reshape(-1) if slots is not None else np.zeros(1, np.int32),
             context_lens, chunk_lens, write_ids, start_off, lora_idx,
@@ -884,11 +971,20 @@ class ModelRunner:
             want_logprobs=want_lp, want_min_tokens=use_mt,
             aot_key=aot_key,
         )
+        return StepHandle(
+            runner=self, work=work, tokens=tokens_dev, lp_arrays=lp_dev,
+            rng_before=rng_before,
+            postproc=functools.partial(self._prefill_rows, work, b),
+        )
+
+    @staticmethod
+    def _prefill_rows(work: PrefillWork, b: int, tokens, lp):
+        """Host-side row building for a resolved prefill handle."""
         if lp is None:
-            self.last_logprobs = None
+            lp_rows = None
         else:
             chosen, top_lp, top_id = lp
-            self.last_logprobs = [
+            lp_rows = [
                 (
                     [(float(chosen[i]),
                       list(map(int, top_id[i])),
@@ -898,11 +994,14 @@ class ModelRunner:
                 )
                 for i in range(b)
             ]
-        return [
+        rows = [
             [int(tokens[i])] if work.sample[i] else [] for i in range(b)
         ]
+        return rows, lp_rows
 
-    def _execute_decode(self, work: DecodeWork) -> list[list[int]]:
+    def _dispatch_decode(
+        self, work: DecodeWork, prev: StepHandle | None = None
+    ) -> StepHandle:
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
         sched = self.config.scheduler
@@ -923,6 +1022,22 @@ class ModelRunner:
 
         first_tokens = np.zeros(b_pad, np.int32)
         first_tokens[:b] = work.token_ids
+        ft = self._put(first_tokens, self._batch1)
+        chain = work.chain_rows
+        if any(c >= 0 for c in chain):
+            # chained rows read their input token straight from the
+            # previous (still in-flight) step's device output — the
+            # D2H→H2D round trip the pipeline eliminates
+            if prev is None:
+                raise RuntimeError(
+                    "decode work chains rows but no previous StepHandle "
+                    "was supplied"
+                )
+            idx = np.full(b_pad, -1, np.int32)
+            idx[: len(chain)] = chain
+            ft = self._chain_fn(
+                prev.tokens, ft, self._put(idx, self._batch1)
+            )
         positions0 = np.zeros(b_pad, np.int32)
         positions0[:b] = work.positions
         block_tables = self._block_table_array(
@@ -932,8 +1047,15 @@ class ModelRunner:
         top_ps = [r.sampling.top_p for r in work.requests] + [1.0] * (b_pad - b)
         top_ks = [r.sampling.top_k for r in work.requests] + [0] * (b_pad - b)
         seeds = [r.sampling.seed for r in work.requests] + [None] * (b_pad - b)
-        counts = [len(r.output_token_ids) for r in work.requests] + [0] * (b_pad - b)
+        # effective output counts: tokens still in flight from the previous
+        # step count as generated (seeded-sampling fold and min_tokens
+        # suppression must see the serial-world counter); 0 on the sync path
+        counts = [
+            len(r.output_token_ids) + r.num_inflight_tokens
+            for r in work.requests
+        ] + [0] * (b_pad - b)
 
+        rng_before = self._rng
         self._rng, step_key = jax.random.split(self._rng)
         has_seed = np.asarray([s is not None for s in seeds], bool)
         seed_vals = np.asarray([(s or 0) & 0xFFFFFFFF for s in seeds], np.uint32)
@@ -942,7 +1064,7 @@ class ModelRunner:
             lora_idx[i] = req.lora_index
         min_toks, stop_ids_arr = self._stop_id_arrays(work.requests, b_pad)
         dyn_args = (
-            self._put(first_tokens, self._batch1),
+            ft,
             self._put(positions0, self._batch1),
             self._put(block_tables, self._batch2),
             self._put(lora_idx, self._batch1) if self._use_lora else None,
@@ -973,14 +1095,27 @@ class ModelRunner:
             )
             self._note_compiled(aot_key)
         if want_lp:
-            self.kv_caches, tokens, (lp_w, top_lp_w, top_id_w) = result
-            lp_w = np.asarray(jax.device_get(lp_w))
-            top_lp_w = np.asarray(jax.device_get(top_lp_w))
-            top_id_w = np.asarray(jax.device_get(top_id_w))
+            self.kv_caches, tokens, lp_arrays = result
+        else:
+            self.kv_caches, tokens = result
+            lp_arrays = None
+        return StepHandle(
+            runner=self, work=work, tokens=tokens, lp_arrays=lp_arrays,
+            rng_before=rng_before,
+            postproc=functools.partial(self._decode_rows, work, b),
+        )
+
+    @staticmethod
+    def _decode_rows(work: DecodeWork, b: int, mat, lp):
+        """Host-side row building for a resolved decode handle."""
+        if lp is None:
+            lp_rows = None
+        else:
+            lp_w, top_lp_w, top_id_w = lp
             # python-ify only the rows that asked — the device already
             # computed the whole batch, but 256x32x8 tuple-building on the
             # host for rows the engine will ignore is pure waste
-            self.last_logprobs = [
+            lp_rows = [
                 (
                     [
                         (float(lp_w[i, k]),
@@ -993,11 +1128,7 @@ class ModelRunner:
                 )
                 for i, req in enumerate(work.requests)
             ]
-        else:
-            self.kv_caches, tokens = result
-            self.last_logprobs = None
-        mat = np.asarray(jax.device_get(tokens))
-        return [list(map(int, mat[i])) for i in range(b)]
+        return [list(map(int, mat[i])) for i in range(b)], lp_rows
 
     # -- helpers -----------------------------------------------------------
 
@@ -1009,6 +1140,7 @@ class ModelRunner:
     ):
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
+        rng_before = self._rng
         self._rng, step_key = jax.random.split(self._rng)
         has_seed = np.asarray([s is not None for s in seeds], bool)
         # 64-bit user seeds (legal per the OpenAI API) fold down to uint32
@@ -1058,11 +1190,12 @@ class ModelRunner:
                 self._note_compiled(aot_key)
         if want_logprobs:
             self.kv_caches, tokens, lp = result
-            lp = tuple(np.asarray(jax.device_get(x)) for x in lp)
         else:
             self.kv_caches, tokens = result
             lp = None
-        return np.asarray(jax.device_get(tokens)), lp
+        # NO host sync here: the caller wraps these in a StepHandle whose
+        # resolve() performs the single batched D2H transfer
+        return tokens, lp, rng_before
 
     def _stop_id_arrays(self, requests, pad_to: int):
         """(min_toks (B,), stop_ids (B, SUPPRESS_IDS)) for device-side
@@ -1358,7 +1491,7 @@ class ModelRunner:
         )
 
     def _decode_avals(self, b: int, nb: int):
-        """ShapeDtypeStructs mirroring _execute_decode's dynamic args —
+        """ShapeDtypeStructs mirroring _dispatch_decode's dynamic args —
         MUST stay in lockstep with the _decode_window_fn call."""
         i32, f32 = jnp.int32, jnp.float32
         b1, b2, rep = self._batch1, self._batch2, self._rep

@@ -80,6 +80,12 @@ class Request:
     first_token_time: float | None = None
     finish_time: float | None = None
     num_preemptions: int = 0
+    # tokens dispatched in not-yet-resolved device steps (async pipeline):
+    # the scheduler plans the NEXT step at num_computed_tokens +
+    # num_inflight_tokens and treats the in-flight window as generated for
+    # max_tokens/window clamping; postprocess of the resolved step moves
+    # these into num_computed_tokens / output_token_ids for real
+    num_inflight_tokens: int = 0
 
     @property
     def num_prompt_tokens(self) -> int:

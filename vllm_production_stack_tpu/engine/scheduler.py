@@ -76,6 +76,10 @@ class DecodeWork:
     window: int = 1
     token_ids: list[int] = field(default_factory=list)  # first token per req
     positions: list[int] = field(default_factory=list)  # first position per req
+    # async pipeline: row index into the IN-FLIGHT DecodeWork whose last
+    # sampled (device-resident) token is this row's input, or -1 when
+    # token_ids[i] carries a host-known token. Empty = all host (sync path).
+    chain_rows: list[int] = field(default_factory=list)
 
 
 @dataclass
@@ -173,7 +177,13 @@ class Scheduler:
 
     # -- scheduling --------------------------------------------------------
 
-    def schedule(self) -> ScheduleOutput | None:
+    def schedule(
+        self, inflight: DecodeWork | None = None
+    ) -> ScheduleOutput | None:
+        """Build the next work item. `inflight` (async pipeline) is the
+        decode step currently executing on device: rows carried by it are
+        planned at their speculatively-advanced positions and chain their
+        input token from its device-resident output matrix (chain_rows)."""
         decode_ready = [r for r in self.running if r.prefill_done]
         prefilling = [r for r in self.running if not r.prefill_done]
         can_admit = bool(self.waiting) and len(self.running) < self.config.max_num_seqs
@@ -185,14 +195,14 @@ class Scheduler:
                 self._last_was_prefill = True
                 return work
         if decode_ready:
-            work = self._schedule_decode_or_verify(decode_ready)
+            work = self._schedule_decode_or_verify(decode_ready, inflight)
             if work is not None:
                 self._last_was_prefill = False
                 return work
         return None
 
     def _schedule_decode_or_verify(
-        self, ready: list[Request]
+        self, ready: list[Request], inflight: DecodeWork | None = None
     ) -> ScheduleOutput | None:
         """With speculative decoding on, greedy rows route through the
         verify program (which subsumes plain decode: no proposal -> 1 bonus
@@ -200,7 +210,7 @@ class Scheduler:
         are ready the two dispatch types alternate."""
         k = self.config.num_speculative_tokens
         if k <= 0:
-            return self._schedule_decode(ready)
+            return self._schedule_decode(ready, inflight)
         # only greedy rows whose proposer actually fires go through verify;
         # proposal-less greedy rows keep the fused decode window (1 token
         # per verify dispatch would re-expose the per-token round-trip the
@@ -231,7 +241,7 @@ class Scheduler:
             if group is spec:
                 work = self._schedule_verify(group, proposals)
             else:
-                work = self._schedule_decode(group)
+                work = self._schedule_decode(group, inflight)
             if work is not None:
                 self._last_was_verify = group is spec
                 return work
@@ -350,8 +360,32 @@ class Scheduler:
         )
         return chunk
 
-    def _schedule_decode(self, ready: list[Request]) -> DecodeWork | None:
-        cand = ready[: self.config.max_num_seqs]
+    def _schedule_decode(
+        self, ready: list[Request], inflight: DecodeWork | None = None
+    ) -> DecodeWork | None:
+        # async pipeline: rows with tokens in flight plan from their
+        # speculatively-advanced position / output count (assumes the whole
+        # in-flight window gets accepted — any deviation finishes the
+        # request, which the engine's reconcile turns into a rollback of
+        # the step scheduled here). On the sync path inflight counts are 0
+        # and these reduce to the plain fields.
+        def eff_computed(r: Request) -> int:
+            return r.num_computed_tokens + r.num_inflight_tokens
+
+        def eff_outputs(r: Request) -> int:
+            return len(r.output_token_ids) + r.num_inflight_tokens
+
+        # rows whose speculative state already exhausted max_tokens /
+        # max_model_len would have FINISHED in the resolved world — they
+        # must sit out until the in-flight step resolves them (no-op on the
+        # sync path: exhausted rows finish in postprocess and leave running)
+        cand = [
+            r for r in ready[: self.config.max_num_seqs]
+            if r.sampling.max_tokens - eff_outputs(r) > 0
+            and self.model_config.max_model_len - eff_computed(r) > 0
+        ]
+        if not cand:
+            return None
         # window bounded by model length per seq and by the batch's largest
         # remaining output budget rounded UP to a power of two: past that
         # every token of every row would be discarded, but rounding up keeps
@@ -362,12 +396,12 @@ class Scheduler:
         # overshoot)
         window = max(1, self.config.decode_window)
         max_remaining = max(
-            r.sampling.max_tokens - len(r.output_token_ids) for r in cand
+            r.sampling.max_tokens - eff_outputs(r) for r in cand
         )
         window = min(
             window,
             1 << max(0, max_remaining - 1).bit_length(),
-            min(self.model_config.max_model_len - r.num_computed_tokens
+            min(self.model_config.max_model_len - eff_computed(r)
                 for r in cand),
         )
         # clamp to pool headroom: the batch's total new-block demand at this
@@ -386,18 +420,38 @@ class Scheduler:
         for req in cand:
             if req not in self.running:
                 continue  # preempted while building this batch
-            if not self._ensure_blocks(req, req.num_computed_tokens + window):
+            if not self._ensure_blocks(req, eff_computed(req) + window):
                 continue  # req preempted itself; others may still decode
             picked.append(req)
         # a later _ensure_blocks may have preempted an earlier pick
         picked = [r for r in picked if r in self.running]
         if not picked:
             return None
+        inflight_row = (
+            {r.request_id: i for i, r in enumerate(inflight.requests)}
+            if inflight is not None
+            else {}
+        )
         batch = DecodeWork(requests=picked, window=window)
         for req in picked:
-            pos = req.num_computed_tokens
-            batch.token_ids.append(req.token_at(pos))
+            pos = eff_computed(req)
             batch.positions.append(pos)
+            if req.num_inflight_tokens > 0:
+                # input token is still device-resident (last column of the
+                # in-flight step's output matrix) — the runner chains it
+                # without a D2H→H2D round trip
+                src = inflight_row.get(req.request_id)
+                if src is None:
+                    raise RuntimeError(
+                        f"request {req.request_id} has "
+                        f"{req.num_inflight_tokens} in-flight tokens but is "
+                        "not in the in-flight decode step"
+                    )
+                batch.token_ids.append(0)  # placeholder; chained on device
+                batch.chain_rows.append(src)
+            else:
+                batch.token_ids.append(req.token_at(pos))
+                batch.chain_rows.append(-1)
         return batch
 
     # -- memory ------------------------------------------------------------
@@ -406,11 +460,14 @@ class Scheduler:
         return (num_tokens + self.block_size - 1) // self.block_size
 
     def _extra_blocks(self, reqs: list[Request], window: int) -> int:
-        """New blocks the batch needs to decode `window` tokens per request."""
+        """New blocks the batch needs to decode `window` tokens per request
+        (from the speculative position when tokens are in flight)."""
         return sum(
             max(
                 0,
-                self._blocks_needed(r.num_computed_tokens + window)
+                self._blocks_needed(
+                    r.num_computed_tokens + r.num_inflight_tokens + window
+                )
                 - len(r.block_table),
             )
             for r in reqs
@@ -475,9 +532,20 @@ class Scheduler:
         while len(req.block_table) < need:
             blk = self.pool.allocate()
             if blk is None:
-                if not self.running:
+                # newest admission loses — but never a request with tokens
+                # in flight (async pipeline): its device step is still
+                # writing KV into its blocks and its unresolved tokens
+                # would be lost, so it cannot be safely recomputed yet
+                victim = next(
+                    (
+                        r
+                        for r in reversed(self.running)
+                        if r.num_inflight_tokens == 0
+                    ),
+                    None,
+                )
+                if victim is None:
                     return False
-                victim = self.running[-1]  # newest admission loses
                 self._preempt(victim)
                 if victim is req:
                     return False
@@ -504,6 +572,69 @@ class Scheduler:
     def _slot(self, req: Request, token_idx: int) -> int:
         blk = req.block_table[token_idx // self.block_size]
         return blk * self.block_size + token_idx % self.block_size
+
+    # -- async pipeline bookkeeping (engine/engine.py pipelined step loop) --
+    #
+    # A dispatched-but-unresolved decode step advances its rows
+    # SPECULATIVELY: num_inflight_tokens carries the window so the next
+    # step can be scheduled (and dispatched, chaining its input tokens
+    # device-side) before the sampled tokens ever reach the host. The
+    # speculation is exactly "every row accepts its whole window" — any
+    # deviation (mid-window stop token, max-tokens/model-len finish, abort,
+    # stop-string hit) FINISHES the request in postprocess, which is what
+    # speculation_valid detects and the engine answers with
+    # rollback_speculative on the step dispatched on top of it.
+
+    def begin_speculative(self, work: DecodeWork) -> None:
+        """Mark `work`'s window as in flight on its rows (called right
+        after the engine dispatches the step to the device)."""
+        for req in work.requests:
+            req.num_inflight_tokens += work.window
+
+    def end_speculative(self, work: DecodeWork) -> None:
+        """Clear `work`'s window from its rows — the step has resolved and
+        postprocess() is about to apply its real results."""
+        for req in work.requests:
+            req.num_inflight_tokens = max(
+                0, req.num_inflight_tokens - work.window
+            )
+
+    def speculation_valid(self, work: DecodeWork) -> bool:
+        """After the PREVIOUS step resolved, is the speculatively dispatched
+        `work` still consistent? Every row must still be running with its
+        reconciled base position exactly where the dispatch assumed — a
+        mid-window stop, max-tokens finish, stop-string hit, or abort moves
+        (or removes) it."""
+        for req, pos in zip(work.requests, work.positions):
+            if req.status.finished or req not in self.running:
+                return False
+            base = (
+                req.num_computed_tokens
+                + req.num_inflight_tokens
+                - work.window
+            )
+            if base != pos:
+                return False
+        return True
+
+    def rollback_speculative(self, work: DecodeWork) -> None:
+        """Discard a dispatched-but-invalidated decode step: clear its
+        in-flight window and free the blocks allocated beyond each row's
+        real residency. The device still executes the discarded step, but
+        its writes land only at positions >= the speculative base — beyond
+        every registered prefix-cache block, and fully overwritten (in
+        device order) by whichever dispatch next owns those slots."""
+        for req in work.requests:
+            req.num_inflight_tokens = max(
+                0, req.num_inflight_tokens - work.window
+            )
+            if req.status.finished or req not in self.running:
+                continue  # blocks already released by its finish
+            keep = self._blocks_needed(
+                req.num_computed_tokens + req.num_inflight_tokens
+            )
+            while len(req.block_table) > keep:
+                self.pool.free_block(req.block_table.pop())
 
     # -- post-step ---------------------------------------------------------
 
@@ -553,6 +684,12 @@ class Scheduler:
                     results.append((req, []))
         else:
             for i, (req, row) in enumerate(zip(work.requests, sampled)):
+                if req.status.finished:
+                    # finished while the step was in flight (async abort /
+                    # stop-string hit): its blocks are already released and
+                    # its stream is closed — the sampled row is void
+                    results.append((req, []))
+                    continue
                 # bulk accept: a decode window hands up to `window` candidate
                 # tokens per row — the previous token-at-a-time loop
                 # (computed += 1, register, append, finish-check per token)

@@ -1146,6 +1146,17 @@ class EngineServer:
 # -- CLI -------------------------------------------------------------------
 
 
+def _parse_bool_flag(v: str) -> bool:
+    """Strict true/false parser — a typo like 'off' must not silently mean
+    True (the flag often gates a correctness bisection)."""
+    s = str(v).lower()
+    if s in ("1", "true", "yes", "on"):
+        return True
+    if s in ("0", "false", "no", "off"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected true/false, got {v!r}")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="TPU LLM serving engine")
     p.add_argument("--model", default="tiny-llama",
@@ -1234,6 +1245,15 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto", "fp8"],
                    help="KV pool storage dtype: fp8 (float8_e4m3fn) halves "
                         "KV HBM traffic and doubles pool capacity")
+    p.add_argument("--async-scheduling", default=True,
+                   type=_parse_bool_flag,
+                   help="two-deep pipelined step loop: dispatch step N+1 "
+                        "against speculatively-advanced state before step "
+                        "N's tokens sync to the host (decode inputs chain "
+                        "device-side; one D2H sync per resolved step). "
+                        "Token streams are bitwise identical to the serial "
+                        "loop. 'false' restores the serial "
+                        "schedule→execute→sync→postprocess path")
     p.add_argument("--enable-prefix-caching", action="store_true", default=True)
     p.add_argument("--no-enable-prefix-caching", dest="enable_prefix_caching",
                    action="store_false")
@@ -1336,6 +1356,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         prefill_attention_backend=getattr(
             args, "prefill_attention_backend", "auto"
         ),
+        async_scheduling=getattr(args, "async_scheduling", True),
     )
 
 
