@@ -286,6 +286,183 @@ def run_steploop_bench() -> dict:
     return out
 
 
+async def _routing_bench() -> dict:
+    """KV-aware routing lookup cost, fan-out vs indexed (CPU-only — pure
+    host-side code, so this number survives even when TPU preflight fails).
+
+    Old request path: router → controller → /kv/lookup probe on EVERY
+    engine (each probe walking the hash chain server-side) — measured here
+    as real aiohttp servers. New path: the event-driven cluster KV index
+    embedded in the router process (kv_index.ClusterKVIndex fed from each
+    pool's KVEventLog) answered in-process with zero network hops. The same
+    probe set runs through both; answers must MATCH (same pool state ⇒ same
+    matched_tokens) and indexed must be >=10x fan-out lookups/s."""
+    import asyncio
+
+    import numpy as np
+    from aiohttp import web
+
+    from vllm_production_stack_tpu.engine.kv_cache import KVBlockPool
+    from vllm_production_stack_tpu.engine.kv_controller import KVController
+    from vllm_production_stack_tpu.kv_index import ClusterKVIndex
+
+    N_ENGINES, BLOCK, N_PROMPTS, PROMPT_TOKENS = 4, 16, 32, 512
+    N_LOOKUPS = 300
+    pools = [KVBlockPool(2048, BLOCK) for _ in range(N_ENGINES)]
+
+    # index first sees each pool EMPTY via snapshot, then ingests the
+    # admissions through the real event stream — the push protocol under
+    # measurement, not a shortcut bulk load. Liveness TTL off: these
+    # simulated engines never heartbeat, and the fan-out phase runs
+    # between the feed and the indexed lookups
+    index = ClusterKVIndex(stale_after_s=None)
+    urls = [None] * N_ENGINES  # filled once servers bind
+
+    rng = np.random.RandomState(7)
+    prompts = [
+        [int(t) for t in rng.randint(1, 30000, size=PROMPT_TOKENS)]
+        for _ in range(N_PROMPTS)
+    ]
+
+    def admit(pool: KVBlockPool, ids: list[int]) -> None:
+        parent = pool.root_hash()
+        for i in range(len(ids) // BLOCK):
+            blk = pool.allocate()
+            assert blk is not None, "routing bench pool sized too small"
+            parent = pool.register_full_block(
+                blk, parent, tuple(ids[i * BLOCK : (i + 1) * BLOCK])
+            )
+
+    # fan-out side: each engine is a real aiohttp server whose /kv/lookup
+    # walks its pool's chain — the per-probe server-side work the old path
+    # pays on every routed request
+    def engine_app(pool: KVBlockPool) -> web.Application:
+        async def kv_lookup(request):
+            body = await request.json()
+            n = pool.match_length(list(body["token_ids"]))
+            return web.json_response({"matched_tokens": n})
+
+        app = web.Application()
+        app.router.add_post("/kv/lookup", kv_lookup)
+        return app
+
+    runners = []
+    try:
+        for i, pool in enumerate(pools):
+            runner = web.AppRunner(engine_app(pool))
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = runner.addresses[0][1]
+            urls[i] = f"http://127.0.0.1:{port}"
+            runners.append(runner)
+
+        # snapshot (empty), admit, then drain the REAL event logs into the
+        # index — each prompt lands on one engine; every third prompt's
+        # first half is also resident on the next engine
+        for i, pool in enumerate(pools):
+            epoch, seq, hashes = pool.snapshot_events()
+            index.apply({
+                "engine": urls[i], "epoch": epoch, "block_size": BLOCK,
+                "snapshot": True, "seq": seq,
+                "hashes": [f"{h:x}" for h in hashes],
+            })
+        for j, pr in enumerate(prompts):
+            k = j % N_ENGINES
+            admit(pools[k], pr)
+            if j % 3 == 0:
+                admit(pools[(k + 1) % N_ENGINES], pr[: PROMPT_TOKENS // 2])
+        for i, pool in enumerate(pools):
+            while True:
+                seq_start, events = pool.events.drain()
+                if not events:
+                    break
+                reply = index.apply({
+                    "engine": urls[i], "epoch": pool.events.epoch,
+                    "block_size": BLOCK, "seq_start": seq_start,
+                    "events": events,
+                })
+                assert reply.get("status") == "ok", reply
+
+        controller = KVController(urls, mode="fanout")
+        c_runner = web.AppRunner(controller.build_app())
+        await c_runner.setup()
+        c_site = web.TCPSite(c_runner, "127.0.0.1", 0)
+        await c_site.start()
+        runners.append(c_runner)
+        c_url = f"http://127.0.0.1:{c_runner.addresses[0][1]}"
+
+        # probe set: hits (full prompt), partial hits (prompt + junk tail),
+        # misses (fresh random) — identical for both paths
+        probes = []
+        for i in range(N_LOOKUPS):
+            pr = prompts[i % N_PROMPTS]
+            kind = i % 3
+            if kind == 0:
+                probes.append(pr)
+            elif kind == 1:
+                probes.append(
+                    pr + [int(t) for t in rng.randint(1, 30000, size=64)]
+                )
+            else:
+                probes.append(
+                    [int(t) for t in rng.randint(1, 30000, size=256)]
+                )
+
+        import aiohttp
+
+        fanout_lat, fanout_ans = [], []
+        async with aiohttp.ClientSession() as sess:
+            for ids in probes:
+                t0 = time.perf_counter()
+                async with sess.post(
+                    c_url + "/lookup", json={"token_ids": ids}
+                ) as resp:
+                    data = await resp.json()
+                fanout_lat.append(time.perf_counter() - t0)
+                fanout_ans.append(int(data["matched_tokens"]))
+
+        indexed_lat, indexed_ans = [], []
+        for ids in probes:
+            t0 = time.perf_counter()
+            _, n = index.lookup_token_ids(ids)
+            indexed_lat.append(time.perf_counter() - t0)
+            indexed_ans.append(n)
+    finally:
+        for runner in runners:
+            await runner.cleanup()
+
+    mismatches = sum(1 for a, b in zip(fanout_ans, indexed_ans) if a != b)
+
+    def pct(lat, p):
+        return round(sorted(lat)[min(len(lat) - 1, int(p * len(lat)))] * 1e3, 3)
+
+    fanout_lps = round(N_LOOKUPS / sum(fanout_lat), 1)
+    indexed_lps = round(N_LOOKUPS / sum(indexed_lat), 1)
+    return {
+        "engines": N_ENGINES,
+        "lookups": N_LOOKUPS,
+        "probes_per_fanout_lookup": controller.probes_sent / N_LOOKUPS,
+        "fanout": {"lookups_s": fanout_lps,
+                   "p50_ms": pct(fanout_lat, 0.50),
+                   "p99_ms": pct(fanout_lat, 0.99)},
+        "indexed": {"lookups_s": indexed_lps,
+                    "p50_ms": pct(indexed_lat, 0.50),
+                    "p99_ms": pct(indexed_lat, 0.99)},
+        "speedup": round(indexed_lps / fanout_lps, 1) if fanout_lps else None,
+        "answers_match": mismatches == 0,
+        "mismatches": mismatches,
+    }
+
+
+def _phase_routing_main() -> None:
+    """Subprocess entry for the CPU-only routing lookup bench."""
+    import asyncio
+
+    result = asyncio.run(_routing_bench())
+    print(json.dumps({"routing": result}), flush=True)
+
+
 def _phase_micro_main() -> None:
     """Subprocess entry: enable the persistent compile cache, run the
     microbench (+ the step-loop attribution bench), print its JSON."""
@@ -325,10 +502,20 @@ def main() -> None:
         phase = sys.argv[sys.argv.index("--phase") + 1]
         if phase == "preflight":
             _phase_preflight_main()
+        elif phase == "routing":
+            _phase_routing_main()
         else:
             assert phase == "micro", phase
             _phase_micro_main()
         return
+
+    # -1) routing lookup bench: pure host-side CPU code, runs BEFORE the
+    # chip preflight so the KV-index routing numbers land in the tail even
+    # when the TPU tunnel is wedged (every BENCH_r0*.json so far)
+    routing = _run_phase(
+        "routing", ["bench.py", "--phase", "routing"],
+        timeout_s=300, key="routing", min_needed_s=60.0,
+    )
 
     # 0) chip preflight: one trivial dispatch. A wedged tunnel fails HERE
     # in minutes with an explicit section; the heavy phases are then
@@ -348,6 +535,7 @@ def main() -> None:
             "vs_baseline": 0.0,
             "error": "chip preflight failed — no TPU dispatch possible",
             "preflight": preflight,
+            "routing": routing,
             "total_elapsed_s": round(time.monotonic() - _t_start, 1),
         }), flush=True)
         return
@@ -414,6 +602,7 @@ def main() -> None:
         "northstar": northstar,
         "int8_8b": int8_8b,
         "microbench": micro,
+        "routing": routing,
         "total_elapsed_s": round(time.monotonic() - _t_start, 1),
     }), flush=True)
 

@@ -16,12 +16,19 @@ from vllm_production_stack_tpu.engine.request import SamplingParams
 
 @pytest.fixture(scope="module")
 def pipe():
-    return LLMEngine(EngineConfig.tiny())
+    engine = LLMEngine(EngineConfig.tiny())
+    yield engine
+    # cancel queued background compiles: leaked compile threads steal CPU
+    # from whatever module runs next (observed: pacing flakes in
+    # test_benchmarks' open-loop drive)
+    engine.runner.shutdown(wait=True)
 
 
 @pytest.fixture(scope="module")
 def serial():
-    return LLMEngine(EngineConfig.tiny().replace(async_scheduling=False))
+    engine = LLMEngine(EngineConfig.tiny().replace(async_scheduling=False))
+    yield engine
+    engine.runner.shutdown(wait=True)
 
 
 def prompt_ids(seed, n):
@@ -192,4 +199,7 @@ def test_spec_decode_forces_serial_path():
         scheduler=replace(cfg.scheduler, num_speculative_tokens=2)
     )
     eng = LLMEngine(cfg)
-    assert not eng._pipeline  # proposer needs host-resident token values
+    try:
+        assert not eng._pipeline  # proposer needs host-resident token values
+    finally:
+        eng.runner.shutdown(wait=True)  # no compile threads outlive the module

@@ -91,6 +91,20 @@ def test_livestack_open_loop_drive():
         ])))
         await router_srv.start_server()
         try:
+            # one warmup request: the cold path's one-time costs (router
+            # first hop, connection setup, CPU stolen by a previous test
+            # module's still-draining background compile thread) otherwise
+            # land inside the fixed schedule origin and every later slot
+            # counts as slipped — the real bench warms up before driving too
+            import aiohttp
+
+            async with aiohttp.ClientSession() as warm:
+                async with warm.post(
+                    f"http://127.0.0.1:{router_srv.port}/v1/completions",
+                    json={"model": "fake-model", "prompt": "warmup",
+                          "max_tokens": 1},
+                ) as resp:
+                    assert resp.status == 200
             return await _drive(
                 f"http://127.0.0.1:{router_srv.port}", "fake-model",
                 users=users, rounds=rounds, answer_tokens=8,

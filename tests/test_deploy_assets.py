@@ -53,6 +53,9 @@ def test_templates_use_only_real_cli_flags():
     """Every --flag the templates pass must exist in the engine/router CLIs
     (dead flags in deployment templates are exactly the 'advertised but
     unbuilt' failure VERDICT r1 flagged)."""
+    from vllm_production_stack_tpu.engine.kv_controller import (
+        build_parser as controller_parser,
+    )
     from vllm_production_stack_tpu.engine.server import build_parser
     from vllm_production_stack_tpu.kvstore.server import (
         build_parser as kvstore_parser,
@@ -60,7 +63,8 @@ def test_templates_use_only_real_cli_flags():
     from vllm_production_stack_tpu.router.args import build_parser as router_parser
 
     known = set()
-    for parser in (build_parser(), router_parser(), kvstore_parser()):
+    for parser in (build_parser(), router_parser(), kvstore_parser(),
+                   controller_parser()):
         for action in parser._actions:
             known.update(action.option_strings)
     known.add("--pipeline-parallel-size")  # multihost statefulset flag

@@ -1,0 +1,892 @@
+"""Event-driven cluster KV index tests (engine/kv_events.py, kv_index.py,
+the indexed KV controller, and the router's embedded-index kvaware mode).
+
+All host-side: real KVBlockPools (no device), real aiohttp servers where the
+wire matters. The core guarantees under test:
+
+- indexed lookups EQUAL fan-out lookups on identical pool state;
+- indexed mode sends ZERO per-request engine probes, and falls back to
+  fan-out automatically for stale (sequence-gapped) engines;
+- evictions and clears mirror into the index through the event stream;
+- a lost event batch (gap) forces a resync that heals the index.
+"""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vllm_production_stack_tpu.engine.kv_cache import KVBlockPool
+from vllm_production_stack_tpu.engine.kv_controller import KVController
+from vllm_production_stack_tpu.engine.kv_events import (
+    KVEventLog,
+    KVEventPublisher,
+)
+from vllm_production_stack_tpu.kv_index import ClusterKVIndex, chain_hashes
+from vllm_production_stack_tpu.router.discovery import Endpoint
+from vllm_production_stack_tpu.router.routing import make_policy
+from vllm_production_stack_tpu.router.routing import RoutingContext
+
+BLOCK = 4
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def admit(pool: KVBlockPool, ids: list[int]) -> list[int]:
+    """Register ids' full blocks as computed KV; returns the block ids."""
+    parent = pool.root_hash()
+    blocks = []
+    for i in range(len(ids) // pool.block_size):
+        blk = pool.allocate()
+        assert blk is not None
+        parent = pool.register_full_block(
+            blk, parent,
+            tuple(ids[i * pool.block_size : (i + 1) * pool.block_size]),
+        )
+        blocks.append(blk)
+    return blocks
+
+
+def feed(index: ClusterKVIndex, url: str, pool: KVBlockPool) -> None:
+    """Push a pool's full state into the index THROUGH the event protocol:
+    empty snapshot isn't needed — a direct snapshot of current state is the
+    resync path; incremental tests drain the log explicitly."""
+    epoch, seq, hashes = pool.snapshot_events()
+    reply = index.apply({
+        "engine": url, "epoch": epoch, "block_size": pool.block_size,
+        "snapshot": True, "seq": seq, "hashes": [f"{h:x}" for h in hashes],
+    })
+    assert reply["status"] == "ok"
+
+
+def drain_into(index: ClusterKVIndex, url: str, pool: KVBlockPool) -> dict:
+    """Ship everything buffered in the pool's event log; returns the last
+    reply (so callers can assert on resync)."""
+    reply = {"status": "ok"}
+    while True:
+        seq_start, events = pool.events.drain()
+        if not events:
+            return reply
+        reply = index.apply({
+            "engine": url, "epoch": pool.events.epoch,
+            "block_size": pool.block_size,
+            "seq_start": seq_start, "events": events,
+        })
+
+
+def test_indexed_equals_fanout_on_same_pool_state():
+    """Same pool state ⇒ same answer: the index walk must reproduce
+    match_length exactly, for hits, partial hits, and misses."""
+    pools = {f"http://e{i}": KVBlockPool(256, BLOCK) for i in range(3)}
+    p0 = list(range(100, 140))  # 10 blocks on e0, first 5 also on e1
+    p1 = list(range(500, 524))  # 6 blocks on e1 only
+    admit(pools["http://e0"], p0)
+    admit(pools["http://e1"], p0[: 5 * BLOCK])
+    admit(pools["http://e1"], p1)
+
+    index = ClusterKVIndex()
+    for url, pool in pools.items():
+        feed(index, url, pool)
+
+    probes = [
+        p0,                       # full hit on e0
+        p0 + [1, 2, 3, 4, 5],     # hit + junk tail
+        p0[: 3 * BLOCK],          # short prefix (both e0 and e1 have it)
+        p1,                       # e1 only
+        list(range(900, 932)),    # miss everywhere
+        p0[: BLOCK - 1],          # below one block: no full block to match
+    ]
+    for ids in probes:
+        url, matched = index.lookup_token_ids(ids)
+        fanout = {u: p.match_length(list(ids)) for u, p in pools.items()}
+        assert matched == max(fanout.values()), ids
+        if matched > 0:
+            assert fanout[url] == matched  # the named engine really has it
+
+
+def test_eviction_and_clear_mirror_into_index():
+    pool = KVBlockPool(6, BLOCK)  # 5 usable
+    ids_a = list(range(0, 4 * BLOCK))
+    blocks = admit(pool, ids_a)
+    index = ClusterKVIndex()
+    feed(index, "http://e0", pool)
+    assert index.lookup_token_ids(ids_a) == ("http://e0", 4 * BLOCK)
+
+    for blk in blocks:
+        pool.free_block(blk)  # park: refcount 0, still addressable
+    # admitting B evicts A's oldest blocks (no host tier -> evict events)
+    ids_b = list(range(1000, 1000 + 4 * BLOCK))
+    admit(pool, ids_b)
+    assert drain_into(index, "http://e0", pool)["status"] == "ok"
+    url, matched = index.lookup_token_ids(ids_a)
+    assert matched == pool.match_length(ids_a)  # still equivalent
+    assert matched < 4 * BLOCK  # and genuinely shrunk
+    assert index.lookup_token_ids(ids_b) == ("http://e0", 4 * BLOCK)
+
+
+def test_clear_event_empties_engine_slice():
+    pool = KVBlockPool(16, BLOCK)
+    ids = list(range(0, 3 * BLOCK))
+    blocks = admit(pool, ids)
+    index = ClusterKVIndex()
+    feed(index, "http://e0", pool)
+    for blk in blocks:
+        pool.free_block(blk)
+    pool.clear_prefix_cache()
+    assert drain_into(index, "http://e0", pool)["status"] == "ok"
+    assert index.lookup_token_ids(ids) == (None, 0)
+    assert pool.match_length(ids) == 0  # equivalence holds after clear
+
+
+def test_sequence_gap_forces_resync_and_snapshot_heals():
+    pool = KVBlockPool(64, BLOCK)
+    index = ClusterKVIndex()
+    feed(index, "http://e0", pool)  # empty snapshot: engine is fresh
+    assert index.fresh_engines() == {"http://e0"}
+
+    admit(pool, list(range(0, 2 * BLOCK)))
+    assert drain_into(index, "http://e0", pool)["status"] == "ok"
+
+    # lose a batch on the floor (publisher crash, dropped POST)
+    admit(pool, list(range(100, 100 + 2 * BLOCK)))
+    pool.events.drain()  # drained but never shipped
+
+    admit(pool, list(range(200, 200 + 2 * BLOCK)))
+    reply = drain_into(index, "http://e0", pool)
+    assert reply.get("resync") is True  # gap detected
+    assert index.fresh_engines() == set()  # stale: no indexed answers
+    assert index.lookup_token_ids(list(range(0, 2 * BLOCK))) == (None, 0)
+
+    # full snapshot heals, including the events that were lost
+    feed(index, "http://e0", pool)
+    assert index.fresh_engines() == {"http://e0"}
+    for start in (0, 100, 200):
+        ids = list(range(start, start + 2 * BLOCK))
+        assert index.lookup_token_ids(ids) == ("http://e0", 2 * BLOCK)
+
+
+def test_epoch_change_forces_resync():
+    index = ClusterKVIndex()
+    index.apply({"engine": "http://e0", "epoch": "aaa", "block_size": BLOCK,
+                 "snapshot": True, "seq": 0, "hashes": []})
+    reply = index.apply({"engine": "http://e0", "epoch": "bbb",
+                         "block_size": BLOCK, "seq_start": 1,
+                         "events": [["a", "ff", "0"]]})
+    assert reply.get("resync") is True
+
+
+class _ProbeCountingEngine:
+    """A /kv/lookup endpoint that counts how often it is probed."""
+
+    def __init__(self, pool: KVBlockPool):
+        self.pool = pool
+        self.probes = 0
+
+    def build_app(self):
+        from aiohttp import web
+
+        async def kv_lookup(request):
+            self.probes += 1
+            body = await request.json()
+            return web.json_response(
+                {"matched_tokens": self.pool.match_length(
+                    list(body["token_ids"]))}
+            )
+
+        app = web.Application()
+        app.router.add_post("/kv/lookup", kv_lookup)
+        return app
+
+
+def test_indexed_controller_sends_zero_probes_and_falls_back_when_stale():
+    """THE tentpole guarantee: /lookup in indexed mode answers with zero
+    per-request engine traffic; a stale engine automatically degrades to a
+    fan-out probe of just that engine."""
+
+    async def go():
+        pool_a = KVBlockPool(64, BLOCK)
+        pool_b = KVBlockPool(64, BLOCK)
+        ids = list(range(0, 4 * BLOCK))
+        admit(pool_a, ids)
+        admit(pool_b, ids[: 2 * BLOCK])
+
+        fa, fb = _ProbeCountingEngine(pool_a), _ProbeCountingEngine(pool_b)
+        ca = TestClient(TestServer(fa.build_app()))
+        cb = TestClient(TestServer(fb.build_app()))
+        await ca.start_server()
+        await cb.start_server()
+        url_a = str(ca.make_url("")).rstrip("/")
+        url_b = str(cb.make_url("")).rstrip("/")
+
+        controller = KVController([url_a, url_b], mode="indexed")
+        cc = TestClient(TestServer(controller.build_app()))
+        await cc.start_server()
+        try:
+            # both engines publish snapshots through the REAL wire
+            for url, pool in ((url_a, pool_a), (url_b, pool_b)):
+                epoch, seq, hashes = pool.snapshot_events()
+                r = await cc.post("/kv/events", json={
+                    "engine": url, "epoch": epoch, "block_size": BLOCK,
+                    "snapshot": True, "seq": seq,
+                    "hashes": [f"{h:x}" for h in hashes],
+                })
+                assert (await r.json())["status"] == "ok"
+
+            r = await cc.post("/lookup", json={"token_ids": ids})
+            data = await r.json()
+            assert data["mode"] == "indexed"
+            assert data["url"] == url_a
+            assert data["matched_tokens"] == 4 * BLOCK
+            assert fa.probes == 0 and fb.probes == 0
+            assert controller.probes_sent == 0
+
+            # make engine B stale: shipped batch with a sequence gap
+            r = await cc.post("/kv/events", json={
+                "engine": url_b, "epoch": pool_b.events.epoch,
+                "block_size": BLOCK, "seq_start": 999,
+                "events": [["a", "ff", "0"]],
+            })
+            assert (await r.json()).get("resync") is True
+
+            r = await cc.post("/lookup", json={"token_ids": ids})
+            data = await r.json()
+            assert data["mode"] == "mixed"  # indexed A + probed B
+            assert data["url"] == url_a
+            assert data["matched_tokens"] == 4 * BLOCK
+            assert fa.probes == 0  # fresh engine still never probed
+            assert fb.probes == 1  # stale engine fanned out
+
+            # LoRA lookups can't be hashed cluster-side -> full fan-out
+            r = await cc.post("/lookup", json={"token_ids": ids,
+                                               "model": "my-adapter"})
+            assert (await r.json())["mode"] == "fanout"
+            assert fa.probes == 1 and fb.probes == 2
+        finally:
+            await cc.close()
+            await ca.close()
+            await cb.close()
+
+    run(go())
+
+
+def test_embedded_kvaware_policy_routes_from_index_with_no_http():
+    """Router-side embedded mode: the kvaware policy answers from its
+    in-process index — no controller hop, no engine probes, no outbound
+    session at all."""
+    policy = make_policy(
+        "kvaware", kv_index_mode="embedded", kv_index_tokenizer="byte",
+        kv_aware_threshold=BLOCK,
+    )
+    assert policy.index is not None
+
+    prompt = "the quick brown fox jumps over the lazy dog" * 3
+    ids = policy.tokenizer.encode(prompt)
+    hashes = chain_hashes(ids, BLOCK)
+    policy.index.apply({
+        "engine": "http://warm", "epoch": "e1", "block_size": BLOCK,
+        "snapshot": True, "seq": 0, "hashes": [f"{h:x}" for h in hashes],
+    })
+    policy.index.apply({
+        "engine": "http://cold", "epoch": "e2", "block_size": BLOCK,
+        "snapshot": True, "seq": 0, "hashes": [],
+    })
+
+    endpoints = [Endpoint(url="http://warm"), Endpoint(url="http://cold")]
+
+    async def go():
+        url = await policy.route(
+            RoutingContext(endpoints=endpoints, body={"prompt": prompt})
+        )
+        assert url == "http://warm"
+        # authoritative miss -> least-loaded, still no controller hop
+        url = await policy.route(
+            RoutingContext(endpoints=endpoints,
+                           body={"prompt": "never seen before zzz"})
+        )
+        assert url in ("http://warm", "http://cold")
+
+    run(go())
+    assert policy._http.session is None  # zero outbound HTTP on the request path
+    modes = {m for m, _ in policy.drain_lookup_log()}
+    assert modes == {"indexed"}
+
+
+def test_make_policy_embedded_requires_tokenizer():
+    """Dynamic-config swaps bypass args.py validation, so make_policy must
+    enforce the embedded-mode tokenizer itself — a silent byte default
+    would hash prompts differently from HF-tokenized engines and degrade
+    kvaware to least-loaded with no sign anything is wrong."""
+    with pytest.raises(ValueError, match="kv_index_tokenizer"):
+        make_policy("kvaware", kv_index_mode="embedded")
+
+
+def test_embedded_policy_normalizes_trailing_slash_endpoints():
+    """Discovery may carry trailing-slash URLs while publishers register
+    rstripped — a resident match must still route (and return the
+    discovery-shaped URL the proxy expects)."""
+    policy = make_policy(
+        "kvaware", kv_index_mode="embedded", kv_index_tokenizer="byte",
+        kv_aware_threshold=BLOCK,
+    )
+    prompt = "the quick brown fox jumps over the lazy dog" * 3
+    ids = policy.tokenizer.encode(prompt)
+    hashes = chain_hashes(ids, BLOCK)
+    policy.index.apply({
+        "engine": "http://warm", "epoch": "e1", "block_size": BLOCK,
+        "snapshot": True, "seq": 0, "hashes": [f"{h:x}" for h in hashes],
+    })
+    url = run(policy.route(RoutingContext(
+        endpoints=[Endpoint(url="http://warm/")], body={"prompt": prompt},
+    )))
+    assert url == "http://warm/"
+    assert {m for m, _ in policy.drain_lookup_log()} == {"indexed"}
+
+
+def test_embedded_policy_churn_keeps_slice_but_deregister_frees_it():
+    """Discovery churn must NOT free an index slice — a health-probe flap
+    would otherwise force a full snapshot resync. Lookups already restrict
+    to available endpoints, so the flapped engine drops out of answers
+    anyway; an explicit /deregister still frees the slice immediately."""
+    policy = make_policy(
+        "kvaware", kv_index_mode="embedded", kv_index_tokenizer="byte",
+    )
+    policy.index.apply({
+        "engine": "http://flap", "epoch": "e", "block_size": BLOCK,
+        "snapshot": True, "seq": 0, "hashes": ["ff"],
+    })
+    assert policy.index.fresh_engines() == {"http://flap"}
+    policy.on_endpoints_changed({"http://flap"}, set())
+    # slice kept: the engine heals instantly when discovery re-adds it...
+    assert policy.index.fresh_engines() == {"http://flap"}
+    # ...but an availability-restricted lookup never routes to it
+    assert policy.index.fresh_engines({"http://other"}) == set()
+    policy.index.remove_engine("http://flap")  # the /deregister path
+    assert policy.index.fresh_engines() == set()
+
+
+def test_dead_engine_slice_purged_after_grace():
+    """An engine silent past purge_after_s loses its memory outright (a
+    scaled-down pod must not hold hashes forever); a publishing engine is
+    never purged."""
+    idx = ClusterKVIndex(stale_after_s=None, purge_after_s=0.05)
+    for url in ("http://gone", "http://alive"):
+        idx.apply({
+            "engine": url, "epoch": "e", "block_size": BLOCK,
+            "snapshot": True, "seq": 0, "hashes": ["ff"],
+        })
+    import time as _time
+
+    _time.sleep(0.08)
+    # alive's heartbeat both refreshes it and sweeps the dead slice
+    idx.apply({
+        "engine": "http://alive", "epoch": "e", "block_size": BLOCK,
+        "seq_start": 1, "events": [],
+    })
+    assert idx.stats()["engines"] == 1
+    assert idx.fresh_engines() == {"http://alive"}
+
+
+def test_controller_lookup_fault_degrades_to_fanout():
+    """A tokenizer fault (e.g. a malformed text payload) on the indexed
+    path must degrade to fan-out, not surface as HTTP 500 — the engines
+    hash the prompt themselves either way."""
+
+    async def go():
+        pool = KVBlockPool(64, BLOCK)
+        engine = _ProbeCountingEngine(pool)
+        ec = TestClient(TestServer(engine.build_app()))
+        await ec.start_server()
+        url = str(ec.make_url("")).rstrip("/")
+
+        class Boom:
+            def encode(self, text):
+                raise TypeError("not a string")
+
+        controller = KVController([url], mode="indexed", tokenizer=Boom())
+        # make the engine's slice fresh so the indexed path is attempted
+        controller.index.apply({
+            "engine": url, "epoch": "e", "block_size": BLOCK,
+            "snapshot": True, "seq": 0, "hashes": ["ff"],
+        })
+        try:
+            data = await controller.lookup({"text": ["not", "a", "string"]})
+            assert data["mode"] == "fanout"
+            assert controller.probes_sent == 1
+        finally:
+            await controller._http.close()
+            await ec.close()
+
+    run(go())
+
+
+def test_event_publisher_snapshot_then_batches_then_gap_resync():
+    """The engine-side publisher against a real controller over the wire:
+    first contact snapshots, steady state ships batches, a buffer overflow
+    (capacity exceeded between flushes) triggers an automatic resync."""
+
+    async def go():
+        pool = KVBlockPool(256, BLOCK)
+        # tiny capacity so a burst overflows between flushes
+        pool.events = KVEventLog(capacity=8)
+        controller = KVController(mode="indexed")
+        cc = TestClient(TestServer(controller.build_app()))
+        await cc.start_server()
+        url = str(cc.make_url("")).rstrip("/")
+
+        import aiohttp
+
+        sess = aiohttp.ClientSession()
+
+        async def snapshot_fn():
+            return pool.snapshot_events()
+
+        pub = KVEventPublisher(
+            url, "http://engine-1", pool.events, snapshot_fn, BLOCK,
+            lambda: sess,
+        )
+        try:
+            ids = list(range(0, 4 * BLOCK))
+            admit(pool, ids)
+            await pub.flush()  # first contact: snapshot
+            assert pub.snapshots_sent == 1
+            assert controller.index.lookup_token_ids(ids) == \
+                ("http://engine-1", 4 * BLOCK)
+
+            ids2 = list(range(100, 100 + 2 * BLOCK))
+            admit(pool, ids2)
+            await pub.flush()  # steady state: incremental events
+            assert pub.snapshots_sent == 1 and pub.events_sent == 2
+            assert controller.index.lookup_token_ids(ids2) == \
+                ("http://engine-1", 2 * BLOCK)
+
+            # burst past the log capacity: oldest events dropped locally
+            ids3 = list(range(1000, 1000 + 12 * BLOCK))
+            admit(pool, ids3)
+            await pub.flush()  # detects its own gap -> schedules resync
+            await pub.flush()  # resync snapshot
+            assert pub.snapshots_sent == 2
+            assert controller.index.lookup_token_ids(ids3) == \
+                ("http://engine-1", 12 * BLOCK)
+            assert controller.index.fresh_engines() == {"http://engine-1"}
+        finally:
+            await sess.close()
+            await cc.close()
+
+    run(go())
+
+
+def test_index_memory_bound_resets_to_stale():
+    index = ClusterKVIndex(max_hashes_per_engine=4)
+    index.apply({"engine": "http://e0", "epoch": "e", "block_size": BLOCK,
+                 "snapshot": True, "seq": 0, "hashes": []})
+    reply = index.apply({
+        "engine": "http://e0", "epoch": "e", "block_size": BLOCK,
+        "seq_start": 1,
+        "events": [["a", f"{h:x}", "0"] for h in range(10, 16)],
+    })
+    assert reply.get("resync") is True
+    assert index.fresh_engines() == set()
+
+
+def test_router_app_mounts_kv_events_in_embedded_mode():
+    """Engines pointed at the router (KV_CONTROLLER_URL=router) can publish
+    and register; non-embedded policies answer 409."""
+    from vllm_production_stack_tpu.router.app import build_app
+    from vllm_production_stack_tpu.router.args import parse_args
+
+    async def go():
+        args = parse_args([
+            "--static-backends", "http://e0",
+            "--routing-logic", "kvaware",
+            "--kv-index-mode", "embedded",
+            "--kv-index-tokenizer", "byte",
+        ])
+        client = TestClient(TestServer(build_app(args)))
+        await client.start_server()
+        try:
+            r = await client.post("/kv/events", json={
+                "engine": "http://e0", "epoch": "e", "block_size": BLOCK,
+                "snapshot": True, "seq": 0, "hashes": ["ab"],
+            })
+            assert r.status == 200
+            assert (await r.json())["status"] == "ok"
+            r = await client.post("/register", json={"url": "http://e0"})
+            assert r.status == 200
+            r = await client.post("/deregister", json={"url": "http://e0"})
+            assert r.status == 200
+            state = client.app["state"]
+            assert state.policy.index.fresh_engines() == set()  # deregistered
+            # metrics render includes the cluster index names
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert "tpu:cluster_kv_index_engines" in text
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_router_kv_events_409_without_embedded_policy():
+    from vllm_production_stack_tpu.router.app import build_app
+    from vllm_production_stack_tpu.router.args import parse_args
+
+    async def go():
+        args = parse_args(["--static-backends", "http://e0"])
+        client = TestClient(TestServer(build_app(args)))
+        await client.start_server()
+        try:
+            r = await client.post("/kv/events", json={"engine": "http://e0"})
+            assert r.status == 409
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_parser_embedded_mode_requires_tokenizer():
+    from vllm_production_stack_tpu.router.args import parse_args
+
+    with pytest.raises(SystemExit):
+        parse_args([
+            "--static-backends", "http://e0",
+            "--routing-logic", "kvaware",
+            "--kv-index-mode", "embedded",
+        ])
+    # and embedded mode SATISFIES the controller-url requirement
+    args = parse_args([
+        "--static-backends", "http://e0",
+        "--routing-logic", "kvaware",
+        "--kv-index-mode", "embedded",
+        "--kv-index-tokenizer", "byte",
+    ])
+    assert args.kv_index_mode == "embedded"
+
+
+def test_embedded_policy_partial_freshness_is_not_authoritative():
+    """One publishing engine + one legacy engine: the index must NOT claim
+    authority over the whole cluster — a sub-threshold indexed match has to
+    escalate (controller hop when configured) instead of silently going
+    least-loaded for engines the index can't speak for."""
+    policy = make_policy(
+        "kvaware", kv_index_mode="embedded", kv_index_tokenizer="byte",
+        kv_aware_threshold=BLOCK,
+    )
+    policy.index.apply({
+        "engine": "http://fresh", "epoch": "e", "block_size": BLOCK,
+        "snapshot": True, "seq": 0, "hashes": [],
+    })
+    endpoints = [Endpoint(url="http://fresh"), Endpoint(url="http://legacy")]
+
+    async def go():
+        ctx = RoutingContext(endpoints=endpoints, body={"prompt": "hello"})
+        _, _, authoritative, _ = await policy._indexed_lookup(
+            ctx, {e.url for e in endpoints}
+        )
+        assert authoritative is False
+        _, _, authoritative, _ = await policy._indexed_lookup(
+            ctx, {"http://fresh"}
+        )
+        assert authoritative is True
+
+    run(go())
+
+
+def test_embedded_policy_skips_index_for_lora_adapters():
+    """Adapter KV chains are salted engine-side — the embedded index must
+    not match an adapter request against unsalted base hashes."""
+    from vllm_production_stack_tpu.router.discovery import ModelInfo
+
+    policy = make_policy(
+        "kvaware", kv_index_mode="embedded", kv_index_tokenizer="byte",
+        kv_aware_threshold=BLOCK,
+    )
+    prompt = "shared adapter prompt " * 4
+    ids = policy.tokenizer.encode(prompt)
+    policy.index.apply({
+        "engine": "http://base-warm", "epoch": "e", "block_size": BLOCK,
+        "snapshot": True, "seq": 0,
+        "hashes": [f"{h:x}" for h in chain_hashes(ids, BLOCK)],
+    })
+    eps_list = [
+        Endpoint(
+            url="http://base-warm",
+            model_info={"my-lora": ModelInfo(id="my-lora", parent="base")},
+        ),
+        Endpoint(url="http://other"),
+    ]
+
+    async def go():
+        # base-model request: indexed match wins
+        url = await policy.route(RoutingContext(
+            endpoints=eps_list, body={"prompt": prompt, "model": "base"}
+        ))
+        assert url == "http://base-warm"
+        assert {m for m, _ in policy.drain_lookup_log()} == {"indexed"}
+        # adapter request: index bypassed entirely (no controller configured
+        # -> least-loaded), so no indexed lookup is ever observed
+        await policy.route(RoutingContext(
+            endpoints=eps_list, body={"prompt": prompt, "model": "my-lora"},
+        ))
+        assert policy.drain_lookup_log() == []
+
+    run(go())
+
+
+def test_session_policy_empty_endpoints_with_header_raises():
+    policy = make_policy("session", session_key="x-user-id")
+    with pytest.raises(LookupError):
+        run(policy.route(RoutingContext(
+            endpoints=[], headers={"x-user-id": "u1"}
+        )))
+
+
+def test_liveness_ttl_expires_dead_publisher():
+    """An engine that stops posting (crash, partition) must expire out of
+    indexed answers — and heal WITHOUT a resync when it resumes in
+    sequence (the slice is kept, only freshness lapses)."""
+    index = ClusterKVIndex(stale_after_s=5.0)
+    pool = KVBlockPool(16, BLOCK)
+    ids = list(range(0, 2 * BLOCK))
+    admit(pool, ids)
+    feed(index, "http://e0", pool)
+    assert index.fresh_engines() == {"http://e0"}
+
+    # simulate publisher silence past the TTL (no sleeping in tests)
+    index._engines["http://e0"].last_event_t -= 6.0
+    assert index.fresh_engines() == set()
+    assert index.stats()["stale_engines"] == 1
+    assert index.lookup_token_ids(ids) == (None, 0)
+
+    # a heartbeat (empty in-sequence batch) revives the slice as-is
+    reply = index.apply({
+        "engine": "http://e0", "epoch": pool.events.epoch,
+        "block_size": BLOCK, "seq_start": pool.events.seq + 1, "events": [],
+    })
+    assert reply["status"] == "ok"
+    assert index.fresh_engines() == {"http://e0"}
+    assert index.lookup_token_ids(ids) == ("http://e0", 2 * BLOCK)
+
+
+def test_publisher_heartbeat_refreshes_liveness(monkeypatch):
+    """An idle publisher (no cache churn) posts empty in-sequence batches
+    so the subscriber's TTL can tell quiet from dead."""
+    from vllm_production_stack_tpu.engine import kv_events as ke
+
+    monkeypatch.setattr(ke, "HEARTBEAT_INTERVAL_S", 0.0)
+
+    async def go():
+        pool = KVBlockPool(64, BLOCK)
+        controller = KVController(mode="indexed")
+        cc = TestClient(TestServer(controller.build_app()))
+        await cc.start_server()
+        url = str(cc.make_url("")).rstrip("/")
+
+        import aiohttp
+
+        sess = aiohttp.ClientSession()
+
+        async def snapshot_fn():
+            return pool.snapshot_events()
+
+        pub = KVEventPublisher(
+            url, "http://e0", pool.events, snapshot_fn, BLOCK, lambda: sess,
+        )
+        try:
+            ids = list(range(0, 2 * BLOCK))
+            admit(pool, ids)
+            await pub.flush()  # first contact: snapshot
+            controller.index._engines["http://e0"].last_event_t -= 100.0
+            assert controller.index.fresh_engines() == set()
+            await pub.flush()  # nothing buffered -> heartbeat
+            assert controller.index.fresh_engines() == {"http://e0"}
+            assert pub.snapshots_sent == 1  # healed by heartbeat, no resync
+            assert controller.index.lookup_token_ids(ids) == \
+                ("http://e0", 2 * BLOCK)
+        finally:
+            await sess.close()
+            await cc.close()
+
+    run(go())
+
+
+def test_publisher_resync_only_on_lost_event_batch():
+    """A transient POST failure forces a full resync ONLY when a drained
+    event batch was actually lost in flight — a failed heartbeat (or
+    snapshot) loses nothing, so the publisher must NOT re-ship the whole
+    pool after every controller blip."""
+
+    async def go():
+        pool = KVBlockPool(64, BLOCK)
+
+        async def snapshot_fn():
+            return pool.snapshot_events()
+
+        fail = {"on": False}
+        posted = []
+
+        async def fake_post(payload):
+            if fail["on"]:
+                raise RuntimeError("controller blip")
+            posted.append(payload)
+            pub._last_post_t = time.monotonic()
+            return {"status": "ok"}
+
+        pub = KVEventPublisher(
+            "http://c", "http://e0", pool.events, snapshot_fn, BLOCK,
+            lambda: None,
+        )
+        pub._post = fake_post
+
+        admit(pool, list(range(0, BLOCK)))
+        await pub.flush()  # first contact: snapshot
+        assert posted[-1].get("snapshot") and not pub._need_snapshot
+
+        # failed heartbeat: nothing was drained, no resync owed
+        fail["on"] = True
+        pub._last_post_t = 0.0  # long silence -> heartbeat due
+        with pytest.raises(RuntimeError):
+            await pub.flush()
+        assert not pub._need_snapshot
+
+        # failed event-batch POST: the drained events are gone — resync owed
+        admit(pool, list(range(BLOCK, 2 * BLOCK)))
+        with pytest.raises(RuntimeError):
+            await pub.flush()
+        assert pub._need_snapshot
+
+        # recovery re-ships the full pool exactly once
+        fail["on"] = False
+        await pub.flush()
+        assert posted[-1].get("snapshot") and not pub._need_snapshot
+        assert pub.snapshots_sent == 2
+
+    run(go())
+
+
+def test_controller_base_models_stay_indexed():
+    """OpenAI-style clients put the served model name in every request;
+    names listed in --base-models must stay on the indexed path instead of
+    being treated as LoRA adapters (which fan out)."""
+
+    async def go():
+        pool = KVBlockPool(64, BLOCK)
+        ids = list(range(0, 3 * BLOCK))
+        admit(pool, ids)
+        controller = KVController(
+            ["http://e0"], mode="indexed", base_models=["tiny-llama"],
+        )
+        feed(controller.index, "http://e0", pool)
+        try:
+            data = await controller.lookup(
+                {"token_ids": ids, "model": "tiny-llama"}
+            )
+            assert data["mode"] == "indexed"
+            assert data["matched_tokens"] == 3 * BLOCK
+            assert controller.probes_sent == 0
+            # any OTHER name is adapter traffic: engine-salted chains only
+            # engine probes can hash
+            data = await controller.lookup(
+                {"token_ids": ids, "model": "some-adapter"}
+            )
+            assert data["mode"] == "fanout"
+            assert controller.probes_sent == 1
+        finally:
+            await controller._http.close()
+
+    run(go())
+
+
+def test_embedded_policy_tokenizer_fault_degrades_to_fallback():
+    """A tokenizer/index fault on the embedded path must degrade to the
+    least-loaded fallback like the controller path does — not 500 every
+    request."""
+    policy = make_policy(
+        "kvaware", kv_index_mode="embedded", kv_index_tokenizer="byte",
+        kv_aware_threshold=BLOCK,
+    )
+    policy.index.apply({
+        "engine": "http://warm", "epoch": "e1", "block_size": BLOCK,
+        "snapshot": True, "seq": 0, "hashes": ["ff"],
+    })
+
+    class Boom:
+        def encode(self, text):
+            raise RuntimeError("tokenizer exploded")
+
+    policy.tokenizer = Boom()
+    url = run(policy.route(RoutingContext(
+        endpoints=[Endpoint(url="http://warm")], body={"prompt": "hello"},
+    )))
+    assert url == "http://warm"
+
+
+def test_disk_tier_hashes_survive_resync_and_drops_mirror(tmp_path):
+    """Snapshot/event-stream consistency across ALL local tiers: a hash
+    demoted to disk stays in the resync snapshot (it is still locally
+    matchable), re-enters HBM without losing indexed coverage, and a disk
+    drop emits the evict that finally unpublishes it."""
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.kv_disk_tier import DiskKVTier
+    from vllm_production_stack_tpu.engine.kv_host_tier import HostKVTier
+
+    class Dev:
+        def __init__(self):
+            self.mem = np.zeros((16, 2, BLOCK), np.float32)
+
+        def fetch(self, blk):
+            return [self.mem[blk, i].copy() for i in range(2)]
+
+        def upload(self, blk, data):
+            self.mem[blk] = data
+
+    dev = Dev()
+    disk = DiskKVTier(str(tmp_path), max_bytes=1 << 20)
+    tier = HostKVTier(2, dev.fetch, dev.upload, disk=disk)  # 2-slot ring
+    pool = KVBlockPool(16, BLOCK, host_tier=tier)
+
+    ids = list(range(6 * BLOCK))
+    blocks = admit(pool, ids)
+    for blk in reversed(blocks):
+        pool.free_block(blk)
+    taken = [pool.allocate() for _ in range(15)]  # evict all 6 cached
+    assert all(b is not None for b in taken)
+    tier.flush()
+    assert len(disk) >= 4  # deep blocks fell through the ring onto disk
+
+    # resync AFTER the demotions: disk-resident hashes must be in the
+    # snapshot — they are still locally matchable
+    index = ClusterKVIndex()
+    feed(index, "http://e0", pool)
+    assert pool.match_length(ids) == 6 * BLOCK
+    assert index.lookup_token_ids(ids) == ("http://e0", 6 * BLOCK)
+
+    # recompute the same blocks into HBM: admit suppression (hash already
+    # host-resident) must not leave a post-resync hole
+    for blk in taken:
+        pool.free_block(blk)
+    reblocks = admit(pool, ids)
+    drain_into(index, "http://e0", pool)
+    assert index.lookup_token_ids(ids) == \
+        ("http://e0", pool.match_length(ids))
+    assert pool.match_length(ids) == 6 * BLOCK
+    for blk in reblocks:
+        pool.free_block(blk)
+
+    # disk drops unpublish: shrink the budget and churn fresh chains
+    # through — whatever the pool stops matching, the index stops matching
+    disk.max_bytes = 1
+    ids2 = list(range(1000, 1000 + 6 * BLOCK))
+    blocks2 = admit(pool, ids2)
+    for blk in reversed(blocks2):
+        pool.free_block(blk)
+    taken2 = [pool.allocate() for _ in range(15)]
+    assert all(b is not None for b in taken2)
+    tier.flush()
+    drain_into(index, "http://e0", pool)
+    for probe in (ids, ids2):
+        url, matched = index.lookup_token_ids(probe)
+        assert matched == pool.match_length(probe)

@@ -283,6 +283,12 @@ def test_api_key_covers_control_surface():
             assert (await client.post("/sleep")).status == 401
             assert (await client.post("/tokenize", json={})).status == 401
             assert (await client.get("/engines")).status == 401
+            # the embedded-KV-index mutation surface steers routing state —
+            # an unauthenticated /kv/events snapshot or /deregister must not
+            # get through either
+            assert (await client.post("/kv/events", json={})).status == 401
+            assert (await client.post("/register", json={})).status == 401
+            assert (await client.post("/deregister", json={})).status == 401
 
     asyncio.run(go())
 

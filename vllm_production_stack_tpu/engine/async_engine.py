@@ -391,6 +391,16 @@ class AsyncEngine:
 
         return await asyncio.get_running_loop().run_in_executor(None, work)
 
+    async def kv_events_snapshot(self) -> tuple[str, int, list[int]]:
+        """Consistent (epoch, seq, hashes) resync snapshot for the cluster
+        KV index — the pool is quiesced under the engine lock so the seq
+        barrier and the hash set describe the same instant."""
+        def work():
+            with self._lock:
+                return self.engine.scheduler.pool.snapshot_events()
+
+        return await asyncio.get_running_loop().run_in_executor(None, work)
+
     async def embed(self, inputs) -> tuple[list[list[float]], int]:
         """Chunked so a large embedding batch can't monopolize the engine
         lock — decode steps interleave between chunks."""

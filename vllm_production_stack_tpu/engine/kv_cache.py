@@ -38,6 +38,29 @@ def chain_hash(parent: int, tokens: tuple[int, ...]) -> int:
     return int.from_bytes(h.digest()[:16], "little")
 
 
+def chain_hash_run(
+    parent: int, token_ids: list[int], block_size: int
+) -> list[int]:
+    """Chain hash of every FULL block of `token_ids`, in order, rooted at
+    `parent` — the single definition of block identity. The pool's `_chain`
+    and the cluster KV index (kv_index.py) both delegate here, so an indexed
+    /lookup can never diverge from what a real match would reuse. Uses the
+    native batch hasher (csrc/kvhash.cpp via utils/native.py) when available,
+    one Python sha256 round-trip per block otherwise."""
+    from ..utils.native import chain_hashes_native
+
+    hashes = chain_hashes_native(parent, token_ids, block_size)
+    if hashes is not None:
+        return hashes
+    out: list[int] = []
+    for i in range(len(token_ids) // block_size):
+        parent = chain_hash(
+            parent, tuple(token_ids[i * block_size : (i + 1) * block_size])
+        )
+        out.append(parent)
+    return out
+
+
 @dataclass
 class CacheStats:
     queries: int = 0  # full prompt blocks looked up
@@ -66,6 +89,22 @@ class KVBlockPool:
         # optional HostKVTier: evicted cached blocks offload HBM→host and
         # prefix matches continue into it (engine/kv_host_tier.py)
         self.host_tier = host_tier
+        # event stream for the cluster KV index (engine/kv_events.py): every
+        # transition of a hash's local matchability is published so the KV
+        # controller/router can answer /lookup without probing this engine.
+        # Always on with prefix caching — emission is a couple of deque ops
+        # per NEW full block, nothing consumes it until a publisher attaches.
+        self.events = None
+        if enable_prefix_caching:
+            from .kv_events import KVEventLog
+
+            self.events = KVEventLog()
+        if host_tier is not None:
+            # ring and disk drops unpublish hashes that are no longer
+            # matchable anywhere local (see _on_host_drop)
+            host_tier.on_drop = self._on_host_drop
+            if getattr(host_tier, "disk", None) is not None:
+                host_tier.disk.on_drop = self._on_host_drop
         # page geometry remote fetches are validated against; the engine
         # sets this once the runner's pool exists (None = skip validation,
         # e.g. unit tests with no device pool)
@@ -119,6 +158,11 @@ class KVBlockPool:
                 # device executes in dispatch order, so the host copy reads
                 # the old pages even though the fetch is asynchronous
                 self.host_tier.store(h, blk)
+            elif self.events is not None:
+                # no host tier: the hash stopped being matchable here (with
+                # a host tier it stays matchable in the ring; the ring's own
+                # drop hook emits the evict when it truly leaves)
+                self.events.emit_evict(h)
         else:
             return None
         self._ref[blk] = 1
@@ -140,25 +184,9 @@ class KVBlockPool:
     # -- prefix caching ----------------------------------------------------
 
     def _chain(self, token_ids: list[int], parent: int):
-        """Yield the chain hash of each FULL block of the prompt, in order —
-        the single definition of block identity shared by match_prefix and
-        match_length (so the /kv/lookup probe can never diverge from what a
-        real match would reuse). Uses the native batch hasher
-        (csrc/kvhash.cpp via utils/native.py) when available — one C call per
-        prompt instead of one Python sha256 round-trip per block."""
-        from ..utils.native import chain_hashes_native
-
-        hashes = chain_hashes_native(parent, token_ids, self.block_size)
-        if hashes is not None:
-            yield from hashes
-            return
-        n_full = len(token_ids) // self.block_size
-        for i in range(n_full):
-            chunk = tuple(
-                token_ids[i * self.block_size : (i + 1) * self.block_size]
-            )
-            parent = chain_hash(parent, chunk)
-            yield parent
+        """Chain hash of each FULL block of the prompt, in order — shared by
+        match_prefix and match_length via module-level `chain_hash_run`."""
+        return chain_hash_run(parent, token_ids, self.block_size)
 
     def match_prefix(
         self, token_ids: list[int], parent: int | None = None
@@ -245,6 +273,8 @@ class KVBlockPool:
             self._hash_to_block[h] = blk
             self._block_to_hash[blk] = h
             self.host_tier.insert_resolved(h, data)
+            if self.events is not None:
+                self.events.emit_admit(h, 0)  # parent unknown mid-chain
             self.stats.hits += 1
             matched.append(blk)
         return matched
@@ -283,6 +313,8 @@ class KVBlockPool:
         for h, blk in staged:
             self._hash_to_block[h] = blk
             self._block_to_hash[blk] = h
+            if self.events is not None:
+                self.events.emit_admit(h, 0)  # parent unknown (adopted run)
             self.free_block(blk)  # park: refcount 0, addressable
         for blk in pinned:
             self.free_block(blk)
@@ -354,6 +386,12 @@ class KVBlockPool:
         if h not in self._hash_to_block:
             self._hash_to_block[h] = blk
             self._block_to_hash[blk] = h
+            if self.events is not None and not (
+                self.host_tier is not None and h in self.host_tier
+            ):
+                # a host-tier-resident hash is already published; re-entering
+                # HBM changes nothing about cluster-level matchability
+                self.events.emit_admit(h, parent_hash)
         return h
 
     @staticmethod
@@ -377,3 +415,43 @@ class KVBlockPool:
         for blk in self._evictable:
             self._free.append(blk)
         self._evictable.clear()
+        if self.events is not None:
+            self.events.emit_clear()
+            if self.host_tier is not None:
+                # ring/disk entries survive the device-pool rebuild (bytes
+                # are bytes, keyed by content hash) and stay matchable —
+                # re-admit them so the cluster index's clear doesn't
+                # under-report
+                for h in self.host_tier.resident_hashes():
+                    self.events.emit_admit(h, 0)
+
+    # -- cluster KV index events (engine/kv_events.py) ---------------------
+
+    def _on_host_drop(self, h: int) -> None:
+        """The host ring or the disk tier dropped hash h: emit a cluster
+        evict only if no local tier (HBM / ring / disk) still holds it —
+        a ring→disk demotion or a drop of a copy keeps it matchable."""
+        if (
+            self.events is not None
+            and h not in self._hash_to_block
+            and (self.host_tier is None or h not in self.host_tier)
+        ):
+            self.events.emit_evict(h)
+
+    def published_hashes(self) -> list[int]:
+        """Every hash `match_length` would currently count from local tiers
+        (HBM + host ring + disk) — the full-resync snapshot for the cluster
+        KV index. Call with the pool quiesced (engine lock held)."""
+        hashes = set(self._hash_to_block)
+        if self.host_tier is not None:
+            hashes.update(self.host_tier.resident_hashes())
+        return list(hashes)
+
+    def snapshot_events(self) -> tuple[str, int, list[int]]:
+        """(epoch, seq, hashes) for a consistent index resync: the event
+        buffer is discarded up to `seq` because the snapshot supersedes it.
+        Call with the pool quiesced (engine lock held)."""
+        if self.events is None:
+            raise RuntimeError("prefix caching (and its event log) disabled")
+        seq = self.events.snapshot_barrier()
+        return self.events.epoch, seq, self.published_hashes()

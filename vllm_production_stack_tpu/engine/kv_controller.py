@@ -4,81 +4,175 @@ The reference embeds an LMCacheControllerManager inside the router process
 (routing_logic.py:222-344, which is why its kvaware image builds on the vLLM
 image), while its Go gateway picker assumes a clean REST controller
 (`/lookup` → instance with the longest KV prefix, kv_aware_picker.go:90-133).
-This service is that REST shape: a standalone aiohttp app that fans a lookup
-out to every registered engine's /kv/lookup (HBM + host tiers,
-engine/server.py) and answers with the engine holding the longest match. The
-router's `kvaware` policy (router/routing.py) points at it via
---kv-controller-url.
+This service is that REST shape: a standalone aiohttp app the router's
+`kvaware` policy (router/routing.py) points at via --kv-controller-url.
+
+Two lookup paths:
+
+- **indexed** (default): engines push batched, sequenced KV events
+  (engine/kv_events.py → POST /kv/events here); the controller maintains a
+  per-engine chain-hash index (kv_index.ClusterKVIndex) and answers /lookup
+  from it — tokenize once (shared tokenizer + native chain hasher,
+  utils/native.py), walk the chain, ZERO per-request engine probes.
+- **fanout** (legacy, also the automatic fallback): probe every engine's
+  /kv/lookup and take the longest match. Used for engines that don't
+  publish events, engines whose index slice is stale (sequence gap pending
+  resync, or publisher silent past the liveness TTL), LoRA-model lookups
+  (the adapter chain salt is engine-local; any /lookup model name not in
+  --base-models is assumed to be an adapter), and text lookups when the
+  controller has no tokenizer configured.
+
+A mixed cluster gets a mixed answer: the indexed result over publishing
+engines is combined with probes of only the non-publishing ones — probe
+traffic shrinks to the legacy stragglers instead of scaling O(QPS x
+num_engines).
 
 Run:
     python -m vllm_production_stack_tpu.engine.kv_controller \
-        --port 9000 --engines http://e1:8000,http://e2:8000
-Engines can also (de)register dynamically via POST /register /deregister
-(the deployment layer wires this like the reference wires
-LMCACHE_CONTROLLER_URL into engine pods, deployment-vllm-multi.yaml:324-339).
+        --port 9000 --engines http://e1:8000,http://e2:8000 \
+        --tokenizer /models/llama  # or "byte" for the byte fallback
+Engines (de)register dynamically via POST /register /deregister (the
+deployment layer wires this like the reference wires LMCACHE_CONTROLLER_URL
+into engine pods, deployment-vllm-multi.yaml:324-339) and publish events to
+POST /kv/events.
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import json
+import time
 
 import aiohttp
 from aiohttp import web
 
+from .. import metrics_contract as mc
+from ..kv_index import ClusterKVIndex
+from ..utils.http import LazyClientSession
 from ..utils.logging import init_logger
 
 logger = init_logger(__name__)
 
+LOOKUP_MODES = ("indexed", "fanout")
+
 
 class KVController:
     def __init__(self, engine_urls: list[str] | None = None,
-                 timeout_s: float = 2.0):
+                 timeout_s: float = 2.0, mode: str = "indexed",
+                 tokenizer=None, base_models: list[str] | None = None):
+        if mode not in LOOKUP_MODES:
+            raise ValueError(f"unknown KV lookup mode: {mode}")
         self.engines: set[str] = {u.rstrip("/") for u in engine_urls or []}
-        self._timeout = aiohttp.ClientTimeout(total=timeout_s)
-        self._session: aiohttp.ClientSession | None = None
+        self.mode = mode
+        # anything with .encode(text) -> list[int]; None means text lookups
+        # cannot be hashed locally and fall back to fan-out
+        self.tokenizer = tokenizer
+        # served base-model names: OpenAI-style clients put the model in
+        # every request, and some forward it into /lookup — names listed
+        # here hash like base traffic (indexed) instead of being assumed
+        # LoRA adapters (fan-out, since adapter chains are engine-salted)
+        self.base_models = set(base_models or [])
+        self.index = ClusterKVIndex()
+        self._http = LazyClientSession(
+            timeout=aiohttp.ClientTimeout(total=timeout_s)
+        )
+        # counters for /metrics and the zero-probe guarantee tests
+        self.probes_sent = 0
+        self.lookup_counts = {"indexed": 0, "fanout": 0, "mixed": 0}
 
-    def _sess(self) -> aiohttp.ClientSession:
-        if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(timeout=self._timeout)
-        return self._session
+    async def _sess(self) -> aiohttp.ClientSession:
+        return await self._http.get()
 
-    async def lookup(self, payload: dict) -> dict:
-        """Fan out to every engine; return the longest resident prefix."""
+    # -- lookup ------------------------------------------------------------
+
+    async def _fanout(self, payload: dict, urls: set[str]) -> tuple[str | None, int]:
+        """Probe each url's /kv/lookup; return the longest resident prefix."""
+        sess = await self._sess()
 
         async def probe(url: str) -> tuple[str, int]:
+            self.probes_sent += 1
             try:
-                async with self._sess().post(
-                    url + "/kv/lookup", json=payload
-                ) as resp:
+                async with sess.post(url + "/kv/lookup", json=payload) as resp:
                     data = await resp.json()
                     return url, int(data.get("matched_tokens", 0))
             except Exception as e:
                 logger.debug("kv lookup to %s failed: %s", url, e)
                 return url, -1
 
-        results = await asyncio.gather(*(probe(u) for u in sorted(self.engines)))
+        results = await asyncio.gather(*(probe(u) for u in sorted(urls)))
         reachable = [(u, n) for u, n in results if n >= 0]
         if not reachable:
-            return {"url": None, "matched_tokens": 0}
+            return None, 0
         url, n = max(reachable, key=lambda r: r[1])
-        return {"url": url, "matched_tokens": n}
+        return url, n
+
+    async def lookup(self, payload: dict) -> dict:
+        """Longest locally-resident KV prefix across the cluster. Indexed
+        where the index is authoritative, fanned out where it is not, and
+        the max of both in a mixed cluster."""
+        token_ids = payload.get("token_ids")
+        text = payload.get("text")
+        lora_model = payload.get("model")
+        if lora_model in self.base_models:
+            lora_model = None  # base traffic hashes unsalted: stay indexed
+        indexable = set()
+        best_url: str | None = None
+        best_n = 0
+        if self.mode == "indexed" and lora_model is None:
+            # LoRA chains are salted per adapter with an engine-local salt
+            # (engine._cache_root) — only the engine can hash them.
+            # fresh_engines BEFORE tokenizing: a cluster with no publishers
+            # must not pay a per-request tokenize just to throw it away
+            try:
+                fresh = self.index.fresh_engines(self.engines)
+                if fresh:
+                    if token_ids is None and self.tokenizer is not None:
+                        # tokenize off-loop: a multi-KB prompt must not
+                        # stall event ingestion and concurrent lookups
+                        token_ids = await asyncio.get_running_loop(
+                        ).run_in_executor(
+                            None, self.tokenizer.encode, text or ""
+                        )
+                    if token_ids is not None:
+                        best_url, best_n = self.index.lookup_token_ids(
+                            list(token_ids), fresh
+                        )
+                        indexable = fresh
+            except Exception as e:
+                # a tokenizer/index fault (malformed text payloads included)
+                # must degrade to fan-out, not turn /lookup into a 500 —
+                # engines hash the prompt themselves either way
+                logger.debug(
+                    "indexed lookup failed (%s); falling back to fan-out", e
+                )
+                indexable, best_url, best_n = set(), None, 0
+        legacy = self.engines - indexable
+        if legacy:
+            url, n = await self._fanout(payload, legacy)
+            if n > best_n or best_url is None:
+                best_url, best_n = url, n
+        mode = ("indexed" if not legacy else
+                "mixed" if indexable else "fanout")
+        self.lookup_counts[mode] += 1
+        return {"url": best_url, "matched_tokens": best_n, "mode": mode}
 
     # -- HTTP surface ------------------------------------------------------
 
     def build_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(client_max_size=64 * 1024 * 1024)
         app.router.add_post("/lookup", self._handle_lookup)
+        app.router.add_post("/kv/events", self._handle_events)
         app.router.add_post("/register", self._handle_register)
         app.router.add_post("/deregister", self._handle_deregister)
         app.router.add_get("/engines", self._handle_engines)
         app.router.add_get("/health", self._handle_health)
+        app.router.add_get("/metrics", self._handle_metrics)
         app.on_cleanup.append(self._on_cleanup)
         return app
 
     async def _on_cleanup(self, app: web.Application) -> None:
-        if self._session is not None and not self._session.closed:
-            await self._session.close()
+        await self._http.close()
 
     async def _handle_lookup(self, request: web.Request) -> web.Response:
         body = await request.json()
@@ -87,9 +181,32 @@ class KVController:
                 {"error": "text or token_ids is required"}, status=400
             )
         payload = {
-            k: body[k] for k in ("text", "token_ids") if body.get(k) is not None
+            k: body[k] for k in ("text", "token_ids", "model")
+            if body.get(k) is not None
         }
-        return web.json_response(await self.lookup(payload))
+        t0 = time.perf_counter()
+        result = await self.lookup(payload)
+        self.index.lookups.observe(
+            result.get("mode", "fanout"), time.perf_counter() - t0
+        )
+        return web.json_response(result)
+
+    async def _handle_events(self, request: web.Request) -> web.Response:
+        raw = await request.text()
+        # off-loop: a resync snapshot parses a whole pool's hashes — keep
+        # the multi-MB json.loads off the event loop along with the hex walk
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, json.loads, raw
+        )
+        url = (body.get("engine") or "").rstrip("/")
+        if url:
+            # publishing IS registering: a pushed engine joins the cluster
+            # view even if the deployment never POSTed /register
+            self.engines.add(url)
+        reply = await asyncio.get_running_loop().run_in_executor(
+            None, self.index.apply, body
+        )
+        return web.json_response(reply)
 
     async def _handle_register(self, request: web.Request) -> web.Response:
         body = await request.json()
@@ -101,27 +218,76 @@ class KVController:
 
     async def _handle_deregister(self, request: web.Request) -> web.Response:
         body = await request.json()
-        self.engines.discard((body.get("url") or "").rstrip("/"))
+        url = (body.get("url") or "").rstrip("/")
+        self.engines.discard(url)
+        self.index.remove_engine(url)
         return web.json_response({"status": "ok", "engines": sorted(self.engines)})
 
     async def _handle_engines(self, request: web.Request) -> web.Response:
-        return web.json_response({"engines": sorted(self.engines)})
+        return web.json_response({
+            "engines": sorted(self.engines),
+            "publishing": sorted(self.index.fresh_engines(self.engines)),
+            "mode": self.mode,
+        })
 
     async def _handle_health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok", "engines": len(self.engines)})
 
+    async def _handle_metrics(self, request: web.Request) -> web.Response:
+        st = self.index.stats()
+        lines = [
+            f"# TYPE {mc.CLUSTER_KV_INDEX_HASHES} gauge",
+            f"{mc.CLUSTER_KV_INDEX_HASHES} {st['hashes']}",
+            f"{mc.CLUSTER_KV_INDEX_ENGINES} {st['engines']}",
+            f"{mc.CLUSTER_KV_INDEX_STALE_ENGINES} {st['stale_engines']}",
+            f"# TYPE {mc.CLUSTER_KV_EVENTS} counter",
+            f"{mc.CLUSTER_KV_EVENTS} {st['events_applied']}",
+            f"{mc.CLUSTER_KV_RESYNCS} {st['resyncs_requested']}",
+            f"# TYPE {mc.CLUSTER_KV_LOOKUPS} counter",
+        ]
+        for mode, n in sorted(self.lookup_counts.items()):
+            lines.append(f'{mc.CLUSTER_KV_LOOKUPS}{{mode="{mode}"}} {n}')
+        lines += self.index.lookups.render(mc.CLUSTER_KV_LOOKUP_LATENCY)
+        return web.Response(
+            text="\n".join(lines) + "\n", content_type="text/plain"
+        )
 
-def main(argv: list[str] | None = None) -> None:
+
+def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="TPU stack KV controller")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9000)
     p.add_argument("--engines", default="",
                    help="comma-separated engine base URLs")
-    args = p.parse_args(argv)
+    p.add_argument("--mode", choices=LOOKUP_MODES, default="indexed",
+                   help="indexed: answer /lookup from the event-driven "
+                        "cluster index (fan-out only to non-publishing or "
+                        "stale engines); fanout: legacy per-request probes")
+    p.add_argument("--tokenizer", default=None,
+                   help="tokenizer for hashing text lookups locally: an HF "
+                        "checkpoint/tokenizer dir, or 'byte' for the byte "
+                        "fallback. Unset = text lookups fan out; token_ids "
+                        "lookups are still indexed")
+    p.add_argument("--base-models", default="",
+                   help="comma-separated served base-model names: a /lookup "
+                        "naming one of these stays on the indexed path "
+                        "(any OTHER model name is assumed to be a LoRA "
+                        "adapter, whose engine-salted chains only engine "
+                        "probes can hash)")
+    return p
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_parser().parse_args(argv)
+    from ..utils.tokenizer import hashing_tokenizer
+
     urls = [u for u in args.engines.split(",") if u]
-    controller = KVController(urls)
-    logger.info("KV controller on %s:%d over %d engines",
-                args.host, args.port, len(urls))
+    controller = KVController(
+        urls, mode=args.mode, tokenizer=hashing_tokenizer(args.tokenizer),
+        base_models=[m for m in args.base_models.split(",") if m],
+    )
+    logger.info("KV controller on %s:%d over %d engines (mode=%s)",
+                args.host, args.port, len(urls), args.mode)
     web.run_app(controller.build_app(), host=args.host, port=args.port,
                 access_log=None)
 

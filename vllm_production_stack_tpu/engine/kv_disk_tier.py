@@ -55,6 +55,10 @@ class DiskKVTier:
         os.makedirs(self.dir, exist_ok=True)
         self.max_bytes = max_bytes
         self.stats = DiskTierStats()
+        # cluster-KV-index hook (wired by KVBlockPool): called when a hash
+        # leaves this tier (budget eviction or corrupt-file unlink) — the
+        # last local rung, so a drop here can end local matchability
+        self.on_drop = None
         # LRU index rebuilt from the directory on start (restart survival):
         # oldest-mtime first
         self._index: OrderedDict[int, int] = OrderedDict()  # hash -> nbytes
@@ -82,6 +86,9 @@ class DiskKVTier:
 
     def __len__(self) -> int:
         return len(self._index)
+
+    def resident_hashes(self) -> list[int]:
+        return list(self._index)
 
     def store(self, h: int, arr: np.ndarray) -> None:
         if self.max_bytes <= 0 or h in self._index:
@@ -116,6 +123,8 @@ class DiskKVTier:
                 pass
             self.total_bytes -= old_size
             self.stats.evictions += 1
+            if self.on_drop is not None:
+                self.on_drop(old)
 
     def load(self, h: int) -> np.ndarray | None:
         if h not in self._index:
@@ -142,6 +151,8 @@ class DiskKVTier:
                 os.unlink(self._path(h))
             except OSError:
                 pass
+            if self.on_drop is not None:
+                self.on_drop(h)
             return None
         self._index.move_to_end(h)
         self.stats.loads += 1

@@ -1,0 +1,262 @@
+"""Engine-side KV event stream: the push half of the cluster KV index.
+
+KV-aware routing used to be pull-based: every routed request made the KV
+controller fan a /kv/lookup probe out to EVERY engine, each probe tokenizing
+the prompt and walking the hash chain server-side — O(slowest engine) latency
+and O(QPS x num_engines) probe traffic. LMCache's controller solves this with
+an event-driven index (PAPERS.md), and BanaServe's unified cluster KV view
+argues the same push design: engines publish cache mutations once, lookups
+are answered from an index with zero engine traffic.
+
+This module is the engine half of that protocol:
+
+- `KVEventLog`: a bounded, thread-safe buffer of monotonically-sequenced
+  cache mutations. `KVBlockPool` emits into it from the step thread (block
+  admitted / block no longer locally matchable / cache cleared); the
+  publisher drains it from the asyncio loop. Overflow drops the OLDEST
+  events — the sequence numbering turns the drop into a visible gap the
+  subscriber answers with a resync request, never a silently wrong index.
+
+- `KVEventPublisher`: a background task owned by the engine server. It
+  flushes batched events to the controller (`POST /kv/events`) on a short
+  interval and falls back to a full snapshot (every currently matchable
+  hash, taken under the engine lock) whenever the controller reports a
+  sequence gap, the epoch changed (pool rebuild), or the connection was
+  down — the classic event-sourcing "resync on reconnect" contract.
+
+Wire format (one POST body):
+    {"engine": "<base url>", "epoch": "<uuid>", "block_size": 16,
+     "seq_start": 17, "events": [["a", "<hash hex>", "<parent hex>"],
+                                 ["e", "<hash hex>"], ["c"]]}
+or, for a snapshot:
+    {"engine": ..., "epoch": ..., "block_size": ..., "snapshot": true,
+     "seq": 42, "hashes": ["<hex>", ...]}
+
+Hashes travel as hex strings: they are 128-bit chain hashes
+(engine/kv_cache.py) and many JSON parsers mangle >64-bit ints.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+import uuid
+from collections import deque
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+# ops, kept single-char: event batches are on the wire every flush interval
+ADMIT = "a"
+EVICT = "e"
+CLEAR = "c"
+
+DEFAULT_CAPACITY = 65536
+DEFAULT_FLUSH_INTERVAL_S = 0.5
+MAX_EVENTS_PER_POST = 8192
+# an idle engine (no cache churn) posts an empty batch this often so the
+# subscriber's liveness TTL (kv_index.DEFAULT_STALE_AFTER_S) can tell
+# "quiet" from "dead" — a crashed publisher must stop winning lookups
+HEARTBEAT_INTERVAL_S = 2.0
+
+
+class KVEventLog:
+    """Bounded buffer of sequenced KV cache events for ONE pool.
+
+    Thread-safe: the pool emits from the engine step thread while the
+    publisher drains from the asyncio loop. `epoch` identifies this pool
+    incarnation — a subscriber seeing a new epoch must resync.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.epoch = uuid.uuid4().hex
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._buf: deque[tuple[int, tuple]] = deque()
+        self._seq = 0  # seq of the most recently emitted event
+
+    @property
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def _emit(self, event: tuple) -> None:
+        with self._lock:
+            self._seq += 1
+            self._buf.append((self._seq, event))
+            if len(self._buf) > self.capacity:
+                # drop oldest: the seq gap is detected by the subscriber
+                # (and by the publisher's own continuity check) -> resync
+                self._buf.popleft()
+
+    def emit_admit(self, h: int, parent: int) -> None:
+        self._emit((ADMIT, f"{h:x}", f"{parent:x}"))
+
+    def emit_evict(self, h: int) -> None:
+        self._emit((EVICT, f"{h:x}"))
+
+    def emit_clear(self) -> None:
+        self._emit((CLEAR,))
+
+    def drain(self, max_events: int = MAX_EVENTS_PER_POST):
+        """Pop up to max_events buffered events. Returns (seq_start, events)
+        — events is [] when nothing is buffered. seq_start is the sequence
+        number of the FIRST returned event; a caller tracking the last seq
+        it shipped can detect overflow drops (seq_start jumped) and resync."""
+        with self._lock:
+            if not self._buf:
+                return self._seq + 1, []
+            n = min(max_events, len(self._buf))
+            first_seq = self._buf[0][0]
+            events = [self._buf.popleft()[1] for _ in range(n)]
+            return first_seq, events
+
+    def snapshot_barrier(self) -> int:
+        """Discard everything buffered and return the current seq — called
+        with the pool quiesced (engine lock held) while the caller captures
+        the full hash set. Buffered events are baked into that snapshot, so
+        shipping them afterwards would double-apply."""
+        with self._lock:
+            self._buf.clear()
+            return self._seq
+
+
+class KVEventPublisher:
+    """Flushes one engine's KVEventLog to the cluster KV index subscriber
+    (KV controller, or a router in embedded-index mode)."""
+
+    def __init__(
+        self,
+        controller_url: str,
+        engine_url: str,
+        log: KVEventLog,
+        snapshot_fn,
+        block_size: int,
+        session_factory,
+        interval_s: float = DEFAULT_FLUSH_INTERVAL_S,
+        headers: dict | None = None,
+    ):
+        """snapshot_fn: async callable -> (epoch, seq, list[int] hashes),
+        taken consistently (under the engine lock). session_factory: zero-arg
+        callable returning the shared aiohttp.ClientSession. headers: extra
+        request headers, e.g. the bearer key a keyed subscriber requires."""
+        self.controller_url = controller_url.rstrip("/")
+        self.headers = headers or {}
+        self.engine_url = engine_url
+        self.log = log
+        self._snapshot_fn = snapshot_fn
+        self.block_size = block_size
+        self._session_factory = session_factory
+        self.interval_s = interval_s
+        self._need_snapshot = True  # first contact always resyncs
+        self._last_sent_seq = 0
+        self._last_post_t = 0.0  # monotonic time of the last successful POST
+        self._task: asyncio.Task | None = None
+        # counters for /debug + tests
+        self.posts = 0
+        self.events_sent = 0
+        self.snapshots_sent = 0
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.flush()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # keep publishing through faults
+                # flush() marks _need_snapshot itself when drained events
+                # were actually lost; a failed heartbeat or snapshot POST
+                # loses nothing, so don't force a full resync here
+                logger.debug("kv event flush failed: %s", e)
+            await asyncio.sleep(self.interval_s)
+
+    async def _post(self, payload: dict) -> dict:
+        sess = self._session_factory()
+        async with sess.post(
+            self.controller_url + "/kv/events", json=payload,
+            headers=self.headers,
+        ) as resp:
+            if resp.status != 200:
+                raise RuntimeError(f"controller returned HTTP {resp.status}")
+            self.posts += 1
+            self._last_post_t = time.monotonic()
+            return await resp.json()
+
+    async def flush(self) -> None:
+        """One publish round: snapshot if needed, else drain-and-send every
+        buffered batch. Raises on transport faults; a full resync is queued
+        only when drained events were actually lost in flight — failed
+        heartbeats/snapshots lose nothing and just retry next round."""
+        if self._need_snapshot:
+            epoch, seq, hashes = await self._snapshot_fn()
+            data = await self._post({
+                "engine": self.engine_url,
+                "epoch": epoch,
+                "block_size": self.block_size,
+                "snapshot": True,
+                "seq": seq,
+                "hashes": [f"{h:x}" for h in hashes],
+            })
+            if data.get("resync") or data.get("status") == "error":
+                raise RuntimeError(
+                    f"controller rejected snapshot: {data.get('error') or data}"
+                )
+            self.snapshots_sent += 1
+            self._last_sent_seq = seq
+            self._need_snapshot = False
+        while True:
+            seq_start, events = self.log.drain()
+            if not events:
+                if (
+                    time.monotonic() - self._last_post_t
+                    >= HEARTBEAT_INTERVAL_S
+                ):
+                    # liveness heartbeat: an empty in-sequence batch — the
+                    # subscriber applies nothing but refreshes last_event_t
+                    data = await self._post({
+                        "engine": self.engine_url,
+                        "epoch": self.log.epoch,
+                        "block_size": self.block_size,
+                        "seq_start": self._last_sent_seq + 1,
+                        "events": [],
+                    })
+                    if data.get("resync"):  # e.g. subscriber restarted
+                        self._need_snapshot = True
+                return
+            if seq_start != self._last_sent_seq + 1:
+                # local overflow dropped events between flushes — the index
+                # is unrecoverable from the buffer; resync next round
+                self._need_snapshot = True
+                return
+            try:
+                data = await self._post({
+                    "engine": self.engine_url,
+                    "epoch": self.log.epoch,
+                    "block_size": self.block_size,
+                    "seq_start": seq_start,
+                    "events": events,
+                })
+            except Exception:
+                # these events left the log buffer and never arrived — the
+                # subscriber's slice is now unrecoverable without a resync
+                self._need_snapshot = True
+                raise
+            self.events_sent += len(events)
+            self._last_sent_seq = seq_start + len(events) - 1
+            if data.get("resync"):
+                self._need_snapshot = True
+                return

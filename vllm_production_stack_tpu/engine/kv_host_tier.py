@@ -55,6 +55,11 @@ class HostKVTier:
         # optional DiskKVTier (kv_disk_tier.py): ring evictions persist to
         # local disk — the middle rung between RAM and the remote store
         self.disk = disk
+        # cluster-KV-index hook (set by KVBlockPool): called whenever an
+        # entry leaves the ring; the pool's handler checks whether the hash
+        # is still locally reloadable (HBM / this ring / disk) before
+        # emitting a cluster evict event
+        self.on_drop = None
         self.stats = HostTierStats()
 
     def _resolve(self, h: int) -> np.ndarray | None:
@@ -85,6 +90,17 @@ class HostKVTier:
 
     def __len__(self) -> int:
         return len(self._data)
+
+    def resident_hashes(self) -> list[int]:
+        """Every locally reloadable hash: ring + disk. Must agree with
+        `__contains__` — this set feeds the cluster-index resync snapshot,
+        and a snapshot narrower than containment would permanently
+        under-report (the admit-suppression in register_full_block keys on
+        containment, so a hash dropped only by the snapshot is never
+        re-published)."""
+        if self.disk is None:
+            return list(self._data)
+        return list({*self._data, *self.disk.resident_hashes()})
 
     @property
     def usage_perc(self) -> float:
@@ -125,6 +141,8 @@ class HostKVTier:
                 # silently misses exactly the blocks that fell off (the
                 # RemoteKVTier dedupes already-pushed hashes)
                 self.remote.put_async(evicted, entry)
+            if self.on_drop is not None:
+                self.on_drop(evicted)
             self.stats.evictions += 1
 
     def reload_into(self, h: int, device_block: int) -> bool:

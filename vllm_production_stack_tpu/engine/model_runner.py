@@ -341,6 +341,7 @@ class ModelRunner:
         self._bg_inflight: set[tuple] = set()
         self._bg_lock = threading.Lock()
         self._bg_executor: ThreadPoolExecutor | None = None
+        self._bg_stop = threading.Event()  # shutdown() -> running job bails
         self.compile_fallbacks = 0  # profiling: pad-up substitutions taken
         self.bg_compiles = 0  # profiling: programs compiled off the hot path
         # warmup disables this so every wave compiles its EXACT program
@@ -1329,14 +1330,22 @@ class ModelRunner:
                 )
         self._bg_executor.submit(self._bg_compile_job, key)
 
-    def shutdown(self) -> None:
+    def shutdown(self, wait: bool = False) -> None:
         """Cancel queued background compiles — each is a 30-60s XLA compile
         behind an idle-gate sleep, and concurrent.futures' atexit hook
-        would otherwise drain them all before the interpreter can exit."""
+        would otherwise drain them all before the interpreter can exit.
+        A job already running is signalled to bail too: without the stop
+        event it would sit in the idle-gate sleep (up to 10 min) and fire
+        its compile exactly when the process next goes quiet — observed as
+        stolen CPU (pacing flakes) in whatever test module runs next.
+        wait=True additionally blocks until the in-flight job (which XLA
+        cannot interrupt) finishes — test teardowns use it so no compile
+        thread ever outlives its module."""
+        self._bg_stop.set()
         with self._bg_lock:
             ex, self._bg_executor = self._bg_executor, None
         if ex is not None:
-            ex.shutdown(wait=False, cancel_futures=True)
+            ex.shutdown(wait=wait, cancel_futures=True)
 
     def _bg_compile_job(self, key: tuple) -> None:
         try:
@@ -1351,10 +1360,12 @@ class ModelRunner:
 
                 deadline = _time.monotonic() + 600.0
                 while not idle():
-                    if _time.monotonic() > deadline:
+                    if self._bg_stop.is_set() or _time.monotonic() > deadline:
                         return  # still busy; the key stays un-compiled and
                         # the fallback keeps absorbing it
                     _time.sleep(0.25)
+            if self._bg_stop.is_set():
+                return
             if self._compile_key_now(key):
                 self.bg_compiles += 1
                 logger.info(

@@ -75,6 +75,7 @@ class EngineServer:
         self.model_name = served_model_name or engine.config.model.model
         self.metrics = EngineMetrics(self.model_name)
         self._session = None  # lazy outbound ClientSession (kv_pull)
+        self.kv_event_publisher = None  # started when KV_CONTROLLER_URL set
         self._tok_repr_cache: dict[int, tuple[str, list[int]]] = {}
         self._start_time = time.time()
         # OpenAI system_fingerprint: identifies the serving configuration
@@ -122,6 +123,46 @@ class EngineServer:
     async def _on_startup(self, app: web.Application) -> None:
         self.async_engine.start(asyncio.get_running_loop())
         await self._register_with_kv_controller("/register")
+        self._start_kv_event_publisher()
+
+    def _start_kv_event_publisher(self) -> None:
+        """Push-based cluster KV index: publish this pool's KV events to the
+        controller named by KV_CONTROLLER_URL so /lookup never has to probe
+        this engine per request (engine/kv_events.py)."""
+        import os
+
+        controller = os.environ.get("KV_CONTROLLER_URL")
+        pod_ip = os.environ.get("POD_IP")
+        pool = self.engine.scheduler.pool
+        if not controller or not pod_ip or pool.events is None:
+            return
+        from .kv_events import DEFAULT_FLUSH_INTERVAL_S, KVEventPublisher
+
+        port = os.environ.get("ENGINE_PORT", "8000")
+        self.kv_event_publisher = KVEventPublisher(
+            controller,
+            f"http://{pod_ip}:{port}",
+            pool.events,
+            self.async_engine.kv_events_snapshot,
+            pool.block_size,
+            self._client_session,
+            interval_s=float(
+                os.environ.get("KV_EVENTS_FLUSH_S", DEFAULT_FLUSH_INTERVAL_S)
+            ),
+            headers=self._kv_controller_headers(),
+        )
+        self.kv_event_publisher.start()
+        logger.info("KV event publisher -> %s (flush every %.2fs)",
+                    controller, self.kv_event_publisher.interval_s)
+
+    @staticmethod
+    def _kv_controller_headers() -> dict:
+        """Bearer key for a keyed KV-event subscriber (a router running with
+        --api-key protects /kv/events and /register|/deregister)."""
+        import os
+
+        key = os.environ.get("KV_CONTROLLER_API_KEY")
+        return {"Authorization": f"Bearer {key}"} if key else {}
 
     async def _register_with_kv_controller(self, endpoint: str) -> None:
         """Join/leave the KV controller's engine set when deployed with
@@ -138,7 +179,8 @@ class EngineServer:
         my_url = f"http://{pod_ip}:{port}"
         try:
             async with self._client_session().post(
-                controller.rstrip("/") + endpoint, json={"url": my_url}
+                controller.rstrip("/") + endpoint, json={"url": my_url},
+                headers=self._kv_controller_headers(),
             ) as resp:
                 logger.info(
                     "KV controller %s%s (%s): HTTP %d",
@@ -148,6 +190,8 @@ class EngineServer:
             logger.warning("KV controller %s failed: %s", endpoint, e)
 
     async def _on_cleanup(self, app: web.Application) -> None:
+        if self.kv_event_publisher is not None:
+            await self.kv_event_publisher.stop()
         await self._register_with_kv_controller("/deregister")
         self.async_engine.shutdown()
         if self._session is not None and not self._session.closed:
@@ -173,7 +217,7 @@ class EngineServer:
             return error(400, f"invalid request: {e}")
         if not 1 <= body.n <= MAX_N_CHOICES:
             return error(400, f"n must be between 1 and {MAX_N_CHOICES}")
-        if err := self._check_model(body.model):
+        if (err := self._check_model(body.model)) is not None:
             return err
         lora_name = body.model if body.model in self.lora_adapters else None
         messages = [m.model_dump() for m in body.messages]
@@ -188,7 +232,7 @@ class EngineServer:
             )
         prompt = self.async_engine.chat_prompt(messages)
         sampling = body.sampling(DEFAULT_MAX_TOKENS)
-        if err := self._check_logprobs(sampling):
+        if (err := self._check_logprobs(sampling)) is not None:
             return err
         rid = request.headers.get("X-Request-Id") or random_id("chatcmpl")
         if body.stream:
@@ -208,14 +252,14 @@ class EngineServer:
             return error(400, f"invalid request: {e}")
         if not 1 <= body.n <= MAX_N_CHOICES:
             return error(400, f"n must be between 1 and {MAX_N_CHOICES}")
-        if err := self._check_model(body.model):
+        if (err := self._check_model(body.model)) is not None:
             return err
         lora_name = body.model if body.model in self.lora_adapters else None
         prompt, prompt_ids = self._resolve_prompt(body.prompt)
         if prompt is None and prompt_ids is None:
             return error(400, "batched prompts are not supported yet")
         sampling = body.sampling(DEFAULT_MAX_TOKENS)
-        if err := self._check_logprobs(sampling):
+        if (err := self._check_logprobs(sampling)) is not None:
             return err
         # echo: the prompt text precedes the completion (vLLM/OpenAI
         # legacy semantics). Prompt LOGPROBS under echo would need a
@@ -252,7 +296,7 @@ class EngineServer:
         except (ValidationError, json.JSONDecodeError) as e:
             return error(400, f"invalid request: {e}")
         model = body.model
-        if err := self._check_model(model):
+        if (err := self._check_model(model)) is not None:
             return err
         if model in self.lora_adapters:
             return error(
@@ -307,7 +351,7 @@ class EngineServer:
             body = ScoreRequest.model_validate(await request.json())
         except (ValidationError, json.JSONDecodeError) as e:
             return error(400, f"invalid request: {e}")
-        if err := self._check_model(body.model):
+        if (err := self._check_model(body.model)) is not None:
             return err
         t1 = [body.text_1] if isinstance(body.text_1, str) else body.text_1
         t2 = [body.text_2] if isinstance(body.text_2, str) else body.text_2
@@ -347,7 +391,7 @@ class EngineServer:
             body = RerankRequest.model_validate(await request.json())
         except (ValidationError, json.JSONDecodeError) as e:
             return error(400, f"invalid request: {e}")
-        if err := self._check_model(body.model):
+        if (err := self._check_model(body.model)) is not None:
             return err
         if not body.documents:
             return error(400, "documents must be non-empty")
@@ -401,7 +445,12 @@ class EngineServer:
     def _check_model(self, model: str):
         """vLLM-compatible 404 for unknown model/adapter names — the
         router's model-filtered dispatch and the LoRA controller's
-        reconciliation both rely on names being authoritative."""
+        reconciliation both rely on names being authoritative.
+
+        Callers MUST test the return `is not None`, never by truthiness: an
+        unprepared aiohttp Response is a MutableMapping with no items, so
+        `bool(error(...))` is False and a bare `if err := ...` silently
+        skips the rejection (the bug behind test_unknown_model_404)."""
         if model != self.model_name and model not in self.lora_adapters:
             return error(
                 404, f"model '{model}' not found", "not_found_error"

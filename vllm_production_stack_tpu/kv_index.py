@@ -1,0 +1,355 @@
+"""Cluster KV index: the subscriber half of the event-driven KV protocol.
+
+One instance of `ClusterKVIndex` holds the cluster-wide view of which engine
+has which KV chain hash locally resident (engine/kv_events.py is the
+publisher half). Two processes embed it:
+
+- the KV controller (engine/kv_controller.py): answers `/lookup` straight
+  from the index — tokenize once, hash the chain once, walk sets — instead
+  of fanning a probe out to every engine;
+- the router in embedded-index mode (router/routing.py KvawarePolicy): the
+  index lives in the router process itself, removing the controller hop
+  from the request path entirely.
+
+Consistency model: per-engine (epoch, seq) tracking. An event batch whose
+seq_start is not exactly last_seq+1, or whose epoch changed (pool rebuild),
+marks the engine STALE and the reply asks the publisher to resync with a
+full snapshot. Stale engines drop out of indexed answers — callers fall back
+to the legacy per-request fan-out for them — so a gap can cost probe
+traffic, never a wrong answer sourced from a desynced index. A liveness TTL
+(stale_after_s; publishers heartbeat when idle) does the same for engines
+that die without deregistering.
+
+Memory bound: an engine exceeding max_hashes_per_engine is reset to stale
+(its set freed) rather than growing without limit — the same resync path
+heals it.
+
+Scope: the index tracks LOCAL residency only (HBM + host ring + disk). KV
+held solely in a shared remote store (--remote-kv-url) is deliberately not
+indexed — any engine can fetch it, so it carries no placement signal. Note
+the asymmetry this buys: an engine-side /kv/lookup probe DOES count
+remote-resident blocks (kv_cache.match_length continues into the store),
+so in a mixed cluster with a remote store the fan-out answer for a
+remote-warm prefix can exceed every indexed answer and routing leans
+legacy for that prefix; acceptable, since placement is indifferent for
+remote-reachable KV.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .engine.kv_cache import KVBlockPool, chain_hash_run
+from .utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+ROOT_HASH = KVBlockPool.root_hash()
+
+# matches KVBlockPool defaults: ~2M 128-bit hashes ≈ tens of MB per engine,
+# far above any realistic HBM+host-ring block count
+DEFAULT_MAX_HASHES_PER_ENGINE = 2_000_000
+
+# liveness TTL: an engine that stops posting (publisher flushes every
+# ~0.5s and heartbeats every ~2s even when idle — kv_events.py) for this
+# long is treated as dead and drops out of indexed answers. Without it a
+# crashed/partitioned engine keeps winning lookups for every prefix it
+# ever held. The slice is kept, not freed: a publisher that resumes with
+# seq continuity heals instantly, no resync needed.
+DEFAULT_STALE_AFTER_S = 10.0
+
+# memory reclamation for engines that are GONE (scaled down, pod replaced
+# under a new URL) rather than flapping: a slice silent this long is
+# deleted outright. Deliberately much longer than the TTL — discovery
+# flaps and rolling restarts must not free a multi-million-hash slice
+# that would then need a full snapshot resync to rebuild.
+DEFAULT_PURGE_AFTER_S = 600.0
+
+
+def chain_hashes(
+    token_ids: list[int], block_size: int, parent: int | None = None
+) -> list[int]:
+    """All full-block chain hashes of a prompt — byte-exact with the pool's
+    matching by construction (same `chain_hash_run` the pool's `_chain`
+    delegates to)."""
+    return chain_hash_run(
+        ROOT_HASH if parent is None else parent, token_ids, block_size
+    )
+
+
+class LookupLatency:
+    """Tiny fixed-bucket latency histogram, rendered in Prometheus text
+    exposition. Shared by the controller's /metrics and the router's — both
+    ends of the protocol report the same contract names
+    (metrics_contract.CLUSTER_KV_LOOKUP_LATENCY) without dragging a
+    prometheus_client registry into the index module."""
+
+    BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+               0.1, 0.25, 0.5, 1.0, 2.5)
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: dict[str, list[int]] = {}
+        self._sums: dict[str, float] = {}
+
+    def observe(self, mode: str, seconds: float) -> None:
+        with self._lock:
+            counts = self._counts.setdefault(
+                mode, [0] * (len(self.BUCKETS) + 1)
+            )
+            for i, ub in enumerate(self.BUCKETS):
+                if seconds <= ub:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[mode] = self._sums.get(mode, 0.0) + seconds
+
+    def render(self, name: str) -> list[str]:
+        lines = [f"# TYPE {name} histogram"]
+        with self._lock:
+            for mode, counts in sorted(self._counts.items()):
+                acc = 0
+                for ub, c in zip(self.BUCKETS, counts):
+                    acc += c
+                    lines.append(
+                        f'{name}_bucket{{mode="{mode}",le="{ub}"}} {acc}'
+                    )
+                acc += counts[-1]
+                lines.append(f'{name}_bucket{{mode="{mode}",le="+Inf"}} {acc}')
+                lines.append(
+                    f'{name}_sum{{mode="{mode}"}} {self._sums[mode]:.6f}'
+                )
+                lines.append(f'{name}_count{{mode="{mode}"}} {acc}')
+        return lines
+
+
+@dataclass
+class _EngineView:
+    """One publishing engine's slice of the index."""
+
+    url: str
+    epoch: str = ""
+    seq: int = 0
+    block_size: int = 0
+    stale: bool = True  # no snapshot accepted yet, or a gap was detected
+    hashes: set[int] = field(default_factory=set)
+    last_event_t: float = 0.0
+
+
+class ClusterKVIndex:
+    """hash → engines view of the cluster's locally-resident KV prefixes.
+
+    Thread-safe (a plain threading.Lock — every operation is pure dict/set
+    work measured in microseconds; the subscriber loop and lookups may live
+    on different threads in tests and bench harnesses).
+    """
+
+    def __init__(
+        self,
+        max_hashes_per_engine: int = DEFAULT_MAX_HASHES_PER_ENGINE,
+        stale_after_s: float | None = DEFAULT_STALE_AFTER_S,
+        purge_after_s: float | None = DEFAULT_PURGE_AFTER_S,
+    ):
+        self.max_hashes_per_engine = max_hashes_per_engine
+        self.stale_after_s = stale_after_s  # None disables the liveness TTL
+        self.purge_after_s = purge_after_s  # None disables dead-slice purge
+        # publishers retry rejected snapshots every flush interval — warn
+        # once a minute per engine, not once per retry
+        self._reject_warn_t: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._engines: dict[str, _EngineView] = {}
+        # counters for metrics
+        self.events_applied = 0
+        self.resyncs_requested = 0
+        self.lookups = LookupLatency()
+
+    # -- event ingestion ---------------------------------------------------
+
+    def apply(self, payload: dict) -> dict:
+        """Apply one publisher POST body (snapshot or event batch). Returns
+        the JSON-able reply: {"status": "ok"} or {"status": "resync",
+        "resync": True} when the publisher must send a full snapshot."""
+        url = (payload.get("engine") or "").rstrip("/")
+        if not url:
+            return {"status": "error", "error": "engine url is required"}
+        epoch = payload.get("epoch") or ""
+        snapshot_hashes: set[int] | None = None
+        if payload.get("snapshot"):
+            raw_hashes = payload.get("hashes", [])
+            if len(raw_hashes) > self.max_hashes_per_engine:
+                # enforce the memory bound on the snapshot path too, and
+                # BEFORE parsing — otherwise an over-cap engine is accepted
+                # here only to trip the cap on its next event batch,
+                # re-shipping the same oversized snapshot forever
+                now = time.monotonic()
+                if now - self._reject_warn_t.get(url, -1e9) > 60.0:
+                    self._reject_warn_t[url] = now
+                    logger.warning(
+                        "rejecting %d-hash snapshot from %s (cap %d)",
+                        len(raw_hashes), url, self.max_hashes_per_engine,
+                    )
+                return {
+                    "status": "error",
+                    "error": f"snapshot of {len(raw_hashes)} hashes exceeds "
+                             f"the per-engine cap {self.max_hashes_per_engine}",
+                }
+            # parse the (potentially huge) resync snapshot BEFORE taking the
+            # lock — only the set swap happens under it, so concurrent
+            # lookups never stall behind a hex-parse of a whole pool
+            snapshot_hashes = {int(h, 16) for h in raw_hashes}
+        with self._lock:
+            self._purge_dead_locked(time.monotonic(), posting=url)
+            view = self._engines.get(url)
+            if view is None:
+                view = self._engines[url] = _EngineView(url=url)
+            view.block_size = int(
+                payload.get("block_size") or view.block_size or 0
+            )
+            view.last_event_t = time.monotonic()
+            if snapshot_hashes is not None:
+                view.epoch = epoch
+                view.seq = int(payload.get("seq") or 0)
+                view.hashes = snapshot_hashes
+                view.stale = False
+                return {"status": "ok"}
+            seq_start = int(payload.get("seq_start") or 0)
+            events = payload.get("events") or []
+            if view.stale or view.epoch != epoch or seq_start != view.seq + 1:
+                view.stale = True
+                self.resyncs_requested += 1
+                return {"status": "resync", "resync": True}
+            for ev in events:
+                op = ev[0]
+                if op == "a":
+                    view.hashes.add(int(ev[1], 16))
+                elif op == "e":
+                    view.hashes.discard(int(ev[1], 16))
+                elif op == "c":
+                    view.hashes.clear()
+                self.events_applied += 1
+            view.seq = seq_start + len(events) - 1
+            if len(view.hashes) > self.max_hashes_per_engine:
+                logger.warning(
+                    "cluster KV index for %s exceeded %d hashes; resetting "
+                    "to stale (publisher will resync)",
+                    url, self.max_hashes_per_engine,
+                )
+                view.hashes = set()
+                view.stale = True
+                self.resyncs_requested += 1
+                return {"status": "resync", "resync": True}
+        return {"status": "ok"}
+
+    def _purge_dead_locked(self, now: float, posting: str) -> None:
+        """Delete slices of engines silent past purge_after_s — called
+        opportunistically from apply() (O(engines), trivially cheap). A
+        scaled-down or replaced pod must not hold millions of hashes
+        forever; a flapping-but-publishing one never trips this (its
+        heartbeats refresh last_event_t, and the engine currently posting
+        is exempt by definition)."""
+        if self.purge_after_s is None:
+            return
+        for u in [
+            u for u, v in self._engines.items()
+            if u != posting and now - v.last_event_t > self.purge_after_s
+        ]:
+            logger.info(
+                "purging cluster KV index slice for %s (silent > %.0fs)",
+                u, self.purge_after_s,
+            )
+            del self._engines[u]
+
+    def remove_engine(self, url: str) -> None:
+        """Drop an engine's slice NOW — for explicit /deregister only.
+        Discovery churn must NOT call this: a health-probe flap would free
+        a slice the publisher then has to rebuild with a full snapshot
+        resync; lookups already restrict to available endpoints, the
+        liveness TTL drops dead publishers from answers, and
+        _purge_dead_locked reclaims the memory of truly-gone engines."""
+        with self._lock:
+            self._engines.pop(url.rstrip("/"), None)
+
+    # -- queries -----------------------------------------------------------
+
+    def _is_fresh(self, v: _EngineView, now: float) -> bool:
+        return (
+            not v.stale
+            and v.block_size > 0
+            and (
+                self.stale_after_s is None
+                or now - v.last_event_t <= self.stale_after_s
+            )
+        )
+
+    def fresh_engines(self, urls: set[str] | None = None) -> set[str]:
+        """Engines whose index slice is current (snapshot applied, no pending
+        gap, publisher heard from within the liveness TTL) — the set indexed
+        lookups may answer for."""
+        now = time.monotonic()
+        with self._lock:
+            fresh = {
+                u for u, v in self._engines.items() if self._is_fresh(v, now)
+            }
+        return fresh if urls is None else fresh & {
+            u.rstrip("/") for u in urls
+        }
+
+    def lookup_token_ids(
+        self, token_ids: list[int], urls: set[str] | None = None
+    ) -> tuple[str | None, int]:
+        """(engine url with the longest locally-resident prefix, matched
+        tokens) over the fresh engines (optionally restricted to `urls`).
+        Tokenizes nothing and probes nothing: one chain-hash pass per
+        distinct block size, then pure set walks."""
+        candidates = self.fresh_engines(urls)
+        if not candidates:
+            return None, 0
+        with self._lock:
+            views = [
+                self._engines[u] for u in candidates if u in self._engines
+            ]
+            sizes = sorted({v.block_size for v in views})
+        # hash OUTSIDE the lock: one pass per distinct block size (almost
+        # always one); a long prompt must not serialize event ingestion
+        hashes_by_bs = {bs: chain_hashes(token_ids, bs) for bs in sizes}
+        with self._lock:
+            best_url: str | None = None
+            best_tokens = 0
+            for bs in sizes:
+                hashes = hashes_by_bs[bs]
+                group = [v for v in views if v.block_size == bs]
+                for v in group:
+                    matched = 0
+                    for h in hashes:
+                        if h not in v.hashes:
+                            break
+                        matched += bs
+                    # ties break on url order for determinism
+                    if matched > best_tokens or (
+                        matched == best_tokens
+                        and best_url is not None
+                        and matched > 0
+                        and v.url < best_url
+                    ):
+                        best_url, best_tokens = v.url, matched
+            if best_tokens == 0:
+                # nothing resident anywhere: still a valid indexed answer
+                return None, 0
+            return best_url, best_tokens
+
+    def stats(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "engines": len(self._engines),
+                "stale_engines": sum(
+                    1 for v in self._engines.values()
+                    if not self._is_fresh(v, now)
+                ),
+                "hashes": sum(len(v.hashes) for v in self._engines.values()),
+                "events_applied": self.events_applied,
+                "resyncs_requested": self.resyncs_requested,
+            }

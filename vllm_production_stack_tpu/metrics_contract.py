@@ -37,6 +37,35 @@ REMOTE_KV_FETCHES = "tpu:remote_kv_fetched_blocks_total"
 SPEC_DRAFT_TOKENS = "tpu:spec_decode_num_draft_tokens_total"
 SPEC_ACCEPTED_TOKENS = "tpu:spec_decode_num_accepted_tokens_total"
 
+# -- cluster KV index (event-driven KV-aware routing) -----------------------
+# Exported by the KV controller's /metrics and re-exported by the router in
+# embedded-index mode (router/metrics.py). NOT part of the per-engine scrape
+# contract below — these describe the cluster-level index, not one engine.
+CLUSTER_KV_INDEX_HASHES = "tpu:cluster_kv_index_hashes"
+CLUSTER_KV_INDEX_ENGINES = "tpu:cluster_kv_index_engines"
+CLUSTER_KV_INDEX_STALE_ENGINES = "tpu:cluster_kv_index_stale_engines"
+CLUSTER_KV_EVENTS = "tpu:cluster_kv_events_total"
+CLUSTER_KV_RESYNCS = "tpu:cluster_kv_resyncs_total"
+# counter labeled mode=. The controller observes "indexed"|"fanout"|"mixed"
+# (mixed = index for fresh engines + fan-out for the rest in one lookup);
+# the router observes "indexed"|"controller"|"mixed" (controller = pure
+# controller hop, mixed = non-authoritative index attempt escalated to the
+# controller hop). Each routed request is counted under exactly one mode.
+CLUSTER_KV_LOOKUPS = "tpu:cluster_kv_lookups_total"
+# histogram labeled mode= (kv_index.LookupLatency renders it)
+CLUSTER_KV_LOOKUP_LATENCY = "tpu:cluster_kv_lookup_latency_seconds"
+
+CLUSTER_KV_GAUGES = (
+    CLUSTER_KV_INDEX_HASHES,
+    CLUSTER_KV_INDEX_ENGINES,
+    CLUSTER_KV_INDEX_STALE_ENGINES,
+)
+CLUSTER_KV_COUNTERS = (
+    CLUSTER_KV_EVENTS,
+    CLUSTER_KV_RESYNCS,
+    CLUSTER_KV_LOOKUPS,
+)
+
 ALL_GAUGES = (
     NUM_REQUESTS_RUNNING,
     NUM_REQUESTS_WAITING,

@@ -68,6 +68,13 @@ class RouterState:
         self.batch_service = None
         self.files = None
         self.started_at = time.time()
+        # endpoint churn -> policy state (prefix trie scrub, session ring
+        # sync, embedded KV index removal). Reads self.policy at fire time,
+        # so dynamic policy swaps need no re-subscription.
+        self.discovery.add_listener(self._on_endpoint_churn)
+
+    def _on_endpoint_churn(self, removed: set, current: set) -> None:
+        self.policy.on_endpoints_changed(removed, current)
 
     async def apply_dynamic_config(self, config: dict) -> None:
         """Hot-swap discovery/routing from a dynamic config dict."""
@@ -78,6 +85,7 @@ class RouterState:
             merged.update(config)
             ns = _ArgsView(merged)
             new = make_discovery(**_discovery_kwargs(ns))
+            new.add_listener(self._on_endpoint_churn)
             old, self.discovery = self.discovery, new
             await new.start()
             await old.stop()
@@ -134,6 +142,8 @@ def _policy_kwargs(d: dict) -> dict:
         "session_key": d.get("session_key") or "",
         "kv_controller_url": d.get("kv_controller_url") or "",
         "kv_aware_threshold": d.get("kv_aware_threshold", 256),
+        "kv_index_mode": d.get("kv_index_mode") or "controller",
+        "kv_index_tokenizer": d.get("kv_index_tokenizer") or "",
         "prefill_model_labels": split(d.get("prefill_model_labels")),
         "decode_model_labels": split(d.get("decode_model_labels")),
     }
@@ -147,9 +157,16 @@ def _state(request: web.Request) -> RouterState:
 
 
 # everything that proxies to or controls engines requires the API key;
-# /health /metrics /version stay open for probes and scrapers
+# /health /metrics /version stay open for probes and scrapers. The embedded
+# KV-index routes mutate routing state (an unauthenticated /kv/events
+# snapshot could steer matched traffic anywhere), so engines publishing to
+# a keyed router must send the same bearer key (KV_CONTROLLER_API_KEY on
+# the engine side).
 _PROTECTED_PREFIXES = ("/v1", "/tokenize", "/detokenize")
-_PROTECTED_EXACT = ("/sleep", "/wake_up", "/is_sleeping", "/engines")
+_PROTECTED_EXACT = (
+    "/sleep", "/wake_up", "/is_sleeping", "/engines",
+    "/kv/events", "/register", "/deregister",
+)
 
 
 @web.middleware
@@ -254,6 +271,45 @@ async def handle_version(request: web.Request) -> web.Response:
     return web.json_response({"version": VERSION})
 
 
+async def handle_kv_events(request: web.Request) -> web.Response:
+    """Embedded-index mode: engines publish their KV events straight to the
+    router (the router IS the cluster index subscriber — no controller hop
+    anywhere). 409 when the active policy doesn't host an index."""
+    state = _state(request)
+    index = getattr(state.policy, "index", None)
+    if index is None:
+        return web.json_response(
+            {"error": "router is not in embedded KV index mode"}, status=409
+        )
+    raw = await request.text()
+    # off-loop: a resync snapshot parses a whole pool's hashes — both the
+    # multi-MB json.loads and the hex walk must not stall concurrent
+    # route()/proxy work (ClusterKVIndex is thread-safe; the lock is held
+    # only for the set swap)
+    reply = await asyncio.get_running_loop().run_in_executor(
+        None, lambda: index.apply(json.loads(raw))
+    )
+    return web.json_response(reply)
+
+
+async def handle_kv_register(request: web.Request) -> web.Response:
+    """Engines POST /register|/deregister to KV_CONTROLLER_URL on startup
+    and shutdown — accept both when that URL points at this router. The
+    index itself treats publishing as registration; deregister drops the
+    engine's slice immediately instead of waiting for discovery."""
+    state = _state(request)
+    index = getattr(state.policy, "index", None)
+    if index is None:
+        return web.json_response(
+            {"error": "router is not in embedded KV index mode"}, status=409
+        )
+    body = await request.json()
+    url = (body.get("url") or "").rstrip("/")
+    if request.path == "/deregister" and url:
+        index.remove_engine(url)
+    return web.json_response({"status": "ok"})
+
+
 async def handle_sleep(request: web.Request) -> web.Response:
     return await _state(request).request_service.sleep_control(request, "sleep")
 
@@ -297,6 +353,12 @@ def build_app(args) -> web.Application:
     app.router.add_post("/sleep", handle_sleep)
     app.router.add_post("/wake_up", handle_wake)
     app.router.add_get("/is_sleeping", handle_is_sleeping)
+    # embedded cluster-KV-index surface (active when the kvaware policy
+    # hosts the index; registered unconditionally because dynamic config
+    # can swap the policy after the route table froze)
+    app.router.add_post("/kv/events", handle_kv_events)
+    app.router.add_post("/register", handle_kv_register)
+    app.router.add_post("/deregister", handle_kv_register)
 
     if args.enable_batch_api:
         from .batch import BatchService

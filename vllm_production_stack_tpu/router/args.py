@@ -64,6 +64,23 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--session-key", default=None, help="session header name")
     r.add_argument("--kv-controller-url", default=None)
     r.add_argument("--kv-aware-threshold", type=int, default=256)
+    r.add_argument(
+        "--kv-index-mode",
+        choices=["controller", "embedded"],
+        default="controller",
+        help="kvaware lookup source: 'controller' asks the REST KV "
+             "controller per request; 'embedded' hosts the event-driven "
+             "cluster KV index in the router itself (engines publish to "
+             "this router's /kv/events; point their KV_CONTROLLER_URL "
+             "here) — zero lookup hops on the request path",
+    )
+    r.add_argument(
+        "--kv-index-tokenizer",
+        default=None,
+        help="embedded mode's shared tokenizer for hashing prompts the way "
+             "engines do: an HF checkpoint/tokenizer dir, or 'byte' for "
+             "the byte fallback (what tokenizer-less engines use)",
+    )
     r.add_argument("--prefill-model-labels", default=None, help="comma-separated")
     r.add_argument("--decode-model-labels", default=None, help="comma-separated")
     r.add_argument(
@@ -131,8 +148,17 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
         parser.error("--service-discovery static requires --static-backends")
     if args.routing_logic == "session" and not args.session_key:
         parser.error("--routing-logic session requires --session-key")
-    if args.routing_logic == "kvaware" and not args.kv_controller_url:
-        parser.error("--routing-logic kvaware requires --kv-controller-url")
+    if args.routing_logic == "kvaware":
+        if args.kv_index_mode == "controller" and not args.kv_controller_url:
+            parser.error(
+                "--routing-logic kvaware requires --kv-controller-url "
+                "(or --kv-index-mode embedded)"
+            )
+        if args.kv_index_mode == "embedded" and not args.kv_index_tokenizer:
+            parser.error(
+                "--kv-index-mode embedded requires --kv-index-tokenizer "
+                "(a tokenizer dir, or 'byte')"
+            )
     if args.routing_logic == "disaggregated_prefill" and not (
         args.prefill_model_labels and args.decode_model_labels
     ):

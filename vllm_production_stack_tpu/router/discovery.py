@@ -105,12 +105,31 @@ class ServiceDiscovery:
 
     def __init__(self) -> None:
         self._snapshot: list[Endpoint] = []
+        self._listeners: list = []
 
     def endpoints(self) -> list[Endpoint]:
         return self._snapshot
 
+    def add_listener(self, cb) -> None:
+        """Subscribe to endpoint churn: cb(removed_urls: set, current_urls:
+        set), called synchronously on every publish whose URL set changed.
+        This is how endpoint death reaches per-endpoint routing state (the
+        prefix trie, the session ring, the embedded KV index) — without it
+        a drained pod lingered in the trie as a routing candidate forever."""
+        self._listeners.append(cb)
+
     def _publish(self, eps: list[Endpoint]) -> None:
+        old_urls = {e.url for e in self._snapshot}
         self._snapshot = list(eps)
+        new_urls = {e.url for e in self._snapshot}
+        if old_urls == new_urls or not self._listeners:
+            return
+        removed = old_urls - new_urls
+        for cb in list(self._listeners):
+            try:
+                cb(removed, new_urls)
+            except Exception:  # a listener fault must not kill the watcher
+                logger.exception("endpoint-churn listener failed")
 
     async def start(self) -> None:  # pragma: no cover - overridden
         pass

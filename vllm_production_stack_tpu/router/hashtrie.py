@@ -40,10 +40,14 @@ class HashTrie:
             yield xxhash.xxh64_intdigest(text[i : i + self.chunk_chars])
 
     async def insert(self, text: str, endpoint: str) -> None:
+        # hash BEFORE taking the lock: xxhashing a multi-KB prompt is the
+        # expensive part, and doing it under the lock serialized every other
+        # routing decision behind this one
+        hashes = list(self._chunks(text))
         async with self._lock:
             node = self.root
             node.endpoints.add(endpoint)
-            for h in self._chunks(text):
+            for h in hashes:
                 node = node.children.setdefault(h, _Node())
                 node.endpoints.add(endpoint)
 
@@ -53,11 +57,12 @@ class HashTrie:
         """Returns (matched chunk count, endpoints sharing that prefix). When
         nothing matches, the candidate set falls back to `available` (pick
         anywhere, then insert) — reference hashtrie.py:76-103."""
+        hashes = list(self._chunks(text))  # hash outside the lock (insert too)
         async with self._lock:
             node = self.root
             matched = 0
             best: set[str] = set()
-            for h in self._chunks(text):
+            for h in hashes:
                 nxt = node.children.get(h)
                 if nxt is None:
                     break

@@ -9,7 +9,16 @@ prometheus-adapter can read everything from the router.
 
 from __future__ import annotations
 
-from prometheus_client import CollectorRegistry, Gauge, generate_latest
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+from .. import metrics_contract as mc
+from ..kv_index import LookupLatency
 
 LABEL = ["server"]
 
@@ -43,8 +52,70 @@ class RouterMetrics:
             "Engines currently routable",
             registry=self.registry,
         )
+        # embedded cluster-KV-index (kvaware --kv-index-mode embedded):
+        # contract names shared with the KV controller's /metrics
+        # (metrics_contract.CLUSTER_KV_*), so dashboards key off ONE name
+        # wherever the index lives
+        self.kv_index_hashes = Gauge(
+            mc.CLUSTER_KV_INDEX_HASHES,
+            "Hashes in the embedded cluster KV index",
+            registry=self.registry,
+        )
+        self.kv_index_engines = Gauge(
+            mc.CLUSTER_KV_INDEX_ENGINES,
+            "Engines publishing into the embedded cluster KV index",
+            registry=self.registry,
+        )
+        self.kv_index_stale = Gauge(
+            mc.CLUSTER_KV_INDEX_STALE_ENGINES,
+            "Engines whose index slice awaits a resync (sequence gap)",
+            registry=self.registry,
+        )
+        self.kv_index_events = Gauge(
+            # monotonic, but exported as a gauge: the value is owned by the
+            # index (set, not incremented, at scrape time)
+            mc.CLUSTER_KV_EVENTS,
+            "KV events applied to the embedded cluster index",
+            registry=self.registry,
+        )
+        self.kv_index_resyncs = Gauge(
+            mc.CLUSTER_KV_RESYNCS,
+            "Resyncs requested from publishers (gap/epoch/overflow)",
+            registry=self.registry,
+        )
+        self.kv_lookups = Counter(
+            mc.CLUSTER_KV_LOOKUPS,
+            "KV-aware lookups by mode",
+            ["mode"],
+            registry=self.registry,
+        )
+        self.kv_lookup_latency = Histogram(
+            mc.CLUSTER_KV_LOOKUP_LATENCY,
+            "KV-aware lookup latency by mode",
+            ["mode"],
+            # same boundaries wherever the index lives — dashboards key off
+            # one metric name across controller and embedded deployments
+            buckets=LookupLatency.BUCKETS,
+            registry=self.registry,
+        )
+
+    def _render_kv_index(self, policy) -> None:
+        index = getattr(policy, "index", None)
+        if index is not None:
+            st = index.stats()
+            self.kv_index_hashes.set(st["hashes"])
+            self.kv_index_engines.set(st["engines"])
+            self.kv_index_stale.set(st["stale_engines"])
+            self.kv_index_events.set(st["events_applied"])
+            self.kv_index_resyncs.set(st["resyncs_requested"])
+        drain = getattr(policy, "drain_lookup_log", None)
+        if drain is not None:
+            for mode, seconds in drain():
+                self.kv_lookups.labels(mode=mode).inc()
+                self.kv_lookup_latency.labels(mode=mode).observe(seconds)
 
     def render(self, state) -> bytes:
+        self._render_kv_index(state.policy)
         req_stats = state.request_monitor.get_request_stats()
         for url, st in req_stats.items():
             self.current_qps.labels(server=url).set(st.qps)

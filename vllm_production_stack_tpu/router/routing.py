@@ -15,11 +15,14 @@ policies just return.
 
 from __future__ import annotations
 
+import asyncio
 import random
+import time
 from dataclasses import dataclass, field
 
 import aiohttp
 
+from ..utils.http import LazyClientSession
 from ..utils.logging import init_logger
 from .discovery import Endpoint
 from .engine_stats import EngineStats
@@ -92,7 +95,11 @@ def qps_min_url(
 ) -> str:
     """Least-loaded fallback: an engine with no recorded requests wins
     immediately, else lowest QPS (reference _qps_routing,
-    routing_logic.py:60-82)."""
+    routing_logic.py:60-82). Raises LookupError on an empty candidate list
+    (the request service maps it to a clean 503) — returning None here used
+    to surface as an AttributeError deep inside the proxy."""
+    if not endpoints:
+        raise LookupError("no engines available")
     best, best_qps = None, float("inf")
     for ep in endpoints:
         st = request_stats.get(ep.url)
@@ -109,6 +116,14 @@ class RoutingPolicy:
     async def route(self, ctx: RoutingContext) -> str:
         raise NotImplementedError
 
+    def on_endpoints_changed(
+        self, removed: set[str], current: set[str]
+    ) -> None:
+        """Discovery churn hook (router/app.py wires it): policies holding
+        per-endpoint state drop dead engines here instead of leaking them
+        forever. Sync and non-blocking — called from discovery's publish
+        path; schedule async cleanup on the running loop if needed."""
+
     async def close(self) -> None:
         """Release any connections the policy holds (swap/shutdown)."""
 
@@ -123,6 +138,8 @@ class RoundRobinPolicy(RoutingPolicy):
 
     async def route(self, ctx: RoutingContext) -> str:
         eps = sorted(ctx.endpoints, key=lambda e: e.url)
+        if not eps:  # ZeroDivisionError from `% 0` was an opaque 500
+            raise LookupError("no engines available")
         url = eps[self._i % len(eps)].url
         self._i += 1
         return url
@@ -141,11 +158,20 @@ class SessionPolicy(RoutingPolicy):
         self.ring = HashRing()
 
     async def route(self, ctx: RoutingContext) -> str:
+        if not ctx.endpoints:  # get_node on an empty ring returns None
+            raise LookupError("no engines available")
         self.ring.sync([e.url for e in ctx.endpoints])
         session_id = ctx.header(self.session_key)
         if session_id is None:
             return qps_min_url(ctx.endpoints, ctx.request_stats)
         return self.ring.get_node(session_id)
+
+    def on_endpoints_changed(
+        self, removed: set[str], current: set[str]
+    ) -> None:
+        # route() re-syncs per request anyway; syncing on churn too means a
+        # dead engine leaves the ring even on an idle router
+        self.ring.sync(sorted(current))
 
 
 class PrefixAwarePolicy(RoutingPolicy):
@@ -154,63 +180,246 @@ class PrefixAwarePolicy(RoutingPolicy):
 
     name = "prefixaware"
 
+    # how long a disappeared endpoint keeps its trie slice: route() already
+    # filters candidates by the live endpoint set, so the scrub is purely a
+    # memory reclaim for truly-gone engines — firing it on the first missed
+    # health probe would erase a flapping engine's prefix affinity and
+    # collapse its cache hit rate until re-learned
+    scrub_grace_s: float = 120.0
+
     def __init__(self) -> None:
         self.trie = HashTrie()
+        # url -> pending delayed-scrub task (strong refs: the loop holds
+        # only weak task references, so a dropped handle could be GC'd
+        # mid-scrub and leave the dead endpoint in the trie after all)
+        self._scrubs: dict[str, asyncio.Task] = {}
 
     async def route(self, ctx: RoutingContext) -> str:
         prompt = ctx.prompt_text()
         available = {e.url for e in ctx.endpoints}
+        if not available:
+            raise LookupError("no engines available")
         _, matched = await self.trie.longest_prefix_match(prompt, available)
         url = random.choice(sorted(matched))
         await self.trie.insert(prompt, url)
         return url
 
+    def on_endpoints_changed(
+        self, removed: set[str], current: set[str]
+    ) -> None:
+        # scrub dead engines from the trie — without this remove_endpoint
+        # was dead code and a drained pod's memory stayed pinned under
+        # every prefix it ever served. Scrubs run after scrub_grace_s so a
+        # health-probe flap cancels them on the way back up.
+        for url in current:
+            task = self._scrubs.pop(url, None)
+            if task is not None:
+                task.cancel()
+        if not removed:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # constructor-time publish; nothing to scrub yet
+        for url in removed:
+            if url in self._scrubs:
+                continue
+            task = loop.create_task(self._delayed_scrub(url))
+            self._scrubs[url] = task
+            task.add_done_callback(
+                lambda t, url=url: (
+                    self._scrubs.pop(url, None)
+                    if self._scrubs.get(url) is t else None
+                )
+            )
+
+    async def _delayed_scrub(self, url: str) -> None:
+        await asyncio.sleep(self.scrub_grace_s)
+        await self.trie.remove_endpoint(url)
+
 
 class KvawarePolicy(RoutingPolicy):
-    """Ask the KV controller which engine holds the longest cached KV prefix
-    for this prompt; below `threshold` matched tokens (or on any controller
-    fault) fall back to least-loaded. The controller is the stack's LMCache-
-    controller equivalent (engine/kv_controller.py) speaking clean REST, the
-    deployment shape the reference's Go picker assumes
-    (gateway_inference_extension/kv_aware_picker.go:90-133) rather than an
-    in-process import."""
+    """Route to the engine holding the longest cached KV prefix for this
+    prompt; below `threshold` matched tokens (or when nothing can answer)
+    fall back to least-loaded.
+
+    Two lookup modes:
+
+    - **embedded** (index is not None): the router hosts the cluster KV
+      index in-process (kv_index.ClusterKVIndex; router/app.py mounts
+      /kv/events so engines publish straight to the router) — the lookup is
+      a tokenize + chain-hash + set walk with ZERO network hops on the
+      request path. Engines not publishing (or stale after a sequence gap)
+      make the index non-authoritative for them; the policy then falls back
+      to the controller hop when one is configured, else least-loaded.
+    - **controller** (index is None): the original two-hop shape — ask the
+      REST KV controller (engine/kv_controller.py), which itself answers
+      from ITS index or fans out to legacy engines
+      (gateway_inference_extension/kv_aware_picker.go:90-133 parity).
+    """
 
     name = "kvaware"
 
-    def __init__(self, controller_url: str, threshold_tokens: int = 256):
-        self.controller_url = controller_url.rstrip("/")
+    def __init__(self, controller_url: str = "", threshold_tokens: int = 256,
+                 index=None, tokenizer=None):
+        self.controller_url = (controller_url or "").rstrip("/")
         self.threshold_tokens = threshold_tokens
-        self._session: aiohttp.ClientSession | None = None
+        # embedded mode: a kv_index.ClusterKVIndex + something with
+        # .encode(text) -> token ids (the shared engine tokenizer)
+        self.index = index
+        self.tokenizer = tokenizer
+        self._http = LazyClientSession(timeout=aiohttp.ClientTimeout(total=2))
+        # (mode, seconds) lookup observations, drained by RouterMetrics
+        self._lookup_log: list[tuple[str, float]] = []
+        # rate limiter for the publish-url/discovery-url mismatch warning
+        self._mismatch_warn_t = 0.0
 
-    def _sess(self) -> aiohttp.ClientSession:
-        # one long-lived session: the lookup is on the hot path, per-request
-        # session+connection churn would tax latency and file descriptors
-        if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=2)
-            )
-        return self._session
+    async def _sess(self) -> aiohttp.ClientSession:
+        return await self._http.get()
 
     async def close(self) -> None:
-        if self._session is not None and not self._session.closed:
-            await self._session.close()
+        await self._http.close()
+
+    # NOTE deliberately no on_endpoints_changed: freeing an index slice on
+    # discovery churn would turn every health-probe flap into a full
+    # snapshot resync. Lookups already restrict to currently-available
+    # endpoints, the liveness TTL drops dead publishers from answers, and
+    # ClusterKVIndex purges truly-gone engines' memory after a long grace;
+    # explicit /deregister (router/app.py) still frees a slice immediately.
+
+    def drain_lookup_log(self) -> list[tuple[str, float]]:
+        log, self._lookup_log = self._lookup_log, []
+        return log
+
+    def _observe(self, mode: str, seconds: float) -> None:
+        self._lookup_log.append((mode, seconds))
+        if len(self._lookup_log) > 10000:  # scrape stopped; stay bounded
+            del self._lookup_log[:5000]
+
+    async def _indexed_lookup(self, ctx, available):
+        """(url, matched_tokens, authoritative, elapsed_s): authoritative
+        only when EVERY available engine has a fresh index slice — a partial
+        cluster view must escalate to the controller hop (which fans out to
+        the legacy/stale engines) instead of silently degrading to
+        least-loaded for engines the index can't speak for. elapsed_s is
+        None when the index couldn't attempt the lookup at all (route()
+        observes each request under exactly one mode)."""
+        fresh = self.index.fresh_engines(available)
+        if not fresh:
+            all_fresh = self.index.fresh_engines()
+            now = time.monotonic()
+            if all_fresh and now - self._mismatch_warn_t > 60.0:
+                # engines ARE publishing, just under URLs discovery doesn't
+                # know (POD_IP:ENGINE_PORT vs a service DNS name) — without
+                # this warning the index silently never answers anything
+                self._mismatch_warn_t = now
+                logger.warning(
+                    "embedded KV index has fresh publishers %s but none "
+                    "match discovery endpoints %s — check POD_IP/"
+                    "ENGINE_PORT vs the discovery URL scheme; indexed "
+                    "routing is disabled until they agree",
+                    sorted(all_fresh), sorted(available),
+                )
+            return None, 0, False, None
+        loop = asyncio.get_running_loop()
+        t0 = time.perf_counter()
+        # tokenize off-loop: multi-KB chat prompts would stall the router
+        ids = await loop.run_in_executor(
+            None, self.tokenizer.encode, ctx.prompt_text()
+        )
+        url, matched = self.index.lookup_token_ids(ids, available)
+        elapsed = time.perf_counter() - t0
+        # route() pre-normalizes, so set equality is exact
+        return url, matched, fresh == available, elapsed
+
+    @staticmethod
+    def _adapter_model(ctx: RoutingContext) -> str | None:
+        """The request's model name IF discovery knows it as a LoRA adapter
+        (ModelInfo.parent set). Adapter KV chains are salted with an
+        engine-local salt, so neither the embedded index nor the
+        controller's index can hash them — only engine-side probes can."""
+        model = ctx.body.get("model")
+        if not model:
+            return None
+        for ep in ctx.endpoints:
+            info = ep.model_info.get(model)
+            if info is not None and info.parent:
+                return model
+        return None
 
     async def route(self, ctx: RoutingContext) -> str:
-        available = {e.url for e in ctx.endpoints}
-        try:
-            async with self._sess().post(
-                self.controller_url + "/lookup",
-                json={"text": ctx.prompt_text()},
-            ) as resp:
-                data = await resp.json()
-            url = data.get("url")
-            if (
-                url in available
-                and data.get("matched_tokens", 0) >= self.threshold_tokens
-            ):
-                return url
-        except Exception as e:
-            logger.debug("kv controller lookup failed: %s", e)
+        # normalized -> discovery-shaped url: the index and the controller
+        # both answer with rstripped urls, while discovery may carry a
+        # trailing slash — membership checks and the returned url must go
+        # through this map or a resident match is silently discarded
+        by_norm = {e.url.rstrip("/"): e.url for e in ctx.endpoints}
+        available = set(by_norm)
+        adapter = self._adapter_model(ctx)
+        # each request is observed under exactly ONE mode: "indexed" when
+        # the embedded index settled it, "controller" for a pure controller
+        # hop, "mixed" when a non-authoritative index attempt escalated to
+        # the controller (sum over modes == routed KV-aware requests)
+        idx_elapsed = None
+        if (
+            self.index is not None
+            and self.tokenizer is not None
+            and adapter is None
+        ):
+            try:
+                url, matched, authoritative, idx_elapsed = (
+                    await self._indexed_lookup(ctx, available)
+                )
+            except Exception as e:
+                # a tokenizer/index fault must degrade to the fallback
+                # chain, not turn every request into a 500 (the controller
+                # path below has the same guard)
+                logger.debug("embedded kv index lookup failed: %s", e)
+                url, matched, authoritative, idx_elapsed = None, 0, False, None
+            if url in by_norm and matched >= self.threshold_tokens:
+                self._observe("indexed", idx_elapsed or 0.0)
+                return by_norm[url]
+            if authoritative:
+                # the index answered for every available engine: a short
+                # match is a real "nothing cached" — go least-loaded, do
+                # NOT add a controller hop that would say the same thing
+                self._observe("indexed", idx_elapsed or 0.0)
+                return qps_min_url(ctx.endpoints, ctx.request_stats)
+        if self.controller_url:
+            t0 = time.perf_counter()
+            payload = {"text": ctx.prompt_text()}
+            if adapter is not None:
+                # the controller's index can't hash salted adapter chains;
+                # naming the model makes it fan out to engine-side probes
+                payload["model"] = adapter
+            try:
+                sess = await self._sess()
+                async with sess.post(
+                    self.controller_url + "/lookup", json=payload
+                ) as resp:
+                    data = await resp.json()
+                elapsed = time.perf_counter() - t0
+                if idx_elapsed is not None:
+                    self._observe("mixed", idx_elapsed + elapsed)
+                else:
+                    self._observe("controller", elapsed)
+                url = (data.get("url") or "").rstrip("/")
+                if (
+                    url in by_norm
+                    and data.get("matched_tokens", 0) >= self.threshold_tokens
+                ):
+                    return by_norm[url]
+            except Exception as e:
+                logger.debug("kv controller lookup failed: %s", e)
+                # a failed hop still counts — during a controller outage the
+                # lookup metrics must keep tracking routed traffic (and the
+                # histogram must show the timeout-bound latencies)
+                elapsed = time.perf_counter() - t0
+                if idx_elapsed is not None:
+                    self._observe("mixed", idx_elapsed + elapsed)
+                else:
+                    self._observe("controller", elapsed)
+        elif idx_elapsed is not None:
+            self._observe("indexed", idx_elapsed)
         return qps_min_url(ctx.endpoints, ctx.request_stats)
 
 
@@ -252,9 +461,30 @@ def make_policy(name: str, **kw) -> RoutingPolicy:
     if name == "prefixaware":
         return PrefixAwarePolicy()
     if name == "kvaware":
+        index = tokenizer = None
+        if kw.get("kv_index_mode", "controller") == "embedded":
+            from ..kv_index import ClusterKVIndex
+            from ..utils.tokenizer import hashing_tokenizer
+
+            spec = kw.get("kv_index_tokenizer")
+            if not spec:
+                # same rule args.py enforces for the CLI — dynamic-config
+                # swaps come through here without that validation, and
+                # silently defaulting to the byte tokenizer would hash
+                # prompts differently from HF-tokenized engines: every
+                # lookup matches 0 and kvaware degrades to least-loaded
+                # with no sign anything is wrong
+                raise ValueError(
+                    "kvaware embedded index mode requires "
+                    "kv_index_tokenizer (a tokenizer dir, or 'byte')"
+                )
+            index = ClusterKVIndex()
+            tokenizer = hashing_tokenizer(spec)
         return KvawarePolicy(
-            kw.get("kv_controller_url", ""),
+            kw.get("kv_controller_url") or "",
             kw.get("kv_aware_threshold", 256),
+            index=index,
+            tokenizer=tokenizer,
         )
     if name == "disaggregated_prefill":
         return DisaggregatedPrefillPolicy(

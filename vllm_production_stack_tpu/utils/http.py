@@ -1,0 +1,31 @@
+"""Shared aiohttp client plumbing."""
+
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+
+
+class LazyClientSession:
+    """One long-lived aiohttp.ClientSession built on first use, under a
+    lock: concurrent FIRST callers must not each construct a session — all
+    but one would leak its connector. Hot paths (router KV lookups, KV
+    controller fan-out probes) share one instance so per-request
+    session+connection churn never taxes latency or file descriptors."""
+
+    def __init__(self, **session_kwargs):
+        self._kwargs = session_kwargs
+        self._lock = asyncio.Lock()
+        self.session: aiohttp.ClientSession | None = None
+
+    async def get(self) -> aiohttp.ClientSession:
+        if self.session is None or self.session.closed:
+            async with self._lock:
+                if self.session is None or self.session.closed:
+                    self.session = aiohttp.ClientSession(**self._kwargs)
+        return self.session
+
+    async def close(self) -> None:
+        if self.session is not None and not self.session.closed:
+            await self.session.close()

@@ -193,6 +193,18 @@ class TokenizerWrapper:
         return ByteTokenizer().apply_chat_template(messages)
 
 
+def hashing_tokenizer(spec: str | None) -> TokenizerWrapper | None:
+    """Tokenizer for KV chain hashing from a CLI/config spec: an HF
+    checkpoint/tokenizer dir, or "byte" for the byte fallback. None/""
+    means text cannot be hashed locally (callers fall back to engine-side
+    probes). The router's embedded index and the KV controller MUST resolve
+    specs through this one function — divergent resolution would hash the
+    same prompt differently on the two ends of the KV-event protocol."""
+    if not spec:
+        return None
+    return TokenizerWrapper(None if spec == "byte" else spec)
+
+
 class IncrementalDetokenizer:
     """Streams text deltas from a growing token-id list, holding back bytes
     that may be a partial multi-byte character / merged token.
