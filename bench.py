@@ -463,6 +463,101 @@ def _phase_routing_main() -> None:
     print(json.dumps({"routing": result}), flush=True)
 
 
+async def _robustness_bench() -> dict:
+    """Request-lifecycle robustness numbers (docs/26-robustness.md), on a
+    CPU tiny engine behind its real HTTP server so the section survives a
+    wedged TPU tunnel:
+
+    - **shed latency** — how fast an overloaded engine turns a request
+      away (429 + Retry-After) under a flood that overruns
+      max_waiting_requests. Slow shedding is no shedding: the 429 must
+      come back orders of magnitude faster than serving the request.
+    - **drain time** — how long POST /drain?wait=true takes to pass the
+      drain barrier with a stream in flight — the bound helm's preStop
+      hook + terminationGracePeriodSeconds rely on.
+    """
+    import asyncio
+    from dataclasses import replace
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vllm_production_stack_tpu.engine.config import EngineConfig
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.server import EngineServer
+
+    N_FLOOD = 32
+    cfg = EngineConfig.tiny()
+    cfg = cfg.replace(
+        scheduler=replace(cfg.scheduler, max_waiting_requests=4)
+    )
+    srv = EngineServer(
+        LLMEngine(cfg), served_model_name="tiny", drain_timeout_s=30.0
+    )
+    client = TestClient(TestServer(srv.build_app()))
+    await client.start_server()
+    try:
+        body = {"model": "tiny", "prompt": [5, 6, 7, 8],
+                "temperature": 0.0, "max_tokens": 24, "ignore_eos": True}
+        # warm up: the flood must measure shedding, not XLA compiles
+        r = await client.post("/v1/completions", json=dict(body, max_tokens=4))
+        assert r.status == 200, await r.text()
+
+        async def one():
+            t0 = time.monotonic()
+            r = await client.post("/v1/completions", json=body)
+            await r.read()
+            return r.status, time.monotonic() - t0, r.headers.get("Retry-After")
+
+        results = await asyncio.gather(*[one() for _ in range(N_FLOOD)])
+        shed_lat = sorted(lat for st, lat, _ in results if st == 429)
+        served_lat = sorted(lat for st, lat, _ in results if st == 200)
+        retry_after = [float(ra) for st, _, ra in results if st == 429 and ra]
+
+        def pct(lat, p):
+            if not lat:
+                return None
+            return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3, 2)
+
+        # drain with a stream in flight (one-way — runs LAST)
+        stream_task = asyncio.ensure_future(
+            client.post("/v1/completions",
+                        json=dict(body, max_tokens=48, stream=True))
+        )
+        await asyncio.sleep(0.05)
+        t0 = time.monotonic()
+        r = await client.post("/drain?wait=true")
+        drain_s = time.monotonic() - t0
+        drained = (await r.json()).get("drained")
+        stream_resp = await stream_task
+        stream_text = await stream_resp.text()
+        return {
+            "flood_requests": N_FLOOD,
+            "served": len(served_lat),
+            "shed": len(shed_lat),
+            "shed_latency_p50_ms": pct(shed_lat, 0.50),
+            "shed_latency_p99_ms": pct(shed_lat, 0.99),
+            "served_latency_p50_ms": pct(served_lat, 0.50),
+            "retry_after_s": retry_after[0] if retry_after else None,
+            "drain_s": round(drain_s, 3),
+            "drained": bool(drained),
+            "drained_stream_clean": "data: [DONE]" in stream_text,
+        }
+    finally:
+        await client.close()
+
+
+def _phase_robustness_main() -> None:
+    """Subprocess entry for the CPU-only robustness bench (shed latency +
+    drain time). Forces CPU before anything touches jax — this phase must
+    report numbers even when the TPU tunnel is wedged."""
+    import asyncio
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = asyncio.run(_robustness_bench())
+    print(json.dumps({"robustness": result}), flush=True)
+
+
 def _phase_micro_main() -> None:
     """Subprocess entry: enable the persistent compile cache, run the
     microbench (+ the step-loop attribution bench), print its JSON."""
@@ -504,6 +599,8 @@ def main() -> None:
             _phase_preflight_main()
         elif phase == "routing":
             _phase_routing_main()
+        elif phase == "robustness":
+            _phase_robustness_main()
         else:
             assert phase == "micro", phase
             _phase_micro_main()
@@ -515,6 +612,14 @@ def main() -> None:
     routing = _run_phase(
         "routing", ["bench.py", "--phase", "routing"],
         timeout_s=300, key="routing", min_needed_s=60.0,
+    )
+
+    # -0.5) robustness (shed latency + drain time): also CPU-only — the
+    # BENCH trajectory captures regressions in how fast overload is turned
+    # away and how long the drain barrier holds a terminating pod
+    robustness = _run_phase(
+        "robustness", ["bench.py", "--phase", "robustness"],
+        timeout_s=300, key="robustness", min_needed_s=60.0,
     )
 
     # 0) chip preflight: one trivial dispatch. A wedged tunnel fails HERE
@@ -536,6 +641,7 @@ def main() -> None:
             "error": "chip preflight failed — no TPU dispatch possible",
             "preflight": preflight,
             "routing": routing,
+            "robustness": robustness,
             "total_elapsed_s": round(time.monotonic() - _t_start, 1),
         }), flush=True)
         return
@@ -603,6 +709,7 @@ def main() -> None:
         "int8_8b": int8_8b,
         "microbench": micro,
         "routing": routing,
+        "robustness": robustness,
         "total_elapsed_s": round(time.monotonic() - _t_start, 1),
     }), flush=True)
 

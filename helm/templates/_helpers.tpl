@@ -87,6 +87,18 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--decode-window"
 - {{ .decodeWindow | quote }}
 {{- end }}
+{{- if .maxWaitingRequests }}
+- "--max-waiting-requests"
+- {{ .maxWaitingRequests | quote }}
+{{- end }}
+{{- if .maxQueuedTokens }}
+- "--max-queued-tokens"
+- {{ .maxQueuedTokens | quote }}
+{{- end }}
+{{- if .drainTimeoutS }}
+- "--drain-timeout-s"
+- {{ .drainTimeoutS | quote }}
+{{- end }}
 {{- if eq (.enablePrefixCaching | default true) false }}
 - "--no-enable-prefix-caching"
 {{- end }}

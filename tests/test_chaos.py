@@ -1,0 +1,706 @@
+"""Chaos suite: deterministic fault injection through the real aiohttp wire.
+
+The invariant under test (docs/26-robustness.md): under engine-kill-mid-
+stream, slow-loris engines, dead endpoints, controller outage, overload and
+drain, every request COMPLETES, FAILS OVER, or gets exactly ONE clean
+4xx/5xx — never hangs, never silently drops — while the breaker / shed /
+expired / drain counters move per the metrics contract.
+
+Router-level faults run against testing/faults.ChaosEngine (a misbehaving
+FakeEngine); engine-lifecycle faults (shed, deadline, drain) run against a
+real tiny CPU engine behind its real HTTP server.
+"""
+
+import asyncio
+import contextlib
+import json
+import time
+
+import aiohttp
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vllm_production_stack_tpu.router.app import build_app
+from vllm_production_stack_tpu.router.args import parse_args
+from vllm_production_stack_tpu.router.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerBoard,
+)
+from vllm_production_stack_tpu.testing.faults import (
+    ChaosEngine,
+    black_hole,
+    dead_port,
+)
+
+pytestmark = pytest.mark.chaos
+
+# every chaos scenario must resolve well inside this — "never hangs" is the
+# suite's core claim, so a wedged request fails the test, not the run
+SCENARIO_TIMEOUT_S = 30.0
+
+
+@contextlib.asynccontextmanager
+async def chaos_rig(n_engines=2, router_args=(), urls_override=None):
+    """N ChaosEngines + the real router app on static discovery.
+    `urls_override(real_urls) -> urls` lets a test splice in dead ports or
+    black holes as extra 'engines'."""
+    engines, servers = [], []
+    try:
+        for _ in range(n_engines):
+            eng = ChaosEngine(model="fake-model", tokens_per_sec=2000.0)
+            srv = TestServer(eng.build_app())
+            await srv.start_server()
+            engines.append(eng)
+            servers.append(srv)
+        urls = [f"http://127.0.0.1:{s.port}" for s in servers]
+        if urls_override is not None:
+            urls = urls_override(urls)
+        argv = [
+            "--static-backends", ",".join(urls),
+            "--static-models", ";".join(["fake-model"] * len(urls)),
+            *router_args,
+        ]
+        app = build_app(parse_args(argv))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            yield client, engines, app["state"]
+        finally:
+            await client.close()
+    finally:
+        for srv in servers:
+            await srv.close()
+
+
+def chat_body(**kw):
+    return {
+        "model": "fake-model",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 8,
+        **kw,
+    }
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, SCENARIO_TIMEOUT_S))
+
+
+async def read_stream(resp):
+    """(chunks, clean_eof, severed): drain an SSE response, reporting
+    whether it ended with [DONE] or a severed transfer."""
+    chunks, clean = [], False
+    try:
+        async for line in resp.content:
+            line = line.decode().strip()
+            if line == "data: [DONE]":
+                clean = True
+            elif line.startswith("data: "):
+                chunks.append(json.loads(line[6:]))
+    except (aiohttp.ClientPayloadError, aiohttp.ServerDisconnectedError,
+            aiohttp.ClientConnectionError):
+        return chunks, clean, True
+    return chunks, clean, False
+
+
+# -- engine-kill mid-stream --------------------------------------------------
+
+
+def test_kill_mid_stream_severs_client_not_clean_eof():
+    """A post-headers engine death must surface as a SEVERED transfer (the
+    client can tell the answer is truncated) — never a clean EOF, and never
+    a hang. The breaker records the failure."""
+
+    async def go():
+        async with chaos_rig(n_engines=1) as (client, engines, state):
+            engines[0].kill_after_chunks = 3
+            resp = await client.post(
+                "/v1/chat/completions", json=chat_body(stream=True)
+            )
+            assert resp.status == 200  # headers were out before the kill
+            chunks, clean, severed = await read_stream(resp)
+            assert severed and not clean
+            assert len(chunks) <= 3
+            assert engines[0].faults_fired == ["kill_after_chunks"]
+            snap = state.breakers.snapshot()
+            url = next(iter(snap))
+            assert snap[url]["failures_total"] >= 1
+
+    run(go())
+
+
+def test_post_headers_death_is_not_resent_to_another_endpoint():
+    """Satellite (_proxy_stream/_sever coverage): once bytes streamed, a
+    dying engine's request must NOT be replayed elsewhere (double execution
+    of non-idempotent work); the healthy engine serves only its own."""
+
+    async def go():
+        async with chaos_rig(n_engines=2) as (client, engines, state):
+            engines[0].kill_after_chunks = 2
+            engines[1].kill_after_chunks = 2
+            severed_count = 0
+            for _ in range(4):  # roundrobin hits both
+                resp = await client.post(
+                    "/v1/chat/completions", json=chat_body(stream=True)
+                )
+                _, clean, severed = await read_stream(resp)
+                assert severed and not clean
+                severed_count += 1
+            # every request was severed in place: totals equal the requests
+            # each engine received first-hand, nothing was replayed
+            assert engines[0].total_requests + engines[1].total_requests == 4
+            assert severed_count == 4
+
+    run(go())
+
+
+def test_pre_body_connect_failure_fails_over_cleanly():
+    """A dead endpoint (connect refused) costs a reconnect, not a failed
+    request: the pick reruns against the live engine and the client sees
+    one clean 200."""
+
+    async def go():
+        dead = f"http://127.0.0.1:{dead_port()}"
+        async with chaos_rig(
+            n_engines=1, urls_override=lambda urls: [dead, *urls]
+        ) as (client, engines, state):
+            for _ in range(3):
+                resp = await client.post(
+                    "/v1/chat/completions", json=chat_body()
+                )
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["choices"][0]["message"]["content"]
+            assert engines[0].total_requests == 3
+            # the dead endpoint accumulated breaker strikes
+            snap = state.breakers.snapshot()
+            assert snap.get(dead, {}).get("failures_total", 0) >= 1
+
+    run(go())
+
+
+def test_kill_before_headers_returns_single_clean_502():
+    """Accept-then-die before headers: the engine MAY have processed the
+    request, so the router must not resend it — the client gets one clean
+    502 after the single stale-reconnect attempt, not a cross-endpoint
+    replay."""
+
+    async def go():
+        async with chaos_rig(n_engines=2) as (client, engines, state):
+            engines[0].kill_before_headers = True
+            engines[1].kill_before_headers = True
+            resp = await client.post("/v1/chat/completions", json=chat_body())
+            assert resp.status == 502
+            body = await resp.json()
+            assert "error" in body
+
+    run(go())
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+def test_breaker_opens_and_excludes_endpoint_from_picks():
+    """Consecutive connect failures open the dead endpoint's breaker; once
+    open, the policy never picks it again (zero reconnect tax), replacing
+    the old behavior where _with_failover re-discovered the corpse on
+    every request."""
+
+    async def go():
+        dead = f"http://127.0.0.1:{dead_port()}"
+        async with chaos_rig(
+            n_engines=1,
+            router_args=("--breaker-failure-threshold", "2"),
+            urls_override=lambda urls: [dead, *urls],
+        ) as (client, engines, state):
+            for _ in range(4):
+                resp = await client.post(
+                    "/v1/chat/completions", json=chat_body()
+                )
+                assert resp.status == 200
+            snap = state.breakers.snapshot()
+            assert snap[dead]["state"] == OPEN
+            opens_after_trip = snap[dead]["failures_total"]
+            # with the breaker open the dead endpoint is excluded BEFORE the
+            # pick: further traffic must not add connect failures
+            for _ in range(5):
+                resp = await client.post(
+                    "/v1/chat/completions", json=chat_body()
+                )
+                assert resp.status == 200
+            snap = state.breakers.snapshot()
+            assert snap[dead]["failures_total"] == opens_after_trip
+            assert engines[0].total_requests == 9
+
+    run(go())
+
+
+def test_breaker_unit_transitions_deterministic_clock():
+    """State machine unit coverage: threshold trip, cooldown exclusion,
+    half-open single probe, probe failure → doubled backoff, probe success
+    → closed + backoff reset, prune."""
+    now = [1000.0]
+    board = BreakerBoard(
+        failure_threshold=3, cooldown_s=10.0, max_cooldown_s=40.0,
+        clock=lambda: now[0],
+    )
+    url = "http://e1"
+    for _ in range(2):
+        board.on_failure(url)
+    assert board.state(url) == CLOSED and board.allow(url)
+    board.on_failure(url)  # third consecutive: trip
+    assert board.state(url) == OPEN and not board.allow(url)
+    now[0] += 9.9
+    assert not board.allow(url)
+    now[0] += 0.2  # cooldown expired → half-open, one probe admitted
+    assert board.allow(url)
+    assert board.state(url) == HALF_OPEN
+    board.on_attempt(url)
+    assert not board.allow(url)  # probe slot taken
+    board.on_failure(url)  # probe failed → re-open, cooldown doubled to 20
+    assert board.state(url) == OPEN
+    now[0] += 10.1
+    assert not board.allow(url), "doubled cooldown must still exclude"
+    now[0] += 10.0
+    assert board.allow(url)
+    board.on_attempt(url)
+    board.on_success(url)  # probe succeeded → closed, backoff reset
+    assert board.state(url) == CLOSED
+    for _ in range(3):
+        board.on_failure(url)
+    b = board._breakers[url]
+    assert b.open_until - now[0] == pytest.approx(10.0), "backoff was reset"
+    board.prune(set())
+    assert board.state(url) == CLOSED  # state gone with the endpoint
+
+
+def test_breaker_half_open_probe_readmits_recovered_endpoint():
+    """End-to-end recovery: endpoint dies (breaker opens), comes back, and
+    after the cooldown a half-open probe re-admits it to the rotation."""
+
+    async def go():
+        # engine that will "die" and "revive": a ChaosEngine we toggle via
+        # kill_before_headers + connection-level death is hard to revive on
+        # the same port with TestServer, so die at the response layer
+        async with chaos_rig(
+            n_engines=2,
+            router_args=(
+                "--breaker-failure-threshold", "2",
+                "--breaker-cooldown-s", "0.2",
+            ),
+        ) as (client, engines, state):
+            flaky_url = None
+            engines[0].kill_before_headers = True
+            # kill_before_headers is a post-body death: _with_failover stops
+            # after the stale-reconnect (no cross-endpoint resend), so each
+            # hit lands 2 breaker strikes on the flaky engine
+            for _ in range(4):
+                await client.post("/v1/chat/completions", json=chat_body())
+            snap = state.breakers.snapshot()
+            flaky_url = next(
+                (u for u, s in snap.items() if s["state"] == OPEN), None
+            )
+            assert flaky_url is not None, snap
+            # revive the engine, wait out the cooldown
+            engines[0].kill_before_headers = False
+            await asyncio.sleep(0.25)
+            for _ in range(6):
+                resp = await client.post(
+                    "/v1/chat/completions", json=chat_body()
+                )
+                assert resp.status == 200
+            assert state.breakers.state(flaky_url) == CLOSED
+
+    run(go())
+
+
+# -- slow loris --------------------------------------------------------------
+
+
+def test_slow_loris_engine_severed_by_sock_read_guard():
+    """An engine that stalls mid-stream (headers + a chunk, then silence)
+    used to hang the client forever (total=None, no sock_read). With the
+    config-driven sock_read guard the client is severed within a bound."""
+
+    async def go():
+        async with chaos_rig(
+            n_engines=1, router_args=("--upstream-sock-read-s", "0.5"),
+        ) as (client, engines, state):
+            engines[0].stall_after_chunks = 1
+            t0 = time.monotonic()
+            resp = await client.post(
+                "/v1/chat/completions", json=chat_body(stream=True)
+            )
+            chunks, clean, severed = await read_stream(resp)
+            elapsed = time.monotonic() - t0
+            engines[0].stall_release.set()  # free the held handler
+            assert severed and not clean
+            assert elapsed < 10.0, f"sock_read guard did not fire ({elapsed:.1f}s)"
+            assert "stall" in engines[0].faults_fired
+
+    run(go())
+
+
+# -- partition (black hole) --------------------------------------------------
+
+
+def test_black_hole_endpoint_gets_clean_error_not_hang():
+    """Connect succeeds, request vanishes (network partition shape). With
+    the sock_read guard the client gets one clean 5xx inside the bound —
+    pre-headers, the work may have started, so no cross-endpoint resend."""
+
+    async def go():
+        server, port = await black_hole()
+        try:
+            hole = f"http://127.0.0.1:{port}"
+            async with chaos_rig(
+                n_engines=1,
+                router_args=("--upstream-sock-read-s", "0.5"),
+                urls_override=lambda urls: [hole],  # ONLY the hole
+            ) as (client, engines, state):
+                t0 = time.monotonic()
+                resp = await client.post(
+                    "/v1/chat/completions", json=chat_body()
+                )
+                assert resp.status in (502, 503, 504)
+                assert time.monotonic() - t0 < 10.0
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    run(go())
+
+
+# -- KV controller outage ----------------------------------------------------
+
+
+def test_kv_controller_outage_degrades_to_least_loaded():
+    """kvaware routing with a dead controller: every request still routes
+    (policy falls back to least-loaded) and each lookup is observed under
+    the controller mode so the outage is visible in metrics."""
+
+    async def go():
+        dead_ctrl = f"http://127.0.0.1:{dead_port()}"
+        async with chaos_rig(
+            n_engines=2,
+            router_args=(
+                "--routing-logic", "kvaware",
+                "--kv-controller-url", dead_ctrl,
+            ),
+        ) as (client, engines, state):
+            for _ in range(4):
+                resp = await client.post(
+                    "/v1/chat/completions", json=chat_body()
+                )
+                assert resp.status == 200
+            metrics = await (await client.get("/metrics")).text()
+            assert 'tpu:cluster_kv_lookups_total{mode="controller"} 4.0' in metrics
+
+    run(go())
+
+
+# -- engine lifecycle: shed / deadline / drain (real tiny engine) ------------
+
+
+@pytest.fixture()
+def tiny_server():
+    """A REAL engine server factory (tiny CPU model) with robustness knobs.
+    Function-scoped: drain is one-way, so tests get their own instance."""
+    from dataclasses import replace
+
+    from vllm_production_stack_tpu.engine.config import EngineConfig
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.server import EngineServer
+
+    def build(max_waiting_requests=0, max_queued_tokens=0,
+              drain_timeout_s=10.0):
+        cfg = EngineConfig.tiny()
+        cfg = cfg.replace(
+            scheduler=replace(
+                cfg.scheduler,
+                max_waiting_requests=max_waiting_requests,
+                max_queued_tokens=max_queued_tokens,
+            )
+        )
+        engine = LLMEngine(cfg)
+        return EngineServer(
+            engine, served_model_name="tiny-llama",
+            drain_timeout_s=drain_timeout_s,
+        )
+
+    return build
+
+
+def completion_body(**kw):
+    return {
+        "model": "tiny-llama",
+        "prompt": [5, 6, 7, 8],
+        "temperature": 0.0,
+        "max_tokens": 8,
+        **kw,
+    }
+
+
+def test_engine_sheds_with_429_and_retry_after(tiny_server):
+    """Bounded waiting queue: a flood beyond max_waiting_requests gets 429
+    + a Retry-After computed from observed throughput; accepted requests
+    complete; the shed counter and /health surface the overload."""
+
+    async def go():
+        srv = tiny_server(max_waiting_requests=2)
+        client = TestClient(TestServer(srv.build_app()))
+        await client.start_server()
+        try:
+            results = await asyncio.gather(*[
+                client.post("/v1/completions",
+                            json=completion_body(max_tokens=32))
+                for _ in range(12)
+            ])
+            statuses = [r.status for r in results]
+            assert set(statuses) <= {200, 429}, statuses
+            assert statuses.count(200) >= 1, "everything shed: gate too tight"
+            shed = [r for r in results if r.status == 429]
+            assert shed, "nothing shed: admission gate never engaged"
+            for r in shed:
+                assert float(r.headers["Retry-After"]) >= 1
+                body = await r.json()
+                assert body["type"] == "overloaded"
+            metrics = await (await client.get("/metrics")).text()
+            assert "tpu:requests_shed" in metrics
+            import re
+
+            m = re.search(r"tpu:requests_shed_total\S*\s+([0-9.]+)", metrics)
+            assert m and float(m.group(1)) == len(shed)
+            health = await (await client.get("/health")).json()
+            assert health["status"] == "ok"  # alive, not dead
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_deadline_expires_mid_decode_with_clean_finish_reason(tiny_server):
+    """x-request-deadline-ms: an expired request is aborted by the
+    scheduler sweep with finish_reason 'deadline' — a clean partial
+    response, not a hang and not burned TPU steps to max_tokens."""
+
+    async def go():
+        srv = tiny_server()
+        client = TestClient(TestServer(srv.build_app()))
+        await client.start_server()
+        try:
+            # warm once so the deadline request is not dominated by compile
+            r = await client.post("/v1/completions", json=completion_body())
+            assert r.status == 200
+            r = await client.post(
+                "/v1/completions",
+                json=completion_body(max_tokens=200, ignore_eos=True),
+                headers={"x-request-deadline-ms": "80"},
+            )
+            assert r.status == 200
+            data = await r.json()
+            assert data["choices"][0]["finish_reason"] == "deadline"
+            assert data["usage"]["completion_tokens"] < 200
+            metrics = await (await client.get("/metrics")).text()
+            import re
+
+            m = re.search(
+                r"tpu:requests_deadline_expired_total\S*\s+([0-9.]+)", metrics
+            )
+            assert m and float(m.group(1)) >= 1
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_deadline_already_expired_rejected_at_admission(tiny_server):
+    """A request whose deadline cannot be met is shed at the door with a
+    clean 503 (deadline_exceeded) — cheaper than prefilling a corpse."""
+    from vllm_production_stack_tpu.engine.engine import DeadlineExceededError
+
+    async def go():
+        srv = tiny_server()
+        client = TestClient(TestServer(srv.build_app()))
+        await client.start_server()
+        try:
+            # the HTTP layer ignores malformed/absent deadlines
+            r = await client.post(
+                "/v1/completions", json=completion_body(),
+                headers={"x-request-deadline-ms": "garbage"},
+            )
+            assert r.status == 200
+            # admission gate unit check: a deadline in the past refuses
+            with pytest.raises(DeadlineExceededError):
+                srv.engine.check_admission(4, time.monotonic() - 1.0)
+            assert srv.engine.deadline_admission_rejects == 1
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_n_choices_do_not_shed_against_themselves(tiny_server):
+    """A single n>1 request submits its choices concurrently; sibling
+    choices must not count against max_waiting_requests (the request would
+    shed itself on an idle engine). Admission is gated ONCE per HTTP
+    request, before any choice is submitted."""
+
+    async def go():
+        srv = tiny_server(max_waiting_requests=2)
+        client = TestClient(TestServer(srv.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/completions", json=completion_body(n=4, max_tokens=8)
+            )
+            assert r.status == 200, await r.text()
+            data = await r.json()
+            assert len(data["choices"]) == 4
+            assert srv.engine.shed_requests == 0
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_router_deadline_decays_across_attempts():
+    """The relative x-request-deadline-ms budget must lose router-side
+    elapsed time on every rebuild — a failover retry that re-armed the
+    full budget would serve work the caller already gave up on."""
+    from aiohttp.test_utils import make_mocked_request
+
+    from vllm_production_stack_tpu.router.app import RouterState
+    from vllm_production_stack_tpu.router.args import parse_args
+
+    async def go():
+        state = RouterState(parse_args([
+            "--static-backends", "http://127.0.0.1:1",
+            "--static-models", "fake-model",
+        ]))
+        svc = state.request_service
+        req = make_mocked_request(
+            "POST", "/v1/completions",
+            headers={"x-request-deadline-ms": "1000"},
+        )
+        first = float(svc._upstream_headers(req)["x-request-deadline-ms"])
+        assert 0 < first <= 1000
+        # simulate 0.6 s of router-side time (connect timeout, re-pick)
+        req[svc._DEADLINE_KEY] -= 0.6
+        second = float(svc._upstream_headers(req)["x-request-deadline-ms"])
+        assert second <= first - 590, (first, second)
+        # exhausted budget still reaches the engine as an expired deadline
+        req[svc._DEADLINE_KEY] -= 10.0
+        third = float(svc._upstream_headers(req)["x-request-deadline-ms"])
+        assert third == 1.0
+
+    run(go())
+
+
+def test_graceful_drain_finishes_streams_stops_admissions(tiny_server):
+    """POST /drain: the in-flight stream runs to [DONE], new work gets 503
+    + X-Engine-Draining, discovery's probe target (/v1/models) flips 503,
+    /ready flips 503 while /health stays alive, and the drain barrier
+    (?wait=true) completes inside the bound."""
+
+    async def go():
+        srv = tiny_server(drain_timeout_s=15.0)
+        client = TestClient(TestServer(srv.build_app()))
+        await client.start_server()
+        try:
+            # warm up (compile) so the drained stream moves promptly
+            await client.post("/v1/completions", json=completion_body())
+
+            async def stream():
+                resp = await client.post(
+                    "/v1/completions",
+                    json=completion_body(max_tokens=60, ignore_eos=True,
+                                         stream=True),
+                )
+                text = await resp.text()
+                return resp.status, text
+
+            task = asyncio.ensure_future(stream())
+            await asyncio.sleep(0.05)  # let the stream get in flight
+            r = await client.post("/drain")
+            assert r.status in (200, 202)
+            # admissions are now refused with the draining signature
+            r = await client.post("/v1/completions", json=completion_body())
+            assert r.status == 503
+            assert r.headers.get("X-Engine-Draining") == "1"
+            r = await client.get("/v1/models")
+            assert r.status == 503
+            r = await client.get("/ready")
+            assert r.status == 503
+            health = await (await client.get("/health")).json()
+            assert health["status"] == "draining"
+            # the in-flight stream still finishes cleanly
+            status, text = await task
+            assert status == 200
+            assert "data: [DONE]" in text
+            # the drain barrier passes within the bound
+            r = await client.post("/drain?wait=true")
+            assert (await r.json())["drained"] is True
+            metrics = await (await client.get("/metrics")).text()
+            assert "tpu:engine_draining" in metrics
+            import re
+
+            m = re.search(r"tpu:engine_draining\S*\s+([0-9.]+)", metrics)
+            assert m and float(m.group(1)) == 1.0
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_all_engines_draining_returns_retryable_503():
+    """Overlapping drain windows (rolling restart): when EVERY candidate
+    refuses with X-Engine-Draining the client gets a retryable 503 +
+    Retry-After — the engines are healthy and coming back, not a 502
+    'unreachable' — and no breaker takes a strike."""
+
+    async def go():
+        async with chaos_rig(n_engines=2) as (client, engines, state):
+            engines[0].draining = True
+            engines[1].draining = True
+            resp = await client.post("/v1/chat/completions", json=chat_body())
+            assert resp.status == 503
+            assert resp.headers.get("Retry-After")
+            body = await resp.json()
+            assert body["error"]["type"] == "service_unavailable"
+            for entry in state.breakers.snapshot().values():
+                assert entry["failures_total"] == 0
+
+    run(go())
+
+
+def test_router_fails_over_draining_engine_within_probe_interval():
+    """Router side of drain: a draining engine's 503+X-Engine-Draining is
+    failed over pre-byte (clients never see the refusal), and the health
+    probe drops the endpoint from discovery within one interval."""
+
+    async def go():
+        async with chaos_rig(
+            n_engines=2,
+            router_args=("--health-probe-interval", "0.2"),
+        ) as (client, engines, state):
+            engines[0].draining = True
+            for _ in range(6):
+                resp = await client.post(
+                    "/v1/chat/completions", json=chat_body()
+                )
+                assert resp.status == 200  # never surfaces the 503
+            assert engines[1].total_requests == 6
+            # within one probe interval discovery stops listing the
+            # draining engine entirely (its /v1/models-equivalent... the
+            # fake keeps /v1/models 200, so assert the pre-byte failover
+            # carried every request — the real engine's /v1/models flips
+            # 503, covered by test_graceful_drain above)
+            snap = state.breakers.snapshot()
+            for entry in snap.values():
+                assert entry["failures_total"] == 0, (
+                    "drain refusals must not count as breaker failures"
+                )
+
+    run(go())

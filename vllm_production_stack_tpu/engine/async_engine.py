@@ -29,6 +29,20 @@ class EngineSleepingError(RuntimeError):
     reference service_discovery.py:414-496)."""
 
 
+class EngineDrainingError(RuntimeError):
+    """Request submitted while the engine is draining (SIGTERM / POST
+    /drain): admissions are stopped so in-flight streams can finish and the
+    process can exit inside its grace period. The HTTP layer answers 503
+    with X-Engine-Draining so the router fails the request over instead of
+    surfacing the refusal to the client."""
+
+
+def _same_request(rid: str, parent: str) -> bool:
+    """True when `rid` is `parent` itself or one of its n>1 sibling choice
+    ids (server._choice_rids derivation: parent, parent-1, parent-2, …)."""
+    return rid == parent or rid.startswith(parent + "-")
+
+
 class AsyncEngine:
     def __init__(self, engine: LLMEngine):
         self.engine = engine
@@ -46,6 +60,12 @@ class AsyncEngine:
         self._loop: asyncio.AbstractEventLoop | None = None
         self._wake = threading.Event()
         self._stop = False
+        # graceful drain: False stops NEW admissions (submit raises
+        # EngineDrainingError) while in-flight requests keep stepping;
+        # _admitting counts requests popped from _pending but not yet in
+        # the scheduler (wait_idle must not miss them)
+        self.accepting = True
+        self._admitting = 0
         self._thread: threading.Thread | None = None
         self._step_error: Exception | None = None
         # served-stack profiling (exposed via /debug/timing): where the step
@@ -170,42 +190,55 @@ class AsyncEngine:
             with self._pending_lock:
                 if not self._pending:
                     return
-                rid, token_ids, sampling, lora_name = self._pending.popleft()
-            if rid not in self._queues:
-                continue  # consumer vanished (disconnect/abort) pre-admission
-            if self.engine.is_sleeping:
-                # raced sleep(): a silent hang (admitted but never stepped)
-                # becomes the same deterministic error the submit-time check
-                # gives
-                q = self._queues.get(rid)
-                if q is not None and self._loop is not None:
-                    out = RequestOutput(
-                        request_id=rid, new_token_ids=[], finished=True,
-                        finish_reason="error",
-                    )
-                    out.text_delta = (
-                        "engine error: engine is sleeping; wake it before "
-                        "sending requests"
-                    )
-                    self._loop.call_soon_threadsafe(q.put_nowait, out)
-                continue
-            try:
-                self.engine.add_request(
-                    request_id=rid,
-                    prompt_token_ids=token_ids,
-                    sampling=sampling,
-                    lora_name=lora_name,
+                rid, token_ids, sampling, lora_name, deadline = (
+                    self._pending.popleft()
                 )
-            except Exception as e:
-                logger.warning("deferred admission failed for %s: %s", rid, e)
-                q = self._queues.get(rid)
-                if q is not None and self._loop is not None:
-                    out = RequestOutput(
-                        request_id=rid, new_token_ids=[], finished=True,
-                        finish_reason="error",
-                    )
-                    out.text_delta = f"engine error: {e}"
-                    self._loop.call_soon_threadsafe(q.put_nowait, out)
+                # popped but not yet in the scheduler: wait_idle must not
+                # read this window as "drained" (pending empty + scheduler
+                # empty) while the request is mid-admission
+                self._admitting += 1
+            try:
+                self._admit_one(rid, token_ids, sampling, lora_name, deadline)
+            finally:
+                with self._pending_lock:
+                    self._admitting -= 1
+
+    def _admit_one(self, rid, token_ids, sampling, lora_name, deadline):
+        """Move one popped submission into the engine (step thread, engine
+        lock held). A failure fails that request's stream, never the loop."""
+        if rid not in self._queues:
+            return  # consumer vanished (disconnect/abort) pre-admission
+        if self.engine.is_sleeping:
+            # raced sleep(): a silent hang (admitted but never stepped)
+            # becomes the same deterministic error the submit-time check
+            # gives
+            self._fail_stream(
+                rid,
+                "engine is sleeping; wake it before sending requests",
+            )
+            return
+        try:
+            self.engine.add_request(
+                request_id=rid,
+                prompt_token_ids=token_ids,
+                sampling=sampling,
+                lora_name=lora_name,
+                deadline=deadline,
+            )
+        except Exception as e:
+            logger.warning("deferred admission failed for %s: %s", rid, e)
+            self._fail_stream(rid, str(e))
+
+    def _fail_stream(self, rid: str, message: str) -> None:
+        """Deliver a terminal error output to a request's stream queue."""
+        q = self._queues.get(rid)
+        if q is not None and self._loop is not None:
+            out = RequestOutput(
+                request_id=rid, new_token_ids=[], finished=True,
+                finish_reason="error",
+            )
+            out.text_delta = f"engine error: {message}"
+            self._loop.call_soon_threadsafe(q.put_nowait, out)
 
     def _abort_all_inflight(self, exc: Exception) -> None:
         """Terminal-error every queued request and reap its engine state
@@ -251,8 +284,46 @@ class AsyncEngine:
 
     _rid_counter = itertools.count()
 
+    def pending_depth(
+        self, exclude_prefix: str | None = None
+    ) -> tuple[int, int]:
+        """(requests, prompt tokens) queued for admission but not yet seen
+        by the scheduler — the share of the backlog only this bridge knows
+        about, fed into the engine's admission gate. exclude_prefix drops
+        a request's own sibling choices ({rid}, {rid}-i) from the count."""
+        with self._pending_lock:
+            items = list(self._pending)
+        if exclude_prefix is not None:
+            items = [
+                it for it in items
+                if not _same_request(it[0], exclude_prefix)
+            ]
+        return len(items), sum(len(it[1]) for it in items)
+
+    def precheck_admission(
+        self, deadline: float | None = None, n_new_tokens: int = 0,
+        record: bool = True,
+    ) -> None:
+        """Lock-free admission gate for HTTP handlers, run BEFORE a stream's
+        SSE headers go out so overload/drain/deadline refusals keep their
+        proper status codes (429/503). The same checks rerun at submit time
+        — this is the common-case fast path, not the only line of defense.
+        record=False is the would-this-shed probe (/ready, /health): probe
+        polls must not inflate the shed counters."""
+        if not self.accepting:
+            raise EngineDrainingError(
+                "engine is draining; retry against another endpoint"
+            )
+        extra_waiting, extra_tokens = self.pending_depth()
+        self.engine.check_admission(
+            n_new_tokens, deadline,
+            extra_waiting=extra_waiting, extra_tokens=extra_tokens,
+            record=record,
+        )
+
     def _submit(
-        self, request_id, prompt, prompt_token_ids, sampling, q, lora_name=None
+        self, request_id, prompt, prompt_token_ids, sampling, q,
+        lora_name=None, deadline=None, admission_exclude_prefix=None,
     ) -> str:
         """Runs in an executor. Deliberately LOCK-FREE: tokenization +
         validation need no engine state mutation, and admission is deferred
@@ -260,6 +331,10 @@ class AsyncEngine:
         the engine lock used to wait out whole device steps (unfair lock +
         near-100% hold time = 1.7s mean TTFT tax under load)."""
         t0 = time.perf_counter()
+        if not self.accepting:
+            raise EngineDrainingError(
+                "engine is draining; retry against another endpoint"
+            )
         if self.engine.is_sleeping:
             raise EngineSleepingError(
                 "engine is sleeping; wake it before sending requests"
@@ -270,7 +345,30 @@ class AsyncEngine:
             prompt_token_ids = self.engine.tokenizer.encode(prompt)
         # synchronous 4xx for invalid requests, even with deferred admission
         self.engine.validate_new_request(prompt_token_ids, lora_name)
+        # load shedding + would-queue-past-deadline, against the scheduler
+        # queue PLUS the pending deque (both feed the same backlog).
+        # admission_exclude_prefix (the HTTP request's parent rid) keeps an
+        # n>1 request's sibling choices out of its own count — without it a
+        # single n=8 request would shed itself against max_waiting_requests
+        # on an idle engine.
+        extra_waiting, extra_tokens = self.pending_depth(
+            exclude_prefix=admission_exclude_prefix
+        )
+        self.engine.check_admission(
+            len(prompt_token_ids), deadline,
+            extra_waiting=extra_waiting, extra_tokens=extra_tokens,
+            exclude_prefix=admission_exclude_prefix,
+        )
         with self._pending_lock:
+            # re-check under the SAME lock wait_idle samples _pending with:
+            # a drain beginning while this thread was tokenizing must not
+            # let the request slip into _pending after the drain barrier
+            # already observed it empty (the stream would be severed by
+            # process exit despite /drain?wait=true reporting drained)
+            if not self.accepting:
+                raise EngineDrainingError(
+                    "engine is draining; retry against another endpoint"
+                )
             # check + insert must be atomic vs concurrent submits: two
             # requests sharing an X-Request-Id would otherwise both pass
             # the check and cross-wire their output queues
@@ -282,7 +380,7 @@ class AsyncEngine:
             rid = request_id or f"req-a{next(self._rid_counter)}"
             self._queues[rid] = q
             self._pending.append((rid, list(prompt_token_ids), sampling,
-                                  lora_name))
+                                  lora_name, deadline))
         self.loop_timing["submits"] += 1
         self.loop_timing["submit_s"] += time.perf_counter() - t0
         self._wake.set()
@@ -295,15 +393,20 @@ class AsyncEngine:
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
         lora_name: str | None = None,
+        deadline: float | None = None,
+        admission_exclude_prefix: str | None = None,
     ) -> AsyncIterator[RequestOutput]:
-        """Submit a request and yield its incremental outputs."""
+        """Submit a request and yield its incremental outputs.
+        admission_exclude_prefix (the parent request id of an n>1 fan-out)
+        keeps sibling choices out of this submission's admission count —
+        choices gate against OTHER requests, never against their own."""
         if self._step_error is not None:
             raise RuntimeError(f"engine is dead: {self._step_error}")
         q: asyncio.Queue[RequestOutput] = asyncio.Queue()
         loop = asyncio.get_running_loop()
         rid = await loop.run_in_executor(
             None, self._submit, request_id, prompt, prompt_token_ids, sampling,
-            q, lora_name,
+            q, lora_name, deadline, admission_exclude_prefix,
         )
         finished = False
         try:
@@ -344,7 +447,31 @@ class AsyncEngine:
 
     def stats(self):
         with self._lock:
-            return self.engine.stats()
+            snap = self.engine.stats()
+        snap.draining = not self.accepting
+        return snap
+
+    # -- graceful drain ----------------------------------------------------
+
+    def begin_drain(self) -> None:
+        """Stop admissions; in-flight requests keep stepping to completion.
+        Idempotent — the /drain handler and the SIGTERM path may both fire."""
+        self.accepting = False
+
+    async def wait_idle(self, timeout_s: float) -> bool:
+        """Wait (bounded) until every in-flight request has finished — the
+        drain barrier between 'admissions stopped' and 'safe to exit'.
+        Returns True when idle, False when the timeout expired with work
+        still in flight (the caller exits anyway; clients of the stragglers
+        see a severed stream rather than the process lingering forever)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._pending_lock:
+                pending = bool(self._pending) or self._admitting > 0
+            if not pending and not self.engine.scheduler.has_unfinished():
+                return True
+            await asyncio.sleep(0.05)
+        return False
 
     def tokenize(self, text: str) -> list[int]:
         return self.engine.tokenizer.encode(text)

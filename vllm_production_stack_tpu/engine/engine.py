@@ -22,6 +22,22 @@ from .scheduler import DecodeWork, PrefillWork, Scheduler
 logger = logging.getLogger(__name__)
 
 
+class EngineOverloadedError(RuntimeError):
+    """Admission refused: the waiting queue / queued-token watermark is
+    full. The HTTP layer answers 429 with Retry-After = `retry_after_s`
+    (computed from observed decode throughput)."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(RuntimeError):
+    """Admission refused: the request's deadline has already passed, or the
+    estimated queue wait would blow through it — shedding at the door is
+    strictly cheaper than prefilling a reply nobody will read (503)."""
+
+
 @dataclass
 class EngineStatsSnapshot:
     """Mirrors the metric contract the router scrapes from engines
@@ -46,6 +62,11 @@ class EngineStatsSnapshot:
     remote_kv_fetched_blocks: int = 0
     spec_draft_tokens: int = 0
     spec_accepted_tokens: int = 0
+    # request-lifecycle robustness (metrics_contract REQUESTS_SHED /
+    # REQUESTS_DEADLINE_EXPIRED / ENGINE_DRAINING)
+    requests_shed: int = 0
+    requests_deadline_expired: int = 0
+    draining: bool = False
 
 
 @dataclass
@@ -196,6 +217,10 @@ class LLMEngine:
         self._req_counter = itertools.count()
         self._prompt_tokens = 0
         self._generation_tokens = 0
+        # admission-time shedding counters (the scheduler counts mid-queue/
+        # mid-decode deadline expiry separately — stats() sums them)
+        self.shed_requests = 0
+        self.deadline_admission_rejects = 0
         # step-phase wall-time decomposition (served-stack profiling; the
         # async server exposes this via /debug/timing). dispatch_s = host
         # time building + enqueueing device work; sync_s = host time
@@ -238,6 +263,7 @@ class LLMEngine:
         prompt_token_ids: list[int] | None = None,
         sampling: SamplingParams | None = None,
         lora_name: str | None = None,
+        deadline: float | None = None,
     ) -> str:
         request_id = request_id or f"req-{next(self._req_counter)}"
         if prompt_token_ids is None:
@@ -255,6 +281,7 @@ class LLMEngine:
             eos_token_id=self.tokenizer.eos_token_id,
             lora_index=self._lora_slots[lora_name] if lora_name else 0,
             lora_cache_salt=self._lora_salts[lora_name] if lora_name else 0,
+            deadline=deadline,
         )
         self.scheduler.add_request(req)
         self._states[request_id] = _RequestState(
@@ -657,6 +684,121 @@ class LLMEngine:
         if lora_name is not None and lora_name not in self._lora_slots:
             raise ValueError(f"LoRA adapter {lora_name!r} is not loaded")
 
+    # -- admission control / load shedding ---------------------------------
+
+    def observed_tokens_per_s(self) -> float:
+        """Generation throughput — the denominator for Retry-After and
+        queue-wait estimates. Decode-phase timing, NOT total step wall:
+        warmup/lazy XLA compiles land in step_wall_s and would poison the
+        estimate for the process's whole life (a 3 tok/s "observed rate"
+        right after boot made the admission gate shed everything). 0.0
+        before the first decode resolves (callers treat as "unknown")."""
+        dec_s = float(self.timing["decode_s"])
+        dec_t = float(self.timing["decode_tokens"])
+        if dec_s > 0.0 and dec_t > 0:
+            return dec_t / dec_s
+        return 0.0
+
+    def queue_depth(
+        self, exclude_prefix: str | None = None
+    ) -> tuple[int, int]:
+        """(waiting requests, waiting prompt tokens still to prefill), read
+        without the engine lock. Unlike len(), ITERATING a deque the step
+        thread is mutating raises RuntimeError — retry the snapshot a few
+        times and degrade to a request-count-only answer rather than turn
+        an admission check or health probe into a 500. exclude_prefix
+        drops a request's own sibling choices from the count (see
+        check_admission)."""
+        from .async_engine import _same_request
+
+        for _ in range(5):
+            waiting = self.scheduler.waiting
+            try:
+                snap = list(waiting)
+            except RuntimeError:  # deque mutated during iteration
+                continue
+            if exclude_prefix is not None:
+                snap = [
+                    r for r in snap
+                    if not _same_request(r.request_id, exclude_prefix)
+                ]
+            return len(snap), sum(
+                max(0, r.prefill_target - r.num_computed_tokens)
+                for r in snap
+            )
+        return len(self.scheduler.waiting), 0
+
+    def estimate_retry_after_s(self, queued_tokens: int) -> float:
+        """Seconds until the current backlog plausibly clears, from observed
+        decode throughput — the Retry-After a 429 carries. Clamped to
+        [1, 60]: never tell a client "retry now" while shedding, never park
+        it for minutes on a stale estimate."""
+        tps = self.observed_tokens_per_s()
+        if tps <= 0.0:
+            return 1.0
+        return min(60.0, max(1.0, queued_tokens / tps))
+
+    def check_admission(
+        self,
+        n_new_tokens: int,
+        deadline: float | None = None,
+        extra_waiting: int = 0,
+        extra_tokens: int = 0,
+        record: bool = True,
+        exclude_prefix: str | None = None,
+    ) -> None:
+        """Load-shedding + deadline gate, run lock-free at submit time
+        (extra_* carries the async server's not-yet-admitted pending queue).
+        Raises EngineOverloadedError (→ 429 + Retry-After) when the bounded
+        waiting queue / queued-token watermark is full, and
+        DeadlineExceededError (→ 503) when the request would queue past its
+        deadline — both strictly cheaper answered at the door than after
+        burning prefill steps on a reply nobody will read. record=False is
+        the would-this-shed probe (/ready, /health) — the shed counters
+        must count refused REQUESTS, not probe polls. exclude_prefix keeps
+        an n>1 request's own sibling choices out of its count — a request
+        must never shed against itself."""
+        cfg = self.config.scheduler
+        n_waiting, queued_tokens = self.queue_depth(exclude_prefix)
+        n_waiting += extra_waiting
+        queued_tokens += extra_tokens
+        if cfg.max_waiting_requests > 0 and n_waiting >= cfg.max_waiting_requests:
+            if record:
+                self.shed_requests += 1
+            raise EngineOverloadedError(
+                f"engine overloaded: {n_waiting} requests waiting "
+                f"(max_waiting_requests={cfg.max_waiting_requests})",
+                self.estimate_retry_after_s(queued_tokens),
+            )
+        if cfg.max_queued_tokens > 0 and queued_tokens >= cfg.max_queued_tokens:
+            if record:
+                self.shed_requests += 1
+            raise EngineOverloadedError(
+                f"engine overloaded: {queued_tokens} prompt tokens queued "
+                f"(max_queued_tokens={cfg.max_queued_tokens})",
+                self.estimate_retry_after_s(queued_tokens),
+            )
+        if deadline is not None:
+            import time as _time
+
+            now = _time.monotonic()
+            if now > deadline:
+                if record:
+                    self.deadline_admission_rejects += 1
+                raise DeadlineExceededError(
+                    "request deadline already expired at admission"
+                )
+            tps = self.observed_tokens_per_s()
+            if tps > 0.0:
+                est_wait = (queued_tokens + n_new_tokens) / tps
+                if now + est_wait > deadline:
+                    if record:
+                        self.deadline_admission_rejects += 1
+                    raise DeadlineExceededError(
+                        f"request would queue ~{est_wait:.1f}s past its "
+                        "deadline; shed at admission"
+                    )
+
     def has_unfinished(self) -> bool:
         return self.scheduler.has_unfinished()
 
@@ -709,11 +851,15 @@ class LLMEngine:
         )
         t1 = time.perf_counter()
         self.timing["sched_s"] += t1 - t0
-        # requests the scheduler terminated outside a step (e.g. an
-        # impossible-fit re-admission aborted inside schedule()) still need
-        # a terminal output or streaming clients would hang forever
+        # requests the scheduler terminated outside a step (impossible-fit
+        # re-admission abort, expired deadline) still need a terminal
+        # output or streaming clients would hang forever
         for req in self.scheduler.take_finished_externally():
-            outputs.append(self._make_output(req, [], "", "abort"))
+            outputs.append(
+                self._make_output(
+                    req, [], "", self._finish_reason(req) or "abort"
+                )
+            )
         nxt: _InflightStep | None = None
         pre_handle: StepHandle | None = None
         sync_work = None
@@ -809,7 +955,11 @@ class LLMEngine:
         # requests the scheduler terminated outside a step still need a
         # terminal output or streaming clients would hang forever
         for req in self.scheduler.take_finished_externally():
-            outputs.append(self._make_output(req, [], "", "abort"))
+            outputs.append(
+                self._make_output(
+                    req, [], "", self._finish_reason(req) or "abort"
+                )
+            )
         if work is None:
             self._drop_finished(outputs)
             return outputs
@@ -934,6 +1084,7 @@ class LLMEngine:
             RequestStatus.FINISHED_STOPPED: "stop",
             RequestStatus.FINISHED_LENGTH: "length",
             RequestStatus.FINISHED_ABORTED: "abort",
+            RequestStatus.FINISHED_DEADLINE: "deadline",
         }.get(req.status)
 
     @staticmethod
@@ -1012,6 +1163,11 @@ class LLMEngine:
             prefix_cache_hits=pool.stats.hits,
             prefix_cache_queries=pool.stats.queries,
             num_preemptions=self.scheduler.total_preemptions,
+            requests_shed=self.shed_requests,
+            requests_deadline_expired=(
+                self.deadline_admission_rejects
+                + self.scheduler.deadline_expired_total
+            ),
             step_overlap_frac=(
                 self.timing["overlap_s"] / self.timing["step_wall_s"]
                 if self.timing["step_wall_s"] > 0

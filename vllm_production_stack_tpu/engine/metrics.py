@@ -72,6 +72,16 @@ class EngineMetrics:
         )
         self.prompt_tokens = counter(mc.PROMPT_TOKENS, "Prompt tokens processed")
         self.generation_tokens = counter(mc.GENERATION_TOKENS, "Tokens generated")
+        self.requests_shed = counter(
+            mc.REQUESTS_SHED, "Requests refused 429 by admission control"
+        )
+        self.deadline_expired = counter(
+            mc.REQUESTS_DEADLINE_EXPIRED,
+            "Requests shed at admission or aborted mid-decode on deadline",
+        )
+        self.draining = gauge(
+            mc.ENGINE_DRAINING, "1 while the engine is draining"
+        )
         self._counter_values: dict[str, int] = {}
 
     def update(self, s: EngineStatsSnapshot) -> None:
@@ -95,6 +105,11 @@ class EngineMetrics:
         self._bump(self.spec_accepted, "spec_acc", s.spec_accepted_tokens)
         self._bump(self.prompt_tokens, "prompt", s.prompt_tokens)
         self._bump(self.generation_tokens, "gen", s.generation_tokens)
+        self._bump(self.requests_shed, "shed", s.requests_shed)
+        self._bump(
+            self.deadline_expired, "deadline", s.requests_deadline_expired
+        )
+        self.draining.labels(**lb).set(1 if s.draining else 0)
 
     def _bump(self, counter: Counter, key: str, total: int) -> None:
         prev = self._counter_values.get(key, 0)

@@ -43,6 +43,9 @@ class RequestStatus(enum.Enum):
     FINISHED_STOPPED = "finished_stopped"  # eos / stop string
     FINISHED_LENGTH = "finished_length"  # max_tokens / max_model_len
     FINISHED_ABORTED = "finished_aborted"
+    # deadline expired while queued or decoding — aborted with a clean
+    # "deadline" finish reason instead of burning further TPU steps
+    FINISHED_DEADLINE = "finished_deadline"
 
     @property
     def finished(self) -> bool:
@@ -50,6 +53,7 @@ class RequestStatus(enum.Enum):
             RequestStatus.FINISHED_STOPPED,
             RequestStatus.FINISHED_LENGTH,
             RequestStatus.FINISHED_ABORTED,
+            RequestStatus.FINISHED_DEADLINE,
         )
 
 
@@ -86,6 +90,11 @@ class Request:
     # max_tokens/window clamping; postprocess of the resolved step moves
     # these into num_computed_tokens / output_token_ids for real
     num_inflight_tokens: int = 0
+    # absolute time.monotonic() after which this request is worthless to its
+    # caller (x-request-deadline-ms, carried router → engine → scheduler);
+    # None = no deadline. The scheduler sweeps expired requests out of
+    # waiting/running at the top of every schedule() call.
+    deadline: float | None = None
 
     @property
     def num_prompt_tokens(self) -> int:

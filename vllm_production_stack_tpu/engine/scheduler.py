@@ -133,6 +133,10 @@ class Scheduler:
         # spec_decode_num_draft_tokens / num_accepted_tokens)
         self.spec_proposed_tokens = 0
         self.spec_accepted_tokens = 0
+        # requests whose deadline expired while queued or decoding (the
+        # admission-time "would queue past deadline" rejections are counted
+        # by the engine — they never reach the scheduler)
+        self.deadline_expired_total = 0
         # requests finished outside a step (e.g. resumed request that outgrew
         # the pool) — the engine drains these to emit terminal outputs
         self._finished_externally: list[Request] = []
@@ -177,6 +181,39 @@ class Scheduler:
 
     # -- scheduling --------------------------------------------------------
 
+    def expire_deadlines(self, now: float | None = None) -> int:
+        """Sweep waiting + running for requests whose deadline passed and
+        finish them with FINISHED_DEADLINE — an expired request must not
+        burn another prefill chunk or decode window on a reply nobody will
+        read. Finished requests surface through take_finished_externally
+        (terminal output with finish reason "deadline"). Requests with
+        tokens in flight (async pipeline) are finished too: postprocess
+        voids their resolved rows and speculation_valid rolls back any step
+        dispatched on top of them — the same path aborts take."""
+        import time as _time
+
+        now = _time.monotonic() if now is None else now
+
+        def alive(r: Request) -> bool:
+            return r.deadline is None or now <= r.deadline
+
+        expired = [
+            r for q in (self.waiting, self.running) for r in q if not alive(r)
+        ]
+        if not expired:
+            return 0
+        # rebuild each queue once — per-request remove() would make the
+        # sweep O(expired × queue_len) at the top of every schedule() call
+        kept_waiting = [r for r in self.waiting if alive(r)]
+        self.waiting.clear()
+        self.waiting.extend(kept_waiting)
+        self.running = [r for r in self.running if alive(r)]
+        for req in expired:
+            self._finish(req, RequestStatus.FINISHED_DEADLINE)
+            self._finished_externally.append(req)
+            self.deadline_expired_total += 1
+        return len(expired)
+
     def schedule(
         self, inflight: DecodeWork | None = None
     ) -> ScheduleOutput | None:
@@ -184,6 +221,7 @@ class Scheduler:
         decode step currently executing on device: rows carried by it are
         planned at their speculatively-advanced positions and chain their
         input token from its device-resident output matrix (chain_rows)."""
+        self.expire_deadlines()
         decode_ready = [r for r in self.running if r.prefill_done]
         prefilling = [r for r in self.running if not r.prefill_done]
         can_admit = bool(self.waiting) and len(self.running) < self.config.max_num_seqs

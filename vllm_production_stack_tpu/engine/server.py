@@ -25,7 +25,7 @@ from pydantic import ValidationError
 from .. import __version__
 from ..models.registry import resolve_model_config
 from ..utils.logging import init_logger
-from .async_engine import AsyncEngine, EngineSleepingError
+from .async_engine import AsyncEngine, EngineDrainingError, EngineSleepingError
 from .config import (
     CacheConfig,
     EngineConfig,
@@ -33,7 +33,11 @@ from .config import (
     ParallelConfig,
     SchedulerConfig,
 )
-from .engine import LLMEngine
+from .engine import (
+    DeadlineExceededError,
+    EngineOverloadedError,
+    LLMEngine,
+)
 from .metrics import EngineMetrics
 from .protocol import (
     ChatCompletionRequest,
@@ -57,11 +61,34 @@ DEFAULT_MAX_TOKENS = 256
 MAX_N_CHOICES = 8
 
 
-def error(status: int, message: str, type_: str = "invalid_request_error"):
+def error(status: int, message: str, type_: str = "invalid_request_error",
+          headers: dict | None = None):
     return web.json_response(
         ErrorResponse(message=message, type=type_, code=status).model_dump(),
         status=status,
+        headers=headers,
     )
+
+
+# relative time budget in ms, carried router → engine (clock-skew safe: the
+# router injects/forwards it, each hop converts to its own monotonic clock)
+DEADLINE_HEADER = "x-request-deadline-ms"
+
+
+def deadline_from_headers(headers) -> float | None:
+    """Absolute time.monotonic() deadline from the x-request-deadline-ms
+    header, or None. Malformed values are ignored (a bad client header must
+    not 500 the request — the deadline is an optimization, not input)."""
+    raw = headers.get(DEADLINE_HEADER)
+    if not raw:
+        return None
+    try:
+        ms = float(raw)
+    except (TypeError, ValueError):
+        return None
+    if ms <= 0:
+        return None
+    return time.monotonic() + ms / 1000.0
 
 
 class _StreamUnsupported(Exception):
@@ -69,7 +96,8 @@ class _StreamUnsupported(Exception):
 
 
 class EngineServer:
-    def __init__(self, engine: LLMEngine, served_model_name: str | None = None):
+    def __init__(self, engine: LLMEngine, served_model_name: str | None = None,
+                 drain_timeout_s: float = 30.0):
         self.engine = engine
         self.async_engine = AsyncEngine(engine)
         self.model_name = served_model_name or engine.config.model.model
@@ -78,6 +106,12 @@ class EngineServer:
         self.kv_event_publisher = None  # started when KV_CONTROLLER_URL set
         self._tok_repr_cache: dict[int, tuple[str, list[int]]] = {}
         self._start_time = time.time()
+        # graceful drain (SIGTERM / POST /drain): admissions stop, in-flight
+        # streams finish (bounded by drain_timeout_s), the KV event log is
+        # flushed and the engine deregisters from its controller
+        self.drain_timeout_s = drain_timeout_s
+        self._drain_task: asyncio.Task | None = None
+        self._drained = asyncio.Event()
         # OpenAI system_fingerprint: identifies the serving configuration
         # whose outputs a seed reproduces — our model fingerprint (weights
         # + seed + kv dtype) is exactly that identity
@@ -101,6 +135,8 @@ class EngineServer:
         r.add_post("/v1/rerank", self.rerank)
         r.add_get("/v1/models", self.list_models)
         r.add_get("/health", self.health)
+        r.add_get("/ready", self.ready)
+        r.add_post("/drain", self.drain)
         r.add_get("/metrics", self.metrics_endpoint)
         r.add_get("/debug/timing", self.debug_timing)
         r.add_post("/sleep", self.sleep)
@@ -124,6 +160,23 @@ class EngineServer:
         self.async_engine.start(asyncio.get_running_loop())
         await self._register_with_kv_controller("/register")
         self._start_kv_event_publisher()
+        self._install_signal_handlers()
+
+    def _install_signal_handlers(self) -> None:
+        """SIGTERM = graceful drain, then exit (k8s pod termination: preStop
+        POSTs /drain first, the kubelet's SIGTERM follows; a bare SIGTERM
+        without preStop gets the same drain). Replaces aiohttp's default
+        immediate-GracefulExit handler; no-op where signals aren't available
+        (non-main thread — the aiohttp TestServer harness)."""
+        import signal
+
+        try:
+            loop = asyncio.get_running_loop()
+            loop.add_signal_handler(
+                signal.SIGTERM, self._begin_drain, True
+            )
+        except (NotImplementedError, RuntimeError, ValueError):
+            pass
 
     def _start_kv_event_publisher(self) -> None:
         """Push-based cluster KV index: publish this pool's KV events to the
@@ -210,6 +263,41 @@ class EngineServer:
 
     # -- inference routes --------------------------------------------------
 
+    @staticmethod
+    def _admission_error(e: Exception) -> web.Response | None:
+        """Map lifecycle-gate exceptions to their HTTP shape: overload →
+        429 + Retry-After (from observed decode throughput), expired/
+        unmeetable deadline → 503, draining → 503 + X-Engine-Draining (the
+        router fails over on that header instead of surfacing it)."""
+        if isinstance(e, EngineOverloadedError):
+            import math
+
+            return error(
+                429, str(e), "overloaded",
+                headers={"Retry-After": str(int(math.ceil(e.retry_after_s)))},
+            )
+        if isinstance(e, DeadlineExceededError):
+            return error(503, str(e), "deadline_exceeded")
+        if isinstance(e, EngineDrainingError):
+            return error(
+                503, str(e), "service_unavailable",
+                headers={"X-Engine-Draining": "1"},
+            )
+        return None
+
+    def _gate_admission(self, request) -> tuple[float | None, web.Response | None]:
+        """(deadline, refusal) for one inference request — run BEFORE any
+        SSE headers go out so 429/503 keep their status codes. The same
+        checks rerun at submit time (this is the fast path, not the only
+        line of defense)."""
+        deadline = deadline_from_headers(request.headers)
+        try:
+            self.async_engine.precheck_admission(deadline)
+        except (EngineOverloadedError, DeadlineExceededError,
+                EngineDrainingError) as e:
+            return deadline, self._admission_error(e)
+        return deadline, None
+
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         try:
             body = ChatCompletionRequest.model_validate(await request.json())
@@ -235,14 +323,18 @@ class EngineServer:
         if (err := self._check_logprobs(sampling)) is not None:
             return err
         rid = request.headers.get("X-Request-Id") or random_id("chatcmpl")
+        deadline, refused = self._gate_admission(request)
+        if refused is not None:
+            return refused
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=True,
                 lora_name=lora_name, parse_tools=use_tools, n=body.n,
+                deadline=deadline,
             )
         return await self._complete(
             rid, prompt, sampling, chat=True, lora_name=lora_name,
-            parse_tools=use_tools, n=body.n,
+            parse_tools=use_tools, n=body.n, deadline=deadline,
         )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
@@ -278,15 +370,19 @@ class EngineServer:
                 )
             )
         rid = request.headers.get("X-Request-Id") or random_id("cmpl")
+        deadline, refused = self._gate_admission(request)
+        if refused is not None:
+            return refused
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=False,
                 prompt_ids=prompt_ids, lora_name=lora_name, n=body.n,
-                echo_text=echo_text,
+                echo_text=echo_text, deadline=deadline,
             )
         return await self._complete(
             rid, prompt, sampling, chat=False, prompt_ids=prompt_ids,
             lora_name=lora_name, n=body.n, echo_text=echo_text,
+            deadline=deadline,
         )
 
     async def embeddings(self, request: web.Request) -> web.Response:
@@ -580,8 +676,12 @@ class EngineServer:
 
         return dataclasses.replace(sampling, seed=sampling.seed + i)
 
-    async def _run_single(self, rid, prompt, sampling, prompt_ids, lora_name):
-        """One full generation; returns the accumulated result dict."""
+    async def _run_single(self, rid, prompt, sampling, prompt_ids, lora_name,
+                          deadline=None, parent_rid=None):
+        """One full generation; returns the accumulated result dict.
+        parent_rid (the HTTP request's base id) exempts sibling choices of
+        the same n>1 request from this submission's admission count — a
+        request gates against OTHER requests, never against itself."""
         text = ""
         token_ids: list[int] = []
         lp_entries: list = []
@@ -590,6 +690,7 @@ class EngineServer:
         async for out in self.async_engine.generate(
             prompt=prompt, prompt_token_ids=prompt_ids,
             sampling=sampling, request_id=rid, lora_name=lora_name,
+            deadline=deadline, admission_exclude_prefix=parent_rid,
         ):
             text += out.text_delta
             token_ids.extend(out.new_token_ids)
@@ -605,7 +706,7 @@ class EngineServer:
     async def _complete(
         self, rid, prompt, sampling, *, chat: bool, prompt_ids=None,
         lora_name=None, parse_tools: bool = False, n: int = 1,
-        echo_text: str | None = None,
+        echo_text: str | None = None, deadline: float | None = None,
     ) -> web.Response:
         # n>1: concurrent submissions — continuous batching runs them in
         # one batch and the prefix cache dedups the shared prompt, so the
@@ -620,6 +721,7 @@ class EngineServer:
             asyncio.ensure_future(self._run_single(
                 crid, prompt,
                 self._nth_sampling(sampling, i), prompt_ids, lora_name,
+                deadline, parent_rid=rid,
             ))
             for i, crid in enumerate(self._choice_rids(rid, n))
         ]
@@ -630,6 +732,8 @@ class EngineServer:
                 if not t.done():
                     t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
+            if (resp := self._admission_error(e)) is not None:
+                return resp  # raced past the handler's gate: same mapping
             if isinstance(e, ValueError):
                 return error(400, str(e))
             if isinstance(e, EngineSleepingError):
@@ -690,6 +794,7 @@ class EngineServer:
         self, request, rid, prompt, sampling, body, *, chat: bool,
         prompt_ids=None, lora_name=None, parse_tools: bool = False,
         n: int = 1, echo_text: str | None = None,
+        deadline: float | None = None,
     ) -> web.StreamResponse:
         """SSE streaming for 1..n choices — ONE implementation (n=1 is a
         single pump), so single- and parallel-sampling semantics can never
@@ -727,6 +832,7 @@ class EngineServer:
                     prompt=prompt, prompt_token_ids=prompt_ids,
                     sampling=self._nth_sampling(sampling, i),
                     request_id=rids[i], lora_name=lora_name,
+                    deadline=deadline, admission_exclude_prefix=rid,
                 ):
                     await queue.put((i, out))
             except Exception as e:
@@ -870,6 +976,13 @@ class EngineServer:
     # -- discovery / control routes ---------------------------------------
 
     async def list_models(self, request: web.Request) -> web.Response:
+        if self.draining:
+            # discovery probes /v1/models: a 503 here is how the router
+            # stops picking a draining engine within one probe interval
+            return error(
+                503, "engine is draining", "service_unavailable",
+                headers={"X-Engine-Draining": "1"},
+            )
         cards = [ModelCard(id=self.model_name)]
         cards += [
             ModelCard(id=name, parent=self.model_name, root=path)
@@ -877,9 +990,109 @@ class EngineServer:
         ]
         return web.json_response(ModelList(data=cards).model_dump())
 
+    @property
+    def draining(self) -> bool:
+        return not self.async_engine.accepting
+
+    def _begin_drain(self, exit_after: bool = False) -> None:
+        """Idempotent drain trigger (POST /drain and SIGTERM both land
+        here). A later exit_after=True (SIGTERM after a preStop /drain)
+        still exits once the running drain's barrier passes."""
+        self.async_engine.begin_drain()
+        loop = asyncio.get_running_loop()
+        if self._drain_task is None or self._drain_task.done():
+            self._drain_task = loop.create_task(self._do_drain(exit_after))
+        elif exit_after:
+            async def _exit_when_drained():
+                await self._drained.wait()
+                raise web.GracefulExit()
+
+            loop.create_task(_exit_when_drained())
+
+    async def _do_drain(self, exit_after: bool) -> None:
+        """Finish in-flight streams (bounded), flush the KV event log,
+        deregister from the KV controller — then optionally exit the
+        process (SIGTERM path) inside the grace period."""
+        t0 = time.monotonic()
+        idle = await self.async_engine.wait_idle(self.drain_timeout_s)
+        if not idle:
+            logger.warning(
+                "drain timeout (%.1fs) with requests still in flight; "
+                "proceeding", self.drain_timeout_s,
+            )
+        if self.kv_event_publisher is not None:
+            try:
+                await self.kv_event_publisher.flush()
+            except Exception as e:  # flush is best-effort on the way out
+                logger.warning("KV event flush during drain failed: %s", e)
+            await self.kv_event_publisher.stop()
+            self.kv_event_publisher = None
+        await self._register_with_kv_controller("/deregister")
+        self._drained.set()
+        logger.info(
+            "drain complete in %.2fs (idle=%s)", time.monotonic() - t0, idle
+        )
+        if exit_after:
+            # GracefulExit unwinds web.run_app through its normal cleanup
+            raise web.GracefulExit()
+
+    async def drain(self, request: web.Request) -> web.Response:
+        """POST /drain: stop admissions, finish in-flight work, flush +
+        deregister. ?wait=true blocks until the drain barrier passes (the
+        helm preStop hook uses this so SIGTERM only ever lands on a drained
+        process). The process does NOT exit — that's SIGTERM's job."""
+        already = self.draining
+        self._begin_drain(exit_after=False)
+        if request.query.get("wait", "").lower() in ("1", "true", "yes"):
+            await self._drained.wait()
+        return web.json_response(
+            {
+                "status": "draining",
+                "already_draining": already,
+                "drained": self._drained.is_set(),
+                "drain_timeout_s": self.drain_timeout_s,
+            },
+            status=200 if self._drained.is_set() else 202,
+        )
+
+    def _overload_state(self) -> str | None:
+        """Reason the engine would currently shed a plain request, or None.
+        Drives /ready so readiness flips BEFORE collapse. record=False:
+        kubelet probe polls must not inflate tpu:requests_shed_total."""
+        try:
+            self.async_engine.precheck_admission(record=False)
+        except EngineDrainingError:
+            return "draining"
+        except EngineOverloadedError as e:
+            return str(e)
+        return None
+
     async def health(self, request: web.Request) -> web.Response:
+        """Liveness: 503 only when the step loop is dead (a draining or
+        overloaded engine is still alive — restarting it would kill the
+        in-flight streams drain exists to protect). Queue/drain state rides
+        in the body; /ready is the readiness view."""
         if not self.async_engine.is_healthy:
             return web.json_response({"status": "dead"}, status=503)
+        waiting, queued_tokens = self.engine.queue_depth()
+        return web.json_response({
+            "status": "draining" if self.draining else "ok",
+            "draining": self.draining,
+            "waiting_requests": waiting,
+            "queued_tokens": queued_tokens,
+            "overloaded": self._overload_state(),
+        })
+
+    async def ready(self, request: web.Request) -> web.Response:
+        """Readiness: 503 while dead, draining, or shedding — flips the
+        pod out of the Service before the engine collapses under backlog."""
+        if not self.async_engine.is_healthy:
+            return web.json_response({"status": "dead"}, status=503)
+        reason = self._overload_state()
+        if reason is not None:
+            return web.json_response(
+                {"status": "not_ready", "reason": reason}, status=503
+            )
         return web.json_response({"status": "ok"})
 
     async def metrics_endpoint(self, request: web.Request) -> web.Response:
@@ -1236,6 +1449,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disk KV tier byte budget in GiB (0 = off)")
     p.add_argument("--max-num-seqs", type=int, default=64)
     p.add_argument("--max-num-batched-tokens", type=int, default=512)
+    p.add_argument("--max-waiting-requests", type=int, default=0,
+                   help="admission control: bound on the waiting queue — "
+                        "beyond it new requests get 429 + Retry-After "
+                        "computed from observed decode throughput "
+                        "(0 = unbounded)")
+    p.add_argument("--max-queued-tokens", type=int, default=0,
+                   help="admission control: watermark on queued prompt "
+                        "tokens awaiting prefill; beyond it new requests "
+                        "are shed with 429 (0 = unbounded)")
+    p.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="graceful drain bound (SIGTERM / POST /drain): "
+                        "in-flight streams get this long to finish before "
+                        "the KV flush + deregister + exit proceed anyway — "
+                        "keep below terminationGracePeriodSeconds")
     p.add_argument("--prefill-buckets", default="",
                    help="comma-separated prefill chunk buckets (default: "
                         "pow2 ladder up to --max-num-batched-tokens). "
@@ -1389,6 +1616,8 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             width_floor_blocks=args.width_floor_blocks,
             num_speculative_tokens=args.num_speculative_tokens,
             speculative_min_ngram=args.speculative_min_ngram,
+            max_waiting_requests=getattr(args, "max_waiting_requests", 0),
+            max_queued_tokens=getattr(args, "max_queued_tokens", 0),
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
@@ -1439,7 +1668,11 @@ def main(argv: list[str] | None = None) -> None:
             "warming serving buckets (%s scope)...", args.warmup_scope
         )
         engine.warmup(scope=args.warmup_scope)
-    server = EngineServer(engine, served_model_name=args.served_model_name)
+    server = EngineServer(
+        engine,
+        served_model_name=args.served_model_name,
+        drain_timeout_s=args.drain_timeout_s,
+    )
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)
 

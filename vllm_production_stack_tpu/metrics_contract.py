@@ -37,6 +37,16 @@ REMOTE_KV_FETCHES = "tpu:remote_kv_fetched_blocks_total"
 SPEC_DRAFT_TOKENS = "tpu:spec_decode_num_draft_tokens_total"
 SPEC_ACCEPTED_TOKENS = "tpu:spec_decode_num_accepted_tokens_total"
 
+# -- request-lifecycle robustness (docs/26-robustness.md) --------------------
+# admission control: requests refused with 429 + Retry-After because the
+# waiting queue / queued-token watermark was full (load shedding)
+REQUESTS_SHED = "tpu:requests_shed_total"
+# deadline enforcement: requests rejected at admission ("would queue past
+# deadline") or aborted mid-decode after their deadline expired
+REQUESTS_DEADLINE_EXPIRED = "tpu:requests_deadline_expired_total"
+# 1 while the engine is draining (admissions stopped, in-flight finishing)
+ENGINE_DRAINING = "tpu:engine_draining"
+
 # -- cluster KV index (event-driven KV-aware routing) -----------------------
 # Exported by the KV controller's /metrics and re-exported by the router in
 # embedded-index mode (router/metrics.py). NOT part of the per-engine scrape
@@ -66,6 +76,20 @@ CLUSTER_KV_COUNTERS = (
     CLUSTER_KV_LOOKUPS,
 )
 
+# -- router-side robustness (NOT part of the per-engine scrape contract:
+# these describe the router's view of its upstreams). Exported by
+# router/metrics.py; the breaker state/open counts follow the same
+# value-owned-by-component gauge convention as CLUSTER_KV_EVENTS.
+ROUTER_BREAKER_STATE = "tpu:router_breaker_state"  # 0 closed / 1 half / 2 open
+ROUTER_BREAKER_OPENS = "tpu:router_breaker_opens_total"
+ROUTER_UPSTREAM_FAILURES = "tpu:router_upstream_failures_total"
+
+ROUTER_BREAKER_GAUGES = (
+    ROUTER_BREAKER_STATE,
+    ROUTER_BREAKER_OPENS,
+    ROUTER_UPSTREAM_FAILURES,
+)
+
 ALL_GAUGES = (
     NUM_REQUESTS_RUNNING,
     NUM_REQUESTS_WAITING,
@@ -73,6 +97,7 @@ ALL_GAUGES = (
     PREFIX_CACHE_HIT_RATE,
     HOST_KV_USAGE_PERC,
     STEP_OVERLAP_FRAC,
+    ENGINE_DRAINING,
 )
 ALL_COUNTERS = (
     PREFIX_CACHE_HITS,
@@ -86,4 +111,6 @@ ALL_COUNTERS = (
     REMOTE_KV_FETCHES,
     SPEC_DRAFT_TOKENS,
     SPEC_ACCEPTED_TOKENS,
+    REQUESTS_SHED,
+    REQUESTS_DEADLINE_EXPIRED,
 )

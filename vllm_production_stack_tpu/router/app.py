@@ -24,6 +24,7 @@ import time
 from aiohttp import web
 
 from ..utils.logging import init_logger
+from .breaker import BreakerBoard
 from .callbacks import load_callbacks
 from .discovery import make_discovery
 from .dynamic_config import DynamicConfigWatcher
@@ -55,6 +56,14 @@ class RouterState:
             args.engine_stats_interval,
         )
         self.metrics = RouterMetrics()
+        # per-endpoint circuit breakers (router/breaker.py): consecutive
+        # upstream failures exclude an endpoint from policy picks until a
+        # half-open probe succeeds
+        self.breakers = BreakerBoard(
+            failure_threshold=getattr(args, "breaker_failure_threshold", 5),
+            cooldown_s=getattr(args, "breaker_cooldown_s", 5.0),
+            max_cooldown_s=getattr(args, "breaker_max_cooldown_s", 120.0),
+        )
         self.request_service = RequestService(self)
         self.feature_gates = FeatureGates(args.feature_gates)
         self.rewriter = make_rewriter(args.request_rewriter)
@@ -75,6 +84,12 @@ class RouterState:
 
     def _on_endpoint_churn(self, removed: set, current: set) -> None:
         self.policy.on_endpoints_changed(removed, current)
+        # endpoints discovery dropped must not leak breaker state —
+        # discovery exclusion supersedes the breaker anyway, and a pod
+        # recreated on the same URL deserves a clean one. The breaker's
+        # real prey (endpoints that pass health probes but fail requests)
+        # stays in `current` and keeps its history.
+        self.breakers.prune(current)
 
     async def apply_dynamic_config(self, config: dict) -> None:
         """Hot-swap discovery/routing from a dynamic config dict."""
@@ -226,6 +241,7 @@ async def handle_engines(request: web.Request) -> web.Response:
     state = _state(request)
     engine_stats = state.engine_scraper.get_engine_stats()
     request_stats = state.request_monitor.get_request_stats()
+    breakers = state.breakers.snapshot()
     out = []
     for ep in state.discovery.endpoints():
         entry = ep.to_dict()
@@ -233,6 +249,7 @@ async def handle_engines(request: web.Request) -> web.Response:
         rs = request_stats.get(ep.url)
         entry["engine_stats"] = es.__dict__ if es else None
         entry["request_stats"] = rs.__dict__ if rs else None
+        entry["breaker"] = breakers.get(ep.url)
         out.append(entry)
     return web.json_response({"engines": out})
 

@@ -89,6 +89,41 @@ def build_parser() -> argparse.ArgumentParser:
         help='JSON object {"alias": "served-model"}',
     )
 
+    u = p.add_argument_group("upstream robustness")
+    u.add_argument(
+        "--upstream-sock-read-s", type=float, default=300.0,
+        help="per-read upstream timeout (seconds) on proxied requests — a "
+             "wedged engine that stops sending bytes severs the client "
+             "instead of hanging it forever. Streaming-safe: active decode "
+             "emits chunks sub-second, so only a truly stalled upstream "
+             "trips it (0 = no guard)",
+    )
+    u.add_argument(
+        "--upstream-total-s", type=float, default=0.0,
+        help="whole-request upstream timeout (seconds), 0 = unlimited. "
+             "Leave 0 for streaming/transcription workloads (a legitimate "
+             "long answer is not a fault) and rely on --upstream-sock-read-s",
+    )
+    u.add_argument(
+        "--default-deadline-ms", type=float, default=0.0,
+        help="inject x-request-deadline-ms on proxied requests that don't "
+             "carry one: engines shed work they can't start in time (429/"
+             "503) and abort decodes whose caller has given up (0 = off)",
+    )
+    u.add_argument(
+        "--breaker-failure-threshold", type=int, default=5,
+        help="consecutive upstream failures that open an endpoint's "
+             "circuit breaker (excluded from policy picks until a "
+             "half-open probe succeeds; backoff doubles per re-open). "
+             "0 disables breakers",
+    )
+    u.add_argument("--breaker-cooldown-s", type=float, default=5.0,
+                   help="initial open-state cooldown before the half-open "
+                        "probe")
+    u.add_argument("--breaker-max-cooldown-s", type=float, default=120.0,
+                   help="backoff ceiling for endpoints that keep failing "
+                        "their half-open probes")
+
     s = p.add_argument_group("stats")
     s.add_argument("--engine-stats-interval", type=float, default=10.0)
     s.add_argument("--request-stats-window", type=float, default=60.0)

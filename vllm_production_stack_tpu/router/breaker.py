@@ -1,0 +1,177 @@
+"""Per-endpoint circuit breakers for the router's upstream picks.
+
+Before this module, `_with_failover` re-discovered a dead engine on every
+request: the policy kept picking it, the connect failed, the failover loop
+evicted it for THAT request only, and the next request started over. Health
+probes eventually drop the pod, but a flapping endpoint (accepts TCP, dies
+mid-request) can look healthy to probes indefinitely. The breaker is the
+memory the failover loop lacked:
+
+- **closed**   — normal; failures are counted, successes reset the count.
+- **open**     — `failure_threshold` CONSECUTIVE failures tripped it; the
+  endpoint is excluded from policy candidate sets for `cooldown_s`, which
+  doubles on every re-open up to `max_cooldown_s` (exponential backoff for
+  endpoints that flap right back down).
+- **half_open** — cooldown expired: exactly ONE live request is let through
+  as the probe (`on_attempt` reserves the slot when the pick actually goes
+  to that endpoint — filtering alone must not consume it). Success closes
+  the breaker; failure re-opens it with the doubled cooldown. Concurrent
+  requests during the probe stay excluded; a probe that never reports back
+  (wedged upstream, client vanished) frees the slot after `probe_ttl_s`.
+
+Everything is synchronous and lock-free (single event loop); time comes
+from `time.monotonic` via an injectable clock so tests drive state
+transitions deterministically.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+# numeric encoding for the tpu:router_breaker_state gauge
+STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+@dataclass
+class _Breaker:
+    state: str = CLOSED
+    consecutive_failures: int = 0
+    # next cooldown to apply when (re)opening; doubles per re-open
+    current_cooldown_s: float = 0.0
+    open_until: float = 0.0
+    probe_inflight: bool = False
+    probe_started: float = 0.0
+    opens_total: int = 0
+    failures_total: int = 0
+
+
+@dataclass
+class BreakerBoard:
+    """All endpoints' breakers. `failure_threshold=0` disables the board
+    (allow() is always True and nothing is recorded)."""
+
+    failure_threshold: int = 5
+    cooldown_s: float = 5.0
+    max_cooldown_s: float = 120.0
+    probe_ttl_s: float = 30.0
+    clock: callable = time.monotonic
+    _breakers: dict[str, _Breaker] = field(default_factory=dict)
+
+    @property
+    def enabled(self) -> bool:
+        return self.failure_threshold > 0
+
+    def _get(self, url: str) -> _Breaker:
+        b = self._breakers.get(url)
+        if b is None:
+            b = self._breakers[url] = _Breaker(
+                current_cooldown_s=self.cooldown_s
+            )
+        return b
+
+    def allow(self, url: str) -> bool:
+        """May `url` receive a request right now? Pure check — nothing is
+        reserved (candidate filtering runs this over every endpoint; the
+        policy may pick another one). Transitions open → half_open when the
+        cooldown has expired."""
+        if not self.enabled:
+            return True
+        b = self._breakers.get(url)
+        if b is None or b.state == CLOSED:
+            return True
+        now = self.clock()
+        if b.state == OPEN:
+            if now < b.open_until:
+                return False
+            b.state = HALF_OPEN
+            b.probe_inflight = False
+            logger.info("breaker for %s half-open (probing)", url)
+        # half_open: one probe at a time, with a TTL so a probe whose
+        # outcome never reports back can't wedge the endpoint out forever
+        if b.probe_inflight and now - b.probe_started < self.probe_ttl_s:
+            return False
+        return True
+
+    def on_attempt(self, url: str) -> None:
+        """The failover loop picked `url` and is about to send the request:
+        reserve the half-open probe slot (no-op in closed/open)."""
+        if not self.enabled:
+            return
+        b = self._breakers.get(url)
+        if b is not None and b.state == HALF_OPEN:
+            b.probe_inflight = True
+            b.probe_started = self.clock()
+
+    def on_success(self, url: str) -> None:
+        if not self.enabled:
+            return
+        b = self._breakers.get(url)
+        if b is None:
+            return
+        if b.state != CLOSED:
+            logger.info("breaker for %s closed (probe succeeded)", url)
+        b.state = CLOSED
+        b.consecutive_failures = 0
+        b.probe_inflight = False
+        b.current_cooldown_s = self.cooldown_s  # backoff resets on recovery
+
+    def on_failure(self, url: str) -> None:
+        if not self.enabled:
+            return
+        b = self._get(url)
+        b.failures_total += 1
+        b.consecutive_failures += 1
+        if b.state == HALF_OPEN:
+            # failed probe: straight back to open with doubled backoff
+            self._open(url, b)
+            return
+        if b.state == CLOSED and b.consecutive_failures >= self.failure_threshold:
+            self._open(url, b)
+
+    def _open(self, url: str, b: _Breaker) -> None:
+        b.state = OPEN
+        b.probe_inflight = False
+        b.opens_total += 1
+        b.open_until = self.clock() + b.current_cooldown_s
+        logger.warning(
+            "breaker for %s OPEN after %d consecutive failures "
+            "(cooldown %.1fs)", url, b.consecutive_failures,
+            b.current_cooldown_s,
+        )
+        b.current_cooldown_s = min(
+            self.max_cooldown_s, b.current_cooldown_s * 2
+        )
+
+    def state(self, url: str) -> str:
+        b = self._breakers.get(url)
+        return b.state if b is not None else CLOSED
+
+    def prune(self, live_urls: set[str]) -> None:
+        """Drop breakers for endpoints discovery no longer knows — state
+        for a deleted pod's URL must not leak forever."""
+        for url in list(self._breakers):
+            if url not in live_urls:
+                del self._breakers[url]
+
+    def snapshot(self) -> dict[str, dict]:
+        """Per-endpoint view for /metrics and debugging."""
+        out = {}
+        for url, b in self._breakers.items():
+            out[url] = {
+                "state": b.state,
+                "state_code": STATE_CODES[b.state],
+                "consecutive_failures": b.consecutive_failures,
+                "opens_total": b.opens_total,
+                "failures_total": b.failures_total,
+                "open_until": b.open_until,
+            }
+        return out

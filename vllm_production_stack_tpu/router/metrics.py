@@ -52,6 +52,22 @@ class RouterMetrics:
             "Engines currently routable",
             registry=self.registry,
         )
+        # per-endpoint circuit breakers (router/breaker.py). Monotonic
+        # totals exported as gauges: the values are owned by the breaker
+        # board and SET at scrape time (same convention as
+        # CLUSTER_KV_EVENTS below).
+        self.breaker_state = g(
+            mc.ROUTER_BREAKER_STATE,
+            "Breaker state per endpoint (0 closed, 1 half-open, 2 open)",
+        )
+        self.breaker_opens = g(
+            mc.ROUTER_BREAKER_OPENS,
+            "Times each endpoint's breaker opened",
+        )
+        self.upstream_failures = g(
+            mc.ROUTER_UPSTREAM_FAILURES,
+            "Upstream failures recorded against each endpoint",
+        )
         # embedded cluster-KV-index (kvaware --kv-index-mode embedded):
         # contract names shared with the KV controller's /metrics
         # (metrics_contract.CLUSTER_KV_*), so dashboards key off ONE name
@@ -124,6 +140,12 @@ class RouterMetrics:
             self.in_prefill.labels(server=url).set(st.in_prefill_requests)
             self.in_decoding.labels(server=url).set(st.in_decoding_requests)
             self.finished.labels(server=url).set(st.finished_requests)
+        for url, snap in state.breakers.snapshot().items():
+            self.breaker_state.labels(server=url).set(snap["state_code"])
+            self.breaker_opens.labels(server=url).set(snap["opens_total"])
+            self.upstream_failures.labels(server=url).set(
+                snap["failures_total"]
+            )
         for url, st in state.engine_scraper.get_engine_stats().items():
             self.engine_running.labels(server=url).set(st.num_running_requests)
             self.engine_queuing.labels(server=url).set(st.num_queuing_requests)

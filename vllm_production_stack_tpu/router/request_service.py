@@ -32,6 +32,14 @@ class UpstreamConnectError(Exception):
         self.url = url
         self.cause = cause
 
+
+class UpstreamDraining(Exception):
+    """The engine answered 503 + X-Engine-Draining before any byte reached
+    the client: it refused the work without starting it, so failing over is
+    exactly as safe as a refused connection — but it is NOT an endpoint
+    fault (no breaker strike; discovery drops the pod within a probe
+    interval)."""
+
 logger = init_logger(__name__)
 
 # hop-by-hop headers must not be forwarded either direction
@@ -62,8 +70,20 @@ class RequestService:
         self._session: aiohttp.ClientSession | None = None
 
     async def start(self) -> None:
+        # config-driven upstream guards (--upstream-total-s /
+        # --upstream-sock-read-s). The old hard-coded shape — total=None
+        # with no sock_read — left a wedged engine free to hang a client
+        # forever; the multipart path's total=300 severed legitimate long
+        # transcriptions. sock_read is the streaming-safe guard: active
+        # decode emits chunks sub-second, so only a stalled upstream trips
+        # it.
+        args = self.state.args
+        total = getattr(args, "upstream_total_s", 0.0) or None
+        sock_read = getattr(args, "upstream_sock_read_s", 300.0) or None
         self._session = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10)
+            timeout=aiohttp.ClientTimeout(
+                total=total, sock_connect=10, sock_read=sock_read
+            )
         )
 
     async def stop(self) -> None:
@@ -88,7 +108,13 @@ class RequestService:
             # engines that published no model list yet still count as
             # candidates in static mode (they may simply not be probed)
             eps = by_model or [e for e in eps if not e.model_names]
-        return eps
+        # circuit breakers: open endpoints are excluded from policy picks.
+        # Fail OPEN when every candidate's breaker is open — the cluster is
+        # down or the breakers are wrong, and a connect attempt beats a
+        # guaranteed 503.
+        breakers = self.state.breakers
+        admissible = [e for e in eps if breakers.allow(e.url)]
+        return admissible or eps
 
     def resolve_alias(self, model: str | None) -> str | None:
         if model and model in self.state.model_aliases:
@@ -207,10 +233,22 @@ class RequestService:
             logger.info(
                 "Routing request %s to %s at %f", request_id, url, time.time()
             )
+            self.state.breakers.on_attempt(url)  # reserve half-open probe
             try:
                 return await attempt(url)
             except UpstreamConnectError as e:
                 last_err = e
+                if isinstance(e.cause, UpstreamDraining):
+                    # a drain refusal is not an endpoint fault: no breaker
+                    # strike, just re-pick among the others
+                    candidates = [c for c in candidates if c.url != url]
+                    logger.info(
+                        "engine %s is draining; request %s fails over "
+                        "(%d candidates left)", url, request_id,
+                        len(candidates),
+                    )
+                    continue
+                self.state.breakers.on_failure(url)
                 if isinstance(e.cause, aiohttp.ServerDisconnectedError):
                     if url not in same_url_retried:
                         same_url_retried.add(url)
@@ -227,6 +265,17 @@ class RequestService:
                 )
         if on_exhausted is not None:
             await on_exhausted()
+        if last_err is not None and isinstance(last_err.cause, UpstreamDraining):
+            # every candidate politely refused (overlapping drain windows in
+            # a rolling restart): the engines are healthy and coming back —
+            # tell the client to retry, don't report them unreachable
+            return web.json_response(
+                {"error": {"message": "all candidate engines are draining; "
+                                      "retry shortly",
+                           "type": "service_unavailable"}},
+                status=503,
+                headers={"Retry-After": "2"},
+            )
         return web.json_response(
             {"error": {"message": f"engine unreachable: {last_err}"}},
             status=502,
@@ -283,7 +332,7 @@ class RequestService:
         # fresh one for the rebuilt form
         headers = {
             k: v
-            for k, v in _forward_headers(request.headers).items()
+            for k, v in self._upstream_headers(request).items()
             if k.lower() != "content-type"
         }
         mon = self.state.request_monitor
@@ -301,12 +350,27 @@ class RequestService:
             mon.on_new_request(url, request_id, time.time())
             resp: web.StreamResponse | None = None
             try:
+                # no per-request timeout override: the session's
+                # config-driven guards apply (the old total=300 here
+                # severed legitimate long transcriptions; sock_read is the
+                # wedged-engine guard)
                 async with self.session.post(
                     url + request.path,
                     data=fd,
                     headers=headers,
-                    timeout=aiohttp.ClientTimeout(total=300),
                 ) as upstream:
+                    if (
+                        upstream.status == 503
+                        and upstream.headers.get("X-Engine-Draining")
+                    ):
+                        raise UpstreamConnectError(url, UpstreamDraining())
+                    if upstream.status < 500:
+                        # a 5xx is not proof of health: it must not reset
+                        # strikes from real mid-stream deaths (an engine
+                        # alternating instant-500s with dying would never
+                        # trip its breaker) — but nor is it a strike (a
+                        # model error is not a flapping endpoint)
+                        self.state.breakers.on_success(url)
                     resp = web.StreamResponse(status=upstream.status)
                     for k, v in upstream.headers.items():
                         if k.lower() not in _HOP_HEADERS:
@@ -333,6 +397,9 @@ class RequestService:
                 # the upload may have been RECEIVED (e.g. the engine died
                 # mid-processing): never resend non-idempotent work
                 if resp is None or not resp.prepared:
+                    # same breaker accounting as the JSON path's pre-headers
+                    # death (_sever strikes for the prepared case)
+                    self.state.breakers.on_failure(url)
                     return web.json_response(
                         {"error": {"message": f"engine error: {e}"}},
                         status=502,
@@ -346,15 +413,53 @@ class RequestService:
         )
 
 
-    @staticmethod
-    async def _sever(request, resp, backend_url, request_id, e):
+    _DEADLINE_KEY = "tpu_deadline_abs"  # per-request slot on the aiohttp req
+
+    def _upstream_headers(self, request) -> dict[str, str]:
+        """Forwardable headers, with the relative x-request-deadline-ms
+        budget DECAYED by router-side elapsed time (the client's header, or
+        --default-deadline-ms when absent). The budget is anchored to an
+        absolute monotonic deadline on first build, so a failover attempt
+        after a 10 s connect timeout forwards the 10-seconds-poorer
+        remainder instead of re-arming the full budget on every retry."""
+        headers = _forward_headers(request.headers)
+        abs_deadline = request.get(self._DEADLINE_KEY)
+        if abs_deadline is None:
+            ms = 0.0
+            raw = request.headers.get("x-request-deadline-ms")
+            if raw:
+                try:
+                    ms = float(raw)
+                except (TypeError, ValueError):
+                    ms = 0.0
+            if ms <= 0:
+                ms = getattr(self.state.args, "default_deadline_ms", 0.0)
+            # 0.0 = no deadline (sentinel, so the parse runs once)
+            abs_deadline = (
+                time.monotonic() + ms / 1000.0 if ms and ms > 0 else 0.0
+            )
+            request[self._DEADLINE_KEY] = abs_deadline
+        if abs_deadline:
+            # clamp to 1 ms: an exhausted budget must still reach the
+            # engine as an immediately-expired deadline (clean admission
+            # 503), not vanish (deadline_from_headers ignores <= 0)
+            remaining_ms = max(
+                1, int((abs_deadline - time.monotonic()) * 1000)
+            )
+            headers["x-request-deadline-ms"] = str(remaining_ms)
+        return headers
+
+    async def _sever(self, request, resp, backend_url, request_id, e):
         """Headers (and possibly chunks) already went out — the only
         honest signal left is severing the connection so the client sees
-        a truncated transfer instead of a clean end."""
+        a truncated transfer instead of a clean end. Counts as a breaker
+        failure: an engine dying mid-stream is exactly the flapping the
+        breaker exists to remember."""
         logger.warning(
             "engine %s died mid-stream for request %s: %s",
             backend_url, request_id, e,
         )
+        self.state.breakers.on_failure(backend_url)
         resp.force_close()
         if request.transport is not None:
             request.transport.close()
@@ -385,9 +490,25 @@ class RequestService:
             async with self.session.request(
                 request.method,
                 backend_url + request.path,
-                headers=_forward_headers(request.headers),
+                headers=self._upstream_headers(request),
                 data=data,
             ) as upstream:
+                if (
+                    upstream.status == 503
+                    and upstream.headers.get("X-Engine-Draining")
+                ):
+                    # the engine refused without starting the work — as
+                    # retry-safe as a refused connection, and not a fault
+                    # (no breaker strike; discovery drops the pod within a
+                    # probe interval)
+                    pre_byte_raise = True
+                    raise UpstreamConnectError(
+                        backend_url, UpstreamDraining()
+                    )
+                if upstream.status < 500:
+                    # same rule as the multipart path: a 5xx neither
+                    # resets breaker strikes nor adds one
+                    self.state.breakers.on_success(backend_url)
                 resp = web.StreamResponse(status=upstream.status)
                 for k, v in upstream.headers.items():
                     if k.lower() not in _HOP_HEADERS:
@@ -429,6 +550,7 @@ class RequestService:
                 # the request MAY have been received and processed (engine
                 # died mid-inference before sending headers): a resend
                 # could double-execute non-idempotent work — fail honestly
+                self.state.breakers.on_failure(backend_url)
                 return web.json_response(
                     {"error": {"message": f"engine error: {e}"}}, status=502
                 )
