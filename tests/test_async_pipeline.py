@@ -191,6 +191,37 @@ def test_timing_keys_lockstep_with_metrics_contract(pipe):
         assert base in text, f"contract metric {name} missing from exporter"
 
 
+def test_multi_tenant_mix_equivalence(pipe, serial):
+    """Weighted fair-share admission (docs/27-multitenancy.md) is part of
+    the scheduler state both loops share — per-request streams must stay
+    BITWISE identical between the serial and pipelined loops under a
+    multi-tenant mix of priorities and weights, including a seat
+    preemption triggered by the realtime arrival."""
+    from vllm_production_stack_tpu.qos import TenantContext
+
+    mix = [
+        (PROMPTS[0], TenantContext("bulk", priority=2, weight=1.0)),
+        (PROMPTS[1], TenantContext("acme", priority=0, weight=3.0)),
+        (PROMPTS[2], TenantContext("bulk", priority=2, weight=1.0)),
+        (prompt_ids(4, 7), TenantContext("std", priority=1, weight=2.0)),
+        (prompt_ids(5, 6), TenantContext()),  # unstamped default traffic
+    ]
+    sp = SamplingParams(max_tokens=15, temperature=0.0, ignore_eos=True)
+    out = {}
+    for eng in (pipe, serial):
+        rids = [
+            eng.add_request(prompt_token_ids=p, sampling=sp, tenant=t)
+            for p, t in mix
+        ]
+        got = {rid: [] for rid in rids}
+        while eng.has_unfinished():
+            for o in eng.step():
+                got[o.request_id].extend(o.new_token_ids)
+        out[eng is pipe] = [got[rid] for rid in rids]
+    assert out[True] == out[False]
+    assert all(len(s) == 15 for s in out[True])  # everyone ran to budget
+
+
 def test_spec_decode_forces_serial_path():
     cfg = EngineConfig.tiny()
     from dataclasses import replace

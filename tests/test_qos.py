@@ -1,0 +1,804 @@
+"""Multi-tenant QoS (docs/27-multitenancy.md): tenant table parsing +
+hot reload, token-bucket/concurrency enforcement, timing-safe key
+resolution, router stamping, weighted fair-share scheduling, and the
+composition with PR 3's load shedding (lowest-priority-first eviction,
+per-tenant 429 distinct from the global shed path)."""
+
+import asyncio
+import contextlib
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vllm_production_stack_tpu.engine.config import (
+    CacheConfig,
+    ModelConfig,
+    SchedulerConfig,
+)
+from vllm_production_stack_tpu.engine.request import (
+    Request,
+    RequestStatus,
+    SamplingParams,
+)
+from vllm_production_stack_tpu.engine.scheduler import PrefillWork, Scheduler
+from vllm_production_stack_tpu.qos import (
+    FairShareClock,
+    TenantContext,
+    TenantLimiter,
+    TenantTable,
+    TokenBucket,
+    tenant_from_headers,
+)
+from vllm_production_stack_tpu.qos.gate import QoSGate, count_prompt_tokens
+from vllm_production_stack_tpu.router.app import RouterState, build_app
+from vllm_production_stack_tpu.router.args import parse_args
+from vllm_production_stack_tpu.router.dynamic_config import DynamicConfigWatcher
+from vllm_production_stack_tpu.testing.fake_engine import FakeEngine
+
+pytestmark = pytest.mark.qos
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30.0))
+
+
+TABLE_YAML = """
+tenants:
+  acme:
+    api_key: sk-acme-1
+    priority: realtime
+    weight: 3
+    requests_per_s: 100
+  bulk:
+    api_key: sk-bulk-1
+    priority: batch
+    weight: 1
+  open.row:
+    priority: standard
+"""
+
+
+# -- tenant table parsing ----------------------------------------------------
+
+
+def test_table_parses_yaml_and_json():
+    t = TenantTable.loads(TABLE_YAML)
+    assert len(t) == 3
+    acme = t.get("acme")
+    assert acme.priority == "realtime" and acme.priority_rank == 0
+    assert acme.weight == 3.0 and acme.requests_per_s == 100.0
+    # bare mapping (no "tenants" wrapper) and JSON both parse
+    t2 = TenantTable.loads(
+        json.dumps({"acme": {"api_key": "k", "priority": "batch"}}),
+        fmt="json",
+    )
+    assert t2.get("acme").priority_rank == 2
+    # unmatched traffic falls back to a standard/weight-1 default policy
+    d = t.default_policy
+    assert d.tenant_id == "default" and d.priority == "standard"
+    # ... unless the table customizes the "default" row
+    t3 = TenantTable.loads("default:\n  priority: batch\n")
+    assert t3.default_policy.priority == "batch"
+
+
+@pytest.mark.parametrize(
+    "text",
+    [
+        "acme:\n  priority: urgent\n",  # unknown class
+        "acme:\n  weight: 0\n",  # zero weight breaks the virtual clock
+        "acme:\n  weight: -2\n",
+        "acme:\n  requests_per_s: -1\n",
+        "acme:\n  turbo: true\n",  # unknown key = likely typo
+        "'bad tenant!':\n  weight: 1\n",  # id charset (label/header safe)
+        "a:\n  api_key: k1\nb:\n  api_key: k1\n",  # shared key is ambiguous
+        "- a\n- b\n",  # not a mapping
+    ],
+)
+def test_table_rejects_malformed(text):
+    with pytest.raises(ValueError):
+        TenantTable.loads(text)
+
+
+def test_resolve_key_and_header_claims():
+    t = TenantTable.loads(TABLE_YAML)
+    assert t.resolve_key("sk-acme-1").tenant_id == "acme"
+    assert t.resolve_key("sk-nope") is None
+    assert t.resolve_key(None) is None
+    gate = QoSGate(t)
+    # a KEYLESS row is claimable via the trusted x-tenant-id header
+    # (mTLS-style deployments); a keyed row never is (spoof guard)
+    assert (
+        gate.resolve_tenant(None, {"x-tenant-id": "open.row"}).tenant_id
+        == "open.row"
+    )
+    assert gate.resolve_tenant(None, {"x-tenant-id": "acme"}) is None
+    assert gate.resolve_tenant("sk-bulk-1", {}).tenant_id == "bulk"
+
+
+def test_tenant_from_headers_degrades_to_default():
+    ctx = tenant_from_headers(
+        {"x-tenant-id": "acme", "x-priority": "batch", "x-tenant-weight": "2.5"}
+    )
+    assert ctx.tenant_id == "acme" and ctx.priority == 2 and ctx.weight == 2.5
+    # malformed values degrade per-field, never raise
+    bad = tenant_from_headers(
+        {"x-tenant-id": "no spaces!", "x-priority": "vip",
+         "x-tenant-weight": "NaN-ish"}
+    )
+    assert bad.tenant_id == "default"
+    assert bad.priority == 1 and bad.weight == 1.0
+    assert tenant_from_headers({}).is_default
+
+
+# -- token buckets + limiter -------------------------------------------------
+
+
+def test_token_bucket_refill_and_retry_after():
+    b = TokenBucket(rate=2.0, burst=4.0)
+    t0 = 100.0
+    for _ in range(4):
+        assert b.try_take(1.0, now=t0) == 0.0
+    wait = b.try_take(1.0, now=t0)
+    assert wait == pytest.approx(0.5)  # 1 token at 2/s
+    # after the advertised wait the take succeeds
+    assert b.try_take(1.0, now=t0 + wait) == 0.0
+
+
+def test_limiter_rps_tpm_concurrency_and_release():
+    t = TenantTable.loads(
+        "a:\n  requests_per_s: 2\n  tokens_per_min: 120\n  max_concurrent: 2\n"
+    )
+    lim = TenantLimiter(t)
+    pol = t.get("a")
+    now = 50.0
+    assert lim.try_admit(pol, 10, now=now) is None
+    assert lim.try_admit(pol, 10, now=now) is None
+    v = lim.try_admit(pol, 10, now=now)
+    # concurrency cap trips first (cheapest check), at 2 in flight
+    assert v is not None and v.reason == "max_concurrent"
+    lim.release("a")
+    # rps bucket (burst=2) is empty now: refusal carries the refill time
+    v = lim.try_admit(pol, 10, now=now)
+    assert v is not None and v.reason == "requests_per_s"
+    assert 0 < v.retry_after_s <= 60
+    # a token-bucket refusal must not also charge the request bucket
+    lim2 = TenantLimiter(
+        TenantTable.loads("b:\n  requests_per_s: 10\n  tokens_per_min: 60\n")
+    )
+    polb = lim2._states["b"].policy
+    assert lim2.try_admit(polb, 60, now=now) is None  # drains the tpm bucket
+    rps_level = lim2._states["b"].rps.level
+    v = lim2.try_admit(polb, 60, now=now)
+    assert v is not None and v.reason == "tokens_per_min"
+    assert lim2._states["b"].rps.level == pytest.approx(rps_level)
+
+
+def test_limiter_hot_reload_preserves_bucket_levels():
+    t1 = TenantTable.loads("a:\n  requests_per_s: 2\n")
+    lim = TenantLimiter(t1)
+    pol = t1.get("a")
+    now = 10.0
+    assert lim.try_admit(pol, 0, now=now) is None
+    assert lim.try_admit(pol, 0, now=now) is None  # bucket drained
+    # reload with a higher limit: rate updates, LEVEL survives (no free
+    # burst for every tenant on table edit)
+    t2 = TenantTable.loads("a:\n  requests_per_s: 4\n")
+    lim.update_table(t2)
+    st = lim._states["a"]
+    assert st.policy.requests_per_s == 4.0
+    assert st.rps.level == pytest.approx(0.0)
+    v = lim.try_admit(t2.get("a"), 0, now=now)
+    assert v is not None and v.reason == "requests_per_s"
+    # removed tenants drop their state; unknown tenants admit as unlimited
+    lim.update_table(TenantTable.loads("b: {}\n"))
+    assert lim.try_admit(pol, 0, now=now) is None
+
+
+def test_count_prompt_tokens():
+    tok_ids = {"prompt": [1, 2, 3, 4]}
+    assert count_prompt_tokens(tok_ids, None) == 4  # ids count exactly
+    assert count_prompt_tokens({"prompt": "hello"}, None) == 0  # no tokenizer
+    class FakeTok:
+        def encode(self, text):
+            return text.split()
+    assert count_prompt_tokens({"prompt": "a b c"}, FakeTok()) == 3
+    msgs = {"messages": [{"role": "user", "content": "a b"},
+                         {"role": "assistant",
+                          "content": [{"type": "text", "text": "c"}]}]}
+    assert count_prompt_tokens(msgs, FakeTok()) == 3
+
+
+# -- fair-share clock --------------------------------------------------------
+
+
+def test_fairshare_clock_weight_proportional():
+    clk = FairShareClock()
+    admitted = {"heavy": 0, "light": 0}
+    # both tenants always have work: pick the smaller key, charge equal cost
+    for _ in range(400):
+        pick = min(admitted, key=lambda t: (clk.key(t), t))
+        admitted[pick] += 1
+        clk.charge(pick, 100.0, 3.0 if pick == "heavy" else 1.0)
+    share = admitted["heavy"] / 400
+    assert 0.70 <= share <= 0.80, admitted  # 3:1 -> 75%
+
+
+def test_fairshare_idle_tenant_rejoins_at_clock():
+    clk = FairShareClock()
+    for _ in range(50):
+        clk.charge("busy", 100.0, 1.0)
+    # an idle tenant's key clamps UP to the virtual clock: it gets the next
+    # pick but no banked monopoly
+    assert clk.key("idle") == pytest.approx(clk.key("busy") - 100.0)
+
+
+# -- scheduler: fair-share pick, priority preemption, shed eviction ----------
+
+
+def make_scheduler(num_blocks=64, block_size=4, max_batched=32, max_seqs=4):
+    return Scheduler(
+        ModelConfig.tiny(max_model_len=256),
+        CacheConfig(
+            block_size=block_size, num_blocks=num_blocks,
+            enable_prefix_caching=True,
+        ),
+        SchedulerConfig(
+            max_num_seqs=max_seqs,
+            max_num_batched_tokens=max_batched,
+            decode_buckets=(max_seqs,),
+            prefill_buckets=(max_batched,),
+            decode_window=1,
+        ),
+    )
+
+
+def qreq(rid, tenant="default", priority=1, weight=1.0, n_prompt=8,
+         max_tokens=4):
+    return Request(
+        request_id=rid,
+        prompt_token_ids=list(range(100, 100 + n_prompt)),
+        sampling=SamplingParams(max_tokens=max_tokens, ignore_eos=True),
+        tenant_id=tenant,
+        priority=priority,
+        weight=weight,
+    )
+
+
+def drive(sched, work, start_token=1000):
+    if isinstance(work, PrefillWork):
+        rows = [[start_token + i] if s else [] for i, s in enumerate(work.sample)]
+    else:
+        rows = [[start_token + i] for i in range(len(work.requests))]
+    return sched.postprocess(work, rows)
+
+
+def test_unstamped_traffic_keeps_fifo():
+    s = make_scheduler(max_seqs=2)
+    for i in range(4):
+        s.add_request(qreq(f"r{i}"))
+    assert not s._qos_active
+    work = s.schedule()
+    # pure FIFO: the first two waiting requests got the seats
+    assert [r.request_id for r in work.requests] == ["r0", "r1"]
+
+
+def test_fair_share_admission_tracks_weight():
+    s = make_scheduler(max_seqs=1, max_batched=16)
+    # both tenants keep 6 requests queued; ONE seat — admission order is
+    # the fair-share pick. Equal cost per request, weights 3:1.
+    n = 6
+    for i in range(n):
+        s.add_request(qreq(f"h{i}", tenant="heavy", weight=3.0, priority=2,
+                           n_prompt=8, max_tokens=1))
+        s.add_request(qreq(f"l{i}", tenant="light", weight=1.0, priority=2,
+                           n_prompt=8, max_tokens=1))
+    order = []
+    for _ in range(200):
+        if not s.waiting and not s.running:
+            break
+        work = s.schedule()
+        if work is None:
+            break
+        for r in work.requests:
+            if isinstance(work, PrefillWork) and r.request_id not in order:
+                order.append(r.request_id)
+        drive(s, work)
+        s.take_finished_externally()
+    # first 8 admissions: heavy should take ~3 of every 4 slots
+    first8 = order[:8]
+    heavy_n = sum(1 for rid in first8 if rid.startswith("h"))
+    assert heavy_n in (5, 6, 7), order  # 6/8 = 75% +- one pick
+    assert len(order) == 2 * n  # everyone eventually served (no starvation)
+
+
+def test_priority_tiers_beat_weight():
+    s = make_scheduler(max_seqs=1)
+    s.add_request(qreq("batch", tenant="bulk", priority=2, weight=100.0))
+    s.add_request(qreq("rt", tenant="acme", priority=0, weight=0.1))
+    work = s.schedule()
+    # realtime wins the pick regardless of weight
+    assert [r.request_id for r in work.requests] == ["rt"]
+
+
+def test_seat_preemption_lowest_priority_first():
+    s = make_scheduler(max_seqs=2)
+    s.add_request(qreq("std", tenant="a", priority=1))
+    s.add_request(qreq("batch", tenant="b", priority=2))
+    work = s.schedule()
+    assert {r.request_id for r in work.requests} == {"std", "batch"}
+    drive(s, work)
+    # seats full; a realtime arrival preempts the BATCH seat, not standard
+    # (the first schedule() may be the alternation's decode turn)
+    s.add_request(qreq("rt", tenant="c", priority=0))
+    for _ in range(3):
+        work = s.schedule()
+        if any(r.request_id == "rt" for r in work.requests):
+            break
+        drive(s, work)
+    assert any(r.request_id == "rt" for r in work.requests)
+    running = {r.request_id for r in s.running}
+    assert "rt" in running and "std" in running
+    batch = next(r for r in s.waiting if r.request_id == "batch")
+    assert batch.status == RequestStatus.PREEMPTED
+
+
+def test_equal_priority_never_preempts_seats():
+    s = make_scheduler(max_seqs=1)
+    s.add_request(qreq("first", tenant="a", priority=1))
+    drive(s, s.schedule())
+    s.add_request(qreq("second", tenant="b", priority=1))
+    work = s.schedule()
+    # the incumbent keeps its seat: same class waits (pre-QoS behavior)
+    assert all(r.request_id == "first" for r in work.requests)
+    assert s.total_preemptions == 0
+
+
+def test_shed_eviction_marks_lowest_priority_and_applies():
+    s = make_scheduler(max_seqs=1)
+    s.add_request(qreq("run", tenant="a", priority=1))
+    drive(s, s.schedule())
+    s.add_request(qreq("w_std", tenant="a", priority=1))
+    s.add_request(qreq("w_batch", tenant="b", priority=2))
+    # a realtime arrival at a full queue evicts the BATCH waiter
+    assert s.has_shed_victim(0)
+    assert s.mark_shed_victim(0)
+    s.schedule()  # step thread applies marks at the top of schedule()
+    shed = s.take_finished_externally()
+    assert [r.request_id for r in shed] == ["w_batch"]
+    assert shed[0].status == RequestStatus.FINISHED_SHED
+    assert s.shed_evictions == 1
+    # a batch arrival finds nothing strictly worse than itself
+    assert not s.mark_shed_victim(2)
+    # and a standard arrival doesn't either (only batch was evictable)
+    assert not s.has_shed_victim(1)
+
+
+def test_engine_check_admission_evicts_batch_before_realtime():
+    """PR 3 composition at the LLMEngine layer: with max_waiting_requests
+    hit, a batch arrival is refused (429-shaped EngineOverloadedError) while
+    a realtime arrival passes by claiming the batch waiter's slot."""
+    from dataclasses import replace
+
+    from vllm_production_stack_tpu.engine.config import EngineConfig
+    from vllm_production_stack_tpu.engine.engine import (
+        EngineOverloadedError,
+        LLMEngine,
+    )
+
+    cfg = EngineConfig.tiny()
+    cfg = cfg.replace(scheduler=replace(cfg.scheduler, max_waiting_requests=2))
+    eng = LLMEngine(cfg)
+    try:
+        sp = SamplingParams(max_tokens=4, ignore_eos=True)
+        batch_ctx = TenantContext("bulk", priority=2, weight=1.0)
+        rt_ctx = TenantContext("acme", priority=0, weight=3.0)
+        for i in range(2):
+            eng.add_request(
+                prompt_token_ids=[7, 8, 9, 10 + i], sampling=sp,
+                tenant=batch_ctx,
+            )
+        # queue full: another batch arrival is shed with the global shape
+        with pytest.raises(EngineOverloadedError) as ei:
+            eng.check_admission(4, tenant=batch_ctx, evict=True)
+        assert ei.value.retry_after_s >= 1
+        shed0 = eng.stats().requests_shed
+        assert shed0 >= 1
+        # a realtime arrival passes by marking the newest batch waiter
+        eng.check_admission(4, tenant=rt_ctx, evict=True)
+        rid = eng.add_request(
+            prompt_token_ids=[1, 2, 3], sampling=sp, tenant=rt_ctx
+        )
+        outs = []
+        while eng.has_unfinished():
+            outs.extend(eng.step())
+        by_reason = {}
+        for o in outs:
+            if o.finish_reason:
+                by_reason.setdefault(o.finish_reason, []).append(o.request_id)
+        assert rid in by_reason.get("length", [])  # realtime ran to budget
+        assert len(by_reason.get("shed", [])) == 1  # one batch waiter evicted
+        snap = eng.stats()
+        assert snap.requests_shed > shed0  # evictions count as shedding
+        assert snap.tenants["bulk"]["shed"] >= 1
+        assert snap.tenants["acme"]["requests"] == 1
+    finally:
+        eng.runner.shutdown(wait=True)
+
+
+def test_refused_arrival_never_claims_a_victim():
+    """A realtime arrival that is going to be refused ANYWAY (token
+    watermark) must not also evict a batch waiter — that would lose two
+    requests where the pre-QoS path lost one."""
+    from dataclasses import replace
+
+    from vllm_production_stack_tpu.engine.config import EngineConfig
+    from vllm_production_stack_tpu.engine.engine import (
+        EngineOverloadedError,
+        LLMEngine,
+    )
+
+    cfg = EngineConfig.tiny()
+    cfg = cfg.replace(scheduler=replace(
+        cfg.scheduler, max_waiting_requests=2, max_queued_tokens=4,
+    ))
+    eng = LLMEngine(cfg)
+    try:
+        sp = SamplingParams(max_tokens=4, ignore_eos=True)
+        batch_ctx = TenantContext("bulk", priority=2, weight=1.0)
+        for i in range(2):
+            eng.add_request(
+                prompt_token_ids=[7, 8, 9, 10 + i], sampling=sp,
+                tenant=batch_ctx,
+            )
+        rt_ctx = TenantContext("acme", priority=0, weight=1.0)
+        with pytest.raises(EngineOverloadedError) as ei:
+            eng.check_admission(4, tenant=rt_ctx, evict=True)
+        assert "tokens queued" in str(ei.value)
+        assert not eng.scheduler._evict_rids  # no victim was claimed
+    finally:
+        eng.runner.shutdown(wait=True)
+
+
+# -- tenant metrics exporter -------------------------------------------------
+
+
+def test_tenant_metrics_rendered_with_labels():
+    from vllm_production_stack_tpu import metrics_contract as mc
+    from vllm_production_stack_tpu.engine.engine import EngineStatsSnapshot
+    from vllm_production_stack_tpu.engine.metrics import EngineMetrics
+
+    snap = EngineStatsSnapshot(
+        tenants={"acme": {"requests": 3, "generation_tokens": 40, "shed": 1}},
+        tenant_queue_waits=[("acme", 0.01), ("acme", 0.3)],
+    )
+    text = EngineMetrics("tiny").render(snap).decode()
+    for name in (mc.TENANT_REQUESTS, mc.TENANT_GENERATION_TOKENS,
+                 mc.TENANT_SHED):
+        assert name in mc.ALL_COUNTERS
+        base = name[: -len("_total")]
+        assert f'{base}_total{{model_name="tiny",tenant="acme"}}' in text
+    assert mc.TENANT_QUEUE_WAIT + "_bucket" in text
+    # waits were DRAINED into the histogram: count matches observations
+    assert f'{mc.TENANT_QUEUE_WAIT}_count{{model_name="tiny",tenant="acme"}} 2.0' in text
+
+
+def test_accounting_caps_label_cardinality():
+    from vllm_production_stack_tpu.qos import TenantAccounting
+
+    acc = TenantAccounting()
+    for i in range(TenantAccounting.MAX_TENANTS + 50):
+        acc.inc(f"t{i}", "requests")
+    counters, _ = acc.snapshot()
+    assert len(counters) <= TenantAccounting.MAX_TENANTS + 1
+    assert counters["_overflow"]["requests"] == 50
+
+
+# -- router integration: auth, stamping, throttling, hot reload --------------
+
+
+@contextlib.asynccontextmanager
+async def qos_rig(tmp_path, table_text=TABLE_YAML, router_args=(),
+                  engine_kw=None):
+    """One FakeEngine + the real router app with a tenant table file."""
+    table_file = tmp_path / "tenants.yaml"
+    table_file.write_text(table_text)
+    eng = FakeEngine(model="fake-model", **(engine_kw or {}))
+    srv = TestServer(eng.build_app())
+    await srv.start_server()
+    try:
+        argv = [
+            "--static-backends", f"http://127.0.0.1:{srv.port}",
+            "--static-models", "fake-model",
+            "--tenant-table-file", str(table_file),
+            *router_args,
+        ]
+        app = build_app(parse_args(argv))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            yield client, eng, app["state"], table_file
+        finally:
+            await client.close()
+    finally:
+        await srv.close()
+
+
+def body(**kw):
+    return {"model": "fake-model", "prompt": [1, 2, 3, 4], "max_tokens": 4,
+            **kw}
+
+
+def test_router_resolves_tenant_and_stamps_upstream(tmp_path):
+    async def go():
+        async with qos_rig(tmp_path) as (client, eng, state, _):
+            r = await client.post(
+                "/v1/completions", json=body(),
+                headers={"Authorization": "Bearer sk-acme-1",
+                         # spoof attempt: must be stripped and re-stamped
+                         "x-tenant-id": "bulk", "x-priority": "batch",
+                         "x-tenant-weight": "999"},
+            )
+            assert r.status == 200, await r.text()
+            seen = eng.seen_request_log[-1]["headers"]
+            assert seen["x-tenant-id"] == "acme"
+            assert seen["x-priority"] == "realtime"
+            assert float(seen["x-tenant-weight"]) == 3.0
+            # unknown bearer key: refused (table has keys, no global key)
+            r = await client.post(
+                "/v1/completions", json=body(),
+                headers={"Authorization": "Bearer sk-wrong"},
+            )
+            assert r.status == 401
+            # keyless request: serves as the default tenant
+            r = await client.post("/v1/completions", json=body())
+            assert r.status == 200
+            assert eng.seen_request_log[-1]["headers"]["x-tenant-id"] == "default"
+
+    run(go())
+
+
+def test_router_global_key_coexists_with_tenant_keys(tmp_path):
+    async def go():
+        async with qos_rig(
+            tmp_path, router_args=("--api-key", "sk-global")
+        ) as (client, eng, state, _):
+            for key, expect_tenant in (
+                ("sk-acme-1", "acme"), ("sk-global", "default"),
+            ):
+                r = await client.post(
+                    "/v1/completions", json=body(),
+                    headers={"Authorization": f"Bearer {key}"},
+                )
+                assert r.status == 200, (key, await r.text())
+                seen = eng.seen_request_log[-1]["headers"]
+                assert seen["x-tenant-id"] == expect_tenant
+            r = await client.post(
+                "/v1/completions", json=body(),
+                headers={"Authorization": "Bearer sk-wrong"},
+            )
+            assert r.status == 401
+            r = await client.post("/v1/completions", json=body())
+            assert r.status == 401  # global key required when configured
+            # a keyless row claimed via x-tenant-id selects identity but
+            # must NOT bypass the configured global key
+            r = await client.post(
+                "/v1/completions", json=body(),
+                headers={"x-tenant-id": "open.row"},
+            )
+            assert r.status == 401
+            # ...with the key it authenticates AND selects the tenant
+            r = await client.post(
+                "/v1/completions", json=body(),
+                headers={"Authorization": "Bearer sk-global",
+                         "x-tenant-id": "open.row"},
+            )
+            assert r.status == 200
+            seen = eng.seen_request_log[-1]["headers"]
+            assert seen["x-tenant-id"] == "open.row"
+            # non-ASCII token: clean 401, not a TypeError 500 from
+            # hmac.compare_digest
+            r = await client.post(
+                "/v1/completions", json=body(),
+                headers={"Authorization": "Bearer café"},
+            )
+            assert r.status == 401
+
+    run(go())
+
+
+THROTTLE_TABLE = """
+slow:
+  api_key: sk-slow
+  requests_per_s: 1
+capped:
+  api_key: sk-capped
+  max_concurrent: 1
+"""
+
+
+def test_per_tenant_429_with_retry_after(tmp_path):
+    async def go():
+        async with qos_rig(tmp_path, table_text=THROTTLE_TABLE) as (
+            client, eng, state, _
+        ):
+            hdr = {"Authorization": "Bearer sk-slow"}
+            r1 = await client.post("/v1/completions", json=body(), headers=hdr)
+            assert r1.status == 200
+            r2 = await client.post("/v1/completions", json=body(), headers=hdr)
+            assert r2.status == 429
+            payload = await r2.json()
+            # the per-tenant refusal is distinguishable from the engines'
+            # global shed path (type "overloaded", no X-Tenant-Id)
+            assert payload["error"]["type"] == "tenant_throttled"
+            assert r2.headers["X-Tenant-Id"] == "slow"
+            retry = int(r2.headers["Retry-After"])
+            assert 1 <= retry <= 60
+            # the engine never saw the throttled request
+            assert eng.total_requests == 1
+            # another tenant is unaffected
+            r3 = await client.post(
+                "/v1/completions", json=body(),
+                headers={"Authorization": "Bearer sk-capped"},
+            )
+            assert r3.status == 200
+
+    run(go())
+
+
+def test_concurrency_cap_releases_after_completion(tmp_path):
+    async def go():
+        async with qos_rig(
+            tmp_path, table_text=THROTTLE_TABLE,
+            engine_kw={"tokens_per_sec": 40.0},
+        ) as (client, eng, state, _):
+            hdr = {"Authorization": "Bearer sk-capped"}
+            slow = asyncio.ensure_future(
+                client.post(
+                    "/v1/completions", json=body(max_tokens=32, stream=True),
+                    headers=hdr,
+                )
+            )
+            await asyncio.sleep(0.15)  # stream is mid-flight (slot held)
+            r = await client.post("/v1/completions", json=body(), headers=hdr)
+            assert r.status == 429
+            assert (await r.json())["error"]["param"] == "max_concurrent"
+            resp = await slow
+            await resp.text()
+            r = await client.post("/v1/completions", json=body(), headers=hdr)
+            assert r.status == 200  # slot released at stream end
+
+    run(go())
+
+
+def test_tenant_table_hot_reload_mid_traffic(tmp_path):
+    """Satellite: add/remove a tenant and change a weight mid-traffic via
+    the dynamic-config watcher; a malformed table keeps the previous one
+    serving."""
+
+    async def go():
+        async with qos_rig(tmp_path) as (client, eng, state, table_file):
+            watcher = DynamicConfigWatcher(
+                None, state, tenant_table_path=str(table_file)
+            )
+            assert await watcher.check_once()  # initial pick-up
+            assert not await watcher.check_once()  # unchanged = no reload
+            gate = state.qos
+
+            # add a tenant + change a weight
+            table_file.write_text(
+                TABLE_YAML + "  newco:\n    api_key: sk-new\n    weight: 7\n"
+            )
+            assert await watcher.check_once()
+            assert state.qos is gate  # gate survives, table swapped
+            assert gate.table.get("newco").weight == 7.0
+            r = await client.post(
+                "/v1/completions", json=body(),
+                headers={"Authorization": "Bearer sk-new"},
+            )
+            assert r.status == 200
+            assert eng.seen_request_log[-1]["headers"]["x-tenant-id"] == "newco"
+
+            # malformed edit: reload raises, previous table keeps serving
+            table_file.write_text("acme:\n  priority: nonsense\n")
+            with pytest.raises(ValueError):
+                await watcher.check_once()
+            assert gate.table.get("newco") is not None
+            r = await client.post(
+                "/v1/completions", json=body(),
+                headers={"Authorization": "Bearer sk-new"},
+            )
+            assert r.status == 200
+
+            # remove the tenant: its key stops resolving (and with no
+            # global key, an unknown presented key is refused)
+            table_file.write_text(TABLE_YAML)
+            assert await watcher.check_once()
+            assert gate.table.get("newco") is None
+            r = await client.post(
+                "/v1/completions", json=body(),
+                headers={"Authorization": "Bearer sk-new"},
+            )
+            assert r.status == 401
+
+    run(go())
+
+
+def test_dynamic_config_inline_tenants_validated_first(tmp_path):
+    """A `tenants` mapping inside the main dynamic config applies through
+    apply_dynamic_config — and a malformed one rejects the WHOLE reload
+    before any other key mutates state."""
+
+    async def go():
+        state = RouterState(parse_args([
+            "--static-backends", "http://e1:8000",
+            "--static-models", "fake-model",
+        ]))
+        assert state.qos is None
+        await state.apply_dynamic_config(
+            {"tenants": {"acme": {"api_key": "k1", "weight": 2}}}
+        )
+        assert state.qos is not None  # gate adopted at runtime
+        assert state.qos.table.get("acme").weight == 2.0
+        aliases_before = dict(state.model_aliases)
+        with pytest.raises(ValueError):
+            await state.apply_dynamic_config({
+                "model_aliases": {"x": "fake-model"},
+                "tenants": {"acme": {"weight": -1}},
+            })
+        # the alias half of the bad reload did NOT apply
+        assert state.model_aliases == aliases_before
+        assert state.qos.table.get("acme").weight == 2.0
+
+    run(go())
+
+
+def test_qos_disabled_router_is_transparent(tmp_path):
+    """No table configured: no gate, no stamping, inbound tenant headers
+    pass through untouched (an upstream gateway may stamp through us)."""
+
+    async def go():
+        eng = FakeEngine(model="fake-model")
+        srv = TestServer(eng.build_app())
+        await srv.start_server()
+        try:
+            app = build_app(parse_args([
+                "--static-backends", f"http://127.0.0.1:{srv.port}",
+                "--static-models", "fake-model",
+            ]))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                assert app["state"].qos is None
+                r = await client.post(
+                    "/v1/completions", json=body(),
+                    headers={"x-tenant-id": "gw-stamped",
+                             "x-priority": "batch"},
+                )
+                assert r.status == 200
+                seen = eng.seen_request_log[-1]["headers"]
+                assert seen["x-tenant-id"] == "gw-stamped"
+                assert seen["x-priority"] == "batch"
+            finally:
+                await client.close()
+        finally:
+            await srv.close()
+
+    run(go())
+
+
+def test_engine_shed_and_throttle_shapes_differ():
+    """The two 429 paths must stay distinguishable: the engine's global
+    shed (type overloaded, Retry-After from decode throughput) vs the
+    router's per-tenant throttle (type tenant_throttled, Retry-After from
+    the tenant's own bucket, X-Tenant-Id header)."""
+    from vllm_production_stack_tpu.engine.engine import EngineOverloadedError
+    from vllm_production_stack_tpu.engine.server import EngineServer
+
+    resp = EngineServer._admission_error(
+        EngineOverloadedError("engine overloaded", 7.0)
+    )
+    assert resp.status == 429
+    assert resp.headers["Retry-After"] == "7"
+    assert "X-Tenant-Id" not in resp.headers
+    assert b"overloaded" in resp.body

@@ -190,7 +190,7 @@ class AsyncEngine:
             with self._pending_lock:
                 if not self._pending:
                     return
-                rid, token_ids, sampling, lora_name, deadline = (
+                rid, token_ids, sampling, lora_name, deadline, tenant = (
                     self._pending.popleft()
                 )
                 # popped but not yet in the scheduler: wait_idle must not
@@ -198,12 +198,16 @@ class AsyncEngine:
                 # empty) while the request is mid-admission
                 self._admitting += 1
             try:
-                self._admit_one(rid, token_ids, sampling, lora_name, deadline)
+                self._admit_one(
+                    rid, token_ids, sampling, lora_name, deadline, tenant
+                )
             finally:
                 with self._pending_lock:
                     self._admitting -= 1
 
-    def _admit_one(self, rid, token_ids, sampling, lora_name, deadline):
+    def _admit_one(
+        self, rid, token_ids, sampling, lora_name, deadline, tenant=None
+    ):
         """Move one popped submission into the engine (step thread, engine
         lock held). A failure fails that request's stream, never the loop."""
         if rid not in self._queues:
@@ -224,6 +228,7 @@ class AsyncEngine:
                 sampling=sampling,
                 lora_name=lora_name,
                 deadline=deadline,
+                tenant=tenant,
             )
         except Exception as e:
             logger.warning("deferred admission failed for %s: %s", rid, e)
@@ -302,7 +307,7 @@ class AsyncEngine:
 
     def precheck_admission(
         self, deadline: float | None = None, n_new_tokens: int = 0,
-        record: bool = True,
+        record: bool = True, tenant=None,
     ) -> None:
         """Lock-free admission gate for HTTP handlers, run BEFORE a stream's
         SSE headers go out so overload/drain/deadline refusals keep their
@@ -318,12 +323,13 @@ class AsyncEngine:
         self.engine.check_admission(
             n_new_tokens, deadline,
             extra_waiting=extra_waiting, extra_tokens=extra_tokens,
-            record=record,
+            record=record, tenant=tenant,
         )
 
     def _submit(
         self, request_id, prompt, prompt_token_ids, sampling, q,
         lora_name=None, deadline=None, admission_exclude_prefix=None,
+        tenant=None,
     ) -> str:
         """Runs in an executor. Deliberately LOCK-FREE: tokenization +
         validation need no engine state mutation, and admission is deferred
@@ -358,6 +364,9 @@ class AsyncEngine:
             len(prompt_token_ids), deadline,
             extra_waiting=extra_waiting, extra_tokens=extra_tokens,
             exclude_prefix=admission_exclude_prefix,
+            # submit time is where a higher-priority arrival actually
+            # claims its lowest-priority eviction victim (QoS)
+            tenant=tenant, evict=True,
         )
         with self._pending_lock:
             # re-check under the SAME lock wait_idle samples _pending with:
@@ -380,7 +389,7 @@ class AsyncEngine:
             rid = request_id or f"req-a{next(self._rid_counter)}"
             self._queues[rid] = q
             self._pending.append((rid, list(prompt_token_ids), sampling,
-                                  lora_name, deadline))
+                                  lora_name, deadline, tenant))
         self.loop_timing["submits"] += 1
         self.loop_timing["submit_s"] += time.perf_counter() - t0
         self._wake.set()
@@ -395,18 +404,21 @@ class AsyncEngine:
         lora_name: str | None = None,
         deadline: float | None = None,
         admission_exclude_prefix: str | None = None,
+        tenant=None,
     ) -> AsyncIterator[RequestOutput]:
         """Submit a request and yield its incremental outputs.
         admission_exclude_prefix (the parent request id of an n>1 fan-out)
         keeps sibling choices out of this submission's admission count —
-        choices gate against OTHER requests, never against their own."""
+        choices gate against OTHER requests, never against their own.
+        tenant (qos.TenantContext, from the router-stamped headers) drives
+        fair-share admission and priority-aware shedding."""
         if self._step_error is not None:
             raise RuntimeError(f"engine is dead: {self._step_error}")
         q: asyncio.Queue[RequestOutput] = asyncio.Queue()
         loop = asyncio.get_running_loop()
         rid = await loop.run_in_executor(
             None, self._submit, request_id, prompt, prompt_token_ids, sampling,
-            q, lora_name, deadline, admission_exclude_prefix,
+            q, lora_name, deadline, admission_exclude_prefix, tenant,
         )
         finished = False
         try:

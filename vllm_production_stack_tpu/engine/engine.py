@@ -11,8 +11,9 @@ from __future__ import annotations
 import itertools
 import logging
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
+from ..qos import TenantContext
 from ..utils.tokenizer import IncrementalDetokenizer, TokenizerWrapper
 from .config import EngineConfig
 from .model_runner import ModelRunner, StepHandle
@@ -67,6 +68,11 @@ class EngineStatsSnapshot:
     requests_shed: int = 0
     requests_deadline_expired: int = 0
     draining: bool = False
+    # multi-tenant QoS (docs/27-multitenancy.md): cumulative per-tenant
+    # counters {tenant: {requests, generation_tokens, shed, ...}} plus the
+    # queue-wait observations drained for the exporter's histogram
+    tenants: dict = field(default_factory=dict)
+    tenant_queue_waits: list = field(default_factory=list)
 
 
 @dataclass
@@ -264,6 +270,7 @@ class LLMEngine:
         sampling: SamplingParams | None = None,
         lora_name: str | None = None,
         deadline: float | None = None,
+        tenant: TenantContext | None = None,
     ) -> str:
         request_id = request_id or f"req-{next(self._req_counter)}"
         if prompt_token_ids is None:
@@ -274,6 +281,7 @@ class LLMEngine:
             # races with a concurrent unload land here too — a clear 4xx-able
             # error, not a KeyError 500
             raise ValueError(f"LoRA adapter {lora_name!r} is not loaded")
+        tenant = tenant or TenantContext()
         req = Request(
             request_id=request_id,
             prompt_token_ids=list(prompt_token_ids),
@@ -282,6 +290,9 @@ class LLMEngine:
             lora_index=self._lora_slots[lora_name] if lora_name else 0,
             lora_cache_salt=self._lora_salts[lora_name] if lora_name else 0,
             deadline=deadline,
+            tenant_id=tenant.tenant_id,
+            priority=tenant.priority,
+            weight=tenant.weight,
         )
         self.scheduler.add_request(req)
         self._states[request_id] = _RequestState(
@@ -746,6 +757,8 @@ class LLMEngine:
         extra_tokens: int = 0,
         record: bool = True,
         exclude_prefix: str | None = None,
+        tenant: TenantContext | None = None,
+        evict: bool = False,
     ) -> None:
         """Load-shedding + deadline gate, run lock-free at submit time
         (extra_* carries the async server's not-yet-admitted pending queue).
@@ -762,21 +775,40 @@ class LLMEngine:
         n_waiting, queued_tokens = self.queue_depth(exclude_prefix)
         n_waiting += extra_waiting
         queued_tokens += extra_tokens
-        if cfg.max_waiting_requests > 0 and n_waiting >= cfg.max_waiting_requests:
+
+        def _shed(msg: str):
             if record:
                 self.shed_requests += 1
+                if tenant is not None:
+                    self.scheduler.accounting.inc(tenant.tenant_id, "shed")
             raise EngineOverloadedError(
-                f"engine overloaded: {n_waiting} requests waiting "
-                f"(max_waiting_requests={cfg.max_waiting_requests})",
-                self.estimate_retry_after_s(queued_tokens),
+                msg, self.estimate_retry_after_s(queued_tokens)
             )
+
+        # multi-tenant QoS: shedding is lowest-priority-first. A
+        # higher-priority arrival at a full queue evicts the newest
+        # strictly-lower-priority WAITING request (applied by the step
+        # thread) instead of being refused itself. The victim is only
+        # CLAIMED (mark_shed_victim) after every other refusal below has
+        # passed — a token-watermark or deadline refusal of this arrival
+        # must not also cost an already-queued request its slot — and only
+        # by the submit-time check (evict=True); the pre-SSE check and
+        # probe polls just peek, so one request can't evict twice.
+        needs_eviction = False
+        if cfg.max_waiting_requests > 0 and n_waiting >= cfg.max_waiting_requests:
+            if tenant is not None and self.scheduler.has_shed_victim(
+                tenant.priority
+            ):
+                needs_eviction = True
+            else:
+                _shed(
+                    f"engine overloaded: {n_waiting} requests waiting "
+                    f"(max_waiting_requests={cfg.max_waiting_requests})"
+                )
         if cfg.max_queued_tokens > 0 and queued_tokens >= cfg.max_queued_tokens:
-            if record:
-                self.shed_requests += 1
-            raise EngineOverloadedError(
+            _shed(
                 f"engine overloaded: {queued_tokens} prompt tokens queued "
-                f"(max_queued_tokens={cfg.max_queued_tokens})",
-                self.estimate_retry_after_s(queued_tokens),
+                f"(max_queued_tokens={cfg.max_queued_tokens})"
             )
         if deadline is not None:
             import time as _time
@@ -798,6 +830,16 @@ class LLMEngine:
                         f"request would queue ~{est_wait:.1f}s past its "
                         "deadline; shed at admission"
                     )
+        if needs_eviction and evict and record:
+            # every other refusal passed: claim the lower-priority victim
+            # now. The peek above and this mark race the step thread, so
+            # the victim may have left the queue — then this arrival sheds
+            # after all.
+            if not self.scheduler.mark_shed_victim(tenant.priority):
+                _shed(
+                    f"engine overloaded: {n_waiting} requests waiting "
+                    f"(max_waiting_requests={cfg.max_waiting_requests})"
+                )
 
     def has_unfinished(self) -> bool:
         return self.scheduler.has_unfinished()
@@ -904,6 +946,23 @@ class LLMEngine:
                 nxt.handle.discard()
                 self.timing["rollback_n"] += 1
                 nxt = None
+        if work is None and inflight is not None and nxt is None:
+            # a priority stall: the scheduler declined to chain because a
+            # higher-priority waiter was blocked by in-flight victims. The
+            # victims are resolved now — re-schedule IN THIS CALL so the
+            # preempt-and-admit happens a full loop round-trip sooner
+            # (the realtime arrival's TTFT is the point of the stall).
+            t4 = time.perf_counter()
+            work2 = self.scheduler.schedule()
+            self.timing["sched_s"] += time.perf_counter() - t4
+            for req in self.scheduler.take_finished_externally():
+                outputs.append(
+                    self._make_output(
+                        req, [], "", self._finish_reason(req) or "abort"
+                    )
+                )
+            if work2 is not None:
+                self._execute_sync(work2, outputs, time.perf_counter())
         if pre_handle is not None:
             t2 = time.perf_counter()
             rows = pre_handle.resolve()
@@ -1085,6 +1144,7 @@ class LLMEngine:
             RequestStatus.FINISHED_LENGTH: "length",
             RequestStatus.FINISHED_ABORTED: "abort",
             RequestStatus.FINISHED_DEADLINE: "deadline",
+            RequestStatus.FINISHED_SHED: "shed",
         }.get(req.status)
 
     @staticmethod
@@ -1155,6 +1215,7 @@ class LLMEngine:
 
     def stats(self) -> EngineStatsSnapshot:
         pool = self.scheduler.pool
+        tenants, waits = self.scheduler.accounting.snapshot(drain_waits=True)
         return EngineStatsSnapshot(
             num_requests_running=self.scheduler.num_running,
             num_requests_waiting=self.scheduler.num_waiting,
@@ -1163,7 +1224,11 @@ class LLMEngine:
             prefix_cache_hits=pool.stats.hits,
             prefix_cache_queries=pool.stats.queries,
             num_preemptions=self.scheduler.total_preemptions,
-            requests_shed=self.shed_requests,
+            # queue evictions ARE load shedding (the victim got a 429-
+            # shaped refusal, just after queueing instead of at the door)
+            requests_shed=self.shed_requests + self.scheduler.shed_evictions,
+            tenants=tenants,
+            tenant_queue_waits=waits,
             requests_deadline_expired=(
                 self.deadline_admission_rejects
                 + self.scheduler.deadline_expired_total

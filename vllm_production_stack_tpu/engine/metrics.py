@@ -8,7 +8,13 @@ vLLM's `vllm:` metrics)."""
 
 from __future__ import annotations
 
-from prometheus_client import CollectorRegistry, Counter, Gauge, generate_latest
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
 
 from .. import metrics_contract as mc
 from .engine import EngineStatsSnapshot
@@ -82,6 +88,35 @@ class EngineMetrics:
         self.draining = gauge(
             mc.ENGINE_DRAINING, "1 while the engine is draining"
         )
+        # -- multi-tenant QoS (docs/27-multitenancy.md): tenant-labeled
+        # series; cardinality bounded by qos.TenantAccounting.MAX_TENANTS
+        tlabels = [*names, "tenant"]
+
+        def tcounter(name: str, doc: str) -> Counter:
+            base = name[: -len("_total")] if name.endswith("_total") else name
+            return Counter(base, doc, tlabels, registry=self.registry)
+
+        self.tenant_requests = tcounter(
+            mc.TENANT_REQUESTS, "Requests admitted per tenant"
+        )
+        self.tenant_tokens = tcounter(
+            mc.TENANT_GENERATION_TOKENS, "Tokens generated per tenant"
+        )
+        self.tenant_shed = tcounter(
+            mc.TENANT_SHED,
+            "Requests shed per tenant (admission refusals + lowest-"
+            "priority-first queue evictions)",
+        )
+        self.tenant_queue_wait = Histogram(
+            mc.TENANT_QUEUE_WAIT,
+            "Seconds from submission to first scheduler seat, per tenant",
+            tlabels,
+            buckets=(
+                0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                30.0, 60.0,
+            ),
+            registry=self.registry,
+        )
         self._counter_values: dict[str, int] = {}
 
     def update(self, s: EngineStatsSnapshot) -> None:
@@ -110,11 +145,36 @@ class EngineMetrics:
             self.deadline_expired, "deadline", s.requests_deadline_expired
         )
         self.draining.labels(**lb).set(1 if s.draining else 0)
+        for tenant, c in s.tenants.items():
+            tl = {**lb, "tenant": tenant}
+            self._bump_labeled(
+                self.tenant_requests, f"t_req:{tenant}",
+                int(c.get("requests", 0)), tl,
+            )
+            self._bump_labeled(
+                self.tenant_tokens, f"t_tok:{tenant}",
+                int(c.get("generation_tokens", 0)), tl,
+            )
+            self._bump_labeled(
+                self.tenant_shed, f"t_shed:{tenant}",
+                int(c.get("shed", 0)), tl,
+            )
+        for tenant, seconds in s.tenant_queue_waits:
+            # observations were DRAINED from the accounting by stats() —
+            # each lands in the histogram exactly once
+            self.tenant_queue_wait.labels(**lb, tenant=tenant).observe(
+                seconds
+            )
 
     def _bump(self, counter: Counter, key: str, total: int) -> None:
+        self._bump_labeled(counter, key, total, self._labels)
+
+    def _bump_labeled(
+        self, counter: Counter, key: str, total: int, labels: dict
+    ) -> None:
         prev = self._counter_values.get(key, 0)
         if total > prev:
-            counter.labels(**self._labels).inc(total - prev)
+            counter.labels(**labels).inc(total - prev)
             self._counter_values[key] = total
 
     def render(self, s: EngineStatsSnapshot) -> bytes:

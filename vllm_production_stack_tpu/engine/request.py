@@ -46,6 +46,11 @@ class RequestStatus(enum.Enum):
     # deadline expired while queued or decoding — aborted with a clean
     # "deadline" finish reason instead of burning further TPU steps
     FINISHED_DEADLINE = "finished_deadline"
+    # evicted from the waiting queue by a higher-priority admission when
+    # the queue bound was full (multi-tenant QoS: lowest-priority-first
+    # shedding, docs/27-multitenancy.md) — the HTTP layer maps this back
+    # to a 429 + Retry-After
+    FINISHED_SHED = "finished_shed"
 
     @property
     def finished(self) -> bool:
@@ -54,6 +59,7 @@ class RequestStatus(enum.Enum):
             RequestStatus.FINISHED_LENGTH,
             RequestStatus.FINISHED_ABORTED,
             RequestStatus.FINISHED_DEADLINE,
+            RequestStatus.FINISHED_SHED,
         )
 
 
@@ -95,6 +101,14 @@ class Request:
     # None = no deadline. The scheduler sweeps expired requests out of
     # waiting/running at the top of every schedule() call.
     deadline: float | None = None
+    # multi-tenant QoS (docs/27-multitenancy.md), from the router-stamped
+    # x-tenant-id / x-priority / x-tenant-weight headers. priority is the
+    # RANK (0 realtime, 1 standard, 2 batch): lower wins admission,
+    # higher is preempted/shed first. Unstamped traffic carries the
+    # defaults and collapses to the pre-QoS FIFO behavior.
+    tenant_id: str = "default"
+    priority: int = 1
+    weight: float = 1.0
 
     @property
     def num_prompt_tokens(self) -> int:

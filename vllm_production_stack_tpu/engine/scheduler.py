@@ -11,9 +11,11 @@ can't starve admissions.
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from dataclasses import dataclass, field
 
+from ..qos import FairShareClock, TenantAccounting
 from .config import CacheConfig, ModelConfig, SchedulerConfig
 from .kv_cache import KVBlockPool, chain_hash
 from .request import Request, RequestStatus
@@ -108,6 +110,7 @@ class Scheduler:
         scheduler_config: SchedulerConfig,
         host_tier=None,
         need_slot_mappings: bool = False,
+        accounting: TenantAccounting | None = None,
     ):
         self.model_config = model_config
         self.cache_config = cache_config
@@ -140,6 +143,21 @@ class Scheduler:
         # requests finished outside a step (e.g. resumed request that outgrew
         # the pool) — the engine drains these to emit terminal outputs
         self._finished_externally: list[Request] = []
+        # -- multi-tenant QoS (docs/27-multitenancy.md) --------------------
+        # per-tenant weighted fair share + accounting. _qos_active latches
+        # True on the first request carrying non-default tenant stamps;
+        # until then every pick/victim path short-circuits to the pre-QoS
+        # FIFO behavior, so an unconfigured stack pays nothing.
+        self.accounting = accounting or TenantAccounting()
+        self._fair = FairShareClock()
+        self._qos_active = False
+        # shed evictions: the admission gate (HTTP threads, lock-free) marks
+        # a lowest-priority WAITING request for eviction when a higher-
+        # priority request would otherwise be refused at a full queue; the
+        # step thread applies the marks at the top of schedule().
+        self._evict_lock = threading.Lock()
+        self._evict_rids: set[str] = set()
+        self.shed_evictions = 0
 
     # -- admission ---------------------------------------------------------
 
@@ -154,6 +172,8 @@ class Scheduler:
                 f"prompt of {req.num_prompt_tokens} tokens cannot fit the KV "
                 f"pool ({self.pool.num_usable} blocks of {self.block_size})"
             )
+        if req.tenant_id != "default" or req.priority != 1 or req.weight != 1.0:
+            self._qos_active = True
         req.status = RequestStatus.WAITING
         self.waiting.append(req)
 
@@ -214,6 +234,121 @@ class Scheduler:
             self.deadline_expired_total += 1
         return len(expired)
 
+    # -- multi-tenant QoS: shed eviction + fair-share pick ------------------
+
+    def mark_shed_victim(self, than_rank: int) -> bool:
+        """Called LOCK-FREE from the admission gate (HTTP threads) when the
+        waiting queue is full: pick the newest waiting request whose
+        priority rank is strictly worse than `than_rank` and mark it for
+        eviction, making room for the higher-priority arrival. Returns
+        False (the arrival is shed instead) when no such victim exists.
+        The actual eviction happens on the step thread (apply_evictions) —
+        this only snapshots the deque, same retry discipline as
+        queue_depth()."""
+        if not self._qos_active:
+            return False
+        snap: list[Request] | None = None
+        for _ in range(5):
+            try:
+                snap = list(self.waiting)
+                break
+            except RuntimeError:  # deque mutated during iteration
+                continue
+        if snap is None:
+            return False
+        with self._evict_lock:
+            for r in reversed(snap):
+                if (
+                    r.priority > than_rank
+                    and r.request_id not in self._evict_rids
+                ):
+                    self._evict_rids.add(r.request_id)
+                    return True
+        return False
+
+    def has_shed_victim(self, than_rank: int) -> bool:
+        """Peek-only twin of mark_shed_victim for the pre-SSE admission
+        check and readiness probes: would a rank-`than_rank` arrival find
+        an evictable lower-priority waiting request? Marks nothing — only
+        the submit-time check actually claims a victim, so the two-phase
+        admission (precheck, then recheck at submit) can't evict twice for
+        one request."""
+        if not self._qos_active:
+            return False
+        for _ in range(5):
+            try:
+                snap = list(self.waiting)
+            except RuntimeError:
+                continue
+            with self._evict_lock:
+                return any(
+                    r.priority > than_rank
+                    and r.request_id not in self._evict_rids
+                    for r in snap
+                )
+        return False
+
+    def apply_evictions(self) -> int:
+        """Step-thread half of mark_shed_victim: finish marked requests
+        still in the waiting queue with FINISHED_SHED (terminal output via
+        take_finished_externally; the HTTP layer maps it to 429). A mark
+        that raced its request into running is dropped — the bound is a
+        watermark, not an invariant."""
+        with self._evict_lock:
+            if not self._evict_rids:
+                return 0
+            rids, self._evict_rids = self._evict_rids, set()
+        evicted = [r for r in self.waiting if r.request_id in rids]
+        if not evicted:
+            return 0
+        kept = [r for r in self.waiting if r.request_id not in rids]
+        self.waiting.clear()
+        self.waiting.extend(kept)
+        for req in evicted:
+            self._finish(req, RequestStatus.FINISHED_SHED)
+            self._finished_externally.append(req)
+            self.shed_evictions += 1
+            self.accounting.inc(req.tenant_id, "shed")
+        return len(evicted)
+
+    def _pick_waiting(self) -> Request | None:
+        """Admission pick: FIFO until any request carries tenant stamps;
+        then strict priority tiers (realtime < standard < batch) broken by
+        the weighted fair-share virtual clock, FIFO within a tenant. The
+        scan keeps the first (oldest) waiting request per tenant, so the
+        pick is deterministic for a given queue state — a requirement for
+        serial/async-pipeline stream equivalence."""
+        if not self.waiting:
+            return None
+        if not self._qos_active:
+            return self.waiting[0]
+        best: Request | None = None
+        best_key: tuple[int, float] | None = None
+        seen: set[str] = set()
+        for r in self.waiting:
+            if r.tenant_id in seen:
+                continue
+            seen.add(r.tenant_id)
+            key = (r.priority, self._fair.key(r.tenant_id))
+            if best_key is None or key < best_key:
+                best, best_key = r, key
+        return best
+
+    def _seat_victim(self, rank: int) -> Request | None:
+        """Running request a rank-`rank` admission may preempt for its SEAT
+        (max_num_seqs full): the newest running request of the strictly
+        lowest priority class worse than `rank`, skipping rows with tokens
+        in flight (their device step is still writing KV). None when every
+        seat is held by equal-or-better traffic."""
+        cands = [
+            r
+            for r in reversed(self.running)  # newest first
+            if r.num_inflight_tokens == 0 and r.priority > rank
+        ]
+        if not cands:
+            return None
+        return max(cands, key=lambda r: r.priority)  # first maximal = newest
+
     def schedule(
         self, inflight: DecodeWork | None = None
     ) -> ScheduleOutput | None:
@@ -222,9 +357,40 @@ class Scheduler:
         planned at their speculatively-advanced positions and chain their
         input token from its device-resident output matrix (chain_rows)."""
         self.expire_deadlines()
+        self.apply_evictions()
+        if (
+            self._qos_active
+            and inflight is not None
+            and self.waiting
+            and len(self.running) >= self.config.max_num_seqs
+        ):
+            head = self._pick_waiting()
+            if (
+                head is not None
+                and self._seat_victim(head.priority) is None
+                and any(
+                    r.num_inflight_tokens > 0 and r.priority > head.priority
+                    for r in self.running
+                )
+            ):
+                # a higher-priority arrival is blocked ONLY by in-flight
+                # victims (their device step is still writing KV, so they
+                # cannot be preempted yet — PR 1 invariant). Decline to
+                # chain the next window: the step loop resolves the
+                # in-flight step instead, and the NEXT schedule() finds a
+                # resolvable victim. Bounds realtime priority inversion at
+                # one decode window instead of a whole seat turnover.
+                return None
         decode_ready = [r for r in self.running if r.prefill_done]
         prefilling = [r for r in self.running if not r.prefill_done]
-        can_admit = bool(self.waiting) and len(self.running) < self.config.max_num_seqs
+        can_admit = bool(self.waiting) and (
+            len(self.running) < self.config.max_num_seqs
+            or (
+                self._qos_active
+                and (head := self._pick_waiting()) is not None
+                and self._seat_victim(head.priority) is not None
+            )
+        )
 
         want_prefill = bool(prefilling) or can_admit
         if want_prefill and (not decode_ready or not self._last_was_prefill):
@@ -344,16 +510,55 @@ class Scheduler:
         while (
             budget > 0
             and self.waiting
-            and len(self.running) < self.config.max_num_seqs
             and len(work.requests) < self.config.max_num_seqs
         ):
-            req = self.waiting[0]
+            req = self._pick_waiting()
+            if req is None:
+                break
+            if len(self.running) >= self.config.max_num_seqs:
+                # seats full: a strictly-higher-priority class may claim
+                # one by preempting the newest lowest-priority running
+                # request (QoS only — equal-priority traffic waits, the
+                # pre-QoS behavior)
+                victim = self._seat_victim(req.priority)
+                if victim is None:
+                    break
+                need = self._blocks_needed(req.prefill_target + 1)
+                if need > self.pool.num_usable:
+                    self._can_admit(req)  # aborts the impossible fit
+                    continue
+                if self.pool.num_free + len(victim.block_table) < need:
+                    # the memory watermark would still block the admission
+                    # even with the victim's blocks back — don't pay a
+                    # preemption (full recompute for the victim) for
+                    # nothing
+                    break
+                self._preempt(victim)
             if not self._can_admit(req):
                 if req in self.waiting:
                     break  # watermark: stop admitting until memory frees
                 continue  # impossible-fit request was aborted; try the next
-            self.waiting.popleft()
+            self.waiting.remove(req)
             self._admit(req)
+            if self._qos_active:
+                # fair-share charge: expected device work (prompt left to
+                # compute + output budget) over the tenant's weight
+                self._fair.charge(
+                    req.tenant_id,
+                    req.prefill_target + req.sampling.max_tokens,
+                    req.weight,
+                )
+            if req.num_preemptions == 0:
+                # first admission only: queue wait + per-tenant served
+                # count (a preempted request re-admitting is not a new
+                # request, and its wait was already observed)
+                import time as _time
+
+                self.accounting.inc(req.tenant_id, "requests")
+                self.accounting.observe_wait(
+                    req.tenant_id,
+                    max(0.0, _time.monotonic() - req.arrival_time),
+                )
             req.status = RequestStatus.RUNNING
             self.running.append(req)
             budget -= self._try_add_chunk(work, req, budget)
@@ -570,17 +775,22 @@ class Scheduler:
         while len(req.block_table) < need:
             blk = self.pool.allocate()
             if blk is None:
-                # newest admission loses — but never a request with tokens
-                # in flight (async pipeline): its device step is still
-                # writing KV into its blocks and its unresolved tokens
-                # would be lost, so it cannot be safely recomputed yet
-                victim = next(
-                    (
-                        r
-                        for r in reversed(self.running)
-                        if r.num_inflight_tokens == 0
-                    ),
-                    None,
+                # LOWEST-priority-class-first, newest-within-class loses
+                # (pure-default traffic: every rank ties, so this reduces
+                # to the historical newest-admission rule) — but never a
+                # request with tokens in flight (async pipeline): its
+                # device step is still writing KV into its blocks and its
+                # unresolved tokens would be lost, so it cannot be safely
+                # recomputed yet
+                cands = [
+                    r
+                    for r in reversed(self.running)  # newest first
+                    if r.num_inflight_tokens == 0
+                ]
+                victim = (
+                    max(cands, key=lambda r: r.priority)  # first max = newest
+                    if cands
+                    else None
                 )
                 if victim is None:
                     return False
@@ -767,6 +977,16 @@ class Scheduler:
                     )
                     self._maybe_finish(req)
                 results.append((req, accepted))
+        # per-tenant decode-share observability (tpu:tenant_generation_
+        # tokens_total) — batched to one counter bump per tenant per step
+        tok_counts: dict[str, int] = {}
+        for req, toks in results:
+            if toks:
+                tok_counts[req.tenant_id] = (
+                    tok_counts.get(req.tenant_id, 0) + len(toks)
+                )
+        for t, n in tok_counts.items():
+            self.accounting.inc(t, "generation_tokens", n)
         return results
 
     def _register_full_blocks(self, req: Request, start: int, end: int) -> None:

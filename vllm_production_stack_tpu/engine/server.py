@@ -24,6 +24,7 @@ from pydantic import ValidationError
 
 from .. import __version__
 from ..models.registry import resolve_model_config
+from ..qos import tenant_from_headers
 from ..utils.logging import init_logger
 from .async_engine import AsyncEngine, EngineDrainingError, EngineSleepingError
 from .config import (
@@ -285,18 +286,24 @@ class EngineServer:
             )
         return None
 
-    def _gate_admission(self, request) -> tuple[float | None, web.Response | None]:
-        """(deadline, refusal) for one inference request — run BEFORE any
-        SSE headers go out so 429/503 keep their status codes. The same
-        checks rerun at submit time (this is the fast path, not the only
-        line of defense)."""
+    def _gate_admission(self, request):
+        """(deadline, tenant, refusal) for one inference request — run
+        BEFORE any SSE headers go out so 429/503 keep their status codes.
+        The same checks rerun at submit time (this is the fast path, not
+        the only line of defense). The tenant context comes from the
+        router-stamped x-tenant-id / x-priority / x-tenant-weight headers
+        (qos.tenant_from_headers); unstamped traffic is the default
+        tenant, and a higher-priority class can pass a full queue by
+        evicting lower-priority waiting work (lowest-priority-first
+        shedding, claimed at submit time)."""
         deadline = deadline_from_headers(request.headers)
+        tenant = tenant_from_headers(request.headers)
         try:
-            self.async_engine.precheck_admission(deadline)
+            self.async_engine.precheck_admission(deadline, tenant=tenant)
         except (EngineOverloadedError, DeadlineExceededError,
                 EngineDrainingError) as e:
-            return deadline, self._admission_error(e)
-        return deadline, None
+            return deadline, tenant, self._admission_error(e)
+        return deadline, tenant, None
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -323,18 +330,19 @@ class EngineServer:
         if (err := self._check_logprobs(sampling)) is not None:
             return err
         rid = request.headers.get("X-Request-Id") or random_id("chatcmpl")
-        deadline, refused = self._gate_admission(request)
+        deadline, tenant, refused = self._gate_admission(request)
         if refused is not None:
             return refused
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=True,
                 lora_name=lora_name, parse_tools=use_tools, n=body.n,
-                deadline=deadline,
+                deadline=deadline, tenant=tenant,
             )
         return await self._complete(
             rid, prompt, sampling, chat=True, lora_name=lora_name,
             parse_tools=use_tools, n=body.n, deadline=deadline,
+            tenant=tenant,
         )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
@@ -370,19 +378,19 @@ class EngineServer:
                 )
             )
         rid = request.headers.get("X-Request-Id") or random_id("cmpl")
-        deadline, refused = self._gate_admission(request)
+        deadline, tenant, refused = self._gate_admission(request)
         if refused is not None:
             return refused
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=False,
                 prompt_ids=prompt_ids, lora_name=lora_name, n=body.n,
-                echo_text=echo_text, deadline=deadline,
+                echo_text=echo_text, deadline=deadline, tenant=tenant,
             )
         return await self._complete(
             rid, prompt, sampling, chat=False, prompt_ids=prompt_ids,
             lora_name=lora_name, n=body.n, echo_text=echo_text,
-            deadline=deadline,
+            deadline=deadline, tenant=tenant,
         )
 
     async def embeddings(self, request: web.Request) -> web.Response:
@@ -677,7 +685,7 @@ class EngineServer:
         return dataclasses.replace(sampling, seed=sampling.seed + i)
 
     async def _run_single(self, rid, prompt, sampling, prompt_ids, lora_name,
-                          deadline=None, parent_rid=None):
+                          deadline=None, parent_rid=None, tenant=None):
         """One full generation; returns the accumulated result dict.
         parent_rid (the HTTP request's base id) exempts sibling choices of
         the same n>1 request from this submission's admission count — a
@@ -691,6 +699,7 @@ class EngineServer:
             prompt=prompt, prompt_token_ids=prompt_ids,
             sampling=sampling, request_id=rid, lora_name=lora_name,
             deadline=deadline, admission_exclude_prefix=parent_rid,
+            tenant=tenant,
         ):
             text += out.text_delta
             token_ids.extend(out.new_token_ids)
@@ -707,6 +716,7 @@ class EngineServer:
         self, rid, prompt, sampling, *, chat: bool, prompt_ids=None,
         lora_name=None, parse_tools: bool = False, n: int = 1,
         echo_text: str | None = None, deadline: float | None = None,
+        tenant=None,
     ) -> web.Response:
         # n>1: concurrent submissions — continuous batching runs them in
         # one batch and the prefix cache dedups the shared prompt, so the
@@ -721,7 +731,7 @@ class EngineServer:
             asyncio.ensure_future(self._run_single(
                 crid, prompt,
                 self._nth_sampling(sampling, i), prompt_ids, lora_name,
-                deadline, parent_rid=rid,
+                deadline, parent_rid=rid, tenant=tenant,
             ))
             for i, crid in enumerate(self._choice_rids(rid, n))
         ]
@@ -742,6 +752,21 @@ class EngineServer:
         for r in runs:
             if r["finish_reason"] == "error":
                 return error(500, r["text"], "internal_error")
+            if r["finish_reason"] == "shed" and not r["token_ids"]:
+                # evicted from the waiting queue by a higher-priority
+                # admission before producing anything: same HTTP shape as
+                # admission-time shedding (429 + Retry-After), so clients
+                # handle both the same way
+                import math
+
+                waiting, queued = self.engine.queue_depth()
+                retry = self.engine.estimate_retry_after_s(queued)
+                return error(
+                    429,
+                    "request shed for a higher-priority admission; retry",
+                    "overloaded",
+                    headers={"Retry-After": str(int(math.ceil(retry)))},
+                )
         created = int(time.time())
         choices = []
         for i, r in enumerate(runs):
@@ -794,7 +819,7 @@ class EngineServer:
         self, request, rid, prompt, sampling, body, *, chat: bool,
         prompt_ids=None, lora_name=None, parse_tools: bool = False,
         n: int = 1, echo_text: str | None = None,
-        deadline: float | None = None,
+        deadline: float | None = None, tenant=None,
     ) -> web.StreamResponse:
         """SSE streaming for 1..n choices — ONE implementation (n=1 is a
         single pump), so single- and parallel-sampling semantics can never
@@ -833,6 +858,7 @@ class EngineServer:
                     sampling=self._nth_sampling(sampling, i),
                     request_id=rids[i], lora_name=lora_name,
                     deadline=deadline, admission_exclude_prefix=rid,
+                    tenant=tenant,
                 ):
                     await queue.put((i, out))
             except Exception as e:

@@ -47,6 +47,36 @@ REQUESTS_DEADLINE_EXPIRED = "tpu:requests_deadline_expired_total"
 # 1 while the engine is draining (admissions stopped, in-flight finishing)
 ENGINE_DRAINING = "tpu:engine_draining"
 
+# -- multi-tenant QoS (docs/27-multitenancy.md). All labeled tenant= with
+# cardinality bounded by the tenant table size (qos.TenantAccounting caps
+# engine-side ids minted from headers; overflow aggregates under
+# tenant="_overflow"). The engine exports requests/tokens/shed and the
+# queue-wait histogram; the router exports requests/prompt-tokens admitted
+# through the QoS gate plus per-tenant throttles (429s that never reached
+# an engine). Shared names: dashboards key off one series wherever the
+# enforcement happened.
+TENANT_REQUESTS = "tpu:tenant_requests_total"
+TENANT_PROMPT_TOKENS = "tpu:tenant_prompt_tokens_total"
+TENANT_GENERATION_TOKENS = "tpu:tenant_generation_tokens_total"
+# engine-side: admission refusals + queue evictions, lowest-priority-first
+TENANT_SHED = "tpu:tenant_shed_total"
+# router-side: per-tenant token-bucket / concurrency refusals (429 +
+# per-tenant Retry-After, distinct from the engine's global-shed path)
+TENANT_THROTTLED = "tpu:tenant_throttled_total"
+# engine-side histogram: seconds from submission to first scheduler seat
+TENANT_QUEUE_WAIT = "tpu:tenant_queue_wait_seconds"
+
+TENANT_ENGINE_COUNTERS = (
+    TENANT_REQUESTS,
+    TENANT_GENERATION_TOKENS,
+    TENANT_SHED,
+)
+TENANT_ROUTER_COUNTERS = (
+    TENANT_REQUESTS,
+    TENANT_PROMPT_TOKENS,
+    TENANT_THROTTLED,
+)
+
 # -- cluster KV index (event-driven KV-aware routing) -----------------------
 # Exported by the KV controller's /metrics and re-exported by the router in
 # embedded-index mode (router/metrics.py). NOT part of the per-engine scrape
@@ -113,4 +143,9 @@ ALL_COUNTERS = (
     SPEC_ACCEPTED_TOKENS,
     REQUESTS_SHED,
     REQUESTS_DEADLINE_EXPIRED,
+    # tenant-labeled (cardinality bounded by the tenant table); rendered
+    # by the engine exporter even before any stamped traffic arrives
+    TENANT_REQUESTS,
+    TENANT_GENERATION_TOKENS,
+    TENANT_SHED,
 )

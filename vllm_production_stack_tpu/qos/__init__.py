@@ -1,0 +1,61 @@
+"""Multi-tenant QoS: tenant identity, quotas, priority classes, and
+weighted fair-share scheduling (docs/27-multitenancy.md).
+
+The subsystem has two halves sharing one vocabulary:
+
+- **Router side** (qos/gate.py): the auth middleware resolves the caller's
+  bearer key to a `TenantPolicy` from a hot-reloadable `TenantTable`
+  (qos/tenants.py); the `QoSGate` enforces per-tenant token-bucket rate
+  limits and concurrency caps (qos/limiter.py) BEFORE any endpoint is
+  picked, and stamps `x-tenant-id` / `x-priority` / `x-tenant-weight` on
+  the upstream request.
+- **Engine side**: the scheduler turns those stamps into a weighted
+  fair-share admission pick (qos/fairshare.py virtual token counter),
+  lowest-priority-first preemption/shedding, and per-tenant accounting
+  (qos/accounting.py) exported through the tpu:tenant_* metric contract.
+
+Traffic with no stamps collapses to the single `default` tenant and the
+pre-QoS FIFO behavior — an unconfigured stack pays nothing for this layer.
+"""
+
+from .accounting import TenantAccounting
+from .fairshare import FairShareClock
+from .limiter import TenantLimiter, Throttled, TokenBucket
+from .tenants import (
+    DEFAULT_TENANT_ID,
+    PRIORITY_BATCH,
+    PRIORITY_CLASSES,
+    PRIORITY_RANK,
+    PRIORITY_REALTIME,
+    PRIORITY_STANDARD,
+    RANK_TO_CLASS,
+    TENANT_HEADER,
+    TENANT_PRIORITY_HEADER,
+    TENANT_WEIGHT_HEADER,
+    TenantContext,
+    TenantPolicy,
+    TenantTable,
+    tenant_from_headers,
+)
+
+__all__ = [
+    "DEFAULT_TENANT_ID",
+    "FairShareClock",
+    "PRIORITY_BATCH",
+    "PRIORITY_CLASSES",
+    "PRIORITY_RANK",
+    "PRIORITY_REALTIME",
+    "PRIORITY_STANDARD",
+    "RANK_TO_CLASS",
+    "TENANT_HEADER",
+    "TENANT_PRIORITY_HEADER",
+    "TENANT_WEIGHT_HEADER",
+    "TenantAccounting",
+    "TenantContext",
+    "TenantLimiter",
+    "TenantPolicy",
+    "TenantTable",
+    "Throttled",
+    "TokenBucket",
+    "tenant_from_headers",
+]

@@ -1,0 +1,63 @@
+"""Per-tenant observability counters, shared by scheduler and HTTP threads.
+
+The scheduler (step thread) counts admitted requests, generated tokens,
+and queue-wait seconds; the admission gate (HTTP executor threads) counts
+sheds. One lock, tiny critical sections. Label cardinality is bounded by
+MAX_TENANTS — tenant ids arrive in request headers, so an abusive client
+must not be able to mint unbounded Prometheus label values; overflow
+traffic aggregates under the ``_overflow`` tenant.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class TenantAccounting:
+    MAX_TENANTS = 64
+    MAX_PENDING_WAITS = 10_000
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, dict[str, float]] = {}
+        self._waits: list[tuple[str, float]] = []
+
+    def _slot(self, tenant_id: str) -> dict[str, float]:
+        c = self._counters.get(tenant_id)
+        if c is None:
+            if len(self._counters) >= self.MAX_TENANTS:
+                tenant_id = "_overflow"
+                c = self._counters.get(tenant_id)
+                if c is None:
+                    c = self._counters[tenant_id] = {}
+            else:
+                c = self._counters[tenant_id] = {}
+        return c
+
+    def inc(self, tenant_id: str, key: str, n: float = 1) -> None:
+        with self._lock:
+            c = self._slot(tenant_id)
+            c[key] = c.get(key, 0) + n
+
+    def observe_wait(self, tenant_id: str, seconds: float) -> None:
+        with self._lock:
+            c = self._slot(tenant_id)
+            c["queue_wait_sum"] = c.get("queue_wait_sum", 0.0) + seconds
+            c["queue_wait_count"] = c.get("queue_wait_count", 0) + 1
+            if len(self._waits) < self.MAX_PENDING_WAITS:
+                self._waits.append((tenant_id, seconds))
+
+    def snapshot(
+        self, drain_waits: bool = False
+    ) -> tuple[dict[str, dict[str, float]], list[tuple[str, float]]]:
+        """(cumulative counters copy, queue-wait observations). Draining
+        hands the raw observations to exactly one consumer (the metrics
+        exporter's histogram); non-draining callers still see the
+        cumulative sum/count in the counters."""
+        with self._lock:
+            counters = {t: dict(c) for t, c in self._counters.items()}
+            if drain_waits:
+                waits, self._waits = self._waits, []
+            else:
+                waits = list(self._waits)
+        return counters, waits

@@ -1,0 +1,46 @@
+"""Weighted fair share via a per-tenant virtual token counter.
+
+Start-time weighted fair queueing, reduced to what an admission pick
+needs: each tenant carries a virtual time that advances by
+``cost / weight`` per admitted request, and the scheduler admits the
+waiting head of the tenant with the smallest virtual time (within the
+winning priority tier). A tenant with weight 3 accrues virtual time a
+third as fast as a weight-1 tenant, so under saturation it is admitted —
+and therefore holds decode seats — 3x as often: decode-token share tracks
+weight without any per-step bookkeeping.
+
+The clamp to the global virtual clock on reactivation is the classic WFQ
+fix for banked credit: an idle tenant rejoins AT the current clock
+instead of monopolizing admissions until its stale counter catches up.
+"""
+
+from __future__ import annotations
+
+
+class FairShareClock:
+    """Single-threaded (scheduler-owned) virtual-time bookkeeping."""
+
+    def __init__(self) -> None:
+        self._vtime: dict[str, float] = {}
+        self._vclock = 0.0
+
+    def key(self, tenant_id: str) -> float:
+        """Ordering key for the admission pick: the tenant's start tag if
+        it were admitted now (idle tenants clamp up to the clock)."""
+        return max(self._vtime.get(tenant_id, 0.0), self._vclock)
+
+    def charge(self, tenant_id: str, cost: float, weight: float) -> None:
+        """Account one admitted request of `cost` tokens."""
+        start = self.key(tenant_id)
+        self._vtime[tenant_id] = start + cost / max(weight, 1e-6)
+        self._vclock = start
+        # bound the map: tenants come from request headers, so an abusive
+        # client could otherwise grow it without limit. Far-behind entries
+        # are equivalent to the clamp anyway.
+        if len(self._vtime) > 4096:
+            self._vtime = {
+                t: v for t, v in self._vtime.items() if v > self._vclock
+            }
+
+    def forget(self, tenant_id: str) -> None:
+        self._vtime.pop(tenant_id, None)

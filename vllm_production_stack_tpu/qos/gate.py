@@ -1,0 +1,157 @@
+"""Router-side QoS enforcement: resolve the tenant, meter it, stamp it.
+
+One `QoSGate` hangs off RouterState when --tenant-table-file is set (or a
+dynamic-config reload supplies a `tenants` mapping). The request path is:
+
+  auth middleware  -> resolve_tenant(bearer token, headers)  (identity)
+  request_service  -> try_admit(policy, body)                (quota)
+                   -> stamp(headers, policy)                 (propagation)
+                   -> release(policy) when the proxy attempt ends
+
+Enforcement runs BEFORE any endpoint is picked, composing with (not
+bypassing) the endpoint breakers and the engines' own load shedding: a
+tenant inside its quota can still get the engine's global 429, and a
+tenant outside it never costs an engine anything.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.logging import init_logger
+from .limiter import TenantLimiter, Throttled
+from .tenants import (
+    TENANT_HEADER,
+    TENANT_PRIORITY_HEADER,
+    TENANT_WEIGHT_HEADER,
+    TenantPolicy,
+    TenantTable,
+)
+
+logger = init_logger(__name__)
+
+# inbound copies of the stamp headers are ALWAYS dropped while QoS is
+# active — a client must not pick its own priority class
+STAMP_HEADERS = (TENANT_HEADER, TENANT_PRIORITY_HEADER, TENANT_WEIGHT_HEADER)
+
+# slot on the aiohttp request where the auth middleware parks the resolved
+# TenantPolicy for the proxy path (router/app.py sets, request_service reads)
+TENANT_REQUEST_KEY = "tpu_tenant_policy"
+
+
+def count_prompt_tokens(body: dict, tokenizer) -> int:
+    """Prompt tokens of an OpenAI-shaped request body, for the
+    tokens-per-minute bucket. Token-id prompts count exactly; text routes
+    through the gate's tokenizer (the same plumbing KV-aware routing uses,
+    utils.tokenizer.hashing_tokenizer). No tokenizer -> requests-only
+    metering (the token bucket charges 0)."""
+    prompt = body.get("prompt")
+    if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+        return len(prompt)
+    if tokenizer is None:
+        return 0
+    parts: list[str] = []
+    if "messages" in body:
+        for msg in body.get("messages", []):
+            content = msg.get("content", "")
+            if isinstance(content, list):
+                parts.extend(
+                    p.get("text", "")
+                    for p in content
+                    if isinstance(p, dict)
+                )
+            elif content:
+                parts.append(str(content))
+    elif isinstance(prompt, list):
+        parts.extend(str(p) for p in prompt)
+    elif prompt:
+        parts.append(str(prompt))
+    text = "\n".join(parts)
+    if not text:
+        return 0
+    try:
+        return len(tokenizer.encode(text))
+    except Exception:  # metering must never fail the request
+        return max(1, len(text) // 4)
+
+
+class QoSGate:
+    def __init__(self, table: TenantTable, tokenizer=None):
+        self.table = table
+        self.tokenizer = tokenizer
+        self.limiter = TenantLimiter(table)
+        # monotonic per-tenant counters, drained as deltas by the /metrics
+        # renderer (router/metrics.py) into real prometheus counters
+        self._mlock = threading.Lock()
+        self._pending: dict[tuple[str, str], float] = {}
+        self.reloads = 0
+
+    # -- table lifecycle ---------------------------------------------------
+
+    def update_table(self, table: TenantTable) -> None:
+        """Hot-swap the policy table (dynamic-config reload). Limiter state
+        for surviving tenants is preserved; the caller validates BEFORE
+        calling, so a malformed file never reaches here."""
+        self.table = table
+        self.limiter.update_table(table)
+        self.reloads += 1
+        logger.info(
+            "tenant table reloaded (#%d): %d tenant(s)",
+            self.reloads, len(table),
+        )
+
+    # -- identity ----------------------------------------------------------
+
+    def resolve_tenant(self, token: str | None, headers) -> TenantPolicy | None:
+        """Caller identity: bearer-key row match first; then the trusted
+        x-tenant-id header for KEYLESS rows (internal/mTLS deployments that
+        authenticate upstream of the router — a row with an api_key can
+        never be claimed by header). None when the token matches no tenant
+        (the middleware then falls back to the global key check)."""
+        policy = self.table.resolve_key(token)
+        if policy is not None:
+            return policy
+        claimed = headers.get(TENANT_HEADER)
+        if claimed:
+            row = self.table.get(claimed)
+            if row is not None and not row.api_key:
+                return row
+        return None
+
+    # -- quota -------------------------------------------------------------
+
+    def try_admit(self, policy: TenantPolicy, body: dict) -> Throttled | None:
+        n_tokens = count_prompt_tokens(body, self.tokenizer)
+        verdict = self.limiter.try_admit(policy, n_tokens)
+        if verdict is None:
+            self._bump(policy.tenant_id, "requests")
+            if n_tokens:
+                self._bump(policy.tenant_id, "prompt_tokens", n_tokens)
+        else:
+            self._bump(policy.tenant_id, "throttled")
+        return verdict
+
+    def release(self, policy: TenantPolicy) -> None:
+        self.limiter.release(policy.tenant_id)
+
+    # -- propagation -------------------------------------------------------
+
+    def stamp(self, headers: dict[str, str], policy: TenantPolicy) -> None:
+        """Stamp the resolved tenant onto upstream headers (inbound copies
+        were already stripped — see request_service._upstream_headers)."""
+        headers[TENANT_HEADER] = policy.tenant_id
+        headers[TENANT_PRIORITY_HEADER] = policy.priority
+        headers[TENANT_WEIGHT_HEADER] = repr(policy.weight)
+
+    # -- metrics -----------------------------------------------------------
+
+    def _bump(self, tenant_id: str, key: str, n: float = 1) -> None:
+        with self._mlock:
+            k = (tenant_id, key)
+            self._pending[k] = self._pending.get(k, 0) + n
+
+    def drain_counter_deltas(self) -> dict[tuple[str, str], float]:
+        """(tenant, kind) -> increment since the last scrape."""
+        with self._mlock:
+            out, self._pending = self._pending, {}
+        return out

@@ -1,0 +1,178 @@
+"""Per-tenant rate limiting: token buckets + a concurrency cap.
+
+Router-side enforcement (qos/gate.py) runs BEFORE any endpoint is picked,
+so a throttled tenant costs the cluster one bucket check — no tokenizer
+work on the engines, no queue slot, no breaker state. The 429 carries a
+per-tenant Retry-After computed from the bucket's own refill rate, which
+is deliberately distinct from the engine's global-shed Retry-After
+(that one is derived from observed decode throughput).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from .tenants import TenantPolicy, TenantTable
+
+
+@dataclass(frozen=True)
+class Throttled:
+    """A refused admission: which limit tripped and when to come back."""
+
+    tenant_id: str
+    reason: str  # "requests_per_s" | "tokens_per_min" | "max_concurrent"
+    retry_after_s: float
+    detail: str = ""
+
+
+class TokenBucket:
+    """Classic token bucket on the monotonic clock. `rate` tokens accrue
+    per second up to `burst`; try_take returns 0.0 on success or the
+    seconds until `n` tokens will have accrued (the Retry-After)."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = max(rate, 1e-9)
+        self.burst = max(burst, 1.0)
+        self._level = self.burst
+        self._stamp = time.monotonic()
+
+    def _refill(self, now: float) -> None:
+        # clamp: a caller-supplied clock earlier than the last stamp must
+        # never DRAIN the bucket (tests inject fake clocks; monotonic
+        # itself never goes backwards)
+        self._level = min(
+            self.burst,
+            self._level + max(0.0, now - self._stamp) * self.rate,
+        )
+        self._stamp = now
+
+    def try_take(self, n: float = 1.0, now: float | None = None) -> float:
+        now = time.monotonic() if now is None else now
+        self._refill(now)
+        if self._level >= n:
+            self._level -= n
+            return 0.0
+        return (n - self._level) / self.rate
+
+    @property
+    def level(self) -> float:
+        return self._level
+
+
+class _TenantState:
+    def __init__(self, policy: TenantPolicy):
+        self.policy = policy
+        self.rps: TokenBucket | None = None
+        self.tpm: TokenBucket | None = None
+        self.in_flight = 0
+        self._configure(policy)
+
+    def _configure(self, policy: TenantPolicy) -> None:
+        if policy.requests_per_s > 0:
+            # burst = one second's worth (>= 1): a tenant at 10 req/s may
+            # legally arrive as a 10-request burst each second
+            if self.rps is None:
+                self.rps = TokenBucket(
+                    policy.requests_per_s, max(1.0, policy.requests_per_s)
+                )
+            else:
+                self.rps.rate = policy.requests_per_s
+                self.rps.burst = max(1.0, policy.requests_per_s)
+        else:
+            self.rps = None
+        if policy.tokens_per_min > 0:
+            if self.tpm is None:
+                self.tpm = TokenBucket(
+                    policy.tokens_per_min / 60.0, policy.tokens_per_min
+                )
+            else:
+                self.tpm.rate = policy.tokens_per_min / 60.0
+                self.tpm.burst = policy.tokens_per_min
+        else:
+            self.tpm = None
+
+    def update(self, policy: TenantPolicy) -> None:
+        """Refresh limits in place — bucket LEVELS survive a hot reload so
+        a mid-traffic weight/limit change can't hand every tenant a fresh
+        burst allowance."""
+        self.policy = policy
+        self._configure(policy)
+
+
+class TenantLimiter:
+    """Thread-safe per-tenant enforcement over a (swappable) TenantTable."""
+
+    def __init__(self, table: TenantTable):
+        self._lock = threading.Lock()
+        self._states: dict[str, _TenantState] = {}
+        self.update_table(table)
+
+    def update_table(self, table: TenantTable) -> None:
+        with self._lock:
+            fresh: dict[str, _TenantState] = {}
+            for policy in [*table.policies(), table.default_policy]:
+                prev = self._states.get(policy.tenant_id)
+                if prev is not None:
+                    prev.update(policy)
+                    fresh[policy.tenant_id] = prev
+                else:
+                    fresh[policy.tenant_id] = _TenantState(policy)
+            self._states = fresh
+
+    def _state(self, tenant_id: str) -> _TenantState | None:
+        return self._states.get(tenant_id)
+
+    def try_admit(
+        self, policy: TenantPolicy, n_tokens: int, now: float | None = None
+    ) -> Throttled | None:
+        """One request carrying `n_tokens` prompt tokens asks in. Returns
+        None and holds a concurrency slot on success (caller MUST release),
+        or a Throttled refusal. Checks are ordered cheapest-first and only
+        the first trip is charged — a refused request consumes nothing."""
+        with self._lock:
+            st = self._state(policy.tenant_id)
+            if st is None:  # tenant removed mid-flight: treat as default
+                return None
+            p = st.policy
+            if p.max_concurrent > 0 and st.in_flight >= p.max_concurrent:
+                return Throttled(
+                    p.tenant_id, "max_concurrent", 1.0,
+                    f"{st.in_flight} requests already in flight "
+                    f"(max_concurrent={p.max_concurrent})",
+                )
+            if st.rps is not None:
+                wait = st.rps.try_take(1.0, now)
+                if wait > 0.0:
+                    return Throttled(
+                        p.tenant_id, "requests_per_s",
+                        min(60.0, max(wait, 0.05)),
+                        f"request rate above {p.requests_per_s}/s",
+                    )
+            if st.tpm is not None and n_tokens > 0:
+                wait = st.tpm.try_take(float(n_tokens), now)
+                if wait > 0.0:
+                    # un-charge the request bucket: this admission failed
+                    if st.rps is not None:
+                        st.rps._level = min(
+                            st.rps.burst, st.rps._level + 1.0
+                        )
+                    return Throttled(
+                        p.tenant_id, "tokens_per_min",
+                        min(60.0, max(wait, 0.05)),
+                        f"prompt-token rate above {p.tokens_per_min}/min",
+                    )
+            st.in_flight += 1
+            return None
+
+    def release(self, tenant_id: str) -> None:
+        with self._lock:
+            st = self._states.get(tenant_id)
+            if st is not None and st.in_flight > 0:
+                st.in_flight -= 1
+
+    def in_flight(self, tenant_id: str) -> int:
+        with self._lock:
+            st = self._states.get(tenant_id)
+            return st.in_flight if st else 0

@@ -1,0 +1,253 @@
+"""Tenant identity: the policy table and the per-request context.
+
+The `TenantTable` is the operator-facing artifact (a YAML/JSON file, helm
+`routerSpec.tenantTable`, hot-reloaded through the router's dynamic-config
+watcher). A row maps an API key to a tenant with a priority class, a
+fair-share weight, and rate/concurrency limits. The router resolves the
+caller to a row and stamps the request; everything downstream (engine
+scheduler, metrics) works from the stamped `TenantContext` — the engine
+never needs the table or the keys.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+# priority classes, most-latency-critical first. RANK is the scheduler's
+# ordering key: LOWER rank wins admission ties, HIGHER rank is shed /
+# preempted first.
+PRIORITY_REALTIME = 0
+PRIORITY_STANDARD = 1
+PRIORITY_BATCH = 2
+PRIORITY_RANK = {
+    "realtime": PRIORITY_REALTIME,
+    "standard": PRIORITY_STANDARD,
+    "batch": PRIORITY_BATCH,
+}
+RANK_TO_CLASS = {v: k for k, v in PRIORITY_RANK.items()}
+PRIORITY_CLASSES = tuple(PRIORITY_RANK)
+
+DEFAULT_TENANT_ID = "default"
+
+# stamped by the router on upstream requests (and stripped from inbound
+# ones when QoS is active — clients must not spoof their class)
+TENANT_HEADER = "x-tenant-id"
+TENANT_PRIORITY_HEADER = "x-priority"
+TENANT_WEIGHT_HEADER = "x-tenant-weight"
+
+# tenant ids become Prometheus label values and header values — keep them
+# boring. Same charset the id validation below enforces.
+_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+# fair-share weight sanity bounds: a 0 weight divides by zero in the
+# virtual clock; an absurd one is a fat-fingered table entry
+_MIN_WEIGHT, _MAX_WEIGHT = 1e-3, 1e6
+
+
+@dataclass(frozen=True)
+class TenantPolicy:
+    """One table row. Limits of 0 mean unlimited (that knob is off)."""
+
+    tenant_id: str
+    api_key: str = ""  # empty = not key-resolvable (header-trusted only)
+    priority: str = "standard"  # realtime | standard | batch
+    weight: float = 1.0
+    requests_per_s: float = 0.0
+    tokens_per_min: float = 0.0
+    max_concurrent: int = 0
+
+    @property
+    def priority_rank(self) -> int:
+        return PRIORITY_RANK[self.priority]
+
+    def context(self) -> "TenantContext":
+        return TenantContext(
+            tenant_id=self.tenant_id,
+            priority=self.priority_rank,
+            weight=self.weight,
+        )
+
+
+@dataclass(frozen=True)
+class TenantContext:
+    """What the engine needs to know about a request's tenant — carried in
+    the stamped headers, never the table itself."""
+
+    tenant_id: str = DEFAULT_TENANT_ID
+    priority: int = PRIORITY_STANDARD  # rank (0 realtime .. 2 batch)
+    weight: float = 1.0
+
+    @property
+    def is_default(self) -> bool:
+        return (
+            self.tenant_id == DEFAULT_TENANT_ID
+            and self.priority == PRIORITY_STANDARD
+            and self.weight == 1.0
+        )
+
+
+DEFAULT_CONTEXT = TenantContext()
+
+
+def _parse_policy(tenant_id: str, row: dict) -> TenantPolicy:
+    if not isinstance(row, dict):
+        raise ValueError(f"tenant {tenant_id!r}: entry must be a mapping")
+    if not _ID_RE.match(tenant_id):
+        raise ValueError(
+            f"tenant id {tenant_id!r} invalid: 1-64 chars of [A-Za-z0-9._-]"
+        )
+    unknown = set(row) - {
+        "api_key", "priority", "weight", "requests_per_s",
+        "tokens_per_min", "max_concurrent",
+    }
+    if unknown:
+        raise ValueError(
+            f"tenant {tenant_id!r}: unknown keys {sorted(unknown)}"
+        )
+    priority = row.get("priority", "standard")
+    if priority not in PRIORITY_RANK:
+        raise ValueError(
+            f"tenant {tenant_id!r}: priority {priority!r} not in "
+            f"{sorted(PRIORITY_RANK)}"
+        )
+    weight = float(row.get("weight", 1.0))
+    if not _MIN_WEIGHT <= weight <= _MAX_WEIGHT:
+        raise ValueError(
+            f"tenant {tenant_id!r}: weight {weight} outside "
+            f"[{_MIN_WEIGHT}, {_MAX_WEIGHT}]"
+        )
+    rps = float(row.get("requests_per_s", 0.0))
+    tpm = float(row.get("tokens_per_min", 0.0))
+    conc = int(row.get("max_concurrent", 0))
+    if rps < 0 or tpm < 0 or conc < 0:
+        raise ValueError(f"tenant {tenant_id!r}: limits must be >= 0")
+    api_key = row.get("api_key", "") or ""
+    if not isinstance(api_key, str):
+        raise ValueError(f"tenant {tenant_id!r}: api_key must be a string")
+    return TenantPolicy(
+        tenant_id=tenant_id,
+        api_key=api_key,
+        priority=priority,
+        weight=weight,
+        requests_per_s=rps,
+        tokens_per_min=tpm,
+        max_concurrent=conc,
+    )
+
+
+class TenantTable:
+    """Validated, immutable-after-construction tenant policy set. A
+    malformed input raises during construction — the caller (dynamic-config
+    reload) keeps serving the previous table."""
+
+    def __init__(self, policies: list[TenantPolicy]):
+        ids = [p.tenant_id for p in policies]
+        dup = {i for i in ids if ids.count(i) > 1}
+        if dup:
+            raise ValueError(f"duplicate tenant ids: {sorted(dup)}")
+        keys = [p.api_key for p in policies if p.api_key]
+        dupk = {k for k in keys if keys.count(k) > 1}
+        if dupk:
+            raise ValueError(
+                f"{len(dupk)} api key(s) are shared by multiple tenants"
+            )
+        self._by_id: dict[str, TenantPolicy] = {
+            p.tenant_id: p for p in policies
+        }
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "TenantTable":
+        """Accepts {"tenants": {id: {...}}} or a bare {id: {...}} mapping.
+        An optional "default" id customizes the policy unmatched traffic
+        falls back to."""
+        if not isinstance(data, dict):
+            raise ValueError("tenant table must be a mapping")
+        rows = data.get("tenants", data)
+        if not isinstance(rows, dict):
+            raise ValueError("'tenants' must map tenant id -> policy")
+        extra = set(data) - {"tenants"} if "tenants" in data else set()
+        if extra:
+            raise ValueError(f"unknown tenant-table keys: {sorted(extra)}")
+        return cls([_parse_policy(tid, row or {}) for tid, row in rows.items()])
+
+    @classmethod
+    def loads(cls, text: str, fmt: str = "yaml") -> "TenantTable":
+        import json
+
+        import yaml
+
+        data = json.loads(text) if fmt == "json" else yaml.safe_load(text)
+        return cls.from_dict(data or {})
+
+    @classmethod
+    def load(cls, path: str | Path) -> "TenantTable":
+        p = Path(path)
+        fmt = "json" if p.suffix == ".json" else "yaml"
+        return cls.loads(p.read_text(), fmt=fmt)
+
+    # -- lookup ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._by_id)
+
+    def __contains__(self, tenant_id: str) -> bool:
+        return tenant_id in self._by_id
+
+    def policies(self) -> list[TenantPolicy]:
+        return list(self._by_id.values())
+
+    def get(self, tenant_id: str) -> TenantPolicy | None:
+        return self._by_id.get(tenant_id)
+
+    @property
+    def default_policy(self) -> TenantPolicy:
+        return self._by_id.get(DEFAULT_TENANT_ID) or TenantPolicy(
+            tenant_id=DEFAULT_TENANT_ID
+        )
+
+    def has_keys(self) -> bool:
+        return any(p.api_key for p in self._by_id.values())
+
+    def resolve_key(self, token: str | None) -> TenantPolicy | None:
+        """Bearer token → tenant. Scans EVERY row with a constant-time
+        comparison so the match position (or absence) is not observable
+        through timing — the same reason the router's global key check
+        uses hmac.compare_digest."""
+        import hmac
+
+        if not token:
+            return None
+        # compare bytes: compare_digest raises TypeError on non-ASCII str
+        # inputs, and a weird client token must 401, not 500
+        tok = token.encode("utf-8", "surrogateescape")
+        found: TenantPolicy | None = None
+        for p in self._by_id.values():
+            if p.api_key and hmac.compare_digest(
+                p.api_key.encode("utf-8", "surrogateescape"), tok
+            ):
+                found = p  # keep scanning: constant work per call
+        return found
+
+
+def tenant_from_headers(headers) -> TenantContext:
+    """Parse the stamped tenant headers into a context; anything absent or
+    malformed falls back to the default-tenant value (a bad header must
+    degrade service class, never 500 the request)."""
+    tid = headers.get(TENANT_HEADER, "") or DEFAULT_TENANT_ID
+    if not _ID_RE.match(tid):
+        tid = DEFAULT_TENANT_ID
+    rank = PRIORITY_RANK.get(
+        (headers.get(TENANT_PRIORITY_HEADER) or "standard").lower(),
+        PRIORITY_STANDARD,
+    )
+    try:
+        weight = float(headers.get(TENANT_WEIGHT_HEADER, "") or 1.0)
+    except (TypeError, ValueError):
+        weight = 1.0
+    if not _MIN_WEIGHT <= weight <= _MAX_WEIGHT:
+        weight = 1.0
+    return TenantContext(tenant_id=tid, priority=rank, weight=weight)

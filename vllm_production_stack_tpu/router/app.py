@@ -18,12 +18,16 @@ HTTP surface (reference parity):
 from __future__ import annotations
 
 import asyncio
+import hmac
 import json
 import time
 
 from aiohttp import web
 
+from ..qos import TenantTable
+from ..qos.gate import TENANT_REQUEST_KEY, QoSGate
 from ..utils.logging import init_logger
+from ..utils.tokenizer import hashing_tokenizer
 from .breaker import BreakerBoard
 from .callbacks import load_callbacks
 from .discovery import make_discovery
@@ -71,6 +75,17 @@ class RouterState:
         self.model_aliases: dict[str, str] = (
             json.loads(args.model_aliases) if args.model_aliases else {}
         )
+        # multi-tenant QoS (docs/27-multitenancy.md): per-tenant identity,
+        # rate limits, and priority stamping. None = QoS off (the historic
+        # single-key behavior, zero overhead).
+        self.qos: QoSGate | None = None
+        if getattr(args, "tenant_table_file", None):
+            self.qos = QoSGate(
+                TenantTable.load(args.tenant_table_file),
+                tokenizer=hashing_tokenizer(
+                    getattr(args, "qos_tokenizer", "byte")
+                ),
+            )
         self.dynamic_config: DynamicConfigWatcher | None = None
         self.semantic_cache = None
         self.pii_middleware = None
@@ -93,6 +108,14 @@ class RouterState:
 
     async def apply_dynamic_config(self, config: dict) -> None:
         """Hot-swap discovery/routing from a dynamic config dict."""
+        # validate the tenant table FIRST: a malformed table must reject
+        # the whole reload before any other key mutates state (and the
+        # previous table keeps serving — TenantTable raises on bad input)
+        new_table = (
+            TenantTable.from_dict(config["tenants"])
+            if "tenants" in config
+            else None
+        )
         if "model_aliases" in config:
             self.model_aliases = dict(config["model_aliases"])
         if any(k.startswith("static") or k == "service_discovery" for k in config):
@@ -111,6 +134,23 @@ class RouterState:
                 config["routing_logic"], **_policy_kwargs(merged)
             )
             await old_policy.close()
+        if new_table is not None:
+            self.apply_tenant_table(new_table)
+
+    def apply_tenant_table(self, table: TenantTable) -> None:
+        """Swap the tenant policy table in place (dynamic-config reload or
+        a change to --tenant-table-file). Limiter bucket levels survive for
+        tenants present in both tables; creating the gate on first use
+        lets a previously-QoS-less router adopt a table at runtime."""
+        if self.qos is None:
+            self.qos = QoSGate(
+                table,
+                tokenizer=hashing_tokenizer(
+                    getattr(self.args, "qos_tokenizer", "byte")
+                ),
+            )
+        else:
+            self.qos.update_table(table)
 
 
 class _ArgsView:
@@ -184,20 +224,58 @@ _PROTECTED_EXACT = (
 )
 
 
+def _unauthorized() -> web.Response:
+    return web.json_response(
+        {"error": {"message": "invalid API key", "type": "auth_error"}},
+        status=401,
+    )
+
+
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
+    """Bearer auth + tenant resolution. Every comparison is
+    hmac.compare_digest — the old `auth != f"Bearer {key}"` check leaked
+    the match length through timing. With a tenant table (state.qos) the
+    key identifies the CALLER, not just validity: the resolved policy
+    rides on the request for the QoS gate and the upstream stamp."""
     state = _state(request)
     key = state.args.api_key
+    qos = state.qos
     needs_auth = request.path.startswith(_PROTECTED_PREFIXES) or (
         request.path in _PROTECTED_EXACT
     )
-    if key and needs_auth:
+    if needs_auth and (key or qos is not None):
         auth = request.headers.get("Authorization", "")
-        if auth != f"Bearer {key}":
-            return web.json_response(
-                {"error": {"message": "invalid API key", "type": "auth_error"}},
-                status=401,
-            )
+        token = auth[7:] if auth.startswith("Bearer ") else None
+        tenant = (
+            qos.resolve_tenant(token, request.headers)
+            if qos is not None
+            else None
+        )
+        # a tenant matched by its OWN api_key authenticates; a keyless row
+        # claimed via the x-tenant-id header only selects identity (mTLS-
+        # style deployments trust the header upstream) and must NOT bypass
+        # a configured global key
+        authed_by_tenant_key = tenant is not None and bool(tenant.api_key)
+        if not authed_by_tenant_key:
+            # the global key (→ default tenant) still authenticates; with
+            # no global key, a PRESENTED-but-unknown token is refused (a
+            # typo'd tenant key must not silently serve on the default
+            # tier) while bare requests stay open. Bytes compares: a
+            # non-ASCII token must 401, not TypeError→500.
+            if key:
+                if not (
+                    token
+                    and hmac.compare_digest(
+                        token.encode("utf-8", "surrogateescape"),
+                        key.encode("utf-8", "surrogateescape"),
+                    )
+                ):
+                    return _unauthorized()
+            elif token is not None and qos is not None and qos.table.has_keys():
+                return _unauthorized()
+        if qos is not None:
+            request[TENANT_REQUEST_KEY] = tenant or qos.table.default_policy
     return await handler(request)
 
 
@@ -406,9 +484,15 @@ def build_app(args) -> web.Application:
         await state.engine_scraper.start()
         if state.batch_service is not None:
             await state.batch_service.start()
-        if args.dynamic_config_file:
+        if args.dynamic_config_file or getattr(
+            args, "tenant_table_file", None
+        ):
+            # one watcher covers both the dynamic config AND the tenant
+            # table file — a router started with only --tenant-table-file
+            # still hot-reloads table edits
             state.dynamic_config = DynamicConfigWatcher(
-                args.dynamic_config_file, state, args.dynamic_config_interval
+                args.dynamic_config_file, state, args.dynamic_config_interval,
+                tenant_table_path=getattr(args, "tenant_table_file", None),
             )
             await state.dynamic_config.start()
         if args.log_stats_interval > 0:

@@ -141,6 +141,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="PII analyzer backend (presidio needs the "
                         "presidio-analyzer package in the router image)")
     x.add_argument("--api-key", default=None, help="require this bearer token")
+
+    q = p.add_argument_group("multi-tenant QoS (docs/27-multitenancy.md)")
+    q.add_argument(
+        "--tenant-table-file", default=None,
+        help="YAML/JSON tenant policy table (per-tenant API keys, priority "
+             "class realtime|standard|batch, fair-share weight, "
+             "requests_per_s / tokens_per_min / max_concurrent limits). "
+             "Enables the QoS gate: callers resolve to a tenant, get "
+             "per-tenant rate limits BEFORE routing, and requests are "
+             "stamped x-tenant-id/x-priority/x-tenant-weight for the "
+             "engines' weighted fair-share scheduler. Hot-reloaded by the "
+             "dynamic-config watcher when --dynamic-config-file is set",
+    )
+    q.add_argument(
+        "--qos-tokenizer", default="byte",
+        help="tokenizer for the tokens-per-minute buckets: an HF "
+             "checkpoint/tokenizer dir (count exactly like the engines), "
+             "'byte' for the dependency-free byte fallback, or '' to "
+             "meter requests only",
+    )
     x.add_argument("--sentry-dsn", default=None,
                    help="enable Sentry error reporting (requires sentry-sdk)")
     x.add_argument("--sentry-traces-sample-rate", type=float, default=0.0)

@@ -31,6 +31,10 @@ _ALLOWED = {
     "prefill_model_labels",
     "decode_model_labels",
     "model_aliases",
+    # multi-tenant QoS: an inline tenant policy table ({id: {...}}),
+    # validated before ANY key of the reload applies — see
+    # RouterState.apply_dynamic_config
+    "tenants",
 }
 
 
@@ -48,14 +52,33 @@ def load_config_file(path: str | Path) -> dict:
 
 
 class DynamicConfigWatcher:
-    def __init__(self, path: str, state, interval: float = 10.0):
-        self.path = Path(path)
+    def __init__(
+        self,
+        path: str,
+        state,
+        interval: float = 10.0,
+        tenant_table_path: str | None = None,
+    ):
+        # path may be None when the watcher exists only for the tenant
+        # table (a router started with --tenant-table-file but no
+        # --dynamic-config-file still hot-reloads table edits)
+        self.path = Path(path) if path else None
         self.state = state
         self.interval = interval
         self.reload_count = 0
         self.current: dict = {}
         self._last_raw: str | None = None
         self._task: asyncio.Task | None = None
+        # multi-tenant QoS: the --tenant-table-file is watched by the SAME
+        # loop (one watcher, two files) — edits to the table hot-reload
+        # without restarting the router, and a malformed edit keeps the
+        # previous table serving (TenantTable validation raises before any
+        # swap)
+        self.tenant_table_path = (
+            Path(tenant_table_path) if tenant_table_path else None
+        )
+        self._last_tenant_raw: str | None = None
+        self.tenant_reload_count = 0
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._loop())
@@ -77,17 +100,57 @@ class DynamicConfigWatcher:
             await asyncio.sleep(self.interval)
 
     async def check_once(self) -> bool:
-        """Returns True when a reload was applied."""
+        """Returns True when a reload was applied (either file)."""
+        applied = False
+        main_err: Exception | None = None
+        raw = None
+        if self.path is not None:
+            try:
+                raw = self.path.read_text()
+            except FileNotFoundError:
+                raw = None
+        if raw is not None and raw != self._last_raw:
+            try:
+                config = load_config_file(self.path)
+                await self.state.apply_dynamic_config(config)
+            except Exception as e:  # noqa: BLE001 — independence below
+                main_err = e
+            else:
+                self._last_raw = raw
+                self.current = config
+                self.reload_count += 1
+                applied = True
+                logger.info(
+                    "applied dynamic config #%d from %s",
+                    self.reload_count, self.path,
+                )
+        # tenant table second, INDEPENDENTLY: a persistently broken main
+        # config (whose error would otherwise re-raise every poll) must
+        # not block an urgent table fix — e.g. revoking a leaked tenant
+        # key. Raises on a malformed table — the loop logs it and the
+        # PREVIOUS table keeps serving.
+        if self.tenant_table_path is not None:
+            applied = self._check_tenant_table() or applied
+        if main_err is not None:
+            raise main_err
+        return applied
+
+    def _check_tenant_table(self) -> bool:
+        from ..qos import TenantTable
+
         try:
-            raw = self.path.read_text()
+            raw = self.tenant_table_path.read_text()
         except FileNotFoundError:
             return False
-        if raw == self._last_raw:
+        if raw == self._last_tenant_raw:
             return False
-        config = load_config_file(self.path)
-        await self.state.apply_dynamic_config(config)
-        self._last_raw = raw
-        self.current = config
-        self.reload_count += 1
-        logger.info("applied dynamic config #%d from %s", self.reload_count, self.path)
+        fmt = "json" if self.tenant_table_path.suffix == ".json" else "yaml"
+        table = TenantTable.loads(raw, fmt=fmt)  # raises before any swap
+        self.state.apply_tenant_table(table)
+        self._last_tenant_raw = raw
+        self.tenant_reload_count += 1
+        logger.info(
+            "applied tenant table #%d from %s (%d tenants)",
+            self.tenant_reload_count, self.tenant_table_path, len(table),
+        )
         return True

@@ -114,6 +114,30 @@ class RouterMetrics:
             buckets=LookupLatency.BUCKETS,
             registry=self.registry,
         )
+        # multi-tenant QoS (docs/27-multitenancy.md): the router's half of
+        # the tpu:tenant_* contract — admitted traffic and per-tenant
+        # throttles (429s that never reached an engine). Label cardinality
+        # is bounded by the tenant table size.
+        tc = lambda name, doc: Counter(  # noqa: E731
+            name[: -len("_total")] if name.endswith("_total") else name,
+            doc, ["tenant"], registry=self.registry,
+        )
+        self.tenant_requests = tc(
+            mc.TENANT_REQUESTS, "Requests admitted through the QoS gate"
+        )
+        self.tenant_prompt_tokens = tc(
+            mc.TENANT_PROMPT_TOKENS,
+            "Prompt tokens metered through the QoS gate",
+        )
+        self.tenant_throttled = tc(
+            mc.TENANT_THROTTLED,
+            "Requests refused by per-tenant rate limits / concurrency caps",
+        )
+        self._tenant_series = {
+            "requests": self.tenant_requests,
+            "prompt_tokens": self.tenant_prompt_tokens,
+            "throttled": self.tenant_throttled,
+        }
 
     def _render_kv_index(self, policy) -> None:
         index = getattr(policy, "index", None)
@@ -132,6 +156,12 @@ class RouterMetrics:
 
     def render(self, state) -> bytes:
         self._render_kv_index(state.policy)
+        qos = getattr(state, "qos", None)
+        if qos is not None:
+            for (tenant, kind), delta in qos.drain_counter_deltas().items():
+                series = self._tenant_series.get(kind)
+                if series is not None:
+                    series.labels(tenant=tenant).inc(delta)
         req_stats = state.request_monitor.get_request_stats()
         for url, st in req_stats.items():
             self.current_qps.labels(server=url).set(st.qps)

@@ -12,12 +12,14 @@ to the decode peer), then the original body streams from a decode engine.
 from __future__ import annotations
 
 import json
+import math
 import time
 import uuid
 
 import aiohttp
 from aiohttp import web
 
+from ..qos.gate import STAMP_HEADERS, TENANT_REQUEST_KEY
 from ..utils.logging import init_logger
 from .routing import DisaggregatedPrefillPolicy, RoutingContext, qps_min_url
 
@@ -132,6 +134,47 @@ class RequestService:
         if self.state.callbacks is not None:
             await self.state.callbacks.post_request(request, b"")
 
+    # -- multi-tenant QoS (docs/27-multitenancy.md) ------------------------
+
+    def _qos_admit(self, request, body: dict):
+        """(tenant_policy, refusal). Per-tenant token buckets + concurrency
+        run BEFORE any endpoint is picked — a throttled tenant costs zero
+        engine work, zero breaker state, zero queue slots. The 429 carries
+        the TENANT's Retry-After (bucket refill time), deliberately
+        distinct from the engines' global-shed Retry-After (backlog over
+        observed decode throughput). On success the caller MUST call
+        _qos_release when the proxy attempt ends (concurrency slot)."""
+        qos = self.state.qos
+        if qos is None:
+            return None, None
+        tenant = request.get(TENANT_REQUEST_KEY) or qos.table.default_policy
+        verdict = qos.try_admit(tenant, body)
+        if verdict is None:
+            return tenant, None
+        return tenant, web.json_response(
+            {
+                "error": {
+                    "message": (
+                        f"tenant {verdict.tenant_id!r} throttled "
+                        f"({verdict.reason}): {verdict.detail}"
+                    ),
+                    "type": "tenant_throttled",
+                    "param": verdict.reason,
+                }
+            },
+            status=429,
+            headers={
+                "Retry-After": str(
+                    max(1, math.ceil(verdict.retry_after_s))
+                ),
+                "X-Tenant-Id": verdict.tenant_id,
+            },
+        )
+
+    def _qos_release(self, tenant) -> None:
+        if tenant is not None and self.state.qos is not None:
+            self.state.qos.release(tenant)
+
     async def route_openai_request(self, request: web.Request) -> web.StreamResponse:
         """Generic /v1/* proxy with routing."""
         if request.content_type == "multipart/form-data":
@@ -148,7 +191,19 @@ class RequestService:
                 {"error": {"message": "request body is not valid JSON"}},
                 status=400,
             )
+        # QoS first: the cheapest possible refusal (no callbacks, no
+        # rewrite, no endpoint work for an over-quota tenant)
+        tenant, throttled = self._qos_admit(request, body)
+        if throttled is not None:
+            return throttled
+        try:
+            return await self._route_parsed(request, body)
+        finally:
+            self._qos_release(tenant)
 
+    async def _route_parsed(
+        self, request: web.Request, body: dict
+    ) -> web.StreamResponse:
         request_id = request.headers.get("X-Request-Id") or uuid.uuid4().hex
         if self.state.callbacks is not None:
             short = await self.state.callbacks.pre_request(request, body)
@@ -408,9 +463,17 @@ class RequestService:
             finally:
                 mon.on_request_complete(url, request_id, time.time())
 
-        return await self._with_failover(
-            eps, request, request_id, {"model": model}, attempt,
-        )
+        # QoS: requests-per-second + concurrency only (multipart bodies
+        # carry audio, not a token-meterable prompt)
+        tenant, throttled = self._qos_admit(request, {"model": model})
+        if throttled is not None:
+            return throttled
+        try:
+            return await self._with_failover(
+                eps, request, request_id, {"model": model}, attempt,
+            )
+        finally:
+            self._qos_release(tenant)
 
 
     _DEADLINE_KEY = "tpu_deadline_abs"  # per-request slot on the aiohttp req
@@ -423,6 +486,21 @@ class RequestService:
         after a 10 s connect timeout forwards the 10-seconds-poorer
         remainder instead of re-arming the full budget on every retry."""
         headers = _forward_headers(request.headers)
+        qos = self.state.qos
+        if qos is not None:
+            # spoof-proofing: with QoS active, inbound x-tenant-id /
+            # x-priority / x-tenant-weight are ALWAYS dropped — the only
+            # stamps an engine sees are the ones this router resolved from
+            # its table. (Without a table the router is transparent, so an
+            # upstream gateway may stamp through it.)
+            headers = {
+                k: v
+                for k, v in headers.items()
+                if k.lower() not in STAMP_HEADERS
+            }
+            policy = request.get(TENANT_REQUEST_KEY)
+            if policy is not None:
+                qos.stamp(headers, policy)
         abs_deadline = request.get(self._DEADLINE_KEY)
         if abs_deadline is None:
             ms = 0.0

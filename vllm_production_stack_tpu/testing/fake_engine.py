@@ -67,7 +67,10 @@ class FakeEngine:
             )
         self.total_requests += 1
         self.seen_request_log.append(
-            {"path": request.path, "body": body, "t": time.time()}
+            {"path": request.path, "body": body, "t": time.time(),
+             # lowercased so tests can assert on router-stamped tenant
+             # headers without caring about wire casing
+             "headers": {k.lower(): v for k, v in request.headers.items()}}
         )
         is_chat = request.path.endswith("chat/completions")
         n = int(body.get("max_tokens") or self.default_tokens)
