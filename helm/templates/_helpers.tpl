@@ -99,6 +99,14 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--drain-timeout-s"
 - {{ .drainTimeoutS | quote }}
 {{- end }}
+{{- if eq (.requestTracing | default true) false }}
+- "--request-tracing"
+- "false"
+{{- end }}
+{{- if .traceBuffer }}
+- "--trace-buffer"
+- {{ .traceBuffer | quote }}
+{{- end }}
 {{- if eq (.enablePrefixCaching | default true) false }}
 - "--no-enable-prefix-caching"
 {{- end }}

@@ -1,0 +1,479 @@
+"""Request-tracing spine (docs/28-request-tracing.md) — tier-1.
+
+Covers the acceptance surface end to end: W3C traceparent propagation
+(router-generated AND caller-supplied), span-timeline correctness through
+a REAL tiny-engine request (queue → prefill → decode ordering, rollback
+never corrupts per-request attribution), the /debug/requests shape on
+both sides, ring-buffer bounding under flood, the no-op path when tracing
+is disabled, and the metrics-contract drift check.
+"""
+
+import asyncio
+import os
+import sys
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vllm_production_stack_tpu.engine.config import EngineConfig
+from vllm_production_stack_tpu.engine.engine import LLMEngine
+from vllm_production_stack_tpu.engine.server import EngineServer
+from vllm_production_stack_tpu.router.app import build_app
+from vllm_production_stack_tpu.router.args import parse_args
+from vllm_production_stack_tpu.testing.fake_engine import FakeEngine
+from vllm_production_stack_tpu.tracing import (
+    NULL_TRACE,
+    TraceStore,
+    format_traceparent,
+    parse_traceparent,
+)
+
+pytestmark = pytest.mark.tracing
+
+
+# -- propagation unit layer --------------------------------------------------
+
+
+def test_parse_traceparent_valid():
+    tid, sid = "ab" * 16, "cd" * 8
+    assert parse_traceparent(f"00-{tid}-{sid}-01") == (tid, sid)
+    # flags/extra fields tolerated (future versions may append)
+    assert parse_traceparent(f"01-{tid}-{sid}-00-extra") == (tid, sid)
+    # case-normalized
+    assert parse_traceparent(f"00-{tid.upper()}-{sid}-01") == (tid, sid)
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        None,
+        "",
+        "garbage",
+        "00-short-beef-01",
+        "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",  # non-hex trace id
+        "00-" + "00" * 16 + "-" + "cd" * 8 + "-01",  # all-zero trace id
+        "00-" + "ab" * 16 + "-" + "00" * 8 + "-01",  # all-zero span id
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # forbidden version
+    ],
+)
+def test_parse_traceparent_malformed_dropped(header):
+    assert parse_traceparent(header) is None
+
+
+def test_format_roundtrip():
+    tid, sid = "12" * 16, "34" * 8
+    assert parse_traceparent(format_traceparent(tid, sid)) == (tid, sid)
+
+
+# -- TraceStore bounding / no-op layer ---------------------------------------
+
+
+def test_ring_buffer_bounded_under_flood():
+    store = TraceStore(capacity=16, enabled=True)
+    for i in range(500):
+        t = store.start(f"r{i}", "x")
+        store.finish(t)
+    payload = store.debug_payload()
+    assert payload["finished_buffered"] == 16
+    assert payload["started_total"] == 500
+    # newest survive
+    assert payload["recent"][0]["rid"] == "r499"
+
+
+def test_inflight_overflow_evicts_oldest_as_orphaned():
+    store = TraceStore(capacity=8, enabled=True)
+    traces = [store.start(f"r{i}", "x") for i in range(50)]  # never finished
+    assert len(store._inflight) <= 8 * TraceStore.INFLIGHT_FACTOR
+    assert store.dropped_inflight_total > 0
+    # evicted timelines surface in the (also bounded) ring, marked orphaned
+    ring_statuses = [t["status"] for t in store.debug_payload()["recent"]]
+    assert ring_statuses and set(ring_statuses) == {"orphaned"}
+    # survivors still finish normally
+    store.finish(traces[-1])
+    assert store.get("r49").root.status == "ok"
+
+
+def test_same_rid_collision_keeps_live_trace_inflight():
+    """Two concurrent requests reusing one client-supplied X-Request-Id:
+    finishing the first must not evict the still-running second from the
+    in-flight view (finish pops by identity, not by rid)."""
+    store = TraceStore(capacity=8, enabled=True)
+    first = store.start("dup", "x")
+    second = store.start("dup", "x")  # takes the in-flight slot
+    store.finish(first)
+    assert store.get("dup") is second  # in-flight wins over the ring
+    store.finish(second)
+    assert store.debug_payload()["finished_buffered"] == 2
+
+
+def test_disabled_store_is_noop():
+    store = TraceStore(capacity=8, enabled=False)
+    t = store.start("rid", "x", traceparent="00-" + "ab" * 16 + "-" + "cd" * 8 + "-01")
+    assert t is NULL_TRACE  # the no-op singleton: zero per-request allocation
+    t.event("whatever", k=1)
+    t.span("child").finish()
+    store.finish(t)
+    payload = store.debug_payload()
+    assert payload["finished_buffered"] == 0
+    assert payload["started_total"] == 0
+
+
+def test_finish_idempotent_and_span_event_cap():
+    store = TraceStore(capacity=4)
+    t = store.start("r", "x")
+    for i in range(t.root.MAX_EVENTS + 50):
+        t.event("e", i=i)
+    assert len(t.root.events) == t.root.MAX_EVENTS + 1
+    assert t.root.events[-1][1] == "events_truncated"
+    store.finish(t, status="ok")
+    store.finish(t, status="error:500")  # second finish must not re-file
+    assert store.debug_payload()["finished_buffered"] == 1
+    assert store.get("r").root.status == "ok"
+
+
+# -- engine: span timeline through a real tiny engine ------------------------
+
+
+@pytest.fixture(scope="module")
+def esrv():
+    return EngineServer(
+        LLMEngine(EngineConfig.tiny()), served_model_name="tiny-llama"
+    )
+
+
+def run_with_client(srv, coro_fn):
+    async def runner():
+        client = TestClient(TestServer(srv.build_app()))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+CALLER_TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+def test_engine_span_timeline_ordering(esrv):
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "tiny-llama", "prompt": [5, 6, 7, 8],
+                  "max_tokens": 6, "temperature": 0.0, "ignore_eos": True},
+            headers={"traceparent": CALLER_TP, "X-Request-Id": "trace-ord"},
+        )
+        assert r.status == 200
+        assert r.headers["X-Request-Id"] == "trace-ord"
+        d = await client.get("/debug/requests?rid=trace-ord")
+        return await d.json()
+
+    trace = run_with_client(esrv, go)
+    # caller-supplied traceparent: the engine JOINS that trace
+    assert trace["trace_id"] == "ab" * 16
+    spans = {s["name"]: s for s in trace["spans"]}
+    root = spans["engine.request"]
+    assert root["parent_id"] == "cd" * 8
+    q, p, dec = (
+        spans["engine.queue"], spans["engine.prefill"], spans["engine.decode"]
+    )
+    # queue → prefill → decode share exact phase boundaries, in order
+    assert q["start"] <= q["end"] == p["start"] <= p["end"] == dec["start"]
+    assert dec["start"] <= dec["end"]
+    # phase spans nest under the engine ingress span's window
+    assert root["start"] <= q["start"] and dec["end"] <= root["end"]
+    names = [e["name"] for e in root["events"]]
+    assert names[0] == "admitted" and "first_token" in names
+    assert dec["attrs"]["output_tokens"] == 6
+
+
+def test_engine_rollback_does_not_corrupt_attribution(esrv):
+    """A mid-window stop (max_tokens far below the decode window multiple)
+    forces the async pipeline to discard and roll back its speculatively
+    dispatched step. Attribution must describe only RESOLVED work: the
+    decode span's token count and the decode_window events must sum to
+    exactly the emitted completion tokens."""
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "tiny-llama", "prompt": [9, 10, 11],
+                  # 5 tokens with decode_window=2 (tiny cfg) stops mid-window
+                  "max_tokens": 5, "temperature": 0.0, "ignore_eos": True},
+            headers={"X-Request-Id": "trace-rb"},
+        )
+        body = await r.json()
+        d = await client.get("/debug/requests?rid=trace-rb")
+        return body, await d.json()
+
+    body, trace = run_with_client(esrv, go)
+    assert body["usage"]["completion_tokens"] == 5
+    spans = {s["name"]: s for s in trace["spans"]}
+    assert spans["engine.decode"]["attrs"]["output_tokens"] == 5
+    windows = [
+        e["attrs"]["tokens"]
+        for e in spans["engine.request"]["events"]
+        if e["name"] == "decode_window"
+    ]
+    assert sum(windows) == 5  # discarded speculative tokens never surface
+
+
+def test_engine_refusal_traced_and_stamped(esrv):
+    """A shed 429 must still return x-request-id and leave a refused
+    timeline (short-circuits are what timelines exist to explain)."""
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "nope", "prompt": [1], "max_tokens": 2},
+            headers={"X-Request-Id": "trace-404"},
+        )
+        return r.status
+
+    # unknown model 404s before tracing starts — use the draining path for
+    # a traced refusal instead
+    esrv.async_engine.accepting = False
+    try:
+        async def refused(client):
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "tiny-llama", "prompt": [1], "max_tokens": 2},
+                headers={"X-Request-Id": "trace-shed"},
+            )
+            assert r.status == 503
+            assert r.headers["X-Request-Id"] == "trace-shed"
+            d = await client.get("/debug/requests?rid=trace-shed")
+            return await d.json()
+
+        trace = run_with_client(esrv, refused)
+        assert trace["status"] == "refused:503"
+        assert any(
+            e["name"] == "refused"
+            for e in trace["spans"][0]["events"]
+        )
+    finally:
+        esrv.async_engine.accepting = True
+
+
+def test_engine_debug_requests_shape_and_histograms(esrv):
+    async def go(client):
+        d = await (await client.get("/debug/requests")).json()
+        one = await client.get("/debug/requests?rid=does-not-exist")
+        m = await (await client.get("/metrics")).text()
+        om = await (
+            await client.get("/metrics?format=openmetrics")
+        ).text()
+        return d, one.status, m, om
+
+    d, missing_status, metrics, om = run_with_client(esrv, go)
+    for key in ("recent", "slowest", "inflight", "finished_buffered",
+                "capacity", "enabled", "started_total"):
+        assert key in d
+    assert d["enabled"] is True
+    for brief in d["recent"]:
+        assert {"rid", "trace_id", "status", "duration_ms"} <= set(brief)
+    assert missing_status == 404
+    # contract histograms in the classic exposition, exact names
+    for name in ("tpu:request_ttft_seconds", "tpu:request_e2e_seconds",
+                 "tpu:request_queue_wait_seconds",
+                 "tpu:request_prefill_seconds",
+                 "tpu:request_decode_seconds"):
+        assert f"{name}_count" in metrics
+    # exemplars (trace ids) only in the explicit OpenMetrics exposition
+    assert "trace_id=" in om
+    assert "trace_id=" not in metrics
+
+
+def test_engine_tracing_disabled_noop_path():
+    """--request-tracing false: no timelines, but the latency histograms
+    still observe (metrics are not a debug feature)."""
+    srv = EngineServer(
+        LLMEngine(EngineConfig.tiny()), served_model_name="tiny-llama",
+        request_tracing=False,
+    )
+
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "tiny-llama", "prompt": [5, 6, 7],
+                  "max_tokens": 3, "temperature": 0.0, "ignore_eos": True},
+            headers={"X-Request-Id": "noop-1"},
+        )
+        assert r.status == 200
+        d = await (await client.get("/debug/requests")).json()
+        m = await (await client.get("/metrics")).text()
+        return d, m
+
+    d, metrics = run_with_client(srv, go)
+    assert d["enabled"] is False
+    assert d["finished_buffered"] == 0 and d["started_total"] == 0
+    assert 'tpu:request_e2e_seconds_count{model_name="tiny-llama"} 1.0' in metrics
+
+
+# -- router: propagation + /debug/requests + x-request-id everywhere ---------
+
+
+async def _router_rig(router_args=(), n_engines=1):
+    engines, servers = [], []
+    for _ in range(n_engines):
+        eng = FakeEngine(model="fake-model")
+        srv = TestServer(eng.build_app())
+        await srv.start_server()
+        engines.append(eng)
+        servers.append(srv)
+    urls = ",".join(f"http://127.0.0.1:{s.port}" for s in servers)
+    app = build_app(parse_args([
+        "--static-backends", urls,
+        "--static-models", ";".join(["fake-model"] * n_engines),
+        *router_args,
+    ]))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client, engines, servers
+
+
+def chat_body(**kw):
+    return {
+        "model": "fake-model",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 4,
+        **kw,
+    }
+
+
+def test_router_generates_and_propagates_traceparent():
+    async def go():
+        client, engines, servers = await _router_rig()
+        try:
+            r = await client.post("/v1/chat/completions", json=chat_body())
+            assert r.status == 200
+            rid = r.headers["X-Request-Id"]
+            seen = engines[0].seen_request_log[-1]["headers"]
+            # correlation id rides upstream (router-generated here)
+            assert seen["x-request-id"] == rid
+            tp = parse_traceparent(seen["traceparent"])
+            assert tp is not None
+            d = await (await client.get(f"/debug/requests?rid={rid}")).json()
+            # the engine's parent IS the router ingress span of this trace
+            assert d["trace_id"] == tp[0]
+            assert d["spans"][0]["span_id"] == tp[1]
+            events = [e["name"] for e in d["spans"][0]["events"]]
+            assert "route" in events and "first_byte" in events
+            assert "upstream_status" in events
+            return True
+        finally:
+            await client.close()
+            for s in servers:
+                await s.close()
+
+    assert asyncio.run(go())
+
+
+def test_router_joins_caller_supplied_trace():
+    async def go():
+        client, engines, servers = await _router_rig()
+        try:
+            r = await client.post(
+                "/v1/chat/completions", json=chat_body(),
+                headers={"traceparent": CALLER_TP, "X-Request-Id": "cli-1"},
+            )
+            assert r.status == 200
+            assert r.headers["X-Request-Id"] == "cli-1"
+            seen = engines[0].seen_request_log[-1]["headers"]
+            tid, parent = parse_traceparent(seen["traceparent"])
+            assert tid == "ab" * 16  # caller's trace id survives the hop
+            d = await (await client.get("/debug/requests?rid=cli-1")).json()
+            assert d["trace_id"] == "ab" * 16
+            # router root is a child of the CALLER's span; upstream parent
+            # is the router's own ingress span, not the caller's
+            assert d["spans"][0]["parent_id"] == "cd" * 8
+            assert parent == d["spans"][0]["span_id"]
+            return True
+        finally:
+            await client.close()
+            for s in servers:
+                await s.close()
+
+    assert asyncio.run(go())
+
+
+def test_router_request_id_on_every_error_path():
+    """401 auth refusals, 400 parse errors, and no-backend 503s must all
+    carry X-Request-Id — error short-circuits used to return without any
+    correlation id."""
+    async def go():
+        client, engines, servers = await _router_rig(
+            router_args=("--api-key", "sekrit")
+        )
+        try:
+            results = {}
+            r = await client.post("/v1/chat/completions", json=chat_body())
+            results["401"] = (r.status, "X-Request-Id" in r.headers)
+            auth = {"Authorization": "Bearer sekrit"}
+            r = await client.post(
+                "/v1/chat/completions", data=b"{nope", headers=auth
+            )
+            results["400"] = (r.status, "X-Request-Id" in r.headers)
+            r = await client.post(
+                "/v1/chat/completions",
+                json=chat_body(model="ghost-model"), headers=auth,
+            )
+            results["503"] = (r.status, "X-Request-Id" in r.headers)
+            # caller-supplied ids echo back even on refusals
+            r = await client.post(
+                "/v1/chat/completions", json=chat_body(),
+                headers={"X-Request-Id": "mine-1"},
+            )
+            results["echo"] = (r.status, r.headers.get("X-Request-Id"))
+            return results
+        finally:
+            await client.close()
+            for s in servers:
+                await s.close()
+
+    res = asyncio.run(go())
+    assert res["401"] == (401, True)
+    assert res["400"] == (400, True)
+    assert res["503"] == (503, True)
+    assert res["echo"] == (401, "mine-1")
+
+
+def test_router_tracing_disabled():
+    async def go():
+        client, engines, servers = await _router_rig(
+            router_args=("--request-tracing", "off")
+        )
+        try:
+            r = await client.post("/v1/chat/completions", json=chat_body())
+            assert r.status == 200
+            assert r.headers["X-Request-Id"]  # stamping is tracing-independent
+            seen = engines[0].seen_request_log[-1]["headers"]
+            assert "traceparent" not in seen  # no spine, no stamp
+            d = await (await client.get("/debug/requests")).json()
+            m = await (await client.get("/metrics")).text()
+            return d, m
+        finally:
+            await client.close()
+            for s in servers:
+                await s.close()
+
+    d, metrics = asyncio.run(go())
+    assert d["enabled"] is False and d["started_total"] == 0
+    # router-vantage latency histograms observe regardless
+    assert "tpu:request_e2e_seconds_count 1.0" in metrics
+
+
+# -- metrics-contract drift check (tier-1 CI teeth) --------------------------
+
+
+def test_metrics_contract_no_drift():
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+    )
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    )
+    sys.path.insert(0, tools_dir)
+    import check_metrics_contract
+
+    problems = check_metrics_contract.check()
+    assert problems == [], "\n".join(problems)

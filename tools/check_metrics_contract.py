@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Metrics-contract drift check (run in tier-1 via tests/test_tracing.py).
+
+Every name in `vllm_production_stack_tpu/metrics_contract.py` must be
+
+  (a) EXPORTED by at least one exporter — the engine's EngineMetrics or
+      the router's RouterMetrics registry (the KV controller re-renders a
+      subset of the router's names by hand and is covered by that union),
+  (b) REFERENCED somewhere an operator will find it — the Grafana
+      dashboard (observability/tpu-dashboard.json), the prometheus-adapter
+      rules, the KEDA trigger, or the docs.
+
+A name failing (a) is a dead contract entry (dashboards key off a series
+nobody emits); a name failing (b) is a silent metric (emitted telemetry
+nobody can discover). Both rotted unnoticed before this check existed —
+the PR 4 tenant series shipped with no dashboard representation.
+
+Exit code 0 = clean; 1 = drift, with one line per violation.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# files that count as "operator-discoverable" references
+REFERENCE_GLOBS = (
+    "observability/tpu-dashboard.json",
+    "observability/prom-adapter.yaml",
+    "observability/keda-scaledobject.yaml",
+    "docs",
+    "README.md",
+    "COMPONENTS.md",
+)
+
+
+def contract_names() -> list[str]:
+    from vllm_production_stack_tpu import metrics_contract as mc
+
+    return sorted(
+        {
+            v
+            for k, v in vars(mc).items()
+            if k.isupper() and isinstance(v, str) and v.startswith("tpu:")
+        }
+    )
+
+
+def exported_names() -> set[str]:
+    """Metric names (with the _total suffix counters carry in the
+    contract) present in the engine + router exporter registries."""
+    from vllm_production_stack_tpu.engine.metrics import EngineMetrics
+    from vllm_production_stack_tpu.router.metrics import RouterMetrics
+
+    names: set[str] = set()
+    for registry in (
+        EngineMetrics("contract-check").registry,
+        RouterMetrics().registry,
+    ):
+        for metric in registry.collect():
+            names.add(metric.name)
+            if metric.type == "counter":
+                # prometheus_client strips _total from counter base names;
+                # the contract spells it out
+                names.add(metric.name + "_total")
+    return names
+
+
+def reference_blob() -> str:
+    chunks: list[str] = []
+    for rel in REFERENCE_GLOBS:
+        path = os.path.join(REPO, rel)
+        if os.path.isfile(path):
+            with open(path, encoding="utf-8") as f:
+                chunks.append(f.read())
+        elif os.path.isdir(path):
+            for root, _, files in os.walk(path):
+                for name in files:
+                    if name.endswith((".md", ".json", ".yaml", ".yml")):
+                        with open(
+                            os.path.join(root, name), encoding="utf-8"
+                        ) as f:
+                            chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def check() -> list[str]:
+    """All drift violations, empty when the contract is clean."""
+    exported = exported_names()
+    refs = reference_blob()
+    problems: list[str] = []
+    for name in contract_names():
+        if name not in exported:
+            problems.append(
+                f"{name}: not exported by the engine or router exporter"
+            )
+        if name not in refs:
+            problems.append(
+                f"{name}: not referenced by the dashboard, adapter/KEDA "
+                "rules, or docs"
+            )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print(f"metrics-contract drift ({len(problems)} problems):")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(f"metrics contract clean ({len(contract_names())} names)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    sys.exit(main())

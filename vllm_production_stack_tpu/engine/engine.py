@@ -1135,6 +1135,23 @@ class LLMEngine:
             num_cached_prompt_tokens=req.num_cached_prompt_tokens,
         )
         out.text_delta = text
+        if out.finished:
+            # lifecycle stamps for the tracing spine's phase attribution —
+            # carried on the terminal output because the request state is
+            # reaped (_drop_finished) before the HTTP layer sees it.
+            # Rollback-safe by construction: outputs only ever describe
+            # RESOLVED steps (a discarded speculative dispatch never
+            # reaches postprocess, so no stamp can come from it).
+            out.phase_times = {
+                "arrival": req.arrival_time,
+                "first_seat": req.first_seat_time,
+                "first_token": req.first_token_time,
+                "finish": req.finish_time or time.monotonic(),
+                "prompt_tokens": req.num_prompt_tokens,
+                "output_tokens": len(req.output_token_ids),
+                "cached_prompt_tokens": req.num_cached_prompt_tokens,
+                "preemptions": req.num_preemptions,
+            }
         return out
 
     @staticmethod

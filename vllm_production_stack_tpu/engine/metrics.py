@@ -15,9 +15,22 @@ from prometheus_client import (
     Histogram,
     generate_latest,
 )
+from prometheus_client.openmetrics import exposition as om_exposition
 
 from .. import metrics_contract as mc
 from .engine import EngineStatsSnapshot
+
+OPENMETRICS_CONTENT_TYPE = om_exposition.CONTENT_TYPE_LATEST
+
+
+def wants_openmetrics(request) -> bool:
+    """/metrics?format=openmetrics serves the OpenMetrics exposition — the
+    one that renders histogram exemplars (trace ids on buckets). An
+    explicit query param, NOT Accept-header negotiation: OpenMetrics
+    forbids colons, so prometheus_client rewrites `tpu:` to `tpu_` there,
+    and honoring Prometheus's default Accept preference would silently
+    rename the whole scrape contract out from under every dashboard."""
+    return request.query.get("format") == "openmetrics"
 
 
 class EngineMetrics:
@@ -117,7 +130,68 @@ class EngineMetrics:
             ),
             registry=self.registry,
         )
+        # -- per-request phase histograms (docs/28-request-tracing.md):
+        # observed at request finish from the lifecycle stamps the tracing
+        # spine attributes, with trace-id exemplars (OpenMetrics exposition)
+        def phase_hist(name: str, doc: str) -> Histogram:
+            return Histogram(
+                name, doc, names,
+                buckets=mc.REQUEST_PHASE_BUCKETS, registry=self.registry,
+            )
+
+        self.request_ttft = phase_hist(
+            mc.REQUEST_TTFT, "Arrival to first generated token"
+        )
+        self.request_e2e = phase_hist(
+            mc.REQUEST_E2E, "Arrival to request finish"
+        )
+        self.request_queue_wait = phase_hist(
+            mc.REQUEST_QUEUE_WAIT, "Arrival to first scheduler seat"
+        )
+        self.request_prefill = phase_hist(
+            mc.REQUEST_PREFILL, "First seat to first generated token"
+        )
+        self.request_decode = phase_hist(
+            mc.REQUEST_DECODE, "First generated token to finish"
+        )
         self._counter_values: dict[str, int] = {}
+
+    @staticmethod
+    def phase_durations(phases: dict) -> dict[str, float]:
+        """{metric-suffix: seconds} from a terminal output's lifecycle
+        stamps (engine.RequestOutput.phase_times). Phases that never
+        happened (shed before a seat, no token before abort) are absent —
+        a refusal must not log a 0-second decode."""
+        arrival = phases.get("arrival")
+        seat = phases.get("first_seat")
+        first_tok = phases.get("first_token")
+        finish = phases.get("finish")
+        if arrival is None or finish is None:
+            return {}
+        out = {"e2e": max(0.0, finish - arrival)}
+        if seat is not None:
+            out["queue_wait"] = max(0.0, seat - arrival)
+        if first_tok is not None:
+            out["ttft"] = max(0.0, first_tok - arrival)
+            out["decode"] = max(0.0, finish - first_tok)
+            if seat is not None:
+                out["prefill"] = max(0.0, first_tok - seat)
+        return out
+
+    def observe_request(self, phases: dict, trace_id: str | None = None) -> None:
+        """Feed one finished request's phase durations into the contract
+        histograms, tagging each bucket with the trace id as an exemplar
+        so a dashboard outlier links straight to /debug/requests?rid=."""
+        exemplar = {"trace_id": trace_id} if trace_id else None
+        hists = {
+            "ttft": self.request_ttft,
+            "e2e": self.request_e2e,
+            "queue_wait": self.request_queue_wait,
+            "prefill": self.request_prefill,
+            "decode": self.request_decode,
+        }
+        for key, seconds in self.phase_durations(phases).items():
+            hists[key].labels(**self._labels).observe(seconds, exemplar=exemplar)
 
     def update(self, s: EngineStatsSnapshot) -> None:
         lb = self._labels
@@ -177,6 +251,10 @@ class EngineMetrics:
             counter.labels(**labels).inc(total - prev)
             self._counter_values[key] = total
 
-    def render(self, s: EngineStatsSnapshot) -> bytes:
+    def render(
+        self, s: EngineStatsSnapshot, openmetrics: bool = False
+    ) -> bytes:
         self.update(s)
+        if openmetrics:
+            return om_exposition.generate_latest(self.registry)
         return generate_latest(self.registry)

@@ -87,6 +87,13 @@ class Request:
     # tokens whose KV is resident (prefix-cache hits + computed prefill/decode)
     num_computed_tokens: int = 0
     num_cached_prompt_tokens: int = 0  # prefix-cache hits at admission
+    # lifecycle stamps (time.monotonic()) behind the tracing spine's phase
+    # attribution (docs/28-request-tracing.md): queue wait = first_seat -
+    # arrival, prefill = first_token - first_seat, decode = finish -
+    # first_token. first_seat_time is the FIRST admission only — a
+    # preempted request re-admitting keeps its original stamp, so phases
+    # describe the caller-visible lifecycle, not scheduler churn.
+    first_seat_time: float | None = None
     first_token_time: float | None = None
     finish_time: float | None = None
     num_preemptions: int = 0
@@ -160,3 +167,9 @@ class RequestOutput:
     # aligned with new_token_ids when the request asked for logprobs:
     # one (chosen_logprob, top_ids, top_logprobs) triple per token
     new_logprobs: list[tuple[float, list[int], list[float]]] | None = None
+    # set on the TERMINAL output only: the request's lifecycle stamps
+    # (time.monotonic(): arrival/first_seat/first_token/finish, None where
+    # a phase never happened) — the HTTP layer turns these into trace
+    # phase spans and the tpu:request_* histograms without reaching back
+    # into engine state that _drop_finished already reaped
+    phase_times: dict | None = None

@@ -554,10 +554,11 @@ class Scheduler:
                 # request, and its wait was already observed)
                 import time as _time
 
+                req.first_seat_time = _time.monotonic()
                 self.accounting.inc(req.tenant_id, "requests")
                 self.accounting.observe_wait(
                     req.tenant_id,
-                    max(0.0, _time.monotonic() - req.arrival_time),
+                    max(0.0, req.first_seat_time - req.arrival_time),
                 )
             req.status = RequestStatus.RUNNING
             self.running.append(req)

@@ -39,7 +39,8 @@ from .engine import (
     EngineOverloadedError,
     LLMEngine,
 )
-from .metrics import EngineMetrics
+from ..tracing import TraceStore, mono_to_epoch
+from .metrics import EngineMetrics, OPENMETRICS_CONTENT_TYPE, wants_openmetrics
 from .protocol import (
     ChatCompletionRequest,
     CompletionRequest,
@@ -98,11 +99,24 @@ class _StreamUnsupported(Exception):
 
 class EngineServer:
     def __init__(self, engine: LLMEngine, served_model_name: str | None = None,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0, request_tracing: bool = True,
+                 trace_buffer: int = 256):
         self.engine = engine
         self.async_engine = AsyncEngine(engine)
         self.model_name = served_model_name or engine.config.model.model
         self.metrics = EngineMetrics(self.model_name)
+        # request-tracing spine (docs/28-request-tracing.md): per-request
+        # span timelines joined to the router's trace via the inbound
+        # traceparent header, served by /debug/requests. Disabled
+        # (--request-tracing false) it degrades to the NULL_TRACE no-op
+        # path; the tpu:request_* histograms are observed either way.
+        self.traces = TraceStore(
+            capacity=trace_buffer, enabled=request_tracing,
+            service="tpu-engine",
+        )
+        # on-demand xprof capture (/debug/profile/start|stop): the dir of
+        # the live jax.profiler trace, None when not capturing
+        self._profile_dir: str | None = None
         self._session = None  # lazy outbound ClientSession (kv_pull)
         self.kv_event_publisher = None  # started when KV_CONTROLLER_URL set
         self._tok_repr_cache: dict[int, tuple[str, list[int]]] = {}
@@ -140,6 +154,9 @@ class EngineServer:
         r.add_post("/drain", self.drain)
         r.add_get("/metrics", self.metrics_endpoint)
         r.add_get("/debug/timing", self.debug_timing)
+        r.add_get("/debug/requests", self.debug_requests)
+        r.add_post("/debug/profile/start", self.debug_profile_start)
+        r.add_post("/debug/profile/stop", self.debug_profile_stop)
         r.add_post("/sleep", self.sleep)
         r.add_post("/wake_up", self.wake_up)
         r.add_get("/is_sleeping", self.is_sleeping)
@@ -305,6 +322,89 @@ class EngineServer:
             return deadline, tenant, self._admission_error(e)
         return deadline, tenant, None
 
+    # -- request tracing (docs/28-request-tracing.md) ----------------------
+
+    def _trace_start(self, request: web.Request, rid: str, **attrs):
+        """Open the engine-side timeline for one HTTP request, joining the
+        router's trace via the inbound W3C traceparent header (a request
+        without one starts a fresh engine-local trace)."""
+        return self.traces.start(
+            rid, "engine.request",
+            traceparent=request.headers.get("traceparent"),
+            attrs={"path": request.path, "model": self.model_name, **attrs},
+        )
+
+    def _trace_refused(self, trace, resp, rid: str):
+        """Admission refusals (429 shed / 503 deadline / 503 draining) end
+        the timeline immediately — short-circuits are exactly the requests
+        a timeline must explain, and every refusal carries the correlation
+        id the router's access log will echo."""
+        trace.event("refused", status=resp.status)
+        self.traces.finish(trace, status=f"refused:{resp.status}")
+        resp.headers.setdefault("X-Request-Id", rid)
+        return resp
+
+    def _trace_respond(self, trace, resp, rid: str):
+        """Terminal bookkeeping for a non-streaming response: stamp the
+        correlation id and close the timeline with the HTTP outcome."""
+        self.traces.finish(
+            trace,
+            status="ok" if resp.status < 400 else f"error:{resp.status}",
+        )
+        resp.headers.setdefault("X-Request-Id", rid)
+        return resp
+
+    def _trace_output(self, trace, out, choice: int = 0) -> None:
+        """Record one resolved step's delta; on the terminal output, turn
+        the request's lifecycle stamps into queue/prefill/decode phase
+        spans and feed the tpu:request_* histograms. Rollback-safe: the
+        engine only emits outputs for RESOLVED steps, so a discarded
+        speculative dispatch can never appear here."""
+        if out.new_token_ids:
+            if out.num_output_tokens == len(out.new_token_ids):
+                trace.event("first_token", choice=choice)
+            trace.event(
+                "decode_window", tokens=len(out.new_token_ids), choice=choice
+            )
+        if not out.finished:
+            return
+        # getattr: error outputs (and RequestOutput-shaped test doubles)
+        # carry no lifecycle to attribute
+        pt = getattr(out, "phase_times", None)
+        if not pt:
+            return
+        # ONE monotonic→epoch anchor for the whole timeline: converting
+        # each stamp independently (mono_to_epoch per call) drifts the
+        # shared phase boundaries apart by float noise
+        anchor = mono_to_epoch(0.0)
+        finish_e = anchor + pt["finish"]
+        arrival_e = anchor + pt["arrival"]
+        seat = pt.get("first_seat")
+        ftok = pt.get("first_token")
+        trace.span(
+            "engine.queue", start=arrival_e,
+            end=anchor + seat if seat is not None else finish_e,
+            choice=choice,
+        )
+        if seat is not None:
+            trace.span(
+                "engine.prefill", start=anchor + seat,
+                end=anchor + ftok if ftok is not None else finish_e,
+                choice=choice,
+                prompt_tokens=pt["prompt_tokens"],
+                cached_prompt_tokens=pt["cached_prompt_tokens"],
+            )
+        if ftok is not None:
+            trace.span(
+                "engine.decode", start=anchor + ftok, end=finish_e,
+                choice=choice, output_tokens=pt["output_tokens"],
+                finish_reason=out.finish_reason or "",
+                preemptions=pt["preemptions"],
+            )
+        # the contract histograms observe REGARDLESS of the tracing flag —
+        # latency metrics are not a debug feature
+        self.metrics.observe_request(pt, trace.trace_id or None)
+
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         try:
             body = ChatCompletionRequest.model_validate(await request.json())
@@ -330,19 +430,25 @@ class EngineServer:
         if (err := self._check_logprobs(sampling)) is not None:
             return err
         rid = request.headers.get("X-Request-Id") or random_id("chatcmpl")
+        trace = self._trace_start(
+            request, rid, stream=bool(body.stream), n=body.n,
+        )
         deadline, tenant, refused = self._gate_admission(request)
         if refused is not None:
-            return refused
+            return self._trace_refused(trace, refused, rid)
+        if tenant is not None:
+            trace.set(tenant=tenant.tenant_id, priority=tenant.priority)
+        trace.event("admitted")
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=True,
                 lora_name=lora_name, parse_tools=use_tools, n=body.n,
-                deadline=deadline, tenant=tenant,
+                deadline=deadline, tenant=tenant, trace=trace,
             )
         return await self._complete(
             rid, prompt, sampling, chat=True, lora_name=lora_name,
             parse_tools=use_tools, n=body.n, deadline=deadline,
-            tenant=tenant,
+            tenant=tenant, trace=trace,
         )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
@@ -378,19 +484,26 @@ class EngineServer:
                 )
             )
         rid = request.headers.get("X-Request-Id") or random_id("cmpl")
+        trace = self._trace_start(
+            request, rid, stream=bool(body.stream), n=body.n,
+        )
         deadline, tenant, refused = self._gate_admission(request)
         if refused is not None:
-            return refused
+            return self._trace_refused(trace, refused, rid)
+        if tenant is not None:
+            trace.set(tenant=tenant.tenant_id, priority=tenant.priority)
+        trace.event("admitted")
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=False,
                 prompt_ids=prompt_ids, lora_name=lora_name, n=body.n,
                 echo_text=echo_text, deadline=deadline, tenant=tenant,
+                trace=trace,
             )
         return await self._complete(
             rid, prompt, sampling, chat=False, prompt_ids=prompt_ids,
             lora_name=lora_name, n=body.n, echo_text=echo_text,
-            deadline=deadline, tenant=tenant,
+            deadline=deadline, tenant=tenant, trace=trace,
         )
 
     async def embeddings(self, request: web.Request) -> web.Response:
@@ -685,7 +798,8 @@ class EngineServer:
         return dataclasses.replace(sampling, seed=sampling.seed + i)
 
     async def _run_single(self, rid, prompt, sampling, prompt_ids, lora_name,
-                          deadline=None, parent_rid=None, tenant=None):
+                          deadline=None, parent_rid=None, tenant=None,
+                          trace=None, choice=0):
         """One full generation; returns the accumulated result dict.
         parent_rid (the HTTP request's base id) exempts sibling choices of
         the same n>1 request from this submission's admission count — a
@@ -707,6 +821,8 @@ class EngineServer:
                 lp_entries.extend(out.new_logprobs)
             finish_reason = out.finish_reason
             n_prompt = out.num_prompt_tokens
+            if trace is not None:
+                self._trace_output(trace, out, choice)
         return {
             "text": text, "token_ids": token_ids, "lp": lp_entries,
             "finish_reason": finish_reason, "n_prompt": n_prompt,
@@ -716,8 +832,10 @@ class EngineServer:
         self, rid, prompt, sampling, *, chat: bool, prompt_ids=None,
         lora_name=None, parse_tools: bool = False, n: int = 1,
         echo_text: str | None = None, deadline: float | None = None,
-        tenant=None,
+        tenant=None, trace=None,
     ) -> web.Response:
+        if trace is None:
+            trace = self.traces.start(rid, "engine.request")
         # n>1: concurrent submissions — continuous batching runs them in
         # one batch and the prefix cache dedups the shared prompt, so the
         # marginal cost per extra choice is its decode tokens only.
@@ -732,6 +850,7 @@ class EngineServer:
                 crid, prompt,
                 self._nth_sampling(sampling, i), prompt_ids, lora_name,
                 deadline, parent_rid=rid, tenant=tenant,
+                trace=trace, choice=i,
             ))
             for i, crid in enumerate(self._choice_rids(rid, n))
         ]
@@ -743,15 +862,22 @@ class EngineServer:
                     t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
             if (resp := self._admission_error(e)) is not None:
-                return resp  # raced past the handler's gate: same mapping
+                # raced past the handler's gate: same mapping
+                return self._trace_respond(trace, resp, rid)
             if isinstance(e, ValueError):
-                return error(400, str(e))
+                return self._trace_respond(trace, error(400, str(e)), rid)
             if isinstance(e, EngineSleepingError):
-                return error(503, str(e), "service_unavailable")
-            return error(500, str(e), "internal_error")
+                return self._trace_respond(
+                    trace, error(503, str(e), "service_unavailable"), rid
+                )
+            return self._trace_respond(
+                trace, error(500, str(e), "internal_error"), rid
+            )
         for r in runs:
             if r["finish_reason"] == "error":
-                return error(500, r["text"], "internal_error")
+                return self._trace_respond(
+                    trace, error(500, r["text"], "internal_error"), rid
+                )
             if r["finish_reason"] == "shed" and not r["token_ids"]:
                 # evicted from the waiting queue by a higher-priority
                 # admission before producing anything: same HTTP shape as
@@ -761,11 +887,16 @@ class EngineServer:
 
                 waiting, queued = self.engine.queue_depth()
                 retry = self.engine.estimate_retry_after_s(queued)
-                return error(
-                    429,
-                    "request shed for a higher-priority admission; retry",
-                    "overloaded",
-                    headers={"Retry-After": str(int(math.ceil(retry)))},
+                return self._trace_refused(
+                    trace,
+                    error(
+                        429,
+                        "request shed for a higher-priority admission; "
+                        "retry",
+                        "overloaded",
+                        headers={"Retry-After": str(int(math.ceil(retry)))},
+                    ),
+                    rid,
                 )
         created = int(time.time())
         choices = []
@@ -799,27 +930,31 @@ class EngineServer:
                         r["token_ids"], r["lp"], sampling.logprobs
                     )
             choices.append(choice)
-        return web.json_response(
-            {
-                "id": rid,
-                "object": "chat.completion" if chat else "text_completion",
-                "created": created,
-                "model": self.model_name,
-                "system_fingerprint": self.system_fingerprint,
-                "choices": choices,
-                # prompt counted once; completion tokens sum over choices
-                "usage": usage(
-                    runs[0]["n_prompt"],
-                    sum(len(r["token_ids"]) for r in runs),
-                ),
-            }
+        return self._trace_respond(
+            trace,
+            web.json_response(
+                {
+                    "id": rid,
+                    "object": "chat.completion" if chat else "text_completion",
+                    "created": created,
+                    "model": self.model_name,
+                    "system_fingerprint": self.system_fingerprint,
+                    "choices": choices,
+                    # prompt counted once; completion tokens sum over choices
+                    "usage": usage(
+                        runs[0]["n_prompt"],
+                        sum(len(r["token_ids"]) for r in runs),
+                    ),
+                }
+            ),
+            rid,
         )
 
     async def _stream(
         self, request, rid, prompt, sampling, body, *, chat: bool,
         prompt_ids=None, lora_name=None, parse_tools: bool = False,
         n: int = 1, echo_text: str | None = None,
-        deadline: float | None = None, tenant=None,
+        deadline: float | None = None, tenant=None, trace=None,
     ) -> web.StreamResponse:
         """SSE streaming for 1..n choices — ONE implementation (n=1 is a
         single pump), so single- and parallel-sampling semantics can never
@@ -829,8 +964,13 @@ class EngineServer:
         token-bearing step emits a chunk, even when detok held the text
         back — first-token latency is only observable if the first token's
         chunk actually goes out."""
+        if trace is None:
+            trace = self.traces.start(rid, "engine.request")
         if self.async_engine.is_sleeping:
-            return error(503, "engine is sleeping", "service_unavailable")
+            return self._trace_respond(
+                trace, error(503, "engine is sleeping", "service_unavailable"),
+                rid,
+            )
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -911,6 +1051,7 @@ class EngineServer:
                     continue
                 n_prompt = out.num_prompt_tokens
                 n_out_total += len(out.new_token_ids)
+                self._trace_output(trace, out, choice=i)
                 if out.finish_reason == "error":
                     # same dedup as pump exceptions: a step-thread death
                     # stamps the identical message into every choice
@@ -962,11 +1103,15 @@ class EngineServer:
             # so abort(rids[i]) could kill a DIFFERENT live request that
             # owns that name. The finally-cancel below reaches generate()'s
             # own cleanup, which aborts under the TRUE engine-side id.
+            self.traces.finish(trace, status="disconnected")
             return resp
         finally:
             for t in tasks:
                 if not t.done():
                     t.cancel()
+            self.traces.finish(
+                trace, status="error:stream" if sent_errors else "ok"
+            )
         if include_usage:
             final = self._chunk(rid, obj, created, None, None)
             final["choices"] = []
@@ -1122,8 +1267,69 @@ class EngineServer:
         return web.json_response({"status": "ok"})
 
     async def metrics_endpoint(self, request: web.Request) -> web.Response:
-        payload = self.metrics.render(await self.async_engine.stats_async())
+        om = wants_openmetrics(request)
+        payload = self.metrics.render(
+            await self.async_engine.stats_async(), openmetrics=om
+        )
+        if om:
+            # full content-type (incl. version params) — aiohttp's
+            # content_type= kwarg rejects parameters
+            return web.Response(
+                body=payload,
+                headers={"Content-Type": OPENMETRICS_CONTENT_TYPE},
+            )
         return web.Response(body=payload, content_type="text/plain")
+
+    async def debug_requests(self, request: web.Request) -> web.Response:
+        """Tracing spine introspection (docs/28-request-tracing.md):
+        recent / slowest / in-flight request timelines; ?rid= returns one
+        full trace (every span + event) as JSON."""
+        payload, status = self.traces.debug_response(request.query)
+        return web.json_response(payload, status=status)
+
+    async def debug_profile_start(self, request: web.Request) -> web.Response:
+        """On-demand xprof capture on a live engine: wraps
+        jax.profiler.start_trace so a slow phase seen in /debug/requests
+        or /debug/timing can be drilled into at the device level without
+        restarting the pod. Load the dump in XProf/TensorBoard."""
+        body = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except json.JSONDecodeError:
+                return error(400, "body must be JSON (or empty)")
+        log_dir = (body or {}).get("dir") or "/tmp/tpu-xprof"
+        if self._profile_dir is not None:
+            return error(
+                409, f"already profiling to {self._profile_dir}", "conflict"
+            )
+        try:
+            import jax
+
+            jax.profiler.start_trace(log_dir)
+        except ImportError:
+            return error(501, "jax is not available in this process")
+        except Exception as e:  # another tracer already active, bad dir...
+            return error(409, f"profiler refused to start: {e}", "conflict")
+        self._profile_dir = log_dir
+        logger.info("xprof capture started -> %s", log_dir)
+        return web.json_response({"status": "profiling", "dir": log_dir})
+
+    async def debug_profile_stop(self, request: web.Request) -> web.Response:
+        if self._profile_dir is None:
+            return error(409, "no profile capture in progress", "conflict")
+        log_dir, self._profile_dir = self._profile_dir, None
+        try:
+            import jax
+
+            # stop_trace flushes the dump to disk — do it off the loop
+            await asyncio.get_running_loop().run_in_executor(
+                None, jax.profiler.stop_trace
+            )
+        except Exception as e:
+            return error(500, f"profiler stop failed: {e}", "internal_error")
+        logger.info("xprof capture stopped (%s)", log_dir)
+        return web.json_response({"status": "stopped", "dir": log_dir})
 
     async def debug_timing(self, request: web.Request) -> web.Response:
         """Served-stack profiling: where the step thread's wall time goes
@@ -1489,6 +1695,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "in-flight streams get this long to finish before "
                         "the KV flush + deregister + exit proceed anyway — "
                         "keep below terminationGracePeriodSeconds")
+    p.add_argument("--request-tracing", default=True, type=_parse_bool_flag,
+                   help="per-request span timelines (docs/28-request-"
+                        "tracing.md): admission, queue wait, prefill, "
+                        "per-decode-window events, joined to the router's "
+                        "trace via the inbound traceparent header and "
+                        "served by /debug/requests. 'false' keeps only "
+                        "the tpu:request_* latency histograms")
+    p.add_argument("--trace-buffer", type=int, default=256,
+                   help="finished request timelines kept in the in-process "
+                        "ring buffer behind /debug/requests")
     p.add_argument("--prefill-buckets", default="",
                    help="comma-separated prefill chunk buckets (default: "
                         "pow2 ladder up to --max-num-batched-tokens). "
@@ -1698,6 +1914,8 @@ def main(argv: list[str] | None = None) -> None:
         engine,
         served_model_name=args.served_model_name,
         drain_timeout_s=args.drain_timeout_s,
+        request_tracing=args.request_tracing,
+        trace_buffer=args.trace_buffer,
     )
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)

@@ -77,6 +77,36 @@ TENANT_ROUTER_COUNTERS = (
     TENANT_THROTTLED,
 )
 
+# -- per-request latency histograms (docs/28-request-tracing.md) ------------
+# Observed at request finish from the tracing spine's phase attribution,
+# with trace-id exemplars (visible under the OpenMetrics exposition —
+# GET /metrics?format=openmetrics; deliberately a query param, not Accept
+# negotiation, because OpenMetrics rewrites the `tpu:` prefix to `tpu_`
+# and honoring Prometheus's default Accept would rename the whole scrape
+# contract). The ENGINE exports
+# all five (its clock sees the whole lifecycle: admission → first seat →
+# first token → finish); the ROUTER exports TTFT and E2E from its own
+# vantage (client-visible latency, including routing + proxy overhead).
+REQUEST_TTFT = "tpu:request_ttft_seconds"
+REQUEST_E2E = "tpu:request_e2e_seconds"
+REQUEST_QUEUE_WAIT = "tpu:request_queue_wait_seconds"
+REQUEST_PREFILL = "tpu:request_prefill_seconds"
+REQUEST_DECODE = "tpu:request_decode_seconds"
+
+REQUEST_PHASE_HISTOGRAMS = (
+    REQUEST_TTFT,
+    REQUEST_E2E,
+    REQUEST_QUEUE_WAIT,
+    REQUEST_PREFILL,
+    REQUEST_DECODE,
+)
+# shared boundaries wherever a phase histogram lives (router and engine
+# export the same names; dashboards aggregate across both)
+REQUEST_PHASE_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
 # -- cluster KV index (event-driven KV-aware routing) -----------------------
 # Exported by the KV controller's /metrics and re-exported by the router in
 # embedded-index mode (router/metrics.py). NOT part of the per-engine scrape

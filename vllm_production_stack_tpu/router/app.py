@@ -21,11 +21,13 @@ import asyncio
 import hmac
 import json
 import time
+import uuid
 
 from aiohttp import web
 
 from ..qos import TenantTable
 from ..qos.gate import TENANT_REQUEST_KEY, QoSGate
+from ..tracing import TraceStore
 from ..utils.logging import init_logger
 from ..utils.tokenizer import hashing_tokenizer
 from .breaker import BreakerBoard
@@ -35,7 +37,7 @@ from .dynamic_config import DynamicConfigWatcher
 from .engine_stats import EngineStatsScraper
 from .feature_gates import FeatureGates
 from .metrics import RouterMetrics
-from .request_service import RequestService
+from .request_service import RID_KEY, RequestService
 from .request_stats import RequestStatsMonitor
 from .rewriter import make_rewriter
 from .routing import make_policy
@@ -60,6 +62,15 @@ class RouterState:
             args.engine_stats_interval,
         )
         self.metrics = RouterMetrics()
+        # request-tracing spine (docs/28-request-tracing.md): per-request
+        # span timelines (routing decision, failover attempts, QoS
+        # verdicts, upstream TTFB) served by /debug/requests and joined
+        # to the engines' spans via the propagated traceparent header
+        self.traces = TraceStore(
+            capacity=getattr(args, "trace_buffer", 512),
+            enabled=getattr(args, "request_tracing", "on") != "off",
+            service="tpu-router",
+        )
         # per-endpoint circuit breakers (router/breaker.py): consecutive
         # upstream failures exclude an endpoint from policy picks until a
         # half-open probe succeeds
@@ -231,6 +242,49 @@ def _unauthorized() -> web.Response:
     )
 
 
+# request paths whose completions land in the structured access log (probe
+# endpoints would flood it — /health, /metrics, /ready poll every few
+# seconds; their failures still log via the status>=400 clause below)
+_ACCESS_LOGGED_PREFIXES = ("/v1", "/tokenize", "/detokenize")
+
+
+@web.middleware
+async def request_id_middleware(request: web.Request, handler):
+    """Outermost middleware: every response — including 401s from the auth
+    middleware, tenant-throttle 429s, shed 429s, and breaker-exhausted
+    503s — carries an `X-Request-Id` (echoed from the caller or generated
+    here), and API-path completions emit one structured access-log line
+    keyed on it. Error short-circuits used to return with no correlation
+    id at all, making them the one class of response a caller could not
+    report usefully."""
+    rid = request.headers.get("X-Request-Id") or uuid.uuid4().hex
+    # the same slot request_service uses — the proxy wrapper reuses this
+    # id for its trace and upstream stamp instead of minting another
+    request[RID_KEY] = rid
+    t0 = time.monotonic()
+    try:
+        resp = await handler(request)
+    except web.HTTPException as e:
+        e.headers.setdefault("X-Request-Id", rid)
+        raise
+    if not resp.prepared:
+        # streamed responses already sent their headers (stamped by the
+        # proxy path before prepare); everything else is stamped here
+        resp.headers.setdefault("X-Request-Id", rid)
+    if (
+        request.path.startswith(_ACCESS_LOGGED_PREFIXES)
+        or resp.status >= 400
+    ):
+        tenant = request.get(TENANT_REQUEST_KEY)
+        logger.info(
+            "access rid=%s method=%s path=%s status=%d dur_ms=%.1f%s",
+            rid, request.method, request.path, resp.status,
+            (time.monotonic() - t0) * 1e3,
+            f" tenant={tenant.tenant_id}" if tenant is not None else "",
+        )
+    return resp
+
+
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
     """Bearer auth + tenant resolution. Every comparison is
@@ -355,11 +409,28 @@ async def handle_health(request: web.Request) -> web.Response:
 
 async def handle_metrics(request: web.Request) -> web.Response:
     state = _state(request)
+    from ..engine.metrics import OPENMETRICS_CONTENT_TYPE, wants_openmetrics
+
+    if wants_openmetrics(request):
+        # the exposition that renders exemplars (trace ids on the
+        # tpu:request_* histogram buckets); explicit opt-in only — see
+        # engine.metrics.wants_openmetrics on why not Accept-negotiated
+        return web.Response(
+            body=state.metrics.render(state, openmetrics=True),
+            headers={"Content-Type": OPENMETRICS_CONTENT_TYPE},
+        )
     return web.Response(
         body=state.metrics.render(state),
         content_type="text/plain",
         charset="utf-8",
     )
+
+
+async def handle_debug_requests(request: web.Request) -> web.Response:
+    """Tracing-spine introspection (docs/28-request-tracing.md): recent /
+    slowest / in-flight request timelines; ?rid= returns one full trace."""
+    payload, status = _state(request).traces.debug_response(request.query)
+    return web.json_response(payload, status=status)
 
 
 async def handle_version(request: web.Request) -> web.Response:
@@ -435,7 +506,12 @@ OPENAI_PROXY_PATHS = (
 
 def build_app(args) -> web.Application:
     state = RouterState(args)
-    app = web.Application(middlewares=[auth_middleware], client_max_size=64 * 2**20)
+    # request_id_middleware OUTERMOST: auth 401s and every other
+    # short-circuit must still come back stamped with X-Request-Id
+    app = web.Application(
+        middlewares=[request_id_middleware, auth_middleware],
+        client_max_size=64 * 2**20,
+    )
     app["state"] = state
 
     for path in OPENAI_PROXY_PATHS:
@@ -444,6 +520,7 @@ def build_app(args) -> web.Application:
     app.router.add_get("/engines", handle_engines)
     app.router.add_get("/health", handle_health)
     app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/debug/requests", handle_debug_requests)
     app.router.add_get("/version", handle_version)
     app.router.add_post("/sleep", handle_sleep)
     app.router.add_post("/wake_up", handle_wake)

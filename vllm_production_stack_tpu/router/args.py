@@ -164,6 +164,19 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--sentry-dsn", default=None,
                    help="enable Sentry error reporting (requires sentry-sdk)")
     x.add_argument("--sentry-traces-sample-rate", type=float, default=0.0)
+    x.add_argument(
+        "--request-tracing", choices=["on", "off"], default="on",
+        help="per-request span timelines (docs/28-request-tracing.md): "
+             "routing decision, failover attempts, QoS verdicts, upstream "
+             "TTFB — joined to the engines' spans via the propagated W3C "
+             "traceparent header and served by /debug/requests. 'off' "
+             "keeps only the tpu:request_* latency histograms",
+    )
+    x.add_argument(
+        "--trace-buffer", type=int, default=512,
+        help="finished request timelines kept in the in-process ring "
+             "buffer behind /debug/requests",
+    )
     x.add_argument("--enable-batch-api", action="store_true")
     x.add_argument("--files-dir", default="/tmp/tpu_router_files")
     x.add_argument("--batch-db", default="/tmp/tpu_router_batch.sqlite")

@@ -138,6 +138,36 @@ class RouterMetrics:
             "prompt_tokens": self.tenant_prompt_tokens,
             "throttled": self.tenant_throttled,
         }
+        # per-request latency histograms (docs/28-request-tracing.md):
+        # the ROUTER's vantage of the shared contract names — client-
+        # visible TTFT/E2E including routing + proxy overhead (the engine
+        # exports the same names plus queue/prefill/decode from its own
+        # clock). Observed with trace-id exemplars at request finish.
+        self.request_ttft = Histogram(
+            mc.REQUEST_TTFT,
+            "Request arrival at the router to first upstream byte",
+            buckets=mc.REQUEST_PHASE_BUCKETS,
+            registry=self.registry,
+        )
+        self.request_e2e = Histogram(
+            mc.REQUEST_E2E,
+            "Request arrival at the router to response completion",
+            buckets=mc.REQUEST_PHASE_BUCKETS,
+            registry=self.registry,
+        )
+
+    def observe_request(
+        self,
+        ttft: float | None,
+        e2e: float,
+        trace_id: str | None = None,
+    ) -> None:
+        """One served request's router-vantage latencies; the exemplar
+        links a dashboard outlier straight to /debug/requests?rid=."""
+        exemplar = {"trace_id": trace_id} if trace_id else None
+        if ttft is not None:
+            self.request_ttft.observe(max(0.0, ttft), exemplar=exemplar)
+        self.request_e2e.observe(max(0.0, e2e), exemplar=exemplar)
 
     def _render_kv_index(self, policy) -> None:
         index = getattr(policy, "index", None)
@@ -154,7 +184,7 @@ class RouterMetrics:
                 self.kv_lookups.labels(mode=mode).inc()
                 self.kv_lookup_latency.labels(mode=mode).observe(seconds)
 
-    def render(self, state) -> bytes:
+    def render(self, state, openmetrics: bool = False) -> bytes:
         self._render_kv_index(state.policy)
         qos = getattr(state, "qos", None)
         if qos is not None:
@@ -188,4 +218,8 @@ class RouterMetrics:
                 if e.healthy and not e.sleeping
             )
         )
+        if openmetrics:
+            from prometheus_client.openmetrics import exposition as om
+
+            return om.generate_latest(self.registry)
         return generate_latest(self.registry)

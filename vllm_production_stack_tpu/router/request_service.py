@@ -20,8 +20,21 @@ import aiohttp
 from aiohttp import web
 
 from ..qos.gate import STAMP_HEADERS, TENANT_REQUEST_KEY
+from ..tracing import NULL_TRACE, TRACEPARENT_HEADER
 from ..utils.logging import init_logger
 from .routing import DisaggregatedPrefillPolicy, RoutingContext, qps_min_url
+
+# per-request slots on the aiohttp request (the router's correlation
+# state): the id stamped on every response and forwarded upstream, the
+# tracing-spine timeline, and the first-upstream-byte stamp feeding the
+# tpu:request_ttft_seconds histogram
+RID_KEY = "tpu_request_id"
+TRACE_KEY = "tpu_trace"
+TTFB_KEY = "tpu_first_byte_mono"
+# set by _sever: the response LOOKS like a 200 (headers already went out)
+# but the client saw a truncated transfer — the trace must say "severed"
+# and the latency histograms must not count it as served
+SEVERED_KEY = "tpu_severed"
 
 
 class UpstreamConnectError(Exception):
@@ -151,6 +164,11 @@ class RequestService:
         verdict = qos.try_admit(tenant, body)
         if verdict is None:
             return tenant, None
+        request.get(TRACE_KEY, NULL_TRACE).event(
+            "tenant_throttled", tenant=verdict.tenant_id,
+            reason=verdict.reason,
+            retry_after_s=round(verdict.retry_after_s, 3),
+        )
         return tenant, web.json_response(
             {
                 "error": {
@@ -176,13 +194,67 @@ class RequestService:
             self.state.qos.release(tenant)
 
     async def route_openai_request(self, request: web.Request) -> web.StreamResponse:
-        """Generic /v1/* proxy with routing."""
-        if request.content_type == "multipart/form-data":
-            # audio transcription (and any multipart upload) routes on the
-            # form's `model` field — json.loads on a multipart body can never
-            # succeed (reference handles this with a dedicated form-aware
-            # path, request.py:513-690)
-            return await self.route_multipart_request(request)
+        """Generic /v1/* proxy with routing. This wrapper owns the
+        request's correlation state: the x-request-id echoed on EVERY
+        response path (the middleware stamps error short-circuits too),
+        the tracing-spine ingress span, and the router-vantage TTFT/E2E
+        histogram observations (docs/28-request-tracing.md)."""
+        state = self.state
+        # normally minted by app.request_id_middleware; the fallback keeps
+        # the service usable without the app's middleware stack (tests)
+        rid = request.get(RID_KEY) or (
+            request.headers.get("X-Request-Id") or uuid.uuid4().hex
+        )
+        request[RID_KEY] = rid
+        trace = state.traces.start(
+            rid, "router.ingress",
+            traceparent=request.headers.get(TRACEPARENT_HEADER),
+            attrs={"path": request.path},
+        )
+        request[TRACE_KEY] = trace
+        t0 = time.monotonic()
+        resp: web.StreamResponse | None = None
+        raised_status = 500
+        try:
+            if request.content_type == "multipart/form-data":
+                # audio transcription (and any multipart upload) routes on
+                # the form's `model` field — json.loads on a multipart body
+                # can never succeed (reference handles this with a dedicated
+                # form-aware path, request.py:513-690)
+                resp = await self.route_multipart_request(request)
+            else:
+                resp = await self._route_json(request)
+            return resp
+        except web.HTTPException as e:
+            # e.g. HTTPRequestEntityTooLarge from request.read(): the trace
+            # must carry the real status, not a phantom 500
+            raised_status = e.status
+            raise
+        finally:
+            status = resp.status if resp is not None else raised_status
+            severed = request.get(SEVERED_KEY, False)
+            # latency histograms observe only SERVED requests (refusals
+            # answer in microseconds, severed streams truncate early —
+            # both would pollute the percentiles); TTFT additionally
+            # needs a first upstream byte to have happened. Observed
+            # regardless of the tracing flag.
+            ttfb = request.get(TTFB_KEY)
+            if resp is not None and status < 400 and not severed:
+                state.metrics.observe_request(
+                    ttft=(ttfb - t0) if ttfb is not None else None,
+                    e2e=time.monotonic() - t0,
+                    trace_id=trace.trace_id or None,
+                )
+            state.traces.finish(
+                trace,
+                status=(
+                    "severed" if severed
+                    else "ok" if status < 400
+                    else f"error:{status}"
+                ),
+            )
+
+    async def _route_json(self, request: web.Request) -> web.StreamResponse:
         raw = await request.read()
         try:
             body = json.loads(raw) if raw else {}
@@ -204,7 +276,7 @@ class RequestService:
     async def _route_parsed(
         self, request: web.Request, body: dict
     ) -> web.StreamResponse:
-        request_id = request.headers.get("X-Request-Id") or uuid.uuid4().hex
+        request_id = request.get(RID_KEY) or uuid.uuid4().hex
         if self.state.callbacks is not None:
             short = await self.state.callbacks.pre_request(request, body)
             if short is not None:
@@ -266,6 +338,7 @@ class RequestService:
         same_url_retried: set[str] = set()
         attempts = 0
         budget = 2 * len(eps) + 1
+        trace = request.get(TRACE_KEY, NULL_TRACE)
         while candidates and attempts < budget:
             attempts += 1
             ctx = RoutingContext(
@@ -278,6 +351,7 @@ class RequestService:
             try:
                 url = await self.state.policy.route(ctx)
             except LookupError as e:
+                trace.event("no_endpoints", error=str(e))
                 if on_exhausted is not None:
                     await on_exhausted()  # callbacks pairing (see below)
                 return web.json_response(
@@ -288,6 +362,11 @@ class RequestService:
             logger.info(
                 "Routing request %s to %s at %f", request_id, url, time.time()
             )
+            trace.event(
+                "route", url=url, attempt=attempts,
+                policy=type(self.state.policy).__name__,
+                candidates=len(candidates),
+            )
             self.state.breakers.on_attempt(url)  # reserve half-open probe
             try:
                 return await attempt(url)
@@ -297,6 +376,7 @@ class RequestService:
                     # a drain refusal is not an endpoint fault: no breaker
                     # strike, just re-pick among the others
                     candidates = [c for c in candidates if c.url != url]
+                    trace.event("failover", url=url, cause="draining")
                     logger.info(
                         "engine %s is draining; request %s fails over "
                         "(%d candidates left)", url, request_id,
@@ -304,6 +384,9 @@ class RequestService:
                     )
                     continue
                 self.state.breakers.on_failure(url)
+                trace.event(
+                    "failover", url=url, cause=type(e.cause).__name__,
+                )
                 if isinstance(e.cause, aiohttp.ServerDisconnectedError):
                     if url not in same_url_retried:
                         same_url_retried.add(url)
@@ -318,6 +401,7 @@ class RequestService:
                     "engine %s refused connection for %s — failing over "
                     "(%d candidates left)", url, request_id, len(candidates),
                 )
+        trace.event("exhausted", attempts=attempts)
         if on_exhausted is not None:
             await on_exhausted()
         if last_err is not None and isinstance(last_err.cause, UpstreamDraining):
@@ -344,7 +428,9 @@ class RequestService:
         labeled `transcription` when any carry labels), rebuild the form with
         a fresh boundary, and relay the reply. Mirrors the reference's
         form-aware path (request.py:513-690) on aiohttp primitives."""
-        request_id = request.headers.get("X-Request-Id") or uuid.uuid4().hex
+        request_id = request.get(RID_KEY) or (
+            request.headers.get("X-Request-Id") or uuid.uuid4().hex
+        )
         form = await request.post()
         for required in ("file", "model"):
             if required not in form:
@@ -437,6 +523,11 @@ class RequestService:
                         if first:
                             first = False
                             mon.on_first_token(url, request_id, time.time())
+                            if TTFB_KEY not in request:
+                                request[TTFB_KEY] = time.monotonic()
+                                request.get(TRACE_KEY, NULL_TRACE).event(
+                                    "first_byte", url=url
+                                )
                         await resp.write(chunk)
                     await resp.write_eof()
                     return resp
@@ -486,6 +577,18 @@ class RequestService:
         after a 10 s connect timeout forwards the 10-seconds-poorer
         remainder instead of re-arming the full budget on every retry."""
         headers = _forward_headers(request.headers)
+        # correlation: the generated/echoed x-request-id rides upstream so
+        # the engine's spans and logs key on the SAME id, and the tracing
+        # spine's W3C traceparent (this router's ingress span as parent)
+        # joins the engine's timeline into one trace
+        rid = request.get(RID_KEY)
+        if rid:
+            headers["X-Request-Id"] = rid
+        trace = request.get(TRACE_KEY)
+        if trace is not None:
+            tp = trace.child_traceparent()
+            if tp:
+                headers[TRACEPARENT_HEADER] = tp
         qos = self.state.qos
         if qos is not None:
             # spoof-proofing: with QoS active, inbound x-tenant-id /
@@ -537,6 +640,10 @@ class RequestService:
             "engine %s died mid-stream for request %s: %s",
             backend_url, request_id, e,
         )
+        request[SEVERED_KEY] = True
+        request.get(TRACE_KEY, NULL_TRACE).event(
+            "severed", url=backend_url, cause=type(e).__name__
+        )
         self.state.breakers.on_failure(backend_url)
         resp.force_close()
         if request.transport is not None:
@@ -551,6 +658,7 @@ class RequestService:
         request_id: str,
     ) -> web.StreamResponse:
         mon = self.state.request_monitor
+        trace = request.get(TRACE_KEY, NULL_TRACE)
         data = json.dumps(body).encode()
         mon.on_new_request(backend_url, request_id, time.time())
         pre_byte_raise = False
@@ -587,6 +695,10 @@ class RequestService:
                     # same rule as the multipart path: a 5xx neither
                     # resets breaker strikes nor adds one
                     self.state.breakers.on_success(backend_url)
+                trace.event(
+                    "upstream_status", status=upstream.status,
+                    url=backend_url,
+                )
                 resp = web.StreamResponse(status=upstream.status)
                 for k, v in upstream.headers.items():
                     if k.lower() not in _HOP_HEADERS:
@@ -598,6 +710,9 @@ class RequestService:
                     if first:
                         first = False
                         mon.on_first_token(backend_url, request_id, time.time())
+                        if TTFB_KEY not in request:
+                            request[TTFB_KEY] = time.monotonic()
+                            trace.event("first_byte", url=backend_url)
                     if want_body:
                         full.extend(chunk)
                     await resp.write(chunk)

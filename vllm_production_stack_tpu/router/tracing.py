@@ -1,11 +1,20 @@
-"""Optional tracing/error reporting (reference: Sentry init in app.py:123-130
-+ parser.py:341-359; OTel→Jaeger via engine env, tutorial 12).
+"""Optional external tracing/error-reporting SDK initialization (reference:
+Sentry init in app.py:123-130 + parser.py:341-359; OTel→Jaeger via engine
+env, tutorial 12).
 
-Both integrations are soft dependencies: if the SDK isn't installed the
-flags log a warning and no-op, so the router never gains a hard dependency
-on an APM stack. Engine-side traces come from the engines themselves (set
-OTEL_EXPORTER_OTLP_ENDPOINT on engine pods — JAX/XLA profiles via xprof are
-the device-level complement, SURVEY §5)."""
+The spans themselves come from the dependency-free tracing spine
+(vllm_production_stack_tpu/tracing, docs/28-request-tracing.md): the router
+records an ingress span per proxied request (routing decision, failover
+attempts, QoS verdict, upstream TTFB) and the engines record
+queue/prefill/decode spans joined by the propagated traceparent — all
+in-process, served by /debug/requests, with or without any SDK. What THIS
+module does is wire the optional export paths: `init_otel` installs an OTLP
+TracerProvider so the spine's finished timelines also ship to a
+Jaeger/Tempo-class backend (tracing/otel.py bridges them), and
+`init_sentry` enables error reporting. Both are soft dependencies: without
+the SDK the flags log a warning and no-op — the router never gains a hard
+dependency on an APM stack. JAX/XLA device profiles are the engine-side
+complement (POST /debug/profile/start on a live engine, SURVEY §5)."""
 
 from __future__ import annotations
 
@@ -39,7 +48,10 @@ def init_sentry(dsn: str | None, traces_sample_rate: float = 0.0,
 
 def init_otel(service_name: str = "tpu-stack-router") -> bool:
     """Initialize OpenTelemetry trace export if the SDK is available and
-    OTEL_EXPORTER_OTLP_ENDPOINT is set (standard OTel env contract)."""
+    OTEL_EXPORTER_OTLP_ENDPOINT is set (standard OTel env contract). With
+    a provider installed, the tracing spine's finished request timelines
+    export through it (tracing/otel.py) — same ids as /debug/requests, so
+    router and engine spans join into one trace in the backend."""
     import os
 
     endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")

@@ -1,0 +1,42 @@
+"""Dependency-free request-tracing spine (docs/28-request-tracing.md).
+
+Every request crossing the stack gets a structured span/event timeline,
+correlated end to end by one trace id: the router opens an ingress span
+(routing decision, failover attempts, QoS verdict, upstream TTFB) and
+propagates W3C `traceparent` to the engine, whose spans (admission, queue
+wait, prefill, per-decode-window events) join the same trace. Timelines
+live in an in-process ring buffer served by `/debug/requests`; when the
+OpenTelemetry SDK is installed AND `init_otel` configured a provider,
+finished timelines also export over OTLP — with zero hard dependency on
+either.
+"""
+
+from .propagation import (
+    TRACEPARENT_HEADER,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
+from .spine import (
+    NULL_TRACE,
+    NullTrace,
+    RequestTrace,
+    Span,
+    TraceStore,
+    mono_to_epoch,
+)
+
+__all__ = [
+    "TRACEPARENT_HEADER",
+    "format_traceparent",
+    "parse_traceparent",
+    "new_trace_id",
+    "new_span_id",
+    "Span",
+    "RequestTrace",
+    "NullTrace",
+    "NULL_TRACE",
+    "TraceStore",
+    "mono_to_epoch",
+]

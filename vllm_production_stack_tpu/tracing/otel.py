@@ -1,0 +1,104 @@
+"""OTLP export bridge — makes `init_otel`'s promise true, softly.
+
+When the OpenTelemetry SDK is installed AND `router.tracing.init_otel`
+(or any other code) installed a real `TracerProvider`, finished request
+timelines are re-emitted through the provider's span processors as
+`ReadableSpan`s carrying OUR ids — so the spans a Jaeger/Tempo backend
+shows join into the same router→engine trace `/debug/requests` shows,
+including a caller-supplied trace id. Without the SDK (the default
+image) `resolve_otel_sink` returns None and the spine stays fully
+in-process, zero deps.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+def resolve_otel_sink(service: str):
+    """A callable(RequestTrace) exporting over the configured OTLP
+    pipeline, or None when the SDK/provider/endpoint is absent."""
+    if not os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT"):
+        return None
+    try:
+        from opentelemetry import trace as ot_trace
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import (
+            Event,
+            ReadableSpan,
+            TracerProvider,
+        )
+        from opentelemetry.trace import (
+            SpanContext,
+            SpanKind,
+            Status,
+            StatusCode,
+            TraceFlags,
+        )
+    except ImportError:
+        return None
+    provider = ot_trace.get_tracer_provider()
+    if not isinstance(provider, TracerProvider):
+        # init_otel never ran (or failed): nothing to export through
+        return None
+    processor = getattr(provider, "_active_span_processor", None)
+    if processor is None:
+        return None
+    resource = Resource.create({"service.name": service})
+
+    def _ctx(trace_id: str, span_id: str, remote: bool = False) -> SpanContext:
+        return SpanContext(
+            trace_id=int(trace_id, 16),
+            span_id=int(span_id, 16),
+            is_remote=remote,
+            trace_flags=TraceFlags(TraceFlags.SAMPLED),
+        )
+
+    def _readable(span, service_attrs=None) -> ReadableSpan:
+        status = (
+            Status(StatusCode.OK)
+            if span.status == "ok"
+            else Status(StatusCode.ERROR, span.status)
+        )
+        return ReadableSpan(
+            name=span.name,
+            context=_ctx(span.trace_id, span.span_id),
+            parent=(
+                _ctx(span.trace_id, span.parent_id, remote=True)
+                if span.parent_id
+                else None
+            ),
+            resource=resource,
+            attributes={
+                k: v
+                for k, v in span.attrs.items()
+                if isinstance(v, (str, bool, int, float))
+            },
+            events=[
+                Event(
+                    name=n,
+                    attributes={
+                        k: v
+                        for k, v in a.items()
+                        if isinstance(v, (str, bool, int, float))
+                    },
+                    timestamp=int(t * 1e9),
+                )
+                for t, n, a in span.events
+            ],
+            kind=SpanKind.SERVER,
+            status=status,
+            start_time=int(span.start * 1e9),
+            end_time=int((span.end if span.end is not None else span.start) * 1e9),
+        )
+
+    def sink(trace) -> None:
+        for span in (*trace.spans, trace.root):
+            processor.on_end(_readable(span))
+
+    logger.info("request-trace OTLP export active (service %s)", service)
+    return sink

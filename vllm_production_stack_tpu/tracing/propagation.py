@@ -1,0 +1,52 @@
+"""W3C Trace Context propagation (traceparent header, version 00).
+
+The router stamps `traceparent: 00-<trace_id>-<span_id>-<flags>` on every
+upstream request so the engine's spans join the router's trace; a caller
+already carrying a traceparent keeps its trace id (the router becomes a
+child of the caller's span, standard distributed-tracing behavior). No
+tracestate support: we propagate identity, not vendor baggage.
+"""
+
+from __future__ import annotations
+
+import os
+
+TRACEPARENT_HEADER = "traceparent"
+
+_HEX = set("0123456789abcdef")
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def _is_hex(s: str) -> bool:
+    return bool(s) and all(c in _HEX for c in s)
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """(trace_id, parent_span_id) from a W3C traceparent, or None for a
+    missing/malformed header. Malformed input is DROPPED, never raised:
+    a bad client header must start a fresh trace, not 500 the request.
+    All-zero ids are invalid per the spec (they mean "no trace")."""
+    if not header:
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id = parts[0], parts[1], parts[2]
+    if version == "ff" or len(version) != 2 or not _is_hex(version):
+        return None
+    if len(trace_id) != 32 or not _is_hex(trace_id) or trace_id == "0" * 32:
+        return None
+    if len(span_id) != 16 or not _is_hex(span_id) or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(trace_id: str, span_id: str, sampled: bool = True) -> str:
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"

@@ -1,0 +1,364 @@
+"""In-process span store: the request timelines behind /debug/requests.
+
+Design constraints, in order:
+
+- **Zero hard deps, bounded memory.** Finished timelines live in a ring
+  buffer (`deque(maxlen=capacity)`); in-flight timelines are capped too
+  (a flood of never-finished requests must not grow the store without
+  bound — overflow evicts the oldest as "orphaned").
+- **Near-zero cost when disabled.** `TraceStore.start` on a disabled
+  store returns the NULL_TRACE singleton whose every method is a no-op —
+  instrumentation call sites never branch on an `if tracing:` guard and
+  the disabled path allocates nothing per request.
+- **Thread-safe.** The engine records from the step thread and HTTP
+  executor threads while /debug/requests reads from the event loop; the
+  store lock covers only membership (start/finish/query), and per-trace
+  mutation is append-only from the request's own execution context.
+
+Timestamps are epoch seconds (`time.time()`), the unit dashboards and
+OTLP speak; `mono_to_epoch` converts the engine's `time.monotonic()`
+request stamps without assuming the two clocks share an origin.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from .propagation import (
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
+
+
+def mono_to_epoch(mono: float) -> float:
+    """Epoch time of a time.monotonic() stamp taken in this process."""
+    return time.time() - (time.monotonic() - mono)
+
+
+class Span:
+    """One named time window with attributes and point-in-time events."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id",
+        "start", "end", "status", "attrs", "events",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: str,
+        parent_id: str | None = None,
+        start: float | None = None,
+        attrs: dict | None = None,
+    ):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = new_span_id()
+        self.parent_id = parent_id
+        self.start = time.time() if start is None else start
+        self.end: float | None = None
+        self.status = "ok"
+        self.attrs: dict = dict(attrs) if attrs else {}
+        self.events: list[tuple[float, str, dict]] = []
+
+    # per-span event bound: a 4k-token stream emits a decode_window event
+    # per resolved window — cap the list so one long request can't bloat
+    # its ring slot (the final marker says truncation happened)
+    MAX_EVENTS = 256
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        n = len(self.events)
+        if n >= self.MAX_EVENTS:
+            if n == self.MAX_EVENTS:
+                self.events.append((time.time(), "events_truncated", {}))
+            return
+        self.events.append((time.time(), name, attrs))
+
+    def finish(self, end: float | None = None, status: str | None = None) -> None:
+        if self.end is None:
+            self.end = time.time() if end is None else end
+        if status is not None:
+            self.status = status
+
+    @property
+    def duration(self) -> float:
+        return (self.end if self.end is not None else time.time()) - self.start
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start,
+            "end": self.end,
+            "duration_ms": round(self.duration * 1e3, 3),
+            "status": self.status,
+            "attrs": self.attrs,
+            "events": [
+                {"t": t, "name": n, **({"attrs": a} if a else {})}
+                for t, n, a in self.events
+            ],
+        }
+
+
+class RequestTrace:
+    """One request's timeline: a root span plus flat child spans. Children
+    parent to the root by default — deep nesting buys nothing for a
+    request lifecycle, and a flat list renders directly as a timeline."""
+
+    __slots__ = ("rid", "root", "spans", "_finished")
+
+    def __init__(self, rid: str, root: Span):
+        self.rid = rid
+        self.root = root
+        self.spans: list[Span] = []
+        self._finished = False
+
+    @property
+    def trace_id(self) -> str:
+        return self.root.trace_id
+
+    def set(self, **attrs) -> None:
+        self.root.set(**attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        self.root.event(name, **attrs)
+
+    def span(
+        self,
+        name: str,
+        start: float | None = None,
+        end: float | None = None,
+        **attrs,
+    ) -> Span:
+        """Add a child span; pass explicit start/end to record a window
+        measured elsewhere (the engine's phase attribution reconstructs
+        queue/prefill/decode windows from request-carried stamps)."""
+        s = Span(
+            name, self.root.trace_id, parent_id=self.root.span_id,
+            start=start, attrs=attrs or None,
+        )
+        if end is not None:
+            s.finish(end=end)
+        self.spans.append(s)
+        return s
+
+    def child_traceparent(self) -> str:
+        """The traceparent to stamp on an outbound hop: this trace, with
+        the root (ingress) span as the remote parent."""
+        return format_traceparent(self.root.trace_id, self.root.span_id)
+
+    @property
+    def duration(self) -> float:
+        return self.root.duration
+
+    def to_dict(self) -> dict:
+        return {
+            "rid": self.rid,
+            "trace_id": self.trace_id,
+            "duration_ms": round(self.duration * 1e3, 3),
+            "status": self.root.status,
+            "spans": [self.root.to_dict()] + [s.to_dict() for s in self.spans],
+        }
+
+
+class NullTrace:
+    """No-op stand-in returned by a disabled store: every recording call
+    vanishes, so instrumentation sites need no enabled-checks."""
+
+    rid = ""
+    trace_id = ""
+    duration = 0.0
+    _finished = True
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def event(self, name: str, **attrs) -> None:
+        pass
+
+    def span(self, name, start=None, end=None, **attrs) -> "NullTrace":
+        return self
+
+    def finish(self, end=None, status=None) -> None:
+        pass
+
+    def child_traceparent(self) -> None:
+        return None
+
+    def to_dict(self) -> dict:
+        return {}
+
+
+NULL_TRACE = NullTrace()
+
+
+class TraceStore:
+    """Ring-buffer-bounded home of request timelines for one process."""
+
+    # in-flight overflow factor: a flood of requests that never finish
+    # (or a leak) evicts the oldest in-flight timeline once the in-flight
+    # set reaches this multiple of the finished ring's capacity
+    INFLIGHT_FACTOR = 2
+
+    def __init__(
+        self,
+        capacity: int = 512,
+        enabled: bool = True,
+        service: str = "tpu-stack",
+        otel_sink=None,
+    ):
+        self.enabled = enabled
+        self.capacity = max(1, int(capacity))
+        self.service = service
+        self._lock = threading.Lock()
+        self._ring: deque[RequestTrace] = deque(maxlen=self.capacity)
+        self._inflight: dict[str, RequestTrace] = {}
+        self.started_total = 0
+        self.dropped_inflight_total = 0
+        # OTLP bridge: resolved lazily on first finish unless injected
+        # (tracing/otel.py) — None means "not resolved yet"
+        self._otel_sink = otel_sink
+        self._otel_resolved = otel_sink is not None
+
+    # -- recording ---------------------------------------------------------
+
+    def start(
+        self,
+        rid: str,
+        name: str,
+        traceparent: str | None = None,
+        attrs: dict | None = None,
+    ) -> RequestTrace | NullTrace:
+        """Open a request timeline. A valid caller traceparent keeps its
+        trace id (this root becomes a child of the caller's span); a
+        missing/malformed one starts a fresh trace."""
+        if not self.enabled:
+            return NULL_TRACE
+        ctx = parse_traceparent(traceparent)
+        trace_id = ctx[0] if ctx else new_trace_id()
+        root = Span(name, trace_id, parent_id=ctx[1] if ctx else None,
+                    attrs=attrs)
+        root.set(rid=rid, service=self.service)
+        trace = RequestTrace(rid, root)
+        with self._lock:
+            self.started_total += 1
+            if (
+                len(self._inflight)
+                >= self.capacity * self.INFLIGHT_FACTOR
+            ):
+                # dict insertion order IS start order (traces are inserted
+                # at creation), so the oldest is the first key — O(1),
+                # which matters because this path runs on every start()
+                # during exactly the flood it guards against
+                oldest = next(iter(self._inflight))
+                orphan = self._inflight.pop(oldest)
+                orphan.root.finish(status="orphaned")
+                orphan._finished = True
+                self._ring.append(orphan)
+                self.dropped_inflight_total += 1
+            # same-rid collision (two concurrent requests reusing one
+            # client-supplied X-Request-Id): the newer trace takes the
+            # in-flight slot; the displaced one still files into the ring
+            # on finish (identity-checked pop below)
+            self._inflight[rid] = trace
+        return trace
+
+    def finish(self, trace, status: str = "ok") -> None:
+        """Close a timeline and move it into the finished ring. Idempotent
+        (refusal paths may finish explicitly, then again in a finally)."""
+        if trace is NULL_TRACE or not isinstance(trace, RequestTrace):
+            return
+        if trace._finished:
+            return
+        trace._finished = True
+        trace.root.finish(status=status)
+        with self._lock:
+            # identity-checked: finishing trace A must not evict a
+            # concurrent trace B that reused the same client-supplied rid
+            if self._inflight.get(trace.rid) is trace:
+                del self._inflight[trace.rid]
+            self._ring.append(trace)
+        self._export(trace)
+
+    # -- queries (/debug/requests) -----------------------------------------
+
+    def get(self, rid: str) -> RequestTrace | None:
+        with self._lock:
+            t = self._inflight.get(rid)
+            if t is not None:
+                return t
+            for t in self._ring:
+                if t.rid == rid:
+                    return t
+        return None
+
+    def debug_response(self, query) -> tuple[dict, int]:
+        """(payload, http_status) for a /debug/requests query mapping —
+        the ONE place the rid/n parsing and 404 shaping live, so the
+        router's and the engine's endpoints cannot diverge."""
+        rid = query.get("rid")
+        try:
+            n = max(1, min(200, int(query.get("n", "20"))))
+        except ValueError:
+            n = 20
+        payload = self.debug_payload(rid=rid, n=n)
+        return payload, 404 if "error" in payload else 200
+
+    def debug_payload(self, rid: str | None = None, n: int = 20) -> dict:
+        """The /debug/requests JSON: one full trace for ?rid=, else the
+        recent / slowest / in-flight summaries."""
+        if rid is not None:
+            t = self.get(rid)
+            if t is None:
+                return {"error": f"no trace for rid {rid!r}", "rid": rid}
+            return t.to_dict()
+        with self._lock:
+            ring = list(self._ring)
+            inflight = list(self._inflight.values())
+
+        def brief(t: RequestTrace) -> dict:
+            return {
+                "rid": t.rid,
+                "trace_id": t.trace_id,
+                "status": t.root.status,
+                "start": t.root.start,
+                "duration_ms": round(t.duration * 1e3, 3),
+                "spans": len(t.spans) + 1,
+            }
+
+        slowest = sorted(ring, key=lambda t: t.duration, reverse=True)
+        return {
+            "service": self.service,
+            "enabled": self.enabled,
+            "capacity": self.capacity,
+            "started_total": self.started_total,
+            "finished_buffered": len(ring),
+            "inflight": [brief(t) for t in inflight[:n]],
+            "recent": [brief(t) for t in ring[-n:]][::-1],
+            "slowest": [brief(t) for t in slowest[:n]],
+        }
+
+    # -- OTLP bridge -------------------------------------------------------
+
+    def _export(self, trace: RequestTrace) -> None:
+        if not self._otel_resolved:
+            from .otel import resolve_otel_sink
+
+            self._otel_sink = resolve_otel_sink(self.service)
+            self._otel_resolved = True
+        if self._otel_sink is not None:
+            try:
+                self._otel_sink(trace)
+            except Exception:
+                # export is best-effort by contract: one bad span must not
+                # fail requests, and a broken SDK install disables export
+                self._otel_sink = None
