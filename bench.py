@@ -900,6 +900,211 @@ async def _fairness_bench() -> dict:
         engine.runner.shutdown(wait=True)
 
 
+def _saturation_bench() -> dict:
+    """Saturation & goodput telemetry proof (docs/29-saturation-slo.md),
+    CPU-only so it survives a wedged TPU tunnel:
+
+    - **ledger exactness** — a flood engineered to hit every waste path
+      (pipeline rollbacks via mid-window stops, pool-pressure preemptions,
+      deadline expiry, QoS shed eviction, mid-flight aborts) must leave
+      the goodput ledger balanced EXACTLY: delivered + wasted == sampled
+      at quiescence, with every event class actually exercised.
+    - **metering overhead** — the same decode wave on two engines, step
+      metering off vs on, alternating reps: the meter's cost must be a
+      measured number (bar: ≤ ~2% p50 wave latency), not an assertion.
+    """
+    import time as _t
+    from dataclasses import replace
+
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.config import EngineConfig
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+    from vllm_production_stack_tpu.qos import TenantContext
+
+    # -- part 1: ledger exactness under a mixed-fate flood -----------------
+    cfg = EngineConfig.tiny()
+    cfg = cfg.replace(
+        cache=replace(cfg.cache, num_blocks=16),  # tight pool: preemptions
+        scheduler=replace(
+            cfg.scheduler, max_num_seqs=4, decode_buckets=(4,),
+            prefill_buckets=(16, 32, 64), max_num_batched_tokens=64,
+            decode_window=4, max_waiting_requests=8,
+        ),
+    )
+    eng = LLMEngine(cfg)
+    rng = np.random.RandomState(7)
+    vocab = cfg.model.vocab_size
+    counts = {"submitted": 0, "deadline_armed": 0, "aborted": 0,
+              "shed_marked": 0}
+    for wave in range(8):
+        rids = []
+        for i in range(10):
+            kind = (wave + i) % 4
+            sampling = SamplingParams(
+                max_tokens=int(rng.randint(3, 24)), temperature=0.0,
+                ignore_eos=True,
+            )
+            deadline = None
+            tenant = None
+            if kind == 1:
+                # expires while queued or mid-decode
+                deadline = _t.monotonic() + float(rng.uniform(0.01, 0.08))
+                counts["deadline_armed"] += 1
+            elif kind == 2:
+                # batch-class long decodes: the preemption/eviction victim
+                # pool (also latches the QoS paths)
+                tenant = TenantContext(
+                    tenant_id="batch", priority=2, weight=1.0
+                )
+                sampling = SamplingParams(
+                    max_tokens=32, temperature=0.0, ignore_eos=True
+                )
+            elif kind == 3:
+                # stop on a spread of ids: greedy tokens from random
+                # weights hit one mid-window, forcing overshoot discards
+                # and pipeline rollbacks at the finish
+                sampling = SamplingParams(
+                    max_tokens=24, temperature=0.0,
+                    stop_token_ids=tuple(
+                        int(t) for t in rng.randint(1, vocab, size=48)
+                    ),
+                )
+            prompt = [int(t) for t in
+                      rng.randint(1, vocab, size=int(rng.randint(4, 24)))]
+            rids.append(eng.add_request(
+                prompt_token_ids=prompt, sampling=sampling,
+                deadline=deadline, tenant=tenant,
+            ))
+            counts["submitted"] += 1
+        steps = 0
+        rt_sent = False
+        while eng.has_unfinished() and steps < 400:
+            eng.step()
+            steps += 1
+            if steps == 4 and len(rids) > 5:
+                # severed mid-flight (client disconnect shape): a request
+                # deep enough in the wave to be running or queued, not the
+                # newest (the shed victim below targets that end)
+                if eng.abort_request(rids[5]):
+                    counts["aborted"] += 1
+            if steps == 6 and not rt_sent:
+                # realtime arrival with seats full of batch-class decodes:
+                # priority seat preemption (the preempted victim keeps its
+                # pending tokens — fate settles at its eventual finish)
+                rt_sent = True
+                eng.add_request(
+                    prompt_token_ids=[int(t) for t in
+                                      rng.randint(1, vocab, size=6)],
+                    sampling=SamplingParams(
+                        max_tokens=6, temperature=0.0, ignore_eos=True
+                    ),
+                    tenant=TenantContext(
+                        tenant_id="rt", priority=0, weight=1.0
+                    ),
+                )
+                counts["submitted"] += 1
+            if steps == 10 and eng.scheduler.mark_shed_victim(0):
+                # a realtime-rank arrival claims a lower-priority victim
+                # (the admission gate's evict path) — after the preemption
+                # above, the newest waiting victim may carry pending
+                # tokens, exercising wasted{shed_evicted}
+                counts["shed_marked"] += 1
+    # bounded drain: a wedged regression must still report the ledger
+    # diagnostic (unbalanced + pending) instead of eating the phase timeout
+    drain_steps = 0
+    while eng.has_unfinished() and drain_steps < 2000:
+        eng.step()
+        drain_steps += 1
+    balance = eng.goodput_balance()
+    events = {
+        "rollbacks": int(eng.timing["rollback_n"]),
+        "preemptions": eng.scheduler.total_preemptions,
+        "deadline_expired": eng.scheduler.deadline_expired_total,
+        "shed_evictions": eng.scheduler.shed_evictions,
+        **counts,
+    }
+    sat = eng.stats().saturation
+    eng.runner.shutdown(wait=True)
+
+    # -- part 2: metering overhead (off vs on, alternating reps) -----------
+    cfg2 = EngineConfig.tiny()
+    cfg2 = cfg2.replace(
+        scheduler=replace(
+            cfg2.scheduler, max_num_seqs=8, decode_buckets=(8,),
+            prefill_buckets=(16, 32, 64), max_num_batched_tokens=64,
+            decode_window=4,
+        ),
+    )
+    engines = {
+        mode: LLMEngine(cfg2.replace(step_metering=mode))
+        for mode in (False, True)
+    }
+    prompts = [
+        [int(t) for t in rng.randint(1, vocab, size=16)] for _ in range(8)
+    ]
+    wave_sampling = SamplingParams(
+        max_tokens=32, temperature=0.0, ignore_eos=True
+    )
+    for e in engines.values():  # pay every XLA compile before measuring
+        e.generate(prompts, wave_sampling)
+        e.generate(prompts, wave_sampling)
+    REPS = 12
+    times: dict[bool, list[float]] = {False: [], True: []}
+    for _ in range(REPS):
+        for mode in (False, True):
+            t0 = time.perf_counter()
+            outs = engines[mode].generate(prompts, wave_sampling)
+            times[mode].append(time.perf_counter() - t0)
+            assert sum(len(o["token_ids"]) for o in outs) == 8 * 32
+    for e in engines.values():
+        e.runner.shutdown(wait=True)
+
+    def p50(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    off_p50, on_p50 = p50(times[False]), p50(times[True])
+    gen_tokens = 8 * 32
+    return {
+        "ledger": balance,
+        "balanced": bool(balance["balanced"]),
+        "events": events,
+        "all_waste_paths_hit": all(
+            balance["wasted"].get(r, 0) > 0
+            for r in ("rollback", "preempted_recompute", "deadline_expired",
+                      "severed", "shed_evicted", "overshoot")
+        ),
+        "meter_snapshot": {
+            k: sat.get(k)
+            for k in ("decode_seat_occupancy", "padding_waste_frac",
+                      "achieved_flops_per_s", "mfu")
+        },
+        "metering": {
+            "reps": REPS,
+            "off_p50_ms": round(off_p50 * 1e3, 2),
+            "on_p50_ms": round(on_p50 * 1e3, 2),
+            "off_tok_s": round(gen_tokens / off_p50, 1),
+            "on_tok_s": round(gen_tokens / on_p50, 1),
+            "p50_overhead_pct": round((on_p50 / off_p50 - 1.0) * 100.0, 2),
+            "min_overhead_pct": round(
+                (min(times[True]) / min(times[False]) - 1.0) * 100.0, 2
+            ),
+        },
+    }
+
+
+def _phase_saturation_main() -> None:
+    """Subprocess entry for the CPU-only saturation/goodput bench. Forces
+    CPU before anything touches jax — runs pre-preflight, so the goodput
+    evidence survives a wedged TPU tunnel."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = _saturation_bench()
+    print(json.dumps({"saturation": result}), flush=True)
+
+
 def _phase_fairness_main() -> None:
     """Subprocess entry for the CPU-only multi-tenant fairness bench.
     Forces CPU before anything touches jax — like routing/robustness, this
@@ -954,14 +1159,47 @@ def _phase_preflight_main() -> None:
     tunnel has been observed to wedge for HOURS after a killed bench
     (grants hang in jax init) — when that happens every phase would eat
     its full timeout; this makes the failure mode one cheap, explicit
-    section instead."""
+    section instead.
+
+    Watchdog (r04 timed out, r05 wedged with no TPU dispatch): a daemon
+    timer hard-kills this subprocess after PREFLIGHT_HARD_TIMEOUT_S
+    (default 300 s, below the parent's kill window) having FIRST printed a
+    structured diagnostic — which init stage wedged (import / devices /
+    dispatch), elapsed time, env — plus the thread stacks. The parent then
+    reports a named failure mode instead of a bare timeout, and the chip
+    frees minutes sooner for nothing-else-to-lose retries."""
+    import faulthandler
+    import threading
+
     t0 = time.monotonic()
+    stage = {"name": "import-jax"}
+    hard_s = float(os.environ.get("PREFLIGHT_HARD_TIMEOUT_S", "300"))
+
+    def watchdog() -> None:
+        print(json.dumps({"preflight": {
+            "error": f"watchdog: preflight wedged after {hard_s:.0f}s",
+            "stage": stage["name"],
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+            "tpu_library": os.environ.get("TPU_LIBRARY_PATH", ""),
+            "hint": "tunnel grant hang — kill stale jax processes / "
+                    "re-establish the TPU tunnel before retrying",
+        }}), flush=True)
+        faulthandler.dump_traceback()  # stderr merges into the phase log
+        os._exit(3)
+
+    timer = threading.Timer(hard_s, watchdog)
+    timer.daemon = True
+    timer.start()
     import jax
     import jax.numpy as jnp
 
+    stage["name"] = "enumerate-devices"
     dev = jax.devices()[0]
+    stage["name"] = "first-dispatch"
     val = int(jax.jit(lambda a: a + 1)(jnp.int32(41)))
     assert val == 42, val
+    timer.cancel()
     print(json.dumps({"preflight": {
         "platform": dev.platform,
         "device": str(dev),
@@ -982,6 +1220,8 @@ def main() -> None:
             _phase_fairness_main()
         elif phase == "tracing":
             _phase_tracing_main()
+        elif phase == "saturation":
+            _phase_saturation_main()
         else:
             assert phase == "micro", phase
             _phase_micro_main()
@@ -1018,6 +1258,14 @@ def main() -> None:
         timeout_s=300, key="tracing", min_needed_s=60.0,
     )
 
+    # -0.0625) saturation & goodput (docs/29-saturation-slo.md): ledger
+    # exactness under a rollback+preemption+deadline flood + step-meter
+    # overhead — CPU-only, pre-preflight, same wedge-proofing
+    saturation = _run_phase(
+        "saturation", ["bench.py", "--phase", "saturation"],
+        timeout_s=300, key="saturation", min_needed_s=60.0,
+    )
+
     # 0) chip preflight: one trivial dispatch. A wedged tunnel fails HERE
     # in minutes with an explicit section; the heavy phases are then
     # reported skipped instead of serially eating their timeouts
@@ -1040,6 +1288,7 @@ def main() -> None:
             "robustness": robustness,
             "fairness": fairness,
             "tracing": tracing,
+            "saturation": saturation,
             "total_elapsed_s": round(time.monotonic() - _t_start, 1),
         }), flush=True)
         return
@@ -1110,6 +1359,7 @@ def main() -> None:
         "robustness": robustness,
         "fairness": fairness,
         "tracing": tracing,
+        "saturation": saturation,
         "total_elapsed_s": round(time.monotonic() - _t_start, 1),
     }), flush=True)
 

@@ -107,6 +107,10 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--trace-buffer"
 - {{ .traceBuffer | quote }}
 {{- end }}
+{{- if eq (.stepMetering | default true) false }}
+- "--step-metering"
+- "false"
+{{- end }}
 {{- if eq (.enablePrefixCaching | default true) false }}
 - "--no-enable-prefix-caching"
 {{- end }}

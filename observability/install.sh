@@ -20,4 +20,18 @@ helm upgrade --install prom-adapter \
     -f "$(dirname "$0")/prom-adapter.yaml"
 
 kubectl apply -f "$(dirname "$0")/podmonitor.yaml"
+
+# SLO rule pack (docs/29-saturation-slo.md): ship the recording rules +
+# burn-rate alerts as a PrometheusRule so the operator-managed Prometheus
+# picks them up (the file's `groups:` body is the standard rule format)
+kubectl -n "$NS" apply -f - <<EOF
+apiVersion: monitoring.coreos.com/v1
+kind: PrometheusRule
+metadata:
+  name: tpu-slo-rules
+  labels:
+    release: kube-prom-stack
+spec:
+$(sed 's/^/  /' "$(dirname "$0")/rules/tpu-slo-rules.yaml" | grep -v '^  #')
+EOF
 echo "observability stack installed in namespace $NS"

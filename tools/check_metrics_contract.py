@@ -8,7 +8,16 @@ Every name in `vllm_production_stack_tpu/metrics_contract.py` must be
       subset of the router's names by hand and is covered by that union),
   (b) REFERENCED somewhere an operator will find it — the Grafana
       dashboard (observability/tpu-dashboard.json), the prometheus-adapter
-      rules, the KEDA trigger, or the docs.
+      rules, the KEDA trigger, the SLO rule pack (observability/rules/),
+      or the docs.
+
+And the SLO rule pack must stay consistent with the contract in the
+other direction:
+
+  (c) every `tpu:*` series a recording/alerting rule references must be a
+      contract name (or one of its _bucket/_count/_sum wire series, or a
+      recorded-rule name the pack itself defines) — a rule keying off a
+      series nobody emits would silently never fire.
 
 A name failing (a) is a dead contract entry (dashboards key off a series
 nobody emits); a name failing (b) is a silent metric (emitted telemetry
@@ -21,6 +30,7 @@ Exit code 0 = clean; 1 = drift, with one line per violation.
 from __future__ import annotations
 
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -30,10 +40,17 @@ REFERENCE_GLOBS = (
     "observability/tpu-dashboard.json",
     "observability/prom-adapter.yaml",
     "observability/keda-scaledobject.yaml",
+    "observability/rules",
     "docs",
     "README.md",
     "COMPONENTS.md",
 )
+
+RULES_DIR = os.path.join(REPO, "observability", "rules")
+
+# a PromQL series token: the tpu: prefix plus name characters. Recorded
+# rule names legitimately carry extra colons (tpu:goodput_ratio:rate5m).
+_SERIES_RE = re.compile(r"tpu:[A-Za-z0-9_:]+")
 
 
 def contract_names() -> list[str]:
@@ -86,6 +103,64 @@ def reference_blob() -> str:
     return "\n".join(chunks)
 
 
+def rule_files() -> list[str]:
+    if not os.path.isdir(RULES_DIR):
+        return []
+    return sorted(
+        os.path.join(RULES_DIR, f)
+        for f in os.listdir(RULES_DIR)
+        if f.endswith((".yaml", ".yml"))
+    )
+
+
+def load_rules(path: str) -> list[dict]:
+    """Flat list of rule dicts (recording + alerting) from one Prometheus
+    rule file. Malformed YAML raises — the tier-1 lint wants that loud."""
+    import yaml
+
+    with open(path, encoding="utf-8") as f:
+        doc = yaml.safe_load(f) or {}
+    rules: list[dict] = []
+    for group in doc.get("groups") or []:
+        rules.extend(group.get("rules") or [])
+    return rules
+
+
+def check_rules() -> list[str]:
+    """(c): every tpu:* series referenced by the SLO rule pack resolves to
+    a contract name, one of its histogram/counter wire series, or a
+    recorded-rule name the pack itself defines."""
+    contract = set(contract_names())
+    allowed = set(contract)
+    allowed |= {
+        f"{n}{suffix}"
+        for n in contract
+        for suffix in ("_bucket", "_count", "_sum")
+    }
+    rules: list[tuple[str, dict]] = []
+    for path in rule_files():
+        try:
+            for rule in load_rules(path):
+                rules.append((os.path.basename(path), rule))
+        except Exception as e:
+            return [f"{os.path.basename(path)}: unparseable rule file: {e}"]
+    # recorded names are legal references for later rules (any order —
+    # Prometheus evaluates recording rules in group sequence)
+    recorded = {r.get("record") for _, r in rules if r.get("record")}
+    allowed |= recorded
+    problems: list[str] = []
+    for fname, rule in rules:
+        expr = str(rule.get("expr", ""))
+        label = rule.get("record") or rule.get("alert") or "<unnamed>"
+        for tok in _SERIES_RE.findall(expr):
+            if tok not in allowed:
+                problems.append(
+                    f"{fname}:{label}: references series {tok!r} that is "
+                    "neither a contract name nor a recorded rule"
+                )
+    return problems
+
+
 def check() -> list[str]:
     """All drift violations, empty when the contract is clean."""
     exported = exported_names()
@@ -99,8 +174,9 @@ def check() -> list[str]:
         if name not in refs:
             problems.append(
                 f"{name}: not referenced by the dashboard, adapter/KEDA "
-                "rules, or docs"
+                "rules, the SLO rule pack, or docs"
             )
+    problems.extend(check_rules())
     return problems
 
 

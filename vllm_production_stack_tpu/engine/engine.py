@@ -18,7 +18,8 @@ from ..utils.tokenizer import IncrementalDetokenizer, TokenizerWrapper
 from .config import EngineConfig
 from .model_runner import ModelRunner, StepHandle
 from .request import Request, RequestOutput, RequestStatus, SamplingParams
-from .scheduler import DecodeWork, PrefillWork, Scheduler
+from .saturation import StepMeter
+from .scheduler import DecodeWork, PrefillWork, Scheduler, VerifyWork
 
 logger = logging.getLogger(__name__)
 
@@ -73,6 +74,11 @@ class EngineStatsSnapshot:
     # queue-wait observations drained for the exporter's histogram
     tenants: dict = field(default_factory=dict)
     tenant_queue_waits: list = field(default_factory=list)
+    # saturation & goodput telemetry (docs/29-saturation-slo.md): the
+    # StepMeter snapshot (occupancy / padding / MFU / per-step histograms)
+    # plus "goodput" (the token-fate ledger) and "kv_tiers" (per-tier
+    # occupancy hbm/host/disk/remote) — rendered by EngineMetrics
+    saturation: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -251,6 +257,15 @@ class LLMEngine:
             and config.scheduler.num_speculative_tokens == 0
         )
         self._inflight: _InflightStep | None = None
+        # saturation telemetry (docs/29-saturation-slo.md): per-resolved-
+        # step occupancy / padding / MFU accounting. The goodput LEDGER
+        # lives on the scheduler (always on — its counters are part of the
+        # metric contract); the meter is the togglable part
+        # (--step-metering false) whose cost the bench's saturation phase
+        # measures.
+        self.meter = StepMeter(
+            config.model, config.scheduler, enabled=config.step_metering
+        )
         # model_fingerprint (computed above, before the KV tiers): same
         # config + same checkpoint (or same random seed) => same KV bytes
         # for same tokens. KV adoption (disaggregated prefill) refuses
@@ -934,6 +949,7 @@ class LLMEngine:
                 if nxt is not None:
                     self.scheduler.rollback_speculative(nxt.work)
                     nxt.handle.discard()
+                    self._ledger_rollback(nxt.work)
                 raise
             if nxt is not None and not self.scheduler.speculation_valid(
                 nxt.work
@@ -945,6 +961,7 @@ class LLMEngine:
                 self.scheduler.rollback_speculative(nxt.work)
                 nxt.handle.discard()
                 self.timing["rollback_n"] += 1
+                self._ledger_rollback(nxt.work)
                 nxt = None
         if work is None and inflight is not None and nxt is None:
             # a priority stall: the scheduler declined to chain because a
@@ -974,6 +991,7 @@ class LLMEngine:
                 len(t) for t in work.token_ids
             )
             results = self.scheduler.postprocess(work, rows)
+            self._meter_prefill(work)
             self._emit_results(results, pre_handle.logprob_rows, outputs)
             self.timing["post_s"] += time.perf_counter() - t3
         elif sync_work is not None:
@@ -998,7 +1016,9 @@ class LLMEngine:
         self.timing["decode_n"] += 1
         self.scheduler.end_speculative(work)
         results = self.scheduler.postprocess(work, rows)
-        self.timing["decode_tokens"] += sum(len(t) for _, t in results)
+        accepted = sum(len(t) for _, t in results)
+        self.timing["decode_tokens"] += accepted
+        self._meter_decode(work, accepted)
         self._emit_results(results, handle.logprob_rows, outputs)
         self.timing["post_s"] += time.perf_counter() - t1
 
@@ -1048,8 +1068,97 @@ class LLMEngine:
             # (1..k+1 accepted per row)
             else sum(len(toks) for _, toks in results)
         )
+        if kind == "prefill":
+            self._meter_prefill(work)
+        else:
+            self._meter_decode(work, sum(len(toks) for _, toks in results))
         self._emit_results(results, lp_rows, outputs)
         self.timing["post_s"] += time.perf_counter() - t2
+
+    # -- saturation & goodput telemetry (docs/29-saturation-slo.md) --------
+
+    def _ledger_rollback(self, work: DecodeWork) -> None:
+        """A dispatched pipeline step was discarded: the device still
+        executed it, sampling window × rows tokens nobody will consume —
+        sampled AND wasted in one motion (they never reach postprocess)."""
+        n = work.window * len(work.requests)
+        self.scheduler.ledger.sampled(n)
+        self.scheduler.ledger.waste("rollback", n)
+
+    def _meter_decode(self, work, accepted: int) -> None:
+        """Record one resolved decode/verify dispatch with the meter. The
+        context sum feeds the attention term of the FLOP estimate: row i's
+        window tokens attend ~positions[i] + j each."""
+        if not self.meter.enabled:
+            return
+        if isinstance(work, VerifyWork):
+            rows = len(work.requests)
+            fed = sum(len(t) for t in work.token_ids)
+            self.meter.record_decode(
+                rows=rows,
+                window=max(1, -(-fed // max(1, rows))),
+                accepted_tokens=accepted,
+                sum_context=sum(
+                    sum(p) + len(p) for p in work.positions
+                ),
+            )
+            return
+        rows = len(work.requests)
+        w = work.window
+        self.meter.record_decode(
+            rows=rows,
+            window=w,
+            accepted_tokens=accepted,
+            sum_context=w * sum(work.positions) + rows * (w * (w + 1) // 2),
+        )
+
+    def _meter_prefill(self, work: PrefillWork) -> None:
+        if not self.meter.enabled:
+            return
+        chunk_tokens = sum(len(t) for t in work.token_ids)
+        # each chunk token attends ~its absolute position: per row the sum
+        # over [start, end) is len × (start + end) / 2
+        sum_ctx = 0
+        for ids, end in zip(work.token_ids, work.context_lens):
+            n = len(ids)
+            sum_ctx += n * (2 * end - n + 1) // 2
+        self.meter.record_prefill(
+            rows=len(work.requests),
+            chunk_tokens=chunk_tokens,
+            sum_context=sum_ctx,
+            max_chunk=max(len(t) for t in work.token_ids),
+        )
+
+    def _kv_tier_usage(self) -> dict[str, float]:
+        """Per-tier occupancy for tpu:engine_kv_tier_usage_perc. Remote is
+        the store-reported fill fraction piggybacked on PUT acks
+        (kvstore/client.py last_usage_perc) — 0 until the first ack."""
+        tiers = {
+            "hbm": self.scheduler.pool.usage_perc,
+            "host": 0.0,
+            "disk": 0.0,
+            "remote": 0.0,
+        }
+        if self.host_tier is not None:
+            tiers["host"] = self.host_tier.usage_perc
+            disk = self.host_tier.disk
+            if disk is not None and disk.max_bytes > 0:
+                tiers["disk"] = min(
+                    1.0, disk.total_bytes / disk.max_bytes
+                )
+        if self.remote_tier is not None:
+            tiers["remote"] = getattr(
+                self.remote_tier, "last_usage_perc", 0.0
+            )
+        return tiers
+
+    def goodput_balance(self) -> dict:
+        """Ledger balance check (delegates to the scheduler — the single
+        definition of "live requests"): sampled == delivered + wasted +
+        pending. At quiescence pending is 0, so delivered + wasted ==
+        sampled EXACTLY — tests and the bench's saturation phase assert
+        `balanced`."""
+        return self.scheduler.goodput_balance()
 
     def _emit_results(
         self, results, lp_rows, outputs: list[RequestOutput]
@@ -1233,7 +1342,11 @@ class LLMEngine:
     def stats(self) -> EngineStatsSnapshot:
         pool = self.scheduler.pool
         tenants, waits = self.scheduler.accounting.snapshot(drain_waits=True)
+        saturation = self.meter.snapshot()
+        saturation["goodput"] = self.scheduler.ledger.snapshot()
+        saturation["kv_tiers"] = self._kv_tier_usage()
         return EngineStatsSnapshot(
+            saturation=saturation,
             num_requests_running=self.scheduler.num_running,
             num_requests_waiting=self.scheduler.num_waiting,
             kv_usage_perc=pool.usage_perc,

@@ -15,10 +15,12 @@ from prometheus_client import (
     Histogram,
     generate_latest,
 )
+from prometheus_client.core import HistogramMetricFamily
 from prometheus_client.openmetrics import exposition as om_exposition
 
 from .. import metrics_contract as mc
 from .engine import EngineStatsSnapshot
+from .saturation import OCCUPANCY_BUCKETS, STEP_WALL_BUCKETS, WASTE_REASONS
 
 OPENMETRICS_CONTENT_TYPE = om_exposition.CONTENT_TYPE_LATEST
 
@@ -33,9 +35,78 @@ def wants_openmetrics(request) -> bool:
     return request.query.get("format") == "openmetrics"
 
 
+def _hist_family(
+    name: str, doc: str, labelnames: list[str]
+) -> HistogramMetricFamily:
+    return HistogramMetricFamily(name, doc, labels=labelnames)
+
+
+def _cum_buckets(hist: dict) -> list[tuple[str, float]]:
+    """(le, cumulative-count) pairs (incl. +Inf) from a StepMeter _Hist
+    snapshot's per-bucket counts."""
+    out: list[tuple[str, float]] = []
+    running = 0
+    counts = hist.get("counts") or []
+    bounds = list(hist.get("buckets") or ()) + [float("inf")]
+    for le, n in zip(bounds, counts):
+        running += n
+        out.append(("+Inf" if le == float("inf") else repr(float(le)),
+                    float(running)))
+    if not out:
+        out = [("+Inf", 0.0)]
+    return out
+
+
+class _SaturationHistograms:
+    """Custom collector rendering the StepMeter's per-step distributions
+    (tpu:engine_step_occupancy, tpu:engine_step_wall_seconds) straight
+    from the cumulative bucket counts the snapshot carries — the step
+    thread increments plain ints; no prometheus objects ride the hot
+    path."""
+
+    _EMPTY_OCC = {"buckets": OCCUPANCY_BUCKETS,
+                  "counts": [0] * (len(OCCUPANCY_BUCKETS) + 1),
+                  "sum": 0.0, "count": 0}
+    _EMPTY_WALL = {"buckets": STEP_WALL_BUCKETS,
+                   "counts": [0] * (len(STEP_WALL_BUCKETS) + 1),
+                   "sum": 0.0, "count": 0}
+
+    def __init__(self, owner: "EngineMetrics"):
+        self._owner = owner
+
+    def collect(self):
+        sat = self._owner.saturation or {}
+        model = self._owner.model_name
+        occ = _hist_family(
+            mc.ENGINE_STEP_OCCUPANCY,
+            "Decode-seat occupancy (rows / max_num_seqs) per resolved "
+            "decode step",
+            ["model_name"],
+        )
+        h = sat.get("occupancy_hist") or self._EMPTY_OCC
+        occ.add_metric([model], _cum_buckets(h), h.get("sum", 0.0))
+        yield occ
+        wall = _hist_family(
+            mc.ENGINE_STEP_WALL,
+            "Resolve-cadence wall seconds per resolved step, by phase",
+            ["model_name", "phase"],
+        )
+        walls = sat.get("step_wall_hist") or {}
+        for phase in ("prefill", "decode"):
+            h = walls.get(phase) or self._EMPTY_WALL
+            wall.add_metric(
+                [model, phase], _cum_buckets(h), h.get("sum", 0.0)
+            )
+        yield wall
+
+
 class EngineMetrics:
     def __init__(self, model_name: str):
         self.registry = CollectorRegistry()
+        self.model_name = model_name
+        # latest snapshot's saturation dict, read by the histogram
+        # collector at scrape time (update() refreshes it first)
+        self.saturation: dict = {}
         self._labels = {"model_name": model_name}
         names = list(self._labels)
 
@@ -101,6 +172,77 @@ class EngineMetrics:
         self.draining = gauge(
             mc.ENGINE_DRAINING, "1 while the engine is draining"
         )
+        # -- saturation & goodput (docs/29-saturation-slo.md) -------------
+        self.seat_occupancy = gauge(
+            mc.ENGINE_DECODE_SEAT_OCCUPANCY,
+            "Decode-seat occupancy EWMA (rows in the resolved decode "
+            "dispatch / max_num_seqs)",
+        )
+        self.padding_waste = gauge(
+            mc.ENGINE_PADDING_WASTE_FRAC,
+            "Fraction of device-computed token slots that were bucket "
+            "padding (EWMA)",
+        )
+        self.achieved_flops = gauge(
+            mc.ENGINE_ACHIEVED_FLOPS,
+            "Achieved forward-pass FLOP/s (analytic model estimate, "
+            "resolve-cadence EWMA)",
+        )
+        self.mfu = gauge(
+            mc.ENGINE_MFU,
+            "Model FLOPs utilization estimate (achieved / chip peak; 0 "
+            "when the peak is unknown)",
+        )
+        self.kv_tier_usage = Gauge(
+            mc.ENGINE_KV_TIER_USAGE,
+            "KV occupancy per cache tier (hbm / host / disk / remote)",
+            [*names, "tier"],
+            registry=self.registry,
+        )
+
+        def pcounter(name: str, doc: str) -> Counter:
+            base = name[: -len("_total")] if name.endswith("_total") else name
+            return Counter(base, doc, [*names, "phase"],
+                           registry=self.registry)
+
+        self.step_tokens = pcounter(
+            mc.ENGINE_STEP_TOKENS,
+            "Useful tokens processed per phase (prefill chunk tokens / "
+            "decode host-accepted tokens)",
+        )
+        self.padded_tokens = pcounter(
+            mc.ENGINE_PADDED_TOKENS,
+            "Device-computed token slots per phase, including bucket "
+            "padding",
+        )
+        self.model_flops = counter(
+            mc.ENGINE_MODEL_FLOPS,
+            "Cumulative analytic forward-pass FLOPs",
+        )
+        self.goodput_tokens = counter(
+            mc.GOODPUT_TOKENS,
+            "Sampled tokens delivered to a successfully finished request",
+        )
+        self.wasted_tokens = Counter(
+            mc.WASTED_TOKENS[: -len("_total")],
+            "Sampled tokens wasted, by reason (closed label set: "
+            + ", ".join(WASTE_REASONS) + ")",
+            [*names, "reason"],
+            registry=self.registry,
+        )
+        # seed the closed label sets at zero so every series exists from
+        # the first scrape (rate() over a counter that appears mid-flight
+        # misses its first increment)
+        for phase in ("prefill", "decode"):
+            self.step_tokens.labels(**self._labels, phase=phase)
+            self.padded_tokens.labels(**self._labels, phase=phase)
+        for reason in WASTE_REASONS:
+            self.wasted_tokens.labels(**self._labels, reason=reason)
+        self.goodput_tokens.labels(**self._labels)
+        self.model_flops.labels(**self._labels)
+        for tier in ("hbm", "host", "disk", "remote"):
+            self.kv_tier_usage.labels(**self._labels, tier=tier)
+        self.registry.register(_SaturationHistograms(self))
         # -- multi-tenant QoS (docs/27-multitenancy.md): tenant-labeled
         # series; cardinality bounded by qos.TenantAccounting.MAX_TENANTS
         tlabels = [*names, "tenant"]
@@ -238,6 +380,46 @@ class EngineMetrics:
             # each lands in the histogram exactly once
             self.tenant_queue_wait.labels(**lb, tenant=tenant).observe(
                 seconds
+            )
+        # -- saturation & goodput (docs/29-saturation-slo.md) -------------
+        sat = s.saturation or {}
+        self.saturation = sat  # histogram collector reads this at scrape
+        self.seat_occupancy.labels(**lb).set(
+            sat.get("decode_seat_occupancy", 0.0)
+        )
+        self.padding_waste.labels(**lb).set(
+            sat.get("padding_waste_frac", 0.0)
+        )
+        self.achieved_flops.labels(**lb).set(
+            sat.get("achieved_flops_per_s", 0.0)
+        )
+        self.mfu.labels(**lb).set(sat.get("mfu", 0.0))
+        for tier, frac in (sat.get("kv_tiers") or {}).items():
+            self.kv_tier_usage.labels(**lb, tier=tier).set(frac)
+        for phase in ("prefill", "decode"):
+            self._bump_labeled(
+                self.step_tokens, f"step_tok:{phase}",
+                int((sat.get("step_tokens") or {}).get(phase, 0)),
+                {**lb, "phase": phase},
+            )
+            self._bump_labeled(
+                self.padded_tokens, f"pad_tok:{phase}",
+                int((sat.get("padded_tokens") or {}).get(phase, 0)),
+                {**lb, "phase": phase},
+            )
+        self._bump(
+            self.model_flops, "model_flops",
+            sat.get("model_flops_total", 0.0),
+        )
+        good = sat.get("goodput") or {}
+        self._bump(self.goodput_tokens, "goodput", good.get("delivered", 0))
+        wasted = good.get("wasted") or {}
+        for reason in WASTE_REASONS:
+            # the CLOSED reason set bounds label cardinality by
+            # construction — every reason series exists from first scrape
+            self._bump_labeled(
+                self.wasted_tokens, f"wasted:{reason}",
+                int(wasted.get(reason, 0)), {**lb, "reason": reason},
             )
 
     def _bump(self, counter: Counter, key: str, total: int) -> None:

@@ -103,6 +103,14 @@ class Request:
     # max_tokens/window clamping; postprocess of the resolved step moves
     # these into num_computed_tokens / output_token_ids for real
     num_inflight_tokens: int = 0
+    # goodput ledger (engine/saturation.GoodputLedger): accepted output
+    # tokens not yet classified delivered/wasted. Charged in postprocess,
+    # settled exactly once at finish (delivered for stop/length,
+    # wasted{reason} otherwise). Pending SURVIVES preemption — the token
+    # values live on in output_token_ids, so their fate is still open; the
+    # recompute cost is charged separately (preempted_recompute) when
+    # resumed prefill re-processes generated positions.
+    ledger_pending: int = 0
     # absolute time.monotonic() after which this request is worthless to its
     # caller (x-request-deadline-ms, carried router → engine → scheduler);
     # None = no deadline. The scheduler sweeps expired requests out of

@@ -19,6 +19,7 @@ from ..qos import FairShareClock, TenantAccounting
 from .config import CacheConfig, ModelConfig, SchedulerConfig
 from .kv_cache import KVBlockPool, chain_hash
 from .request import Request, RequestStatus
+from .saturation import GoodputLedger
 from .spec_decode import propose_ngram
 
 
@@ -158,6 +159,11 @@ class Scheduler:
         self._evict_lock = threading.Lock()
         self._evict_rids: set[str] = set()
         self.shed_evictions = 0
+        # goodput ledger (docs/29-saturation-slo.md): every device-sampled
+        # token classified exactly once as delivered or wasted{reason}.
+        # Mutated only under the engine lock (postprocess / finish /
+        # preempt here, plus the engine's pipeline-rollback sites).
+        self.ledger = GoodputLedger()
 
     # -- admission ---------------------------------------------------------
 
@@ -734,6 +740,21 @@ class Scheduler:
         out, self._finished_externally = self._finished_externally, []
         return out
 
+    def goodput_balance(self) -> dict:
+        """Ledger balance audit: sampled == delivered + wasted + pending
+        tokens on live requests (docs/29-saturation-slo.md). Lives HERE —
+        the single definition of "live requests" — so the invariant can't
+        drift between the engine's view and scheduler-level tests."""
+        snap = self.ledger.snapshot()
+        snap["pending"] = sum(
+            r.ledger_pending for q in (self.running, self.waiting) for r in q
+        )
+        snap["balanced"] = (
+            snap["sampled"]
+            == snap["delivered"] + snap["wasted_total"] + snap["pending"]
+        )
+        return snap
+
     def _chain_root(self, req: Request) -> int:
         """Root of a request's KV hash chain. Base model = the pool root;
         LoRA requests salt it with their adapter's load-unique id — adapter
@@ -805,6 +826,12 @@ class Scheduler:
     def _preempt(self, req: Request) -> None:
         self.running.remove(req)
         self._release_blocks(req)
+        # goodput ledger: nothing to classify here — the preempted
+        # request's pending tokens keep their unknown fate (the VALUES
+        # survive in output_token_ids; they settle at finish). The
+        # recompute cost lands when resumed prefill actually re-processes
+        # generated positions (postprocess charges preempted_recompute
+        # chunk-exactly).
         req.num_computed_tokens = 0
         req.num_preemptions += 1
         self.total_preemptions += 1
@@ -917,16 +944,38 @@ class Scheduler:
                     if j < len(p) and int(m[j]) != p[j]:
                         break
                 accepted_rows.append(accepted)
+                # goodput ledger: the device argmax-sampled len(p)+1
+                # positions; everything past the first mismatch is a
+                # mispredicted draft — just another rollback (the accepted
+                # prefix is ledgered by the decode loop below)
+                rejected = len(p) + 1 - len(accepted)
+                self.ledger.sampled(rejected)
+                self.ledger.waste("rollback", rejected)
             work = DecodeWork(requests=work.requests)  # shared accounting
             sampled = accepted_rows
         if isinstance(work, PrefillWork):
             for i, req in enumerate(work.requests):
                 start = req.num_computed_tokens
-                req.num_computed_tokens = work.context_lens[i]
+                end = work.context_lens[i]
+                req.num_computed_tokens = end
                 self._register_full_blocks(req, start, work.context_lens[i])
+                # goodput ledger: chunk positions past the prompt are
+                # GENERATED tokens being re-computed after a preemption
+                # dropped their KV — the device samples through them again
+                # and the re-pass is pure waste (the values were already
+                # known). Counting them as sampled+wasted here keeps the
+                # partition exact: each token's FATE (pending → delivered/
+                # wasted at finish) is still classified exactly once.
+                recomputed = max(0, end - max(start, req.num_prompt_tokens))
+                self.ledger.sampled(recomputed)
+                self.ledger.waste("preempted_recompute", recomputed)
                 if work.sample[i]:
                     tok = sampled[i][0]
                     req.output_token_ids.append(tok)
+                    # goodput ledger: one sampled first token, pending until
+                    # the request's fate is known (finish / preemption)
+                    self.ledger.sampled(1)
+                    req.ledger_pending += 1
                     self._maybe_finish(req)
                     results.append((req, [tok]))
                 else:
@@ -936,7 +985,11 @@ class Scheduler:
                 if req.status.finished:
                     # finished while the step was in flight (async abort /
                     # stop-string hit): its blocks are already released and
-                    # its stream is closed — the sampled row is void
+                    # its stream is closed — the sampled row is void.
+                    # Ledger: the device executed the row for a request
+                    # nobody is waiting on — pipeline machinery waste
+                    self.ledger.sampled(len(row))
+                    self.ledger.waste("rollback", len(row))
                     results.append((req, []))
                     continue
                 # bulk accept: a decode window hands up to `window` candidate
@@ -961,6 +1014,13 @@ class Scheduler:
                             cut = j + 1
                             break
                 accepted = [int(t) for t in row[:cut]]
+                # goodput ledger: every candidate in the row was sampled on
+                # device; the tail past the stop/length cut is discarded
+                # overshoot, the accepted prefix stays pending on the
+                # request until its fate is known
+                self.ledger.sampled(len(row))
+                self.ledger.waste("overshoot", len(row) - len(accepted))
+                req.ledger_pending += len(accepted)
                 if proposal_lens is not None:
                     # every emitted token past the first rode a matched
                     # proposal; the first is the plain greedy/bonus token
@@ -1033,4 +1093,9 @@ class Scheduler:
 
         req.status = status
         req.finish_time = time.monotonic()
+        # goodput ledger: the request's fate is sealed — classify its
+        # pending tokens (delivered for stop/length; deadline_expired /
+        # shed_evicted / severed for the rest, saturation.FINISH_REASONS)
+        self.ledger.classify_finish(status.name, req.ledger_pending)
+        req.ledger_pending = 0
         self._release_blocks(req)

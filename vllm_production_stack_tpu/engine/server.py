@@ -1705,6 +1705,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-buffer", type=int, default=256,
                    help="finished request timelines kept in the in-process "
                         "ring buffer behind /debug/requests")
+    p.add_argument("--step-metering", default=True, type=_parse_bool_flag,
+                   help="per-step saturation accounting (docs/29-"
+                        "saturation-slo.md): decode-seat occupancy, "
+                        "padding-waste fraction, achieved-FLOP/s → MFU and "
+                        "the tpu:engine_step_* histograms, metered in the "
+                        "step loop. 'false' disables the meter; the "
+                        "goodput token ledger (tpu:goodput_tokens_total / "
+                        "tpu:wasted_tokens_total) stays on either way")
     p.add_argument("--prefill-buckets", default="",
                    help="comma-separated prefill chunk buckets (default: "
                         "pow2 ladder up to --max-num-batched-tokens). "
@@ -1877,6 +1885,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             args, "prefill_attention_backend", "auto"
         ),
         async_scheduling=getattr(args, "async_scheduling", True),
+        step_metering=getattr(args, "step_metering", True),
     )
 
 

@@ -103,6 +103,10 @@ class RemoteKVTier:
         self.fingerprint = fingerprint
         self.cooldown_s = cooldown_s
         self.stats = RemoteTierStats()
+        # last store-reported fill fraction (X-Store-Usage on PUT acks) —
+        # the engine's tpu:engine_kv_tier_usage_perc{tier="remote"} source;
+        # 0.0 until the first ack lands (docs/29-saturation-slo.md)
+        self.last_usage_perc = 0.0
         self._fetch_conn = _Conn(self.host, self.port, timeout)
         self._store_conn = _Conn(self.host, self.port, timeout)
         self._down_until = 0.0
@@ -167,7 +171,7 @@ class RemoteKVTier:
                 self.stats.dropped += 1
                 continue
             try:
-                status, _, _ = self._store_conn.request(
+                status, resp_headers, _ = self._store_conn.request(
                     "PUT",
                     f"/v1/blocks/{h}",
                     body=np.ascontiguousarray(arr).tobytes(),
@@ -186,6 +190,12 @@ class RemoteKVTier:
                 continue
             if status == 200:
                 self.stats.stores += 1
+                usage = resp_headers.get("X-Store-Usage")
+                if usage is not None:
+                    try:
+                        self.last_usage_perc = min(1.0, float(usage))
+                    except ValueError:
+                        pass
                 with self._stored_lock:
                     self._inflight.discard(h)
                     self._stored[h] = None

@@ -112,7 +112,18 @@ class KVStoreServer:
                 {"error": "empty block payload"}, status=400
             )
         self.store.put(fp, h, payload, meta)
-        return web.json_response({"stored": True, "nbytes": len(payload)})
+        # piggyback the store's fill fraction on the ack: engines surface
+        # it as tpu:engine_kv_tier_usage_perc{tier="remote"} without a
+        # dedicated polling round trip (docs/29-saturation-slo.md)
+        usage = (
+            self.store.total_bytes / self.store.capacity_bytes
+            if self.store.capacity_bytes > 0 else 0.0
+        )
+        return web.json_response(
+            {"stored": True, "nbytes": len(payload),
+             "usage_perc": round(usage, 6)},
+            headers={"X-Store-Usage": f"{usage:.6f}"},
+        )
 
     async def h_get(self, request: web.Request) -> web.Response:
         h = request.match_info["hash"]

@@ -107,6 +107,65 @@ REQUEST_PHASE_BUCKETS = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
 )
 
+# -- saturation & goodput (docs/29-saturation-slo.md) -----------------------
+# Per-step utilization accounting from the engine step loop
+# (engine/saturation.StepMeter) — the "why isn't the chip full" signals the
+# SLO rule pack (observability/rules/) and the KEDA/prom-adapter autoscaling
+# path key off. Gauges are resolve-cadence EWMAs (~10 s time constant).
+ENGINE_DECODE_SEAT_OCCUPANCY = "tpu:engine_decode_seat_occupancy"
+ENGINE_PADDING_WASTE_FRAC = "tpu:engine_padding_waste_frac"
+# analytic-model achieved FLOP/s and the MFU estimate (achieved / chip peak;
+# 0 when the peak is unknown — CPU backend or unrecognized device kind)
+ENGINE_ACHIEVED_FLOPS = "tpu:engine_achieved_flops_per_s"
+ENGINE_MFU = "tpu:engine_mfu"
+# per-tier KV occupancy, labeled tier="hbm"|"host"|"disk"|"remote" (remote
+# is the store-reported fill fraction piggybacked on PUT acks; 0 until the
+# first ack lands)
+ENGINE_KV_TIER_USAGE = "tpu:engine_kv_tier_usage_perc"
+# token split + padding accounting, labeled phase="prefill"|"decode":
+# step tokens are USEFUL tokens processed (prefill chunk tokens / decode
+# host-accepted tokens); padded tokens are the device-computed token slots
+# including bucket padding — padding-waste rate = 1 - step/padded by rule
+ENGINE_STEP_TOKENS = "tpu:engine_step_tokens_total"
+ENGINE_PADDED_TOKENS = "tpu:engine_padded_tokens_total"
+# cumulative analytic forward-pass FLOPs (rate() ÷ chip peak = MFU by rule)
+ENGINE_MODEL_FLOPS = "tpu:engine_model_flops_total"
+# per-resolved-step distributions (histograms): decode-seat occupancy
+# fraction, and the resolve-cadence wall per step labeled phase=
+ENGINE_STEP_OCCUPANCY = "tpu:engine_step_occupancy"
+ENGINE_STEP_WALL = "tpu:engine_step_wall_seconds"
+# goodput ledger (engine/saturation.GoodputLedger): every device-sampled
+# token classified exactly once — delivered + wasted == sampled at
+# quiescence. reason= is the CLOSED saturation.WASTE_REASONS set
+# (rollback | preempted_recompute | deadline_expired | severed |
+# shed_evicted | overshoot).
+GOODPUT_TOKENS = "tpu:goodput_tokens_total"
+WASTED_TOKENS = "tpu:wasted_tokens_total"
+# router-side: streams severed after headers (engine died mid-stream; the
+# truncated transfer is the client's only honest signal). Request-level —
+# the router can't see token boundaries; the engine-side ledger carries the
+# token cost of torn streams under wasted{reason="severed"}.
+ROUTER_SEVERED_STREAMS = "tpu:router_severed_streams_total"
+
+SATURATION_GAUGES = (
+    ENGINE_DECODE_SEAT_OCCUPANCY,
+    ENGINE_PADDING_WASTE_FRAC,
+    ENGINE_ACHIEVED_FLOPS,
+    ENGINE_MFU,
+    ENGINE_KV_TIER_USAGE,
+)
+SATURATION_COUNTERS = (
+    ENGINE_STEP_TOKENS,
+    ENGINE_PADDED_TOKENS,
+    ENGINE_MODEL_FLOPS,
+    GOODPUT_TOKENS,
+    WASTED_TOKENS,
+)
+SATURATION_HISTOGRAMS = (
+    ENGINE_STEP_OCCUPANCY,
+    ENGINE_STEP_WALL,
+)
+
 # -- cluster KV index (event-driven KV-aware routing) -----------------------
 # Exported by the KV controller's /metrics and re-exported by the router in
 # embedded-index mode (router/metrics.py). NOT part of the per-engine scrape
@@ -158,6 +217,12 @@ ALL_GAUGES = (
     HOST_KV_USAGE_PERC,
     STEP_OVERLAP_FRAC,
     ENGINE_DRAINING,
+    # saturation (docs/29-saturation-slo.md)
+    ENGINE_DECODE_SEAT_OCCUPANCY,
+    ENGINE_PADDING_WASTE_FRAC,
+    ENGINE_ACHIEVED_FLOPS,
+    ENGINE_MFU,
+    ENGINE_KV_TIER_USAGE,
 )
 ALL_COUNTERS = (
     PREFIX_CACHE_HITS,
@@ -178,4 +243,11 @@ ALL_COUNTERS = (
     TENANT_REQUESTS,
     TENANT_GENERATION_TOKENS,
     TENANT_SHED,
+    # saturation & goodput (docs/29-saturation-slo.md); phase=/reason=
+    # labels are closed sets, so cardinality is bounded by construction
+    ENGINE_STEP_TOKENS,
+    ENGINE_PADDED_TOKENS,
+    ENGINE_MODEL_FLOPS,
+    GOODPUT_TOKENS,
+    WASTED_TOKENS,
 )

@@ -68,6 +68,16 @@ class RouterMetrics:
             mc.ROUTER_UPSTREAM_FAILURES,
             "Upstream failures recorded against each endpoint",
         )
+        # goodput signal path (docs/29-saturation-slo.md): streams torn
+        # after headers went out (engine died mid-stream) — the requests
+        # whose partial output the engine-side ledger can't see. Request-
+        # level: the router proxies bytes and can't count token boundaries.
+        self.severed_streams = Counter(
+            mc.ROUTER_SEVERED_STREAMS[: -len("_total")],
+            "Streams severed after headers (engine died mid-stream; the "
+            "client saw a truncated transfer)",
+            registry=self.registry,
+        )
         # embedded cluster-KV-index (kvaware --kv-index-mode embedded):
         # contract names shared with the KV controller's /metrics
         # (metrics_contract.CLUSTER_KV_*), so dashboards key off ONE name

@@ -644,6 +644,10 @@ class RequestService:
         request.get(TRACE_KEY, NULL_TRACE).event(
             "severed", url=backend_url, cause=type(e).__name__
         )
+        # goodput signal path (docs/29-saturation-slo.md): the engine that
+        # produced this stream died with it, so its ledger can't classify
+        # the tokens — the router's request-level counter is the record
+        self.state.metrics.severed_streams.inc()
         self.state.breakers.on_failure(backend_url)
         resp.force_close()
         if request.transport is not None:
