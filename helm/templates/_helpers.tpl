@@ -111,6 +111,10 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--step-metering"
 - "false"
 {{- end }}
+{{- if eq (.kvFlowMetering | default true) false }}
+- "--kv-flow-metering"
+- "false"
+{{- end }}
 {{- if eq (.enablePrefixCaching | default true) false }}
 - "--no-enable-prefix-caching"
 {{- end }}

@@ -19,6 +19,17 @@ other direction:
       recorded-rule name the pack itself defines) — a rule keying off a
       series nobody emits would silently never fire.
 
+Closed label sets (metrics_contract.METRIC_LABEL_VALUES) are validated
+BOTH ways too:
+
+  (d) the exporters must render EXACTLY the declared values for each
+      closed-set label (a reason/tier/source added in code but missing
+      from the contract — or vice versa — fails here), and
+  (e) every literal label matcher in the dashboard or rule pack naming a
+      closed-set label must use a declared value — a typo'd
+      tier="dsk" used to pass the checker silently and produce a panel
+      that reads empty forever.
+
 A name failing (a) is a dead contract entry (dashboards key off a series
 nobody emits); a name failing (b) is a silent metric (emitted telemetry
 nobody can discover). Both rotted unnoticed before this check existed —
@@ -51,6 +62,14 @@ RULES_DIR = os.path.join(REPO, "observability", "rules")
 # a PromQL series token: the tpu: prefix plus name characters. Recorded
 # rule names legitimately carry extra colons (tpu:goodput_ratio:rate5m).
 _SERIES_RE = re.compile(r"tpu:[A-Za-z0-9_:]+")
+
+# a series token immediately followed by a brace selector — the label
+# matchers the closed-set validation inspects
+_SELECTOR_RE = re.compile(r"(tpu:[A-Za-z0-9_:]+)\{([^}]*)\}")
+# one label matcher inside a selector; group(2) is the operator — only
+# plain equality against a literal is checked (regex/negative matchers
+# are not closed-set claims)
+_MATCHER_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_]*)\s*(=~|!=|!~|=)\s*\"([^\"]*)\"")
 
 
 def contract_names() -> list[str]:
@@ -161,6 +180,96 @@ def check_rules() -> list[str]:
     return problems
 
 
+def _declared_label_sets() -> dict[str, dict[str, tuple[str, ...]]]:
+    from vllm_production_stack_tpu import metrics_contract as mc
+
+    return mc.METRIC_LABEL_VALUES
+
+
+def check_exported_label_sets() -> list[str]:
+    """(d): for every metric with a declared closed label set, the engine
+    exporter must render EXACTLY the declared values — the exporters seed
+    closed sets at zero, so a missing value means the seeding (or the
+    declaration) drifted, and an extra value means unbounded cardinality
+    snuck in."""
+    from vllm_production_stack_tpu.engine.metrics import EngineMetrics
+
+    declared = _declared_label_sets()
+    # contract names spell counters with _total; sample names drop it
+    by_base = {
+        (n[: -len("_total")] if n.endswith("_total") else n): (n, labels)
+        for n, labels in declared.items()
+    }
+    rendered: dict[str, dict[str, set]] = {}
+    for metric in EngineMetrics("contract-check").registry.collect():
+        entry = by_base.get(metric.name)
+        if entry is None:
+            continue
+        name, labels = entry
+        got = rendered.setdefault(name, {lab: set() for lab in labels})
+        for sample in metric.samples:
+            for lab in labels:
+                if lab in sample.labels:
+                    got[lab].add(sample.labels[lab])
+    problems: list[str] = []
+    for name, labels in declared.items():
+        got = rendered.get(name)
+        if got is None:
+            problems.append(
+                f"{name}: declares closed label sets but the engine "
+                "exporter renders no such metric"
+            )
+            continue
+        for lab, want in labels.items():
+            have = got.get(lab, set())
+            if have != set(want):
+                problems.append(
+                    f"{name}: label {lab}= renders {sorted(have)} but the "
+                    f"contract declares {sorted(want)}"
+                )
+    return problems
+
+
+def check_reference_label_values() -> list[str]:
+    """(e): every literal equality matcher in the dashboard / rule pack
+    that names a closed-set label of a contract metric must use a
+    declared value."""
+    declared = _declared_label_sets()
+    # resolve histogram wire series (_bucket/_count/_sum) and counter
+    # _total spellings back to the declaring contract name
+    resolve: dict[str, str] = {}
+    for name in declared:
+        resolve[name] = name
+        base = name[: -len("_total")] if name.endswith("_total") else name
+        resolve[base] = name
+        for suffix in ("_bucket", "_count", "_sum"):
+            resolve[f"{name}{suffix}"] = name
+    texts: list[tuple[str, str]] = []
+    dash = os.path.join(REPO, "observability", "tpu-dashboard.json")
+    if os.path.isfile(dash):
+        with open(dash, encoding="utf-8") as f:
+            texts.append(("tpu-dashboard.json", f.read()))
+    for path in rule_files():
+        with open(path, encoding="utf-8") as f:
+            texts.append((os.path.basename(path), f.read()))
+    problems: list[str] = []
+    for fname, text in texts:
+        for m in _SELECTOR_RE.finditer(text):
+            name = resolve.get(m.group(1))
+            if name is None:
+                continue
+            labels = declared[name]
+            for lab, op, value in _MATCHER_RE.findall(m.group(2)):
+                if lab not in labels or op != "=":
+                    continue
+                if value not in labels[lab]:
+                    problems.append(
+                        f"{fname}: {m.group(1)} matcher {lab}={value!r} is "
+                        f"not in the closed set {list(labels[lab])}"
+                    )
+    return problems
+
+
 def check() -> list[str]:
     """All drift violations, empty when the contract is clean."""
     exported = exported_names()
@@ -177,6 +286,8 @@ def check() -> list[str]:
                 "rules, the SLO rule pack, or docs"
             )
     problems.extend(check_rules())
+    problems.extend(check_exported_label_sets())
+    problems.extend(check_reference_label_values())
     return problems
 
 

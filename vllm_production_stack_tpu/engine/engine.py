@@ -79,6 +79,13 @@ class EngineStatsSnapshot:
     # plus "goodput" (the token-fate ledger) and "kv_tiers" (per-tier
     # occupancy hbm/host/disk/remote) — rendered by EngineMetrics
     saturation: dict = field(default_factory=dict)
+    # KV flow telemetry (docs/30-kv-flow-telemetry.md): the KVFlowMeter
+    # snapshot — per-(tier, direction) bytes/blocks/latency/bandwidth plus
+    # the hydration-source partition counters — rendered by EngineMetrics
+    kv_flow: dict = field(default_factory=dict)
+    # disk-tier block counters (the rung between HOST_KV_* and REMOTE_KV_*)
+    disk_kv_stores: int = 0
+    disk_kv_loads: int = 0
 
 
 @dataclass
@@ -161,6 +168,15 @@ class LLMEngine:
                 )
             ).encode()
         ).hexdigest()[:16]
+        # KV flow meter (docs/30-kv-flow-telemetry.md): ONE instance shared
+        # by every tier object — host ring, disk tier, remote client,
+        # device-path PD transfer — plus the scheduler's hydration
+        # attribution. Transfer metering is togglable
+        # (--kv-flow-metering false); the hydration partition counters are
+        # contract series and stay on, like the goodput ledger.
+        from .kv_flow import KVFlowMeter
+
+        self.flow = KVFlowMeter(enabled=config.kv_flow_metering)
         self.host_tier = None
         self.remote_tier = None
         num_host_blocks = config.cache.num_host_blocks
@@ -182,7 +198,8 @@ class LLMEngine:
             from ..kvstore.client import RemoteKVTier
 
             self.remote_tier = RemoteKVTier(
-                config.cache.remote_kv_url, self.model_fingerprint
+                config.cache.remote_kv_url, self.model_fingerprint,
+                flow=self.flow,
             )
             # the remote tier stages through the host ring; give it a
             # minimal ring even when CPU offload wasn't asked for
@@ -195,6 +212,7 @@ class LLMEngine:
                 config.cache.disk_kv_dir,
                 int(config.cache.disk_kv_gib * 2**30),
                 fingerprint=self.model_fingerprint,
+                flow=self.flow,
             )
             num_host_blocks = max(num_host_blocks, 16)
         if num_host_blocks > 0:
@@ -207,11 +225,13 @@ class LLMEngine:
                 remote=self.remote_tier,
                 upload_blocks=self.runner.upload_blocks,
                 disk=disk_tier,
+                flow=self.flow,
             )
         self.scheduler = Scheduler(
             config.model, config.cache, config.scheduler,
             host_tier=self.host_tier,
             need_slot_mappings=config.parallel.sequence_parallel_size > 1,
+            flow=self.flow,
         )
         if self.runner.kv_caches:
             # page geometry the remote-match path validates fetched blocks
@@ -1160,6 +1180,45 @@ class LLMEngine:
         `balanced`."""
         return self.scheduler.goodput_balance()
 
+    def hydration_signal(self) -> dict:
+        """The compute-or-load planner's inputs (ROADMAP item 3,
+        docs/30-kv-flow-telemetry.md): measured fetch bandwidth per tier
+        alongside the analytic prefill FLOP/s. The planner's per-chunk
+        decision is `block_bytes / fetch_bw` (load cost) vs
+        `block_size_tokens × flops_per_token / prefill_flops_per_s`
+        (recompute cost) — both denominators MEASURED here, not guessed.
+        prefill_flops_per_s is the StepMeter's achieved-FLOP/s EWMA (0
+        before any step resolves — fall back to a peak_flops_per_s
+        fraction until traffic warms it); bandwidths are 0 for tiers that
+        have never moved bytes."""
+        from .kv_flow import TRANSFER_TIERS
+        from .memory import kv_block_bytes
+        from .saturation import matmul_params
+
+        bw = self.flow.bandwidth_bytes_per_s()
+        sat = self.meter.snapshot()
+        return {
+            "fetch_bandwidth_bytes_per_s": {
+                tier: bw[(tier, "in")] for tier in TRANSFER_TIERS
+            },
+            "store_bandwidth_bytes_per_s": {
+                tier: bw[(tier, "out")] for tier in TRANSFER_TIERS
+            },
+            "prefill_flops_per_s": sat["achieved_flops_per_s"],
+            "peak_flops_per_s": sat["peak_flops_per_s"],
+            "flops_per_token": 2.0 * matmul_params(self.config.model),
+            "block_bytes": kv_block_bytes(
+                self.config.model,
+                self.config.cache.block_size,
+                self.config.parallel.tensor_parallel_size,
+                self.config.parallel.pipeline_parallel_size,
+                kv_dtype=self.config.cache.resolved_kv_dtype(
+                    self.config.model.dtype
+                ),
+            ),
+            "block_size_tokens": self.config.cache.block_size,
+        }
+
     def _emit_results(
         self, results, lp_rows, outputs: list[RequestOutput]
     ) -> None:
@@ -1261,6 +1320,10 @@ class LLMEngine:
                 "cached_prompt_tokens": req.num_cached_prompt_tokens,
                 "preemptions": req.num_preemptions,
             }
+            # hydration-source partition for the trace timeline's
+            # kv_hydration event (docs/30-kv-flow-telemetry.md); None for
+            # requests that never got a seat
+            out.hydration = req.hydration
         return out
 
     @staticmethod
@@ -1345,8 +1408,12 @@ class LLMEngine:
         saturation = self.meter.snapshot()
         saturation["goodput"] = self.scheduler.ledger.snapshot()
         saturation["kv_tiers"] = self._kv_tier_usage()
+        disk = self.host_tier.disk if self.host_tier is not None else None
         return EngineStatsSnapshot(
             saturation=saturation,
+            kv_flow=self.flow.snapshot(),
+            disk_kv_stores=disk.stats.stores if disk is not None else 0,
+            disk_kv_loads=disk.stats.loads if disk is not None else 0,
             num_requests_running=self.scheduler.num_running,
             num_requests_waiting=self.scheduler.num_waiting,
             kv_usage_perc=pool.usage_perc,

@@ -118,6 +118,10 @@ class KVBlockPool:
         # refcount-0 cached blocks, LRU order (oldest first -> evicted first)
         self._evictable: OrderedDict[int, None] = OrderedDict()
         self.stats = CacheStats()
+        # per-block KV origin of the LAST match_prefix call ("hbm" | "host"
+        # | "disk" | "remote", parallel to its return) — consumed by the
+        # scheduler's hydration attribution before the next match runs
+        self.last_match_sources: list[str] = []
         if enable_prefix_caching:
             # warm the native batch hasher NOW (pool construction = engine
             # init, where XLA compiles already dominate) — never lazily from
@@ -197,8 +201,15 @@ class KVBlockPool:
         reference on every matched block. `parent` is the chain root — the
         scheduler salts it per LoRA adapter so base and adapter KV never
         cross-match (their K/V bytes differ when k/v projections carry
-        deltas)."""
+        deltas).
+
+        Hydration attribution (docs/30-kv-flow-telemetry.md): alongside
+        the matched blocks, `last_match_sources` records where each came
+        from — "hbm" | "host" | "disk" | "remote", parallel to the return
+        value — so the scheduler can classify the request's prompt tokens
+        by KV origin exactly once at admission."""
         matched: list[int] = []
+        self.last_match_sources = sources = []
         if not self.enable_prefix_caching:
             return matched
         hashes = list(
@@ -208,16 +219,20 @@ class KVBlockPool:
             self.stats.queries += 1
             blk = self._hash_to_block.get(h)
             if blk is None:
-                blk = self._reload_from_host(h)
+                blk, source = self._reload_from_host(h)
                 if blk is None:
                     # both local tiers miss: continue the chain into the
                     # remote store (one batched mget for the remainder)
-                    matched.extend(self._match_remote(hashes[idx:]))
+                    remote = self._match_remote(hashes[idx:])
+                    matched.extend(remote)
+                    sources.extend(["remote"] * len(remote))
                     break
             else:
                 self._acquire(blk)
+                source = "hbm"
             self.stats.hits += 1
             matched.append(blk)
+            sources.append(source)
         return matched
 
     def _match_remote(self, hashes: list[int]) -> list[int]:
@@ -327,20 +342,23 @@ class KVBlockPool:
         for blk in pinned:
             self.free_block(blk)
 
-    def _reload_from_host(self, h: int) -> int | None:
-        """Host-tier continuation of a prefix match: allocate an HBM block and
-        upload hash h's offloaded pages into it."""
+    def _reload_from_host(self, h: int) -> tuple[int | None, str]:
+        """Host-tier continuation of a prefix match: allocate an HBM block
+        and upload hash h's offloaded pages into it. Returns (block, rung)
+        where rung is "host" (ring hit) or "disk" (promoted off the disk
+        tier) — the hydration-attribution distinction."""
         if self.host_tier is None or h not in self.host_tier:
-            return None
+            return None, ""
         blk = self.allocate()  # may itself evict (and offload) another block
         if blk is None:
-            return None
-        if not self.host_tier.reload_into(h, blk):  # raced an eviction
+            return None, ""
+        source = self.host_tier.reload_into(h, blk)
+        if not source:  # raced an eviction
             self.free_block(blk)
-            return None
+            return None, ""
         self._hash_to_block[h] = blk
         self._block_to_hash[blk] = h
-        return blk
+        return blk, source
 
     def match_length(
         self, token_ids: list[int], parent: int | None = None

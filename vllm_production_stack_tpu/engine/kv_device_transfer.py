@@ -31,6 +31,7 @@ remains the cross-cluster / cross-runtime fallback.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import numpy as np
@@ -38,12 +39,33 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
 from ..utils.logging import init_logger
+from .kv_flow import NULL_FLOW
 
 logger = init_logger(__name__)
 
 
 def _pow2(n: int) -> int:
     return 1 << max(0, n - 1).bit_length()
+
+
+def _block_nbytes(kv_caches) -> int:
+    """Bytes of ONE pool block across all layers: each leaf is
+    (2, num_blocks, block_size, kv_heads, head_dim), so a block's payload
+    is everything but the block axis."""
+    total = 0
+    for leaf in kv_caches:
+        shape = leaf.shape
+        n = shape[0]
+        for d in shape[2:]:
+            n *= d
+        total += n * leaf.dtype.itemsize
+    return total
+
+
+def _engine_flow(engine):
+    """The engine's KV flow meter; NULL_FLOW for pre-telemetry test
+    doubles."""
+    return getattr(engine, "flow", None) or NULL_FLOW
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -132,6 +154,27 @@ def ship_kv_device(
         src_idx[i] = src_by_hash[h]
         dst_idx[i] = dblk
 
+    # flow metering (docs/30-kv-flow-telemetry.md): the source records a
+    # device/out sample, the destination device/in — bytes are the useful
+    # payload (staged blocks × per-block bytes; padding slots excluded).
+    # Recorded in the failure path too with 0 bytes moved: a stalled or
+    # faulted PD transfer must surface in tpu:kv_transfer_seconds{tier=
+    # "device"} rather than vanish (the chaos harness asserts this).
+    xfer_bytes = len(staged) * _block_nbytes(src_engine.runner.kv_caches)
+    t0 = time.perf_counter()
+
+    def _flow(ok: bool) -> None:
+        elapsed = time.perf_counter() - t0
+        nbytes = xfer_bytes if ok else 0
+        nblocks = len(staged) if ok else 0
+        _engine_flow(src_engine).record(
+            "device", "out", nbytes, nblocks, elapsed
+        )
+        if dst_engine is not src_engine:
+            _engine_flow(dst_engine).record(
+                "device", "in", nbytes, nblocks, elapsed
+            )
+
     try:
         gathered = _gather_blocks(
             src_engine.runner.kv_caches,
@@ -164,8 +207,10 @@ def ship_kv_device(
             ),
         )
     except Exception:
+        _flow(ok=False)
         dst_pool.abort_adoption(staged, pinned)
         raise
+    _flow(ok=True)
     dst_pool.commit_adoption(staged, pinned)
     logger.info(
         "device-shipped %d KV blocks (%d offered) prefill→decode",
@@ -386,6 +431,20 @@ def ship_kv_device_crossproc(
         )
         return 0
 
+    # flow metering: each role records its own half of the hop (source
+    # device/out, destination device/in); the failure path records the
+    # elapsed wall at 0 bytes so a wedged collective is visible in
+    # tpu:kv_transfer_seconds{tier="device"} instead of vanishing
+    xfer_bytes = n_ship * _block_nbytes(engine.runner.kv_caches)
+    t_xfer = time.perf_counter()
+
+    def _flow(ok: bool) -> None:
+        _engine_flow(engine).record(
+            "device", "out" if is_src else "in",
+            xfer_bytes if ok else 0, n_ship if ok else 0,
+            time.perf_counter() - t_xfer,
+        )
+
     try:
         # THE transfer: one pairwise shard flip per kvh chunk — each is a
         # collective permute between src_devs[j] and dst_devs[j] over
@@ -443,9 +502,11 @@ def ship_kv_device_crossproc(
                 ),
             )
     except Exception:
+        _flow(ok=False)
         if staged or pinned:
             pool.abort_adoption(staged, pinned)
         raise
+    _flow(ok=True)
     if not is_src:
         pool.commit_adoption(staged, pinned)
         logger.info(

@@ -17,12 +17,14 @@ on the prefix-match path, each saving an entire chunk of prefill compute.
 from __future__ import annotations
 
 import os
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 
 import numpy as np
 
 from ..utils.logging import init_logger
+from .kv_flow import NULL_FLOW
 
 logger = init_logger(__name__)
 
@@ -50,11 +52,15 @@ class DiskKVTier:
 
     SUFFIX = ".kvb"
 
-    def __init__(self, directory: str, max_bytes: int, fingerprint: str = ""):
+    def __init__(self, directory: str, max_bytes: int, fingerprint: str = "",
+                 flow=None):
         self.dir = os.path.join(directory, fingerprint or "default")
         os.makedirs(self.dir, exist_ok=True)
         self.max_bytes = max_bytes
         self.stats = DiskTierStats()
+        # KV flow meter (engine/kv_flow.py): store/load record bytes +
+        # wall latency under tier="disk"
+        self.flow = flow if flow is not None else NULL_FLOW
         # cluster-KV-index hook (wired by KVBlockPool): called when a hash
         # leaves this tier (budget eviction or corrupt-file unlink) — the
         # last local rung, so a drop here can end local matchability
@@ -101,17 +107,26 @@ class DiskKVTier:
             h, np.ascontiguousarray(arr).tobytes(), arr.dtype.name,
             list(arr.shape),
         )
+        t0 = time.perf_counter()
         try:
             with open(tmp, "wb") as f:
                 f.write(payload)
             os.replace(tmp, path)
         except OSError as e:  # full/readonly disk: a cache degrades, never fails
             logger.warning("disk KV store of %x failed: %s", h, e)
+            # the attempt's wall time is real (a dying disk shows up as
+            # collapsing disk/out bandwidth, not silence)
+            self.flow.record(
+                "disk", "out", 0, 0, time.perf_counter() - t0
+            )
             try:
                 os.unlink(tmp)
             except OSError:
                 pass
             return
+        self.flow.record(
+            "disk", "out", len(payload), 1, time.perf_counter() - t0
+        )
         self._index[h] = len(payload)
         self.total_bytes += len(payload)
         self.stats.stores += 1
@@ -131,6 +146,7 @@ class DiskKVTier:
             return None
         from .kv_transfer import FrameParser
 
+        t0 = time.perf_counter()
         try:
             with open(self._path(h), "rb") as f:
                 frames = FrameParser().feed(f.read())
@@ -153,7 +169,13 @@ class DiskKVTier:
                 pass
             if self.on_drop is not None:
                 self.on_drop(h)
+            self.flow.record(
+                "disk", "in", 0, 0, time.perf_counter() - t0
+            )
             return None
+        self.flow.record(
+            "disk", "in", arr.nbytes, 1, time.perf_counter() - t0
+        )
         self._index.move_to_end(h)
         self.stats.loads += 1
         return arr

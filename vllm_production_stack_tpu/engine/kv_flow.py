@@ -1,0 +1,206 @@
+"""KV-hierarchy flow telemetry (docs/30-kv-flow-telemetry.md).
+
+The tiering stack's occupancy gauges (tpu:engine_kv_tier_usage_perc) say
+how FULL each tier is; this module measures how fast bytes actually MOVE
+between tiers and where each request's prefix actually came from — the
+measurement substrate the compute-or-load hydration planner (ROADMAP
+item 3, "Compute Or Load KV Cache? Why Not Both?") needs before it can
+pick load-vs-recompute per chunk by measured fetch bandwidth vs prefill
+FLOP/s.
+
+Two instruments, mirroring the PR 6 StepMeter/GoodputLedger split:
+
+- **Transfer meters** (togglable, ``--kv-flow-metering false``): every
+  tier move — host-ring offload/reload, disk store/load, remote
+  put/fetch, device-path PD transfer — records bytes, blocks and wall
+  latency into per-(tier, direction) counters, a fixed-bucket latency
+  histogram, and a :class:`TierBandwidth` recent-mean estimator. Plain
+  ints under one small lock (transfers are orders of magnitude rarer
+  than steps); the exporter renders histograms from cumulative bucket
+  counts at scrape time, so no prometheus objects ride the engine or
+  writer threads.
+
+- **Hydration attribution** (always on, like the goodput ledger — its
+  counters are part of the metric contract): every admitted request's
+  prompt tokens are classified EXACTLY once by KV origin, and the
+  partition is audited::
+
+      hbm_hit + host_reload + disk_load + remote_fetch + recomputed
+          == prompt_tokens
+
+Direction semantics: ``"in"`` moves bytes toward the HBM pool
+(hydration — reload/load/fetch/PD-adopt), ``"out"`` moves them away
+(offload — store/put/PD-export). ``tier`` names the non-HBM side of the
+hop, so a disk block promoted through the ring into HBM records one
+``disk/in`` sample (disk → RAM) and one ``host/in`` sample (RAM → HBM):
+per-tier meters count HOPS, not end-to-end journeys.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+from .. import metrics_contract as mc
+from .saturation import _Hist
+
+TRANSFER_TIERS = mc.KV_TRANSFER_TIERS
+DIRECTIONS = mc.KV_TRANSFER_DIRECTIONS
+HYDRATION_SOURCES = mc.KV_HYDRATION_SOURCES
+
+# wall seconds per transfer batch: spans sub-µs host copies to multi-second
+# remote fetches over a cold link
+TRANSFER_SECONDS_BUCKETS = (
+    1e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+_BW_TAU_S = 60.0  # horizon of the recent-mean bandwidth estimator
+
+
+class TierBandwidth:
+    """Time-decayed recent-mean bandwidth for one (tier, direction).
+
+    Separate exponentially-decayed accumulators for bytes and busy
+    seconds, decayed by the WALL gap since the previous sample; the
+    estimate is their ratio. For a burst of back-to-back transfers this
+    converges to total_bytes / total_seconds (a plain duration-weighted
+    mean — robust to microsecond samples where a per-sample-value EWMA
+    would be noise), while samples older than ~:data:`_BW_TAU_S` fade
+    out. The estimate deliberately does NOT decay toward zero when idle:
+    it answers "how fast CAN this tier move bytes", a capability the
+    hydration planner consults exactly when the tier has been idle —
+    unlike the occupancy EWMAs, which measure utilization and must fall.
+
+    A failed transfer recorded as (0 bytes, elapsed) drags the estimate
+    toward zero honestly: during a remote-store outage the measured
+    fetch bandwidth IS ~0, which is precisely what should flip the
+    planner to recompute.
+    """
+
+    __slots__ = ("_bytes", "_seconds", "_last_t", "samples")
+
+    def __init__(self) -> None:
+        self._bytes = 0.0
+        self._seconds = 0.0
+        self._last_t: float | None = None
+        self.samples = 0
+
+    def record(self, nbytes: int, seconds: float, now: float) -> None:
+        if self._last_t is not None:
+            decay = math.exp(-max(0.0, now - self._last_t) / _BW_TAU_S)
+            self._bytes *= decay
+            self._seconds *= decay
+        self._last_t = now
+        self._bytes += nbytes
+        self._seconds += max(seconds, 1e-9)
+        self.samples += 1
+
+    @property
+    def bytes_per_s(self) -> float:
+        return self._bytes / self._seconds if self._seconds > 0 else 0.0
+
+
+class KVFlowMeter:
+    """Per-tier transfer meters + per-request hydration attribution.
+
+    One instance per engine, shared by every tier object (host ring,
+    disk tier, remote client, device-path transfer) — the engine thread,
+    the remote writer thread and HTTP executor threads all record here,
+    so mutation happens under ``_lock``. ``enabled=False`` turns
+    :meth:`record` into a no-op (the bench's ``kvflow`` phase measures
+    the difference); hydration attribution stays on regardless, because
+    its counters are contract series the dashboard's hydration panel
+    keys off (same always-on rule as the goodput ledger).
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self.bytes: dict[tuple[str, str], int] = {}
+        self.blocks: dict[tuple[str, str], int] = {}
+        self.transfers: dict[tuple[str, str], int] = {}
+        self.seconds: dict[tuple[str, str], _Hist] = {}
+        self.bandwidth: dict[tuple[str, str], TierBandwidth] = {}
+        for tier in TRANSFER_TIERS:
+            for direction in DIRECTIONS:
+                key = (tier, direction)
+                self.bytes[key] = 0
+                self.blocks[key] = 0
+                self.transfers[key] = 0
+                self.seconds[key] = _Hist(TRANSFER_SECONDS_BUCKETS)
+                self.bandwidth[key] = TierBandwidth()
+        # audited partition counters (tokens), keyed by HYDRATION_SOURCES
+        self.hydration: dict[str, int] = {s: 0 for s in HYDRATION_SOURCES}
+        self.hydrated_requests = 0
+
+    # -- transfer meters (togglable) ----------------------------------------
+
+    def record(
+        self, tier: str, direction: str, nbytes: int, blocks: int,
+        seconds: float,
+    ) -> None:
+        """One transfer batch: `blocks` KV blocks totalling `nbytes` moved
+        in `seconds` of wall time. A FAILED transfer should still be
+        recorded with whatever partial batch completed (possibly 0 bytes)
+        — the elapsed time is real, and losing it would overstate the
+        tier's bandwidth exactly when the planner most needs the truth."""
+        if not self.enabled:
+            return
+        key = (tier, direction)  # unknown tier/direction: KeyError, loud
+        now = time.perf_counter()
+        with self._lock:
+            self.bytes[key] += int(nbytes)
+            self.blocks[key] += int(blocks)
+            self.transfers[key] += 1
+            self.seconds[key].observe(seconds)
+            self.bandwidth[key].record(int(nbytes), seconds, now)
+
+    # -- hydration attribution (always on) ----------------------------------
+
+    def record_hydration(self, counts: dict[str, int]) -> None:
+        """One admitted request's prompt-token partition. Keys must come
+        from HYDRATION_SOURCES (closed set — a typo fails loud, even at
+        count 0: a mistyped key that's usually zero would otherwise drop
+        tokens from the audited partition only on the rare nonzero hit)."""
+        with self._lock:
+            for source, n in counts.items():
+                self.hydration[source] += int(n)
+            self.hydrated_requests += 1
+
+    # -- reporting -----------------------------------------------------------
+
+    def bandwidth_bytes_per_s(self) -> dict[tuple[str, str], float]:
+        with self._lock:
+            return {k: bw.bytes_per_s for k, bw in self.bandwidth.items()}
+
+    def snapshot(self) -> dict:
+        """Cumulative counters + histograms + bandwidth estimates, in the
+        shape EngineMetrics renders (keys are "tier/direction")."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "bytes": {f"{t}/{d}": v for (t, d), v in self.bytes.items()},
+                "blocks": {
+                    f"{t}/{d}": v for (t, d), v in self.blocks.items()
+                },
+                "transfers": {
+                    f"{t}/{d}": v for (t, d), v in self.transfers.items()
+                },
+                "seconds_hist": {
+                    f"{t}/{d}": h.snapshot()
+                    for (t, d), h in self.seconds.items()
+                },
+                "bandwidth_bytes_per_s": {
+                    f"{t}/{d}": bw.bytes_per_s
+                    for (t, d), bw in self.bandwidth.items()
+                },
+                "hydration": dict(self.hydration),
+                "hydrated_requests": self.hydrated_requests,
+            }
+
+
+# Shared disabled singleton for tier objects constructed without an engine
+# (unit tests, standalone tools): call sites never branch on `if flow:`.
+NULL_FLOW = KVFlowMeter(enabled=False)

@@ -15,10 +15,13 @@ under the same hash.
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 
 import numpy as np
+
+from .kv_flow import NULL_FLOW
 
 
 @dataclass
@@ -35,8 +38,12 @@ class HostKVTier:
     `reload_into` from prefix matching."""
 
     def __init__(self, num_blocks: int, fetch_block, upload_block,
-                 remote=None, upload_blocks=None, disk=None):
+                 remote=None, upload_blocks=None, disk=None, flow=None):
         self.num_blocks = num_blocks
+        # KV flow meter (engine/kv_flow.py): tier moves record bytes/
+        # blocks/latency here; NULL_FLOW no-ops when metering is off or
+        # the tier is constructed standalone
+        self.flow = flow if flow is not None else NULL_FLOW
         # fetch returns per-layer device slices with host copies STARTED
         # (ModelRunner.fetch_block); entries resolve to numpy one store
         # behind, so the device→host transfer overlaps the next step instead
@@ -67,7 +74,14 @@ class HostKVTier:
         if entry is None:
             return None
         if not isinstance(entry, np.ndarray):
+            # the HBM→host hop materializes HERE: np.asarray blocks until
+            # the async device→host copy lands, then the stack builds the
+            # block's host bytes — the honest wall cost of the offload
+            t0 = time.perf_counter()
             entry = np.stack([np.asarray(p) for p in entry])
+            self.flow.record(
+                "host", "out", entry.nbytes, 1, time.perf_counter() - t0
+            )
             self._data[h] = entry
             if self.remote is not None:
                 self.remote.put_async(h, entry)
@@ -145,42 +159,63 @@ class HostKVTier:
                 self.on_drop(evicted)
             self.stats.evictions += 1
 
-    def reload_into(self, h: int, device_block: int) -> bool:
+    def reload_into(self, h: int, device_block: int) -> str:
         """Upload hash h's pages into a freshly allocated device block.
-        Returns False if h is not resident in the ring OR on disk. The
-        entry stays resident (it may be needed again after the device copy
-        is evicted); a disk hit promotes back into the ring."""
+        Returns the serving rung — "host" (ring hit) or "disk" (disk hit,
+        promoted back into the ring) — or "" if h is resident in neither
+        (falsy, so boolean call sites keep working). The entry stays
+        resident (it may be needed again after the device copy is
+        evicted)."""
+        source = "host"
         data = self._resolve(h)
         if data is None:
             if self.disk is None:
-                return False
-            data = self.disk.load(h)
+                return ""
+            data = self.disk.load(h)  # records the disk/in hop itself
             if data is None:
-                return False
+                return ""
+            source = "disk"
             self.insert_resolved(h, data)  # promote: next match stays in RAM
         else:
             if h in self._pending:
                 self._pending.remove(h)
             self._data.move_to_end(h)
+        t0 = time.perf_counter()
         self._upload(device_block, data)
+        self.flow.record(
+            "host", "in", data.nbytes, 1, time.perf_counter() - t0
+        )
         self.stats.reloads += 1
-        return True
+        return source
 
     # -- remote-tier cooperation (kvstore.client.RemoteKVTier) -------------
 
     def upload(self, device_block: int, data: np.ndarray) -> None:
         """Host→HBM upload for blocks sourced OUTSIDE the ring (remote
         fetches) — same runner callback the reload path uses."""
+        t0 = time.perf_counter()
         self._upload(device_block, data)
+        self.flow.record(
+            "host", "in", data.nbytes, 1, time.perf_counter() - t0
+        )
 
     def upload_many(self, device_blocks: list[int], data) -> None:
         """Batched host→HBM for remote-fetched runs: one device dispatch
         when the runner supports it, per-block otherwise."""
+        t0 = time.perf_counter()
         if self._upload_many is not None:
-            self._upload_many(device_blocks, np.stack(data))
+            stacked = np.stack(data)
+            self._upload_many(device_blocks, stacked)
+            nbytes = stacked.nbytes
         else:
+            nbytes = 0
             for blk, d in zip(device_blocks, data):
                 self._upload(blk, d)
+                nbytes += np.asarray(d).nbytes
+        self.flow.record(
+            "host", "in", nbytes, len(device_blocks),
+            time.perf_counter() - t0,
+        )
 
     def insert_resolved(self, h: int, data: np.ndarray) -> None:
         """Promote a remote-fetched block into the ring so the next match is

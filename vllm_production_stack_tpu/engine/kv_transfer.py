@@ -83,37 +83,67 @@ class FrameParser:
     def __init__(self, max_frame_bytes: int = 256 << 20):
         self._buf = bytearray()
         self.max_frame_bytes = max_frame_bytes
+        # first parse failure in partial mode (feed_partial); once set,
+        # the parser is dead — further feeds return nothing
+        self.error: Exception | None = None
+
+    def _next_frame(self) -> tuple[int, np.ndarray] | None:
+        """Parse ONE complete frame off the buffer, None if the buffered
+        bytes don't yet hold a whole frame. Raises on a corrupt frame."""
+        if len(self._buf) < 4:
+            return None
+        head_len = struct.unpack_from("<I", self._buf)[0]
+        if head_len > self.max_frame_bytes:
+            raise ValueError(
+                f"frame header claims {head_len} bytes "
+                f"(max {self.max_frame_bytes}) — corrupt stream"
+            )
+        if len(self._buf) < 4 + head_len:
+            return None
+        head = json.loads(bytes(self._buf[4 : 4 + head_len]))
+        nbytes = int(head["nbytes"])
+        if nbytes < 0 or nbytes > self.max_frame_bytes:
+            raise ValueError(
+                f"frame payload claims {nbytes} bytes "
+                f"(max {self.max_frame_bytes}) — corrupt stream"
+            )
+        total = 4 + head_len + nbytes
+        if len(self._buf) < total:
+            return None
+        raw = bytes(self._buf[4 + head_len : total])
+        del self._buf[:total]
+        arr = np.frombuffer(
+            raw, dtype=np_dtype_from_name(head["dtype"])
+        ).reshape(head["shape"])
+        return (int(head["hash"]), arr)
 
     def feed(self, data: bytes) -> list[tuple[int, np.ndarray]]:
         self._buf.extend(data)
         out: list[tuple[int, np.ndarray]] = []
+        while (frame := self._next_frame()) is not None:
+            out.append(frame)
+        return out
+
+    def feed_partial(self, data: bytes) -> list[tuple[int, np.ndarray]]:
+        """Like feed(), but a corrupt frame KEEPS the frames completed
+        before it instead of discarding the whole batch: the valid prefix
+        is real data (the remote fetch path promotes it — losing it would
+        turn a one-frame corruption into a full-run cache miss, and lose
+        the timing of blocks that actually moved). `self.error` carries
+        the failure; the parser is dead afterwards."""
+        if self.error is not None:
+            return []
+        self._buf.extend(data)
+        out: list[tuple[int, np.ndarray]] = []
         while True:
-            if len(self._buf) < 4:
+            try:
+                frame = self._next_frame()
+            except Exception as e:  # corrupt header/payload claim/dtype
+                self.error = e
                 break
-            head_len = struct.unpack_from("<I", self._buf)[0]
-            if head_len > self.max_frame_bytes:
-                raise ValueError(
-                    f"frame header claims {head_len} bytes "
-                    f"(max {self.max_frame_bytes}) — corrupt stream"
-                )
-            if len(self._buf) < 4 + head_len:
+            if frame is None:
                 break
-            head = json.loads(bytes(self._buf[4 : 4 + head_len]))
-            nbytes = int(head["nbytes"])
-            if nbytes < 0 or nbytes > self.max_frame_bytes:
-                raise ValueError(
-                    f"frame payload claims {nbytes} bytes "
-                    f"(max {self.max_frame_bytes}) — corrupt stream"
-                )
-            total = 4 + head_len + nbytes
-            if len(self._buf) < total:
-                break
-            raw = bytes(self._buf[4 + head_len : total])
-            del self._buf[:total]
-            arr = np.frombuffer(
-                raw, dtype=np_dtype_from_name(head["dtype"])
-            ).reshape(head["shape"])
-            out.append((int(head["hash"]), arr))
+            out.append(frame)
         return out
 
     @property

@@ -20,6 +20,12 @@ from prometheus_client.openmetrics import exposition as om_exposition
 
 from .. import metrics_contract as mc
 from .engine import EngineStatsSnapshot
+from .kv_flow import (
+    DIRECTIONS,
+    HYDRATION_SOURCES,
+    TRANSFER_SECONDS_BUCKETS,
+    TRANSFER_TIERS,
+)
 from .saturation import OCCUPANCY_BUCKETS, STEP_WALL_BUCKETS, WASTE_REASONS
 
 OPENMETRICS_CONTENT_TYPE = om_exposition.CONTENT_TYPE_LATEST
@@ -100,6 +106,42 @@ class _SaturationHistograms:
         yield wall
 
 
+class _KVFlowHistograms:
+    """Custom collector rendering the KVFlowMeter's per-(tier, direction)
+    transfer-latency distribution (tpu:kv_transfer_seconds) straight from
+    the cumulative bucket counts its snapshot carries — same pattern as
+    _SaturationHistograms: the transfer paths increment plain ints; no
+    prometheus objects ride the engine/writer threads. Every (tier,
+    direction) combo renders from the first scrape (closed label sets,
+    seeded at zero)."""
+
+    _EMPTY = {"buckets": TRANSFER_SECONDS_BUCKETS,
+              "counts": [0] * (len(TRANSFER_SECONDS_BUCKETS) + 1),
+              "sum": 0.0, "count": 0}
+
+    def __init__(self, owner: "EngineMetrics"):
+        self._owner = owner
+
+    def collect(self):
+        flow = self._owner.kv_flow or {}
+        model = self._owner.model_name
+        fam = _hist_family(
+            mc.KV_TRANSFER_SECONDS,
+            "Wall seconds per KV tier-transfer batch, by tier and "
+            "direction (in = toward HBM / hydration, out = offload)",
+            ["model_name", "tier", "direction"],
+        )
+        hists = flow.get("seconds_hist") or {}
+        for tier in TRANSFER_TIERS:
+            for direction in DIRECTIONS:
+                h = hists.get(f"{tier}/{direction}") or self._EMPTY
+                fam.add_metric(
+                    [model, tier, direction],
+                    _cum_buckets(h), h.get("sum", 0.0),
+                )
+        yield fam
+
+
 class EngineMetrics:
     def __init__(self, model_name: str):
         self.registry = CollectorRegistry()
@@ -107,6 +149,9 @@ class EngineMetrics:
         # latest snapshot's saturation dict, read by the histogram
         # collector at scrape time (update() refreshes it first)
         self.saturation: dict = {}
+        # latest snapshot's kv_flow dict (the transfer-latency histogram
+        # collector reads it at scrape time)
+        self.kv_flow: dict = {}
         self._labels = {"model_name": model_name}
         names = list(self._labels)
 
@@ -243,6 +288,59 @@ class EngineMetrics:
         for tier in ("hbm", "host", "disk", "remote"):
             self.kv_tier_usage.labels(**self._labels, tier=tier)
         self.registry.register(_SaturationHistograms(self))
+        # -- KV flow telemetry (docs/30-kv-flow-telemetry.md) -------------
+        flabels = [*names, "tier", "direction"]
+
+        def fcounter(name: str, doc: str) -> Counter:
+            base = name[: -len("_total")] if name.endswith("_total") else name
+            return Counter(base, doc, flabels, registry=self.registry)
+
+        self.kv_transfer_bytes = fcounter(
+            mc.KV_TRANSFER_BYTES,
+            "Bytes moved between KV tiers, by tier and direction (in = "
+            "toward HBM / hydration, out = offload)",
+        )
+        self.kv_transfer_blocks = fcounter(
+            mc.KV_TRANSFER_BLOCKS,
+            "KV blocks moved between tiers, by tier and direction",
+        )
+        self.kv_tier_bandwidth = Gauge(
+            mc.KV_TIER_BANDWIDTH,
+            "Recent-mean transfer bandwidth per (tier, direction) — the "
+            "measured fetch-GB/s half of the compute-or-load hydration "
+            "signal",
+            flabels,
+            registry=self.registry,
+        )
+        self.prefix_tokens = Counter(
+            mc.REQUEST_PREFIX_TOKENS[: -len("_total")],
+            "Prompt tokens by hydration source (closed label set: "
+            + ", ".join(HYDRATION_SOURCES)
+            + ") — an audited partition: the sum over sources equals the "
+            "prompt tokens of admitted requests",
+            [*names, "source"],
+            registry=self.registry,
+        )
+        self.disk_stores = counter(
+            mc.DISK_KV_STORES, "KV blocks persisted to the local-disk tier"
+        )
+        self.disk_loads = counter(
+            mc.DISK_KV_LOADS, "KV blocks loaded from the local-disk tier"
+        )
+        # seed the closed label sets at zero (same rationale as the
+        # saturation series: rate() over a counter appearing mid-flight
+        # misses its first increment)
+        for tier in TRANSFER_TIERS:
+            for direction in DIRECTIONS:
+                fl = {**self._labels, "tier": tier, "direction": direction}
+                self.kv_transfer_bytes.labels(**fl)
+                self.kv_transfer_blocks.labels(**fl)
+                self.kv_tier_bandwidth.labels(**fl)
+        for source in HYDRATION_SOURCES:
+            self.prefix_tokens.labels(**self._labels, source=source)
+        self.disk_stores.labels(**self._labels)
+        self.disk_loads.labels(**self._labels)
+        self.registry.register(_KVFlowHistograms(self))
         # -- multi-tenant QoS (docs/27-multitenancy.md): tenant-labeled
         # series; cardinality bounded by qos.TenantAccounting.MAX_TENANTS
         tlabels = [*names, "tenant"]
@@ -421,6 +519,33 @@ class EngineMetrics:
                 self.wasted_tokens, f"wasted:{reason}",
                 int(wasted.get(reason, 0)), {**lb, "reason": reason},
             )
+        # -- KV flow telemetry (docs/30-kv-flow-telemetry.md) -------------
+        flow = s.kv_flow or {}
+        self.kv_flow = flow  # histogram collector reads this at scrape
+        fbytes = flow.get("bytes") or {}
+        fblocks = flow.get("blocks") or {}
+        fbw = flow.get("bandwidth_bytes_per_s") or {}
+        for tier in TRANSFER_TIERS:
+            for direction in DIRECTIONS:
+                key = f"{tier}/{direction}"
+                fl = {**lb, "tier": tier, "direction": direction}
+                self._bump_labeled(
+                    self.kv_transfer_bytes, f"kvb:{key}",
+                    int(fbytes.get(key, 0)), fl,
+                )
+                self._bump_labeled(
+                    self.kv_transfer_blocks, f"kvn:{key}",
+                    int(fblocks.get(key, 0)), fl,
+                )
+                self.kv_tier_bandwidth.labels(**fl).set(fbw.get(key, 0.0))
+        hyd = flow.get("hydration") or {}
+        for source in HYDRATION_SOURCES:
+            self._bump_labeled(
+                self.prefix_tokens, f"hyd:{source}",
+                int(hyd.get(source, 0)), {**lb, "source": source},
+            )
+        self._bump(self.disk_stores, "disk_store", s.disk_kv_stores)
+        self._bump(self.disk_loads, "disk_load", s.disk_kv_loads)
 
     def _bump(self, counter: Counter, key: str, total: int) -> None:
         self._bump_labeled(counter, key, total, self._labels)

@@ -111,6 +111,12 @@ class Request:
     # recompute cost is charged separately (preempted_recompute) when
     # resumed prefill re-processes generated positions.
     ledger_pending: int = 0
+    # hydration attribution (docs/30-kv-flow-telemetry.md): where this
+    # request's prompt-token KV came from, classified EXACTLY once at first
+    # admission — {hbm_hit, host_reload, disk_load, remote_fetch,
+    # recomputed} tokens summing to num_prompt_tokens. None until seated
+    # (and forever for requests refused before a seat).
+    hydration: dict | None = None
     # absolute time.monotonic() after which this request is worthless to its
     # caller (x-request-deadline-ms, carried router → engine → scheduler);
     # None = no deadline. The scheduler sweeps expired requests out of
@@ -181,3 +187,7 @@ class RequestOutput:
     # phase spans and the tpu:request_* histograms without reaching back
     # into engine state that _drop_finished already reaped
     phase_times: dict | None = None
+    # terminal output only: the request's hydration-source partition
+    # (Request.hydration) — the HTTP layer emits it as the timeline's
+    # kv_hydration event (docs/30-kv-flow-telemetry.md)
+    hydration: dict | None = None

@@ -37,8 +37,12 @@ import os
 import time
 from bisect import bisect_left
 
+from ..metrics_contract import WASTE_REASON_VALUES
+
 # Reason labels for tpu:wasted_tokens_total — a CLOSED set (exporter label
-# cardinality is bounded by construction, not by a cap):
+# cardinality is bounded by construction, not by a cap). The tuple itself
+# lives in metrics_contract (single definition, validated against the
+# exporters by the contract checker); the semantics live here:
 #   rollback            sampled by a pipeline dispatch that was discarded
 #                       (speculation invalidated / resolve fault), by a
 #                       row whose request finished while the step was in
@@ -58,14 +62,7 @@ from bisect import bisect_left
 #                       by a higher-priority admission (QoS shedding)
 #   overshoot           fused-decode-window candidates sampled past a
 #                       per-request stop condition and discarded host-side
-WASTE_REASONS = (
-    "rollback",
-    "preempted_recompute",
-    "deadline_expired",
-    "severed",
-    "shed_evicted",
-    "overshoot",
-)
+WASTE_REASONS = WASTE_REASON_VALUES
 
 # finish-status → waste reason for a request's still-pending tokens
 # (None = delivered). Keys are RequestStatus *names* so this module stays

@@ -112,6 +112,7 @@ class Scheduler:
         host_tier=None,
         need_slot_mappings: bool = False,
         accounting: TenantAccounting | None = None,
+        flow=None,
     ):
         self.model_config = model_config
         self.cache_config = cache_config
@@ -164,6 +165,15 @@ class Scheduler:
         # Mutated only under the engine lock (postprocess / finish /
         # preempt here, plus the engine's pipeline-rollback sites).
         self.ledger = GoodputLedger()
+        # KV flow meter (docs/30-kv-flow-telemetry.md): _admit records each
+        # request's hydration partition here exactly once. Standalone
+        # construction (tests) gets its own meter so the attribution
+        # counters always exist; the engine passes its shared one.
+        if flow is None:
+            from .kv_flow import KVFlowMeter
+
+            flow = KVFlowMeter()
+        self.flow = flow
 
     # -- admission ---------------------------------------------------------
 
@@ -774,6 +784,7 @@ class Scheduler:
         # write are what the next step needs)
         while matched and len(matched) * self.block_size >= req.prefill_target:
             self.pool.free_block(matched.pop())
+        self._attribute_hydration(req, len(matched))
         req.block_table = matched
         req.num_computed_tokens = len(matched) * self.block_size
         req.num_cached_prompt_tokens = min(
@@ -784,6 +795,37 @@ class Scheduler:
             chunk = tuple(seq[i * self.block_size : (i + 1) * self.block_size])
             chain.append(chain_hash(chain[-1], chunk))
         self._hash_chains[req.request_id] = chain
+
+    _HYDRATION_BY_TIER = {
+        "hbm": "hbm_hit",
+        "host": "host_reload",
+        "disk": "disk_load",
+        "remote": "remote_fetch",
+    }
+
+    def _attribute_hydration(self, req: Request, n_matched: int) -> None:
+        """Classify the request's prompt tokens by KV origin, EXACTLY once
+        (first admission only — a preempted request re-admitting keeps its
+        original attribution; the recompute cost is the goodput ledger's
+        preempted_recompute story, not a hydration event). The partition is
+        exact by construction: matched blocks are full blocks of the
+        prompt's head (trimmed below prefill_target == prompt tokens at
+        first admission), so
+
+            hbm_hit + host_reload + disk_load + remote_fetch + recomputed
+                == prompt_tokens
+
+        with recomputed >= 1 (the keep-one-token-to-compute rule)."""
+        if req.hydration is not None:
+            return
+        counts = dict.fromkeys(self._HYDRATION_BY_TIER.values(), 0)
+        for tier in self.pool.last_match_sources[:n_matched]:
+            counts[self._HYDRATION_BY_TIER[tier]] += self.block_size
+        counts["recomputed"] = (
+            req.num_prompt_tokens - n_matched * self.block_size
+        )
+        req.hydration = counts
+        self.flow.record_hydration(counts)
 
     def _ensure_blocks(self, req: Request, num_tokens: int) -> bool:
         """Grow req's block table to cover num_tokens. On pool exhaustion the

@@ -373,6 +373,15 @@ class EngineServer:
         pt = getattr(out, "phase_times", None)
         if not pt:
             return
+        # hydration attribution (docs/30-kv-flow-telemetry.md): where the
+        # prompt's KV came from — the timeline's explanation of a fast (or
+        # slow) prefill, and the per-request view behind the
+        # tpu:request_prefix_tokens_total counters. Emitted before the
+        # phase spans so /debug/requests?rid= shows it with the prefill
+        # span it explains.
+        hyd = getattr(out, "hydration", None)
+        if hyd:
+            trace.event("kv_hydration", choice=choice, **hyd)
         # ONE monotonic→epoch anchor for the whole timeline: converting
         # each stamp independently (mono_to_epoch per call) drifts the
         # shared phase boundaries apart by float noise
@@ -1713,6 +1722,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "step loop. 'false' disables the meter; the "
                         "goodput token ledger (tpu:goodput_tokens_total / "
                         "tpu:wasted_tokens_total) stays on either way")
+    p.add_argument("--kv-flow-metering", default=True, type=_parse_bool_flag,
+                   help="per-tier KV transfer metering (docs/30-kv-flow-"
+                        "telemetry.md): bytes/blocks/latency per tier move "
+                        "(tpu:kv_transfer_*) and the per-tier bandwidth "
+                        "estimators (tpu:kv_tier_bandwidth_bytes_per_s) "
+                        "behind the compute-or-load hydration signal. "
+                        "'false' disables the transfer meters; the "
+                        "hydration attribution counters "
+                        "(tpu:request_prefix_tokens_total) stay on either "
+                        "way")
     p.add_argument("--prefill-buckets", default="",
                    help="comma-separated prefill chunk buckets (default: "
                         "pow2 ladder up to --max-num-batched-tokens). "
@@ -1886,6 +1905,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         ),
         async_scheduling=getattr(args, "async_scheduling", True),
         step_metering=getattr(args, "step_metering", True),
+        kv_flow_metering=getattr(args, "kv_flow_metering", True),
     )
 
 

@@ -26,6 +26,7 @@ from urllib.parse import urlsplit
 
 import numpy as np
 
+from ..engine.kv_flow import NULL_FLOW
 from ..utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -98,11 +99,17 @@ class RemoteKVTier:
         max_pending: int = 512,
         dedupe_capacity: int = 65536,
         cooldown_s: float = 5.0,
+        flow=None,
     ):
         self.host, self.port = parse_store_url(url)
         self.fingerprint = fingerprint
         self.cooldown_s = cooldown_s
         self.stats = RemoteTierStats()
+        # KV flow meter (engine/kv_flow.py): PUTs and fetches record
+        # bytes/blocks/latency under tier="remote" — including failed
+        # round trips at 0 bytes, so an outage reads as collapsing fetch
+        # bandwidth instead of silence
+        self.flow = flow if flow is not None else NULL_FLOW
         # last store-reported fill fraction (X-Store-Usage on PUT acks) —
         # the engine's tpu:engine_kv_tier_usage_perc{tier="remote"} source;
         # 0.0 until the first ack lands (docs/29-saturation-slo.md)
@@ -170,11 +177,13 @@ class RemoteKVTier:
                     self._inflight.discard(h)
                 self.stats.dropped += 1
                 continue
+            body = np.ascontiguousarray(arr).tobytes()
+            t0 = time.perf_counter()
             try:
                 status, resp_headers, _ = self._store_conn.request(
                     "PUT",
                     f"/v1/blocks/{h}",
-                    body=np.ascontiguousarray(arr).tobytes(),
+                    body=body,
                     headers={
                         "X-KV-Fingerprint": self.fingerprint,
                         "X-KV-Shape": ",".join(str(d) for d in arr.shape),
@@ -183,11 +192,20 @@ class RemoteKVTier:
                     },
                 )
             except OSError as e:
+                self.flow.record(
+                    "remote", "out", 0, 0, time.perf_counter() - t0
+                )
                 self._trip(e)
                 with self._stored_lock:
                     self._inflight.discard(h)
                 self.stats.dropped += 1
                 continue
+            self.flow.record(
+                "remote", "out",
+                len(body) if status == 200 else 0,
+                1 if status == 200 else 0,
+                time.perf_counter() - t0,
+            )
             if status == 200:
                 self.stats.stores += 1
                 usage = resp_headers.get("X-Store-Usage")
@@ -239,9 +257,29 @@ class RemoteKVTier:
 
     def fetch_run(self, hashes: list[int]) -> list[np.ndarray]:
         """The consecutive present prefix of `hashes` as arrays, one batched
-        mget round trip."""
+        mget round trip.
+
+        Partial failures degrade to partial SUCCESS: when the response
+        stream goes corrupt mid-run (foreign-version store, truncated
+        proxy body) the frames parsed before the fault are real blocks —
+        they're returned, counted in `fetched_blocks`, and their bytes +
+        the round trip's wall time land in the flow meter BEFORE the
+        error path runs. The old all-or-nothing parse turned a one-frame
+        corruption into a full-run cache miss and lost the timing of
+        blocks that had already moved."""
         if not hashes or not self._available():
             return []
+        from ..engine.kv_transfer import FrameParser
+
+        t0 = time.perf_counter()
+        out: list[np.ndarray] = []
+
+        def _flow(nbytes: int) -> None:
+            self.flow.record(
+                "remote", "in", nbytes, len(out),
+                time.perf_counter() - t0,
+            )
+
         try:
             status, headers, payload = self._fetch_conn.request(
                 "POST",
@@ -253,24 +291,15 @@ class RemoteKVTier:
                 headers={"Content-Type": "application/json"},
             )
         except OSError as e:
+            _flow(0)  # a dead store IS ~0 fetch bandwidth — record it
             self._trip(e)
             return []
         if status != 200:
+            _flow(0)
             return []
-        from ..engine.kv_transfer import FrameParser
-
         self.stats.fetches += 1
-        out: list[np.ndarray] = []
-        try:
-            frames = FrameParser().feed(payload)
-        except Exception as e:
-            # a malformed/foreign-version response must degrade to a cache
-            # miss like every other remote-tier failure — never fail the
-            # user's request from inside match_prefix
-            logger.warning("malformed mget response: %s", e)
-            self.stats.errors += 1
-            return []
-        for h, arr in frames:
+        parser = FrameParser()
+        for h, arr in parser.feed_partial(payload):
             if len(out) >= len(hashes) or h != hashes[len(out)]:
                 break  # non-consecutive frame; stop clean
             # copy: a frombuffer view would pin the ENTIRE multi-block
@@ -284,6 +313,17 @@ class RemoteKVTier:
                 while len(self._stored) > self._dedupe_capacity:
                     self._stored.popitem(last=False)
         self.stats.fetched_blocks += len(out)
+        _flow(sum(a.nbytes for a in out))
+        if parser.error is not None:
+            # a malformed/foreign-version response must degrade to a cache
+            # miss (here: the valid prefix) like every other remote-tier
+            # failure — never fail the user's request from inside
+            # match_prefix
+            logger.warning(
+                "malformed mget response after %d valid frames: %s",
+                len(out), parser.error,
+            )
+            self.stats.errors += 1
         return out
 
     def drain(self, timeout: float = 10.0) -> bool:

@@ -136,11 +136,19 @@ ENGINE_STEP_OCCUPANCY = "tpu:engine_step_occupancy"
 ENGINE_STEP_WALL = "tpu:engine_step_wall_seconds"
 # goodput ledger (engine/saturation.GoodputLedger): every device-sampled
 # token classified exactly once — delivered + wasted == sampled at
-# quiescence. reason= is the CLOSED saturation.WASTE_REASONS set
-# (rollback | preempted_recompute | deadline_expired | severed |
-# shed_evicted | overshoot).
+# quiescence. reason= is the CLOSED WASTE_REASON_VALUES set below, the
+# single definition saturation.WASTE_REASONS aliases (semantics of each
+# reason are documented there).
 GOODPUT_TOKENS = "tpu:goodput_tokens_total"
 WASTED_TOKENS = "tpu:wasted_tokens_total"
+WASTE_REASON_VALUES = (
+    "rollback",
+    "preempted_recompute",
+    "deadline_expired",
+    "severed",
+    "shed_evicted",
+    "overshoot",
+)
 # router-side: streams severed after headers (engine died mid-stream; the
 # truncated transfer is the client's only honest signal). Request-level —
 # the router can't see token boundaries; the engine-side ledger carries the
@@ -164,6 +172,71 @@ SATURATION_COUNTERS = (
 SATURATION_HISTOGRAMS = (
     ENGINE_STEP_OCCUPANCY,
     ENGINE_STEP_WALL,
+)
+
+# -- KV-hierarchy flow telemetry (docs/30-kv-flow-telemetry.md) -------------
+# Per-tier transfer meters (engine/kv_flow.KVFlowMeter): every tier move —
+# host-ring offload/reload, disk store/load, remote put/fetch, device-path
+# PD transfer — records bytes, blocks and wall latency. Labels are CLOSED
+# sets (cardinality bounded by construction, series seeded at zero):
+# tier= names the NON-HBM side of the hop, direction= is relative to HBM
+# ("in" = toward the device pool / hydration, "out" = away / offload).
+KV_TRANSFER_TIERS = ("host", "disk", "remote", "device")
+KV_TRANSFER_DIRECTIONS = ("in", "out")
+KV_TRANSFER_BYTES = "tpu:kv_transfer_bytes_total"
+KV_TRANSFER_BLOCKS = "tpu:kv_transfer_blocks_total"
+# histogram: wall seconds per transfer batch, labeled tier=/direction=
+KV_TRANSFER_SECONDS = "tpu:kv_transfer_seconds"
+# gauge: time-decayed recent-mean transfer bandwidth per (tier, direction)
+# (engine/kv_flow.TierBandwidth) — the measured fetch-GB/s half of the
+# compute-or-load hydration signal (LLMEngine.hydration_signal, ROADMAP 3)
+KV_TIER_BANDWIDTH = "tpu:kv_tier_bandwidth_bytes_per_s"
+# per-request hydration attribution: every admitted request's prompt
+# tokens classified EXACTLY once by where their KV came from —
+# hbm_hit + host_reload + disk_load + remote_fetch + recomputed ==
+# prompt_tokens (same audited-partition discipline as the goodput ledger)
+KV_HYDRATION_SOURCES = (
+    "hbm_hit", "host_reload", "disk_load", "remote_fetch", "recomputed",
+)
+REQUEST_PREFIX_TOKENS = "tpu:request_prefix_tokens_total"
+# disk-tier block counters (the host ring has HOST_KV_*, the remote store
+# REMOTE_KV_* — the disk rung was dark before this pair existed)
+DISK_KV_STORES = "tpu:disk_kv_stored_blocks_total"
+DISK_KV_LOADS = "tpu:disk_kv_loaded_blocks_total"
+
+# Closed label sets per metric, the single source of truth the exporters
+# seed from and tools/check_metrics_contract.py validates BOTH ways: the
+# exporter registries must render exactly these values, and any literal
+# label matcher in the dashboard / rule pack must name one of them (a
+# typo'd tier="dsk" used to pass the checker silently). Open-but-bounded
+# labels (tenant=, model_name=) are deliberately absent.
+METRIC_LABEL_VALUES: dict[str, dict[str, tuple[str, ...]]] = {
+    KV_TRANSFER_BYTES: {
+        "tier": KV_TRANSFER_TIERS, "direction": KV_TRANSFER_DIRECTIONS,
+    },
+    KV_TRANSFER_BLOCKS: {
+        "tier": KV_TRANSFER_TIERS, "direction": KV_TRANSFER_DIRECTIONS,
+    },
+    KV_TRANSFER_SECONDS: {
+        "tier": KV_TRANSFER_TIERS, "direction": KV_TRANSFER_DIRECTIONS,
+    },
+    KV_TIER_BANDWIDTH: {
+        "tier": KV_TRANSFER_TIERS, "direction": KV_TRANSFER_DIRECTIONS,
+    },
+    REQUEST_PREFIX_TOKENS: {"source": KV_HYDRATION_SOURCES},
+    ENGINE_KV_TIER_USAGE: {"tier": ("hbm", "host", "disk", "remote")},
+    ENGINE_STEP_TOKENS: {"phase": ("prefill", "decode")},
+    ENGINE_PADDED_TOKENS: {"phase": ("prefill", "decode")},
+    ENGINE_STEP_WALL: {"phase": ("prefill", "decode")},
+    WASTED_TOKENS: {"reason": WASTE_REASON_VALUES},
+}
+
+KV_FLOW_COUNTERS = (
+    KV_TRANSFER_BYTES,
+    KV_TRANSFER_BLOCKS,
+    REQUEST_PREFIX_TOKENS,
+    DISK_KV_STORES,
+    DISK_KV_LOADS,
 )
 
 # -- cluster KV index (event-driven KV-aware routing) -----------------------
@@ -223,6 +296,8 @@ ALL_GAUGES = (
     ENGINE_ACHIEVED_FLOPS,
     ENGINE_MFU,
     ENGINE_KV_TIER_USAGE,
+    # KV flow telemetry (docs/30-kv-flow-telemetry.md)
+    KV_TIER_BANDWIDTH,
 )
 ALL_COUNTERS = (
     PREFIX_CACHE_HITS,
@@ -250,4 +325,11 @@ ALL_COUNTERS = (
     ENGINE_MODEL_FLOPS,
     GOODPUT_TOKENS,
     WASTED_TOKENS,
+    # KV flow telemetry (docs/30-kv-flow-telemetry.md); tier=/direction=/
+    # source= labels are closed sets (METRIC_LABEL_VALUES)
+    KV_TRANSFER_BYTES,
+    KV_TRANSFER_BLOCKS,
+    REQUEST_PREFIX_TOKENS,
+    DISK_KV_STORES,
+    DISK_KV_LOADS,
 )
