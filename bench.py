@@ -38,8 +38,8 @@ Phases, in order:
    percentiles, HBM accounting
 
 CPU-only pre-preflight phases (routing, robustness, fairness, tracing,
-saturation, kvflow) run BEFORE the chip preflight so their evidence
-survives a wedged TPU tunnel.
+saturation, kvflow, hydration) run BEFORE the chip preflight so their
+evidence survives a wedged TPU tunnel.
 
 The final line is the ONE driver-parsed JSON: headline = served
 closed-loop req/s vs the >=2.0 req/s bar, with every phase attached.
@@ -1290,6 +1290,219 @@ def _kvflow_bench() -> dict:
     }
 
 
+def _hydration_bench() -> dict:
+    """Compute-or-load hydration planner proof (docs/31-hydration-
+    planner.md), CPU-only so it survives a wedged TPU tunnel. The
+    acceptance shape: cold-prefix 8k-token prompts resident on
+    disk/remote (seeded by one engine, measured on fresh engines with
+    the same weights fingerprint), TTFT for
+
+    - **compute-only** (`--kv-hydration off`): full prefill, the
+      lower-tier residency ignored;
+    - **load-only** (`--kv-hydration sync`): the legacy blocking
+      whole-prefix reload;
+    - **planner** (`--kv-hydration auto`): chunked tier fetches
+      pipelined with partial recompute, split chosen from MEASURED
+      bandwidth vs MEASURED prefill FLOP/s.
+
+    Remote scenario (the headline): the fetch link is throttled (a
+    sleep proportional to payload bytes at the connection layer —
+    INSIDE the flow meter's timing window, so the planner's bandwidth
+    estimate sees the throttled truth) to the crossover point where
+    fetch-everything ~= compute-everything — exactly where
+    all-or-nothing policies are worst and the planner's max(fetch tail,
+    compute tail) pays off. Disk scenario: the same arms against the
+    local NVMe tier, unthrottled — disk is fast here, so the planner's
+    job is to match load-only (reported with a 5% noise tolerance
+    rather than asserted strictly). The planner engines warm honestly:
+    one compute pass (FLOP/s estimate + XLA compiles — the width floor
+    makes the program keys context-independent, so a short junk prompt
+    warms the 8k shapes) and sync-fallback reloads of smaller resident
+    prompts (the bandwidth samples that cross the TierBandwidth floor).
+    The per-request hydration partition must stay EXACT on every engine
+    with the planner on."""
+    import time as _t
+    from dataclasses import replace
+
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.config import EngineConfig
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+    from vllm_production_stack_tpu.kvstore.server import run_in_thread
+
+    import tempfile
+
+    BS = 16
+    PROMPT_TOKENS = 8192
+    WARM_TOKENS = 2048
+    url, stop_store, _server = run_in_thread(capacity_bytes=1 << 30)
+    disk_dir = tempfile.mkdtemp(prefix="bench-hydration-")
+
+    def make_engine(mode: str, remote: str = "", disk: str = "",
+                    num_blocks: int = 560) -> LLMEngine:
+        cfg = EngineConfig.tiny(max_model_len=PROMPT_TOKENS + 256)
+        return LLMEngine(cfg.replace(
+            cache=replace(
+                cfg.cache, block_size=BS, num_blocks=num_blocks,
+                num_host_blocks=16, remote_kv_url=remote,
+                disk_kv_dir=disk, disk_kv_gib=1.0 if disk else 0.0,
+            ),
+            scheduler=replace(
+                cfg.scheduler, max_num_seqs=2,
+                max_num_batched_tokens=512, decode_buckets=(2,),
+                prefill_buckets=(64, 512), decode_window=4,
+                # ONE block-table width program: the phase measures
+                # hydration, not the width compile ladder — and it makes
+                # a short warmup prompt compile the 8k prompt's programs
+                width_floor_blocks=600,
+            ),
+            kv_hydration=mode,
+            kv_hydration_chunk_blocks=16,
+        ))
+
+    def prompt(seed: int, n: int) -> list[int]:
+        return [int(t) for t in
+                np.random.RandomState(seed).randint(1, 500, size=n)]
+
+    GREEDY = SamplingParams(max_tokens=2, temperature=0.0, ignore_eos=True)
+    target = prompt(1, PROMPT_TOKENS)
+    warms = [prompt(10 + i, WARM_TOKENS) for i in range(2)]
+    junk_small = prompt(98, 1024)  # compile warmup (width floor: same keys)
+    junk_big = prompt(99, PROMPT_TOKENS)  # seeding churn only
+
+    # -- seed BOTH lower tiers: engine A computes everything; churn pushes
+    # every block through the ring, whose evictions persist to disk AND
+    # write through to the remote store
+    eng_a = make_engine("sync", remote=url, disk=disk_dir)
+    ref_tokens = eng_a.generate([target], GREEDY)[0]["token_ids"]
+    for w in warms:
+        eng_a.generate([w], GREEDY)
+    eng_a.generate([junk_big], GREEDY)  # evicts target+warm blocks
+    eng_a.host_tier.flush()
+    assert eng_a.remote_tier.drain(timeout=120), "remote store drain hung"
+    seeded_remote = eng_a.remote_tier.stats.stores
+    seeded_disk = eng_a.host_tier.disk.stats.stores
+    eng_a.runner.shutdown(wait=True)
+
+    def first_token_latency(eng: LLMEngine, ids: list[int]):
+        t0 = _t.perf_counter()
+        rid = eng.add_request(prompt_token_ids=ids, sampling=GREEDY)
+        ttft = None
+        tok = None
+        while eng.has_unfinished():
+            for out in eng.step():
+                if out.request_id == rid and out.new_token_ids and ttft is None:
+                    ttft = _t.perf_counter() - t0
+                    tok = out.new_token_ids[0]
+        return ttft, tok
+
+    def throttle_store(bytes_per_s: float) -> None:
+        """Slow every remote fetch connection (shared AND the hydrator's
+        dedicated one) to `bytes_per_s` — the sleep happens inside
+        fetch_run's metering window, so TierBandwidth measures the
+        throttled link, exactly what a WAN-attached store looks like.
+        Disk IO is untouched (the sleep keys on the /v1/mget path)."""
+        from vllm_production_stack_tpu.kvstore import client as kvclient
+
+        inner = kvclient._Conn.request
+
+        def slowed(self, method, path, body=None, headers=None):
+            status, hdrs, payload = inner(
+                self, method, path, body=body, headers=headers
+            )
+            if path == "/v1/mget":
+                _t.sleep(len(payload) / bytes_per_s)
+            return status, hdrs, payload
+
+        kvclient._Conn.request = slowed
+
+    def run_arm(mode: str, remote: str = "", disk: str = "",
+                warm_prompts=()):  # -> (ttft, first_token, details)
+        eng = make_engine(mode, remote=remote, disk=disk)
+        eng.generate([junk_small], GREEDY)  # XLA compiles + FLOP/s sample
+        for w in warm_prompts:  # sync-fallback loads: bandwidth samples
+            eng.generate([w], GREEDY)
+        sig = eng.hydration_signal()
+        ttft, tok = first_token_latency(eng, target)
+        snap = eng.flow.snapshot()
+        hyd = snap["hydration"]
+        details = {
+            "ttft_s": round(ttft, 3),
+            "decisions": dict(snap["decisions"]),
+            "partition_exact": sum(hyd.values()) == eng._prompt_tokens,
+            "measured_before_run": dict(sig["fetch_bandwidth_measured"]),
+        }
+        eng.runner.shutdown(wait=True)
+        return ttft, tok, details
+
+    # -- remote scenario (throttled to the crossover) ----------------------
+    ttft_c, tok_c, det_c = run_arm("off", remote=url)
+    region_blocks = PROMPT_TOKENS // BS - 1
+    from vllm_production_stack_tpu.engine.memory import kv_block_bytes
+
+    tiny = EngineConfig.tiny(max_model_len=PROMPT_TOKENS + 256)
+    blk_bytes = kv_block_bytes(
+        tiny.model, BS, 1, 1,
+        kv_dtype=tiny.cache.resolved_kv_dtype(tiny.model.dtype),
+    )
+    region_bytes = region_blocks * (blk_bytes + 160)  # + frame header
+    bw = region_bytes / max(ttft_c, 0.05)
+    throttle_store(bw)
+    ttft_l, tok_l, det_l = run_arm("sync", remote=url)
+    ttft_p, tok_p, det_p = run_arm("auto", remote=url, warm_prompts=warms)
+    remote = {
+        "compute_only": det_c,
+        "load_only": det_l,
+        "planner": det_p,
+        "throttle_bytes_per_s": round(bw, 1),
+        "tokens_agree": tok_c == tok_l == tok_p,
+        "planner_ttft_le_min": bool(ttft_p <= min(ttft_c, ttft_l)),
+        "speedup_vs_best_baseline": round(min(ttft_c, ttft_l) / ttft_p, 3),
+    }
+
+    # -- disk scenario (local NVMe, unthrottled: the planner should LOAD
+    # nearly everything and match load-only; 5% noise tolerance) -----------
+    d_ttft_l, d_tok_l, d_det_l = run_arm("sync", disk=disk_dir)
+    d_ttft_p, d_tok_p, d_det_p = run_arm(
+        "auto", disk=disk_dir, warm_prompts=warms[:1]
+    )
+    stop_store()
+    disk = {
+        "compute_only_ttft_s": det_c["ttft_s"],  # compute is tier-blind
+        "load_only": d_det_l,
+        "planner": d_det_p,
+        "tokens_agree": d_tok_l == d_tok_p == tok_c,
+        "planner_ttft_le_min_5pct": bool(
+            d_ttft_p <= min(ttft_c, d_ttft_l) * 1.05
+        ),
+    }
+
+    return {
+        "workload": {
+            "prompt_tokens": PROMPT_TOKENS,
+            "block_size": BS,
+            "seeded_remote_blocks": seeded_remote,
+            "seeded_disk_blocks": seeded_disk,
+        },
+        "remote": remote,
+        "disk": disk,
+        "planner_ttft_le_min": remote["planner_ttft_le_min"],
+        "speedup_vs_best_baseline": remote["speedup_vs_best_baseline"],
+    }
+
+
+def _phase_hydration_main() -> None:
+    """Subprocess entry for the CPU-only hydration-planner bench. Forces
+    CPU before anything touches jax — runs pre-preflight, so the
+    compute-or-load evidence survives a wedged TPU tunnel."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = _hydration_bench()
+    print(json.dumps({"hydration": result}), flush=True)
+
+
 def _phase_kvflow_main() -> None:
     """Subprocess entry for the CPU-only KV-flow telemetry bench. Forces
     CPU before anything touches jax — runs pre-preflight, so the flow
@@ -1431,6 +1644,8 @@ def main() -> None:
             _phase_saturation_main()
         elif phase == "kvflow":
             _phase_kvflow_main()
+        elif phase == "hydration":
+            _phase_hydration_main()
         else:
             assert phase == "micro", phase
             _phase_micro_main()
@@ -1483,6 +1698,15 @@ def main() -> None:
         timeout_s=300, key="kvflow", min_needed_s=60.0,
     )
 
+    # -0.015625) compute-or-load hydration planner (docs/31-hydration-
+    # planner.md): TTFT on cold remote-resident 8k prompts, planner vs
+    # load-only vs compute-only — CPU-only, pre-preflight (survives a
+    # wedged chip, the r04/r05 lesson)
+    hydration = _run_phase(
+        "hydration", ["bench.py", "--phase", "hydration"],
+        timeout_s=540, key="hydration", min_needed_s=120.0,
+    )
+
     # 0) chip preflight: one trivial dispatch. A wedged tunnel fails HERE
     # in minutes with an explicit section; the heavy phases are then
     # reported skipped instead of serially eating their timeouts
@@ -1507,6 +1731,7 @@ def main() -> None:
             "tracing": tracing,
             "saturation": saturation,
             "kvflow": kvflow,
+            "hydration": hydration,
             "total_elapsed_s": round(time.monotonic() - _t_start, 1),
         }), flush=True)
         return
@@ -1579,6 +1804,7 @@ def main() -> None:
         "tracing": tracing,
         "saturation": saturation,
         "kvflow": kvflow,
+        "hydration": hydration,
         "total_elapsed_s": round(time.monotonic() - _t_start, 1),
     }), flush=True)
 

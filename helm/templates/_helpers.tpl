@@ -115,6 +115,18 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--kv-flow-metering"
 - "false"
 {{- end }}
+{{- if .kvHydration }}
+- "--kv-hydration"
+- {{ .kvHydration | quote }}
+{{- end }}
+{{- if .kvHydrationChunkBlocks }}
+- "--kv-hydration-chunk-blocks"
+- {{ .kvHydrationChunkBlocks | quote }}
+{{- end }}
+{{- if .kvHydrationTimeoutS }}
+- "--kv-hydration-timeout-s"
+- {{ .kvHydrationTimeoutS | quote }}
+{{- end }}
 {{- if eq (.enablePrefixCaching | default true) false }}
 - "--no-enable-prefix-caching"
 {{- end }}

@@ -104,6 +104,9 @@ class AsyncEngine:
         runner = getattr(self.engine, "runner", None)
         if runner is not None and hasattr(runner, "shutdown"):
             runner.shutdown()  # cancel queued background compiles
+        hydrator = getattr(self.engine, "hydrator", None)
+        if hydrator is not None:
+            hydrator.close()  # stop the hydration fetcher thread
         host_tier = getattr(self.engine, "host_tier", None)
         remote = getattr(self.engine, "remote_tier", None)
         if host_tier is not None:

@@ -227,11 +227,33 @@ class LLMEngine:
                 disk=disk_tier,
                 flow=self.flow,
             )
+        # compute-or-load hydration planner (docs/31-hydration-planner.md):
+        # only engines with a rung BELOW the host ring (disk / remote) ever
+        # face the blocking-load-vs-recompute choice; everything else keeps
+        # the legacy admission path untouched.
+        self.hydrator = None
+        if (
+            config.kv_hydration != "sync"
+            and config.cache.enable_prefix_caching
+            and self.host_tier is not None
+            and (disk_tier is not None or self.remote_tier is not None)
+        ):
+            from .hydration import Hydrator
+
+            self.hydrator = Hydrator(
+                mode=config.kv_hydration,
+                chunk_blocks=config.kv_hydration_chunk_blocks,
+                timeout_s=config.kv_hydration_timeout_s,
+                flow=self.flow,
+                signal_fn=lambda: self.hydration_signal(),
+                host_tier=self.host_tier,
+            )
         self.scheduler = Scheduler(
             config.model, config.cache, config.scheduler,
             host_tier=self.host_tier,
             need_slot_mappings=config.parallel.sequence_parallel_size > 1,
             flow=self.flow,
+            hydrator=self.hydrator,
         )
         if self.runner.kv_caches:
             # page geometry the remote-match path validates fetched blocks
@@ -1000,6 +1022,18 @@ class LLMEngine:
                 )
             if work2 is not None:
                 self._execute_sync(work2, outputs, time.perf_counter())
+        if (
+            work is None
+            and inflight is None
+            and nxt is None
+            and self.scheduler.hydration_parked()
+        ):
+            # the only schedulable work is parked at a pending hydration
+            # fetch: yield a beat instead of busy-spinning step() — the
+            # spin would contend the GIL with the very fetcher thread
+            # whose landing we're waiting on, inflating the fetch latency
+            # the planner priced
+            time.sleep(0.001)
         if pre_handle is not None:
             t2 = time.perf_counter()
             rows = pre_handle.resolve()
@@ -1060,6 +1094,8 @@ class LLMEngine:
                 )
             )
         if work is None:
+            if self.scheduler.hydration_parked():
+                time.sleep(0.001)  # see the pipelined loop's parked note
             self._drop_finished(outputs)
             return outputs
         self._execute_sync(work, outputs, t1)
@@ -1195,18 +1231,36 @@ class LLMEngine:
         from .memory import kv_block_bytes
         from .saturation import matmul_params
 
+        cfg = self.config.model
+
         bw = self.flow.bandwidth_bytes_per_s()
+        meas = self.flow.bandwidth_measured()
         sat = self.meter.snapshot()
         return {
             "fetch_bandwidth_bytes_per_s": {
                 tier: bw[(tier, "in")] for tier in TRANSFER_TIERS
+            },
+            # sample-floor state per tier (TierBandwidth.measured): the
+            # planner never trusts an estimate built from a single tiny
+            # transfer — unmeasured tiers fall back (auto: sync load;
+            # planner mode: recompute)
+            "fetch_bandwidth_measured": {
+                tier: meas[(tier, "in")] for tier in TRANSFER_TIERS
             },
             "store_bandwidth_bytes_per_s": {
                 tier: bw[(tier, "out")] for tier in TRANSFER_TIERS
             },
             "prefill_flops_per_s": sat["achieved_flops_per_s"],
             "peak_flops_per_s": sat["peak_flops_per_s"],
-            "flops_per_token": 2.0 * matmul_params(self.config.model),
+            "flops_per_token": 2.0 * matmul_params(cfg),
+            # attention score/value FLOPs per (token, attended-context-
+            # position) pair — the same coefficient the StepMeter's
+            # analytic model charges (saturation.step_flops), so the
+            # planner prices long-context recompute with the context term
+            # the achieved-FLOP/s denominator was measured against
+            "attn_flops_per_token_ctx": (
+                4.0 * cfg.num_heads * cfg.head_dim * cfg.num_layers
+            ),
             "block_bytes": kv_block_bytes(
                 self.config.model,
                 self.config.cache.block_size,
@@ -1324,6 +1378,9 @@ class LLMEngine:
             # kv_hydration event (docs/30-kv-flow-telemetry.md); None for
             # requests that never got a seat
             out.hydration = req.hydration
+            # planner per-chunk outcomes (docs/31-hydration-planner.md):
+            # the kv_hydration event's "plan" view
+            out.hydration_chunks = req.hydration_outcomes
         return out
 
     @staticmethod

@@ -193,7 +193,8 @@ class KVBlockPool:
         return chain_hash_run(parent, token_ids, self.block_size)
 
     def match_prefix(
-        self, token_ids: list[int], parent: int | None = None
+        self, token_ids: list[int], parent: int | None = None,
+        limit_blocks: int | None = None,
     ) -> list[int]:
         """Longest run of cached full blocks matching the prompt's head —
         HBM-resident blocks first, then continuing into the host tier (each
@@ -202,6 +203,11 @@ class KVBlockPool:
         scheduler salts it per LoRA adapter so base and adapter KV never
         cross-match (their K/V bytes differ when k/v projections carry
         deltas).
+
+        `limit_blocks` caps the match (hydration planner admissions: the
+        scheduler consumes the leading HBM/host-ring run synchronously and
+        plans the disk/remote remainder as async chunk loads instead of
+        blocking here — docs/31-hydration-planner.md).
 
         Hydration attribution (docs/30-kv-flow-telemetry.md): alongside
         the matched blocks, `last_match_sources` records where each came
@@ -212,6 +218,10 @@ class KVBlockPool:
         self.last_match_sources = sources = []
         if not self.enable_prefix_caching:
             return matched
+        if limit_blocks is not None:
+            # hash only what the cap can match — the planner admission
+            # already chained the full prompt once in probe_prefix
+            token_ids = token_ids[: limit_blocks * self.block_size]
         hashes = list(
             self._chain(token_ids, _ROOT_HASH if parent is None else parent)
         )
@@ -221,6 +231,8 @@ class KVBlockPool:
             if blk is None:
                 blk, source = self._reload_from_host(h)
                 if blk is None:
+                    if limit_blocks is not None:
+                        break  # planner admission: never block on remote
                     # both local tiers miss: continue the chain into the
                     # remote store (one batched mget for the remainder)
                     remote = self._match_remote(hashes[idx:])
@@ -293,6 +305,108 @@ class KVBlockPool:
             self.stats.hits += 1
             matched.append(blk)
         return matched
+
+    # -- hydration planner support (docs/31-hydration-planner.md) ----------
+
+    def probe_prefix(
+        self, token_ids: list[int], parent: int | None = None,
+        local_only: bool = False,
+    ) -> tuple[list[int], list[str]]:
+        """(hashes, tiers) of the longest consecutively-resident run of
+        full prompt blocks across EVERY tier, WITHOUT moving data, taking
+        references, or touching the hit counters — the residency map the
+        compute-or-load planner decides over. tiers[i] is "hbm" | "host"
+        | "disk" | "remote"; the remote continuation is one batched
+        contains round trip (no payload), same as match_length.
+        `local_only` skips that round trip entirely — the `off` kill
+        switch must not keep a sick remote store on the admission path."""
+        if not self.enable_prefix_caching:
+            return [], []
+        hashes = list(
+            self._chain(token_ids, _ROOT_HASH if parent is None else parent)
+        )
+        tiers: list[str] = []
+        for idx, h in enumerate(hashes):
+            if h in self._hash_to_block:
+                tiers.append("hbm")
+                continue
+            loc = (
+                self.host_tier.location(h)
+                if self.host_tier is not None
+                else ""
+            )
+            if loc:
+                tiers.append(loc)
+                continue
+            if not local_only:
+                remote = getattr(self.host_tier, "remote", None)
+                if remote is not None:
+                    n = remote.contains_run(hashes[idx:])
+                    tiers.extend(["remote"] * n)
+            break
+        return hashes[: len(tiers)], tiers
+
+    def adopt_planned_run(
+        self, hashes: list[int], arrays: list
+    ) -> list[int] | None:
+        """Commit one LANDED hydration chunk: upload its fetched host-RAM
+        bytes into freshly allocated HBM blocks and register them, taking
+        a reference on every block for the adopting request (allocate()
+        hands blocks out at refcount 1; a block that raced back into HBM
+        is re-acquired instead of re-uploaded — its arrays slot may be
+        None). All-or-nothing: any allocation/geometry/upload failure
+        frees everything staged and returns None, and the scheduler falls
+        back to recomputing the chunk. Same commit discipline as
+        _match_remote: hash→block mappings land only AFTER the batched
+        device upload succeeds."""
+        want = self.expected_block_shape
+        staged: list[tuple[int, int, object]] = []  # (hash, blk, data|None)
+        for h, data in zip(hashes, arrays):
+            existing = self._hash_to_block.get(h)
+            if existing is not None:
+                self._acquire(existing)
+                staged.append((h, existing, None))
+                continue
+            if data is None or (
+                want is not None
+                and tuple(np.shape(data)) != tuple(want)
+            ):
+                # missing bytes (evicted hbm-tier block) or a version-
+                # skewed remote payload: the chunk cannot adopt
+                for _, blk, _ in staged:
+                    self.free_block(blk)
+                return None
+            blk = self.allocate()
+            if blk is None:
+                for _, bl, _ in staged:
+                    self.free_block(bl)
+                return None
+            staged.append((h, blk, data))
+        uploads = [(blk, d) for _, blk, d in staged if d is not None]
+        if uploads:
+            try:
+                self.host_tier.upload_many(
+                    [blk for blk, _ in uploads], [d for _, d in uploads]
+                )
+            except Exception:
+                logger.exception(
+                    "hydration chunk upload failed — freeing %d staged "
+                    "blocks and falling back to recompute", len(staged)
+                )
+                for _, blk, _ in staged:
+                    self.free_block(blk)
+                return None
+        for h, blk, data in staged:
+            if data is not None:
+                self._hash_to_block[h] = blk
+                self._block_to_hash[blk] = h
+                # promote into the ring so the next match (and a
+                # preempted resume) stays local
+                self.host_tier.insert_resolved(h, data)
+                if self.events is not None:
+                    self.events.emit_admit(h, 0)  # parent unknown mid-chain
+            self.stats.hits += 1
+        return [blk for _, blk, _ in staged]
 
     # -- adoption staging (KV transfer, both transports) -------------------
 

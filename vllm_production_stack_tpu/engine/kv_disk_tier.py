@@ -17,6 +17,7 @@ on the prefix-match path, each saving an entire chunk of prefill compute.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -58,6 +59,10 @@ class DiskKVTier:
         os.makedirs(self.dir, exist_ok=True)
         self.max_bytes = max_bytes
         self.stats = DiskTierStats()
+        # loads may run on the hydration fetcher thread concurrently with
+        # step-thread stores/evictions (docs/31-hydration-planner.md) —
+        # one small lock guards the LRU index + file operations
+        self._mu = threading.RLock()
         # KV flow meter (engine/kv_flow.py): store/load record bytes +
         # wall latency under tier="disk"
         self.flow = flow if flow is not None else NULL_FLOW
@@ -88,17 +93,26 @@ class DiskKVTier:
         return os.path.join(self.dir, f"{h}{self.SUFFIX}")
 
     def __contains__(self, h: int) -> bool:
-        return h in self._index
+        with self._mu:
+            return h in self._index
 
     def __len__(self) -> int:
-        return len(self._index)
+        with self._mu:
+            return len(self._index)
 
     def resident_hashes(self) -> list[int]:
-        return list(self._index)
+        with self._mu:
+            return list(self._index)
 
     def store(self, h: int, arr: np.ndarray) -> None:
-        if self.max_bytes <= 0 or h in self._index:
-            return
+        """File I/O runs OUTSIDE the lock (a multi-MB write must not
+        stall the fetcher thread's loads or the step thread's probes);
+        only the duplicate check and the index/eviction bookkeeping hold
+        it. A same-hash double store is impossible by construction (only
+        the step thread's ring eviction stores)."""
+        with self._mu:
+            if self.max_bytes <= 0 or h in self._index:
+                return
         from .kv_transfer import raw_frame
 
         path = self._path(h)
@@ -127,23 +141,29 @@ class DiskKVTier:
         self.flow.record(
             "disk", "out", len(payload), 1, time.perf_counter() - t0
         )
-        self._index[h] = len(payload)
-        self.total_bytes += len(payload)
-        self.stats.stores += 1
-        while self.total_bytes > self.max_bytes and len(self._index) > 1:
-            old, old_size = self._index.popitem(last=False)
-            try:
-                os.unlink(self._path(old))
-            except OSError:
-                pass
-            self.total_bytes -= old_size
-            self.stats.evictions += 1
-            if self.on_drop is not None:
-                self.on_drop(old)
+        with self._mu:
+            self._index[h] = len(payload)
+            self.total_bytes += len(payload)
+            self.stats.stores += 1
+            while self.total_bytes > self.max_bytes and len(self._index) > 1:
+                old, old_size = self._index.popitem(last=False)
+                try:
+                    os.unlink(self._path(old))
+                except OSError:
+                    pass
+                self.total_bytes -= old_size
+                self.stats.evictions += 1
+                if self.on_drop is not None:
+                    self.on_drop(old)
 
     def load(self, h: int) -> np.ndarray | None:
-        if h not in self._index:
-            return None
+        """Like store, the read+parse runs outside the lock — a budget
+        eviction racing the read just unlinks the file under us, which
+        lands in the corrupt-miss path below (the honest outcome)."""
+        with self._mu:
+            if h not in self._index:
+                return None
+            self._index.move_to_end(h)  # LRU touch on the attempt
         from .kv_transfer import FrameParser
 
         t0 = time.perf_counter()
@@ -159,16 +179,17 @@ class DiskKVTier:
             # AttributeError from the dtype lookup) must degrade to a cache
             # miss and unlink — never kill the prefix-match path
             logger.warning("disk KV load of %x failed: %s", h, e)
-            size = self._index.pop(h, 0)
-            self.total_bytes -= size
-            # unlink the corrupt file: leaving it would leak untracked
-            # bytes AND re-index the dead entry on every restart
-            try:
-                os.unlink(self._path(h))
-            except OSError:
-                pass
-            if self.on_drop is not None:
-                self.on_drop(h)
+            with self._mu:
+                size = self._index.pop(h, 0)
+                self.total_bytes -= size
+                # unlink the corrupt file: leaving it would leak untracked
+                # bytes AND re-index the dead entry on every restart
+                try:
+                    os.unlink(self._path(h))
+                except OSError:
+                    pass
+                if size and self.on_drop is not None:
+                    self.on_drop(h)
             self.flow.record(
                 "disk", "in", 0, 0, time.perf_counter() - t0
             )
@@ -176,6 +197,6 @@ class DiskKVTier:
         self.flow.record(
             "disk", "in", arr.nbytes, 1, time.perf_counter() - t0
         )
-        self._index.move_to_end(h)
-        self.stats.loads += 1
+        with self._mu:
+            self.stats.loads += 1
         return arr

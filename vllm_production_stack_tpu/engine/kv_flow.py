@@ -77,15 +77,32 @@ class TierBandwidth:
     toward zero honestly: during a remote-store outage the measured
     fetch bandwidth IS ~0, which is precisely what should flip the
     planner to recompute.
+
+    **Sample floor** (docs/31-hydration-planner.md): :attr:`measured` is
+    False until at least :data:`MIN_SAMPLES` transfers totalling
+    :data:`MIN_BYTES` undecayed bytes have been observed — a single tiny
+    first transfer after startup must never become the estimate the
+    compute-or-load planner trusts. ``hydration_signal()`` reports the
+    flag per tier; the planner treats an unmeasured tier as
+    recompute-only (forced mode) or declines the plan entirely (auto
+    mode, where the synchronous fallback load is exactly what feeds the
+    floor).
     """
 
-    __slots__ = ("_bytes", "_seconds", "_last_t", "samples")
+    # floors for `measured`: enough independent samples that one outlier
+    # can't own the ratio, and enough real payload that the estimate
+    # reflects steady-state transfer, not connection setup
+    MIN_SAMPLES = 2
+    MIN_BYTES = 1 << 20
+
+    __slots__ = ("_bytes", "_seconds", "_last_t", "samples", "total_bytes")
 
     def __init__(self) -> None:
         self._bytes = 0.0
         self._seconds = 0.0
         self._last_t: float | None = None
         self.samples = 0
+        self.total_bytes = 0  # undecayed — feeds the measurement floor
 
     def record(self, nbytes: int, seconds: float, now: float) -> None:
         if self._last_t is not None:
@@ -96,10 +113,18 @@ class TierBandwidth:
         self._bytes += nbytes
         self._seconds += max(seconds, 1e-9)
         self.samples += 1
+        self.total_bytes += int(nbytes)
 
     @property
     def bytes_per_s(self) -> float:
         return self._bytes / self._seconds if self._seconds > 0 else 0.0
+
+    @property
+    def measured(self) -> bool:
+        return (
+            self.samples >= self.MIN_SAMPLES
+            and self.total_bytes >= self.MIN_BYTES
+        )
 
 
 class KVFlowMeter:
@@ -115,8 +140,13 @@ class KVFlowMeter:
     keys off (same always-on rule as the goodput ledger).
     """
 
-    def __init__(self, enabled: bool = True):
+    def __init__(self, enabled: bool = True, _null: bool = False):
         self.enabled = enabled
+        # NULL_FLOW only: a complete no-op, bandwidth estimators included
+        # (the singleton is shared by UNRELATED standalone tier objects —
+        # cross-polluting their bandwidth samples would fabricate a
+        # "measured" tier out of other objects' transfers)
+        self._null = _null
         self._lock = threading.Lock()
         self.bytes: dict[tuple[str, str], int] = {}
         self.blocks: dict[tuple[str, str], int] = {}
@@ -134,6 +164,14 @@ class KVFlowMeter:
         # audited partition counters (tokens), keyed by HYDRATION_SOURCES
         self.hydration: dict[str, int] = {s: 0 for s in HYDRATION_SOURCES}
         self.hydrated_requests = 0
+        # compute-or-load planner decisions per CHUNK (closed choice set,
+        # docs/31-hydration-planner.md): load / recompute at plan time,
+        # plus fallback_recompute when a load chunk misses its deadline
+        # or its fetch fails. Contract counters — always on, like the
+        # attribution partition.
+        self.decisions: dict[str, int] = {
+            c: 0 for c in mc.KV_HYDRATION_CHOICES
+        }
 
     # -- transfer meters (togglable) ----------------------------------------
 
@@ -145,35 +183,65 @@ class KVFlowMeter:
         in `seconds` of wall time. A FAILED transfer should still be
         recorded with whatever partial batch completed (possibly 0 bytes)
         — the elapsed time is real, and losing it would overstate the
-        tier's bandwidth exactly when the planner most needs the truth."""
-        if not self.enabled:
+        tier's bandwidth exactly when the planner most needs the truth.
+
+        ``enabled=False`` silences the METRIC side (bytes/blocks/latency
+        counters) but the TierBandwidth estimators keep recording: they
+        are the hydration planner's decision input, and starving them
+        would silently disable compute-or-load (no tier could ever cross
+        the sample floor). Their cost is a dict lookup + a few float ops
+        per transfer — nothing next to the transfer itself."""
+        if self._null:
+            self.bandwidth[(tier, direction)]  # unknown key: still loud
             return
         key = (tier, direction)  # unknown tier/direction: KeyError, loud
         now = time.perf_counter()
         with self._lock:
+            self.bandwidth[key].record(int(nbytes), seconds, now)
+            if not self.enabled:
+                return
             self.bytes[key] += int(nbytes)
             self.blocks[key] += int(blocks)
             self.transfers[key] += 1
             self.seconds[key].observe(seconds)
-            self.bandwidth[key].record(int(nbytes), seconds, now)
 
     # -- hydration attribution (always on) ----------------------------------
 
-    def record_hydration(self, counts: dict[str, int]) -> None:
+    def record_hydration(
+        self, counts: dict[str, int], requests: int = 1
+    ) -> None:
         """One admitted request's prompt-token partition. Keys must come
         from HYDRATION_SOURCES (closed set — a typo fails loud, even at
         count 0: a mistyped key that's usually zero would otherwise drop
-        tokens from the audited partition only on the rare nonzero hit)."""
+        tokens from the audited partition only on the rare nonzero hit).
+        ``requests=0`` is the hydration planner's incremental form: a
+        planned chunk's tokens are classified when its fate resolves
+        (adopted → its tier's source, fallback/cancel → recomputed), so
+        the partition stays exact while outcomes are still in flight."""
         with self._lock:
             for source, n in counts.items():
                 self.hydration[source] += int(n)
-            self.hydrated_requests += 1
+            self.hydrated_requests += requests
+
+    def record_decision(self, choice: str, n: int = 1) -> None:
+        """One planner chunk decision (tpu:kv_hydration_decision_total).
+        Closed choice set — unknown choices fail loud, like sources."""
+        with self._lock:
+            if choice not in self.decisions:
+                raise KeyError(choice)
+            self.decisions[choice] += n
 
     # -- reporting -----------------------------------------------------------
 
     def bandwidth_bytes_per_s(self) -> dict[tuple[str, str], float]:
         with self._lock:
             return {k: bw.bytes_per_s for k, bw in self.bandwidth.items()}
+
+    def bandwidth_measured(self) -> dict[tuple[str, str], bool]:
+        """Per-(tier, direction) sample-floor state — the planner's
+        trust gate on each bandwidth estimate."""
+        with self._lock:
+            return {k: bw.measured for k, bw in self.bandwidth.items()}
 
     def snapshot(self) -> dict:
         """Cumulative counters + histograms + bandwidth estimates, in the
@@ -198,9 +266,10 @@ class KVFlowMeter:
                 },
                 "hydration": dict(self.hydration),
                 "hydrated_requests": self.hydrated_requests,
+                "decisions": dict(self.decisions),
             }
 
 
 # Shared disabled singleton for tier objects constructed without an engine
 # (unit tests, standalone tools): call sites never branch on `if flow:`.
-NULL_FLOW = KVFlowMeter(enabled=False)
+NULL_FLOW = KVFlowMeter(enabled=False, _null=True)

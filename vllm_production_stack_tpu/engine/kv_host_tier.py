@@ -102,6 +102,23 @@ class HostKVTier:
         # the /kv/lookup probe treat them as one local tier
         return h in self._data or (self.disk is not None and h in self.disk)
 
+    def location(self, h: int) -> str:
+        """Which local rung serves hash h without moving bytes: "host"
+        (ring), "disk", or "" — the hydration planner's residency probe
+        (docs/31-hydration-planner.md)."""
+        if h in self._data:
+            return "host"
+        if self.disk is not None and h in self.disk:
+            return "disk"
+        return ""
+
+    def peek_bytes(self, h: int):
+        """Resolved host-RAM bytes for a ring-resident hash, or None.
+        STEP THREAD ONLY (mutates the ring's pending/entry state) — the
+        hydrator pre-resolves ring blocks here at plan launch so its
+        fetcher thread never touches the ring."""
+        return self._resolve(h) if h in self._data else None
+
     def __len__(self) -> int:
         return len(self._data)
 

@@ -327,6 +327,15 @@ class EngineMetrics:
         self.disk_loads = counter(
             mc.DISK_KV_LOADS, "KV blocks loaded from the local-disk tier"
         )
+        self.hydration_decisions = Counter(
+            mc.KV_HYDRATION_DECISIONS[: -len("_total")],
+            "Compute-or-load hydration planner chunk decisions (closed "
+            "label set: " + ", ".join(mc.KV_HYDRATION_CHOICES)
+            + ") — fallback_recompute = a load chunk that missed its "
+            "fetch deadline or whose fetch failed",
+            [*names, "choice"],
+            registry=self.registry,
+        )
         # seed the closed label sets at zero (same rationale as the
         # saturation series: rate() over a counter appearing mid-flight
         # misses its first increment)
@@ -338,6 +347,8 @@ class EngineMetrics:
                 self.kv_tier_bandwidth.labels(**fl)
         for source in HYDRATION_SOURCES:
             self.prefix_tokens.labels(**self._labels, source=source)
+        for choice in mc.KV_HYDRATION_CHOICES:
+            self.hydration_decisions.labels(**self._labels, choice=choice)
         self.disk_stores.labels(**self._labels)
         self.disk_loads.labels(**self._labels)
         self.registry.register(_KVFlowHistograms(self))
@@ -543,6 +554,12 @@ class EngineMetrics:
             self._bump_labeled(
                 self.prefix_tokens, f"hyd:{source}",
                 int(hyd.get(source, 0)), {**lb, "source": source},
+            )
+        decisions = flow.get("decisions") or {}
+        for choice in mc.KV_HYDRATION_CHOICES:
+            self._bump_labeled(
+                self.hydration_decisions, f"hyd_dec:{choice}",
+                int(decisions.get(choice, 0)), {**lb, "choice": choice},
             )
         self._bump(self.disk_stores, "disk_store", s.disk_kv_stores)
         self._bump(self.disk_loads, "disk_load", s.disk_kv_loads)

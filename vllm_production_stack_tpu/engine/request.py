@@ -117,6 +117,14 @@ class Request:
     # recomputed} tokens summing to num_prompt_tokens. None until seated
     # (and forever for requests refused before a seat).
     hydration: dict | None = None
+    # compute-or-load hydration planner (docs/31-hydration-planner.md):
+    # the live chunk plan over this request's lower-tier-resident prefix
+    # (engine/hydration.HydrationPlan), None when no plan is active —
+    # cleared when fully consumed, cancelled on preempt/finish.
+    hydration_plan: object | None = None
+    # per-chunk outcome records appended as chunks resolve — surfaced on
+    # the terminal output for the kv_hydration trace event's plan view
+    hydration_outcomes: list | None = None
     # absolute time.monotonic() after which this request is worthless to its
     # caller (x-request-deadline-ms, carried router → engine → scheduler);
     # None = no deadline. The scheduler sweeps expired requests out of
@@ -191,3 +199,7 @@ class RequestOutput:
     # (Request.hydration) — the HTTP layer emits it as the timeline's
     # kv_hydration event (docs/30-kv-flow-telemetry.md)
     hydration: dict | None = None
+    # terminal output only: the hydration planner's per-chunk outcomes
+    # (Request.hydration_outcomes) — the kv_hydration trace event's
+    # "plan" attribute (docs/31-hydration-planner.md)
+    hydration_chunks: list | None = None

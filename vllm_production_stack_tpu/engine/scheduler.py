@@ -113,6 +113,7 @@ class Scheduler:
         need_slot_mappings: bool = False,
         accounting: TenantAccounting | None = None,
         flow=None,
+        hydrator=None,
     ):
         self.model_config = model_config
         self.cache_config = cache_config
@@ -174,6 +175,13 @@ class Scheduler:
 
             flow = KVFlowMeter()
         self.flow = flow
+        # compute-or-load hydration planner (docs/31-hydration-planner.md,
+        # engine/hydration.Hydrator): when set, first admissions with a
+        # disk/remote-resident prefix split it into chunks decided
+        # load-vs-recompute, and _try_add_chunk consumes fetched chunks as
+        # they land instead of blocking the whole reload in match_prefix.
+        # None (unit tests, engines without lower tiers) = legacy path.
+        self.hydrator = hydrator
 
     # -- admission ---------------------------------------------------------
 
@@ -593,9 +601,23 @@ class Scheduler:
         return work if work.requests else None
 
     def _try_add_chunk(self, work: PrefillWork, req: Request, budget: int) -> int:
-        """Add one chunk of `req` to the batch; returns tokens consumed."""
+        """Add one chunk of `req` to the batch; returns tokens consumed.
+
+        With an active hydration plan (docs/31-hydration-planner.md) this
+        first consumes any landed load-chunks at the request's compute
+        boundary (free tokens — adopted, not computed) and then bounds
+        the prefill chunk at the next unresolved load boundary; a request
+        parked exactly at a pending fetch contributes no row this step
+        (decode and other prefills proceed around it)."""
+        limit = (
+            self._consume_hydrated(req)
+            if req.hydration_plan is not None
+            else None
+        )
         target = req.prefill_target
         chunk = min(budget, target - req.num_computed_tokens)
+        if limit is not None:
+            chunk = min(chunk, limit)
         if chunk <= 0:
             return 0
         if not self._ensure_blocks(req, req.num_computed_tokens + chunk):
@@ -776,15 +798,35 @@ class Scheduler:
 
     def _admit(self, req: Request) -> None:
         """Prefix-cache lookup for a waiting (possibly resumed) request.
-        The matchable sequence is everything that will be recomputed."""
+        The matchable sequence is everything that will be recomputed.
+
+        With a hydrator (docs/31-hydration-planner.md), a FIRST admission
+        whose resident run continues past the local rungs consumes the
+        HBM/host-ring prefix synchronously (cheap — RAM→device dispatch)
+        and plans the disk/remote remainder as chunked async loads
+        pipelined with the recompute of whatever the planner prices as
+        cheaper; resumed (preempted) requests keep the legacy blocking
+        match — their blocks are usually still local, and their
+        attribution is already settled."""
         seq = req.all_token_ids
         root = self._chain_root(req)
-        matched = self.pool.match_prefix(seq, parent=root)
+        plan = None
+        if (
+            self.hydrator is not None
+            and req.hydration is None  # first admission only
+            and self.pool.enable_prefix_caching
+        ):
+            matched, plan = self._admit_planned(req, seq, root)
+        else:
+            matched = self.pool.match_prefix(seq, parent=root)
         # keep at least one token to actually compute (its logits / its KV
         # write are what the next step needs)
         while matched and len(matched) * self.block_size >= req.prefill_target:
             self.pool.free_block(matched.pop())
-        self._attribute_hydration(req, len(matched))
+        self._attribute_hydration(
+            req, len(matched),
+            deferred=plan.deferred_tokens() if plan is not None else 0,
+        )
         req.block_table = matched
         req.num_computed_tokens = len(matched) * self.block_size
         req.num_cached_prompt_tokens = min(
@@ -795,6 +837,199 @@ class Scheduler:
             chunk = tuple(seq[i * self.block_size : (i + 1) * self.block_size])
             chain.append(chain_hash(chain[-1], chunk))
         self._hash_chains[req.request_id] = chain
+        if plan is not None:
+            req.hydration_plan = plan
+            self.hydrator.launch(plan)
+
+    def _admit_planned(self, req: Request, seq: list[int], root: int):
+        """Planner half of _admit: probe residency without moving bytes,
+        take the leading HBM/ring run synchronously, and build a chunk
+        plan over the disk/remote remainder. Returns (matched, plan);
+        plan None means the caller behaves exactly like the legacy path
+        (matched is then a full-hierarchy blocking match — the auto-mode
+        fallback, whose transfers are what feed the bandwidth estimator
+        past its sample floor)."""
+        # kill switch / bench compute-only arm: lower-tier residency is
+        # ignored and — crucially — the remote store is never probed (a
+        # sick store is exactly why an operator flips this off)
+        off = self.hydrator.mode == "off"
+        hashes, tiers = self.pool.probe_prefix(
+            seq, parent=root, local_only=off
+        )
+        # keep-one-token rule applied to the whole resident run: the plan
+        # region must end at least one token short of the prefill target
+        cap = max(0, (req.prefill_target - 1) // self.block_size)
+        hashes, tiers = hashes[:cap], tiers[:cap]
+        n_sync = 0
+        while n_sync < len(tiers) and tiers[n_sync] in ("hbm", "host"):
+            n_sync += 1
+        if off:
+            return (
+                self.pool.match_prefix(seq, parent=root, limit_blocks=n_sync),
+                None,
+            )
+        if n_sync == len(tiers):
+            # nothing beyond the local rungs — the legacy match never
+            # blocks on disk/remote here
+            return self.pool.match_prefix(seq, parent=root), None
+        plan = self.hydrator.build_plan(
+            req.request_id, n_sync, hashes[n_sync:], tiers[n_sync:],
+            self.block_size,
+        )
+        if plan is None:
+            return self.pool.match_prefix(seq, parent=root), None
+        matched = self.pool.match_prefix(
+            seq, parent=root, limit_blocks=n_sync
+        )
+        if len(matched) != n_sync:
+            # an eviction raced the probe: the plan's block indices no
+            # longer line up — drop the plan (the region recomputes; the
+            # next identical prompt re-plans against fresh residency)
+            return matched, None
+        # region blocks are real cache queries; hits count at adoption
+        self.pool.stats.queries += len(hashes) - n_sync
+        return matched, plan
+
+    # -- hydration plan consumption (docs/31-hydration-planner.md) ---------
+
+    def _consume_hydrated(self, req: Request) -> int | None:
+        """Resolve the request's hydration plan at its compute boundary:
+        adopt landed chunks (extend the block table without computing),
+        flip failed/expired load-chunks to recompute, and return how many
+        tokens prefill may compute before the next unresolved load
+        boundary — 0 parks the request this step, None means
+        unconstrained (plan exhausted or no load chunk ahead)."""
+        import time as _time
+
+        plan = req.hydration_plan
+        bs = self.block_size
+        while plan is not None and not plan.done():
+            cur = plan.current()
+            start_tok = cur.start_block * bs
+            end_tok = start_tok + cur.tokens(bs)
+            if req.num_computed_tokens >= end_tok:
+                if cur.status == "pending":
+                    cur.status = "recomputed"  # computed straight through
+                plan.advance()
+                continue
+            if req.num_computed_tokens != start_tok or cur.decision != "load":
+                break  # mid-compute of a recompute chunk
+            with plan.lock:
+                status = cur.status
+                expired = (
+                    status == "pending"
+                    and _time.monotonic() > plan.deadline
+                )
+                if expired:
+                    # claim the flip under the lock so a late fetcher
+                    # landing can't resurrect the chunk
+                    cur.status = "fallback"
+            if status == "landed":
+                blocks = self.pool.adopt_planned_run(cur.hashes, cur.arrays)
+                if blocks is None:
+                    # allocation/geometry/upload failure: recompute keeps
+                    # liveness (the compute path can preempt for blocks;
+                    # adoption must not)
+                    self._flip_chunk(req, cur, "adopt_failed")
+                    continue
+                cur.arrays = None
+                cur.status = "adopted"
+                req.block_table.extend(blocks)
+                req.num_computed_tokens = end_tok
+                req.num_cached_prompt_tokens = min(
+                    req.num_computed_tokens, req.num_prompt_tokens
+                )
+                chain = self._hash_chains.setdefault(
+                    req.request_id, [self._chain_root(req)]
+                )
+                chain.extend(cur.hashes)
+                self._record_outcome(req, cur, "adopted")
+                for tier in cur.tiers:
+                    self._attribute_increment(
+                        req, self._HYDRATION_BY_TIER[tier], bs
+                    )
+                plan.advance()
+                continue
+            if expired:
+                self._flip_chunk(req, cur, "timeout", already_claimed=True)
+                continue
+            if status in ("failed", "cancelled"):
+                self._flip_chunk(req, cur, status)
+                continue
+            return 0  # pending within its deadline: park this request
+        if plan is not None and plan.done():
+            req.hydration_plan = None
+            plan = None
+        if plan is None:
+            return None
+        nxt = None
+        for c in plan.chunks[plan.cursor:]:
+            if c.decision == "load":
+                nxt = c.start_block * bs
+                break
+        if nxt is None:
+            return None
+        return max(0, nxt - req.num_computed_tokens)
+
+    def _flip_chunk(
+        self, req: Request, chunk, why: str, already_claimed: bool = False,
+    ) -> None:
+        """A load chunk's fetch failed, expired, or could not adopt: it
+        becomes a recompute chunk (choice counter: fallback_recompute)
+        and its tokens classify as recomputed — the partition invariant
+        holds no matter which way a chunk resolves."""
+        plan = req.hydration_plan
+        if not already_claimed and plan is not None:
+            with plan.lock:
+                chunk.status = "fallback"
+        elif plan is None:
+            chunk.status = "fallback"
+        chunk.decision = "recompute"
+        chunk.arrays = None
+        self.flow.record_decision("fallback_recompute")
+        self._record_outcome(req, chunk, f"fallback:{why}")
+        self._attribute_increment(
+            req, "recomputed", chunk.tokens(self.block_size)
+        )
+
+    def _record_outcome(self, req: Request, chunk, outcome: str) -> None:
+        if req.hydration_outcomes is None:
+            req.hydration_outcomes = []
+        req.hydration_outcomes.append({
+            "chunk": chunk.index,
+            "start_block": chunk.start_block,
+            "tokens": chunk.tokens(self.block_size),
+            "tiers": sorted(set(chunk.tiers)),
+            "decision": "load",
+            "outcome": outcome,
+        })
+
+    def hydration_parked(self) -> bool:
+        """True when some running request still has an active hydration
+        plan — the engine's step loop sleeps a beat instead of busy-
+        spinning when such a request is the only schedulable work (its
+        fetch needs the CPU the spin would burn)."""
+        return any(r.hydration_plan is not None for r in self.running)
+
+    def _settle_hydration_plan(self, req: Request) -> None:
+        """Cancel an active plan and classify every still-open load chunk
+        as recomputed — a request leaving the scheduler mid-hydration
+        (preemption, abort, deadline, shed) must not strand deferred
+        tokens outside the audited partition. In-flight fetch jobs see
+        the cancel flag and drop their results."""
+        plan = req.hydration_plan
+        if plan is None:
+            return
+        req.hydration_plan = None
+        open_chunks = plan.unresolved()
+        plan.cancel()
+        for chunk in open_chunks:
+            chunk.decision = "recompute"
+            chunk.arrays = None
+            self._record_outcome(req, chunk, "cancelled")
+            self._attribute_increment(
+                req, "recomputed", chunk.tokens(self.block_size)
+            )
 
     _HYDRATION_BY_TIER = {
         "hbm": "hbm_hit",
@@ -803,7 +1038,9 @@ class Scheduler:
         "remote": "remote_fetch",
     }
 
-    def _attribute_hydration(self, req: Request, n_matched: int) -> None:
+    def _attribute_hydration(
+        self, req: Request, n_matched: int, deferred: int = 0
+    ) -> None:
         """Classify the request's prompt tokens by KV origin, EXACTLY once
         (first admission only — a preempted request re-admitting keeps its
         original attribution; the recompute cost is the goodput ledger's
@@ -815,17 +1052,34 @@ class Scheduler:
             hbm_hit + host_reload + disk_load + remote_fetch + recomputed
                 == prompt_tokens
 
-        with recomputed >= 1 (the keep-one-token-to-compute rule)."""
+        with recomputed >= 1 (the keep-one-token-to-compute rule).
+
+        `deferred` (hydration planner) excludes the plan's load-decided
+        chunk tokens from the admission-time counts: each classifies via
+        _attribute_increment when its fate resolves — adopted under its
+        tier's source, fallback/cancelled as recomputed — so the
+        partition stays exact at every settle point."""
         if req.hydration is not None:
             return
         counts = dict.fromkeys(self._HYDRATION_BY_TIER.values(), 0)
         for tier in self.pool.last_match_sources[:n_matched]:
             counts[self._HYDRATION_BY_TIER[tier]] += self.block_size
         counts["recomputed"] = (
-            req.num_prompt_tokens - n_matched * self.block_size
+            req.num_prompt_tokens - n_matched * self.block_size - deferred
         )
         req.hydration = counts
         self.flow.record_hydration(counts)
+
+    def _attribute_increment(self, req: Request, source: str, n: int) -> None:
+        """Deferred-chunk classification (hydration planner): move n of
+        the request's prompt tokens into `source`, mirrored into the
+        shared flow counters without bumping hydrated_requests."""
+        if req.hydration is None:  # planner admissions always attribute
+            req.hydration = dict.fromkeys(
+                (*self._HYDRATION_BY_TIER.values(), "recomputed"), 0
+            )
+        req.hydration[source] = req.hydration.get(source, 0) + n
+        self.flow.record_hydration({source: n}, requests=0)
 
     def _ensure_blocks(self, req: Request, num_tokens: int) -> bool:
         """Grow req's block table to cover num_tokens. On pool exhaustion the
@@ -867,6 +1121,11 @@ class Scheduler:
 
     def _preempt(self, req: Request) -> None:
         self.running.remove(req)
+        # preemption mid-hydration: the plan dies with the seat (its
+        # deferred tokens settle as recomputed — partition stays exact);
+        # re-admission runs the legacy match, which will find whatever
+        # the fetches already promoted into the ring
+        self._settle_hydration_plan(req)
         self._release_blocks(req)
         # goodput ledger: nothing to classify here — the preempted
         # request's pending tokens keep their unknown fate (the VALUES
@@ -1135,6 +1394,10 @@ class Scheduler:
 
         req.status = status
         req.finish_time = time.monotonic()
+        # a request finishing mid-hydration (abort / deadline / shed)
+        # settles its plan first: deferred tokens classify as recomputed,
+        # in-flight fetches drop their results
+        self._settle_hydration_plan(req)
         # goodput ledger: the request's fate is sealed — classify its
         # pending tokens (delivered for stop/length; deadline_expired /
         # shed_evicted / severed for the rest, saturation.FINISH_REASONS)

@@ -154,6 +154,7 @@ class EngineServer:
         r.add_post("/drain", self.drain)
         r.add_get("/metrics", self.metrics_endpoint)
         r.add_get("/debug/timing", self.debug_timing)
+        r.add_get("/debug/hydration", self.debug_hydration)
         r.add_get("/debug/requests", self.debug_requests)
         r.add_post("/debug/profile/start", self.debug_profile_start)
         r.add_post("/debug/profile/stop", self.debug_profile_stop)
@@ -381,7 +382,15 @@ class EngineServer:
         # span it explains.
         hyd = getattr(out, "hydration", None)
         if hyd:
-            trace.event("kv_hydration", choice=choice, **hyd)
+            plan = getattr(out, "hydration_chunks", None)
+            if plan:
+                # compute-or-load planner (docs/31-hydration-planner.md):
+                # the per-chunk decisions and outcomes that produced this
+                # partition — which chunks adopted a tier fetch, which
+                # fell back to recompute and why
+                trace.event("kv_hydration", choice=choice, plan=plan, **hyd)
+            else:
+                trace.event("kv_hydration", choice=choice, **hyd)
         # ONE monotonic→epoch anchor for the whole timeline: converting
         # each stamp independently (mono_to_epoch per call) drifts the
         # shared phase boundaries apart by float noise
@@ -1359,6 +1368,32 @@ class EngineServer:
             },
         })
 
+    async def debug_hydration(self, request: web.Request) -> web.Response:
+        """Operator view of the compute-or-load hydration planner
+        (docs/31-hydration-planner.md): the LIVE decision inputs —
+        per-tier measured fetch bandwidth + sample-floor state, achieved
+        prefill FLOP/s, per-block KV bytes — alongside the cumulative
+        per-chunk decision counters and the planner's configuration.
+        Exactly the numbers the planner acted on, not a reconstruction."""
+        eng = self.engine
+
+        def work():
+            sig = eng.hydration_signal()
+            snap = eng.flow.snapshot()
+            hydr = getattr(eng, "hydrator", None)
+            return {
+                "signal": sig,
+                "decisions": snap.get("decisions", {}),
+                "hydration_sources": snap.get("hydration", {}),
+                "planner": (
+                    hydr.snapshot() if hydr is not None
+                    else {"mode": eng.config.kv_hydration, "enabled": False}
+                ),
+            }
+
+        data = await asyncio.get_running_loop().run_in_executor(None, work)
+        return web.json_response(data)
+
     async def sleep(self, request: web.Request) -> web.Response:
         level = int(request.query.get("level", "1"))
         try:
@@ -1730,8 +1765,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "behind the compute-or-load hydration signal. "
                         "'false' disables the transfer meters; the "
                         "hydration attribution counters "
-                        "(tpu:request_prefix_tokens_total) stay on either "
-                        "way")
+                        "(tpu:request_prefix_tokens_total) AND the "
+                        "bandwidth estimators (the hydration planner's "
+                        "decision input) stay on either way")
+    p.add_argument("--kv-hydration", default="auto",
+                   choices=["auto", "planner", "sync", "off"],
+                   help="compute-or-load KV hydration for disk/remote-"
+                        "resident prefixes (docs/31-hydration-planner.md): "
+                        "auto chunks the resident run and picks "
+                        "load-vs-recompute per chunk from measured tier "
+                        "bandwidth vs prefill FLOP/s, pipelining async "
+                        "fetches with chunked prefill (sync-load fallback "
+                        "below the bandwidth sample floor); planner always "
+                        "plans (unmeasured tiers recompute); sync is the "
+                        "legacy blocking whole-prefix reload; off ignores "
+                        "lower-tier residency (recompute-only)")
+    p.add_argument("--kv-hydration-chunk-blocks", type=int, default=16,
+                   help="hydration planner chunk granularity in KV blocks "
+                        "(the fetch/adopt/decide unit)")
+    p.add_argument("--kv-hydration-timeout-s", type=float, default=0.0,
+                   help="seconds a planned chunk fetch may run before the "
+                        "chunk falls back to recompute; 0 = auto (3x the "
+                        "plan's own fetch estimate, clamped to [0.5, 30])")
     p.add_argument("--prefill-buckets", default="",
                    help="comma-separated prefill chunk buckets (default: "
                         "pow2 ladder up to --max-num-batched-tokens). "
@@ -1906,6 +1961,11 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         async_scheduling=getattr(args, "async_scheduling", True),
         step_metering=getattr(args, "step_metering", True),
         kv_flow_metering=getattr(args, "kv_flow_metering", True),
+        kv_hydration=getattr(args, "kv_hydration", "auto"),
+        kv_hydration_chunk_blocks=getattr(
+            args, "kv_hydration_chunk_blocks", 16
+        ),
+        kv_hydration_timeout_s=getattr(args, "kv_hydration_timeout_s", 0.0),
     )
 
 

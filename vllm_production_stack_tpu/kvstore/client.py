@@ -116,6 +116,12 @@ class RemoteKVTier:
         self.last_usage_perc = 0.0
         self._fetch_conn = _Conn(self.host, self.port, timeout)
         self._store_conn = _Conn(self.host, self.port, timeout)
+        # the fetch connection is shared by the engine step thread
+        # (match_prefix / probe continuations) and the hydration fetcher
+        # thread (chunked async loads, docs/31-hydration-planner.md) —
+        # serialize round trips so interleaved requests can't corrupt the
+        # keep-alive stream
+        self._fetch_mu = threading.Lock()
         self._down_until = 0.0
         # hashes known stored (by US — other engines' pushes are invisible,
         # which only costs a redundant put); shared engine/writer thread
@@ -232,15 +238,16 @@ class RemoteKVTier:
         if not hashes or not self._available():
             return 0
         try:
-            status, _, payload = self._fetch_conn.request(
-                "POST",
-                "/v1/contains",
-                body=json.dumps({
-                    "fingerprint": self.fingerprint,
-                    "hashes": [str(h) for h in hashes],
-                }).encode(),
-                headers={"Content-Type": "application/json"},
-            )
+            with self._fetch_mu:
+                status, _, payload = self._fetch_conn.request(
+                    "POST",
+                    "/v1/contains",
+                    body=json.dumps({
+                        "fingerprint": self.fingerprint,
+                        "hashes": [str(h) for h in hashes],
+                    }).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
         except OSError as e:
             self._trip(e)
             return 0
@@ -255,9 +262,19 @@ class RemoteKVTier:
         self.stats.probe_hits += n
         return n
 
-    def fetch_run(self, hashes: list[int]) -> list[np.ndarray]:
+    def new_fetch_conn(self) -> _Conn:
+        """A dedicated keep-alive connection for a long-lived fetch
+        consumer (the hydration planner's fetcher thread) — its
+        multi-second chunk mgets must never hold the shared fetch lock
+        the step thread's probes and sync matches contend on."""
+        return _Conn(self.host, self.port, self._fetch_conn.timeout)
+
+    def fetch_run(
+        self, hashes: list[int], conn: _Conn | None = None
+    ) -> list[np.ndarray]:
         """The consecutive present prefix of `hashes` as arrays, one batched
-        mget round trip.
+        mget round trip. `conn` routes the round trip over a dedicated
+        connection (new_fetch_conn) instead of the shared, locked one.
 
         Partial failures degrade to partial SUCCESS: when the response
         stream goes corrupt mid-run (foreign-version store, truncated
@@ -280,16 +297,22 @@ class RemoteKVTier:
                 time.perf_counter() - t0,
             )
 
+        body = json.dumps({
+            "fingerprint": self.fingerprint,
+            "hashes": [str(h) for h in hashes],
+        }).encode()
         try:
-            status, headers, payload = self._fetch_conn.request(
-                "POST",
-                "/v1/mget",
-                body=json.dumps({
-                    "fingerprint": self.fingerprint,
-                    "hashes": [str(h) for h in hashes],
-                }).encode(),
-                headers={"Content-Type": "application/json"},
-            )
+            if conn is not None:
+                status, headers, payload = conn.request(
+                    "POST", "/v1/mget", body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+            else:
+                with self._fetch_mu:
+                    status, headers, payload = self._fetch_conn.request(
+                        "POST", "/v1/mget", body=body,
+                        headers={"Content-Type": "application/json"},
+                    )
         except OSError as e:
             _flow(0)  # a dead store IS ~0 fetch bandwidth — record it
             self._trip(e)

@@ -203,6 +203,18 @@ REQUEST_PREFIX_TOKENS = "tpu:request_prefix_tokens_total"
 # REMOTE_KV_* — the disk rung was dark before this pair existed)
 DISK_KV_STORES = "tpu:disk_kv_stored_blocks_total"
 DISK_KV_LOADS = "tpu:disk_kv_loaded_blocks_total"
+# compute-or-load hydration planner (docs/31-hydration-planner.md):
+# per-CHUNK decisions over lower-tier-resident prefix runs. "load" =
+# async tier fetch pipelined with prefill of the recomputed head;
+# "recompute" = the chunk's measured fetch cost lost to prefill FLOP/s
+# (or its tier sits below the TierBandwidth sample floor in forced
+# mode); "fallback_recompute" = a load chunk that missed its deadline or
+# whose fetch failed, flipped back to compute at the prefill boundary —
+# an ADDITIONAL event on top of that chunk's plan-time "load" count, so
+# share-of-plan rules must use {choice=~"load|recompute"} as their
+# denominator (tpu:kv_hydration_load_share:rate5m does).
+KV_HYDRATION_DECISIONS = "tpu:kv_hydration_decision_total"
+KV_HYDRATION_CHOICES = ("load", "recompute", "fallback_recompute")
 
 # Closed label sets per metric, the single source of truth the exporters
 # seed from and tools/check_metrics_contract.py validates BOTH ways: the
@@ -224,6 +236,7 @@ METRIC_LABEL_VALUES: dict[str, dict[str, tuple[str, ...]]] = {
         "tier": KV_TRANSFER_TIERS, "direction": KV_TRANSFER_DIRECTIONS,
     },
     REQUEST_PREFIX_TOKENS: {"source": KV_HYDRATION_SOURCES},
+    KV_HYDRATION_DECISIONS: {"choice": KV_HYDRATION_CHOICES},
     ENGINE_KV_TIER_USAGE: {"tier": ("hbm", "host", "disk", "remote")},
     ENGINE_STEP_TOKENS: {"phase": ("prefill", "decode")},
     ENGINE_PADDED_TOKENS: {"phase": ("prefill", "decode")},
@@ -237,6 +250,7 @@ KV_FLOW_COUNTERS = (
     REQUEST_PREFIX_TOKENS,
     DISK_KV_STORES,
     DISK_KV_LOADS,
+    KV_HYDRATION_DECISIONS,
 )
 
 # -- cluster KV index (event-driven KV-aware routing) -----------------------
@@ -332,4 +346,5 @@ ALL_COUNTERS = (
     REQUEST_PREFIX_TOKENS,
     DISK_KV_STORES,
     DISK_KV_LOADS,
+    KV_HYDRATION_DECISIONS,
 )
