@@ -1492,6 +1492,439 @@ def _hydration_bench() -> dict:
     }
 
 
+async def _fleet_bench() -> dict:
+    """Fleet-coherence telemetry baselines (docs/32-fleet-telemetry.md),
+    CPU-only pre-preflight: M=3 REAL router apps × N=4 fake engines, the
+    three numbers ROADMAP 1's multi-replica refactor must beat, measured
+    through real wire traffic:
+
+    1. **convergence**: a 10k-event KV storm pumped (with real publish
+       timestamps) through POST /kv/events into each replica's embedded
+       index + the controller — publish→apply lag p50/p95 per replica,
+       plus the replica-restart arc: a cold replica's divergence on
+       GET /fleet rises to the full slice, then heals to 0 after resync.
+    2. **stickiness**: session flood spread across 3 routers with
+       IDENTICAL ring membership → violation rate must be 0; the same
+       flood with one router's membership forcibly skewed (a phantom
+       backend the others don't list) → violations > 0 (detection proven,
+       ring divergence flagged on /fleet).
+    3. **tenant accounting**: a 3-replica flood against a 20 req/s tenant
+       budget — each replica's local bucket admits the full budget, so the
+       controller's fleet rollup must measure utilization ≈ 3× and
+       over-admission ≈ 2; the single-router baseline measures ≈ 1× / ≈ 0.
+    """
+    import asyncio
+    import socket
+
+    import numpy as np
+    from aiohttp import web
+
+    import aiohttp
+    from vllm_production_stack_tpu.engine.kv_cache import KVBlockPool
+    from vllm_production_stack_tpu.engine.kv_controller import KVController
+    from vllm_production_stack_tpu.fleet import SessionStickinessAudit
+    from vllm_production_stack_tpu.qos import TenantTable
+    from vllm_production_stack_tpu.router.app import build_app
+    from vllm_production_stack_tpu.router.args import parse_args
+
+    N_ENGINES, BLOCK = 4, 16
+    N_REPLICAS = 3
+    STORM_EVENTS = 10_000
+    STORM_BATCH = 512
+
+    runners: list[web.AppRunner] = []
+
+    async def serve(app) -> tuple[web.AppRunner, str]:
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        runners.append(runner)
+        return runner, f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+    # -- fake engines: real HTTP servers that answer /v1/completions and
+    # feed the REAL engine-side stickiness audit from the router stamps
+    audits: list[SessionStickinessAudit] = []
+    engine_urls: list[str] = []
+
+    def engine_app(audit_holder: list) -> web.Application:
+        async def completions(request):
+            audit_holder[0].observe_headers(request.headers)
+            return web.json_response({
+                "id": "cmpl-fleet", "object": "text_completion",
+                "choices": [{"index": 0, "text": "ok",
+                             "finish_reason": "stop"}],
+            })
+
+        app = web.Application()
+        app.router.add_post("/v1/completions", completions)
+        return app
+
+    tenants_yaml = {
+        "acme": {"api_key": "k-acme", "requests_per_s": 20.0},
+    }
+    import tempfile
+
+    import yaml as _yaml
+
+    tenant_file = tempfile.NamedTemporaryFile(
+        "w", suffix=".yaml", delete=False
+    )
+    _yaml.safe_dump(tenants_yaml, tenant_file)
+    tenant_file.close()
+
+    try:
+        for _ in range(N_ENGINES):
+            holder = [None]
+            _, url = await serve(engine_app(holder))
+            holder[0] = SessionStickinessAudit(self_url=url)
+            audits.append(holder[0])
+            engine_urls.append(url)
+
+        controller = KVController(
+            engine_urls, mode="indexed",
+            tenant_table=TenantTable.from_dict(tenants_yaml),
+        )
+        controller.fleet.rate_window_s = 10.0
+        _, controller_url = await serve(controller.build_app())
+
+        def router_args(replica: str, backends: list[str], policy: str,
+                        with_qos: bool = False):
+            argv = [
+                "--static-backends", ",".join(backends),
+                # static model list: skips the one-shot /v1/models probe
+                # (the fake engines only serve /v1/completions)
+                "--static-models", ";".join(["tiny"] * len(backends)),
+                "--router-replica-id", replica,
+                "--fleet-report-url", controller_url,
+                "--fleet-report-interval", "0.25",
+                "--breaker-failure-threshold", "0",
+            ]
+            if policy == "session":
+                argv += ["--routing-logic", "session",
+                         "--session-key", "x-user-id"]
+            else:
+                argv += ["--routing-logic", "kvaware",
+                         "--kv-index-mode", "embedded",
+                         "--kv-index-tokenizer", "byte"]
+            if with_qos:
+                argv += ["--tenant-table-file", tenant_file.name]
+            return parse_args(argv)
+
+        # ---------------- 1. convergence storm -------------------------
+        pools = [KVBlockPool(4096, BLOCK) for _ in range(N_ENGINES)]
+        replicas = []
+        for i in range(N_REPLICAS):
+            runner, url = await serve(build_app(router_args(
+                f"replica-{i}", engine_urls, "kvaware"
+            )))
+            replicas.append((runner.app["state"], url))
+
+        async with aiohttp.ClientSession() as sess:
+            async def publish(url: str, payload: dict):
+                async with sess.post(url + "/kv/events", json=payload) as r:
+                    assert r.status == 200, await r.text()
+                    return await r.json()
+
+            subscriber_urls = [u for _, u in replicas] + [controller_url]
+            # snapshot-first (empty pools), mirroring the publisher's
+            # first-contact resync
+            for i, pool in enumerate(pools):
+                epoch, seq, hashes = pool.snapshot_events()
+                for sub in subscriber_urls:
+                    await publish(sub, {
+                        "engine": engine_urls[i], "epoch": epoch,
+                        "block_size": BLOCK, "snapshot": True, "seq": seq,
+                        "hashes": [f"{h:x}" for h in hashes],
+                        "ts": time.time(),
+                    })
+            # admit ~STORM_EVENTS blocks across the pools (each admission
+            # emits one sequenced event with its emit wall-time)
+            rng = np.random.RandomState(11)
+            per_engine = STORM_EVENTS // N_ENGINES
+            for pool in pools:
+                parent = pool.root_hash()
+                for _ in range(per_engine):
+                    blk = pool.allocate()
+                    assert blk is not None
+                    parent = pool.register_full_block(
+                        blk, parent,
+                        tuple(int(t) for t in rng.randint(1, 30000, BLOCK)),
+                    )
+            # pump the storm: real drain_timed batches (publish ts = the
+            # oldest event's emit time, so lag includes in-buffer dwell)
+            # POSTed to every subscriber over real wire
+            events_pumped = 0
+            for i, pool in enumerate(pools):
+                while True:
+                    seq_start, events, oldest_ts = (
+                        pool.events.drain_timed(STORM_BATCH)
+                    )
+                    if not events:
+                        break
+                    events_pumped += len(events)
+                    for sub in subscriber_urls:
+                        reply = await publish(sub, {
+                            "engine": engine_urls[i],
+                            "epoch": pool.events.epoch,
+                            "block_size": BLOCK, "seq_start": seq_start,
+                            "events": events, "ts": oldest_ts,
+                        })
+                        assert reply.get("status") == "ok", reply
+
+            def lag_pcts(state) -> dict:
+                lags = sorted(state.policy.index.convergence.drain())
+                if not lags:
+                    return {"p50_ms": None, "p95_ms": None, "batches": 0}
+                pick = lambda p: round(  # noqa: E731
+                    lags[min(len(lags) - 1, int(p * len(lags)))] * 1e3, 3
+                )
+                return {"p50_ms": pick(0.50), "p95_ms": pick(0.95),
+                        "batches": len(lags)}
+
+            convergence = {
+                f"replica-{i}": lag_pcts(state)
+                for i, (state, _) in enumerate(replicas)
+            }
+
+            # replica-restart arc: a COLD index (replica-3 boots fresh)
+            # reports positions without the storm → /fleet divergence is
+            # the full authoritative slice; a snapshot resync heals it
+            cold_runner, cold_url = await serve(build_app(router_args(
+                "replica-cold", engine_urls, "kvaware"
+            )))
+            cold_state = cold_runner.app["state"]
+            await cold_state.fleet_reporter.report_once()
+            async with sess.get(controller_url + "/fleet") as r:
+                fleet_before = await r.json()
+            div_before = {
+                rep["replica"]: rep["divergence_blocks"]
+                for rep in fleet_before["replicas"]
+            }
+            for i, pool in enumerate(pools):
+                epoch, seq, hashes = pool.snapshot_events()
+                await publish(cold_url, {
+                    "engine": engine_urls[i], "epoch": epoch,
+                    "block_size": BLOCK, "snapshot": True, "seq": seq,
+                    "hashes": [f"{h:x}" for h in hashes],
+                    "ts": time.time(),
+                })
+            await cold_state.fleet_reporter.report_once()
+            async with sess.get(controller_url + "/fleet") as r:
+                fleet_after = await r.json()
+            div_after = {
+                rep["replica"]: rep["divergence_blocks"]
+                for rep in fleet_after["replicas"]
+            }
+
+            # ---------------- 2. stickiness audit ----------------------
+            def reset_audits():
+                for holder in audits:
+                    holder.violations = {
+                        k: 0 for k in holder.violations
+                    }
+                    holder._sessions.clear()
+                    holder.observed = 0
+
+            async def session_flood(router_urls: list[str],
+                                    sessions: int = 48,
+                                    rounds: int = 4) -> dict:
+                reset_audits()
+                n = 0
+                for rnd in range(rounds):
+                    tasks = []
+                    for s in range(sessions):
+                        url = router_urls[(s + rnd) % len(router_urls)]
+                        tasks.append(sess.post(
+                            url + "/v1/completions",
+                            json={"model": "tiny", "prompt": "hello"},
+                            headers={"x-user-id": f"sess-{s}"},
+                        ))
+                    for resp in await asyncio.gather(*tasks):
+                        n += 1
+                        await resp.read()
+                violations = {}
+                for holder in audits:
+                    for k, v in holder.counts().items():
+                        violations[k] = violations.get(k, 0) + v
+                return {
+                    "requests": n,
+                    "violations": violations,
+                    "violation_rate": round(
+                        sum(violations.values()) / max(1, n), 4
+                    ),
+                }
+
+            session_routers = []
+            for i in range(N_REPLICAS):
+                runner, url = await serve(build_app(router_args(
+                    f"sess-{i}", engine_urls, "session"
+                )))
+                session_routers.append(url)
+            sticky_identical = await session_flood(session_routers)
+
+            # forced membership skew: one replica also lists a PHANTOM
+            # backend (a closed port — connect refused, breakers off), the
+            # ring-divergence scenario a stale discovery view produces.
+            # Sessions the skewed ring maps to the phantom fail over and
+            # arrive stamped owner=phantom → non_owner_delivery; sessions
+            # re-ringed after the phantom's removal flip owners →
+            # owner_changed.
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            phantom = f"http://127.0.0.1:{s.getsockname()[1]}"
+            s.close()
+            skew_runner, skew_url = await serve(build_app(router_args(
+                "sess-skewed", engine_urls + [phantom], "session"
+            )))
+            sticky_skewed = await session_flood(
+                session_routers[:2] + [skew_url]
+            )
+            # deterministic ring-divergence snapshot: after a failover the
+            # skewed ring re-syncs to the shrunken candidate list (phantom
+            # evicted), momentarily matching the healthy replicas' hash.
+            # Route one session that maps to a LIVE engine last — its
+            # first pick re-syncs the ring to the full 5-node membership
+            # and no failover follows, so the report carries the skew.
+            from vllm_production_stack_tpu.router.hashring import HashRing
+
+            probe_ring = HashRing()
+            for u in engine_urls + [phantom]:
+                probe_ring.add_node(u)
+            live_sid = next(
+                f"probe-{i}" for i in range(1000)
+                if probe_ring.get_node(f"probe-{i}") != phantom
+            )
+            r = await sess.post(
+                skew_url + "/v1/completions",
+                json={"model": "tiny", "prompt": "x"},
+                headers={"x-user-id": live_sid},
+            )
+            await r.read()
+            await skew_runner.app["state"].fleet_reporter.report_once()
+            # the unskewed replicas report on their own 0.25s interval
+            await asyncio.sleep(0.4)
+            async with sess.get(controller_url + "/fleet") as r:
+                ring_divergent = (await r.json())["ring_divergent"]
+
+            # ---------------- 3. fleet tenant accounting ----------------
+            async def tenant_flood(router_urls: list[str],
+                                   window_s: float = 6.0,
+                                   offered_rps: float = 40.0) -> dict:
+                t_end = time.monotonic() + window_s
+                admitted = throttled = 0
+                interval = 1.0 / offered_rps
+
+                async def client(url: str):
+                    nonlocal admitted, throttled
+                    while time.monotonic() < t_end:
+                        t0 = time.monotonic()
+                        async with sess.post(
+                            url + "/v1/completions",
+                            json={"model": "tiny", "prompt": "hi"},
+                            headers={"Authorization": "Bearer k-acme"},
+                        ) as r:
+                            await r.read()
+                            if r.status == 200:
+                                admitted += 1
+                            elif r.status == 429:
+                                throttled += 1
+                        dt = interval - (time.monotonic() - t0)
+                        if dt > 0:
+                            await asyncio.sleep(dt)
+
+                # 2 clients per router × offered_rps pacing each ≈ well
+                # over the 20 req/s budget per replica
+                await asyncio.gather(*[
+                    client(u) for u in router_urls for _ in range(2)
+                ])
+                return {
+                    "admitted": admitted, "throttled": throttled,
+                    "admitted_rps": round(admitted / window_s, 2),
+                }
+
+            qos_routers = []
+            qos_states = []
+            for i in range(N_REPLICAS):
+                runner, url = await serve(build_app(router_args(
+                    f"qos-{i}", engine_urls, "session", with_qos=True
+                )))
+                qos_routers.append(url)
+                qos_states.append(runner.app["state"])
+            fleet_flood = await tenant_flood(qos_routers)
+            # force a final report round so the controller sees the full
+            # flood window before we read the rollup
+            for st in qos_states:
+                await st.fleet_reporter.report_once()
+            async with sess.get(controller_url + "/fleet") as r:
+                rollup = (await r.json())["tenants"].get("acme", {})
+
+            baseline_runner, baseline_url = await serve(build_app(
+                router_args("qos-solo", engine_urls, "session",
+                            with_qos=True)
+            ))
+            controller.fleet._replicas.clear()  # fresh rollup window
+            baseline_flood = await tenant_flood([baseline_url])
+            await baseline_runner.app["state"].fleet_reporter.report_once()
+            async with sess.get(controller_url + "/fleet") as r:
+                baseline_rollup = (await r.json())["tenants"].get("acme", {})
+
+        return {
+            "replicas": N_REPLICAS,
+            "engines": N_ENGINES,
+            "convergence": {
+                "storm_events": events_pumped,
+                "per_replica_lag": convergence,
+                "restart_divergence_blocks": {
+                    "cold": div_before.get("replica-cold"),
+                    "healed": div_after.get("replica-cold"),
+                },
+            },
+            "stickiness": {
+                "identical_membership": sticky_identical,
+                "skewed_membership": sticky_skewed,
+                "detection_proven": (
+                    sticky_identical["violation_rate"] == 0.0
+                    and sum(sticky_skewed["violations"].values()) > 0
+                ),
+                "ring_divergent_flagged": bool(ring_divergent),
+            },
+            "tenant_accounting": {
+                "budget_rps": 20.0,
+                "fleet_3_replicas": {
+                    **fleet_flood,
+                    "limit_utilization": rollup.get("limit_utilization"),
+                    "overadmission_ratio": rollup.get("overadmission_ratio"),
+                },
+                "single_router_baseline": {
+                    **baseline_flood,
+                    "limit_utilization":
+                        baseline_rollup.get("limit_utilization"),
+                    "overadmission_ratio":
+                        baseline_rollup.get("overadmission_ratio"),
+                },
+            },
+        }
+    finally:
+        import os as _os
+
+        for runner in reversed(runners):
+            await runner.cleanup()
+        _os.unlink(tenant_file.name)
+
+
+def _phase_fleet_main() -> None:
+    """Subprocess entry for the CPU-only fleet-coherence bench. Forces CPU
+    before anything touches jax — runs pre-preflight, so the multi-replica
+    baselines survive a wedged TPU tunnel."""
+    import asyncio
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = asyncio.run(_fleet_bench())
+    print(json.dumps({"fleet": result}), flush=True)
+
+
 def _phase_hydration_main() -> None:
     """Subprocess entry for the CPU-only hydration-planner bench. Forces
     CPU before anything touches jax — runs pre-preflight, so the
@@ -1646,6 +2079,8 @@ def main() -> None:
             _phase_kvflow_main()
         elif phase == "hydration":
             _phase_hydration_main()
+        elif phase == "fleet":
+            _phase_fleet_main()
         else:
             assert phase == "micro", phase
             _phase_micro_main()
@@ -1707,6 +2142,15 @@ def main() -> None:
         timeout_s=540, key="hydration", min_needed_s=120.0,
     )
 
+    # -0.0078125) fleet-coherence telemetry (docs/32-fleet-telemetry.md):
+    # the ROADMAP-1 baselines — convergence lag across 3 router replicas
+    # after a 10k-event storm, stickiness-violation detection, fleet
+    # tenant over-admission vs 1 router — CPU-only, pre-preflight
+    fleet = _run_phase(
+        "fleet", ["bench.py", "--phase", "fleet"],
+        timeout_s=300, key="fleet", min_needed_s=60.0,
+    )
+
     # 0) chip preflight: one trivial dispatch. A wedged tunnel fails HERE
     # in minutes with an explicit section; the heavy phases are then
     # reported skipped instead of serially eating their timeouts
@@ -1732,6 +2176,7 @@ def main() -> None:
             "saturation": saturation,
             "kvflow": kvflow,
             "hydration": hydration,
+            "fleet": fleet,
             "total_elapsed_s": round(time.monotonic() - _t_start, 1),
         }), flush=True)
         return
@@ -1805,6 +2250,7 @@ def main() -> None:
         "saturation": saturation,
         "kvflow": kvflow,
         "hydration": hydration,
+        "fleet": fleet,
         "total_elapsed_s": round(time.monotonic() - _t_start, 1),
     }), flush=True)
 

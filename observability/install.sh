@@ -34,4 +34,18 @@ metadata:
 spec:
 $(sed 's/^/  /' "$(dirname "$0")/rules/tpu-slo-rules.yaml" | grep -v '^  #')
 EOF
+
+# Fleet-coherence rule pack (docs/32-fleet-telemetry.md): convergence-lag
+# percentiles, stickiness-violation rates, tenant over-admission, and the
+# router ring-divergence alert
+kubectl -n "$NS" apply -f - <<EOF
+apiVersion: monitoring.coreos.com/v1
+kind: PrometheusRule
+metadata:
+  name: tpu-fleet-rules
+  labels:
+    release: kube-prom-stack
+spec:
+$(sed 's/^/  /' "$(dirname "$0")/rules/tpu-fleet-rules.yaml" | grep -v '^  #')
+EOF
 echo "observability stack installed in namespace $NS"

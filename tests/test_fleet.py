@@ -1,0 +1,801 @@
+"""Fleet-coherence telemetry tests (docs/32-fleet-telemetry.md): the
+measurement layer ROADMAP 1's multi-replica router refactor builds against.
+
+All host-side: real ClusterKVIndexes and real aiohttp servers where the
+wire matters. The guarantees under test:
+
+- publish→apply convergence lag is measured per subscriber from the
+  publisher's own emit timestamps (in-buffer dwell included), and a cold
+  embedded replica's divergence on GET /fleet rises to the full
+  authoritative slice then heals to zero after a snapshot resync;
+- the engine-side stickiness audit counts exactly the two affinity-break
+  shapes (owner_changed / non_owner_delivery) and nothing else — one
+  replica with a stable ring produces structural zero;
+- the controller's FleetView aggregates per-tenant spend fleet-wide and
+  measures the N-way bucket-split over-admission against the configured
+  budget;
+- the router stamps replica identity + ring owner + ring hash upstream,
+  re-exports the fleet signals on /metrics, and serves /debug/fleet;
+- docs index (mkdocs nav + docs/README.md) stays mechanically complete.
+"""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vllm_production_stack_tpu import metrics_contract as mc
+from vllm_production_stack_tpu.engine.kv_cache import KVBlockPool
+from vllm_production_stack_tpu.engine.kv_controller import KVController
+from vllm_production_stack_tpu.engine.kv_events import (
+    KVEventLog,
+    KVEventPublisher,
+)
+from vllm_production_stack_tpu.fleet import (
+    RING_HASH_HEADER,
+    REPLICA_HEADER,
+    STICKY_OWNER_HEADER,
+    STICKY_SESSION_HEADER,
+    ConvergenceMeter,
+    FleetView,
+    SessionStickinessAudit,
+    index_divergence_blocks,
+    membership_hash,
+)
+from vllm_production_stack_tpu.kv_index import ClusterKVIndex
+
+pytestmark = pytest.mark.fleet
+
+BLOCK = 4
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def admit(pool: KVBlockPool, ids: list[int]) -> None:
+    parent = pool.root_hash()
+    for i in range(len(ids) // pool.block_size):
+        blk = pool.allocate()
+        assert blk is not None
+        parent = pool.register_full_block(
+            blk, parent,
+            tuple(ids[i * pool.block_size:(i + 1) * pool.block_size]),
+        )
+
+
+# -- fleet.py primitives -----------------------------------------------------
+
+
+def test_membership_hash_order_invariant_and_membership_sensitive():
+    a = membership_hash(["http://e1", "http://e0"])
+    assert a == membership_hash(["http://e0", "http://e1"])
+    assert a != membership_hash(["http://e0"])
+    assert a != membership_hash(["http://e0", "http://e1", "http://e2"])
+    # ring-level accessor agrees with the raw helper
+    from vllm_production_stack_tpu.router.hashring import HashRing
+
+    ring = HashRing()
+    ring.add_node("http://e0")
+    ring.add_node("http://e1")
+    assert ring.membership_hash() == a
+    # the cached digest invalidates on membership changes
+    ring.remove_node("http://e1")
+    assert ring.membership_hash() == membership_hash(["http://e0"])
+    ring.add_node("http://e1")
+    assert ring.membership_hash() == a
+
+
+def test_convergence_meter_stats_render_and_single_drain():
+    m = ConvergenceMeter()
+    for lag in (0.001, 0.02, 0.3, 4.0):
+        m.observe(lag)
+    m.observe(-0.5)  # NTP skew clamps to zero, never negative
+    st = m.stats()
+    assert st["count"] == 5
+    assert st["p50_s"] is not None and st["p95_s"] >= st["p50_s"]
+    lines = m.render("tpu:cluster_kv_convergence_lag_seconds")
+    assert lines[0].startswith("# TYPE")
+    assert any("_count 5" in ln for ln in lines)
+    drained = m.drain()
+    assert len(drained) == 5 and min(drained) == 0.0
+    assert m.drain() == []  # each observation lands in exactly one consumer
+    assert m.stats()["count"] == 5  # cumulative view survives the drain
+    # overflow-bucket percentiles clamp to the last finite bound — a
+    # float('inf') would serialize as invalid JSON on /fleet
+    import json
+
+    for _ in range(20):
+        m.observe(120.0)
+    st = m.stats()
+    assert st["p95_s"] == ConvergenceMeter.BUCKETS[-1]
+    json.dumps(st, allow_nan=False)  # strictly serializable (no Infinity)
+
+
+def test_stickiness_audit_owner_changed_and_non_owner_delivery():
+    audit = SessionStickinessAudit(self_url="http://e0")
+    # clean sticky delivery: chosen owner is this engine, twice
+    assert audit.observe("s1", owner="http://e0", replica="r1") == []
+    assert audit.observe("s1", owner="http://e0", replica="r2") == []
+    # another replica chose a DIFFERENT owner yet it landed here
+    reasons = audit.observe("s1", owner="http://e1", replica="r3")
+    assert set(reasons) == {"owner_changed", "non_owner_delivery"}
+    # failover delivery: first sight of the session, wrong engine only
+    assert audit.observe("s2", owner="http://e9") == ["non_owner_delivery"]
+    counts = audit.counts()
+    assert counts["owner_changed"] == 1
+    assert counts["non_owner_delivery"] == 2
+    snap = audit.snapshot()
+    assert snap["observed"] == 4 and snap["sessions_tracked"] == 2
+
+
+def test_stickiness_audit_scheme_mismatch_never_arms_non_owner():
+    """Discovery may address engines by service DNS / VIP while the engine
+    advertises POD_IP:PORT — comparing those would count a violation on
+    every perfectly-sticky request. non_owner_delivery stays DISARMED
+    until an owner stamp has matched self_url at least once."""
+    audit = SessionStickinessAudit(self_url="http://10.2.3.4:8000")
+    # all traffic stamped with the service-DNS identity: never a violation
+    for i in range(5):
+        assert audit.observe(
+            f"s{i}", owner="http://svc.ns.svc:8000"
+        ) == []
+    assert audit.counts()["non_owner_delivery"] == 0
+    assert audit.snapshot()["self_url_confirmed"] is False
+    # owner_changed still works without the identity proof
+    assert audit.observe("s0", owner="http://other.ns.svc:8000") == [
+        "owner_changed"
+    ]
+    # one pod-IP-scheme delivery proves the schemes agree → armed
+    assert audit.observe("s9", owner="http://10.2.3.4:8000") == []
+    assert audit.snapshot()["self_url_confirmed"] is True
+    assert audit.observe("s8", owner="http://svc.ns.svc:8000") == [
+        "non_owner_delivery"
+    ]
+
+
+def test_stickiness_audit_unknown_self_url_and_header_wrapper():
+    audit = SessionStickinessAudit()  # self_url unknown: owner_changed only
+    assert audit.observe("s", owner="http://e1") == []
+    assert audit.observe("s", owner="http://e2") == ["owner_changed"]
+    # the header wrapper: no sticky stamp = not session traffic
+    assert audit.observe_headers({}) == []
+    reasons = audit.observe_headers({
+        STICKY_SESSION_HEADER: "s",
+        STICKY_OWNER_HEADER: "http://e3",
+        REPLICA_HEADER: "r9",
+        RING_HASH_HEADER: "abc",
+    })
+    assert reasons == ["owner_changed"]
+    assert "abc" in audit.snapshot()["ring_hashes_seen"]
+
+
+def test_stickiness_audit_session_map_is_bounded():
+    audit = SessionStickinessAudit(max_sessions=4)
+    for i in range(10):
+        audit.observe(f"s{i}", owner="http://e0")
+    assert audit.snapshot()["sessions_tracked"] == 4
+    # evicted oldest: s0 re-observed with a new owner has no history
+    assert audit.observe("s0", owner="http://e1") == []
+
+
+def test_index_divergence_blocks_math():
+    auth = {
+        "http://e0": {"epoch": "a", "seq": 100, "hashes": 80},
+        "http://e1": {"epoch": "b", "seq": 50, "hashes": 40},
+        "http://e2": {"epoch": "c", "seq": 10, "hashes": 7},
+    }
+    # identical → 0
+    assert index_divergence_blocks(auth, auth) == 0
+    replica = {
+        "http://e0": {"epoch": "a", "seq": 90, "hashes": 75},   # 10 behind
+        "http://e1": {"epoch": "STALE", "seq": 50, "hashes": 40},  # epoch
+        # e2 missing entirely → full slice
+    }
+    assert index_divergence_blocks(auth, replica) == 10 + 40 + 7
+    # replica-only engines are ignored (controller is the authority)
+    assert index_divergence_blocks(
+        {}, {"http://x": {"epoch": "z", "seq": 5, "hashes": 3}}
+    ) == 0
+
+
+def test_fleet_view_tenant_rollup_measures_overadmission():
+    from vllm_production_stack_tpu.qos import TenantTable
+
+    table = TenantTable.from_dict({"acme": {"requests_per_s": 10.0}})
+    view = FleetView(tenant_table=table, rate_window_s=30.0)
+    # 3 replicas each report the FULL budget's worth of admissions over
+    # ~1s — the N-way bucket split measuring ≈ N× the global limit
+    for rid in ("r0", "r1", "r2"):
+        view.apply_report({"replica": rid, "tenants": {
+            "acme": {"requests": 0, "prompt_tokens": 0, "throttled": 0},
+        }})
+    time.sleep(0.6)
+    reply = None
+    for rid in ("r0", "r1", "r2"):
+        reply = view.apply_report({"replica": rid, "tenants": {
+            "acme": {"requests": 6, "prompt_tokens": 60, "throttled": 2},
+        }})
+    rollup = reply["tenants"]["acme"]
+    # each replica admitted ~10 req/s (6 in 0.6s) → fleet ~30 req/s over a
+    # 10 req/s budget → utilization ~3, over-admission ~2 (wide tolerance:
+    # wall-clock sleep)
+    assert 2.0 < rollup["limit_utilization"] < 4.5
+    assert rollup["overadmission_ratio"] == pytest.approx(
+        rollup["limit_utilization"] - 1.0, abs=1e-6
+    )
+    assert rollup["requests"] == 18  # fleet-wide absolute totals
+    assert rollup["throttled"] == 6
+    # an unknown replica id is rejected, not silently aggregated
+    assert view.apply_report({"replica": ""})["status"] == "error"
+
+
+def test_fleet_view_divergence_and_ring_flag():
+    auth = {"http://e0": {"epoch": "a", "seq": 100, "hashes": 80}}
+    view = FleetView()
+    # cold embedded replica: index key present but empty → full slice
+    reply = view.apply_report(
+        {"replica": "r0", "ring_hash": "h1", "index": {}},
+        authoritative_positions=auth,
+    )
+    assert reply["divergence_blocks"] == 80
+    assert reply["ring_divergent"] is False
+    # controller-mode replica (no index key): divergence is None
+    reply = view.apply_report(
+        {"replica": "r1", "ring_hash": "h2"},
+        authoritative_positions=auth,
+    )
+    assert reply["divergence_blocks"] is None
+    assert reply["ring_divergent"] is True  # h1 vs h2
+    # caught-up replica heals to zero
+    reply = view.apply_report(
+        {"replica": "r0", "ring_hash": "h1", "index": auth},
+        authoritative_positions=auth,
+    )
+    assert reply["divergence_blocks"] == 0
+    snap = view.snapshot(authoritative_positions=auth)
+    assert snap["ring_divergent"] is True
+    by_id = {r["replica"]: r for r in snap["replicas"]}
+    assert by_id["r0"]["divergence_blocks"] == 0
+
+
+def test_fleet_view_expires_silent_replicas_on_read_paths():
+    """A scaled-down router fleet must drop out of the exported gauges on
+    the next READ, not freeze at its last busy values — tenant_rollup and
+    divergence_by_replica expire, not just report ingestion."""
+    view = FleetView(expire_after_s=0.05)
+    view.apply_report(
+        {"replica": "r0", "index": {},
+         "tenants": {"acme": {"requests": 9}}},
+        authoritative_positions={"e": {"epoch": "a", "seq": 1, "hashes": 4}},
+    )
+    assert view.divergence_by_replica() == {"r0": 4}
+    assert "acme" in view.tenant_rollup()
+    time.sleep(0.08)
+    assert view.divergence_by_replica() == {}
+    assert view.tenant_rollup() == {}
+
+
+def test_router_metrics_fleet_reply_freshness_gate():
+    """A controller outage must not leave the last /fleet/report reply
+    exporting as current: stale replies clear the fleet gauges."""
+    from vllm_production_stack_tpu.router.metrics import RouterMetrics
+
+    class _Reporter:
+        replica_id = "r-test"
+        interval_s = 1.0
+        last_report_t = time.monotonic()
+        last_reply = {
+            "divergence_blocks": 7,
+            "tenants": {"acme": {"limit_utilization": 2.0,
+                                 "overadmission_ratio": 1.0}},
+        }
+
+    class _State:
+        policy = object()
+        fleet_reporter = _Reporter()
+
+    from prometheus_client import generate_latest
+
+    m = RouterMetrics()
+    m._render_fleet(_State())
+    text = generate_latest(m.registry).decode()
+    assert (
+        f'{mc.CLUSTER_KV_INDEX_DIVERGENCE}{{replica="r-test"}} 7.0' in text
+    )
+    assert f'{mc.FLEET_TENANT_UTILIZATION}{{tenant="acme"}} 2.0' in text
+    # the controller goes away: the reply ages past the gate → cleared
+    _Reporter.last_report_t = time.monotonic() - 120.0
+    m._render_fleet(_State())
+    text = generate_latest(m.registry).decode()
+    assert 'replica="r-test"' not in text
+    assert 'tenant="acme"' not in text
+
+
+def test_qos_gate_totals_compose_with_metric_drain():
+    from vllm_production_stack_tpu.qos import TenantTable
+    from vllm_production_stack_tpu.qos.gate import QoSGate
+
+    gate = QoSGate(TenantTable.from_dict({"acme": {}}))
+    policy = gate.table.get("acme")
+    assert gate.try_admit(policy, {"prompt": [1, 2, 3]}) is None
+    gate.release(policy)
+    assert gate.drain_counter_deltas()  # metrics consumer takes its deltas
+    totals = gate.totals()
+    assert totals["acme"]["requests"] == 1  # totals survive the drain
+    assert gate.try_admit(policy, {"prompt": [1]}) is None
+    gate.release(policy)
+    assert gate.totals()["acme"]["requests"] == 2  # and keep accumulating
+
+
+# -- event log / publisher / index instrumentation ---------------------------
+
+
+def test_event_log_timed_drain_and_pending_depth():
+    log = KVEventLog()
+    assert log.pending_depth() == 0
+    t0 = time.time()
+    log.emit_admit(1, 0)
+    log.emit_admit(2, 1)
+    assert log.pending_depth() == 2
+    seq_start, events, oldest_ts = log.drain_timed()
+    assert seq_start == 1 and len(events) == 2
+    assert t0 - 1.0 <= oldest_ts <= time.time()
+    assert log.pending_depth() == 0
+    # empty drain carries no timestamp
+    assert log.drain_timed() == (3, [], None)
+    # the untimed drain keeps its 2-tuple contract
+    log.emit_evict(1)
+    assert log.drain() == (3, [("e", "1")])
+
+
+def test_publisher_stamps_ts_and_counts_failures():
+    """The publisher's wire payloads carry the oldest event's emit time,
+    and failed publish rounds land in publish_failures (the engine-side
+    health counter) — through a real HTTP subscriber."""
+    import aiohttp
+    from aiohttp import web
+
+    async def go():
+        seen = []
+        fail = {"on": False}
+
+        async def kv_events(request):
+            if fail["on"]:
+                return web.Response(status=500)
+            body = await request.json()
+            seen.append(body)
+            return web.json_response({"status": "ok"})
+
+        app = web.Application()
+        app.router.add_post("/kv/events", kv_events)
+        server = TestServer(app)
+        await server.start_server()
+        url = f"http://127.0.0.1:{server.port}"
+        sess = aiohttp.ClientSession()
+        log = KVEventLog()
+
+        async def snapshot_fn():
+            return log.epoch, log.seq, [7, 9]
+
+        pub = KVEventPublisher(
+            url, "http://engine:8000", log, snapshot_fn, BLOCK,
+            lambda: sess,
+        )
+        try:
+            emit_t = time.time()
+            await pub.flush()          # first contact: snapshot
+            log.emit_admit(11, 7)
+            await pub.flush()          # event batch
+            assert [b.get("snapshot", False) for b in seen] == [True, False]
+            assert seen[0]["ts"] >= emit_t - 1.0
+            batch = seen[1]
+            assert emit_t - 1.0 <= batch["ts"] <= time.time()
+            assert batch["events"] == [["a", "b", "7"]]
+            # a failing subscriber increments the failure counter through
+            # the background loop's guard
+            fail["on"] = True
+            log.emit_admit(12, 11)
+            before = pub.publish_failures
+            pub.start()
+            await asyncio.sleep(0.05)
+            await pub.stop()
+            assert pub.publish_failures > before
+            assert pub.posts == 2  # only the successful rounds counted
+        finally:
+            await sess.close()
+            await server.close()
+
+    run(go())
+
+
+def test_index_apply_observes_convergence_lag_and_positions():
+    index = ClusterKVIndex()
+    pool = KVBlockPool(64, BLOCK)
+    epoch, seq, hashes = pool.snapshot_events()
+    index.apply({
+        "engine": "http://e0", "epoch": epoch, "block_size": BLOCK,
+        "snapshot": True, "seq": seq, "hashes": [f"{h:x}" for h in hashes],
+        "ts": time.time() - 0.2,
+    })
+    admit(pool, list(range(4 * BLOCK)))
+    seq_start, events, oldest_ts = pool.events.drain_timed()
+    index.apply({
+        "engine": "http://e0", "epoch": pool.events.epoch,
+        "block_size": BLOCK, "seq_start": seq_start, "events": events,
+        "ts": oldest_ts,
+    })
+    st = index.convergence.stats()
+    assert st["count"] == 2  # snapshot + batch, both observed
+    assert st["p50_s"] is not None
+    pos = index.positions()["http://e0"]
+    assert pos["seq"] == seq_start + len(events) - 1
+    assert pos["hashes"] == 4
+    assert pos["stale"] is False
+    # heartbeats (empty batches) refresh liveness but observe no lag
+    index.apply({
+        "engine": "http://e0", "epoch": pool.events.epoch,
+        "block_size": BLOCK, "seq_start": pos["seq"] + 1, "events": [],
+        "ts": time.time(),
+    })
+    assert index.convergence.stats()["count"] == 2
+
+
+# -- controller /fleet surface -----------------------------------------------
+
+
+def test_controller_fleet_report_and_view_over_wire():
+    from vllm_production_stack_tpu.qos import TenantTable
+
+    async def go():
+        controller = KVController(
+            ["http://e0"],
+            tenant_table=TenantTable.from_dict(
+                {"acme": {"requests_per_s": 5.0}}
+            ),
+        )
+        pool = KVBlockPool(64, BLOCK)
+        admit(pool, list(range(3 * BLOCK)))
+        epoch, seq, hashes = pool.snapshot_events()
+        controller.index.apply({
+            "engine": "http://e0", "epoch": epoch, "block_size": BLOCK,
+            "snapshot": True, "seq": seq,
+            "hashes": [f"{h:x}" for h in hashes],
+        })
+        client = TestClient(TestServer(controller.build_app()))
+        await client.start_server()
+        try:
+            # a cold embedded replica reports an empty index
+            r = await client.post("/fleet/report", json={
+                "replica": "router-a", "ring_hash": "h1", "index": {},
+                "tenants": {"acme": {"requests": 3}},
+            })
+            assert r.status == 200
+            reply = await r.json()
+            assert reply["divergence_blocks"] == 3  # the full slice
+            r = await client.get("/fleet")
+            fleet = await r.json()
+            assert fleet["controller"]["engines"]["http://e0"]["hashes"] == 3
+            by_id = {x["replica"]: x for x in fleet["replicas"]}
+            assert by_id["router-a"]["divergence_blocks"] == 3
+            assert fleet["tenants"]["acme"]["requests"] == 3
+            # malformed reports → 400, not a silent aggregate or a 500
+            r = await client.post("/fleet/report", json={"replica": ""})
+            assert r.status == 400
+            for bad in (
+                {"replica": "r", "tenants": ["x"]},      # list, not dict
+                {"replica": "r", "ts": "abc"},           # non-numeric ts
+                {"replica": "r",
+                 "tenants": {"acme": {"requests": None}}},  # null count
+            ):
+                r = await client.post("/fleet/report", json=bad)
+                assert r.status == 400, bad
+                assert (await r.json())["status"] == "error"
+            # /metrics renders the fleet names
+            text = await (await client.get("/metrics")).text()
+            assert mc.CLUSTER_KV_CONVERGENCE_LAG + "_count" in text
+            assert (
+                f'{mc.CLUSTER_KV_ENGINE_SEQ}{{engine="http://e0"}}' in text
+            )
+            assert (
+                f'{mc.CLUSTER_KV_INDEX_DIVERGENCE}{{replica="router-a"}} 3'
+                in text
+            )
+            assert mc.FLEET_TENANT_UTILIZATION in text
+        finally:
+            await client.close()
+
+    run(go())
+
+
+# -- router integration ------------------------------------------------------
+
+
+async def _fake_engine(audit: SessionStickinessAudit):
+    """A real HTTP engine double that feeds the REAL stickiness audit."""
+    from aiohttp import web
+
+    async def completions(request):
+        audit.observe_headers(request.headers)
+        return web.json_response({
+            "id": "c", "object": "text_completion",
+            "choices": [{"index": 0, "text": "ok", "finish_reason": "stop"}],
+        })
+
+    app = web.Application()
+    app.router.add_post("/v1/completions", completions)
+    server = TestServer(app)
+    await server.start_server()
+    return server, f"http://127.0.0.1:{server.port}"
+
+
+def _router_args(backends: list[str], replica: str = "r-test",
+                 extra: list[str] | None = None):
+    from vllm_production_stack_tpu.router.args import parse_args
+
+    return parse_args([
+        "--static-backends", ",".join(backends),
+        "--static-models", ";".join(["tiny"] * len(backends)),
+        "--routing-logic", "session", "--session-key", "x-user-id",
+        "--router-replica-id", replica,
+        *(extra or []),
+    ])
+
+
+def test_router_stamps_sticky_headers_and_serves_debug_fleet():
+    from vllm_production_stack_tpu.router.app import build_app
+
+    async def go():
+        audit = SessionStickinessAudit()
+        engine_server, engine_url = await _fake_engine(audit)
+        client = TestClient(TestServer(build_app(
+            _router_args([engine_url])
+        )))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "tiny", "prompt": "hello"},
+                headers={
+                    "x-user-id": "sess-1",
+                    # spoofed inbound stamps must be stripped
+                    STICKY_OWNER_HEADER: "http://attacker",
+                    REPLICA_HEADER: "fake-replica",
+                },
+            )
+            assert r.status == 200, await r.text()
+            snap = audit.snapshot()
+            assert snap["observed"] == 1
+            assert snap["violations"] == {
+                "owner_changed": 0, "non_owner_delivery": 0,
+            }
+            sess_state = audit._sessions["sess-1"]
+            assert sess_state[0] == engine_url  # ring owner, not attacker
+            assert sess_state[1] == "r-test"    # OUR replica id
+            # a session-less request carries the replica stamp only
+            r = await client.post(
+                "/v1/completions", json={"model": "tiny", "prompt": "x"},
+            )
+            assert r.status == 200
+            assert audit.snapshot()["observed"] == 1  # no sticky stamp
+            # /debug/fleet: this replica's coherence view
+            fleet = await (await client.get("/debug/fleet")).json()
+            assert fleet["replica"] == "r-test"
+            assert fleet["ring_nodes"] == [engine_url]
+            assert fleet["ring_hash"] == membership_hash([engine_url])
+            assert fleet["active_streams"] == 0
+            assert engine_url in fleet["endpoints"]
+            # /metrics: ring hash + stream/endpoint gauges render
+            text = await (await client.get("/metrics")).text()
+            assert (
+                f'{mc.ROUTER_RING_MEMBERSHIP_HASH}'
+                f'{{hash="{membership_hash([engine_url])}"}} 1.0' in text
+            )
+            assert f"{mc.ROUTER_ACTIVE_STREAMS} 0.0" in text
+            assert f"{mc.ROUTER_DISCOVERY_ENDPOINTS} 1.0" in text
+            assert mc.CLUSTER_KV_CONVERGENCE_LAG + "_count" in text
+        finally:
+            await client.close()
+            await engine_server.close()
+
+    run(go())
+
+
+def test_engine_exporter_renders_fleet_series():
+    from vllm_production_stack_tpu.engine.metrics import EngineMetrics
+
+    m = EngineMetrics("tiny")
+    m.update_fleet_health(
+        publish_batches=5, publish_failures=1, pending_depth=7,
+        stickiness={"owner_changed": 2, "non_owner_delivery": 0,
+                    "bogus_reason": 9},
+    )
+    from prometheus_client import generate_latest
+
+    text = generate_latest(m.registry).decode()
+    assert 'reason="owner_changed"} 2.0' in text
+    assert 'reason="non_owner_delivery"} 0.0' in text
+    assert "bogus_reason" not in text  # closed set: unknown reasons dropped
+    base = mc.KV_EVENT_PUBLISH_BATCHES[: -len("_total")]
+    assert f"{base}_total" in text
+    assert f"{mc.KV_EVENT_QUEUE_DEPTH}" in text
+    # delta-bump idempotence: same totals again adds nothing
+    m.update_fleet_health(publish_batches=5, publish_failures=1,
+                          pending_depth=3)
+    text = generate_latest(m.registry).decode()
+    assert f"{mc.KV_EVENT_QUEUE_DEPTH}" in text
+    assert f'{base}_total{{model_name="tiny"}} 5.0' in text
+
+
+# -- chaos: the two ROADMAP-1 failure modes, forced --------------------------
+
+
+@pytest.mark.chaos
+def test_replica_restart_divergence_rises_then_heals_on_fleet():
+    """Embedded-index cold start: a restarted replica's /fleet divergence
+    is the whole authoritative slice, then heals to 0 once the resync
+    snapshot + live events land — convergence lag visibly recorded."""
+    async def go():
+        controller = KVController(["http://e0"])
+        pool = KVBlockPool(256, BLOCK)
+        admit(pool, list(range(20 * BLOCK)))
+        epoch, seq, hashes = pool.snapshot_events()
+        snapshot_payload = {
+            "engine": "http://e0", "epoch": epoch, "block_size": BLOCK,
+            "snapshot": True, "seq": seq,
+            "hashes": [f"{h:x}" for h in hashes], "ts": time.time(),
+        }
+        controller.index.apply(snapshot_payload)
+        client = TestClient(TestServer(controller.build_app()))
+        await client.start_server()
+        try:
+            # replica "restarts": a FRESH embedded index reports cold
+            replica = ClusterKVIndex()
+            r = await client.post("/fleet/report", json={
+                "replica": "router-a", "index": replica.positions(),
+            })
+            cold = (await r.json())["divergence_blocks"]
+            assert cold == 20  # the full authoritative slice
+
+            # resync lands (with a publish timestamp → lag recorded)...
+            replica.apply(dict(snapshot_payload, ts=time.time() - 0.05))
+            # ...and live events continue past the snapshot
+            admit(pool, list(range(1000, 1000 + 4 * BLOCK)))
+            seq_start, events, oldest_ts = pool.events.drain_timed()
+            for index in (replica, controller.index):
+                reply = index.apply({
+                    "engine": "http://e0", "epoch": pool.events.epoch,
+                    "block_size": BLOCK, "seq_start": seq_start,
+                    "events": events, "ts": oldest_ts,
+                })
+                assert reply["status"] == "ok"
+            assert replica.convergence.stats()["count"] == 2
+            r = await client.post("/fleet/report", json={
+                "replica": "router-a", "index": replica.positions(),
+            })
+            healed = (await r.json())["divergence_blocks"]
+            assert healed == 0
+            fleet = await (await client.get("/fleet")).json()
+            by_id = {x["replica"]: x for x in fleet["replicas"]}
+            assert by_id["router-a"]["divergence_blocks"] == 0
+        finally:
+            await client.close()
+
+    run(go())
+
+
+@pytest.mark.chaos
+def test_forced_ring_skew_trips_divergence_and_stickiness_violation():
+    """Two real router replicas whose static backend lists differ (one
+    lists a phantom engine — the stale-discovery shape): the same session
+    routed through each lands on different engines, the engine-side audit
+    counts violations, and the controller's /fleet flags ring
+    divergence."""
+    from vllm_production_stack_tpu.router.app import build_app
+
+    async def go():
+        audits, servers, urls = [], [], []
+        for _ in range(2):
+            audit = SessionStickinessAudit()
+            server, url = await _fake_engine(audit)
+            audit.self_url = url
+            audits.append(audit)
+            servers.append(server)
+            urls.append(url)
+        controller = KVController([])
+        c_client = TestClient(TestServer(controller.build_app()))
+        await c_client.start_server()
+        c_url = f"http://127.0.0.1:{c_client.server.port}"
+
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        phantom = f"http://127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+
+        extra = ["--fleet-report-url", c_url,
+                 "--fleet-report-interval", "0.1",
+                 "--breaker-failure-threshold", "0"]
+        r_ok = TestClient(TestServer(build_app(
+            _router_args(urls, replica="router-ok", extra=extra)
+        )))
+        r_skew = TestClient(TestServer(build_app(
+            _router_args(urls + [phantom], replica="router-skewed",
+                         extra=extra)
+        )))
+        await r_ok.start_server()
+        await r_skew.start_server()
+        try:
+            # spray sessions through BOTH replicas; with the skewed ring
+            # some sessions map to the phantom and fail over (delivered
+            # off-owner), others flip owners between the two rings
+            for rnd in range(2):
+                for i in range(24):
+                    for client in (r_ok, r_skew):
+                        r = await client.post(
+                            "/v1/completions",
+                            json={"model": "tiny", "prompt": "x"},
+                            headers={"x-user-id": f"sess-{i}"},
+                        )
+                        await r.read()
+            total = sum(
+                sum(a.counts().values()) for a in audits
+            )
+            assert total > 0, [a.snapshot() for a in audits]
+            # deterministic ring state before the report: a failover
+            # re-sync momentarily shrinks the skewed ring to the live
+            # set — route one session that maps to a LIVE engine last so
+            # the ring re-syncs to the full (phantom-bearing) membership
+            from vllm_production_stack_tpu.router.hashring import HashRing
+
+            probe_ring = HashRing()
+            for u in [*urls, phantom]:
+                probe_ring.add_node(u)
+            live_sid = next(
+                f"probe-{i}" for i in range(1000)
+                if probe_ring.get_node(f"probe-{i}") != phantom
+            )
+            r = await r_skew.post(
+                "/v1/completions", json={"model": "tiny", "prompt": "x"},
+                headers={"x-user-id": live_sid},
+            )
+            await r.read()
+            # both replicas report their (differing) ring hashes
+            await r_ok.app["state"].fleet_reporter.report_once()
+            await r_skew.app["state"].fleet_reporter.report_once()
+            fleet = await (await c_client.get("/fleet")).json()
+            assert fleet["ring_divergent"] is True
+            hashes = {x["replica"]: x["ring_hash"]
+                      for x in fleet["replicas"]}
+            assert hashes["router-ok"] != hashes["router-skewed"]
+        finally:
+            await r_ok.close()
+            await r_skew.close()
+            await c_client.close()
+            for server in servers:
+                await server.close()
+
+    run(go())
+
+
+# -- satellite: docs index is mechanically complete --------------------------
+
+
+def test_docs_index_and_metrics_contract_clean():
+    """Every docs/NN-*.md must appear in BOTH the mkdocs nav and the
+    docs/README.md index (tools/check_docs_index.py — PR 2 caught this by
+    hand once; now it's mechanical)."""
+    import pathlib
+    import sys
+
+    tools = pathlib.Path(__file__).resolve().parent.parent / "tools"
+    sys.path.insert(0, str(tools))
+    try:
+        import check_docs_index
+
+        problems = check_docs_index.check()
+    finally:
+        sys.path.remove(str(tools))
+    assert problems == [], "\n".join(problems)

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Docs-index drift check (run in tier-1 via tests/test_fleet.py).
+
+Every numbered tutorial (`docs/NN-*.md`) must be reachable from BOTH
+navigation surfaces an operator actually uses:
+
+  (a) the mkdocs nav (`mkdocs.yml`) — the rendered-site sidebar, and
+  (b) the `docs/README.md` index — the GitHub-browsing entry point.
+
+PR 2 caught a missing `docs/README.md` entry for doc 25 by hand during
+review; this makes that check mechanical (every observability PR since
+has added a numbered doc, so the drift surface keeps growing).
+
+Also validates the reverse direction: every `NN-*.md` either nav surface
+references must exist on disk — a nav entry pointing at a deleted or
+renamed file 404s the rendered site.
+
+Exit code 0 = clean; 1 = drift, with one line per violation.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = os.path.join(REPO, "docs")
+MKDOCS = os.path.join(REPO, "mkdocs.yml")
+DOCS_INDEX = os.path.join(DOCS, "README.md")
+
+_NUMBERED_RE = re.compile(r"\b(\d{2}-[a-z0-9-]+\.md)\b")
+
+
+def numbered_docs() -> list[str]:
+    return sorted(
+        f for f in os.listdir(DOCS)
+        if _NUMBERED_RE.fullmatch(f)
+    )
+
+
+def referenced(path: str) -> set[str]:
+    with open(path, encoding="utf-8") as f:
+        return set(_NUMBERED_RE.findall(f.read()))
+
+
+def check() -> list[str]:
+    problems: list[str] = []
+    on_disk = set(numbered_docs())
+    for surface, path in (("mkdocs.yml nav", MKDOCS),
+                          ("docs/README.md index", DOCS_INDEX)):
+        if not os.path.isfile(path):
+            problems.append(f"{surface}: file missing")
+            continue
+        refs = referenced(path)
+        for doc in sorted(on_disk - refs):
+            problems.append(f"{doc}: not referenced by the {surface}")
+        for doc in sorted(refs - on_disk):
+            problems.append(
+                f"{surface}: references {doc} which does not exist in docs/"
+            )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print(f"docs-index drift ({len(problems)} problems):")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(f"docs index clean ({len(numbered_docs())} numbered docs)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,7 @@ import aiohttp
 from aiohttp import web
 
 from .. import metrics_contract as mc
+from ..fleet import FleetView
 from ..kv_index import ClusterKVIndex
 from ..utils.http import LazyClientSession
 from ..utils.logging import init_logger
@@ -60,7 +61,8 @@ LOOKUP_MODES = ("indexed", "fanout")
 class KVController:
     def __init__(self, engine_urls: list[str] | None = None,
                  timeout_s: float = 2.0, mode: str = "indexed",
-                 tokenizer=None, base_models: list[str] | None = None):
+                 tokenizer=None, base_models: list[str] | None = None,
+                 tenant_table=None):
         if mode not in LOOKUP_MODES:
             raise ValueError(f"unknown KV lookup mode: {mode}")
         self.engines: set[str] = {u.rstrip("/") for u in engine_urls or []}
@@ -74,6 +76,14 @@ class KVController:
         # LoRA adapters (fan-out, since adapter chains are engine-salted)
         self.base_models = set(base_models or [])
         self.index = ClusterKVIndex()
+        # the controller renders its convergence meter cumulatively on
+        # /metrics and never drains it — don't buffer raw observations
+        self.index.convergence.buffer_pending = False
+        # fleet-coherence aggregate (docs/32-fleet-telemetry.md): router
+        # replicas POST /fleet/report; GET /fleet is the operator view.
+        # tenant_table (qos.TenantTable, optional) supplies the per-tenant
+        # budget fleet-wide utilization is measured against.
+        self.fleet = FleetView(tenant_table=tenant_table)
         self._http = LazyClientSession(
             timeout=aiohttp.ClientTimeout(total=timeout_s)
         )
@@ -165,6 +175,8 @@ class KVController:
         app.router.add_post("/kv/events", self._handle_events)
         app.router.add_post("/register", self._handle_register)
         app.router.add_post("/deregister", self._handle_deregister)
+        app.router.add_post("/fleet/report", self._handle_fleet_report)
+        app.router.add_get("/fleet", self._handle_fleet)
         app.router.add_get("/engines", self._handle_engines)
         app.router.add_get("/health", self._handle_health)
         app.router.add_get("/metrics", self._handle_metrics)
@@ -223,6 +235,39 @@ class KVController:
         self.index.remove_engine(url)
         return web.json_response({"status": "ok", "engines": sorted(self.engines)})
 
+    async def _handle_fleet_report(self, request: web.Request) -> web.Response:
+        """One router replica's periodic coherence report (router/fleet.py
+        FleetReporter): ring-membership hash, embedded-index positions,
+        breaker states, per-tenant drained totals. The reply carries the
+        fleet view back (this replica's index divergence vs the
+        controller's authoritative index, fleet tenant utilization, the
+        ring-divergence flag) so every replica re-exports the fleet
+        signals on its own /metrics."""
+        body = await request.json()
+        reply = self.fleet.apply_report(
+            body, authoritative_positions=self.index.positions()
+        )
+        status = 400 if reply.get("status") == "error" else 200
+        return web.json_response(reply, status=status)
+
+    async def _handle_fleet(self, request: web.Request) -> web.Response:
+        """Operator view of fleet coherence: per-replica index seq
+        positions + divergence estimates, ring-membership agreement, and
+        cluster-wide tenant accounting — next to the controller's own
+        authoritative index positions."""
+        # ONE positions snapshot for both consumers: two calls would take
+        # the index lock twice and could even disagree mid-request
+        positions = self.index.positions()
+        return web.json_response({
+            "controller": {
+                "engines": positions,
+                "stats": self.index.stats(),
+                "convergence": self.index.convergence.stats(),
+                "mode": self.mode,
+            },
+            **self.fleet.snapshot(authoritative_positions=positions),
+        })
+
     async def _handle_engines(self, request: web.Request) -> web.Response:
         return web.json_response({
             "engines": sorted(self.engines),
@@ -248,6 +293,37 @@ class KVController:
         for mode, n in sorted(self.lookup_counts.items()):
             lines.append(f'{mc.CLUSTER_KV_LOOKUPS}{{mode="{mode}"}} {n}')
         lines += self.index.lookups.render(mc.CLUSTER_KV_LOOKUP_LATENCY)
+        # fleet-coherence telemetry (docs/32-fleet-telemetry.md): the
+        # controller-vantage convergence lag, per-engine applied seq
+        # positions, per-replica index divergence, and the fleet-wide
+        # tenant accounting rollup
+        lines += self.index.convergence.render(mc.CLUSTER_KV_CONVERGENCE_LAG)
+        lines.append(f"# TYPE {mc.CLUSTER_KV_ENGINE_SEQ} gauge")
+        for url, pos in sorted(self.index.positions().items()):
+            lines.append(
+                f'{mc.CLUSTER_KV_ENGINE_SEQ}{{engine="{url}"}} {pos["seq"]}'
+            )
+        lines.append(f"# TYPE {mc.CLUSTER_KV_INDEX_DIVERGENCE} gauge")
+        for rid, d in sorted(self.fleet.divergence_by_replica().items()):
+            if d is not None:
+                lines.append(
+                    f'{mc.CLUSTER_KV_INDEX_DIVERGENCE}{{replica="{rid}"}} {d}'
+                )
+        rollup = self.fleet.tenant_rollup()
+        lines.append(f"# TYPE {mc.FLEET_TENANT_UTILIZATION} gauge")
+        for tenant, row in sorted(rollup.items()):
+            if "limit_utilization" in row:
+                lines.append(
+                    f'{mc.FLEET_TENANT_UTILIZATION}{{tenant="{tenant}"}} '
+                    f'{row["limit_utilization"]}'
+                )
+        lines.append(f"# TYPE {mc.FLEET_TENANT_OVERADMISSION} gauge")
+        for tenant, row in sorted(rollup.items()):
+            if "overadmission_ratio" in row:
+                lines.append(
+                    f'{mc.FLEET_TENANT_OVERADMISSION}{{tenant="{tenant}"}} '
+                    f'{row["overadmission_ratio"]}'
+                )
         return web.Response(
             text="\n".join(lines) + "\n", content_type="text/plain"
         )
@@ -274,6 +350,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(any OTHER model name is assumed to be a LoRA "
                         "adapter, whose engine-salted chains only engine "
                         "probes can hash)")
+    p.add_argument("--tenant-table-file", default=None,
+                   help="tenant policy table (same YAML/JSON shape the "
+                        "router takes): supplies the per-tenant budgets "
+                        "the fleet-wide accounting measures router "
+                        "reports against (tpu:fleet_tenant_* on /metrics "
+                        "and GET /fleet). Unset = fleet reports are still "
+                        "aggregated, utilization gauges are absent")
     return p
 
 
@@ -282,9 +365,15 @@ def main(argv: list[str] | None = None) -> None:
     from ..utils.tokenizer import hashing_tokenizer
 
     urls = [u for u in args.engines.split(",") if u]
+    tenant_table = None
+    if args.tenant_table_file:
+        from ..qos import TenantTable
+
+        tenant_table = TenantTable.load(args.tenant_table_file)
     controller = KVController(
         urls, mode=args.mode, tokenizer=hashing_tokenizer(args.tokenizer),
         base_models=[m for m in args.base_models.split(",") if m],
+        tenant_table=tenant_table,
     )
     logger.info("KV controller on %s:%d over %d engines (mode=%s)",
                 args.host, args.port, len(urls), args.mode)

@@ -74,7 +74,11 @@ class KVEventLog:
         self.epoch = uuid.uuid4().hex
         self.capacity = capacity
         self._lock = threading.Lock()
-        self._buf: deque[tuple[int, tuple]] = deque()
+        # (seq, event, emit wall-time). The timestamp rides the wire so
+        # subscribers can measure publish→apply convergence lag
+        # (fleet.ConvergenceMeter) including in-buffer dwell, not just the
+        # POST hop.
+        self._buf: deque[tuple[int, tuple, float]] = deque()
         self._seq = 0  # seq of the most recently emitted event
 
     @property
@@ -82,10 +86,18 @@ class KVEventLog:
         with self._lock:
             return self._seq
 
+    def pending_depth(self) -> int:
+        """Events buffered awaiting flush — the publisher-vantage backlog
+        gauge (tpu:kv_event_pending_queue_depth). A depth pinned at
+        capacity means the publisher can't keep up (or is down) and the
+        subscriber is about to see an overflow gap."""
+        with self._lock:
+            return len(self._buf)
+
     def _emit(self, event: tuple) -> None:
         with self._lock:
             self._seq += 1
-            self._buf.append((self._seq, event))
+            self._buf.append((self._seq, event, time.time()))
             if len(self._buf) > self.capacity:
                 # drop oldest: the seq gap is detected by the subscriber
                 # (and by the publisher's own continuity check) -> resync
@@ -105,13 +117,21 @@ class KVEventLog:
         — events is [] when nothing is buffered. seq_start is the sequence
         number of the FIRST returned event; a caller tracking the last seq
         it shipped can detect overflow drops (seq_start jumped) and resync."""
+        seq_start, events, _ = self.drain_timed(max_events)
+        return seq_start, events
+
+    def drain_timed(self, max_events: int = MAX_EVENTS_PER_POST):
+        """drain() plus the emit wall-time of the OLDEST returned event
+        (None when the batch is empty) — the publish timestamp the wire
+        payload carries for convergence-lag measurement."""
         with self._lock:
             if not self._buf:
-                return self._seq + 1, []
+                return self._seq + 1, [], None
             n = min(max_events, len(self._buf))
             first_seq = self._buf[0][0]
+            oldest_ts = self._buf[0][2]
             events = [self._buf.popleft()[1] for _ in range(n)]
-            return first_seq, events
+            return first_seq, events, oldest_ts
 
     def snapshot_barrier(self) -> int:
         """Discard everything buffered and return the current seq — called
@@ -154,10 +174,14 @@ class KVEventPublisher:
         self._last_sent_seq = 0
         self._last_post_t = 0.0  # monotonic time of the last successful POST
         self._task: asyncio.Task | None = None
-        # counters for /debug + tests
+        # counters for /debug + tests + the publisher-health contract
+        # names (tpu:kv_event_publish_{batches,failures}_total — `posts`
+        # is the batches counter: every successful POST incl. heartbeats
+        # and snapshots)
         self.posts = 0
         self.events_sent = 0
         self.snapshots_sent = 0
+        self.publish_failures = 0
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._run())
@@ -181,6 +205,7 @@ class KVEventPublisher:
                 # flush() marks _need_snapshot itself when drained events
                 # were actually lost; a failed heartbeat or snapshot POST
                 # loses nothing, so don't force a full resync here
+                self.publish_failures += 1
                 logger.debug("kv event flush failed: %s", e)
             await asyncio.sleep(self.interval_s)
 
@@ -210,6 +235,7 @@ class KVEventPublisher:
                 "snapshot": True,
                 "seq": seq,
                 "hashes": [f"{h:x}" for h in hashes],
+                "ts": time.time(),
             })
             if data.get("resync") or data.get("status") == "error":
                 raise RuntimeError(
@@ -219,7 +245,7 @@ class KVEventPublisher:
             self._last_sent_seq = seq
             self._need_snapshot = False
         while True:
-            seq_start, events = self.log.drain()
+            seq_start, events, oldest_ts = self.log.drain_timed()
             if not events:
                 if (
                     time.monotonic() - self._last_post_t
@@ -233,6 +259,7 @@ class KVEventPublisher:
                         "block_size": self.block_size,
                         "seq_start": self._last_sent_seq + 1,
                         "events": [],
+                        "ts": time.time(),
                     })
                     if data.get("resync"):  # e.g. subscriber restarted
                         self._need_snapshot = True
@@ -249,6 +276,10 @@ class KVEventPublisher:
                     "block_size": self.block_size,
                     "seq_start": seq_start,
                     "events": events,
+                    # emit time of the OLDEST event in the batch: the
+                    # subscriber's publish→apply lag measurement covers
+                    # in-buffer dwell, not just the POST hop
+                    "ts": oldest_ts,
                 })
             except Exception:
                 # these events left the log buffer and never arrived — the

@@ -352,6 +352,40 @@ class EngineMetrics:
         self.disk_stores.labels(**self._labels)
         self.disk_loads.labels(**self._labels)
         self.registry.register(_KVFlowHistograms(self))
+        # -- fleet-coherence telemetry (docs/32-fleet-telemetry.md) --------
+        # session-stickiness audit (fleet.SessionStickinessAudit): closed
+        # reason set, seeded so both series exist from the first scrape
+        self.stickiness_violations = Counter(
+            mc.SESSION_STICKINESS_VIOLATIONS[: -len("_total")],
+            "Session-affinity violations detected engine-side (closed "
+            "reason set: " + ", ".join(mc.STICKINESS_REASON_VALUES)
+            + ") — zero with one router replica and stable membership",
+            [*names, "reason"],
+            registry=self.registry,
+        )
+        for reason in mc.STICKINESS_REASON_VALUES:
+            self.stickiness_violations.labels(**self._labels, reason=reason)
+        # KV event publisher health: the PUBLISHER vantage on a failing
+        # event path (a dying publisher used to be visible only as
+        # controller-side resync storms)
+        self.kv_event_batches = counter(
+            mc.KV_EVENT_PUBLISH_BATCHES,
+            "KV event batches POSTed to the index subscriber (incl. "
+            "heartbeats and snapshots)",
+        )
+        self.kv_event_failures = counter(
+            mc.KV_EVENT_PUBLISH_FAILURES,
+            "KV event publish rounds that failed (transport fault or "
+            "subscriber error)",
+        )
+        self.kv_event_queue_depth = gauge(
+            mc.KV_EVENT_QUEUE_DEPTH,
+            "KV events buffered awaiting flush (pinned at capacity = the "
+            "publisher cannot keep up and a resync gap is imminent)",
+        )
+        self.kv_event_batches.labels(**self._labels)
+        self.kv_event_failures.labels(**self._labels)
+        self.kv_event_queue_depth.labels(**self._labels).set(0)
         # -- multi-tenant QoS (docs/27-multitenancy.md): tenant-labeled
         # series; cardinality bounded by qos.TenantAccounting.MAX_TENANTS
         tlabels = [*names, "tenant"]
@@ -563,6 +597,27 @@ class EngineMetrics:
             )
         self._bump(self.disk_stores, "disk_store", s.disk_kv_stores)
         self._bump(self.disk_loads, "disk_load", s.disk_kv_loads)
+
+    def update_fleet_health(
+        self,
+        publish_batches: int = 0,
+        publish_failures: int = 0,
+        pending_depth: int = 0,
+        stickiness: dict[str, int] | None = None,
+    ) -> None:
+        """Fleet-coherence series owned by the HTTP server rather than the
+        engine snapshot (docs/32-fleet-telemetry.md): KV event publisher
+        health counters and the stickiness-audit violation counts, bumped
+        delta-style from their monotonic owners at scrape time."""
+        self._bump(self.kv_event_batches, "kvev_batches", publish_batches)
+        self._bump(self.kv_event_failures, "kvev_failures", publish_failures)
+        self.kv_event_queue_depth.labels(**self._labels).set(pending_depth)
+        for reason, total in (stickiness or {}).items():
+            if reason in mc.STICKINESS_REASON_VALUES:
+                self._bump_labeled(
+                    self.stickiness_violations, f"sticky:{reason}",
+                    int(total), {**self._labels, "reason": reason},
+                )
 
     def _bump(self, counter: Counter, key: str, total: int) -> None:
         self._bump_labeled(counter, key, total, self._labels)

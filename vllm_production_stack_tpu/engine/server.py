@@ -23,6 +23,7 @@ from aiohttp import web
 from pydantic import ValidationError
 
 from .. import __version__
+from ..fleet import SessionStickinessAudit
 from ..models.registry import resolve_model_config
 from ..qos import tenant_from_headers
 from ..utils.logging import init_logger
@@ -131,6 +132,26 @@ class EngineServer:
         # whose outputs a seed reproduces — our model fingerprint (weights
         # + seed + kv dtype) is exactly that identity
         self.system_fingerprint = "fp_" + engine.model_fingerprint[:12]
+        # session-stickiness audit (docs/32-fleet-telemetry.md): counts
+        # consistent-hash affinity breaks from the router-stamped
+        # x-session-sticky-* headers. self_url (the same POD_IP:ENGINE_PORT
+        # identity the KV event publisher advertises) arms the
+        # non_owner_delivery detection; without it owner_changed still works.
+        self.stickiness = SessionStickinessAudit(
+            self_url=self._advertised_url()
+        )
+
+    @staticmethod
+    def _advertised_url() -> str | None:
+        """This engine's cluster-visible base URL (http://POD_IP:ENGINE_PORT
+        — the identity used for KV controller registration), or None
+        outside a deployment that sets the downward-API env."""
+        import os
+
+        pod_ip = os.environ.get("POD_IP")
+        if not pod_ip:
+            return None
+        return f"http://{pod_ip}:{os.environ.get('ENGINE_PORT', '8000')}"
 
     @property
     def lora_adapters(self) -> dict[str, str]:
@@ -316,6 +337,11 @@ class EngineServer:
         shedding, claimed at submit time)."""
         deadline = deadline_from_headers(request.headers)
         tenant = tenant_from_headers(request.headers)
+        # stickiness audit (docs/32-fleet-telemetry.md): every inference
+        # request carrying a router session stamp is observed, refused or
+        # not — an affinity break on a request the engine then sheds is
+        # still an affinity break
+        self.stickiness.observe_headers(request.headers)
         try:
             self.async_engine.precheck_admission(deadline, tenant=tenant)
         except (EngineOverloadedError, DeadlineExceededError,
@@ -1286,6 +1312,26 @@ class EngineServer:
 
     async def metrics_endpoint(self, request: web.Request) -> web.Response:
         om = wants_openmetrics(request)
+        # fleet-coherence series owned by the server, not the engine
+        # snapshot: publisher health + stickiness-audit counts
+        pub = self.kv_event_publisher
+        try:
+            events_log = self.engine.scheduler.pool.events
+        except AttributeError:  # engine test doubles carry no pool
+            events_log = None
+        self.metrics.update_fleet_health(
+            publish_batches=pub.posts if pub is not None else 0,
+            publish_failures=pub.publish_failures if pub is not None else 0,
+            # depth is meaningful only with a publisher draining the log:
+            # a standalone engine (no KV_CONTROLLER_URL) fills the bounded
+            # buffer and parks at capacity — exporting that would be a
+            # permanent false "publisher can't keep up" alarm
+            pending_depth=(
+                events_log.pending_depth()
+                if pub is not None and events_log is not None else 0
+            ),
+            stickiness=self.stickiness.counts(),
+        )
         payload = self.metrics.render(
             await self.async_engine.stats_async(), openmetrics=om
         )

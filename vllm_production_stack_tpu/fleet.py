@@ -1,0 +1,530 @@
+"""Fleet-coherence telemetry: the shared pieces (docs/32-fleet-telemetry.md).
+
+ROADMAP 1 wants N router replicas to be *correct* — identical session
+affinity, converged embedded KV indexes, globally-enforced tenant limits.
+None of those three failure modes was measurable before this module
+existed, so the multi-replica refactor had no acceptance signal. This is
+the measurement layer, built the same way PR 6 (goodput → autoscaling
+signal) and PR 7 (tier bandwidth → hydration planner signal) were: the
+numbers first, on real wire traffic.
+
+Three replica-coherence signals, one component each:
+
+- `ConvergenceMeter` — publish→apply lag of KV events as seen by ONE
+  subscriber (controller or embedded replica index). Each publisher batch
+  carries the wall-clock emit time of its oldest event
+  (engine/kv_events.py); the subscriber observes `now - ts` on apply.
+  Cross-process wall clocks, so the number is honest only to NTP skew —
+  fine at the ≥10 ms granularity replica convergence plays out on.
+- `SessionStickinessAudit` — the engine-side detector for broken
+  consistent-hash affinity. Routers stamp their replica id and the
+  hashring-chosen owner on upstream requests
+  (`x-router-replica-id` / `x-session-sticky-*`); the engine counts a
+  violation when a session's consecutive requests carry different chosen
+  owners, or when a request lands on an engine that is not its stamped
+  owner (pre-byte failover moved it — affinity broke observably).
+  With one replica and stable ring membership both counts are zero: the
+  baseline the multi-replica refactor must preserve.
+- `FleetView` — the controller-side aggregate. Router replicas POST
+  periodic reports (router/fleet.FleetReporter): ring-membership hash,
+  embedded-index positions, breaker states, per-tenant drained counters.
+  The controller computes per-replica index divergence against its own
+  authoritative index, flags ring-membership divergence, and rolls
+  per-tenant spend up fleet-wide against the configured budget — the
+  over-admission gauge is the "N split buckets overshoot the global
+  limit N×" problem as a number.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+
+import xxhash
+
+from .metrics_contract import STICKINESS_REASON_VALUES
+from .utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+# upstream stamp headers (router/request_service.py writes, engine/server.py
+# reads). With no session policy the router is transparent to inbound
+# copies, mirroring the tenant-stamp convention.
+REPLICA_HEADER = "x-router-replica-id"
+STICKY_SESSION_HEADER = "x-session-sticky-id"
+STICKY_OWNER_HEADER = "x-session-sticky-owner"
+RING_HASH_HEADER = "x-router-ring-hash"
+
+# closed reason set for tpu:session_stickiness_violations_total — the
+# single definition lives in the metrics contract (no imports there, so
+# no cycle); aliased here for the audit's own bookkeeping
+STICKINESS_REASONS = STICKINESS_REASON_VALUES
+
+
+def membership_hash(nodes) -> str:
+    """Stable 64-bit hex digest of a ring membership set. Two replicas
+    whose session rings hold the same nodes — regardless of insertion
+    order — report the same hash; any difference in membership shows up as
+    a different value, which is exactly what the Prometheus
+    `count(count by (hash)(tpu:router_ring_membership_hash)) > 1`
+    divergence alert keys off."""
+    return f"{xxhash.xxh64_intdigest(chr(10).join(sorted(nodes))):016x}"
+
+
+class ConvergenceMeter:
+    """Publish→apply lag histogram for ONE index subscriber.
+
+    Fixed buckets, plain ints under a small lock (apply() runs on executor
+    threads while /metrics scrapes from the loop). Two consumers, two
+    shapes: `render()` emits cumulative Prometheus text for hand-rolled
+    exporters (the KV controller), `drain()` hands raw observations to
+    exactly one prometheus_client Histogram (the router's registry) so
+    each lands in a real histogram exactly once."""
+
+    BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+               1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+    MAX_PENDING = 10_000  # scrape stopped; stay bounded
+
+    def __init__(self, buffer_pending: bool = True) -> None:
+        # buffer_pending=False for render-only hosts (the KV controller):
+        # nothing ever drains there, so buffering raw observations would
+        # just pin MAX_PENDING floats for the process lifetime
+        self.buffer_pending = buffer_pending
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.BUCKETS) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._pending: list[float] = []
+
+    def observe(self, seconds: float) -> None:
+        seconds = max(0.0, seconds)  # NTP skew must not go negative
+        with self._lock:
+            for i, ub in enumerate(self.BUCKETS):
+                if seconds <= ub:
+                    self._counts[i] += 1
+                    break
+            else:
+                self._counts[-1] += 1
+            self._sum += seconds
+            self._count += 1
+            if self.buffer_pending and len(self._pending) < self.MAX_PENDING:
+                self._pending.append(seconds)
+
+    def drain(self) -> list[float]:
+        """Observations since the last drain (for ONE prometheus
+        Histogram consumer)."""
+        with self._lock:
+            out, self._pending = self._pending, []
+        return out
+
+    def stats(self) -> dict:
+        """count / sum / p50 / p95 estimated from the bucket upper bounds
+        (the honest resolution a fixed-bucket histogram has)."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            lag_sum = self._sum
+
+        def pct(p: float) -> float | None:
+            if total == 0:
+                return None
+            rank = p * total
+            acc = 0
+            for ub, c in zip(self.BUCKETS, counts):
+                acc += c
+                if acc >= rank:
+                    return ub
+            # overflow bucket: report the last finite bound (a lower
+            # bound on the true percentile) — float('inf') would make
+            # /fleet and /debug/fleet emit the invalid-JSON `Infinity`
+            return float(self.BUCKETS[-1])
+
+        return {
+            "count": total,
+            "sum_s": round(lag_sum, 6),
+            "mean_s": round(lag_sum / total, 6) if total else None,
+            "p50_s": pct(0.50),
+            "p95_s": pct(0.95),
+        }
+
+    def render(self, name: str) -> list[str]:
+        """Prometheus text-exposition lines (cumulative histogram)."""
+        lines = [f"# TYPE {name} histogram"]
+        with self._lock:
+            acc = 0
+            for ub, c in zip(self.BUCKETS, self._counts):
+                acc += c
+                lines.append(f'{name}_bucket{{le="{ub}"}} {acc}')
+            acc += self._counts[-1]
+            lines.append(f'{name}_bucket{{le="+Inf"}} {acc}')
+            lines.append(f"{name}_sum {self._sum:.6f}")
+            lines.append(f"{name}_count {acc}")
+        return lines
+
+
+class SessionStickinessAudit:
+    """Engine-side session-affinity violation detector.
+
+    Bounded LRU of session → (chosen owner, replica id, ring hash). Two
+    violation classes (the closed STICKINESS_REASONS set):
+
+    - ``owner_changed``: consecutive requests for one session reached this
+      engine stamped with DIFFERENT ring-chosen owners — two replicas (or
+      one replica across a membership change) disagreed about where the
+      session lives.
+    - ``non_owner_delivery``: the request landed here but its stamp names
+      another engine as the ring-chosen owner — the routing layer moved a
+      sticky session off its affinity target (pre-byte failover away from
+      a dead/refusing owner is the common cause, and is exactly how a
+      ring-membership mismatch between replicas becomes client-visible:
+      the replica with the stale ring keeps choosing the gone engine).
+
+    One replica with a stable ring produces zero of both by construction —
+    the baseline number ROADMAP 1's refactor must preserve at N>1.
+
+    Identity-scheme guard: non_owner_delivery only starts counting after
+    this engine has seen its OWN advertised URL as an owner stamp at
+    least once. Discovery may publish a different identity scheme than
+    POD_IP:ENGINE_PORT (service-DNS names, a Service VIP) — comparing
+    those against self_url would count a violation on 100% of perfectly
+    sticky requests. An owner stamp that matches proves the schemes
+    agree; until then mismatches are recorded as a scheme hint, not
+    violations.
+    """
+
+    MAX_SESSIONS = 8192
+
+    def __init__(self, self_url: str | None = None,
+                 max_sessions: int = MAX_SESSIONS):
+        # the engine's own advertised URL (http://POD_IP:ENGINE_PORT, the
+        # same identity the KV event publisher uses). None = unknown →
+        # non_owner_delivery detection is off, owner_changed still works.
+        self.self_url = (self_url or "").rstrip("/") or None
+        self.max_sessions = max_sessions
+        self._lock = threading.Lock()
+        self._sessions: OrderedDict[str, tuple[str, str, str]] = OrderedDict()
+        self.violations: dict[str, int] = {r: 0 for r in STICKINESS_REASONS}
+        self.observed = 0
+        # identity-scheme proof: set the first time an owner stamp equals
+        # self_url (routers address this engine the way it addresses
+        # itself) — the gate that arms non_owner_delivery
+        self.self_url_confirmed = False
+        # ring hashes seen on stamps, for /debug introspection (replicas
+        # whose hashes differ route the same session differently)
+        self.ring_hashes_seen: dict[str, str] = {}  # hash -> last replica
+
+    def observe_headers(self, headers) -> list[str]:
+        """Convenience wrapper over the upstream stamp headers; a request
+        without a sticky stamp is not session traffic and counts nothing."""
+        session = headers.get(STICKY_SESSION_HEADER)
+        if not session:
+            return []
+        return self.observe(
+            session,
+            owner=headers.get(STICKY_OWNER_HEADER, ""),
+            replica=headers.get(REPLICA_HEADER, ""),
+            ring_hash=headers.get(RING_HASH_HEADER, ""),
+        )
+
+    def observe(self, session_id: str, owner: str = "", replica: str = "",
+                ring_hash: str = "") -> list[str]:
+        """Record one session request; returns the violation reasons it
+        tripped (empty for a clean sticky delivery)."""
+        owner = owner.rstrip("/")
+        reasons: list[str] = []
+        with self._lock:
+            self.observed += 1
+            if ring_hash:
+                self.ring_hashes_seen[ring_hash] = replica
+                if len(self.ring_hashes_seen) > 64:  # stay bounded
+                    self.ring_hashes_seen.pop(
+                        next(iter(self.ring_hashes_seen))
+                    )
+            if self.self_url and owner:
+                if owner == self.self_url:
+                    self.self_url_confirmed = True
+                elif self.self_url_confirmed:
+                    # armed only after the schemes provably agree — see
+                    # the class docstring's identity-scheme guard
+                    reasons.append("non_owner_delivery")
+            prev = self._sessions.get(session_id)
+            if (
+                prev is not None
+                and owner and prev[0]
+                and owner != prev[0]
+            ):
+                reasons.append("owner_changed")
+            self._sessions[session_id] = (owner, replica, ring_hash)
+            self._sessions.move_to_end(session_id)
+            while len(self._sessions) > self.max_sessions:
+                self._sessions.popitem(last=False)
+            for r in reasons:
+                self.violations[r] += 1
+        return reasons
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.violations)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "observed": self.observed,
+                "sessions_tracked": len(self._sessions),
+                "violations": dict(self.violations),
+                "ring_hashes_seen": dict(self.ring_hashes_seen),
+                "self_url": self.self_url,
+                "self_url_confirmed": self.self_url_confirmed,
+            }
+
+
+def index_divergence_blocks(authoritative: dict, replica: dict) -> int:
+    """Estimated blocks by which a replica's embedded index diverges from
+    the authoritative (controller) index, from per-engine positions
+    ({url: {"epoch", "seq", "hashes"}}).
+
+    Same epoch → events are block mutations, so |seq gap| ≈ blocks of
+    drift. Epoch mismatch or engine missing from the replica → the whole
+    authoritative slice is divergent (a full snapshot resync is pending).
+    Engines only the replica knows are ignored: the controller is the
+    authority being compared against."""
+    d = 0
+    for url, a in authoritative.items():
+        r = replica.get(url)
+        if r is None or (r.get("epoch") or "") != (a.get("epoch") or ""):
+            d += int(a.get("hashes", 0))
+        else:
+            d += abs(int(a.get("seq", 0)) - int(r.get("seq", 0)))
+    return d
+
+
+class _ReplicaState:
+    """One router replica's latest report + a short rate-window history."""
+
+    __slots__ = ("replica_id", "recv_t", "report_ts", "ring_hash",
+                 "breakers", "has_index", "positions", "tenants", "history",
+                 "divergence_blocks")
+
+    def __init__(self, replica_id: str):
+        self.replica_id = replica_id
+        self.recv_t = 0.0
+        self.report_ts = 0.0
+        self.ring_hash = ""
+        self.breakers: dict = {}
+        # True when the replica hosts an embedded index at all — an EMPTY
+        # positions dict from a cold embedded replica must still compute
+        # divergence (= the whole authoritative slice), while a
+        # controller-mode router (no index) must not
+        self.has_index = False
+        self.positions: dict = {}
+        self.tenants: dict[str, dict[str, float]] = {}
+        # (recv_t, {tenant: requests_total}) samples for rate computation
+        self.history: deque = deque(maxlen=64)
+        self.divergence_blocks: int | None = None
+
+
+class FleetView:
+    """Controller-side aggregate over router-replica reports.
+
+    Owns no clock assumptions beyond monotonic receive times; replicas are
+    expired from the view after `expire_after_s` silence (a scaled-down
+    router must not pin a stale ring hash or tenant rate forever)."""
+
+    def __init__(self, tenant_table=None, rate_window_s: float = 30.0,
+                 expire_after_s: float = 120.0):
+        # qos.tenants.TenantTable (or None): the per-tenant budget the
+        # fleet-wide utilization is measured against
+        self.tenant_table = tenant_table
+        self.rate_window_s = rate_window_s
+        self.expire_after_s = expire_after_s
+        self._lock = threading.Lock()
+        self._replicas: dict[str, _ReplicaState] = {}
+        self.reports_applied = 0
+
+    # -- ingestion ---------------------------------------------------------
+
+    def apply_report(self, report: dict, authoritative_positions:
+                     dict | None = None) -> dict:
+        """Apply one replica report; returns the JSON-able reply the
+        replica re-exports on its own /metrics (divergence vs the
+        authoritative index, fleet-wide tenant utilization, ring-divergence
+        flag) — so every replica can alert on the fleet view without an
+        extra scrape target."""
+        replica_id = str(report.get("replica") or "").strip()
+        if not replica_id:
+            return {"status": "error", "error": "replica id is required"}
+        # coerce field shapes BEFORE mutating state: a malformed (but
+        # JSON-valid) report must come back as the handler's 400 error
+        # reply, not escape as a 500 every report interval
+        try:
+            report_ts = float(report.get("ts") or 0.0)
+            ring_hash = str(report.get("ring_hash") or "")
+            breakers = dict(report.get("breakers") or {})
+            has_index = "index" in report
+            positions = dict(report.get("index") or {})
+            tenants = {
+                str(t): {
+                    str(k): float(v) for k, v in dict(c or {}).items()
+                }
+                for t, c in dict(report.get("tenants") or {}).items()
+            }
+        except (TypeError, ValueError) as e:
+            return {"status": "error",
+                    "error": f"malformed report field: {e}"}
+        now = time.monotonic()
+        with self._lock:
+            self._expire_locked(now)
+            st = self._replicas.get(replica_id)
+            if st is None:
+                st = self._replicas[replica_id] = _ReplicaState(replica_id)
+            st.recv_t = now
+            st.report_ts = report_ts
+            st.ring_hash = ring_hash
+            st.breakers = breakers
+            st.has_index = has_index
+            st.positions = positions
+            st.tenants = tenants
+            st.history.append((
+                now,
+                {t: c.get("requests", 0.0) for t, c in tenants.items()},
+            ))
+            if authoritative_positions is not None and st.has_index:
+                st.divergence_blocks = index_divergence_blocks(
+                    authoritative_positions, st.positions
+                )
+            elif not st.has_index:
+                st.divergence_blocks = None
+            self.reports_applied += 1
+            ring_divergent = self._ring_divergent_locked()
+            divergence = st.divergence_blocks
+        return {
+            "status": "ok",
+            "replicas": self.replica_count(),
+            "divergence_blocks": divergence,
+            "ring_divergent": ring_divergent,
+            "tenants": self.tenant_rollup(),
+        }
+
+    def _expire_locked(self, now: float) -> None:
+        for rid in [
+            rid for rid, st in self._replicas.items()
+            if now - st.recv_t > self.expire_after_s
+        ]:
+            del self._replicas[rid]
+
+    def _ring_divergent_locked(self) -> bool:
+        hashes = {
+            st.ring_hash for st in self._replicas.values() if st.ring_hash
+        }
+        return len(hashes) > 1
+
+    # -- queries -----------------------------------------------------------
+
+    def replica_count(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def tenant_rollup(self) -> dict[str, dict]:
+        """Fleet-wide per-tenant accounting: admitted request rate summed
+        across replicas over the rate window, measured against the
+        configured per-tenant budget. utilization 1.0 = the fleet admits
+        exactly the global limit; over-admission ratio is how far PAST the
+        limit the N per-replica buckets let traffic through (the N-way
+        split problem as a number: N identical replicas each granting the
+        full budget measure ≈ N-1)."""
+        now = time.monotonic()
+        with self._lock:
+            # expire on EVERY read path, not just report ingestion — a
+            # scaled-down router fleet must not freeze the utilization
+            # gauges at their last busy values (the frozen-gauge failure
+            # mode the StepMeter EWMAs were once fixed for)
+            self._expire_locked(now)
+            per_tenant_rate: dict[str, float] = {}
+            totals: dict[str, dict[str, float]] = {}
+            for st in self._replicas.values():
+                # oldest sample inside the window (fall back to the oldest
+                # held — a young view measures over what it has)
+                base = None
+                for t, counts in st.history:
+                    if now - t <= self.rate_window_s:
+                        base = (t, counts)
+                        break
+                if base is None and st.history:
+                    base = st.history[0]
+                latest = st.history[-1] if st.history else None
+                if latest is not None and base is not None:
+                    dt = max(1e-6, latest[0] - base[0])
+                    for tenant, n in latest[1].items():
+                        if dt < 0.5:
+                            continue  # one sample: no honest rate yet
+                        delta = n - base[1].get(tenant, 0.0)
+                        per_tenant_rate[tenant] = (
+                            per_tenant_rate.get(tenant, 0.0)
+                            + max(0.0, delta) / dt
+                        )
+                for tenant, counts in st.tenants.items():
+                    slot = totals.setdefault(
+                        tenant, {"requests": 0.0, "prompt_tokens": 0.0,
+                                 "throttled": 0.0}
+                    )
+                    for k in slot:
+                        slot[k] += counts.get(k, 0.0)
+        out: dict[str, dict] = {}
+        for tenant in sorted(set(per_tenant_rate) | set(totals)):
+            row: dict = {
+                "requests_per_s": round(per_tenant_rate.get(tenant, 0.0), 3),
+                **{k: v for k, v in (totals.get(tenant) or {}).items()},
+            }
+            limit = 0.0
+            if self.tenant_table is not None:
+                policy = self.tenant_table.get(tenant)
+                if policy is not None:
+                    limit = policy.requests_per_s
+            if limit > 0:
+                util = per_tenant_rate.get(tenant, 0.0) / limit
+                row["limit_requests_per_s"] = limit
+                row["limit_utilization"] = round(util, 3)
+                row["overadmission_ratio"] = round(max(0.0, util - 1.0), 3)
+            out[tenant] = row
+        return out
+
+    def snapshot(self, authoritative_positions: dict | None = None) -> dict:
+        """The GET /fleet body: per-replica positions + divergence, ring
+        membership agreement, fleet tenant rollup."""
+        now = time.monotonic()
+        with self._lock:
+            self._expire_locked(now)
+            replicas = []
+            for st in sorted(self._replicas.values(),
+                             key=lambda s: s.replica_id):
+                if authoritative_positions is not None and st.has_index:
+                    st.divergence_blocks = index_divergence_blocks(
+                        authoritative_positions, st.positions
+                    )
+                replicas.append({
+                    "replica": st.replica_id,
+                    "age_s": round(now - st.recv_t, 3),
+                    "ring_hash": st.ring_hash,
+                    "breakers": st.breakers,
+                    "index": st.positions or None,
+                    "divergence_blocks": st.divergence_blocks,
+                    "tenants": st.tenants,
+                })
+            ring_divergent = self._ring_divergent_locked()
+        return {
+            "replicas": replicas,
+            "ring_divergent": ring_divergent,
+            "tenants": self.tenant_rollup(),
+            "reports_applied": self.reports_applied,
+        }
+
+    def divergence_by_replica(self) -> dict[str, int | None]:
+        with self._lock:
+            # same expiry rule as tenant_rollup: dead replicas must drop
+            # out of the exported divergence gauges, not freeze in them
+            self._expire_locked(time.monotonic())
+            return {
+                st.replica_id: st.divergence_blocks
+                for st in self._replicas.values()
+            }

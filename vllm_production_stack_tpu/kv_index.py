@@ -42,6 +42,7 @@ import time
 from dataclasses import dataclass, field
 
 from .engine.kv_cache import KVBlockPool, chain_hash_run
+from .fleet import ConvergenceMeter
 from .utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -165,6 +166,12 @@ class ClusterKVIndex:
         self.events_applied = 0
         self.resyncs_requested = 0
         self.lookups = LookupLatency()
+        # fleet-coherence telemetry (docs/32-fleet-telemetry.md): publish→
+        # apply lag of event batches/snapshots as seen by THIS subscriber
+        # (tpu:cluster_kv_convergence_lag_seconds). Publishers stamp the
+        # oldest event's emit wall-time on each POST (kv_events.py "ts");
+        # heartbeats apply nothing and are not observed.
+        self.convergence = ConvergenceMeter()
 
     # -- event ingestion ---------------------------------------------------
 
@@ -209,11 +216,17 @@ class ClusterKVIndex:
                 payload.get("block_size") or view.block_size or 0
             )
             view.last_event_t = time.monotonic()
+            # publish→apply lag: publishers stamp the oldest event's emit
+            # wall-time; heartbeats (empty batches) apply nothing and are
+            # skipped so idle traffic doesn't dilute the histogram
+            publish_ts = payload.get("ts")
             if snapshot_hashes is not None:
                 view.epoch = epoch
                 view.seq = int(payload.get("seq") or 0)
                 view.hashes = snapshot_hashes
                 view.stale = False
+                if publish_ts:
+                    self.convergence.observe(time.time() - float(publish_ts))
                 return {"status": "ok"}
             seq_start = int(payload.get("seq_start") or 0)
             events = payload.get("events") or []
@@ -231,6 +244,8 @@ class ClusterKVIndex:
                     view.hashes.clear()
                 self.events_applied += 1
             view.seq = seq_start + len(events) - 1
+            if events and publish_ts:
+                self.convergence.observe(time.time() - float(publish_ts))
             if len(view.hashes) > self.max_hashes_per_engine:
                 logger.warning(
                     "cluster KV index for %s exceeded %d hashes; resetting "
@@ -339,6 +354,25 @@ class ClusterKVIndex:
                 # nothing resident anywhere: still a valid indexed answer
                 return None, 0
             return best_url, best_tokens
+
+    def positions(self) -> dict[str, dict]:
+        """Per-engine (epoch, seq) positions + slice sizes — the replica-
+        coherence view /fleet and /debug/fleet expose, and the input to
+        fleet.index_divergence_blocks (controller index vs an embedded
+        replica's report)."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                u: {
+                    "epoch": v.epoch,
+                    "seq": v.seq,
+                    "hashes": len(v.hashes),
+                    "block_size": v.block_size,
+                    "stale": not self._is_fresh(v, now),
+                    "age_s": round(now - v.last_event_t, 3),
+                }
+                for u, v in self._engines.items()
+            }
 
     def stats(self) -> dict:
         now = time.monotonic()

@@ -271,6 +271,72 @@ CLUSTER_KV_LOOKUPS = "tpu:cluster_kv_lookups_total"
 # histogram labeled mode= (kv_index.LookupLatency renders it)
 CLUSTER_KV_LOOKUP_LATENCY = "tpu:cluster_kv_lookup_latency_seconds"
 
+# -- fleet-coherence telemetry (docs/32-fleet-telemetry.md) ------------------
+# The measurement layer ROADMAP 1's multi-replica router refactor builds
+# against: replica index convergence, session-stickiness audit, and
+# cluster-wide tenant accounting. Subscriber-side names are exported by
+# every index host (KV controller /metrics AND the router registry in
+# embedded mode) under the same names, like the CLUSTER_KV_* set above.
+#
+# histogram: publish-timestamp → apply-timestamp lag of KV event batches
+# as seen by ONE subscriber (wall clocks across processes — honest to NTP
+# skew, which is far below the ≥10ms replica-convergence granularity)
+CLUSTER_KV_CONVERGENCE_LAG = "tpu:cluster_kv_convergence_lag_seconds"
+# gauge labeled engine=: the subscriber's applied event-sequence position
+# per publishing engine (cardinality bounded by the engine count; compare
+# across replicas to see who lags whom)
+CLUSTER_KV_ENGINE_SEQ = "tpu:cluster_kv_engine_seq"
+# gauge: estimated blocks by which a replica's embedded index diverges
+# from the controller's authoritative one (|seq gap| same-epoch, full
+# slice on epoch mismatch / missing engine — fleet.index_divergence_blocks).
+# The controller exports it per replica (labeled replica=); each router
+# re-exports its OWN value unlabeled from the /fleet/report reply.
+CLUSTER_KV_INDEX_DIVERGENCE = "tpu:cluster_kv_index_divergence_blocks"
+# engine-side counter labeled reason= (closed set, fleet.STICKINESS_REASONS):
+# "owner_changed" = consecutive requests of one session stamped with
+# different ring-chosen owners; "non_owner_delivery" = a session request
+# delivered to an engine that is not its stamped owner (failover moved it).
+# Zero with 1 replica and STABLE membership — the baseline ROADMAP 1 must
+# preserve. Endpoint churn legitimately remaps sessions at any N (the
+# consistent-hash minimal-remap property bounds how many), so transient
+# owner_changed blips during scaling are expected; a SUSTAINED rate on a
+# stable fleet is the multi-replica affinity break.
+SESSION_STICKINESS_VIOLATIONS = "tpu:session_stickiness_violations_total"
+# gauges labeled tenant= (cardinality bounded by the tenant table):
+# fleet-wide admitted request rate over the configured per-tenant budget
+# (1.0 = the fleet admits exactly the global limit), and how far past the
+# limit N per-replica buckets over-admit (≈ N-1 when every replica grants
+# the full budget). Computed by the controller's FleetView from periodic
+# router reports; each router re-exports the reply so the fleet view is
+# scrapeable at every replica.
+FLEET_TENANT_UTILIZATION = "tpu:fleet_tenant_limit_utilization"
+FLEET_TENANT_OVERADMISSION = "tpu:fleet_tenant_overadmission_ratio"
+# info-style gauge labeled hash= (value 1): the router's session-ring
+# membership hash. Replicas whose hashes differ route the same session to
+# different engines — `count(count by (hash)(...)) > 1` is the
+# TpuRouterRingDivergence alert.
+ROUTER_RING_MEMBERSHIP_HASH = "tpu:router_ring_membership_hash"
+# router gauges the 10k-connection bench (ROADMAP 1) reads: in-flight
+# proxied streams and the endpoint count discovery currently publishes
+ROUTER_ACTIVE_STREAMS = "tpu:router_active_streams"
+ROUTER_DISCOVERY_ENDPOINTS = "tpu:router_discovery_endpoints"
+# engine-side KV event publisher health (engine/kv_events.py): batches
+# POSTed (incl. heartbeats/snapshots), failed publish rounds, and the
+# events buffered awaiting flush — the PUBLISHER vantage on a failing
+# event path (before this pair, a dying publisher was only visible as
+# controller-side resync storms, the wrong place to alert on)
+KV_EVENT_PUBLISH_BATCHES = "tpu:kv_event_publish_batches_total"
+KV_EVENT_PUBLISH_FAILURES = "tpu:kv_event_publish_failures_total"
+KV_EVENT_QUEUE_DEPTH = "tpu:kv_event_pending_queue_depth"
+
+# closed reason set — the SINGLE definition (fleet.STICKINESS_REASONS
+# aliases it, so the audit and the exporter can never drift). Registered
+# into METRIC_LABEL_VALUES below — the dict literal predates this section.
+STICKINESS_REASON_VALUES = ("owner_changed", "non_owner_delivery")
+METRIC_LABEL_VALUES[SESSION_STICKINESS_VIOLATIONS] = {
+    "reason": STICKINESS_REASON_VALUES,
+}
+
 CLUSTER_KV_GAUGES = (
     CLUSTER_KV_INDEX_HASHES,
     CLUSTER_KV_INDEX_ENGINES,
@@ -312,6 +378,9 @@ ALL_GAUGES = (
     ENGINE_KV_TIER_USAGE,
     # KV flow telemetry (docs/30-kv-flow-telemetry.md)
     KV_TIER_BANDWIDTH,
+    # fleet-coherence telemetry (docs/32-fleet-telemetry.md): engine-side
+    # KV event publisher backlog
+    KV_EVENT_QUEUE_DEPTH,
 )
 ALL_COUNTERS = (
     PREFIX_CACHE_HITS,
@@ -347,4 +416,10 @@ ALL_COUNTERS = (
     DISK_KV_STORES,
     DISK_KV_LOADS,
     KV_HYDRATION_DECISIONS,
+    # fleet-coherence telemetry (docs/32-fleet-telemetry.md): stickiness
+    # audit (reason= is the closed STICKINESS_REASON_VALUES set) and the
+    # KV event publisher's own health counters
+    SESSION_STICKINESS_VIOLATIONS,
+    KV_EVENT_PUBLISH_BATCHES,
+    KV_EVENT_PUBLISH_FAILURES,
 )

@@ -81,9 +81,13 @@ class QoSGate:
         self.tokenizer = tokenizer
         self.limiter = TenantLimiter(table)
         # monotonic per-tenant counters, drained as deltas by the /metrics
-        # renderer (router/metrics.py) into real prometheus counters
+        # renderer (router/metrics.py) into real prometheus counters;
+        # _totals accumulates the same bumps WITHOUT draining, for the
+        # fleet reporter (router/fleet.py) — two consumers, two stores, so
+        # neither steals the other's increments
         self._mlock = threading.Lock()
         self._pending: dict[tuple[str, str], float] = {}
+        self._totals: dict[tuple[str, str], float] = {}
         self.reloads = 0
 
     # -- table lifecycle ---------------------------------------------------
@@ -149,9 +153,20 @@ class QoSGate:
         with self._mlock:
             k = (tenant_id, key)
             self._pending[k] = self._pending.get(k, 0) + n
+            self._totals[k] = self._totals.get(k, 0) + n
 
     def drain_counter_deltas(self) -> dict[tuple[str, str], float]:
         """(tenant, kind) -> increment since the last scrape."""
         with self._mlock:
             out, self._pending = self._pending, {}
+        return out
+
+    def totals(self) -> dict[str, dict[str, float]]:
+        """Monotonic per-tenant totals ({tenant: {kind: count}}) — the
+        fleet report's tenant accounting payload. Reading never drains, so
+        it composes with the /metrics delta consumer."""
+        with self._mlock:
+            out: dict[str, dict[str, float]] = {}
+            for (tenant, kind), n in self._totals.items():
+                out.setdefault(tenant, {})[kind] = n
         return out

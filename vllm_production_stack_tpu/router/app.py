@@ -98,6 +98,11 @@ class RouterState:
                 ),
             )
         self.dynamic_config: DynamicConfigWatcher | None = None
+        # fleet-coherence reporter (docs/32-fleet-telemetry.md): periodic
+        # replica reports to the controller's /fleet/report; started in
+        # on_startup when --fleet-report-url (or --kv-controller-url) and
+        # a non-zero interval are configured
+        self.fleet_reporter = None
         self.semantic_cache = None
         self.pii_middleware = None
         self.batch_service = None
@@ -433,6 +438,17 @@ async def handle_debug_requests(request: web.Request) -> web.Response:
     return web.json_response(payload, status=status)
 
 
+async def handle_debug_fleet(request: web.Request) -> web.Response:
+    """Fleet-coherence introspection (docs/32-fleet-telemetry.md): this
+    replica's ring membership hash, embedded KV-index seq positions +
+    convergence lag, breaker states, in-flight streams, and the last
+    fleet-view reply from the controller (index divergence, fleet tenant
+    utilization, ring-divergence flag)."""
+    from .fleet import debug_fleet_snapshot
+
+    return web.json_response(debug_fleet_snapshot(_state(request)))
+
+
 async def handle_version(request: web.Request) -> web.Response:
     return web.json_response({"version": VERSION})
 
@@ -521,6 +537,7 @@ def build_app(args) -> web.Application:
     app.router.add_get("/health", handle_health)
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/debug/requests", handle_debug_requests)
+    app.router.add_get("/debug/fleet", handle_debug_fleet)
     app.router.add_get("/version", handle_version)
     app.router.add_post("/sleep", handle_sleep)
     app.router.add_post("/wake_up", handle_wake)
@@ -559,6 +576,18 @@ def build_app(args) -> web.Application:
         await state.request_service.start()
         await state.discovery.start()
         await state.engine_scraper.start()
+        fleet_url = getattr(args, "fleet_report_url", None) or getattr(
+            args, "kv_controller_url", None
+        )
+        if fleet_url and getattr(args, "fleet_report_interval", 0) > 0:
+            from .fleet import FleetReporter
+
+            state.fleet_reporter = FleetReporter(
+                state, fleet_url,
+                interval_s=args.fleet_report_interval,
+                replica_id=getattr(args, "router_replica_id", "") or "",
+            )
+            await state.fleet_reporter.start()
         if state.batch_service is not None:
             await state.batch_service.start()
         if args.dynamic_config_file or getattr(
@@ -581,6 +610,8 @@ def build_app(args) -> web.Application:
         task = app.get("log_stats_task")
         if task:
             task.cancel()
+        if state.fleet_reporter is not None:
+            await state.fleet_reporter.stop()
         if state.dynamic_config is not None:
             await state.dynamic_config.stop()
         if state.batch_service is not None:

@@ -130,6 +130,29 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--log-stats-interval", type=float, default=0.0,
                    help="seconds between stats log lines (0 = off)")
 
+    f = p.add_argument_group("fleet coherence (docs/32-fleet-telemetry.md)")
+    f.add_argument(
+        "--router-replica-id", default=None,
+        help="this router replica's identity, stamped on upstream requests "
+             "(x-router-replica-id) and on fleet reports. Default: the "
+             "hostname — which on k8s is the pod name, already unique per "
+             "replica",
+    )
+    f.add_argument(
+        "--fleet-report-url", default=None,
+        help="base URL of the fleet aggregation endpoint (the KV "
+             "controller hosts POST /fleet/report + GET /fleet). Defaults "
+             "to --kv-controller-url when that is set; unset = no "
+             "reporting",
+    )
+    f.add_argument(
+        "--fleet-report-interval", type=float, default=10.0,
+        help="seconds between fleet coherence reports (ring membership "
+             "hash, embedded KV-index positions, breaker states, "
+             "per-tenant drained counters) POSTed to --fleet-report-url; "
+             "0 disables reporting even with a URL configured",
+    )
+
     x = p.add_argument_group("extensions")
     x.add_argument("--dynamic-config-file", default=None)
     x.add_argument("--dynamic-config-interval", type=float, default=10.0)
@@ -208,6 +231,14 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
         parser.set_defaults(**defaults)
     args = parser.parse_args(argv)
     validate_args(parser, args)
+    if not args.router_replica_id:
+        # hostname == pod name on k8s: unique per replica with zero config
+        import socket
+
+        args.router_replica_id = socket.gethostname()
+    # NOTE: the fleet-report-url → kv-controller-url fallback lives in ONE
+    # place, app.build_app's startup (it must cover programmatically
+    # constructed args too, which never pass through here)
     return args
 
 

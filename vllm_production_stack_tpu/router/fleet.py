@@ -1,0 +1,163 @@
+"""Router-side fleet coherence reporting (docs/32-fleet-telemetry.md).
+
+`FleetReporter` is a background task that periodically POSTs this
+replica's coherence state to the fleet aggregation endpoint (the KV
+controller's /fleet/report): ring-membership hash, embedded KV-index
+positions, breaker states, and the per-tenant drained counters the
+controller rolls up into fleet-wide tenant accounting.
+
+The reply rides back fleet-level signals this replica cannot compute
+alone — its index divergence against the controller's authoritative
+index, fleet tenant utilization/over-admission, and the ring-divergence
+flag — and RouterMetrics re-exports them, so the fleet view is scrapeable
+at every replica without adding a scrape target.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import aiohttp
+
+from ..utils.http import LazyClientSession
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class FleetReporter:
+    def __init__(self, state, url: str, interval_s: float = 10.0,
+                 replica_id: str = ""):
+        self.state = state  # RouterState (app.py)
+        self.url = url.rstrip("/")
+        self.interval_s = interval_s
+        self.replica_id = replica_id
+        self._http = LazyClientSession(
+            timeout=aiohttp.ClientTimeout(total=max(2.0, interval_s))
+        )
+        self._task: asyncio.Task | None = None
+        # last successful reply (divergence, fleet tenants, ring flag) —
+        # read by RouterMetrics.render and /debug/fleet
+        self.last_reply: dict | None = None
+        self.last_report_t: float = 0.0
+        self.last_error: str | None = None
+        self.reports_sent = 0
+        self.report_failures = 0
+
+    async def start(self) -> None:
+        if self.interval_s > 0 and self.url:
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self._http.close()
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.report_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # keep reporting through faults
+                self.report_failures += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+                logger.debug("fleet report failed: %s", e)
+            await asyncio.sleep(self.interval_s)
+
+    def build_report(self) -> dict:
+        """This replica's coherence state, as one JSON-able dict."""
+        state = self.state
+        report: dict = {
+            "replica": self.replica_id,
+            "ts": time.time(),
+            "ring_hash": "",
+            "breakers": {},
+            "tenants": {},
+        }
+        ring = getattr(state.policy, "ring", None)
+        if ring is not None and ring.nodes():
+            # an EMPTY ring (no session traffic routed yet) reports no
+            # hash: an idle replica must not trip the ring-divergence
+            # alert against busy ones
+            report["ring_hash"] = ring.membership_hash()
+        index = getattr(state.policy, "index", None)
+        if index is not None:
+            report["index"] = index.positions()
+        try:
+            report["breakers"] = {
+                url: snap["state_code"]
+                for url, snap in state.breakers.snapshot().items()
+            }
+        except Exception:  # breakers are optional context, never fatal
+            pass
+        qos = getattr(state, "qos", None)
+        if qos is not None:
+            report["tenants"] = qos.totals()
+        return report
+
+    async def report_once(self) -> dict:
+        """One report round; returns (and stores) the controller reply."""
+        sess = await self._http.get()
+        async with sess.post(
+            self.url + "/fleet/report", json=self.build_report()
+        ) as resp:
+            reply = await resp.json()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"fleet endpoint returned HTTP {resp.status}: {reply}"
+                )
+        self.reports_sent += 1
+        self.last_reply = reply
+        self.last_report_t = time.monotonic()
+        self.last_error = None
+        return reply
+
+    def snapshot(self) -> dict:
+        """/debug/fleet view of the reporting loop itself."""
+        return {
+            "url": self.url,
+            "interval_s": self.interval_s,
+            "reports_sent": self.reports_sent,
+            "report_failures": self.report_failures,
+            "last_error": self.last_error,
+            "last_report_age_s": (
+                round(time.monotonic() - self.last_report_t, 3)
+                if self.last_report_t else None
+            ),
+            "last_reply": self.last_reply,
+        }
+
+
+def debug_fleet_snapshot(state) -> dict:
+    """The router's GET /debug/fleet body: this replica's own coherence
+    state (ring membership, embedded index positions, breakers, stickiness
+    stamps it emits) plus the last fleet-view reply if reporting is on."""
+    policy = state.policy
+    ring = getattr(policy, "ring", None)
+    index = getattr(policy, "index", None)
+    body: dict = {
+        "replica": getattr(state.args, "router_replica_id", None),
+        "policy": type(policy).__name__,
+        "ring_hash": ring.membership_hash() if ring is not None else None,
+        "ring_nodes": sorted(ring.nodes()) if ring is not None else None,
+        "index": index.positions() if index is not None else None,
+        "index_convergence": (
+            index.convergence.stats() if index is not None else None
+        ),
+        "breakers": state.breakers.snapshot(),
+        "endpoints": [e.url for e in state.discovery.endpoints()],
+        "active_streams": state.request_service.active_streams,
+        "fleet_report": (
+            state.fleet_reporter.snapshot()
+            if getattr(state, "fleet_reporter", None) is not None
+            else None
+        ),
+    }
+    return body

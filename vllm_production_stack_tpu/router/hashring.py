@@ -25,14 +25,31 @@ class HashRing:
         self._points: list[int] = []  # sorted virtual-point hashes
         self._owner: dict[int, str] = {}  # point hash -> node
         self._nodes: set[str] = set()
+        self._membership_hash: str | None = None  # cache; add/remove clear
 
     def nodes(self) -> set[str]:
         return set(self._nodes)
+
+    def membership_hash(self) -> str:
+        """Stable digest of the ring's node set (fleet.membership_hash).
+        Two router replicas with equal hashes compute identical owners for
+        every session key (the virtual points are a pure function of the
+        node names); differing hashes mean the same session can route to
+        different engines — the divergence the
+        tpu:router_ring_membership_hash gauge exists to expose. Cached:
+        this sits on the per-request routing path, and membership only
+        changes in add_node/remove_node."""
+        if self._membership_hash is None:
+            from ..fleet import membership_hash
+
+            self._membership_hash = membership_hash(self._nodes)
+        return self._membership_hash
 
     def add_node(self, node: str) -> None:
         if node in self._nodes:
             return
         self._nodes.add(node)
+        self._membership_hash = None
         for i in range(self.replicas):
             p = _h64(f"{node}#{i}")
             # 64-bit collisions across distinct nodes are ~impossible; keep
@@ -46,6 +63,7 @@ class HashRing:
         if node not in self._nodes:
             return
         self._nodes.discard(node)
+        self._membership_hash = None
         for i in range(self.replicas):
             p = _h64(f"{node}#{i}")
             if self._owner.get(p) == node:

@@ -9,6 +9,8 @@ prometheus-adapter can read everything from the router.
 
 from __future__ import annotations
 
+import time
+
 from prometheus_client import (
     CollectorRegistry,
     Counter,
@@ -18,6 +20,7 @@ from prometheus_client import (
 )
 
 from .. import metrics_contract as mc
+from ..fleet import ConvergenceMeter
 from ..kv_index import LookupLatency
 
 LABEL = ["server"]
@@ -124,6 +127,73 @@ class RouterMetrics:
             buckets=LookupLatency.BUCKETS,
             registry=self.registry,
         )
+        # fleet-coherence telemetry (docs/32-fleet-telemetry.md) ----------
+        # subscriber-vantage convergence lag of the EMBEDDED index (the
+        # controller renders the same name for its own index); drained
+        # from kv_index.ConvergenceMeter so each observation lands once
+        self.kv_convergence_lag = Histogram(
+            mc.CLUSTER_KV_CONVERGENCE_LAG,
+            "Publish-to-apply lag of KV event batches at this subscriber",
+            buckets=ConvergenceMeter.BUCKETS,
+            registry=self.registry,
+        )
+        self.kv_engine_seq = Gauge(
+            mc.CLUSTER_KV_ENGINE_SEQ,
+            "Applied KV event sequence position per publishing engine "
+            "(embedded index)",
+            ["engine"],
+            registry=self.registry,
+        )
+        # labeled replica= like the controller's exposition, and so the
+        # series can be CLEARED when the fleet reply goes stale — an
+        # unlabeled gauge would freeze its last value through a
+        # controller outage
+        self.kv_index_divergence = Gauge(
+            mc.CLUSTER_KV_INDEX_DIVERGENCE,
+            "Estimated blocks by which this replica's embedded index "
+            "diverges from the controller's authoritative one (from the "
+            "/fleet/report reply)",
+            ["replica"],
+            registry=self.registry,
+        )
+        # info-style: value 1 under the current membership hash; replicas
+        # whose hashes differ route the same session differently —
+        # count(count by (hash)(...)) > 1 is the ring-divergence alert
+        self.ring_membership_hash = Gauge(
+            mc.ROUTER_RING_MEMBERSHIP_HASH,
+            "Session-ring membership hash of this router replica "
+            "(info-style gauge, value 1, labeled hash=)",
+            ["hash"],
+            registry=self.registry,
+        )
+        self._last_ring_hash: str | None = None
+        self.active_streams = Gauge(
+            mc.ROUTER_ACTIVE_STREAMS,
+            "In-flight proxied requests (SSE streams included)",
+            registry=self.registry,
+        )
+        self.discovery_endpoints = Gauge(
+            mc.ROUTER_DISCOVERY_ENDPOINTS,
+            "Endpoints service discovery currently publishes",
+            registry=self.registry,
+        )
+        # fleet tenant accounting, re-exported from the controller's
+        # /fleet/report reply (cardinality bounded by the tenant table)
+        self.fleet_tenant_utilization = Gauge(
+            mc.FLEET_TENANT_UTILIZATION,
+            "Fleet-wide admitted request rate over the tenant's "
+            "configured requests_per_s budget (1.0 = at the global limit)",
+            ["tenant"],
+            registry=self.registry,
+        )
+        self.fleet_tenant_overadmission = Gauge(
+            mc.FLEET_TENANT_OVERADMISSION,
+            "How far past the global per-tenant limit the N per-replica "
+            "buckets over-admit (N identical replicas each granting the "
+            "full budget measure about N-1)",
+            ["tenant"],
+            registry=self.registry,
+        )
         # multi-tenant QoS (docs/27-multitenancy.md): the router's half of
         # the tpu:tenant_* contract — admitted traffic and per-tenant
         # throttles (429s that never reached an engine). Label cardinality
@@ -188,14 +258,82 @@ class RouterMetrics:
             self.kv_index_stale.set(st["stale_engines"])
             self.kv_index_events.set(st["events_applied"])
             self.kv_index_resyncs.set(st["resyncs_requested"])
+            # fleet coherence: convergence-lag observations land in the
+            # real histogram exactly once; per-engine seq positions are
+            # re-set each scrape (clear first so gone engines drop)
+            for seconds in index.convergence.drain():
+                self.kv_convergence_lag.observe(seconds)
+            self.kv_engine_seq.clear()
+            for url, pos in index.positions().items():
+                self.kv_engine_seq.labels(engine=url).set(pos["seq"])
         drain = getattr(policy, "drain_lookup_log", None)
         if drain is not None:
             for mode, seconds in drain():
                 self.kv_lookups.labels(mode=mode).inc()
                 self.kv_lookup_latency.labels(mode=mode).observe(seconds)
 
+    def _render_fleet(self, state) -> None:
+        """Fleet-coherence gauges (docs/32-fleet-telemetry.md): ring
+        membership hash, in-flight streams, discovery endpoint count, and
+        the controller's fleet-view reply re-exported at this replica."""
+        ring = getattr(state.policy, "ring", None)
+        if ring is not None and ring.nodes():
+            # empty ring (no session traffic yet) exports no hash: an idle
+            # replica must not read as ring divergence
+            h = ring.membership_hash()
+            if h != self._last_ring_hash:
+                # one series per CURRENT membership: stale hashes must not
+                # linger or count(count by (hash)) sees phantom divergence
+                self.ring_membership_hash.clear()
+                self._last_ring_hash = h
+            self.ring_membership_hash.labels(hash=h).set(1)
+        elif self._last_ring_hash is not None:
+            # the ring DRAINED to empty (discovery outage, scale-to-zero):
+            # the old hash must stop exporting or this idle replica keeps
+            # feeding phantom ring divergence against healthy ones
+            self.ring_membership_hash.clear()
+            self._last_ring_hash = None
+        svc = getattr(state, "request_service", None)
+        if svc is not None:
+            self.active_streams.set(svc.active_streams)
+        disc = getattr(state, "discovery", None)
+        if disc is not None:
+            self.discovery_endpoints.set(len(disc.endpoints()))
+        reporter = getattr(state, "fleet_reporter", None)
+        reply = reporter.last_reply if reporter is not None else None
+        # freshness gate: during a controller outage the last reply must
+        # not keep exporting as current — stale fleet gauges clear, and
+        # the outage reads as absent series instead of frozen-healthy
+        fresh = (
+            reply is not None
+            and reporter.last_report_t
+            and time.monotonic() - reporter.last_report_t
+            <= max(3 * reporter.interval_s, 30.0)
+        )
+        if fresh:
+            if reply.get("divergence_blocks") is not None:
+                self.kv_index_divergence.labels(
+                    replica=reporter.replica_id or ""
+                ).set(reply["divergence_blocks"])
+            self.fleet_tenant_utilization.clear()
+            self.fleet_tenant_overadmission.clear()
+            for tenant, row in (reply.get("tenants") or {}).items():
+                if "limit_utilization" in row:
+                    self.fleet_tenant_utilization.labels(
+                        tenant=tenant
+                    ).set(row["limit_utilization"])
+                if "overadmission_ratio" in row:
+                    self.fleet_tenant_overadmission.labels(
+                        tenant=tenant
+                    ).set(row["overadmission_ratio"])
+        elif reporter is not None:
+            self.kv_index_divergence.clear()
+            self.fleet_tenant_utilization.clear()
+            self.fleet_tenant_overadmission.clear()
+
     def render(self, state, openmetrics: bool = False) -> bytes:
         self._render_kv_index(state.policy)
+        self._render_fleet(state)
         qos = getattr(state, "qos", None)
         if qos is not None:
             for (tenant, kind), delta in qos.drain_counter_deltas().items():

@@ -19,6 +19,12 @@ import uuid
 import aiohttp
 from aiohttp import web
 
+from ..fleet import (
+    REPLICA_HEADER,
+    RING_HASH_HEADER,
+    STICKY_OWNER_HEADER,
+    STICKY_SESSION_HEADER,
+)
 from ..qos.gate import STAMP_HEADERS, TENANT_REQUEST_KEY
 from ..tracing import NULL_TRACE, TRACEPARENT_HEADER
 from ..utils.logging import init_logger
@@ -35,6 +41,11 @@ TTFB_KEY = "tpu_first_byte_mono"
 # but the client saw a truncated transfer — the trace must say "severed"
 # and the latency histograms must not count it as served
 SEVERED_KEY = "tpu_severed"
+# the FIRST route() attempt's session-affinity choice ({"session_id",
+# "owner", "ring_hash"} from SessionPolicy): kept per-request so failover
+# re-picks forward the ORIGINAL ring owner — a delivery that moved off it
+# is exactly the stickiness break the engine-side audit counts
+STICKY_KEY = "tpu_sticky"
 
 
 class UpstreamConnectError(Exception):
@@ -83,6 +94,10 @@ class RequestService:
     def __init__(self, state):
         self.state = state  # RouterState (app.py) — discovery/policy/stats
         self._session: aiohttp.ClientSession | None = None
+        # in-flight proxied requests (SSE streams included): the
+        # tpu:router_active_streams gauge the 10k-connection bench reads.
+        # Plain int mutated only on the event loop — no lock needed.
+        self.active_streams = 0
 
     async def start(self) -> None:
         # config-driven upstream guards (--upstream-total-s /
@@ -215,6 +230,7 @@ class RequestService:
         t0 = time.monotonic()
         resp: web.StreamResponse | None = None
         raised_status = 500
+        self.active_streams += 1
         try:
             if request.content_type == "multipart/form-data":
                 # audio transcription (and any multipart upload) routes on
@@ -231,6 +247,7 @@ class RequestService:
             raised_status = e.status
             raise
         finally:
+            self.active_streams -= 1
             status = resp.status if resp is not None else raised_status
             severed = request.get(SEVERED_KEY, False)
             # latency histograms observe only SERVED requests (refusals
@@ -359,6 +376,12 @@ class RequestService:
                                "type": "service_unavailable"}},
                     status=503,
                 )
+            if ctx.sticky is not None and STICKY_KEY not in request:
+                # first pick only: the affinity target. Re-picks against a
+                # shrunken candidate set (failover) must not rewrite it —
+                # the original owner stamp is what lets the engine see
+                # that delivery moved (docs/32-fleet-telemetry.md)
+                request[STICKY_KEY] = ctx.sticky
             logger.info(
                 "Routing request %s to %s at %f", request_id, url, time.time()
             )
@@ -469,16 +492,21 @@ class RequestService:
                 fields.append((key, model or "", None, None))
             else:
                 fields.append((key, value, None, None))
-        # the original Content-Type names the OLD boundary — aiohttp sets the
-        # fresh one for the rebuilt form
-        headers = {
-            k: v
-            for k, v in self._upstream_headers(request).items()
-            if k.lower() != "content-type"
-        }
         mon = self.state.request_monitor
 
         async def attempt(url: str) -> web.StreamResponse:
+            # headers built PER ATTEMPT, after route() ran: the sticky
+            # stamps (request[STICKY_KEY], set by _with_failover on the
+            # first pick) and the decaying deadline must reflect this
+            # attempt — a once-built dict predates routing and silently
+            # dropped the stamps for all multipart session traffic. The
+            # original Content-Type names the OLD boundary — aiohttp sets
+            # the fresh one for the rebuilt form.
+            headers = {
+                k: v
+                for k, v in self._upstream_headers(request).items()
+                if k.lower() != "content-type"
+            }
             # fresh FormData per attempt from the buffered fields — the
             # object is single-use and a retry must resend identical bytes
             fd = aiohttp.FormData()
@@ -589,6 +617,30 @@ class RequestService:
             tp = trace.child_traceparent()
             if tp:
                 headers[TRACEPARENT_HEADER] = tp
+        # fleet-coherence stamps (docs/32-fleet-telemetry.md): which router
+        # replica proxied this request, and — for session traffic — the
+        # ring-chosen owner + ring membership hash the engine-side
+        # stickiness audit compares across a session's requests. Inbound
+        # copies are dropped whenever this router stamps (a client must
+        # not be able to fabricate violations); a replica with no id and
+        # no session policy stays transparent, like the tenant stamps.
+        replica_id = getattr(self.state.args, "router_replica_id", None)
+        sticky = request.get(STICKY_KEY)
+        if replica_id or sticky is not None:
+            fleet_headers = (
+                REPLICA_HEADER, STICKY_SESSION_HEADER,
+                STICKY_OWNER_HEADER, RING_HASH_HEADER,
+            )
+            headers = {
+                k: v for k, v in headers.items()
+                if k.lower() not in fleet_headers
+            }
+        if replica_id:
+            headers[REPLICA_HEADER] = str(replica_id)
+        if sticky is not None:
+            headers[STICKY_SESSION_HEADER] = sticky["session_id"]
+            headers[STICKY_OWNER_HEADER] = sticky["owner"]
+            headers[RING_HASH_HEADER] = sticky["ring_hash"]
         qos = self.state.qos
         if qos is not None:
             # spoof-proofing: with QoS active, inbound x-tenant-id /
@@ -788,7 +840,12 @@ class RequestService:
             async with self.session.post(
                 prefill_url + request.path,
                 json=prefill_body,
-                headers=_forward_headers(request.headers),
+                # _upstream_headers, not the raw forward: the prefill hop
+                # must strip inbound tenant/fleet stamp spoofs and carry
+                # the same rid/traceparent/deadline the decode hop gets —
+                # a client could otherwise fabricate stickiness violations
+                # through the prefill engine's audit
+                headers=self._upstream_headers(request),
             ) as resp:
                 await resp.read()
                 if resp.status != 200:

@@ -50,6 +50,12 @@ class RoutingContext:
     request_stats: dict[str, RequestStats] = field(default_factory=dict)
     headers: dict[str, str] = field(default_factory=dict)
     body: dict = field(default_factory=dict)
+    # filled by SessionPolicy.route when the request carries a session id:
+    # {"session_id", "owner", "ring_hash"} — the stickiness-audit stamp
+    # the proxy forwards upstream (docs/32-fleet-telemetry.md). The FIRST
+    # attempt's value is the affinity target; failover re-routes leave it
+    # alone so a moved delivery is visible engine-side.
+    sticky: dict | None = None
 
     def header(self, name: str) -> str | None:
         """Case-insensitive header lookup. HTTP header names are
@@ -164,7 +170,17 @@ class SessionPolicy(RoutingPolicy):
         session_id = ctx.header(self.session_key)
         if session_id is None:
             return qps_min_url(ctx.endpoints, ctx.request_stats)
-        return self.ring.get_node(session_id)
+        owner = self.ring.get_node(session_id)
+        # stickiness-audit stamp (docs/32-fleet-telemetry.md): the ring-
+        # chosen owner + this ring's membership hash ride upstream so the
+        # engine can detect affinity breaks (owner changed between
+        # requests, or delivery moved off the owner via failover)
+        ctx.sticky = {
+            "session_id": session_id,
+            "owner": owner,
+            "ring_hash": self.ring.membership_hash(),
+        }
+        return owner
 
     def on_endpoints_changed(
         self, removed: set[str], current: set[str]
