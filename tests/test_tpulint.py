@@ -1,0 +1,319 @@
+"""tpulint — the AST concurrency & contract analyzer (tools/tpulint/).
+
+Three layers:
+
+* fixture corpus (tests/tpulint_fixtures/): every rule fires on a seeded
+  positive and stays quiet on the matching corrected negative — the
+  rules' own regression suite;
+* mechanism tests: inline suppression (reason MANDATORY), baseline
+  round-trip with line-drift immunity and stale-entry detection, import
+  alias resolution, CLI exit codes;
+* the tier-1 teeth: `vllm_production_stack_tpu/` must have ZERO
+  unsuppressed, non-baselined findings — the same gate the pre-commit
+  lane runs in CI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.tpulint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tpulint_fixtures")
+for p in (REPO, TOOLS):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+import tpulint  # noqa: E402
+from tpulint import (  # noqa: E402
+    Finding,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from tpulint.rules import ALL_RULES, RULE_SLUGS  # noqa: E402
+
+PACKAGE = os.path.join(REPO, "vllm_production_stack_tpu")
+
+
+# -- fixture corpus: every rule catches its seeded bug -----------------------
+
+FIXTURE_EXPECT = {
+    "async_blocking": ("async-blocking", 3),
+    "lock_blocking": ("lock-blocking", 1),
+    "response_truthiness": ("response-truthiness", 2),
+    "untracked_task": ("untracked-task", 3),
+    "thread_lifecycle": ("thread-lifecycle", 2),
+    "metric_literal": ("metric-literal", 2),
+}
+
+
+def test_every_rule_has_a_fixture_pair():
+    stems = {r.slug.replace("-", "_") for r in ALL_RULES}
+    assert stems == set(FIXTURE_EXPECT)
+    for stem in stems:
+        for suffix in ("_pos.py", "_neg.py"):
+            assert os.path.isfile(os.path.join(FIXTURES, stem + suffix)), \
+                f"missing fixture {stem}{suffix}"
+
+
+@pytest.mark.parametrize("stem", sorted(FIXTURE_EXPECT))
+def test_rule_fires_on_seeded_positive(stem):
+    slug, expected_n = FIXTURE_EXPECT[stem]
+    findings = analyze_file(os.path.join(FIXTURES, f"{stem}_pos.py"))
+    assert [f.rule for f in findings] == [slug] * expected_n, \
+        "\n".join(f.render() for f in findings)
+
+
+@pytest.mark.parametrize("stem", sorted(FIXTURE_EXPECT))
+def test_rule_quiet_on_corrected_negative(stem):
+    findings = analyze_file(os.path.join(FIXTURES, f"{stem}_neg.py"))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# -- suppressions ------------------------------------------------------------
+
+_SLEEPY = textwrap.dedent("""\
+    import time
+
+    async def handler():
+        time.sleep(1){trailer}
+""")
+
+
+def test_unsuppressed_finding_reported():
+    findings = analyze_source(_SLEEPY.format(trailer=""), "x.py")
+    assert [f.rule for f in findings] == ["async-blocking"]
+    assert findings[0].line == 4
+    assert findings[0].code == "time.sleep(1)"
+
+
+def test_inline_suppression_with_reason_silences():
+    src = _SLEEPY.format(
+        trailer="  # tpulint: allow(async-blocking) — test pacing stub"
+    )
+    assert analyze_source(src, "x.py") == []
+
+
+def test_standalone_comment_suppresses_next_line():
+    src = textwrap.dedent("""\
+        import time
+
+        async def handler():
+            # tpulint: allow(async-blocking) — measured: sub-ms, cheaper
+            # than the hop
+            time.sleep(0.0001)
+    """)
+    # a standalone suppression comment covers the next CODE line —
+    # continuation comment lines in between don't break the binding
+    assert analyze_source(src, "x.py") == []
+
+
+def test_suppression_without_reason_is_itself_a_finding():
+    src = _SLEEPY.format(trailer="  # tpulint: allow(async-blocking)")
+    findings = analyze_source(src, "x.py")
+    rules = sorted(f.rule for f in findings)
+    # the reasonless allowance does NOT silence the finding, and adds one
+    assert rules == ["async-blocking", "bad-suppression"]
+    msg = next(f for f in findings if f.rule == "bad-suppression").message
+    assert "reason" in msg
+
+
+def test_suppression_for_wrong_rule_does_not_cover():
+    src = _SLEEPY.format(
+        trailer="  # tpulint: allow(metric-literal) — wrong rule on purpose"
+    )
+    assert [f.rule for f in analyze_source(src, "x.py")] == ["async-blocking"]
+
+
+def test_wildcard_suppression_covers_any_rule():
+    src = _SLEEPY.format(trailer="  # tpulint: allow(*) — generated code")
+    assert analyze_source(src, "x.py") == []
+
+
+def test_ascii_separator_accepted():
+    src = _SLEEPY.format(
+        trailer="  # tpulint: allow(async-blocking) -- plain-ascii reason"
+    )
+    assert analyze_source(src, "x.py") == []
+
+
+# -- baseline ----------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    src = _SLEEPY.format(trailer="")
+    findings = analyze_source(src, "pkg/mod.py")
+    assert len(findings) == 1
+    path = str(tmp_path / "baseline.json")
+    write_baseline(findings, path)
+    loaded = load_baseline(path)
+    new, stale = apply_baseline(findings, loaded)
+    assert new == [] and stale == []
+    # the persisted shape is the documented one
+    doc = json.loads(open(path).read())
+    assert doc["findings"][0]["rule"] == "async-blocking"
+    assert doc["findings"][0]["path"] == "pkg/mod.py"
+    assert doc["findings"][0]["code"] == "time.sleep(1)"
+
+
+def test_baseline_is_line_drift_immune():
+    findings = analyze_source(_SLEEPY.format(trailer=""), "pkg/mod.py")
+    entry = {"rule": "async-blocking", "path": "pkg/mod.py",
+             "line": 9999, "code": "time.sleep(1)"}
+    new, stale = apply_baseline(findings, [entry])
+    assert new == [] and stale == []
+
+
+def test_fixed_finding_surfaces_as_stale_baseline_entry():
+    entry = {"rule": "async-blocking", "path": "pkg/gone.py",
+             "line": 4, "code": "time.sleep(1)"}
+    new, stale = apply_baseline([], [entry])
+    assert new == [] and stale == [entry]
+
+
+def test_baseline_multiset_semantics():
+    f = analyze_source(_SLEEPY.format(trailer=""), "pkg/mod.py")[0]
+    twice = [f, f]
+    entry = {"rule": f.rule, "path": f.path, "line": f.line, "code": f.code}
+    new, _ = apply_baseline(twice, [entry])
+    assert len(new) == 1  # one entry absorbs exactly one finding
+
+
+def test_checked_in_baseline_parses():
+    baseline = load_baseline()
+    assert isinstance(baseline, list)
+    for entry in baseline:
+        assert entry["rule"] in RULE_SLUGS | {"bad-suppression",
+                                              "syntax-error"}
+
+
+def test_suppression_text_in_docstring_is_prose():
+    src = textwrap.dedent('''\
+        """Docs: suppress with `# tpulint: allow(<rule>) — <reason>`."""
+        import time
+
+        async def handler():
+            time.sleep(1)
+    ''')
+    findings = analyze_source(src, "x.py")
+    # the docstring mention is neither a bad-suppression finding nor a
+    # live suppression — only the real finding remains
+    assert [f.rule for f in findings] == ["async-blocking"]
+
+
+def test_string_join_is_not_a_thread_stop_path():
+    src = textwrap.dedent("""\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                print(", ".join(["a", "b"]))
+    """)
+    assert [f.rule for f in analyze_source(src, "x.py")] == ["thread-lifecycle"]
+
+
+def test_thread_join_with_timeout_is_a_stop_path():
+    src = textwrap.dedent("""\
+        import threading
+
+        class C:
+            def run_once(self):
+                t = threading.Thread(target=self.work)
+                t.start()
+                t.join(timeout=5)
+
+            def work(self):
+                pass
+    """)
+    assert analyze_source(src, "x.py") == []
+
+
+# -- resolution details ------------------------------------------------------
+
+def test_import_alias_resolution():
+    src = textwrap.dedent("""\
+        import time as _t
+
+        async def f():
+            _t.sleep(1)
+    """)
+    assert [f.rule for f in analyze_source(src, "x.py")] == ["async-blocking"]
+
+
+def test_from_import_resolution():
+    src = textwrap.dedent("""\
+        from json import loads
+
+        async def f(raw):
+            return loads(raw)
+    """)
+    assert [f.rule for f in analyze_source(src, "x.py")] == ["async-blocking"]
+
+
+def test_nested_sync_def_is_executor_target_not_flagged():
+    src = textwrap.dedent("""\
+        import asyncio, time
+
+        async def f():
+            def work():
+                time.sleep(1)
+            await asyncio.get_running_loop().run_in_executor(None, work)
+    """)
+    assert analyze_source(src, "x.py") == []
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    findings = analyze_source("def broken(:\n", "x.py")
+    assert [f.rule for f in findings] == ["syntax-error"]
+
+
+# -- tier-1 teeth ------------------------------------------------------------
+
+def test_package_has_no_unsuppressed_nonbaselined_findings():
+    """The gate: same check the CI tpulint lane runs.  A finding here
+    means new code tripped a review-pass bug class — fix it, suppress it
+    with a reason, or (last resort) baseline it via
+    `python -m tools.tpulint vllm_production_stack_tpu --write-baseline`."""
+    findings = analyze_paths([PACKAGE])
+    new, _stale = apply_baseline(findings, load_baseline())
+    assert new == [], "\n" + "\n".join(f.render() for f in new)
+
+
+def test_cli_exit_codes(tmp_path):
+    from tpulint.__main__ import main
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    assert main([str(clean), "--no-baseline"]) == 0
+    assert main([str(dirty), "--no-baseline"]) == 1
+    assert main(["--list-rules"]) == 0
+
+
+def test_cli_write_baseline_then_clean(tmp_path):
+    from tpulint.__main__ import main
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    baseline = str(tmp_path / "b.json")
+    assert main([str(dirty), "--baseline", baseline,
+                 "--write-baseline"]) == 0
+    assert main([str(dirty), "--baseline", baseline]) == 0   # grandfathered
+    dirty.write_text("import time\n\nasync def f():\n    time.sleep(2)\n")
+    assert main([str(dirty), "--baseline", baseline]) == 1   # changed line

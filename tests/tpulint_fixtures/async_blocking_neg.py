@@ -1,0 +1,21 @@
+"""Corrected form: the same work hopped through the executor."""
+import asyncio
+import json
+import time
+
+from aiohttp import web
+
+
+def _parse_and_read(raw: bytes):
+    body = json.loads(raw)          # off-loop helper: legal blocking code
+    time.sleep(0.1)
+    with open("/tmp/x") as f:
+        return body, f.read()
+
+
+async def handler(request: web.Request) -> web.Response:
+    raw = await request.read()
+    loop = asyncio.get_running_loop()
+    body, data = await loop.run_in_executor(None, _parse_and_read, raw)
+    await asyncio.sleep(0.1)        # the async sleep is the right one
+    return web.json_response({"body": body, "data": data})

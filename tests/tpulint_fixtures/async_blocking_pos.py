@@ -1,0 +1,14 @@
+"""Seeded positive: blocking calls on the event loop (PR 2 bug class)."""
+import json
+import time
+
+from aiohttp import web
+
+
+async def handler(request: web.Request) -> web.Response:
+    raw = await request.read()
+    body = json.loads(raw)          # finding: json.loads on the loop
+    time.sleep(0.1)                 # finding: time.sleep on the loop
+    with open("/tmp/x") as f:       # finding: file open on the loop
+        data = f.read()
+    return web.json_response({"body": body, "data": data})

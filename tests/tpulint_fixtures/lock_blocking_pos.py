@@ -1,0 +1,17 @@
+"""Seeded positive: file I/O under the tier lock (PR 8 DiskKVTier class)."""
+import threading
+
+
+class DiskTier:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._index = {}
+
+    def load(self, key: str) -> bytes:
+        with self._lock:
+            path = self._index[key]
+            with open(path, "rb") as f:   # finding: multi-MB read holds
+                return f.read()           # every probe/offload behind it
+
+    def close(self):
+        pass

@@ -1,0 +1,13 @@
+"""Corrected form: names imported from the contract; prose mentions of a
+name (help text, docstrings) stay legal."""
+
+METRIC_MY_COUNTER = "imported from vllm_production_stack_tpu.metrics_contract"
+
+HELP_TEXT = (
+    "disabling the meter keeps the ledger (tpu:wasted_tokens_total) "
+    "counting either way"
+)
+
+
+def render(name: str, value: float) -> str:
+    return f"{name} {value}"

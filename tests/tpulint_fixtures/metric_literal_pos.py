@@ -1,0 +1,8 @@
+"""Seeded positive: metric-name literals minted outside the contract
+(the drift class check_metrics_contract.py's PR 5 audit found 4 of)."""
+
+COUNTER = "tpu:my_new_counter_total"            # finding: full-name literal
+
+
+def series_name(kind: str) -> str:
+    return f"tpu:my_gauge_{kind}"               # finding: f-string composes

@@ -1,0 +1,18 @@
+"""Corrected form: `is not None` wherever a helper returns Response|None."""
+from aiohttp import web
+
+
+class Server:
+    def _check_request(self, body: dict) -> web.Response | None:
+        if "model" not in body:
+            return web.json_response({"error": "model required"}, status=400)
+        return None
+
+    async def handle(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        if (err := self._check_request(body)) is not None:
+            return err
+        refusal = self._check_request(body)
+        if refusal is not None:
+            return refusal
+        return web.json_response({"ok": True})

@@ -1,0 +1,19 @@
+"""Seeded positive: truthiness guard on a Response-or-None helper
+(the PR 2 engine/server.py bug — an empty aiohttp Response is falsy)."""
+from aiohttp import web
+
+
+class Server:
+    def _check_request(self, body: dict) -> web.Response | None:
+        if "model" not in body:
+            return web.json_response({"error": "model required"}, status=400)
+        return None
+
+    async def handle(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        if err := self._check_request(body):   # finding: falsy-Response guard
+            return err
+        refusal = self._check_request(body)
+        if refusal:                            # finding: name truthiness
+            return refusal
+        return web.json_response({"ok": True})

@@ -1,0 +1,27 @@
+"""Corrected form: stop event checked by the loop, join on shutdown,
+exceptions caught narrowly and logged."""
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+class Compiler:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.compile_one()
+            except Exception:
+                logger.exception("compile job failed")
+
+    def compile_one(self):
+        pass
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=5)

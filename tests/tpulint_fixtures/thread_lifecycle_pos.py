@@ -1,0 +1,21 @@
+"""Seeded positive: unstoppable daemon thread + swallowing bare except
+(the PR 2 leaked-_bg_compile_job class)."""
+import threading
+
+
+class Compiler:
+    def __init__(self):
+        self._thread = threading.Thread(      # finding: no stop path in class
+            target=self._loop, daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                self.compile_one()
+            except:                            # finding: bare except swallows
+                pass
+
+    def compile_one(self):
+        pass

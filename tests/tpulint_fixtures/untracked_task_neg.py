@@ -1,0 +1,17 @@
+"""Corrected form: strong refs held for the task's lifetime."""
+import asyncio
+
+_tasks: set = set()
+
+
+async def scrub_later(trie):
+    await asyncio.sleep(60)
+    trie.scrub()
+
+
+async def schedule(trie):
+    task = asyncio.create_task(scrub_later(trie))
+    _tasks.add(task)
+    task.add_done_callback(_tasks.discard)
+    await asyncio.ensure_future(scrub_later(trie))   # awaited: ref held
+    return asyncio.create_task(scrub_later(trie))    # returned: caller holds

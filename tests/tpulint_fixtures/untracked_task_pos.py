@@ -1,0 +1,14 @@
+"""Seeded positive: create_task result dropped (PR 2 trie-scrub class)."""
+import asyncio
+
+
+async def scrub_later(trie):
+    await asyncio.sleep(60)
+    trie.scrub()
+
+
+async def schedule(trie):
+    asyncio.create_task(scrub_later(trie))        # finding: ref dropped
+    loop = asyncio.get_running_loop()
+    loop.create_task(scrub_later(trie))           # finding: ref dropped
+    asyncio.ensure_future(scrub_later(trie))      # finding: ref dropped

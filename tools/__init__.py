@@ -1,0 +1,3 @@
+# Makes `python -m tools.tpulint` / `python -m tools.check_metrics_contract`
+# work from the repo root. The scripts also stay runnable directly (tests
+# put tools/ itself on sys.path and import them as top-level modules).

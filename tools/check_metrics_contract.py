@@ -270,6 +270,31 @@ def check_reference_label_values() -> list[str]:
     return problems
 
 
+def check_source_metric_literals() -> list[str]:
+    """(f): no `tpu:` metric-name literal may be minted in *source*
+    outside metrics_contract.py — tpulint's metric-literal rule, run here
+    so contract drift in code fails the same gate that already guards
+    exporters, dashboards, rules, and docs.  tpulint inline suppressions
+    and its baseline apply (a reasoned allowance is visible and audited;
+    a bare literal is drift)."""
+    try:
+        from tools import tpulint
+    except ImportError:
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import tpulint
+    findings = tpulint.analyze_paths(
+        [os.path.join(REPO, "vllm_production_stack_tpu")],
+        select={"metric-literal"},
+    )
+    new, _stale = tpulint.apply_baseline(findings, tpulint.load_baseline())
+    # analyze_paths surfaces bad-suppression/syntax-error meta-findings
+    # regardless of `select` — those belong to the tpulint gate, not here
+    return [
+        f"source metric literal: {f.render()}"
+        for f in new if f.rule == "metric-literal"
+    ]
+
+
 def check() -> list[str]:
     """All drift violations, empty when the contract is clean."""
     exported = exported_names()
@@ -288,6 +313,7 @@ def check() -> list[str]:
     problems.extend(check_rules())
     problems.extend(check_exported_label_sets())
     problems.extend(check_reference_label_values())
+    problems.extend(check_source_metric_literals())
     return problems
 
 

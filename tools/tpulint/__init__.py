@@ -1,0 +1,288 @@
+"""tpulint — AST-based concurrency & contract analyzer for this repo.
+
+Every rule encodes a bug class actually found (and fixed by hand) during
+the PR 2–9 review passes; the analyzer makes those review passes
+mechanical.  See docs/33-static-analysis.md for the rule catalog with the
+historical bug each rule encodes.
+
+    python -m tools.tpulint vllm_production_stack_tpu
+
+Findings are suppressed inline with a MANDATORY reason
+
+    # tpulint: allow(<rule>) — <reason>
+
+on the finding line or on a comment line directly above it.  A
+suppression without a reason is itself a finding (`bad-suppression`) —
+an allowance nobody can audit is how grandfathered bugs become
+permanent.  Grandfathered findings live in a checked-in baseline
+(tools/tpulint/baseline.json, matched by (rule, path, source-line text)
+so line-number drift never churns it); anything not suppressed and not
+in the baseline fails the run, which is what lets the analyzer land
+blocking from day one while the baseline burns down.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import tokenize
+
+__all__ = [
+    "Finding",
+    "analyze_file",
+    "analyze_source",
+    "analyze_paths",
+    "load_baseline",
+    "apply_baseline",
+    "write_baseline",
+    "DEFAULT_BASELINE",
+]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.json")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str          # kebab-case rule slug ("async-blocking", ...)
+    path: str          # repo-relative (or as-given) file path
+    line: int          # 1-indexed
+    message: str
+    code: str = ""     # stripped source line — the baseline match key
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# -- inline suppressions -----------------------------------------------------
+
+# `# tpulint: allow(rule[, rule...]) — reason` ; the reason separator
+# accepts an em-dash, `--`, or `:` so plain-ASCII editors aren't locked out.
+_ALLOW_RE = re.compile(
+    r"#\s*tpulint:\s*allow\(\s*([A-Za-z0-9*,\- ]*?)\s*\)\s*(?:(?:—|--|:)\s*(.*))?$"
+)
+
+
+class _Suppression:
+    def __init__(self, line: int, rules: frozenset[str], reason: str):
+        self.line = line
+        self.rules = rules
+        self.reason = reason
+        self.used = False
+
+    def covers(self, finding_rule: str) -> bool:
+        return "*" in self.rules or finding_rule in self.rules
+
+
+def _comment_tokens(src: str) -> list[tuple[int, str, bool]]:
+    """(line, comment_text, standalone) for every real COMMENT token —
+    tokenizing (not text-scanning) so suppression syntax quoted inside a
+    docstring or string literal is prose, not a directive."""
+    import io
+
+    out: list[tuple[int, str, bool]] = []
+    lines = src.splitlines()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                line = tok.start[0]
+                before = lines[line - 1][: tok.start[1]] if line <= len(lines) else ""
+                out.append((line, tok.string, not before.strip()))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # analyze_source already reports files that don't parse
+    return out
+
+
+def parse_suppressions(
+    src: str, path: str
+) -> tuple[dict[int, _Suppression], list[Finding]]:
+    """Map of source line → suppression in force there, plus findings for
+    malformed suppressions (missing/empty reason, empty rule list).
+
+    A suppression comment covers its own line; when the comment stands
+    alone on a line, it also covers the next non-blank, non-comment line
+    (the conventional "annotation above the statement" form)."""
+    lines = src.splitlines()
+    by_line: dict[int, _Suppression] = {}
+    problems: list[Finding] = []
+    for i, text, standalone in _comment_tokens(src):
+        m = _ALLOW_RE.search(text)
+        if not m:
+            # only comments that START as a directive are candidates for
+            # "unparseable" — `# see tpulint: allow(...) syntax` is prose
+            if re.match(r"#\s*tpulint\s*:", text):
+                problems.append(Finding(
+                    rule="bad-suppression", path=path, line=i,
+                    message="unparseable tpulint suppression "
+                            "(expected `# tpulint: allow(<rule>) — <reason>`)",
+                    code=text.strip(),
+                ))
+            continue
+        rules = frozenset(
+            r.strip() for r in m.group(1).split(",") if r.strip()
+        )
+        reason = (m.group(2) or "").strip()
+        if not rules:
+            problems.append(Finding(
+                rule="bad-suppression", path=path, line=i,
+                message="suppression names no rule", code=text.strip(),
+            ))
+            continue
+        if not reason:
+            problems.append(Finding(
+                rule="bad-suppression", path=path, line=i,
+                message="suppression without a reason — the reason is "
+                        "mandatory (`# tpulint: allow(<rule>) — <why>`)",
+                code=text.strip(),
+            ))
+            continue
+        sup = _Suppression(i, rules, reason)
+        by_line[i] = sup
+        if standalone:
+            # standalone comment: also covers the next code line
+            for j in range(i + 1, len(lines) + 1):
+                nxt = lines[j - 1].strip()
+                if nxt and not nxt.startswith("#"):
+                    by_line.setdefault(j, sup)
+                    break
+    return by_line, problems
+
+
+# -- analysis ----------------------------------------------------------------
+
+def _rule_registry():
+    from . import rules
+
+    return rules.ALL_RULES
+
+
+def analyze_source(
+    src: str, path: str, select: set[str] | None = None
+) -> list[Finding]:
+    """All unsuppressed findings for one file's source text."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(
+            rule="syntax-error", path=path, line=e.lineno or 1,
+            message=f"file does not parse: {e.msg}",
+        )]
+    lines = src.splitlines()
+
+    def code_at(line: int) -> str:
+        return lines[line - 1].strip() if 0 < line <= len(lines) else ""
+
+    suppressions, findings = parse_suppressions(src, path)
+    for rule in _rule_registry():
+        if select is not None and rule.slug not in select:
+            continue
+        for f in rule.check(tree, src, path):
+            f = dataclasses.replace(f, code=f.code or code_at(f.line))
+            sup = suppressions.get(f.line)
+            if sup is not None and sup.covers(f.rule):
+                sup.used = True
+                continue
+            findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def analyze_file(path: str, select: set[str] | None = None) -> list[Finding]:
+    with tokenize.open(path) as f:
+        src = f.read()
+    return analyze_source(src, _rel(path), select)
+
+
+def _rel(path: str) -> str:
+    repo = os.path.dirname(os.path.dirname(_HERE))
+    abspath = os.path.abspath(path)
+    if abspath.startswith(repo + os.sep):
+        return os.path.relpath(abspath, repo)
+    return path
+
+
+def iter_python_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", "node_modules")
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def analyze_paths(
+    paths: list[str], select: set[str] | None = None
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(analyze_file(path, select))
+    return findings
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> list[dict]:
+    if not os.path.isfile(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return list(doc.get("findings", []))
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: list[dict]
+) -> tuple[list[Finding], list[dict]]:
+    """Split findings into (new, matched-baseline-entries-left-unmatched).
+
+    A baseline entry matches by (rule, path, stripped source line) —
+    line numbers are recorded for humans but deliberately not compared,
+    so edits elsewhere in a file never churn the baseline.  Multiset
+    semantics: N identical entries absorb at most N identical findings.
+    The second return value is the baseline entries that matched nothing
+    (stale entries — the finding was fixed; `--write-baseline` prunes
+    them)."""
+    pool: dict[tuple[str, str, str], int] = {}
+    for entry in baseline:
+        key = (entry.get("rule", ""), entry.get("path", ""),
+               (entry.get("code") or "").strip())
+        pool[key] = pool.get(key, 0) + 1
+    new: list[Finding] = []
+    for f in findings:
+        key = (f.rule, f.path, f.code.strip())
+        if pool.get(key, 0) > 0:
+            pool[key] -= 1
+        else:
+            new.append(f)
+    stale = []
+    for entry in baseline:
+        key = (entry.get("rule", ""), entry.get("path", ""),
+               (entry.get("code") or "").strip())
+        if pool.get(key, 0) > 0:
+            pool[key] -= 1
+            stale.append(entry)
+    return new, stale
+
+
+def write_baseline(
+    findings: list[Finding], path: str = DEFAULT_BASELINE
+) -> None:
+    doc = {
+        "comment": "tpulint grandfathered findings — burn this down. "
+                   "Matched by (rule, path, code); line is informational.",
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line, "code": f.code}
+            for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line))
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")

@@ -1,0 +1,76 @@
+"""CLI: `python -m tools.tpulint <paths...>`.
+
+Exit 0 = no unsuppressed, non-baselined findings; 1 = findings (each
+printed `path:line: [rule] message`); 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import (
+    DEFAULT_BASELINE,
+    analyze_paths,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from .rules import ALL_RULES, RULE_SLUGS
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpulint",
+        description="AST concurrency & contract analyzer "
+                    "(rules encode this repo's review-pass bug classes)",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories to analyze")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON (default: tools/tpulint/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (show every finding)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current unsuppressed findings as the baseline")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule slugs to run (default: all)")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.slug:22s} {rule.doc}")
+        return 0
+    if not args.paths:
+        p.error("no paths given")
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+        unknown = select - RULE_SLUGS
+        if unknown:
+            p.error(f"unknown rule(s): {', '.join(sorted(unknown))}")
+
+    findings = analyze_paths(args.paths, select)
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        print(f"baseline written: {len(findings)} findings -> {args.baseline}")
+        return 0
+    baseline = [] if args.no_baseline else load_baseline(args.baseline)
+    new, stale = apply_baseline(findings, baseline)
+    for f in new:
+        print(f.render())
+    grandfathered = len(findings) - len(new)
+    if new:
+        print(f"\ntpulint: {len(new)} finding(s) "
+              f"({grandfathered} baselined, {len(stale)} stale baseline "
+              "entries)")
+        return 1
+    print(f"tpulint clean ({grandfathered} baselined finding(s) remain"
+          + (f", {len(stale)} stale baseline entries — re-run with "
+             "--write-baseline to prune" if stale else "")
+          + ")")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""tpulint rule registry — one module per review-pass bug class."""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Callable
+
+from . import (
+    async_blocking,
+    lock_blocking,
+    metric_literal,
+    response_truthiness,
+    thread_lifecycle,
+    untracked_task,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    slug: str
+    check: Callable
+    doc: str
+
+
+def _rule(mod) -> Rule:
+    return Rule(
+        slug=mod.SLUG,
+        check=mod.check,
+        doc=(mod.__doc__ or "").strip().splitlines()[0],
+    )
+
+
+ALL_RULES: tuple[Rule, ...] = tuple(
+    _rule(m) for m in (
+        async_blocking,
+        lock_blocking,
+        response_truthiness,
+        untracked_task,
+        thread_lifecycle,
+        metric_literal,
+    )
+)
+
+RULE_SLUGS = frozenset(r.slug for r in ALL_RULES)

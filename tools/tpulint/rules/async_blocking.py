@@ -1,0 +1,49 @@
+"""Rule `async-blocking`: blocking calls reachable from `async def`.
+
+Historical bug class (PR 2 review pass 3, PR 8 review pass): `json.loads`
+of a multi-MB KV-index resync body ran directly in the router's
+`/kv/events` aiohttp handler, stalling every concurrent stream; the fix
+moved it behind `loop.run_in_executor`.  Same class: `time.sleep`, file
+`open`, tokenizer calls, `jax.device_get`, synchronous HTTP — anything
+that parks the one thread every coroutine shares.
+
+The rule flags blocking-set calls whose nearest enclosing function is
+`async def`.  Nested sync `def`s and lambdas are NOT flagged — they are
+this repo's executor-target idiom (`loop.run_in_executor(None, helper)`),
+and the helper itself is legal blocking code.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from .common import FunctionContextVisitor, blocking_reason, import_aliases
+
+SLUG = "async-blocking"
+
+
+class _Visitor(FunctionContextVisitor):
+    def __init__(self, aliases, path):
+        super().__init__()
+        self.aliases = aliases
+        self.path = path
+        self.findings: list[Finding] = []
+
+    def visit_Call(self, node: ast.Call):
+        if self.in_async:
+            reason = blocking_reason(node, self.aliases)
+            if reason is not None:
+                self.findings.append(Finding(
+                    rule=SLUG, path=self.path, line=node.lineno,
+                    message=f"{reason} — it runs on the event loop here; "
+                            "hop through loop.run_in_executor (or make it "
+                            "truly async)",
+                ))
+        self.generic_visit(node)
+
+
+def check(tree: ast.Module, src: str, path: str) -> list[Finding]:
+    v = _Visitor(import_aliases(tree), path)
+    v.visit(tree)
+    return v.findings

@@ -1,0 +1,155 @@
+"""Shared AST helpers for tpulint rules: import-alias resolution, dotted
+call-name extraction, and the blocking-call classifier both concurrency
+rules (async-blocking, lock-blocking) key off."""
+
+from __future__ import annotations
+
+import ast
+
+
+def import_aliases(tree: ast.Module) -> dict[str, str]:
+    """Local name → fully-qualified dotted origin, from every import in
+    the module (top-level and nested — function-local `import time` is
+    how half this repo imports it)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    aliases[alias.asname] = alias.name
+                else:
+                    root = alias.name.split(".")[0]
+                    aliases[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            if node.module is None or node.level:
+                continue  # relative imports resolve inside the repo itself
+            for alias in node.names:
+                local = alias.asname or alias.name
+                aliases[local] = f"{node.module}.{alias.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """`a.b.c` for a Name/Attribute chain, None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve(name: str | None, aliases: dict[str, str]) -> str | None:
+    """Rewrite the first segment of a dotted name through the module's
+    import aliases: `_time.sleep` → `time.sleep`, bare `loads` imported
+    from json → `json.loads`."""
+    if name is None:
+        return None
+    first, _, rest = name.partition(".")
+    origin = aliases.get(first)
+    if origin is None:
+        return name
+    return f"{origin}.{rest}" if rest else origin
+
+
+# Calls that block the calling thread for unbounded / I/O-scale time.
+# Curated to what this codebase actually does on its hot paths — the goal
+# is the review-pass bug classes, not a generic flake8 plugin.
+BLOCKING_EXACT = {
+    "time.sleep": "time.sleep blocks the thread",
+    "open": "file open() is blocking I/O",
+    "io.open": "file open() is blocking I/O",
+    "json.load": "json.load reads a file synchronously",
+    "json.loads": "json.loads of a large payload stalls the thread "
+                  "(the PR 2 multi-MB resync-body class)",
+    "pickle.load": "pickle.load reads a file synchronously",
+    "subprocess.run": "subprocess.run blocks until the child exits",
+    "subprocess.call": "subprocess.call blocks until the child exits",
+    "subprocess.check_call": "subprocess.check_call blocks",
+    "subprocess.check_output": "subprocess.check_output blocks",
+    "shutil.rmtree": "shutil.rmtree is bulk file I/O",
+    "shutil.copytree": "shutil.copytree is bulk file I/O",
+    "shutil.copy": "shutil.copy is file I/O",
+    "shutil.copy2": "shutil.copy2 is file I/O",
+    "shutil.move": "shutil.move is file I/O",
+    "requests.get": "synchronous HTTP",
+    "requests.post": "synchronous HTTP",
+    "requests.put": "synchronous HTTP",
+    "requests.delete": "synchronous HTTP",
+    "requests.head": "synchronous HTTP",
+    "requests.request": "synchronous HTTP",
+    "urllib.request.urlopen": "synchronous HTTP",
+    "socket.getaddrinfo": "blocking DNS resolution",
+    "socket.create_connection": "blocking connect",
+    "jax.device_get": "jax.device_get synchronizes with the device",
+}
+
+# attribute-tail matches (any receiver): device syncs the dotted-name
+# resolver can't see through a variable.
+BLOCKING_ATTRS = {
+    "block_until_ready": "block_until_ready synchronizes with the device",
+    "device_get": "device_get synchronizes with the device",
+}
+
+
+def blocking_reason(call: ast.Call, aliases: dict[str, str]) -> str | None:
+    """Why this call blocks, or None if it isn't in the blocking set."""
+    name = resolve(dotted_name(call.func), aliases)
+    if name is not None:
+        if name in BLOCKING_EXACT:
+            return BLOCKING_EXACT[name]
+        head = name.split(".")[0]
+        # any call THROUGH a tokenizer object (self.tokenizer(...),
+        # tokenizer.encode(...)): HF tokenization of a long prompt is a
+        # multi-ms CPU stall — the kv-index lookup paths learned this
+        if any("tokenizer" in seg.lower() for seg in name.split(".")[:-1]) \
+                or "tokenizer" in head.lower():
+            return "tokenizer call is CPU-bound (multi-ms on long prompts)"
+    if isinstance(call.func, ast.Attribute) and call.func.attr in BLOCKING_ATTRS:
+        return BLOCKING_ATTRS[call.func.attr]
+    return None
+
+
+def is_lockish(expr: ast.AST) -> str | None:
+    """The dotted name of a with-item that looks like a mutex, else None.
+
+    Matches `self._lock`, `self._fetch_lock`, `lock`, `self._locks[k]` —
+    anything whose terminal identifier contains "lock". Condition
+    variables and semaphores are out of scope (waiting on them is their
+    point)."""
+    if isinstance(expr, ast.Subscript):
+        expr = expr.value
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    tail = name.split(".")[-1].lower()
+    if "lock" in tail and "unlock" not in tail:
+        return name
+    return None
+
+
+class FunctionContextVisitor(ast.NodeVisitor):
+    """Base visitor tracking whether we're inside `async def` code that
+    runs ON the event loop.  Nested *sync* defs and lambdas are treated
+    as off-loop (they are this repo's executor-target idiom) and are NOT
+    descended into while the async flag is set."""
+
+    def __init__(self):
+        self.in_async = False
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        prev, self.in_async = self.in_async, True
+        self.generic_visit(node)
+        self.in_async = prev
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        prev, self.in_async = self.in_async, False
+        self.generic_visit(node)
+        self.in_async = prev
+
+    def visit_Lambda(self, node: ast.Lambda):
+        prev, self.in_async = self.in_async, False
+        self.generic_visit(node)
+        self.in_async = prev

@@ -1,0 +1,97 @@
+"""Rule `lock-blocking`: a lock held across blocking I/O or device sync.
+
+Historical bug class (PR 8 review pass): `DiskKVTier` read multi-MB
+chunk files while holding its tier lock, so every step-thread probe and
+offload stalled behind a disk read — the synchronous stall the hydration
+planner exists to remove.  The fix moved file I/O outside the lock and
+let an eviction racing a read degrade to the corrupt-miss path.
+
+The rule flags calls from the blocking set lexically inside a
+`with <lock>:` / `async with <lock>:` body, where <lock> is anything
+whose terminal identifier contains "lock" (`self._lock`,
+`self._fetch_lock`, `self._locks[key]`...).  Nested function bodies are
+skipped — they don't run while the lock is held.  Awaits under an
+asyncio lock are NOT flagged: serializing async work is what an asyncio
+lock is for; the hazard is a *synchronous* stall that freezes the loop
+(or every other thread contending the mutex) for the lock-hold duration.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from .common import blocking_reason, import_aliases, is_lockish
+
+SLUG = "lock-blocking"
+
+
+class _LockBodyVisitor(ast.NodeVisitor):
+    """Collect blocking calls inside one lock-guarded body."""
+
+    def __init__(self, aliases, path, lock_name, findings):
+        self.aliases = aliases
+        self.path = path
+        self.lock_name = lock_name
+        self.findings = findings
+
+    # code inside a nested def/lambda does not execute under the lock
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def _nested_with(self, node):
+        # a nested lock-guarded with is the outer visitor's job — scanning
+        # it here too would double-report every call under both lock names
+        if any(is_lockish(i.context_expr) for i in node.items):
+            return
+        self.generic_visit(node)
+
+    visit_With = _nested_with
+    visit_AsyncWith = _nested_with
+
+    def visit_Call(self, node: ast.Call):
+        reason = blocking_reason(node, self.aliases)
+        if reason is not None:
+            self.findings.append(Finding(
+                rule=SLUG, path=self.path, line=node.lineno,
+                message=f"{reason} — while holding {self.lock_name}; "
+                        "move the I/O outside the lock (copy refs under "
+                        "the lock, do the slow work after release)",
+            ))
+        self.generic_visit(node)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, aliases, path):
+        self.aliases = aliases
+        self.path = path
+        self.findings: list[Finding] = []
+
+    def _handle_with(self, node):
+        lock_names = [
+            name for item in node.items
+            if (name := is_lockish(item.context_expr)) is not None
+        ]
+        if lock_names:
+            body_visitor = _LockBodyVisitor(
+                self.aliases, self.path, lock_names[0], self.findings
+            )
+            for stmt in node.body:
+                body_visitor.visit(stmt)
+        # still recurse: nested withs, and non-lock withs containing locks
+        self.generic_visit(node)
+
+    visit_With = _handle_with
+    visit_AsyncWith = _handle_with
+
+
+def check(tree: ast.Module, src: str, path: str) -> list[Finding]:
+    v = _Visitor(import_aliases(tree), path)
+    v.visit(tree)
+    return v.findings

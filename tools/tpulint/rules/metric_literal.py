@@ -1,0 +1,66 @@
+"""Rule `metric-literal`: `tpu:` metric-name literals outside the contract.
+
+Historical bug class (PR 5 satellite, ongoing): every metric name lives
+in `metrics_contract.py`, and `tools/check_metrics_contract.py` validates
+exporters, dashboards, rules, and docs against it — but nothing stopped
+*source* from minting `tpu:something` strings directly, bypassing the
+contract (the PR 5 audit found 4 orphaned names that had drifted exactly
+this way before the checker existed).  This rule closes the source side:
+a string literal that IS a metric name (full-string match of
+`tpu:<name>`), or an f-string that starts composing one, must not appear
+outside `metrics_contract.py` — import the constant instead.
+
+Prose that merely *mentions* a name (help text, docstrings, comments)
+does not match: the pattern must consume the entire literal.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .. import Finding
+
+SLUG = "metric-literal"
+
+CONTRACT_BASENAME = "metrics_contract.py"
+
+_METRIC_NAME_RE = re.compile(r"\Atpu:[a-z0-9_]+(?::[a-z0-9_]+)*\Z")
+
+
+def check(tree: ast.Module, src: str, path: str) -> list[Finding]:
+    if os.path.basename(path) == CONTRACT_BASENAME:
+        return []
+    findings: list[Finding] = []
+    fstring_parts = {
+        id(v)
+        for node in ast.walk(tree) if isinstance(node, ast.JoinedStr)
+        for v in node.values
+    }
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and id(node) not in fstring_parts:
+            if _METRIC_NAME_RE.match(node.value):
+                findings.append(Finding(
+                    rule=SLUG, path=path, line=node.lineno,
+                    message=f"metric-name literal {node.value!r} outside "
+                            "metrics_contract.py — import the contract "
+                            "constant so the drift checker can see it",
+                ))
+        elif isinstance(node, ast.JoinedStr):
+            first = node.values[0] if node.values else None
+            if (
+                isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                # name charset only — f"tpu:{x} looks stale" is prose, not
+                # a composed metric name
+                and re.fullmatch(r"tpu:[a-z0-9_:]*", first.value)
+            ):
+                findings.append(Finding(
+                    rule=SLUG, path=path, line=node.lineno,
+                    message="f-string composes a tpu: metric name outside "
+                            "metrics_contract.py — build names from the "
+                            "contract constants instead",
+                ))
+    return findings

@@ -1,0 +1,156 @@
+"""Rule `response-truthiness`: truthiness test on a Response-or-None helper.
+
+Historical bug class (PR 2 satellite): aiohttp 3.11 made `web.Response`
+a MutableMapping, and an *empty* mapping is falsy — so every
+`if err := self._check_request(...):` guard in engine/server.py silently
+passed and the refusal responses were never returned.  The fix was
+`is not None` everywhere a helper returns `web.Response | None`.
+
+The rule finds, per module, every function that can return BOTH an
+aiohttp response object (`web.Response(...)`, `web.json_response(...)`,
+`web.StreamResponse(...)` — or declares a `Response... | None`-shaped
+return annotation) AND `None`, then flags truthiness tests on their call
+results: `if helper(...):`, `if err := helper(...):`, `if not x` /
+`while x` / boolean operands where `x` was assigned from such a call.
+`is None` / `is not None` comparisons are the corrected form and never
+match.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from .common import dotted_name
+
+SLUG = "response-truthiness"
+
+_RESPONSE_FACTORIES = {"Response", "json_response", "StreamResponse"}
+
+
+def _is_response_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return name is not None and name.split(".")[-1] in _RESPONSE_FACTORIES
+
+
+def _annotation_is_optional_response(returns: ast.AST | None) -> bool:
+    if returns is None:
+        return False
+    text = ast.unparse(returns)
+    return "Response" in text and ("None" in text or "Optional" in text)
+
+
+def _returns_response_or_none(fn) -> bool:
+    if _annotation_is_optional_response(fn.returns):
+        return True
+    saw_response = saw_none = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return):
+            if node.value is None or (
+                isinstance(node.value, ast.Constant) and node.value.value is None
+            ):
+                saw_none = True
+            elif _is_response_call(node.value):
+                saw_response = True
+    return saw_response and saw_none
+
+
+def _suspect_functions(tree: ast.Module) -> set[str]:
+    return {
+        node.name
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and _returns_response_or_none(node)
+    }
+
+
+def _call_of_suspect(node: ast.AST, suspects: set[str]) -> bool:
+    if isinstance(node, ast.Await):
+        node = node.value
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return name is not None and name.split(".")[-1] in suspects
+
+
+class _FunctionScan(ast.NodeVisitor):
+    """One function's truthiness tests, with simple local-assignment
+    tracking (`x = helper(...)` then `if x:`)."""
+
+    def __init__(self, suspects, path, findings):
+        self.suspects = suspects
+        self.path = path
+        self.findings = findings
+        self.assigned: set[str] = set()
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                if _call_of_suspect(node.value, self.suspects):
+                    self.assigned.add(tgt.id)
+                else:
+                    self.assigned.discard(tgt.id)
+        self.generic_visit(node)
+
+    def _flag(self, node: ast.AST, how: str):
+        self.findings.append(Finding(
+            rule=SLUG, path=self.path, line=node.lineno,
+            message=f"truthiness test on a web.Response-or-None {how} — "
+                    "an empty Response is FALSY (aiohttp MutableMapping); "
+                    "compare `is not None`",
+        ))
+
+    def _check_test(self, test: ast.AST):
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            self._check_test(test.operand)
+            return
+        if isinstance(test, ast.BoolOp):
+            for value in test.values:
+                self._check_test(value)
+            return
+        if isinstance(test, ast.NamedExpr):
+            if _call_of_suspect(test.value, self.suspects):
+                self._flag(test, "helper result (walrus)")
+            return
+        if _call_of_suspect(test, self.suspects):
+            self._flag(test, "helper call")
+        elif isinstance(test, ast.Name) and test.id in self.assigned:
+            self._flag(test, "helper result")
+
+    def visit_If(self, node):
+        self._check_test(node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_test(node.test)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._check_test(node.test)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        self._check_test(node.test)
+        self.generic_visit(node)
+
+    # nested functions get their own scan (fresh assignment scope)
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+
+def check(tree: ast.Module, src: str, path: str) -> list[Finding]:
+    suspects = _suspect_functions(tree)
+    if not suspects:
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan = _FunctionScan(suspects, path, findings)
+            for stmt in node.body:
+                scan.visit(stmt)
+    return findings

@@ -1,0 +1,145 @@
+"""Rule `thread-lifecycle`: background threads without a stop path, and
+bare `except:` that swallows exceptions.
+
+Historical bug class (PR 2 review pass): `_bg_compile_job` threads
+leaked past `runner.shutdown()` and stole CPU from the next test module
+— the fix added a stop event the job checks in its idle-gate loop, set
+by `shutdown()`.  Every long-lived thread in this stack (publisher,
+fetcher, watcher, compile job) now owns a registered stop/shutdown path;
+this rule keeps the next one honest.
+
+Two checks:
+
+* `threading.Thread(...)` constructed inside a class that exposes no
+  stop-shaped method (`stop`/`shutdown`/`close`/`join`/`cancel`/
+  `terminate`/`__exit__`/`__aexit__`/`stop_all`/`aclose`/`drain`), or at
+  module/function scope with no `.join(...)` call in the same scope — a
+  thread nobody can stop.
+* a bare `except:` whose handler does not re-raise — in a daemon thread
+  this silently eats even SystemExit/KeyboardInterrupt and the thread
+  spins on as a zombie; everywhere else it still hides the failure.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from .common import dotted_name, import_aliases, resolve
+
+SLUG = "thread-lifecycle"
+
+_STOP_METHODS = {
+    "stop", "shutdown", "close", "join", "cancel", "terminate",
+    "__exit__", "__aexit__", "stop_all", "aclose", "drain",
+}
+
+
+def _is_thread_ctor(call: ast.AST, aliases: dict[str, str]) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    name = resolve(dotted_name(call.func), aliases)
+    return name in ("threading.Thread", "threading.Timer")
+
+
+def _is_thread_join(call: ast.Call) -> bool:
+    """`t.join()` / `t.join(5)` / `t.join(timeout=...)` — and NOT a string
+    `", ".join(parts)`, which would otherwise make any class with a
+    log-line join look like it has a stop path."""
+    func = call.func
+    if not (isinstance(func, ast.Attribute) and func.attr == "join"):
+        return False
+    if isinstance(func.value, ast.Constant) and isinstance(func.value.value, str):
+        return False  # literal-string receiver: definitely str.join
+    if call.keywords:
+        return all(kw.arg == "timeout" for kw in call.keywords) and not call.args
+    if not call.args:
+        return True
+    # one positional arg: thread.join takes only a numeric timeout —
+    # anything else (an iterable) is a string join
+    return len(call.args) == 1 and isinstance(call.args[0], ast.Constant) \
+        and isinstance(call.args[0].value, (int, float))
+
+
+def _class_has_stop_path(cls: ast.ClassDef) -> bool:
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in _STOP_METHODS:
+            return True
+    # a thread-shaped `.join(...)` anywhere in the class counts: some
+    # classes scope the whole thread lifetime inside one method
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and _is_thread_join(node):
+            return True
+    return False
+
+
+def _scope_joins(scope: ast.AST) -> bool:
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and _is_thread_join(node):
+            return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, aliases, path):
+        self.aliases = aliases
+        self.path = path
+        self.findings: list[Finding] = []
+        self.class_stack: list[ast.ClassDef] = []
+        self.func_stack: list[ast.AST] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.class_stack.append(node)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node):
+        self.func_stack.append(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call):
+        if _is_thread_ctor(node, self.aliases):
+            if self.class_stack:
+                ok = _class_has_stop_path(self.class_stack[-1])
+                where = f"class {self.class_stack[-1].name}"
+            elif self.func_stack:
+                ok = _scope_joins(self.func_stack[-1])
+                where = "this function"
+            else:
+                ok = False
+                where = "module scope"
+            if not ok:
+                self.findings.append(Finding(
+                    rule=SLUG, path=self.path, line=node.lineno,
+                    message=f"thread started with no stop path in {where} — "
+                            "register a shutdown (stop event checked by the "
+                            "loop + join) so tests and drain can reclaim it",
+                ))
+        self.generic_visit(node)
+
+    def visit_Try(self, node: ast.Try):
+        for handler in node.handlers:
+            if handler.type is None:
+                reraises = any(
+                    isinstance(n, ast.Raise) for n in ast.walk(handler)
+                )
+                if not reraises:
+                    self.findings.append(Finding(
+                        rule=SLUG, path=self.path, line=handler.lineno,
+                        message="bare `except:` without re-raise swallows "
+                                "EVERYTHING incl. SystemExit — in a daemon "
+                                "thread that's a silent zombie; catch "
+                                "Exception (and log it) instead",
+                    ))
+        self.generic_visit(node)
+
+
+def check(tree: ast.Module, src: str, path: str) -> list[Finding]:
+    v = _Visitor(import_aliases(tree), path)
+    v.visit(tree)
+    return v.findings

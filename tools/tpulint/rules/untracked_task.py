@@ -1,0 +1,52 @@
+"""Rule `untracked-task`: `asyncio.create_task` result thrown away.
+
+Historical bug class (PR 2 review pass 2): the prefix-aware trie's scrub
+tasks were held only by weak references, so the garbage collector could
+reap a scrub mid-flight — the event loop keeps only a weak set of
+scheduled tasks, and a task nobody strongly references can vanish before
+it runs (CPython asyncio docs call this out explicitly).  The fix stored
+strong refs for the task's lifetime.
+
+The rule flags `asyncio.create_task(...)`, `asyncio.ensure_future(...)`,
+and `<loop>.create_task(...)` used as a bare expression statement — the
+returned Task object is dropped on the floor.  Assigning, appending,
+returning, or awaiting the result all pass (whether the chosen container
+keeps the ref long enough is the reviewer's judgement; dropping it is
+mechanically wrong).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from .common import dotted_name, import_aliases, resolve
+
+SLUG = "untracked-task"
+
+
+def _is_spawn(call: ast.AST, aliases: dict[str, str]) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    name = resolve(dotted_name(call.func), aliases)
+    if name is None:
+        return False
+    if name in ("asyncio.create_task", "asyncio.ensure_future"):
+        return True
+    # loop.create_task(...) through any receiver
+    return name.split(".")[-1] == "create_task" and len(name.split(".")) > 1
+
+
+def check(tree: ast.Module, src: str, path: str) -> list[Finding]:
+    aliases = import_aliases(tree)
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Expr) and _is_spawn(node.value, aliases):
+            findings.append(Finding(
+                rule=SLUG, path=path, line=node.lineno,
+                message="create_task result is not stored — the event loop "
+                        "holds tasks only weakly, so GC can cancel this "
+                        "mid-flight; keep a strong reference (and discard "
+                        "it on completion)",
+            ))
+    return findings

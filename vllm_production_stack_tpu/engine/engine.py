@@ -125,7 +125,7 @@ class LLMEngine:
             if config.model.sliding_window <= config.scheduler.decode_window:
                 raise ValueError(
                     f"sliding_window ({config.model.sliding_window}) must "
-                    f"exceed decode_window "
+                    "exceed decode_window "
                     f"({config.scheduler.decode_window})"
                 )
             if config.parallel.sequence_parallel_size > 1:

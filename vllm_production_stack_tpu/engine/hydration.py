@@ -42,7 +42,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from .. import metrics_contract as mc
 from ..utils.logging import init_logger

@@ -116,7 +116,7 @@ def ship_kv_device(
     AsyncEngine._lock (async_engine.py kv_import/kv_export)."""
     if src_engine.model_fingerprint != dst_engine.model_fingerprint:
         raise ValueError(
-            f"KV fingerprint mismatch: sender "
+            "KV fingerprint mismatch: sender "
             f"{src_engine.model_fingerprint!r} != receiver "
             f"{dst_engine.model_fingerprint!r} — refusing foreign KV"
         )
@@ -365,7 +365,7 @@ def ship_kv_device_crossproc(
                 others *= size
         if tp_size != n_shard or others != 1:
             raise NotImplementedError(
-                f"cross-process ship needs a pure-tp engine mesh with "
+                "cross-process ship needs a pure-tp engine mesh with "
                 f"tp == local devices (got mesh {mesh_shape} over "
                 f"{n_shard} devices)"
             )

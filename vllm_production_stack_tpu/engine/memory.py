@@ -11,7 +11,6 @@ from __future__ import annotations
 import math
 
 import jax
-import numpy as np
 
 from ..utils.logging import init_logger
 from .config import CacheConfig, ModelConfig, ParallelConfig
@@ -156,7 +155,7 @@ def derive_num_blocks(
     if budget < 2 * per_block * max(1, pp):
         raise ValueError(
             f"model weights ({param_bytes(model, tp, pp) / 1024**3:.2f} GiB/device) "
-            f"+ reserve leave no room for a KV pool in "
+            "+ reserve leave no room for a KV pool in "
             f"{cache.hbm_utilization:.0%} of {hbm / 1024**3:.2f} GiB HBM — "
             f"raise hbm_utilization, shard wider (tp={tp}), or shrink the model"
         )

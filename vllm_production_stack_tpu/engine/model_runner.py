@@ -18,7 +18,6 @@ rows write to the reserved null page (block 0) and their samples are dropped.
 from __future__ import annotations
 
 import functools
-import logging
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor

@@ -15,7 +15,6 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
-import logging
 import time
 
 import numpy as np
@@ -127,6 +126,7 @@ class EngineServer:
         # flushed and the engine deregisters from its controller
         self.drain_timeout_s = drain_timeout_s
         self._drain_task: asyncio.Task | None = None
+        self._exit_task: asyncio.Task | None = None
         self._drained = asyncio.Event()
         # OpenAI system_fingerprint: identifies the serving configuration
         # whose outputs a seed reproduces — our model fingerprint (weights
@@ -1222,7 +1222,10 @@ class EngineServer:
                 await self._drained.wait()
                 raise web.GracefulExit()
 
-            loop.create_task(_exit_when_drained())
+            # strong ref: the loop holds tasks only weakly, and a GC'd
+            # exit task would leave a SIGTERM'd pod running forever
+            if self._exit_task is None or self._exit_task.done():
+                self._exit_task = loop.create_task(_exit_when_drained())
 
     async def _do_drain(self, exit_after: bool) -> None:
         """Finish in-flight streams (bounded), flush the KV event log,

@@ -27,8 +27,8 @@ import sys
 
 import grpc
 
-from ..router.discovery import Endpoint
 from ..router.routing import RoutingContext, make_policy
+from ..utils.jsonio import loads_off_loop
 from ..utils.logging import init_logger
 from ..utils.native import _build_dir
 
@@ -217,7 +217,10 @@ class EppService:
                     )
                     continue
                 try:
-                    body = json.loads(b"".join(body_chunks) or b"{}")
+                    # large prompt bodies parse off the gRPC event loop —
+                    # a multi-MB json.loads here stalls every concurrent
+                    # ext-proc stream (the PR 2 resync-body bug class)
+                    body = await loads_off_loop(b"".join(body_chunks) or b"{}")
                 except json.JSONDecodeError:
                     body = {}
                 if not isinstance(body, dict):

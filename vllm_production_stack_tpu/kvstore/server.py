@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import json
 from collections import OrderedDict
 from dataclasses import dataclass
 

@@ -525,7 +525,6 @@ def decode_window_step(
     loop-invariant history gather out of the window loop, `hists` carries the
     contiguous per-layer (hist_k, hist_v) and the pool is not touched here
     (ops/attention.py:attention_with_hist). Returns (hidden (B, h), staged')."""
-    hd = cfg.head_dim
     window = staged.shape[2]
     x = _embed(cfg, params, token_ids)[:, None]  # (B, 1, h)
     # staged slot w is attendable once written: w <= k

@@ -160,7 +160,7 @@ def _from_hf_config(path: str) -> dict:
         if hf.get("mlp_only_layers") or (hf.get("decoder_sparse_step", 1)
                                          != 1):
             raise ValueError(
-                f"qwen3moe with dense layers interleaved is not "
+                "qwen3moe with dense layers interleaved is not "
                 f"implemented ({path})"
             )
         moe = dict(

@@ -67,14 +67,18 @@ class DownloaderSidecar:
         key = _spec_key(spec)
         async with lock:
             marker = os.path.join(target, _MARKER)
-            if os.path.exists(marker):
-                if open(marker).read() == key:
-                    return target  # idempotent: same source already landed
-                # same target, DIFFERENT source: re-download fresh
-                shutil.rmtree(target)
-            os.makedirs(target, exist_ok=True)
-            source = spec.get("source", "hf")
             loop = asyncio.get_running_loop()
+            # marker check + stale-target rmtree are file I/O (rmtree of a
+            # multi-GB model dir takes seconds): off the event loop — the
+            # per-target asyncio lock stays held across the await, which
+            # is its job (serialize work on one target dir), but health
+            # probes and downloads for OTHER targets keep flowing
+            fresh = await loop.run_in_executor(
+                None, self._prepare_target, target, marker, key
+            )
+            if not fresh:
+                return target  # idempotent: same source already landed
+            source = spec.get("source", "hf")
             if source == "local":
                 await loop.run_in_executor(
                     None, self._copy_local, spec["path"], target
@@ -92,10 +96,26 @@ class DownloaderSidecar:
                 )
             else:
                 raise ValueError(f"unknown source {source!r}")
-            with open(marker, "w") as f:
-                f.write(key)
+            await loop.run_in_executor(None, self._write_marker, marker, key)
             logger.info("downloaded %s -> %s", spec, target)
             return target
+
+    @staticmethod
+    def _prepare_target(target: str, marker: str, key: str) -> bool:
+        """Executor-side: True iff the target needs (re-)downloading.
+        A marker for a DIFFERENT source wipes the target first."""
+        if os.path.exists(marker):
+            with open(marker) as f:
+                if f.read() == key:
+                    return False
+            shutil.rmtree(target)
+        os.makedirs(target, exist_ok=True)
+        return True
+
+    @staticmethod
+    def _write_marker(marker: str, key: str) -> None:
+        with open(marker, "w") as f:
+            f.write(key)
 
     @staticmethod
     def _copy_local(src: str, target: str) -> None:
@@ -114,14 +134,22 @@ class DownloaderSidecar:
         # basename of the URL PATH — query strings (presigned URLs) must not
         # leak into the on-disk filename
         name = os.path.basename(urlparse(url).path) or "download"
+        loop = asyncio.get_running_loop()
         async with aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=600)
         ) as sess:
             async with sess.get(url) as resp:
                 resp.raise_for_status()
-                with open(os.path.join(target, name), "wb") as f:
+                # open + per-chunk writes are disk I/O: keep them off the
+                # event loop so a slow volume can't stall health probes
+                f = await loop.run_in_executor(
+                    None, open, os.path.join(target, name), "wb"
+                )
+                try:
                     async for chunk in resp.content.iter_chunked(1 << 20):
-                        f.write(chunk)
+                        await loop.run_in_executor(None, f.write, chunk)
+                finally:
+                    await loop.run_in_executor(None, f.close)
 
     @staticmethod
     def _fetch_s3(uri: str, target: str) -> None:

@@ -85,7 +85,7 @@ class K8sClient:
 
     def _crd(self, plural: str, name: str = "") -> str:
         p = (
-            f"/apis/production-stack.tpu.ai/v1alpha1/namespaces/"
+            "/apis/production-stack.tpu.ai/v1alpha1/namespaces/"
             f"{self.namespace}/{plural}"
         )
         return f"{p}/{name}" if name else p
@@ -143,6 +143,8 @@ class K8sClient:
                     del buf[: nl + 1]
                     if not line:
                         continue
+                    # tpulint: allow(async-blocking) — one watch event per
+                    # line, KB-scale by apiserver construction
                     event = json.loads(line)
                     if event.get("type") == "ERROR":
                         status = event.get("object", {})
@@ -205,7 +207,7 @@ class K8sClient:
 
     def leases(self, name: str = "") -> str:
         p = (
-            f"/apis/coordination.k8s.io/v1/namespaces/"
+            "/apis/coordination.k8s.io/v1/namespaces/"
             f"{self.namespace}/leases"
         )
         return f"{p}/{name}" if name else p

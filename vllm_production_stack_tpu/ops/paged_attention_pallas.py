@@ -62,14 +62,13 @@ def _decode_kernel(
     q = q_ref[0].astype(jnp.float32)  # (nh, D)
 
     @pl.when(j == 0)
-    def _():
+    def _init_acc():
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def flash_update(scores, values):
         """scores: (nh, S) f32 already masked; values: (S, kvh, D)."""
-        s_len = scores.shape[1]
         m_prev, l_prev = m_ref[:], l_ref[:]
         m_cur = jnp.max(scores, axis=1, keepdims=True)  # (nh, 1)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -96,7 +95,7 @@ def _decode_kernel(
         )
 
     @pl.when(j < num_pages)
-    def _():
+    def _visit_page():
         k_page = kv_ref[0, 0].astype(jnp.float32)  # (bs, kvh, D)
         v_page = kv_ref[1, 0]
         # token positions covered by this page slot
@@ -120,7 +119,7 @@ def _decode_kernel(
         flash_update(scores, v_page)
 
     @pl.when(j == num_pages)
-    def _():
+    def _finalize():
         w = staged_k_ref.shape[0]
         k_st = staged_k_ref[:, 0].astype(jnp.float32)  # (W, kvh, D)
         v_st = staged_v_ref[:, 0]
@@ -178,7 +177,7 @@ def _hist_kernel(
     q = q_ref[0]  # (nh, D) native dtype; dots accumulate f32
 
     @pl.when(j == 0)
-    def _():
+    def _init_acc():
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
@@ -208,7 +207,7 @@ def _hist_kernel(
         )
 
     @pl.when(j < num_chunks)
-    def _():
+    def _visit_chunk():
         k_chunk = k_ref[0]  # (C, kvh, D)
         v_chunk = v_ref[0]
         pos = j * chunk + jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
@@ -229,7 +228,7 @@ def _hist_kernel(
         flash_update(scores, v_chunk)
 
     @pl.when(j == num_chunks)
-    def _():
+    def _finalize():
         w = staged_k_ref.shape[0]
         k_st = staged_k_ref[:, 0]  # (W, kvh, D)
         v_st = staged_v_ref[:, 0]
@@ -300,7 +299,7 @@ def _prefill_kernel(
     qpk = nh // num_kv_heads
 
     @pl.when(j == 0)
-    def _():
+    def _init_acc():
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
@@ -324,7 +323,7 @@ def _prefill_kernel(
     )
 
     @pl.when(page_live)
-    def _():
+    def _visit_live_page():
         k_page = kv_ref[0, 0].astype(jnp.float32)  # (bs, kvh, D)
         v_page = kv_ref[1, 0].astype(jnp.float32)
         for h in range(nh):
@@ -349,7 +348,7 @@ def _prefill_kernel(
             )
 
     @pl.when(j == num_pages - 1)
-    def _():
+    def _finalize():
         for h in range(nh):
             r0, r1 = h * tt, (h + 1) * tt
             # padding rows attend nothing (ctx 0) — l stays 0; the max

@@ -18,6 +18,7 @@ import uuid
 
 from aiohttp import web
 
+from ..utils.jsonio import loads_off_loop
 from ..utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -145,7 +146,9 @@ class BatchService:
                 continue
             item: dict | None = None
             try:
-                parsed = json.loads(line)
+                # a batch line is one full OpenAI request body — parse
+                # large ones off the loop like the live request path
+                parsed = await loads_off_loop(line)
                 item = parsed if isinstance(parsed, dict) else None
                 if item is None:
                     raise ValueError("batch line is not a JSON object")

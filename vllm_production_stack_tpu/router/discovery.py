@@ -357,6 +357,9 @@ class KubernetesDiscovery(ServiceDiscovery):
                         async for line in resp.content:
                             if not line.strip():
                                 continue
+                            # tpulint: allow(async-blocking) — one watch
+                            # event per line, KB-scale by apiserver
+                            # construction
                             ev = json.loads(line)
                             await self._on_event(
                                 sess, ev.get("type", ""), ev.get("object", {})

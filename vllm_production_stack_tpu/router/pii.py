@@ -16,6 +16,7 @@ from dataclasses import dataclass
 
 from aiohttp import web
 
+from ..utils.jsonio import loads_off_loop
 from ..utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -132,7 +133,7 @@ class PIIMiddleware:
         """Returns a 400 response when PII is found, else None."""
         raw = await request.read()
         try:
-            body = json.loads(raw)
+            body = await loads_off_loop(raw)
         except json.JSONDecodeError:
             return None
         texts = []

@@ -27,6 +27,7 @@ from ..fleet import (
 )
 from ..qos.gate import STAMP_HEADERS, TENANT_REQUEST_KEY
 from ..tracing import NULL_TRACE, TRACEPARENT_HEADER
+from ..utils.jsonio import loads_off_loop
 from ..utils.logging import init_logger
 from .routing import DisaggregatedPrefillPolicy, RoutingContext, qps_min_url
 
@@ -274,7 +275,9 @@ class RequestService:
     async def _route_json(self, request: web.Request) -> web.StreamResponse:
         raw = await request.read()
         try:
-            body = json.loads(raw) if raw else {}
+            # multi-MB prompt bodies parse off the event loop (jsonio) —
+            # an inline json.loads here stalls every concurrent stream
+            body = await loads_off_loop(raw) if raw else {}
         except json.JSONDecodeError:
             return web.json_response(
                 {"error": {"message": "request body is not valid JSON"}},
@@ -776,7 +779,7 @@ class RequestService:
                 if cacheable and upstream.status == 200:
                     try:
                         await self.state.semantic_cache.store(
-                            body, json.loads(bytes(full))
+                            body, await loads_off_loop(bytes(full))
                         )
                     except (json.JSONDecodeError, UnicodeDecodeError):
                         pass

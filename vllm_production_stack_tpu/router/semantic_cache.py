@@ -15,6 +15,7 @@ import time
 import numpy as np
 from aiohttp import web
 
+from ..utils.jsonio import loads_off_loop
 from ..utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -192,7 +193,7 @@ class SemanticCache:
         cache (a cached body can't replay a stream faithfully)."""
         raw = await request.read()
         try:
-            body = json.loads(raw)
+            body = await loads_off_loop(raw)
         except json.JSONDecodeError:
             return None
         if body.get("stream"):

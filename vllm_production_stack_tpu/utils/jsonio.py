@@ -1,0 +1,31 @@
+"""Event-loop-safe JSON parsing.
+
+`json.loads` of a request/response body runs on the aiohttp event loop
+wherever it's called from a handler — for a multi-MB prompt payload that
+is a multi-ms stall every concurrent stream shares (the bug class the
+PR 2 review pass fixed by hand in the /kv/events handlers, and that
+tpulint's `async-blocking` rule now flags mechanically).  This helper is
+the sanctioned escape: small payloads parse inline (an executor hop
+costs more than the parse), large ones hop to the default executor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+# Below this, the parse is cheaper than the executor round-trip; above,
+# the loop stall dominates.  64 KiB ≈ a 16k-token prompt.
+OFFLOAD_BYTES = 64 * 1024
+
+
+async def loads_off_loop(raw: bytes | bytearray | str):
+    """`json.loads(raw)`, hopped off the event loop when `raw` is large.
+
+    Raises `json.JSONDecodeError` exactly like the inline form."""
+    if len(raw) <= OFFLOAD_BYTES:
+        # tpulint: allow(async-blocking) — sub-64KiB parse is cheaper than
+        # the executor round-trip; large payloads take the branch below
+        return json.loads(raw)
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, json.loads, raw)
