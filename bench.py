@@ -1598,6 +1598,10 @@ async def _fleet_bench() -> dict:
                 "--router-replica-id", replica,
                 "--fleet-report-url", controller_url,
                 "--fleet-report-interval", "0.25",
+                # this phase measures the UNSCALED baseline (the N-way
+                # bucket-split over-admission PR 9 quantified); the
+                # fleet_scale phase proves the budget-scaling fix
+                "--fleet-budget-scaling", "off",
                 "--breaker-failure-threshold", "0",
             ]
             if policy == "session":
@@ -1925,6 +1929,585 @@ def _phase_fleet_main() -> None:
     print(json.dumps({"fleet": result}), flush=True)
 
 
+async def _fleet_scale_client(spec: dict) -> dict:
+    """One load-generator process (bench.py --phase fleet_scale_client):
+    closed-loop or paced completions, or long-hold SSE streams, against a
+    list of router URLs. Separate OS processes so the CLIENT is never the
+    serialization point when measuring multi-router aggregate req/s."""
+    import asyncio
+
+    import aiohttp
+
+    from vllm_production_stack_tpu.utils.system import raise_fd_limit
+
+    raise_fd_limit(200_000)
+    routers = spec["routers"]
+    mode = spec.get("mode", "throughput")
+    seconds = float(spec.get("seconds", 6.0))
+    conc = int(spec.get("concurrency", 32))
+    prefix = spec.get("session_prefix", "s")
+    body_base = {
+        "model": spec.get("model", "tiny"),
+        "prompt": "hello fleet",
+        "max_tokens": int(spec.get("max_tokens", 1)),
+    }
+    if spec.get("tokens_per_sec"):
+        # fake-engine pacing knob: slow token gaps = long-held streams
+        body_base["tokens_per_sec"] = spec["tokens_per_sec"]
+    headers_base = {}
+    if spec.get("auth"):
+        headers_base["Authorization"] = f"Bearer {spec['auth']}"
+    # "errors" = anything that wasn't a 200/429 INCLUDING client-side
+    # transport faults; "server_5xx" counts only actual 5xx statuses so
+    # the outage drill's "kept serving" claim isn't contradicted by a
+    # load-client connection blip
+    counts = {"completed": 0, "throttled": 0, "errors": 0, "server_5xx": 0}
+    retry_after: list[str] = []
+
+    conn = aiohttp.TCPConnector(limit=0)
+    timeout = aiohttp.ClientTimeout(
+        total=None, sock_connect=60, sock_read=180
+    )
+    async with aiohttp.ClientSession(
+        connector=conn, timeout=timeout
+    ) as sess:
+        async def stream_worker(i: int) -> None:
+            url = routers[i % len(routers)]
+            try:
+                async with sess.post(
+                    url + "/v1/completions",
+                    json={**body_base, "stream": True},
+                    headers={**headers_base, "x-user-id": f"{prefix}-{i}"},
+                ) as r:
+                    async for _ in r.content:
+                        pass
+                    counts["completed" if r.status == 200 else "errors"] += 1
+            except Exception:
+                counts["errors"] += 1
+
+        async def loop_worker(i: int) -> None:
+            url = routers[i % len(routers)]
+            sid = f"{prefix}-{i}"
+            paced = spec.get("paced_rps")
+            interval = (1.0 / paced) if paced else 0.0
+            t_end = time.monotonic() + seconds
+            while time.monotonic() < t_end:
+                t0 = time.monotonic()
+                try:
+                    async with sess.post(
+                        url + "/v1/completions", json=body_base,
+                        headers={**headers_base, "x-user-id": sid},
+                    ) as r:
+                        await r.read()
+                        if r.status == 200:
+                            counts["completed"] += 1
+                        elif r.status == 429:
+                            counts["throttled"] += 1
+                            ra = r.headers.get("Retry-After")
+                            if ra and len(retry_after) < 4:
+                                retry_after.append(ra)
+                        else:
+                            counts["errors"] += 1
+                            if r.status >= 500:
+                                counts["server_5xx"] += 1
+                except Exception:
+                    counts["errors"] += 1
+                if interval:
+                    dt = interval - (time.monotonic() - t0)
+                    if dt > 0:
+                        await asyncio.sleep(dt)
+
+        t0 = time.monotonic()
+        worker = stream_worker if mode == "streams" else loop_worker
+        await asyncio.gather(*(worker(i) for i in range(conc)))
+        elapsed = time.monotonic() - t0
+    return {**counts, "elapsed_s": round(elapsed, 3),
+            "retry_after_sample": retry_after}
+
+
+def _phase_fleet_scale_client_main() -> None:
+    import asyncio
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    spec = json.loads(sys.argv[sys.argv.index("--spec") + 1])
+    print(json.dumps(asyncio.run(_fleet_scale_client(spec))), flush=True)
+
+
+async def _fleet_scale_bench() -> dict:
+    """Horizontal router scale-out (docs/34-fleet-routing.md), CPU-only
+    pre-preflight — the EXECUTION half of ROADMAP 1, measured over real
+    OS processes and real wire (the PR 9 `fleet` phase measures what
+    breaks; this phase proves the fixes):
+
+    1. **throughput scaling**: M ∈ {1,2,3} router processes × N=4 fake
+       engine processes, one load-generator PROCESS per router — the
+       aggregate req/s curve in M (near-linear when the host has the
+       cores; `host_cores` rides the JSON so a serialized 1-core result
+       reads as what it is).
+    2. **10k concurrent streams**: long-held SSE streams spread across
+       the M=3 fleet; peak sum of `tpu:router_active_streams` across
+       replicas, with engine-side stickiness violations staying ~0 under
+       stable membership (identical rings by construction).
+    3. **fleet-scaled tenant budgets**: the 3-replica flood from PR 9's
+       phase, now with --fleet-budget-scaling on — over-admission must
+       fall from ≈2 to ≈0 with no admission-path hop; 429 Retry-After is
+       sampled from the SCALED buckets.
+    4. **controller-outage drill**: the controller process is killed
+       mid-flood — replicas degrade to the full local budget inside
+       ~3 report intervals and KEEP SERVING (fail open).
+    5. **cold-replica heal**: a 10k-block engine publishes (real
+       KVEventPublisher, fan-out) to 2 embedded-index replicas + the
+       controller; a freshly booted replica's divergence on /fleet reads
+       the full slice, then the publisher's own background resync heals
+       it to 0 — no human, no per-request controller hop.
+    """
+    import asyncio
+    import shlex
+    import socket
+    import tempfile
+
+    import aiohttp
+    import yaml as _yaml
+
+    N_ENGINES = 4
+    BUDGET_RPS = 30.0
+    LOAD_S = float(os.environ.get("FLEET_SCALE_SECONDS", "6"))
+    STREAM_TARGET = int(os.environ.get("FLEET_SCALE_STREAMS", "10000"))
+    REPORT_INTERVAL = 0.25
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs: list[subprocess.Popen] = []
+    runners = []
+
+    def spawn(module: str, args: list[str]) -> subprocess.Popen:
+        p = subprocess.Popen(
+            [sys.executable, "-m", module, *args], cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        procs.append(p)
+        return p
+
+    tenant_file = tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                              delete=False)
+    _yaml.safe_dump(
+        {"acme": {"api_key": "k-acme", "requests_per_s": BUDGET_RPS}},
+        tenant_file,
+    )
+    tenant_file.close()
+
+    sess = aiohttp.ClientSession(
+        connector=aiohttp.TCPConnector(limit=0),
+        timeout=aiohttp.ClientTimeout(total=15),
+    )
+
+    async def wait_http(url: str, path: str = "/health",
+                        timeout_s: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                async with sess.get(url + path) as r:
+                    if r.status < 500:
+                        return
+            except Exception:
+                pass
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"{url}{path} never came up")
+            await asyncio.sleep(0.2)
+
+    async def scrape_gauge(url: str, name: str) -> float | None:
+        async with sess.get(url + "/metrics") as r:
+            text = await r.text()
+        for line in text.splitlines():
+            if line.startswith(name + " ") or line.startswith(name + "{"):
+                try:
+                    return float(line.rsplit(" ", 1)[1])
+                except ValueError:
+                    return None
+        return None
+
+    async def run_clients(specs: list[dict]) -> list[dict]:
+        """One load-generator subprocess per spec; parse each last line."""
+        children = []
+        for spec in specs:
+            children.append(await asyncio.create_subprocess_exec(
+                sys.executable, os.path.join(REPO, "bench.py"),
+                "--phase", "fleet_scale_client",
+                "--spec", json.dumps(spec),
+                cwd=REPO, env=env,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL,
+            ))
+        outs = []
+        for i, child in enumerate(children):
+            out, _ = await child.communicate()
+            lines = out.decode(errors="replace").strip().splitlines()
+            if not lines:
+                # a client that died before printing (import error, OOM)
+                # must surface as a named failure, not an IndexError
+                outs.append({
+                    "completed": 0, "throttled": 0, "errors": 0,
+                    "server_5xx": 0, "retry_after_sample": [],
+                    "error": f"load client {i} produced no output "
+                             f"(rc={child.returncode})",
+                })
+                continue
+            outs.append(json.loads(lines[-1]))
+        return outs
+
+    try:
+        # -- shared fleet: engines + controller -------------------------
+        engine_ports = [free_port() for _ in range(N_ENGINES)]
+        engine_urls = [f"http://127.0.0.1:{p}" for p in engine_ports]
+        for port, url in zip(engine_ports, engine_urls):
+            spawn("vllm_production_stack_tpu.testing.fake_engine", [
+                "--port", str(port), "--model", "tiny",
+                "--tokens-per-sec", "2000",
+                "--self-url", url, "--no-request-log",
+            ])
+        ctrl_port = free_port()
+        ctrl_url = f"http://127.0.0.1:{ctrl_port}"
+        ctrl_proc = spawn("vllm_production_stack_tpu.engine.kv_controller", [
+            "--host", "127.0.0.1", "--port", str(ctrl_port),
+            "--tenant-table-file", tenant_file.name,
+            # rate window < the flood length, so utilization measures the
+            # steady state instead of diluting over pre-flood idle time
+            "--fleet-rate-window", "10",
+        ])
+        for url in engine_urls:
+            await wait_http(url)
+        await wait_http(ctrl_url)
+
+        def router_cmd(replica: str, port: int) -> list[str]:
+            return [
+                "--host", "127.0.0.1", "--port", str(port),
+                "--static-backends", ",".join(engine_urls),
+                "--static-models", ";".join(["tiny"] * N_ENGINES),
+                "--routing-logic", "session", "--session-key", "x-user-id",
+                "--router-replica-id", replica,
+                "--fleet-report-url", ctrl_url,
+                "--fleet-report-interval", str(REPORT_INTERVAL),
+                "--tenant-table-file", tenant_file.name,
+                "--breaker-failure-threshold", "0",
+                "--request-tracing", "off",
+            ]
+
+        # -- 1. throughput scaling in M ----------------------------------
+        throughput: dict = {}
+        routers3: list[str] = []
+        router3_procs: list[subprocess.Popen] = []
+        for m in (1, 2, 3):
+            ports = [free_port() for _ in range(m)]
+            urls = [f"http://127.0.0.1:{p}" for p in ports]
+            # replica ids OVERLAP across runs (r0..r{m-1}) so the
+            # controller's fleet view counts exactly m live replicas
+            # instead of accumulating dead ids from earlier runs
+            batch = [
+                spawn("vllm_production_stack_tpu.router.app",
+                      router_cmd(f"r{i}", port))
+                for i, port in enumerate(ports)
+            ]
+            for url in urls:
+                await wait_http(url)
+            outs = await run_clients([
+                {"mode": "throughput", "routers": [url],
+                 "seconds": LOAD_S, "concurrency": 48,
+                 "max_tokens": 1, "session_prefix": f"m{m}-{j}"}
+                for j, url in enumerate(urls)
+            ])
+            total = sum(o["completed"] for o in outs)
+            throughput[f"m{m}"] = {
+                "req_per_s": round(total / LOAD_S, 1),
+                "completed": total,
+                "errors": sum(o["errors"] for o in outs),
+            }
+            if m == 3:
+                routers3, router3_procs = urls, batch
+            else:
+                for p in batch:
+                    p.terminate()
+                for p in batch:
+                    p.wait(timeout=15)
+        m1 = throughput["m1"]["req_per_s"] or 1.0
+        throughput["scaling_m3_over_m1"] = round(
+            throughput["m3"]["req_per_s"] / m1, 2
+        )
+        throughput["host_cores"] = os.cpu_count()
+        if (os.cpu_count() or 1) < 5:
+            throughput["note"] = (
+                "router/engine/client processes timeshare "
+                f"{os.cpu_count()} core(s) — aggregate req/s is "
+                "serialized by the host, not the architecture"
+            )
+
+        # -- 2. 10k concurrent long-held streams through M=3 -------------
+        n_clients = 4
+        per_client = STREAM_TARGET // n_clients
+        stream_clients = [
+            asyncio.create_task(run_clients([
+                {"mode": "streams", "routers": routers3,
+                 "concurrency": per_client, "max_tokens": 4,
+                 "tokens_per_sec": 0.2,  # 4 tokens @ 5s gap ≈ 20s hold
+                 "session_prefix": f"st{j}"}
+            ]))
+            for j in range(n_clients)
+        ]
+        peak_streams = 0.0
+        while not all(t.done() for t in stream_clients):
+            total = 0.0
+            for url in routers3:
+                v = await scrape_gauge(url, "tpu:router_active_streams")
+                total += v or 0.0
+            peak_streams = max(peak_streams, total)
+            await asyncio.sleep(0.5)
+        stream_outs = [t.result()[0] for t in stream_clients]
+        violations = 0
+        observed = 0
+        for url in engine_urls:
+            async with sess.get(url + "/debug/stickiness") as r:
+                snap = await r.json()
+            violations += sum(snap["violations"].values())
+            observed += snap["observed"]
+        streams = {
+            "target": STREAM_TARGET,
+            "peak_active_streams": int(peak_streams),
+            "completed": sum(o["completed"] for o in stream_outs),
+            "errors": sum(o["errors"] for o in stream_outs),
+            "stickiness": {
+                "observed": observed,
+                "violations": violations,
+                "violation_rate": round(violations / max(1, observed), 6),
+            },
+        }
+
+        # -- 3. fleet-scaled tenant budgets ------------------------------
+        # reporters have long since learned replicas=3 (0.25s interval)
+        FLOOD_S = 12.0
+        tenant_specs = [
+            {"mode": "tenant", "routers": [url], "seconds": FLOOD_S,
+             "concurrency": 2, "paced_rps": 12.5, "max_tokens": 1,
+             "auth": "k-acme", "session_prefix": f"t{j}"}
+            for j, url in enumerate(routers3)
+        ]
+        scale_gauge = await scrape_gauge(
+            routers3[0], "tpu:router_tenant_budget_scale"
+        )
+        outs = await run_clients(tenant_specs)
+        await asyncio.sleep(3 * REPORT_INTERVAL)  # final reports land
+        async with sess.get(ctrl_url + "/fleet") as r:
+            rollup = (await r.json())["tenants"].get("acme", {})
+        scaled = {
+            "budget_rps": BUDGET_RPS,
+            "offered_rps": 75.0,
+            "admitted": sum(o["completed"] for o in outs),
+            "throttled": sum(o["throttled"] for o in outs),
+            "admitted_rps": round(
+                sum(o["completed"] for o in outs) / FLOOD_S, 2
+            ),
+            "limit_utilization": rollup.get("limit_utilization"),
+            "overadmission_ratio": rollup.get("overadmission_ratio"),
+            "budget_scale_gauge": scale_gauge,
+            "retry_after_sample": next(
+                (o["retry_after_sample"] for o in outs
+                 if o["retry_after_sample"]), []
+            ),
+        }
+
+        # -- 4. controller-outage drill ----------------------------------
+        ctrl_proc.terminate()
+        ctrl_proc.wait(timeout=15)
+        # a failed report past 3 intervals degrades budgets to full-local
+        await asyncio.sleep(6 * REPORT_INTERVAL + 1.0)
+        outs = await run_clients([
+            dict(spec, seconds=6.0) for spec in tenant_specs
+        ])
+        outage = {
+            "admitted": sum(o["completed"] for o in outs),
+            "admitted_rps": round(
+                sum(o["completed"] for o in outs) / 6.0, 2
+            ),
+            "errors_5xx": sum(o.get("server_5xx", 0) for o in outs),
+            "client_errors": sum(o["errors"] for o in outs),
+            "budget_scale_gauge": await scrape_gauge(
+                routers3[0], "tpu:router_tenant_budget_scale"
+            ),
+            "kept_serving": sum(o["completed"] for o in outs) > 0,
+            "degraded_to_full_local": None,  # filled below
+        }
+        outage["degraded_to_full_local"] = (
+            outage["budget_scale_gauge"] == 1.0
+            and outage["admitted_rps"] > 1.5 * BUDGET_RPS
+        )
+        for p in router3_procs:
+            p.terminate()
+
+        # -- 5. cold-replica heal through publisher fan-out --------------
+        from aiohttp import web
+
+        from vllm_production_stack_tpu.engine.kv_cache import KVBlockPool
+        from vllm_production_stack_tpu.engine.kv_controller import (
+            KVController,
+        )
+        from vllm_production_stack_tpu.engine.kv_events import (
+            KVEventPublisher,
+        )
+        from vllm_production_stack_tpu.router.app import build_app
+        from vllm_production_stack_tpu.router.args import parse_args
+
+        BLOCK = 16
+        HEAL_BLOCKS = 10_000
+
+        async def serve(app, port: int = 0):
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            runners.append(runner)
+            return runner, runner.addresses[0][1]
+
+        heal_ctrl = KVController(["http://e0"], mode="indexed")
+        _, heal_ctrl_port = await serve(heal_ctrl.build_app())
+        heal_ctrl_url = f"http://127.0.0.1:{heal_ctrl_port}"
+
+        def heal_router_args(replica: str):
+            return parse_args([
+                "--static-backends", "http://e0",
+                "--static-models", "tiny",
+                "--routing-logic", "kvaware",
+                "--kv-index-mode", "embedded",
+                "--kv-index-tokenizer", "byte",
+                "--router-replica-id", replica,
+                "--fleet-report-url", heal_ctrl_url,
+                "--fleet-report-interval", "0.2",
+            ])
+
+        _, port_a = await serve(build_app(heal_router_args("warm")))
+        cold_port = free_port()
+
+        pool = KVBlockPool(HEAL_BLOCKS + 16, BLOCK)
+
+        async def snapshot_fn():
+            return pool.snapshot_events()
+
+        pub = KVEventPublisher(
+            [f"http://127.0.0.1:{port_a}",
+             f"http://127.0.0.1:{cold_port}", heal_ctrl_url],
+            "http://e0", pool.events, snapshot_fn, BLOCK, lambda: sess,
+            interval_s=0.05, jitter_frac=0.0,
+        )
+        parent = pool.root_hash()
+        rng_base = 0
+        for _ in range(HEAL_BLOCKS):
+            blk = pool.allocate()
+            assert blk is not None
+            parent = pool.register_full_block(
+                blk, parent,
+                tuple(range(rng_base, rng_base + BLOCK)),
+            )
+            rng_base += BLOCK
+        pub.start()
+        # warm replica + controller converge; the cold replica's
+        # subscriber keeps failing (port closed) and stays snapshot-owed
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if heal_ctrl.index.stats()["hashes"] >= HEAL_BLOCKS:
+                break
+            await asyncio.sleep(0.2)
+        # pause publishing only to take a deterministic COLD reading (on
+        # a fast host the background snapshot can land before the first
+        # fleet report); production publishers never pause — the heal
+        # below runs through the same background loop
+        await pub.stop()
+        # boot the cold replica on the pre-registered address; its first
+        # fleet report carries an EMPTY index -> divergence = full slice
+        runner_cold, _ = await serve(
+            build_app(heal_router_args("cold")), cold_port
+        )
+        cold_state = runner_cold.app["state"]
+        await cold_state.fleet_reporter.report_once()
+        async with sess.get(heal_ctrl_url + "/fleet") as r:
+            before = {
+                x["replica"]: x["divergence_blocks"]
+                for x in (await r.json())["replicas"]
+            }
+        # ...and the publisher's own background fan-out heals it: the
+        # cold subscriber answers the next batch with "resync", gets the
+        # snapshot, divergence returns to 0 — no human anywhere
+        pub.start()
+        t_heal0 = time.monotonic()
+        healed = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            await cold_state.fleet_reporter.report_once()
+            async with sess.get(heal_ctrl_url + "/fleet") as r:
+                div = {
+                    x["replica"]: x["divergence_blocks"]
+                    for x in (await r.json())["replicas"]
+                }.get("cold")
+            if div == 0:
+                healed = div
+                break
+            await asyncio.sleep(0.2)
+        heal_time = time.monotonic() - t_heal0
+        await pub.stop()
+        cold_heal = {
+            "blocks": HEAL_BLOCKS,
+            "divergence_cold": before.get("cold"),
+            "divergence_healed": healed,
+            "heal_time_s": round(heal_time, 2),
+            "publisher": pub.debug_snapshot(),
+        }
+
+        return {
+            "engines": N_ENGINES,
+            "host_cores": os.cpu_count(),
+            "throughput": throughput,
+            "streams": streams,
+            "tenant_budget": {
+                "fleet_scaled_3_replicas": scaled,
+                "controller_outage_drill": outage,
+            },
+            "cold_replica": cold_heal,
+            "command_shape": shlex.join(
+                ["python", "-m", "vllm_production_stack_tpu.router.app",
+                 *router_cmd("rN", 0)][:8]
+            ) + " ...",
+        }
+    finally:
+        for runner in reversed(runners):
+            try:
+                await runner.cleanup()
+            except Exception:
+                pass
+        await sess.close()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        os.unlink(tenant_file.name)
+
+
+def _phase_fleet_scale_main() -> None:
+    """Subprocess entry for the CPU-only horizontal-scale-out bench.
+    Forces CPU before anything touches jax — runs pre-preflight, so the
+    multi-replica execution evidence survives a wedged TPU tunnel."""
+    import asyncio
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = asyncio.run(_fleet_scale_bench())
+    print(json.dumps({"fleet_scale": result}), flush=True)
+
+
 def _phase_hydration_main() -> None:
     """Subprocess entry for the CPU-only hydration-planner bench. Forces
     CPU before anything touches jax — runs pre-preflight, so the
@@ -2081,6 +2664,10 @@ def main() -> None:
             _phase_hydration_main()
         elif phase == "fleet":
             _phase_fleet_main()
+        elif phase == "fleet_scale":
+            _phase_fleet_scale_main()
+        elif phase == "fleet_scale_client":
+            _phase_fleet_scale_client_main()
         else:
             assert phase == "micro", phase
             _phase_micro_main()
@@ -2151,6 +2738,16 @@ def main() -> None:
         timeout_s=300, key="fleet", min_needed_s=60.0,
     )
 
+    # -0.00390625) horizontal router scale-out (docs/34-fleet-routing.md):
+    # the execution half of ROADMAP 1 — aggregate req/s in M router
+    # processes, 10k concurrent streams, fleet-scaled tenant budgets with
+    # a controller-outage drill, and the cold-replica fan-out heal —
+    # CPU-only, pre-preflight
+    fleet_scale = _run_phase(
+        "fleet_scale", ["bench.py", "--phase", "fleet_scale"],
+        timeout_s=540, key="fleet_scale", min_needed_s=120.0,
+    )
+
     # 0) chip preflight: one trivial dispatch. A wedged tunnel fails HERE
     # in minutes with an explicit section; the heavy phases are then
     # reported skipped instead of serially eating their timeouts
@@ -2177,6 +2774,7 @@ def main() -> None:
             "kvflow": kvflow,
             "hydration": hydration,
             "fleet": fleet,
+            "fleet_scale": fleet_scale,
             "total_elapsed_s": round(time.monotonic() - _t_start, 1),
         }), flush=True)
         return
@@ -2251,6 +2849,7 @@ def main() -> None:
         "kvflow": kvflow,
         "hydration": hydration,
         "fleet": fleet,
+        "fleet_scale": fleet_scale,
         "total_elapsed_s": round(time.monotonic() - _t_start, 1),
     }), flush=True)
 

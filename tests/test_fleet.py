@@ -641,6 +641,11 @@ def test_replica_restart_divergence_rises_then_heals_on_fleet():
         pool = KVBlockPool(256, BLOCK)
         admit(pool, list(range(20 * BLOCK)))
         epoch, seq, hashes = pool.snapshot_events()
+        # snapshot_events no longer clears the shared buffer (fan-out
+        # keeps it for other subscribers); play the publisher cursor and
+        # discard the events the snapshot already bakes in
+        while pool.events.drain()[1]:
+            pass
         snapshot_payload = {
             "engine": "http://e0", "epoch": epoch, "block_size": BLOCK,
             "snapshot": True, "seq": seq,

@@ -1,0 +1,674 @@
+"""Horizontal router scale-out tests (docs/34-fleet-routing.md): the
+EXECUTION half of ROADMAP 1 on top of PR 9's measurement layer.
+
+The guarantees under test:
+
+- ring determinism gate: two rings built from the same endpoint set in
+  shuffled arrival orders produce identical membership hashes AND the
+  identical owner for every sampled session id; churn keeps the bounded-
+  remap property (only the removed node's keys move); even a virtual-point
+  collision resolves order-free;
+- KV-event fan-out: one publisher, many subscribers, each with its own
+  cursor — a dead/cold subscriber heals through its own snapshot resync
+  while in-sync subscribers keep streaming batches (chaos-marked
+  replica-restart heal over real wire);
+- thundering-herd jitter: publisher and fleet-reporter intervals spread
+  instead of ticking in lockstep;
+- fleet budget scaling: local buckets re-rate to a 1/M share from the
+  controller's replica count, 429 Retry-After derives from the SCALED
+  rate, and a controller outage degrades to the full local budget.
+"""
+
+import asyncio
+import random
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from vllm_production_stack_tpu.engine.kv_cache import KVBlockPool
+from vllm_production_stack_tpu.engine.kv_controller import KVController
+from vllm_production_stack_tpu.engine.kv_events import KVEventPublisher
+from vllm_production_stack_tpu.fleet import FleetView
+from vllm_production_stack_tpu.qos import TenantTable
+from vllm_production_stack_tpu.qos.gate import QoSGate
+from vllm_production_stack_tpu.router import hashring
+from vllm_production_stack_tpu.router.fleet import FleetReporter
+from vllm_production_stack_tpu.router.hashring import HashRing
+
+pytestmark = pytest.mark.fleet_scale
+
+BLOCK = 4
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def admit(pool: KVBlockPool, ids: list[int]) -> None:
+    parent = pool.root_hash()
+    for i in range(len(ids) // pool.block_size):
+        blk = pool.allocate()
+        assert blk is not None
+        parent = pool.register_full_block(
+            blk, parent,
+            tuple(ids[i * pool.block_size:(i + 1) * pool.block_size]),
+        )
+
+
+# -- ring determinism gate ---------------------------------------------------
+
+
+def test_ring_identical_owners_regardless_of_arrival_order():
+    """The fleet-consistency contract: every replica computes the same
+    ring from the same membership, no matter in which order discovery
+    surfaced the endpoints. 1k sampled session ids must agree exactly."""
+    nodes = [f"http://e{i}:8000" for i in range(7)]
+    rng = random.Random(7)
+    rings = []
+    for _ in range(5):
+        order = list(nodes)
+        rng.shuffle(order)
+        ring = HashRing()
+        for n in order:
+            ring.add_node(n)
+        rings.append(ring)
+    base = rings[0]
+    keys = [f"session-{i}" for i in range(1000)]
+    for other in rings[1:]:
+        assert other.membership_hash() == base.membership_hash()
+        assert other._points == base._points  # identical virtual layout
+        for k in keys:
+            assert other.get_node(k) == base.get_node(k)
+
+
+def test_ring_churn_remap_is_bounded_to_the_removed_node():
+    """Consistent-hash minimal remap: dropping one of N nodes moves ONLY
+    the keys it owned (≈1/N of traffic); no key hops between survivors —
+    the bound that keeps stickiness violations transient on churn."""
+    nodes = [f"http://e{i}:8000" for i in range(5)]
+    ring = HashRing()
+    for n in nodes:
+        ring.add_node(n)
+    keys = [f"session-{i}" for i in range(2000)]
+    before = {k: ring.get_node(k) for k in keys}
+    victim = nodes[2]
+    ring.remove_node(victim)
+    moved = 0
+    for k in keys:
+        after = ring.get_node(k)
+        if after != before[k]:
+            moved += 1
+            # every moved key previously belonged to the removed node
+            assert before[k] == victim
+    orphaned = sum(1 for k in keys if before[k] == victim)
+    assert moved == orphaned
+    # ≈1/5 of traffic, generously bounded (virtual points smooth variance)
+    assert 0 < moved < len(keys) * 0.45
+    # re-adding restores the exact previous ownership (pure function)
+    ring.add_node(victim)
+    assert {k: ring.get_node(k) for k in keys} == before
+
+
+def test_ring_virtual_point_collision_resolves_order_free(monkeypatch):
+    """A 64-bit point collision between two nodes is ~impossible, but if
+    one happens the owner must not depend on insertion order (replicas see
+    different arrival orders). Forced collision: both nodes' point #0 hash
+    identically; min() of the contenders must own it either way, and
+    removing the winner must hand the point to the survivor."""
+    real = hashring._h64
+
+    def collide(s: str) -> int:
+        if s in ("http://a#0", "http://b#0"):
+            return 42
+        return real(s)
+
+    monkeypatch.setattr(hashring, "_h64", collide)
+    for order in (["http://a", "http://b"], ["http://b", "http://a"]):
+        ring = HashRing(replicas=1)
+        for n in order:
+            ring.add_node(n)
+        assert ring._owner[42] == "http://a", order  # min(), not first-in
+        ring.remove_node("http://a")
+        assert ring._owner[42] == "http://b"  # reassigned, not dropped
+        ring.remove_node("http://b")
+        assert ring._points == [] and ring._owner == {}
+
+
+def test_ring_same_node_self_collision_keeps_points_consistent(monkeypatch):
+    """Two of the SAME node's virtual indices colliding must not duplicate
+    the point in _points (a stranded ownerless copy would KeyError every
+    lookup landing on it after removal)."""
+    real = hashring._h64
+
+    def collide(s: str) -> int:
+        if s in ("http://a#0", "http://a#1"):
+            return 42
+        return real(s)
+
+    monkeypatch.setattr(hashring, "_h64", collide)
+    ring = HashRing(replicas=2)
+    ring.add_node("http://a")
+    assert ring._points.count(42) == 1
+    ring.add_node("http://b")
+    ring.remove_node("http://a")
+    assert 42 not in ring._points and 42 not in ring._owner
+    # every remaining point resolves — no stranded ownerless copies
+    for _ in range(50):
+        assert ring.get_node("probe") == "http://b"
+    ring.remove_node("http://b")
+    assert ring._points == [] and ring._owner == {}
+
+
+# -- thundering-herd jitter --------------------------------------------------
+
+
+def test_publisher_and_reporter_intervals_are_jittered():
+    pool = KVBlockPool(16, BLOCK)
+    pub = KVEventPublisher(
+        "http://c", "http://e0", pool.events, None, BLOCK, lambda: None,
+        interval_s=1.0, jitter_frac=0.2,
+    )
+
+    class _S:  # minimal RouterState stand-in
+        qos = None
+
+    rep = FleetReporter(_S(), "http://c", interval_s=1.0, jitter_frac=0.1)
+    for obj, frac in ((pub, 0.2), (rep, 0.1)):
+        draws = [obj._next_interval() for _ in range(300)]
+        assert all(1.0 - frac <= d <= 1.0 + frac for d in draws)
+        # genuinely spread, not a constant tick M replicas would share
+        assert max(draws) - min(draws) > frac * 0.5
+    pub.jitter_frac = 0.0
+    assert pub._next_interval() == 1.0
+
+
+# -- KV-event fan-out --------------------------------------------------------
+
+
+class _Subscriber:
+    """A real /kv/events endpoint over its own ClusterKVIndex."""
+
+    def __init__(self):
+        from vllm_production_stack_tpu.kv_index import ClusterKVIndex
+
+        self.index = ClusterKVIndex()
+        self.fail = False
+
+    def build_app(self) -> web.Application:
+        async def kv_events(request):
+            if self.fail:
+                return web.Response(status=500)
+            return web.json_response(self.index.apply(await request.json()))
+
+        app = web.Application()
+        app.router.add_post("/kv/events", kv_events)
+        return app
+
+
+def test_fanout_per_subscriber_resync_keeps_others_streaming():
+    """One failing subscriber must cost ITSELF a snapshot resync — the
+    in-sync subscriber keeps receiving incremental batches and never
+    re-receives the pool."""
+    import aiohttp
+
+    async def go():
+        pool = KVBlockPool(256, BLOCK)
+        a, b = _Subscriber(), _Subscriber()
+        sa, sb = TestServer(a.build_app()), TestServer(b.build_app())
+        await sa.start_server()
+        await sb.start_server()
+        url_a = f"http://127.0.0.1:{sa.port}"
+        url_b = f"http://127.0.0.1:{sb.port}"
+        sess = aiohttp.ClientSession()
+
+        async def snapshot_fn():
+            return pool.snapshot_events()
+
+        pub = KVEventPublisher(
+            [url_a, url_b], "http://e0", pool.events, snapshot_fn, BLOCK,
+            lambda: sess,
+        )
+        sub_a, sub_b = pub.subscribers
+        try:
+            ids = list(range(0, 4 * BLOCK))
+            admit(pool, ids)
+            await pub.flush()  # first contact: ONE snapshot capture, both
+            assert (sub_a.snapshots_sent, sub_b.snapshots_sent) == (1, 1)
+            for s in (a, b):
+                assert s.index.lookup_token_ids(ids) == \
+                    ("http://e0", 4 * BLOCK)
+
+            # B goes down across a batch -> only B owes a resync
+            b.fail = True
+            ids2 = list(range(100, 100 + 2 * BLOCK))
+            admit(pool, ids2)
+            await pub.flush()
+            assert not sub_a.need_snapshot and sub_b.need_snapshot
+            assert a.index.lookup_token_ids(ids2) == \
+                ("http://e0", 2 * BLOCK)
+
+            # B recovers: it alone gets the snapshot; A streams on with
+            # zero extra snapshots and no double-applied events
+            b.fail = False
+            ids3 = list(range(1000, 1000 + 3 * BLOCK))
+            admit(pool, ids3)
+            await pub.flush()
+            assert (sub_a.snapshots_sent, sub_b.snapshots_sent) == (1, 2)
+            for s in (a, b):
+                for probe in (ids, ids2, ids3):
+                    assert s.index.lookup_token_ids(probe) == \
+                        ("http://e0", len(probe)), probe
+            # cursors agree with the log position
+            assert sub_a.last_sent_seq == sub_b.last_sent_seq == \
+                pool.events.seq
+        finally:
+            await sess.close()
+            await sa.close()
+            await sb.close()
+
+    run(go())
+
+
+def test_fanout_blackholed_subscriber_does_not_block_healthy_one():
+    """A subscriber that accepts the TCP connection and then hangs (the
+    rescheduled-pod blackhole) must cost its OWN pipeline the bounded
+    send timeout, not head-of-line block batch delivery to the healthy
+    subscriber — each subscriber runs its own send pipeline and every
+    POST is wait_for-bounded."""
+    import aiohttp
+
+    async def go():
+        pool = KVBlockPool(64, BLOCK)
+        a = _Subscriber()
+        sa = TestServer(a.build_app())
+        await sa.start_server()
+
+        hang = asyncio.Event()
+
+        async def hanging_kv_events(request):
+            await hang.wait()  # never set: blackhole until cancelled
+            return web.Response(status=500)
+
+        happ = web.Application()
+        happ.router.add_post("/kv/events", hanging_kv_events)
+        sh = TestServer(happ)
+        await sh.start_server()
+        sess = aiohttp.ClientSession()
+
+        async def snapshot_fn():
+            return pool.snapshot_events()
+
+        pub = KVEventPublisher(
+            [f"http://127.0.0.1:{sa.port}", f"http://127.0.0.1:{sh.port}"],
+            "http://e0", pool.events, snapshot_fn, BLOCK, lambda: sess,
+            send_timeout_s=0.3,
+        )
+        sub_a, sub_hung = pub.subscribers
+        try:
+            ids = list(range(0, 2 * BLOCK))
+            admit(pool, ids)
+            t0 = time.monotonic()
+            await pub.flush()
+            elapsed = time.monotonic() - t0
+            # the healthy subscriber converged within ~the send bound,
+            # not the shared session's multi-second connect/total timeout
+            assert elapsed < 2.0
+            assert a.index.lookup_token_ids(ids) == \
+                ("http://e0", 2 * BLOCK)
+            assert not sub_a.need_snapshot
+            # the hung one timed out its snapshot and still owes it
+            assert sub_hung.need_snapshot
+            assert sub_hung.publish_failures >= 1
+            assert "TimeoutError" in (sub_hung.last_error or "")
+        finally:
+            await sess.close()
+            await sa.close()
+            await sh.close()
+
+    run(go())
+
+
+@pytest.mark.chaos
+def test_replica_restart_heals_through_real_wire_resync():
+    """Chaos: an embedded-index router replica restarts (fresh process,
+    same address). The publisher's next rounds must heal the replica's
+    full divergence to 0 through the wire — snapshot resync, no human, no
+    per-request controller hop — while the surviving replica streams
+    batches uninterrupted. Divergence measured the same way the
+    controller's /fleet does (fleet.index_divergence_blocks)."""
+    import aiohttp
+
+    from vllm_production_stack_tpu.fleet import index_divergence_blocks
+    from vllm_production_stack_tpu.router.app import build_app
+    from vllm_production_stack_tpu.router.args import parse_args
+
+    def router_args():
+        return parse_args([
+            "--static-backends", "http://e0",
+            "--static-models", "tiny",
+            "--routing-logic", "kvaware",
+            "--kv-index-mode", "embedded",
+            "--kv-index-tokenizer", "byte",
+        ])
+
+    async def serve(app, port: int = 0):
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        return runner, runner.addresses[0][1]
+
+    async def go():
+        pool = KVBlockPool(512, BLOCK)
+        controller = KVController(["http://e0"], mode="indexed")
+        ctrl_runner, ctrl_port = await serve(controller.build_app())
+        runner_a, port_a = await serve(build_app(router_args()))
+        runner_b, port_b = await serve(build_app(router_args()))
+        sess = aiohttp.ClientSession()
+
+        async def snapshot_fn():
+            return pool.snapshot_events()
+
+        pub = KVEventPublisher(
+            [f"http://127.0.0.1:{port_a}", f"http://127.0.0.1:{port_b}",
+             f"http://127.0.0.1:{ctrl_port}"],
+            "http://e0", pool.events, snapshot_fn, BLOCK, lambda: sess,
+        )
+        try:
+            ids = list(range(0, 8 * BLOCK))
+            admit(pool, ids)
+            await pub.flush()
+            index_b = runner_b.app["state"].policy.index
+            assert index_b.lookup_token_ids(ids) == \
+                ("http://e0", 8 * BLOCK)
+
+            # replica B dies mid-fleet; traffic continues
+            await runner_b.cleanup()
+            ids2 = list(range(500, 500 + 4 * BLOCK))
+            admit(pool, ids2)
+            await pub.flush()
+            index_a = runner_a.app["state"].policy.index
+            assert index_a.lookup_token_ids(ids2) == \
+                ("http://e0", 4 * BLOCK)
+
+            # B restarts on the same address with a COLD index: its
+            # divergence against the controller is the full slice
+            runner_b2, _ = await serve(build_app(router_args()), port_b)
+            index_b2 = runner_b2.app["state"].policy.index
+            div = index_divergence_blocks(
+                controller.index.positions(), index_b2.positions()
+            )
+            assert div == 12  # the whole authoritative slice
+
+            # the publisher's own background retry heals it: first round
+            # answers resync (cold subscriber), next ships the snapshot
+            pub.interval_s, pub.jitter_frac = 0.02, 0.0
+            pub.start()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if index_divergence_blocks(
+                    controller.index.positions(), index_b2.positions()
+                ) == 0:
+                    break
+                await asyncio.sleep(0.05)
+            await pub.stop()
+            assert index_divergence_blocks(
+                controller.index.positions(), index_b2.positions()
+            ) == 0
+            assert index_b2.lookup_token_ids(ids + ids2[:0]) == \
+                ("http://e0", 8 * BLOCK)
+            assert index_b2.lookup_token_ids(ids2) == \
+                ("http://e0", 4 * BLOCK)
+            await runner_b2.cleanup()
+        finally:
+            await sess.close()
+            await runner_a.cleanup()
+            await ctrl_runner.cleanup()
+
+    run(go())
+
+
+# -- fleet budget scaling ----------------------------------------------------
+
+
+def _gate(rps: float = 10.0) -> QoSGate:
+    return QoSGate(TenantTable.from_dict(
+        {"acme": {"api_key": "k", "requests_per_s": rps}}
+    ))
+
+
+def test_budget_scale_rerates_buckets_and_retry_after_uses_scaled_rate():
+    """M=5 replicas -> each bucket refills at rate/5, and the 429's
+    Retry-After must advertise the SCALED refill time: a 1/M bucket
+    advertising the full-rate refill under-backs-off clients by M×."""
+    gate = _gate(rps=10.0)
+    policy = gate.table.get("acme")
+    now = [1000.0]
+
+    def throttle_wait() -> float:
+        """Drain the burst, return the first refusal's retry_after."""
+        while True:
+            v = gate.limiter.try_admit(policy, 0, now=now[0])
+            if v is not None:
+                assert v.reason == "requests_per_s"
+                return v.retry_after_s
+            gate.limiter.release("acme")
+
+    # unscaled: rate 10/s -> 1 token deficit refills in 0.1s
+    assert throttle_wait() == pytest.approx(0.1, rel=1e-6)
+
+    gate.set_fleet_scale(5)
+    assert gate.budget_scale == pytest.approx(0.2)
+    st = gate.limiter._states["acme"]
+    assert st.rps.rate == pytest.approx(2.0)  # 10/s × 1/5
+    assert st.rps.burst == pytest.approx(2.0)
+    now[0] += 60.0  # refill fully under the new burst
+    # scaled: rate 2/s -> the SAME deficit now honestly takes 0.5s
+    assert throttle_wait() == pytest.approx(0.5, rel=1e-6)
+
+    # degradation / single replica restores the full local budget
+    gate.set_fleet_scale(1)
+    assert gate.budget_scale == 1.0
+    assert st.rps.rate == pytest.approx(10.0)
+    # idempotent + nonsense-proof
+    gate.set_fleet_scale(0)
+    assert gate.budget_scale == 1.0
+
+
+def test_budget_scale_survives_table_hot_reload():
+    gate = _gate(rps=12.0)
+    gate.set_fleet_scale(3)
+    gate.update_table(TenantTable.from_dict(
+        {"acme": {"api_key": "k", "requests_per_s": 6.0}}
+    ))
+    st = gate.limiter._states["acme"]
+    assert st.rps.rate == pytest.approx(2.0)  # new limit × the live scale
+    assert gate.limiter.rate_scale == pytest.approx(1 / 3)
+
+
+def test_reporter_closes_budget_loop_and_degrades_on_outage():
+    """Wire-level: the /fleet/report reply's replica count re-rates the
+    local buckets; a silent controller (reports stale past 3 intervals)
+    degrades to the full local budget — fail open, keep serving."""
+
+    async def go():
+        controller = KVController(
+            [], tenant_table=TenantTable.from_dict(
+                {"acme": {"requests_per_s": 9.0}}
+            ),
+        )
+        client = TestClient(TestServer(controller.build_app()))
+        await client.start_server()
+        ctrl_url = str(client.make_url("")).rstrip("/")
+
+        class _Breakers:
+            def snapshot(self):
+                return {}
+
+        class _State:  # just enough RouterState for build_report()
+            policy = object()
+            breakers = _Breakers()
+            qos = _gate(rps=9.0)
+
+        state = _State()
+        # a second ENFORCING replica is already reporting (plus a report-
+        # only one that must NOT count toward the scaling denominator)
+        controller.fleet.apply_report(
+            {"replica": "other", "ts": 1.0, "enforcing": True}
+        )
+        controller.fleet.apply_report({"replica": "report-only", "ts": 1.0})
+        rep = FleetReporter(state, ctrl_url, interval_s=0.2,
+                            replica_id="me")
+        try:
+            await rep.report_once()
+            assert state.qos.budget_replicas == 2
+            assert state.qos.budget_scale == pytest.approx(0.5)
+            assert state.qos.limiter._states["acme"].rps.rate == \
+                pytest.approx(4.5)
+
+            # outage: the last success ages past 3 intervals -> full local
+            rep.last_report_t = time.monotonic() - 10 * rep.interval_s
+            rep._degrade_if_stale()
+            assert state.qos.budget_scale == 1.0
+
+            # budget_scaling=False is report-only (the PR 9 behavior)
+            rep2 = FleetReporter(state, ctrl_url, interval_s=0.2,
+                                 replica_id="me", budget_scaling=False)
+            state.qos.set_fleet_scale(1)
+            await rep2.report_once()
+            assert state.qos.budget_scale == 1.0
+            await rep2.stop()
+        finally:
+            await rep.stop()
+            await client.close()
+
+    run(go())
+
+
+def test_router_metrics_render_budget_scale_gauge():
+    from vllm_production_stack_tpu import metrics_contract as mc
+    from vllm_production_stack_tpu.router.app import RouterState
+    from vllm_production_stack_tpu.router.args import parse_args
+
+    async def go():
+        args = parse_args([
+            "--static-backends", "http://e0", "--static-models", "tiny",
+        ])
+        state = RouterState(args)
+        state.qos = _gate()
+        state.qos.set_fleet_scale(4)
+        text = state.metrics.render(state).decode()
+        assert f"{mc.ROUTER_TENANT_BUDGET_SCALE} 0.25" in text
+        await state.policy.close()
+
+    run(go())
+
+
+def test_fleet_view_replica_count_rides_every_reply():
+    view = FleetView()
+    r1 = view.apply_report({"replica": "a", "ts": 1.0})
+    assert r1["replicas"] == 1
+    r2 = view.apply_report({"replica": "b", "ts": 1.0})
+    assert r2["replicas"] == 2
+
+
+def test_enforcing_count_excludes_report_only_and_restart_leftovers():
+    """The budget-scaling denominator counts only QoS-ENFORCING replicas
+    heard within the tight liveness window — a report-only replica, or
+    the ids a rolling restart leaves behind, must not push the live
+    replicas below their honest 1/M share."""
+    view = FleetView(live_within_s=5.0)
+    reply = view.apply_report({"replica": "a", "ts": 1.0,
+                               "enforcing": True})
+    assert reply["enforcing_replicas"] == 1
+    view.apply_report({"replica": "report-only", "ts": 1.0})
+    reply = view.apply_report({"replica": "b", "ts": 1.0,
+                               "enforcing": True})
+    assert reply["replicas"] == 3  # everyone still counts as a replica
+    assert reply["enforcing_replicas"] == 2  # ...but not toward M
+    # a replaced pod's id ages out of the DENOMINATOR in seconds (it
+    # stays in the view for divergence/history until expire_after_s)
+    view._replicas["a"].recv_t -= 10.0
+    assert view.enforcing_count() == 1
+    assert view.replica_count() == 3
+
+
+def test_snapshot_capture_backs_off_for_a_dead_subscriber():
+    """A permanently unreachable subscriber must not re-trigger the
+    O(pool) snapshot capture (engine lock held) on every flush round —
+    failed attempts back off exponentially per subscriber and reset on
+    success."""
+
+    async def go():
+        pool = KVBlockPool(64, BLOCK)
+        captures = {"n": 0}
+
+        async def snapshot_fn():
+            captures["n"] += 1
+            return pool.snapshot_events()
+
+        async def dead_post(sub, payload):
+            raise RuntimeError("connect refused")
+
+        pub = KVEventPublisher(
+            "http://dead", "http://e0", pool.events, snapshot_fn, BLOCK,
+            lambda: None, interval_s=0.05,
+        )
+        pub._post = dead_post
+        sub = pub.subscribers[0]
+        await pub.flush()  # first contact: capture + failed POST
+        assert captures["n"] == 1 and sub.need_snapshot
+        assert sub.snapshot_backoff_s > 0
+        await pub.flush()  # inside the backoff window: NO new capture
+        await pub.flush()
+        assert captures["n"] == 1
+        backoff1 = sub.snapshot_backoff_s
+        sub.next_snapshot_t = 0.0  # backoff elapses -> one more attempt
+        await pub.flush()
+        assert captures["n"] == 2
+        assert sub.snapshot_backoff_s >= backoff1  # grows toward the cap
+
+        # recovery resets the backoff entirely
+        async def ok_post(sub, payload):
+            sub.posts += 1
+            sub.last_post_t = time.monotonic()
+            return {"status": "ok"}
+
+        pub._post = ok_post
+        sub.next_snapshot_t = 0.0
+        await pub.flush()
+        assert not sub.need_snapshot
+        assert sub.snapshot_backoff_s == 0.0
+
+    run(go())
+
+
+def test_publisher_dedupes_subscriber_urls():
+    """The same endpoint listed twice (comma typo / trailing-slash
+    variant) must collapse to ONE cursor — two cursors on one endpoint
+    would ping-pong its seq view stale/resynced every round."""
+    pool = KVBlockPool(16, BLOCK)
+    pub = KVEventPublisher(
+        "http://c:9000,http://c:9000/,http://r:8001",
+        "http://e0", pool.events, None, BLOCK, lambda: None,
+    )
+    assert [s.url for s in pub.subscribers] == \
+        ["http://c:9000", "http://r:8001"]
+
+
+def test_engine_kv_subscriber_env_parsing(monkeypatch):
+    from vllm_production_stack_tpu.engine.server import _kv_subscriber_urls
+
+    monkeypatch.delenv("KV_CONTROLLER_URL", raising=False)
+    assert _kv_subscriber_urls() == []
+    monkeypatch.setenv("KV_CONTROLLER_URL", "http://c:9000")
+    assert _kv_subscriber_urls() == ["http://c:9000"]
+    monkeypatch.setenv(
+        "KV_CONTROLLER_URL",
+        "http://c:9000, http://r0:8001,http://r1:8001 ,",
+    )
+    assert _kv_subscriber_urls() == [
+        "http://c:9000", "http://r0:8001", "http://r1:8001",
+    ]

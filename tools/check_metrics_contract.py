@@ -188,11 +188,14 @@ def _declared_label_sets() -> dict[str, dict[str, tuple[str, ...]]]:
 
 def check_exported_label_sets() -> list[str]:
     """(d): for every metric with a declared closed label set, the engine
-    exporter must render EXACTLY the declared values — the exporters seed
-    closed sets at zero, so a missing value means the seeding (or the
-    declaration) drifted, and an extra value means unbounded cardinality
-    snuck in."""
+    and router exporters (union — a closed set may live on either side of
+    the proxy, e.g. the stickiness reasons engine-side and any future
+    router-side set) must render EXACTLY the declared values — the
+    exporters seed closed sets at zero, so a missing value means the
+    seeding (or the declaration) drifted, and an extra value means
+    unbounded cardinality snuck in."""
     from vllm_production_stack_tpu.engine.metrics import EngineMetrics
+    from vllm_production_stack_tpu.router.metrics import RouterMetrics
 
     declared = _declared_label_sets()
     # contract names spell counters with _total; sample names drop it
@@ -201,23 +204,27 @@ def check_exported_label_sets() -> list[str]:
         for n, labels in declared.items()
     }
     rendered: dict[str, dict[str, set]] = {}
-    for metric in EngineMetrics("contract-check").registry.collect():
-        entry = by_base.get(metric.name)
-        if entry is None:
-            continue
-        name, labels = entry
-        got = rendered.setdefault(name, {lab: set() for lab in labels})
-        for sample in metric.samples:
-            for lab in labels:
-                if lab in sample.labels:
-                    got[lab].add(sample.labels[lab])
+    for registry in (
+        EngineMetrics("contract-check").registry,
+        RouterMetrics().registry,
+    ):
+        for metric in registry.collect():
+            entry = by_base.get(metric.name)
+            if entry is None:
+                continue
+            name, labels = entry
+            got = rendered.setdefault(name, {lab: set() for lab in labels})
+            for sample in metric.samples:
+                for lab in labels:
+                    if lab in sample.labels:
+                        got[lab].add(sample.labels[lab])
     problems: list[str] = []
     for name, labels in declared.items():
         got = rendered.get(name)
         if got is None:
             problems.append(
-                f"{name}: declares closed label sets but the engine "
-                "exporter renders no such metric"
+                f"{name}: declares closed label sets but neither the "
+                "engine nor the router exporter renders such a metric"
             )
             continue
         for lab, want in labels.items():

@@ -580,10 +580,13 @@ class KVBlockPool:
         return list(hashes)
 
     def snapshot_events(self) -> tuple[str, int, list[int]]:
-        """(epoch, seq, hashes) for a consistent index resync: the event
-        buffer is discarded up to `seq` because the snapshot supersedes it.
-        Call with the pool quiesced (engine lock held)."""
+        """(epoch, seq, hashes) for a consistent index resync. The event
+        buffer is NOT cleared — with publisher fan-out other subscribers
+        may still need the buffered events; the publisher's per-subscriber
+        cursors skip anything at or below `seq` for the subscriber this
+        snapshot heals, so nothing double-applies. Call with the pool
+        quiesced (engine lock held)."""
         if self.events is None:
             raise RuntimeError("prefix caching (and its event log) disabled")
-        seq = self.events.snapshot_barrier()
+        seq = self.events.snapshot_mark()
         return self.events.epoch, seq, self.published_hashes()

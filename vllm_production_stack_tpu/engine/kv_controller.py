@@ -62,7 +62,7 @@ class KVController:
     def __init__(self, engine_urls: list[str] | None = None,
                  timeout_s: float = 2.0, mode: str = "indexed",
                  tokenizer=None, base_models: list[str] | None = None,
-                 tenant_table=None):
+                 tenant_table=None, fleet_rate_window_s: float = 30.0):
         if mode not in LOOKUP_MODES:
             raise ValueError(f"unknown KV lookup mode: {mode}")
         self.engines: set[str] = {u.rstrip("/") for u in engine_urls or []}
@@ -83,7 +83,8 @@ class KVController:
         # replicas POST /fleet/report; GET /fleet is the operator view.
         # tenant_table (qos.TenantTable, optional) supplies the per-tenant
         # budget fleet-wide utilization is measured against.
-        self.fleet = FleetView(tenant_table=tenant_table)
+        self.fleet = FleetView(tenant_table=tenant_table,
+                               rate_window_s=fleet_rate_window_s)
         self._http = LazyClientSession(
             timeout=aiohttp.ClientTimeout(total=timeout_s)
         )
@@ -357,6 +358,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "reports against (tpu:fleet_tenant_* on /metrics "
                         "and GET /fleet). Unset = fleet reports are still "
                         "aggregated, utilization gauges are absent")
+    p.add_argument("--fleet-rate-window", type=float, default=30.0,
+                   help="seconds of router-report history the fleet-wide "
+                        "per-tenant admission RATE is measured over "
+                        "(utilization/over-admission smooth over this "
+                        "window; shorter reacts faster, longer dampens "
+                        "report jitter)")
     return p
 
 
@@ -374,6 +381,7 @@ def main(argv: list[str] | None = None) -> None:
         urls, mode=args.mode, tokenizer=hashing_tokenizer(args.tokenizer),
         base_models=[m for m in args.base_models.split(",") if m],
         tenant_table=tenant_table,
+        fleet_rate_window_s=args.fleet_rate_window,
     )
     logger.info("KV controller on %s:%d over %d engines (mode=%s)",
                 args.host, args.port, len(urls), args.mode)

@@ -18,11 +18,16 @@ This module is the engine half of that protocol:
   subscriber answers with a resync request, never a silently wrong index.
 
 - `KVEventPublisher`: a background task owned by the engine server. It
-  flushes batched events to the controller (`POST /kv/events`) on a short
-  interval and falls back to a full snapshot (every currently matchable
-  hash, taken under the engine lock) whenever the controller reports a
-  sequence gap, the epoch changed (pool rebuild), or the connection was
-  down — the classic event-sourcing "resync on reconnect" contract.
+  flushes batched events to EVERY registered subscriber (`POST /kv/events`)
+  on a short jittered interval. Subscribers advance independently: each one
+  keeps its own publish cursor and snapshot-resync state, so a cold router
+  replica joining the fleet (or one that dropped a batch) heals through a
+  full snapshot addressed to it alone while in-sync subscribers keep
+  receiving incremental batches — the fan-out half of ROADMAP 1's
+  multi-replica routing (docs/34-fleet-routing.md). A resync is requested
+  whenever that subscriber reports a sequence gap, the epoch changed (pool
+  rebuild), or its connection was down while drained events were in flight
+  — the classic event-sourcing "resync on reconnect" contract.
 
 Wire format (one POST body):
     {"engine": "<base url>", "epoch": "<uuid>", "block_size": 16,
@@ -45,6 +50,7 @@ import uuid
 from collections import deque
 
 from ..utils.logging import init_logger
+from ..utils.system import jittered_interval
 
 logger = init_logger(__name__)
 
@@ -56,6 +62,20 @@ CLEAR = "c"
 DEFAULT_CAPACITY = 65536
 DEFAULT_FLUSH_INTERVAL_S = 0.5
 MAX_EVENTS_PER_POST = 8192
+# ±fraction of the flush interval each sleep is jittered by, so M router
+# replicas × E engines never converge on synchronized publish ticks (the
+# thundering-herd failure mode on a shared subscriber)
+DEFAULT_JITTER_FRAC = 0.15
+# per-POST bound: a blackholed subscriber (rescheduled pod, dead IP) must
+# cost its OWN pipeline at most this long per round, never the shared
+# session's full connect/total timeout — the log buffer only has to ride
+# out this window before healthy subscribers would see an overflow gap
+DEFAULT_SEND_TIMEOUT_S = 10.0
+# failed snapshot attempts back off exponentially (per subscriber) up to
+# this ceiling: capturing a snapshot costs O(pool) work under the engine
+# lock, and a PERMANENTLY dead subscriber in the fan-out list must not
+# tax the engine's hot path every flush round forever
+SNAPSHOT_BACKOFF_MAX_S = 30.0
 # an idle engine (no cache churn) posts an empty batch this often so the
 # subscriber's liveness TTL (kv_index.DEFAULT_STALE_AFTER_S) can tell
 # "quiet" from "dead" — a crashed publisher must stop winning lookups
@@ -133,23 +153,63 @@ class KVEventLog:
             events = [self._buf.popleft()[1] for _ in range(n)]
             return first_seq, events, oldest_ts
 
-    def snapshot_barrier(self) -> int:
-        """Discard everything buffered and return the current seq — called
-        with the pool quiesced (engine lock held) while the caller captures
-        the full hash set. Buffered events are baked into that snapshot, so
-        shipping them afterwards would double-apply."""
+    def snapshot_mark(self) -> int:
+        """Current seq for a consistent snapshot — called with the pool
+        quiesced (engine lock held) while the caller captures the full hash
+        set. The buffer is deliberately NOT cleared: with fan-out, other
+        subscribers may still need the buffered events, and the publisher's
+        per-subscriber cursors skip anything at or below a subscriber's
+        snapshot seq so nothing double-applies."""
         with self._lock:
-            self._buf.clear()
             return self._seq
 
 
+class _SubscriberState:
+    """One subscriber's publish cursor. Each subscriber resyncs and
+    advances independently, so a cold/failing replica never forces the
+    in-sync ones through a snapshot — per-subscriber batching/resync is
+    what makes publisher fan-out safe (docs/34-fleet-routing.md)."""
+
+    __slots__ = ("url", "need_snapshot", "last_sent_seq", "last_post_t",
+                 "posts", "events_sent", "snapshots_sent",
+                 "publish_failures", "last_error", "snapshot_backoff_s",
+                 "next_snapshot_t")
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.need_snapshot = True  # first contact always resyncs
+        self.last_sent_seq = 0
+        self.last_post_t = 0.0  # monotonic time of the last successful POST
+        self.posts = 0
+        self.events_sent = 0
+        self.snapshots_sent = 0
+        self.publish_failures = 0
+        self.last_error: str | None = None
+        # failed-snapshot backoff (0 = try on the next round): a dead
+        # subscriber's O(pool) snapshot capture must not recur every flush
+        self.snapshot_backoff_s = 0.0
+        self.next_snapshot_t = 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "url": self.url,
+            "need_snapshot": self.need_snapshot,
+            "last_sent_seq": self.last_sent_seq,
+            "posts": self.posts,
+            "events_sent": self.events_sent,
+            "snapshots_sent": self.snapshots_sent,
+            "publish_failures": self.publish_failures,
+            "last_error": self.last_error,
+        }
+
+
 class KVEventPublisher:
-    """Flushes one engine's KVEventLog to the cluster KV index subscriber
-    (KV controller, or a router in embedded-index mode)."""
+    """Flushes one engine's KVEventLog to every cluster KV index subscriber
+    (the KV controller, router replicas in embedded-index mode, or both)."""
 
     def __init__(
         self,
-        controller_url: str,
+        subscriber_urls: str | list[str],
         engine_url: str,
         log: KVEventLog,
         snapshot_fn,
@@ -157,12 +217,28 @@ class KVEventPublisher:
         session_factory,
         interval_s: float = DEFAULT_FLUSH_INTERVAL_S,
         headers: dict | None = None,
+        jitter_frac: float = DEFAULT_JITTER_FRAC,
+        send_timeout_s: float = DEFAULT_SEND_TIMEOUT_S,
     ):
-        """snapshot_fn: async callable -> (epoch, seq, list[int] hashes),
-        taken consistently (under the engine lock). session_factory: zero-arg
-        callable returning the shared aiohttp.ClientSession. headers: extra
-        request headers, e.g. the bearer key a keyed subscriber requires."""
-        self.controller_url = controller_url.rstrip("/")
+        """subscriber_urls: one base URL, a comma-separated string, or a
+        list — every subscriber gets every batch, each with its own resync
+        state. snapshot_fn: async callable -> (epoch, seq, list[int]
+        hashes), taken consistently (under the engine lock).
+        session_factory: zero-arg callable returning the shared
+        aiohttp.ClientSession. headers: extra request headers, e.g. the
+        bearer key a keyed subscriber requires."""
+        if isinstance(subscriber_urls, str):
+            subscriber_urls = [
+                u.strip() for u in subscriber_urls.split(",") if u.strip()
+            ]
+        # normalize + dedupe: the same endpoint listed twice (comma-list
+        # typo, trailing-slash variant) would mean two cursors fighting
+        # over its ONE per-engine seq view — every round the second
+        # arrival reads as a gap and the entry ping-pongs stale/resynced
+        self.subscribers = [
+            _SubscriberState(u)
+            for u in dict.fromkeys(u.rstrip("/") for u in subscriber_urls)
+        ]
         self.headers = headers or {}
         self.engine_url = engine_url
         self.log = log
@@ -170,18 +246,37 @@ class KVEventPublisher:
         self.block_size = block_size
         self._session_factory = session_factory
         self.interval_s = interval_s
-        self._need_snapshot = True  # first contact always resyncs
-        self._last_sent_seq = 0
-        self._last_post_t = 0.0  # monotonic time of the last successful POST
+        self.jitter_frac = jitter_frac
+        self.send_timeout_s = send_timeout_s
         self._task: asyncio.Task | None = None
-        # counters for /debug + tests + the publisher-health contract
-        # names (tpu:kv_event_publish_{batches,failures}_total — `posts`
-        # is the batches counter: every successful POST incl. heartbeats
-        # and snapshots)
-        self.posts = 0
-        self.events_sent = 0
-        self.snapshots_sent = 0
-        self.publish_failures = 0
+        # flush-loop faults not attributable to one subscriber (e.g. the
+        # snapshot_fn itself); per-subscriber transport faults land on the
+        # subscriber's own counter and both roll up in publish_failures
+        self._loop_failures = 0
+
+    # -- aggregate counters (metrics contract names keep reading the same
+    # publisher-vantage totals whether one subscriber is configured or M:
+    # tpu:kv_event_publish_{batches,failures}_total) ----------------------
+
+    @property
+    def posts(self) -> int:
+        """Successful POSTs across all subscribers (incl. heartbeats and
+        snapshots) — the batches counter."""
+        return sum(s.posts for s in self.subscribers)
+
+    @property
+    def events_sent(self) -> int:
+        return sum(s.events_sent for s in self.subscribers)
+
+    @property
+    def snapshots_sent(self) -> int:
+        return sum(s.snapshots_sent for s in self.subscribers)
+
+    @property
+    def publish_failures(self) -> int:
+        return self._loop_failures + sum(
+            s.publish_failures for s in self.subscribers
+        )
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._run())
@@ -195,6 +290,12 @@ class KVEventPublisher:
                 pass
             self._task = None
 
+    def _next_interval(self) -> float:
+        """The next sleep, jittered so engines never POST to the shared
+        subscribers on synchronized ticks (utils.system.jittered_interval
+        is the one shared herd-avoidance policy)."""
+        return jittered_interval(self.interval_s, self.jitter_frac)
+
     async def _run(self) -> None:
         while True:
             try:
@@ -202,92 +303,181 @@ class KVEventPublisher:
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # keep publishing through faults
-                # flush() marks _need_snapshot itself when drained events
-                # were actually lost; a failed heartbeat or snapshot POST
-                # loses nothing, so don't force a full resync here
-                self.publish_failures += 1
+                # per-subscriber transport faults are handled inside
+                # flush(); whatever reaches here (snapshot_fn and other
+                # shared-path faults) loses no subscriber-attributed events
+                self._loop_failures += 1
                 logger.debug("kv event flush failed: %s", e)
-            await asyncio.sleep(self.interval_s)
+            await asyncio.sleep(self._next_interval())
 
-    async def _post(self, payload: dict) -> dict:
+    async def _post(self, sub: _SubscriberState, payload: dict) -> dict:
         sess = self._session_factory()
         async with sess.post(
-            self.controller_url + "/kv/events", json=payload,
-            headers=self.headers,
+            sub.url + "/kv/events", json=payload, headers=self.headers,
         ) as resp:
             if resp.status != 200:
-                raise RuntimeError(f"controller returned HTTP {resp.status}")
-            self.posts += 1
-            self._last_post_t = time.monotonic()
+                raise RuntimeError(f"subscriber returned HTTP {resp.status}")
+            sub.posts += 1
+            sub.last_post_t = time.monotonic()
             return await resp.json()
 
     async def flush(self) -> None:
-        """One publish round: snapshot if needed, else drain-and-send every
-        buffered batch. Raises on transport faults; a full resync is queued
-        only when drained events were actually lost in flight — failed
-        heartbeats/snapshots lose nothing and just retry next round."""
-        if self._need_snapshot:
+        """One publish round. The shared log is drained ONCE into this
+        round's batch list; every subscriber then runs its OWN send
+        pipeline concurrently (snapshot if owed — one capture serves them
+        all — then the batches, then a heartbeat if idle), so a slow or
+        blackholed subscriber never head-of-line blocks delivery to the
+        healthy ones: it can only stretch the round's tail, and each POST
+        is additionally bounded by send_timeout_s. Per-subscriber faults
+        never raise — they mark only that subscriber for resync (a failed
+        heartbeat or snapshot loses nothing and just retries next round; a
+        failed event batch lost those events FOR THAT SUBSCRIBER and owes
+        it a snapshot)."""
+        snapshot = None
+        now = time.monotonic()
+        if any(
+            s.need_snapshot and now >= s.next_snapshot_t
+            for s in self.subscribers
+        ):
+            # capture only when an owed subscriber's attempt is actually
+            # due (failed attempts back off): the O(pool) capture under
+            # the engine lock must not recur every round for a dead URL
             epoch, seq, hashes = await self._snapshot_fn()
-            data = await self._post({
+            snapshot = (epoch, seq, [f"{h:x}" for h in hashes])
+        batches = []
+        while True:
+            seq_start, events, oldest_ts = self.log.drain_timed()
+            if not events:
+                break
+            batches.append((seq_start, events, oldest_ts))
+        now = time.monotonic()
+        await asyncio.gather(*(
+            self._subscriber_round(s, snapshot, batches, now)
+            for s in self.subscribers
+        ))
+
+    async def _subscriber_round(
+        self, sub: _SubscriberState, snapshot, batches: list, now: float,
+    ) -> None:
+        if (
+            sub.need_snapshot and snapshot is not None
+            and now >= sub.next_snapshot_t
+        ):
+            await self._send_snapshot(sub, *snapshot)
+        for seq_start, events, oldest_ts in batches:
+            await self._send_batch(sub, seq_start, events, oldest_ts)
+        if (
+            not sub.need_snapshot
+            and now - sub.last_post_t >= HEARTBEAT_INTERVAL_S
+        ):
+            await self._send_heartbeat(sub)
+
+    async def _send_snapshot(
+        self, sub: _SubscriberState, epoch: str, seq: int,
+        hex_hashes: list[str],
+    ) -> None:
+        try:
+            data = await asyncio.wait_for(self._post(sub, {
                 "engine": self.engine_url,
                 "epoch": epoch,
                 "block_size": self.block_size,
                 "snapshot": True,
                 "seq": seq,
-                "hashes": [f"{h:x}" for h in hashes],
+                "hashes": hex_hashes,
                 "ts": time.time(),
-            })
+            }), self.send_timeout_s)
             if data.get("resync") or data.get("status") == "error":
                 raise RuntimeError(
-                    f"controller rejected snapshot: {data.get('error') or data}"
+                    f"subscriber rejected snapshot: "
+                    f"{data.get('error') or data}"
                 )
-            self.snapshots_sent += 1
-            self._last_sent_seq = seq
-            self._need_snapshot = False
-        while True:
-            seq_start, events, oldest_ts = self.log.drain_timed()
-            if not events:
-                if (
-                    time.monotonic() - self._last_post_t
-                    >= HEARTBEAT_INTERVAL_S
-                ):
-                    # liveness heartbeat: an empty in-sequence batch — the
-                    # subscriber applies nothing but refreshes last_event_t
-                    data = await self._post({
-                        "engine": self.engine_url,
-                        "epoch": self.log.epoch,
-                        "block_size": self.block_size,
-                        "seq_start": self._last_sent_seq + 1,
-                        "events": [],
-                        "ts": time.time(),
-                    })
-                    if data.get("resync"):  # e.g. subscriber restarted
-                        self._need_snapshot = True
-                return
-            if seq_start != self._last_sent_seq + 1:
-                # local overflow dropped events between flushes — the index
-                # is unrecoverable from the buffer; resync next round
-                self._need_snapshot = True
-                return
-            try:
-                data = await self._post({
-                    "engine": self.engine_url,
-                    "epoch": self.log.epoch,
-                    "block_size": self.block_size,
-                    "seq_start": seq_start,
-                    "events": events,
-                    # emit time of the OLDEST event in the batch: the
-                    # subscriber's publish→apply lag measurement covers
-                    # in-buffer dwell, not just the POST hop
-                    "ts": oldest_ts,
-                })
-            except Exception:
-                # these events left the log buffer and never arrived — the
-                # subscriber's slice is now unrecoverable without a resync
-                self._need_snapshot = True
-                raise
-            self.events_sent += len(events)
-            self._last_sent_seq = seq_start + len(events) - 1
-            if data.get("resync"):
-                self._need_snapshot = True
-                return
+        except Exception as e:
+            # nothing was lost — the snapshot retries after a per-
+            # subscriber exponential backoff (a permanently dead URL must
+            # not re-trigger the O(pool) capture every round)
+            sub.publish_failures += 1
+            sub.last_error = f"{type(e).__name__}: {e}"
+            sub.snapshot_backoff_s = min(
+                SNAPSHOT_BACKOFF_MAX_S,
+                max(self.interval_s, 2 * sub.snapshot_backoff_s),
+            )
+            sub.next_snapshot_t = time.monotonic() + sub.snapshot_backoff_s
+            logger.debug("kv snapshot to %s failed: %s", sub.url, e)
+            return
+        sub.snapshots_sent += 1
+        sub.last_sent_seq = seq
+        sub.need_snapshot = False
+        sub.last_error = None
+        sub.snapshot_backoff_s = 0.0
+        sub.next_snapshot_t = 0.0
+
+    async def _send_batch(
+        self, sub: _SubscriberState, seq_start: int, events: list,
+        oldest_ts: float | None,
+    ) -> None:
+        if sub.need_snapshot:
+            return  # resync pending; batches resume after its snapshot
+        if seq_start > sub.last_sent_seq + 1:
+            # local overflow dropped events between this subscriber's
+            # cursor and the batch — its slice is unrecoverable from the
+            # buffer; resync next round
+            sub.need_snapshot = True
+            return
+        # events at or below the cursor are already baked into this
+        # subscriber's snapshot (the log's snapshot_mark doesn't clear the
+        # shared buffer) or were delivered in an earlier round — skip them
+        skip = sub.last_sent_seq + 1 - seq_start
+        if skip >= len(events):
+            return
+        try:
+            data = await asyncio.wait_for(self._post(sub, {
+                "engine": self.engine_url,
+                "epoch": self.log.epoch,
+                "block_size": self.block_size,
+                "seq_start": seq_start + skip,
+                # emit time of the OLDEST event in the DRAINED batch: lag
+                # covers in-buffer dwell; for a sliced batch it slightly
+                # overestimates (rare: only right after a snapshot)
+                "ts": oldest_ts,
+                "events": events[skip:],
+            }), self.send_timeout_s)
+        except Exception as e:
+            # these events left the shared buffer and never arrived HERE —
+            # only this subscriber's slice needs the snapshot
+            sub.need_snapshot = True
+            sub.publish_failures += 1
+            sub.last_error = f"{type(e).__name__}: {e}"
+            logger.debug("kv event batch to %s failed: %s", sub.url, e)
+            return
+        sub.events_sent += len(events) - skip
+        sub.last_sent_seq = seq_start + len(events) - 1
+        sub.last_error = None
+        if data.get("resync"):  # e.g. subscriber restarted / epoch change
+            sub.need_snapshot = True
+
+    async def _send_heartbeat(self, sub: _SubscriberState) -> None:
+        try:
+            data = await asyncio.wait_for(self._post(sub, {
+                "engine": self.engine_url,
+                "epoch": self.log.epoch,
+                "block_size": self.block_size,
+                "seq_start": sub.last_sent_seq + 1,
+                "events": [],
+                "ts": time.time(),
+            }), self.send_timeout_s)
+        except Exception as e:
+            # a failed heartbeat loses nothing; no resync owed
+            sub.publish_failures += 1
+            sub.last_error = f"{type(e).__name__}: {e}"
+            logger.debug("kv heartbeat to %s failed: %s", sub.url, e)
+            return
+        if data.get("resync"):
+            sub.need_snapshot = True
+
+    def debug_snapshot(self) -> dict:
+        """Per-subscriber cursor view for /debug introspection."""
+        return {
+            "interval_s": self.interval_s,
+            "jitter_frac": self.jitter_frac,
+            "subscribers": [s.snapshot() for s in self.subscribers],
+        }

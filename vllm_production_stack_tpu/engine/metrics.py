@@ -383,9 +383,16 @@ class EngineMetrics:
             "KV events buffered awaiting flush (pinned at capacity = the "
             "publisher cannot keep up and a resync gap is imminent)",
         )
+        self.kv_event_subscribers = gauge(
+            mc.KV_EVENT_SUBSCRIBERS,
+            "Subscribers this engine's KV event publisher fans batches out "
+            "to (the controller, embedded-index router replicas, or both; "
+            "0 = no publisher configured)",
+        )
         self.kv_event_batches.labels(**self._labels)
         self.kv_event_failures.labels(**self._labels)
         self.kv_event_queue_depth.labels(**self._labels).set(0)
+        self.kv_event_subscribers.labels(**self._labels).set(0)
         # -- multi-tenant QoS (docs/27-multitenancy.md): tenant-labeled
         # series; cardinality bounded by qos.TenantAccounting.MAX_TENANTS
         tlabels = [*names, "tenant"]
@@ -603,15 +610,18 @@ class EngineMetrics:
         publish_batches: int = 0,
         publish_failures: int = 0,
         pending_depth: int = 0,
+        subscribers: int = 0,
         stickiness: dict[str, int] | None = None,
     ) -> None:
         """Fleet-coherence series owned by the HTTP server rather than the
         engine snapshot (docs/32-fleet-telemetry.md): KV event publisher
-        health counters and the stickiness-audit violation counts, bumped
-        delta-style from their monotonic owners at scrape time."""
+        health counters, the fan-out subscriber count, and the
+        stickiness-audit violation counts, bumped delta-style from their
+        monotonic owners at scrape time."""
         self._bump(self.kv_event_batches, "kvev_batches", publish_batches)
         self._bump(self.kv_event_failures, "kvev_failures", publish_failures)
         self.kv_event_queue_depth.labels(**self._labels).set(pending_depth)
+        self.kv_event_subscribers.labels(**self._labels).set(subscribers)
         for reason, total in (stickiness or {}).items():
             if reason in mc.STICKINESS_REASON_VALUES:
                 self._bump_labeled(

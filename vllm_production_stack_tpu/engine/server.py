@@ -93,6 +93,17 @@ def deadline_from_headers(headers) -> float | None:
     return time.monotonic() + ms / 1000.0
 
 
+def _kv_subscriber_urls() -> list[str]:
+    """KV_CONTROLLER_URL parsed as a comma-separated subscriber list: the
+    KV controller, embedded-index router replicas, or any mix — the KV
+    event publisher fans batches out to all of them and registration runs
+    against each (docs/34-fleet-routing.md)."""
+    import os
+
+    raw = os.environ.get("KV_CONTROLLER_URL") or ""
+    return [u.strip() for u in raw.split(",") if u.strip()]
+
+
 class _StreamUnsupported(Exception):
     """Sender has no /kv/export_stream (older engine) — use the npz hop."""
 
@@ -219,21 +230,25 @@ class EngineServer:
             pass
 
     def _start_kv_event_publisher(self) -> None:
-        """Push-based cluster KV index: publish this pool's KV events to the
-        controller named by KV_CONTROLLER_URL so /lookup never has to probe
-        this engine per request (engine/kv_events.py)."""
+        """Push-based cluster KV index: publish this pool's KV events to
+        every subscriber named by KV_CONTROLLER_URL (comma-separated — the
+        KV controller, embedded-index router replicas, or both) so /lookup
+        never has to probe this engine per request (engine/kv_events.py).
+        Each subscriber keeps its own cursor/resync state, so a cold router
+        replica heals through its own snapshot while the rest stream
+        batches (docs/34-fleet-routing.md)."""
         import os
 
-        controller = os.environ.get("KV_CONTROLLER_URL")
+        subscribers = _kv_subscriber_urls()
         pod_ip = os.environ.get("POD_IP")
         pool = self.engine.scheduler.pool
-        if not controller or not pod_ip or pool.events is None:
+        if not subscribers or not pod_ip or pool.events is None:
             return
         from .kv_events import DEFAULT_FLUSH_INTERVAL_S, KVEventPublisher
 
         port = os.environ.get("ENGINE_PORT", "8000")
         self.kv_event_publisher = KVEventPublisher(
-            controller,
+            subscribers,
             f"http://{pod_ip}:{port}",
             pool.events,
             self.async_engine.kv_events_snapshot,
@@ -246,7 +261,8 @@ class EngineServer:
         )
         self.kv_event_publisher.start()
         logger.info("KV event publisher -> %s (flush every %.2fs)",
-                    controller, self.kv_event_publisher.interval_s)
+                    ", ".join(subscribers),
+                    self.kv_event_publisher.interval_s)
 
     @staticmethod
     def _kv_controller_headers() -> dict:
@@ -258,29 +274,37 @@ class EngineServer:
         return {"Authorization": f"Bearer {key}"} if key else {}
 
     async def _register_with_kv_controller(self, endpoint: str) -> None:
-        """Join/leave the KV controller's engine set when deployed with
-        KV_CONTROLLER_URL (+POD_IP/ENGINE_PORT from the operator's downward
-        API) — the LMCACHE_CONTROLLER_URL contract
-        (deployment-vllm-multi.yaml:324-339)."""
+        """Join/leave every KV subscriber's engine set when deployed with
+        KV_CONTROLLER_URL (comma-separated; +POD_IP/ENGINE_PORT from the
+        operator's downward API) — the LMCACHE_CONTROLLER_URL contract
+        (deployment-vllm-multi.yaml:324-339), fanned out so embedded-index
+        router replicas see the same membership the controller does."""
         import os
 
-        controller = os.environ.get("KV_CONTROLLER_URL")
+        subscribers = _kv_subscriber_urls()
         pod_ip = os.environ.get("POD_IP")
-        if not controller or not pod_ip:
+        if not subscribers or not pod_ip:
             return
         port = os.environ.get("ENGINE_PORT", "8000")
         my_url = f"http://{pod_ip}:{port}"
-        try:
-            async with self._client_session().post(
-                controller.rstrip("/") + endpoint, json={"url": my_url},
-                headers=self._kv_controller_headers(),
-            ) as resp:
-                logger.info(
-                    "KV controller %s%s (%s): HTTP %d",
-                    controller, endpoint, my_url, resp.status,
-                )
-        except Exception as e:
-            logger.warning("KV controller %s failed: %s", endpoint, e)
+
+        async def post_one(controller: str) -> None:
+            try:
+                async with self._client_session().post(
+                    controller.rstrip("/") + endpoint, json={"url": my_url},
+                    headers=self._kv_controller_headers(),
+                ) as resp:
+                    logger.info(
+                        "KV controller %s%s (%s): HTTP %d",
+                        controller, endpoint, my_url, resp.status,
+                    )
+            except Exception as e:
+                logger.warning("KV controller %s failed: %s", endpoint, e)
+
+        # concurrent, not sequential: one unreachable subscriber must not
+        # delay registration with (or, worse, shutdown deregistration
+        # from) the healthy ones by its full connect timeout
+        await asyncio.gather(*(post_one(c) for c in subscribers))
 
     async def _on_cleanup(self, app: web.Application) -> None:
         if self.kv_event_publisher is not None:
@@ -1333,6 +1357,7 @@ class EngineServer:
                 events_log.pending_depth()
                 if pub is not None and events_log is not None else 0
             ),
+            subscribers=len(pub.subscribers) if pub is not None else 0,
             stickiness=self.stickiness.counts(),
         )
         payload = self.metrics.render(

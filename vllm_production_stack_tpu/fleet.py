@@ -304,12 +304,15 @@ class _ReplicaState:
 
     __slots__ = ("replica_id", "recv_t", "report_ts", "ring_hash",
                  "breakers", "has_index", "positions", "tenants", "history",
-                 "divergence_blocks")
+                 "divergence_blocks", "enforcing", "report_interval")
 
     def __init__(self, replica_id: str):
         self.replica_id = replica_id
         self.recv_t = 0.0
         self.report_ts = 0.0
+        # the cadence the replica says it reports at (0 = unknown):
+        # enforcing_count sizes its per-replica liveness window from it
+        self.report_interval = 0.0
         self.ring_hash = ""
         self.breakers: dict = {}
         # True when the replica hosts an embedded index at all — an EMPTY
@@ -322,6 +325,12 @@ class _ReplicaState:
         # (recv_t, {tenant: requests_total}) samples for rate computation
         self.history: deque = deque(maxlen=64)
         self.divergence_blocks: int | None = None
+        # True when the replica runs a QoS gate (it admits tenant traffic
+        # against local buckets) — only these count toward the budget-
+        # scaling denominator M (docs/34-fleet-routing.md): a report-only
+        # replica enforces nothing, so counting it would make the
+        # enforcing ones admit BELOW the global budget forever
+        self.enforcing = False
 
 
 class FleetView:
@@ -332,12 +341,17 @@ class FleetView:
     router must not pin a stale ring hash or tenant rate forever)."""
 
     def __init__(self, tenant_table=None, rate_window_s: float = 30.0,
-                 expire_after_s: float = 120.0):
+                 expire_after_s: float = 120.0, live_within_s: float = 30.0):
         # qos.tenants.TenantTable (or None): the per-tenant budget the
         # fleet-wide utilization is measured against
         self.tenant_table = tenant_table
         self.rate_window_s = rate_window_s
         self.expire_after_s = expire_after_s
+        # the budget-scaling denominator uses this TIGHTER liveness window
+        # (not expire_after_s): a rolling restart mints new replica ids,
+        # and counting a replaced pod for the full expiry would make the
+        # live replicas enforce 1/(2M) shares for minutes per deploy
+        self.live_within_s = live_within_s
         self._lock = threading.Lock()
         self._replicas: dict[str, _ReplicaState] = {}
         self.reports_applied = 0
@@ -359,9 +373,11 @@ class FleetView:
         # reply, not escape as a 500 every report interval
         try:
             report_ts = float(report.get("ts") or 0.0)
+            report_interval = float(report.get("interval") or 0.0)
             ring_hash = str(report.get("ring_hash") or "")
             breakers = dict(report.get("breakers") or {})
             has_index = "index" in report
+            enforcing = bool(report.get("enforcing"))
             positions = dict(report.get("index") or {})
             tenants = {
                 str(t): {
@@ -380,9 +396,11 @@ class FleetView:
                 st = self._replicas[replica_id] = _ReplicaState(replica_id)
             st.recv_t = now
             st.report_ts = report_ts
+            st.report_interval = report_interval
             st.ring_hash = ring_hash
             st.breakers = breakers
             st.has_index = has_index
+            st.enforcing = enforcing
             st.positions = positions
             st.tenants = tenants
             st.history.append((
@@ -401,6 +419,9 @@ class FleetView:
         return {
             "status": "ok",
             "replicas": self.replica_count(),
+            # the budget-scaling denominator: QoS-enforcing replicas heard
+            # from within the tight liveness window (see enforcing_count)
+            "enforcing_replicas": self.enforcing_count(),
             "divergence_blocks": divergence,
             "ring_divergent": ring_divergent,
             "tenants": self.tenant_rollup(),
@@ -424,6 +445,27 @@ class FleetView:
     def replica_count(self) -> int:
         with self._lock:
             return len(self._replicas)
+
+    def enforcing_count(self) -> int:
+        """Replicas that run a QoS gate AND were heard from recently —
+        the M fleet budget scaling divides by. 'Recently' is 3 of the
+        replica's OWN reported intervals (the standard freshness rule),
+        floored at live_within_s so sub-second test cadences don't flap;
+        a fleet reporting slower than live_within_s/3 therefore still
+        counts as live instead of silently collapsing scaling to 1.
+        Excludes report-only replicas (nothing to scale there; their
+        presence must not starve tenants below the global budget) and the
+        ids a rolling restart leaves behind (they age out of this count
+        in a few intervals, not the full expire_after_s)."""
+        now = time.monotonic()
+        with self._lock:
+            return sum(
+                1 for st in self._replicas.values()
+                if st.enforcing
+                and now - st.recv_t <= max(
+                    self.live_within_s, 3 * st.report_interval
+                )
+            )
 
     def tenant_rollup(self) -> dict[str, dict]:
         """Fleet-wide per-tenant accounting: admitted request rate summed

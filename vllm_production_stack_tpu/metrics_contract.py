@@ -328,6 +328,17 @@ ROUTER_DISCOVERY_ENDPOINTS = "tpu:router_discovery_endpoints"
 KV_EVENT_PUBLISH_BATCHES = "tpu:kv_event_publish_batches_total"
 KV_EVENT_PUBLISH_FAILURES = "tpu:kv_event_publish_failures_total"
 KV_EVENT_QUEUE_DEPTH = "tpu:kv_event_pending_queue_depth"
+# gauge: subscribers the engine's KV event publisher fans batches out to
+# (KV_CONTROLLER_URL is a comma-separated list — the controller, embedded-
+# index router replicas, or both; each keeps its own cursor/resync state).
+# 0 = no publisher configured (docs/34-fleet-routing.md).
+KV_EVENT_SUBSCRIBERS = "tpu:kv_event_subscribers"
+# router gauge: the share of each tenant's GLOBAL budget this replica's
+# local token buckets enforce (fleet budget scaling, 1/M for M live
+# replicas per the controller's /fleet/report reply). 1.0 = full local
+# budget — either a single replica, scaling off, or the controller-outage
+# degradation (fail open toward availability, never stricter).
+ROUTER_TENANT_BUDGET_SCALE = "tpu:router_tenant_budget_scale"
 
 # closed reason set — the SINGLE definition (fleet.STICKINESS_REASONS
 # aliases it, so the audit and the exporter can never drift). Registered
@@ -379,8 +390,9 @@ ALL_GAUGES = (
     # KV flow telemetry (docs/30-kv-flow-telemetry.md)
     KV_TIER_BANDWIDTH,
     # fleet-coherence telemetry (docs/32-fleet-telemetry.md): engine-side
-    # KV event publisher backlog
+    # KV event publisher backlog + fan-out subscriber count
     KV_EVENT_QUEUE_DEPTH,
+    KV_EVENT_SUBSCRIBERS,
 )
 ALL_COUNTERS = (
     PREFIX_CACHE_HITS,

@@ -89,6 +89,12 @@ class QoSGate:
         self._pending: dict[tuple[str, str], float] = {}
         self._totals: dict[tuple[str, str], float] = {}
         self.reloads = 0
+        # fleet budget scaling (docs/34-fleet-routing.md): the share of
+        # each tenant's GLOBAL budget this replica's buckets enforce.
+        # Driven by the fleet reporter from the controller's replica
+        # count; exported as tpu:router_tenant_budget_scale.
+        self.budget_scale = 1.0
+        self.budget_replicas = 1
 
     # -- table lifecycle ---------------------------------------------------
 
@@ -102,6 +108,27 @@ class QoSGate:
         logger.info(
             "tenant table reloaded (#%d): %d tenant(s)",
             self.reloads, len(table),
+        )
+
+    def set_fleet_scale(self, replicas: int) -> None:
+        """Scale local buckets to a 1/M share of each tenant's global
+        budget, M = live router replica count from the controller's
+        /fleet/report reply — N replicas each granting the full budget
+        over-admit ≈ N-1×; N at 1/N each admit ~the global limit with no
+        synchronous hop on the admission path. replicas <= 1 (single
+        replica, or the fleet reporter's controller-outage degradation)
+        restores the full local budget: fail open toward availability,
+        never stricter."""
+        m = max(1, int(replicas))
+        scale = 1.0 / m
+        if scale == self.budget_scale:
+            return
+        self.budget_scale = scale
+        self.budget_replicas = m
+        self.limiter.set_rate_scale(scale)
+        logger.info(
+            "fleet budget scaling: %d live replica(s) -> local share %.3f",
+            m, scale,
         )
 
     # -- identity ----------------------------------------------------------

@@ -62,43 +62,53 @@ class TokenBucket:
 
 
 class _TenantState:
-    def __init__(self, policy: TenantPolicy):
+    def __init__(self, policy: TenantPolicy, scale: float = 1.0):
         self.policy = policy
         self.rps: TokenBucket | None = None
         self.tpm: TokenBucket | None = None
         self.in_flight = 0
-        self._configure(policy)
+        self._configure(policy, scale)
 
-    def _configure(self, policy: TenantPolicy) -> None:
+    def _configure(self, policy: TenantPolicy, scale: float) -> None:
+        """`scale` is the fleet budget share this replica enforces
+        (docs/34-fleet-routing.md): bucket RATES and bursts are the
+        configured limits × scale, so M replicas at 1/M each admit ~the
+        global budget — and every Retry-After derives from bucket.rate, so
+        a scaled bucket advertises the scaled refill time, not the full-
+        rate one. max_concurrent stays unscaled: concurrency guards this
+        replica's own resources, not a fleet-wide rate."""
         if policy.requests_per_s > 0:
             # burst = one second's worth (>= 1): a tenant at 10 req/s may
             # legally arrive as a 10-request burst each second
+            rate = policy.requests_per_s * scale
             if self.rps is None:
-                self.rps = TokenBucket(
-                    policy.requests_per_s, max(1.0, policy.requests_per_s)
-                )
+                self.rps = TokenBucket(rate, max(1.0, rate))
             else:
-                self.rps.rate = policy.requests_per_s
-                self.rps.burst = max(1.0, policy.requests_per_s)
+                self.rps.rate = max(rate, 1e-9)
+                self.rps.burst = max(1.0, rate)
+                self.rps._level = min(self.rps._level, self.rps.burst)
         else:
             self.rps = None
         if policy.tokens_per_min > 0:
+            tpm = policy.tokens_per_min * scale
             if self.tpm is None:
-                self.tpm = TokenBucket(
-                    policy.tokens_per_min / 60.0, policy.tokens_per_min
-                )
+                # same explicit >=1 burst floor as the update path below —
+                # admission must not depend on whether the tenant predates
+                # a table reload
+                self.tpm = TokenBucket(tpm / 60.0, max(1.0, tpm))
             else:
-                self.tpm.rate = policy.tokens_per_min / 60.0
-                self.tpm.burst = policy.tokens_per_min
+                self.tpm.rate = max(tpm / 60.0, 1e-9)
+                self.tpm.burst = max(1.0, tpm)
+                self.tpm._level = min(self.tpm._level, self.tpm.burst)
         else:
             self.tpm = None
 
-    def update(self, policy: TenantPolicy) -> None:
+    def update(self, policy: TenantPolicy, scale: float = 1.0) -> None:
         """Refresh limits in place — bucket LEVELS survive a hot reload so
         a mid-traffic weight/limit change can't hand every tenant a fresh
         burst allowance."""
         self.policy = policy
-        self._configure(policy)
+        self._configure(policy, scale)
 
 
 class TenantLimiter:
@@ -107,6 +117,9 @@ class TenantLimiter:
     def __init__(self, table: TenantTable):
         self._lock = threading.Lock()
         self._states: dict[str, _TenantState] = {}
+        # fleet budget share (docs/34-fleet-routing.md): 1.0 = full local
+        # budget; 1/M when the fleet reporter learns M replicas are live
+        self._scale = 1.0
         self.update_table(table)
 
     def update_table(self, table: TenantTable) -> None:
@@ -115,11 +128,30 @@ class TenantLimiter:
             for policy in [*table.policies(), table.default_policy]:
                 prev = self._states.get(policy.tenant_id)
                 if prev is not None:
-                    prev.update(policy)
+                    prev.update(policy, self._scale)
                     fresh[policy.tenant_id] = prev
                 else:
-                    fresh[policy.tenant_id] = _TenantState(policy)
+                    fresh[policy.tenant_id] = _TenantState(
+                        policy, self._scale
+                    )
             self._states = fresh
+
+    @property
+    def rate_scale(self) -> float:
+        return self._scale
+
+    def set_rate_scale(self, scale: float) -> None:
+        """Re-rate every tenant's buckets in place to `scale` × the
+        configured limits (levels survive, clamped to the new burst).
+        Clamped to (0, 1]: scaling can only tighten toward a fleet share,
+        never loosen past the configured budget."""
+        scale = min(1.0, max(1e-6, scale))
+        with self._lock:
+            if scale == self._scale:
+                return
+            self._scale = scale
+            for st in self._states.values():
+                st.update(st.policy, scale)
 
     def _state(self, tenant_id: str) -> _TenantState | None:
         return self._states.get(tenant_id)

@@ -586,6 +586,9 @@ def build_app(args) -> web.Application:
                 state, fleet_url,
                 interval_s=args.fleet_report_interval,
                 replica_id=getattr(args, "router_replica_id", "") or "",
+                budget_scaling=(
+                    getattr(args, "fleet_budget_scaling", "on") != "off"
+                ),
             )
             await state.fleet_reporter.start()
         if state.batch_service is not None:

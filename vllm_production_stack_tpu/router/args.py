@@ -105,6 +105,13 @@ def build_parser() -> argparse.ArgumentParser:
              "long answer is not a fault) and rely on --upstream-sock-read-s",
     )
     u.add_argument(
+        "--upstream-connector-limit", type=int, default=0,
+        help="max concurrent upstream connections held by the proxy "
+             "(0 = unlimited, the default). aiohttp's own default of 100 "
+             "would silently queue a 10k-concurrent-stream replica behind "
+             "100 upstream sockets (docs/34-fleet-routing.md)",
+    )
+    u.add_argument(
         "--default-deadline-ms", type=float, default=0.0,
         help="inject x-request-deadline-ms on proxied requests that don't "
              "carry one: engines shed work they can't start in time (429/"
@@ -150,7 +157,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="seconds between fleet coherence reports (ring membership "
              "hash, embedded KV-index positions, breaker states, "
              "per-tenant drained counters) POSTed to --fleet-report-url; "
-             "0 disables reporting even with a URL configured",
+             "0 disables reporting even with a URL configured. Jittered "
+             "±15%% so replicas don't tick in lockstep",
+    )
+    f.add_argument(
+        "--fleet-budget-scaling", choices=["on", "off"], default="on",
+        help="scale local tenant token buckets to a 1/M share of each "
+             "tenant's global budget, M = the live replica count from the "
+             "controller's /fleet/report reply (docs/34-fleet-routing.md) "
+             "— closes the N-replica over-admission gap (~N-1x) without a "
+             "synchronous hop on admission. Degrades to the full local "
+             "budget when the controller goes silent past 3 report "
+             "intervals. Needs fleet reporting and a tenant table; 'off' "
+             "restores the report-only PR 9 behavior",
     )
 
     x = p.add_argument_group("extensions")

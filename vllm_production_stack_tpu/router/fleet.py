@@ -8,9 +8,15 @@ controller rolls up into fleet-wide tenant accounting.
 
 The reply rides back fleet-level signals this replica cannot compute
 alone — its index divergence against the controller's authoritative
-index, fleet tenant utilization/over-admission, and the ring-divergence
-flag — and RouterMetrics re-exports them, so the fleet view is scrapeable
-at every replica without adding a scrape target.
+index, fleet tenant utilization/over-admission, the ring-divergence
+flag, and the live replica count — and RouterMetrics re-exports them, so
+the fleet view is scrapeable at every replica without adding a scrape
+target. The replica count also CLOSES the tenant-budget loop
+(docs/34-fleet-routing.md): with budget scaling on, the reporter re-rates
+this replica's local token buckets to a 1/M share of each tenant's
+global budget, and degrades back to the full local budget when the
+controller goes silent — no synchronous hop ever lands on the admission
+path.
 """
 
 from __future__ import annotations
@@ -22,17 +28,29 @@ import aiohttp
 
 from ..utils.http import LazyClientSession
 from ..utils.logging import init_logger
+from ..utils.system import jittered_interval
 
 logger = init_logger(__name__)
+
+# ±fraction of the report interval each sleep is jittered by: M replicas
+# rolling out together must de-correlate instead of POSTing /fleet/report
+# on synchronized ticks (the same thundering-herd guard the KV event
+# publisher applies toward its subscribers)
+DEFAULT_JITTER_FRAC = 0.15
 
 
 class FleetReporter:
     def __init__(self, state, url: str, interval_s: float = 10.0,
-                 replica_id: str = ""):
+                 replica_id: str = "", budget_scaling: bool = True,
+                 jitter_frac: float = DEFAULT_JITTER_FRAC):
         self.state = state  # RouterState (app.py)
         self.url = url.rstrip("/")
         self.interval_s = interval_s
         self.replica_id = replica_id
+        # closes the tenant-budget loop from the reply's replica count;
+        # off = report-only (the PR 9 measurement behavior)
+        self.budget_scaling = budget_scaling
+        self.jitter_frac = jitter_frac
         self._http = LazyClientSession(
             timeout=aiohttp.ClientTimeout(total=max(2.0, interval_s))
         )
@@ -59,6 +77,12 @@ class FleetReporter:
             self._task = None
         await self._http.close()
 
+    def _next_interval(self) -> float:
+        """The next sleep, jittered so replicas never POST /fleet/report
+        on synchronized ticks (utils.system.jittered_interval is the one
+        shared herd-avoidance policy)."""
+        return jittered_interval(self.interval_s, self.jitter_frac)
+
     async def _run(self) -> None:
         while True:
             try:
@@ -69,7 +93,26 @@ class FleetReporter:
                 self.report_failures += 1
                 self.last_error = f"{type(e).__name__}: {e}"
                 logger.debug("fleet report failed: %s", e)
-            await asyncio.sleep(self.interval_s)
+                self._degrade_if_stale()
+            await asyncio.sleep(self._next_interval())
+
+    def _degrade_if_stale(self) -> None:
+        """Controller-outage degradation: once the last successful report
+        is older than 3 report intervals (the same freshness rule the
+        metrics re-export uses), scaled budgets fall back to the FULL
+        local budget — a dead controller must cost budget coherence, never
+        availability. Re-tightens automatically on the next successful
+        report."""
+        if not self.budget_scaling:
+            return
+        qos = getattr(self.state, "qos", None)
+        if qos is None:
+            return
+        if (
+            not self.last_report_t
+            or time.monotonic() - self.last_report_t > 3 * self.interval_s
+        ):
+            qos.set_fleet_scale(1)
 
     def build_report(self) -> dict:
         """This replica's coherence state, as one JSON-able dict."""
@@ -77,6 +120,10 @@ class FleetReporter:
         report: dict = {
             "replica": self.replica_id,
             "ts": time.time(),
+            # the cadence this replica reports at: the controller sizes
+            # its liveness window for the budget-scaling denominator from
+            # it (3 intervals, same freshness rule as everywhere else)
+            "interval": self.interval_s,
             "ring_hash": "",
             "breakers": {},
             "tenants": {},
@@ -100,6 +147,11 @@ class FleetReporter:
         qos = getattr(state, "qos", None)
         if qos is not None:
             report["tenants"] = qos.totals()
+            # this replica admits tenant traffic against local buckets —
+            # it belongs in the budget-scaling denominator M (a report-
+            # only replica does not: counting it would starve tenants
+            # below the global budget)
+            report["enforcing"] = True
         return report
 
     async def report_once(self) -> dict:
@@ -117,6 +169,19 @@ class FleetReporter:
         self.last_reply = reply
         self.last_report_t = time.monotonic()
         self.last_error = None
+        if self.budget_scaling:
+            qos = getattr(self.state, "qos", None)
+            if qos is not None:
+                # the live ENFORCING replica count closes the tenant-
+                # budget loop: local buckets enforce a 1/M share so the
+                # FLEET admits ~the configured budget. Report-only
+                # replicas and rolling-restart leftovers are excluded
+                # controller-side (FleetView.enforcing_count); the total
+                # replica count is a pre-enforcing-field fallback
+                m = reply.get("enforcing_replicas")
+                if m is None:
+                    m = reply.get("replicas")
+                qos.set_fleet_scale(int(m or 1))
         return reply
 
     def snapshot(self) -> dict:
@@ -124,6 +189,7 @@ class FleetReporter:
         return {
             "url": self.url,
             "interval_s": self.interval_s,
+            "budget_scaling": self.budget_scaling,
             "reports_sent": self.reports_sent,
             "report_failures": self.report_failures,
             "last_error": self.last_error,
@@ -154,6 +220,9 @@ def debug_fleet_snapshot(state) -> dict:
         "breakers": state.breakers.snapshot(),
         "endpoints": [e.url for e in state.discovery.endpoints()],
         "active_streams": state.request_service.active_streams,
+        "tenant_budget_scale": (
+            state.qos.budget_scale if state.qos is not None else None
+        ),
         "fleet_report": (
             state.fleet_reporter.snapshot()
             if getattr(state, "fleet_reporter", None) is not None

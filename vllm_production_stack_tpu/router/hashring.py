@@ -6,6 +6,18 @@ a self-contained equivalent: each node owns `replicas` virtual points on a
 Adding/removing a node only remaps the keys that landed on its points — the
 property the reference's session-stickiness tests assert
 (src/tests/test_session_router.py:24-230).
+
+Fleet determinism contract (docs/34-fleet-routing.md): the ring is a PURE
+FUNCTION of its node set. Virtual points derive only from node names, the
+point list is kept sorted, and even a 64-bit point collision between two
+nodes resolves to the lexicographically-smallest contender rather than to
+whichever node happened to be inserted first. Two router replicas whose
+discovery views agree therefore compute the identical owner for every
+session key regardless of endpoint ARRIVAL ORDER — the invariant the
+`membership_hash` divergence alert and the ring-determinism test gate
+(tests/test_fleet_scale.py) hold the fleet to. Churn keeps the classic
+bounded-remap guarantee: removing a node remaps only keys that landed on
+its points (~1/N of traffic), and no key moves between two surviving nodes.
 """
 
 from __future__ import annotations
@@ -23,7 +35,13 @@ class HashRing:
     def __init__(self, replicas: int = 120):
         self.replicas = replicas
         self._points: list[int] = []  # sorted virtual-point hashes
-        self._owner: dict[int, str] = {}  # point hash -> node
+        self._owner: dict[int, str] = {}  # point hash -> owning node
+        # point hash -> every node hashing to it. 64-bit collisions across
+        # distinct nodes are ~impossible, but if one ever happens the owner
+        # must not depend on insertion order (two replicas seeing the same
+        # endpoints in different arrival orders would route that point's
+        # sessions differently): min() of the contenders is order-free.
+        self._contenders: dict[int, set[str]] = {}
         self._nodes: set[str] = set()
         self._membership_hash: str | None = None  # cache; add/remove clear
 
@@ -52,12 +70,17 @@ class HashRing:
         self._membership_hash = None
         for i in range(self.replicas):
             p = _h64(f"{node}#{i}")
-            # 64-bit collisions across distinct nodes are ~impossible; keep
-            # first owner if one happens so removal stays symmetric
-            if p in self._owner:
-                continue
-            self._owner[p] = node
-            bisect.insort(self._points, p)
+            contenders = self._contenders.get(p)
+            if contenders is None:
+                # insort only on FIRST sight of the point: two of the SAME
+                # node's virtual indices colliding must not duplicate it
+                # in _points (sets dedupe the contender, so a len check
+                # would insort twice and strand an ownerless copy)
+                self._contenders[p] = {node}
+                bisect.insort(self._points, p)
+            else:
+                contenders.add(node)
+            self._owner[p] = min(self._contenders[p])
 
     def remove_node(self, node: str) -> None:
         if node not in self._nodes:
@@ -66,7 +89,14 @@ class HashRing:
         self._membership_hash = None
         for i in range(self.replicas):
             p = _h64(f"{node}#{i}")
-            if self._owner.get(p) == node:
+            contenders = self._contenders.get(p)
+            if contenders is None or node not in contenders:
+                continue
+            contenders.discard(node)
+            if contenders:
+                self._owner[p] = min(contenders)
+            else:
+                del self._contenders[p]
                 del self._owner[p]
                 idx = bisect.bisect_left(self._points, p)
                 self._points.pop(idx)

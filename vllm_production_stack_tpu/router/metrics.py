@@ -194,6 +194,17 @@ class RouterMetrics:
             ["tenant"],
             registry=self.registry,
         )
+        # fleet budget scaling (docs/34-fleet-routing.md): the share of
+        # each tenant's global budget this replica's buckets enforce —
+        # 1/M with M live replicas, 1.0 on a single replica, scaling off,
+        # or the controller-outage degradation
+        self.tenant_budget_scale = Gauge(
+            mc.ROUTER_TENANT_BUDGET_SCALE,
+            "Share of each tenant's global budget this replica's local "
+            "token buckets enforce (1.0 = full local budget)",
+            registry=self.registry,
+        )
+        self.tenant_budget_scale.set(1.0)
         # multi-tenant QoS (docs/27-multitenancy.md): the router's half of
         # the tpu:tenant_* contract — admitted traffic and per-tenant
         # throttles (429s that never reached an engine). Label cardinality
@@ -336,6 +347,7 @@ class RouterMetrics:
         self._render_fleet(state)
         qos = getattr(state, "qos", None)
         if qos is not None:
+            self.tenant_budget_scale.set(qos.budget_scale)
             for (tenant, kind), delta in qos.drain_counter_deltas().items():
                 series = self._tenant_series.get(kind)
                 if series is not None:

@@ -111,7 +111,15 @@ class RequestService:
         args = self.state.args
         total = getattr(args, "upstream_total_s", 0.0) or None
         sock_read = getattr(args, "upstream_sock_read_s", 300.0) or None
+        # --upstream-connector-limit, default unlimited: aiohttp's default
+        # connector limit (100) silently serialized every replica behind
+        # 100 upstream sockets — the 10k-concurrent-stream target
+        # (docs/34-fleet-routing.md) queues at 1% of its concurrency with
+        # no error anywhere. fd exhaustion is the real bound; main()
+        # raises RLIMIT_NOFILE at boot for exactly this.
+        limit = int(getattr(args, "upstream_connector_limit", 0) or 0)
         self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=limit),
             timeout=aiohttp.ClientTimeout(
                 total=total, sock_connect=10, sock_read=sock_read
             )

@@ -21,6 +21,7 @@ import uuid
 from aiohttp import web
 
 from .. import metrics_contract as mc
+from ..fleet import SessionStickinessAudit
 
 
 class FakeEngine:
@@ -30,6 +31,8 @@ class FakeEngine:
         tokens_per_sec: float = 500.0,
         default_tokens: int = 64,
         model_label: str = "",
+        self_url: str = "",
+        log_requests: bool = True,
     ):
         self.model = model
         self.tokens_per_sec = tokens_per_sec
@@ -40,6 +43,14 @@ class FakeEngine:
         self.prompt_tokens_total = 0
         self.generation_tokens_total = 0
         self.sleeping = False
+        # the REAL engine-side stickiness audit (fleet.py) over the
+        # router's sticky stamps, so multi-replica benches measure
+        # violations through the same detector production uses; self_url
+        # arms non_owner_delivery (the fleet_scale bench passes it)
+        self.stickiness = SessionStickinessAudit(self_url=self_url or None)
+        # off for open-loop load benches: an unbounded per-request log
+        # would grow by the full request volume
+        self.log_requests = log_requests
         self.seen_request_log: list[dict] = []  # tests inspect who got what
 
     # -- handlers ----------------------------------------------------------
@@ -66,12 +77,14 @@ class FakeEngine:
                 {"error": {"message": "engine is asleep"}}, status=503
             )
         self.total_requests += 1
-        self.seen_request_log.append(
-            {"path": request.path, "body": body, "t": time.time(),
-             # lowercased so tests can assert on router-stamped tenant
-             # headers without caring about wire casing
-             "headers": {k.lower(): v for k, v in request.headers.items()}}
-        )
+        self.stickiness.observe_headers(request.headers)
+        if self.log_requests:
+            self.seen_request_log.append(
+                {"path": request.path, "body": body, "t": time.time(),
+                 # lowercased so tests can assert on router-stamped tenant
+                 # headers without caring about wire casing
+                 "headers": {k.lower(): v for k, v in request.headers.items()}}
+            )
         is_chat = request.path.endswith("chat/completions")
         n = int(body.get("max_tokens") or self.default_tokens)
         prompt = body.get("prompt") or json.dumps(body.get("messages", []))
@@ -79,7 +92,10 @@ class FakeEngine:
         self.prompt_tokens_total += n_prompt
         rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
-        gap = 1.0 / self.tokens_per_sec
+        # testing-only knob: a body-level token rate overrides the server
+        # default, so one fake fleet can serve a fast non-stream throughput
+        # phase and a slow long-hold stream phase in the same bench run
+        gap = 1.0 / float(body.get("tokens_per_sec") or self.tokens_per_sec)
 
         self.running += 1
         try:
@@ -223,7 +239,17 @@ class FakeEngine:
             f"{mc.PROMPT_TOKENS}{label} {self.prompt_tokens_total}",
             f"{mc.GENERATION_TOKENS}{label} {self.generation_tokens_total}",
         ]
+        # stickiness-audit contract series (closed reason set), so the
+        # multi-replica benches read violations the same way a scraper
+        # would off a real engine
+        base = mc.SESSION_STICKINESS_VIOLATIONS
+        lines.append(f"# TYPE {base} counter")
+        for reason, n in sorted(self.stickiness.counts().items()):
+            lines.append(f'{base}{{reason="{reason}"}} {n}')
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def h_debug_stickiness(self, request: web.Request) -> web.Response:
+        return web.json_response(self.stickiness.snapshot())
 
     async def h_health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
@@ -249,6 +275,7 @@ class FakeEngine:
         app.router.add_post("/v1/audio/transcriptions", self.h_transcription)
         app.router.add_post("/v1/embeddings", self.h_embeddings)
         app.router.add_get("/metrics", self.h_metrics)
+        app.router.add_get("/debug/stickiness", self.h_debug_stickiness)
         app.router.add_get("/health", self.h_health)
         app.router.add_post("/sleep", self.h_sleep)
         app.router.add_post("/wake_up", self.h_wake)
@@ -263,11 +290,24 @@ def main(argv=None) -> None:
     p.add_argument("--model", default="fake-model")
     p.add_argument("--tokens-per-sec", type=float, default=500.0)
     p.add_argument("--model-label", default="")
+    p.add_argument("--self-url", default="",
+                   help="this engine's advertised URL — arms the "
+                        "stickiness audit's non_owner_delivery detection")
+    p.add_argument("--no-request-log", action="store_true",
+                   help="disable the per-request log (open-loop load "
+                        "benches would grow it unboundedly)")
     args = p.parse_args(argv)
+    from ..utils.system import raise_fd_limit
+
+    # the 10k-concurrent-stream bench holds thousands of sockets per fake
+    # engine; the 1024 default soft limit severs them mid-stream
+    raise_fd_limit()
     engine = FakeEngine(
         model=args.model,
         tokens_per_sec=args.tokens_per_sec,
         model_label=args.model_label,
+        self_url=args.self_url,
+        log_requests=not args.no_request_log,
     )
     web.run_app(engine.build_app(), host=args.host, port=args.port, print=None)
 

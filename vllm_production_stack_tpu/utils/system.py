@@ -38,3 +38,16 @@ def raise_fd_limit(target: int = 65535) -> int:
     except (ImportError, ValueError, OSError) as e:
         logger.warning("could not raise fd limit: %s", e)
         return -1
+
+
+def jittered_interval(interval_s: float, jitter_frac: float) -> float:
+    """A sleep interval jittered ±jitter_frac around interval_s — the ONE
+    herd-avoidance policy shared by every periodic fleet tick (the KV
+    event publisher and the fleet reporter): M replicas × E engines
+    starting together must de-correlate instead of hitting a shared
+    subscriber on synchronized ticks (docs/34-fleet-routing.md)."""
+    if jitter_frac <= 0:
+        return interval_s
+    import random
+
+    return interval_s * random.uniform(1.0 - jitter_frac, 1.0 + jitter_frac)
