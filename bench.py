@@ -2519,6 +2519,312 @@ def _phase_hydration_main() -> None:
     print(json.dumps({"hydration": result}), flush=True)
 
 
+async def _peer_bench() -> dict:
+    """Peer-engine KV tier: priced route-vs-migrate vs owner-affinity
+    under skewed prefix popularity (docs/35-peer-kv-reuse.md). CPU-only,
+    pre-preflight — router + fake engines, no jax on the hot path.
+
+    Scenario: 3 fake-engine-backed engines, 4 decode seats each; ONE
+    engine owns the hot prefix (its residency fed into the router's
+    embedded cluster KV index exactly as a publisher would, and its
+    warm-prefix model marked warm). A closed-loop flood where 85% of
+    requests share that hot prefix then runs twice against fresh fleets:
+
+    - **affinity** (--kv-migrate-scoring off): KV-aware routing follows
+      the prefix owner, so the hot traffic serializes behind ONE
+      engine's seats while the other two idle;
+    - **priced** (--kv-migrate-scoring priced): once the owner's load/
+      TTFT exceeds the least-loaded engine's wait plus the measured
+      migration cost, requests route there with x-kv-owner-hint stamped —
+      the fake pays the (cheap) peer-pull once per engine, the prefix
+      warms fleet-wide, and all three engines serve the hot traffic.
+
+    Reported: aggregate completion tok/s and TTFT p50/p99 per arm, the
+    router's migrate-decision counts, and the engines' peer-pull/cold-
+    prefill counters. The acceptance bar: priced beats affinity on
+    aggregate tok/s OR TTFT p99 (it should win both)."""
+    import asyncio
+
+    import aiohttp
+    from aiohttp import web
+
+    from vllm_production_stack_tpu.kv_index import chain_hashes
+    from vllm_production_stack_tpu.router.app import build_app
+    from vllm_production_stack_tpu.router.args import parse_args
+    from vllm_production_stack_tpu.testing.fake_engine import FakeEngine
+    from vllm_production_stack_tpu.utils.tokenizer import hashing_tokenizer
+
+    N_ENGINES, SEATS, BLOCK = 3, 4, 16
+    CLIENTS, DURATION_S, HOT_SHARE = 24, 8.0, 0.85
+    GEN_TOKENS = 24
+    # ~8KB hot prefix => ~8k byte-tokens: far above the 256-token
+    # threshold, and a 4s cold prefill at 2000 tok/s vs 0.2s peer pull
+    hot_prefix = "the shared system prompt " * 400
+    # tpulint: allow(async-blocking) — constructing the byte tokenizer is
+    # a trivial object init, not a tokenize; encoding runs off-loop below
+    tok = hashing_tokenizer("byte")
+
+    runners: list[web.AppRunner] = []
+
+    async def serve(app) -> tuple[web.AppRunner, str]:
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        runners.append(runner)
+        return runner, f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+    async def run_arm(scoring: str) -> dict:
+        engines: list[FakeEngine] = []
+        urls: list[str] = []
+        arm_runners: list[web.AppRunner] = []
+        for _ in range(N_ENGINES):
+            eng = FakeEngine(
+                model="fake-model", tokens_per_sec=120.0,
+                default_tokens=GEN_TOKENS, log_requests=False,
+                seats=SEATS, prefill_tps=2000.0, peer_pull_tps=40000.0,
+                kv_bytes_per_token=4096.0,
+            )
+            runner = web.AppRunner(eng.build_app())
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runners.append(runner)
+            arm_runners.append(runner)
+            engines.append(eng)
+            urls.append(f"http://127.0.0.1:{runner.addresses[0][1]}")
+        owner_eng, owner_url = engines[0], urls[0]
+        owner_eng.warm_prefixes.add(
+            hot_prefix[: FakeEngine.WARM_KEY_CHARS]
+        )
+        router_runner, router_url = await serve(build_app(parse_args([
+            "--static-backends", ",".join(urls),
+            "--static-models", ";".join(["fake-model"] * N_ENGINES),
+            "--routing-logic", "kvaware",
+            "--kv-index-mode", "embedded",
+            "--kv-index-tokenizer", "byte",
+            "--kv-migrate-scoring", scoring,
+            "--engine-stats-interval", "0.5",
+            "--request-stats-window", "5",
+            "--breaker-failure-threshold", "0",
+        ])))
+        # feed the owner's hot-prefix residency into the embedded index
+        # exactly as its KV event publisher would (snapshot POST);
+        # tokenize+hash off-loop (multi-KB prompt)
+        hashes = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: chain_hashes(tok.encode(hot_prefix), BLOCK)
+        )
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(router_url + "/kv/events", json={
+                "engine": owner_url, "epoch": "bench", "block_size": BLOCK,
+                "snapshot": True, "seq": 0,
+                "hashes": [f"{h:x}" for h in hashes],
+            }) as resp:
+                assert resp.status == 200, await resp.text()
+
+            # closed-loop flood: each client loops hot/cold prompts until
+            # the deadline; TTFT = first SSE chunk
+            t_end = time.monotonic() + DURATION_S
+            ttfts: list[float] = []
+            done_tokens = [0]
+            failures = [0]
+
+            async def client(i: int) -> None:
+                r = 0
+                while time.monotonic() < t_end:
+                    r += 1
+                    hot = (i * 31 + r) % 100 < HOT_SHARE * 100
+                    prompt = (
+                        hot_prefix + f" user{i} round{r}"
+                        if hot else f"cold prompt {i}-{r} " * 30
+                    )
+                    t0 = time.monotonic()
+                    try:
+                        async with sess.post(
+                            router_url + "/v1/completions",
+                            json={"model": "fake-model", "prompt": prompt,
+                                  "max_tokens": GEN_TOKENS, "stream": True},
+                        ) as resp:
+                            if resp.status != 200:
+                                failures[0] += 1
+                                continue
+                            first = True
+                            async for _ in resp.content.iter_any():
+                                if first:
+                                    ttfts.append(time.monotonic() - t0)
+                                    first = False
+                            done_tokens[0] += GEN_TOKENS
+                    except aiohttp.ClientError:
+                        failures[0] += 1
+
+            t0 = time.monotonic()
+            await asyncio.gather(*(client(i) for i in range(CLIENTS)))
+            elapsed = time.monotonic() - t0
+
+            # migrate-decision counters off the router's own /metrics
+            async with sess.get(router_url + "/metrics") as resp:
+                metrics_text = await resp.text()
+
+        def count(decision: str) -> float:
+            needle = (
+                "tpu:router_kv_migrate_decisions_total"
+                f'{{decision="{decision}"}} '
+            )
+            for ln in metrics_text.splitlines():
+                if ln.startswith(needle):
+                    return float(ln.split()[-1])
+            return 0.0
+
+        ttfts.sort()
+
+        def pct(p: float) -> float:
+            if not ttfts:
+                return 0.0
+            return round(ttfts[min(len(ttfts) - 1, int(p * len(ttfts)))], 4)
+
+        for runner in arm_runners:
+            await runner.cleanup()
+            runners.remove(runner)
+        return {
+            "scoring": scoring,
+            "agg_tok_per_s": round(done_tokens[0] / elapsed, 1),
+            "requests": len(ttfts),
+            "failures": failures[0],
+            "ttft_p50_s": pct(0.50),
+            "ttft_p99_s": pct(0.99),
+            "decisions": {"owner": count("owner"),
+                          "migrate": count("migrate")},
+            "owner_requests": owner_eng.total_requests,
+            "per_engine_requests": [e.total_requests for e in engines],
+            "peer_pulls": sum(e.peer_pulls for e in engines),
+            "cold_prefills": sum(e.cold_prefills for e in engines),
+        }
+
+    async def bit_identical_check() -> dict:
+        """REAL-engine half of the acceptance bar: engine A computes a
+        prompt, engine B pulls it over the actual peer tier (owner hint,
+        /kv/peer_contains + /kv/peer_fetch, frame adoption) — tokens must
+        be bit-equal to A's compute and the hydration partition exact."""
+        import numpy as np
+
+        from vllm_production_stack_tpu.engine.config import (
+            CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
+        )
+        from vllm_production_stack_tpu.engine.engine import LLMEngine
+        from vllm_production_stack_tpu.engine.kv_flow import TierBandwidth
+        from vllm_production_stack_tpu.engine.request import SamplingParams
+        from vllm_production_stack_tpu.engine.server import EngineServer
+
+        bs = 8
+        greedy = SamplingParams(
+            max_tokens=8, temperature=0.0, ignore_eos=True
+        )
+
+        def tiny(peer: bool) -> LLMEngine:
+            return LLMEngine(EngineConfig(
+                model=ModelConfig.tiny(),
+                cache=CacheConfig(
+                    block_size=bs, num_blocks=64, num_host_blocks=4,
+                ),
+                scheduler=SchedulerConfig(
+                    max_num_seqs=2, max_num_batched_tokens=64,
+                    decode_buckets=(2,), prefill_buckets=(32, 64),
+                    decode_window=4,
+                ),
+                kv_hydration="planner" if peer else "sync",
+                kv_hydration_chunk_blocks=2,
+                kv_peer_fetch=peer,
+            ))
+
+        prompt = [int(t) for t in
+                  np.random.RandomState(13).randint(1, 500, size=6 * bs)]
+        loop = asyncio.get_running_loop()
+        eng_a = tiny(peer=False)
+        ref = eng_a.generate([prompt], greedy)[0]["token_ids"]
+        runner = web.AppRunner(
+            EngineServer(eng_a, served_model_name="tiny").build_app()
+        )
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        a_url = f"http://127.0.0.1:{runner.addresses[0][1]}"
+        try:
+            eng_b = tiny(peer=True)
+            # cross the sample floor + seed a compute-rate estimate (the
+            # planner's trust gate), exactly like the tier-1 tests
+            eng_b.flow.record("peer", "in", TierBandwidth.MIN_BYTES, 32, 0.01)
+            eng_b.flow.record("peer", "in", TierBandwidth.MIN_BYTES, 32, 0.01)
+            eng_b.generate([[7] * bs], greedy)
+            got = await loop.run_in_executor(
+                None,
+                lambda: eng_b.generate(
+                    [prompt], greedy, kv_owner_hint=a_url
+                )[0]["token_ids"],
+            )
+            hyd = eng_b.flow.snapshot()["hydration"]
+            partition_exact = sum(hyd.values()) == eng_b._prompt_tokens
+            result = {
+                "tokens_equal": got == ref,
+                "peer_fetch_tokens": hyd.get("peer_fetch", 0),
+                "partition_exact": partition_exact,
+            }
+            assert result["tokens_equal"], (got, ref)
+            assert result["peer_fetch_tokens"] > 0, hyd
+            assert partition_exact, hyd
+            await loop.run_in_executor(
+                None, lambda: eng_b.runner.shutdown(True)
+            )
+        finally:
+            await runner.cleanup()
+        eng_a.runner.shutdown(wait=True)
+        return result
+
+    try:
+        affinity = await run_arm("off")
+        priced = await run_arm("priced")
+        bit_identical = await bit_identical_check()
+    finally:
+        for runner in runners:
+            await runner.cleanup()
+
+    return {
+        "engines": N_ENGINES,
+        "seats_per_engine": SEATS,
+        "clients": CLIENTS,
+        "hot_share": HOT_SHARE,
+        "affinity": affinity,
+        "priced": priced,
+        "bit_identical": bit_identical,
+        "speedup_tok_per_s": (
+            round(priced["agg_tok_per_s"] / affinity["agg_tok_per_s"], 2)
+            if affinity["agg_tok_per_s"] else None
+        ),
+        "ttft_p99_ratio": (
+            round(affinity["ttft_p99_s"] / priced["ttft_p99_s"], 2)
+            if priced["ttft_p99_s"] else None
+        ),
+        # the acceptance bar (ISSUE 13): priced must beat owner-affinity
+        # on aggregate tok/s or TTFT p99 under skewed popularity
+        "priced_beats_affinity": bool(
+            priced["agg_tok_per_s"] > affinity["agg_tok_per_s"]
+            or priced["ttft_p99_s"] < affinity["ttft_p99_s"]
+        ),
+        "migrations_happened": priced["decisions"]["migrate"] > 0,
+    }
+
+
+def _phase_peer_main() -> None:
+    """Subprocess entry for the CPU-only peer route-vs-migrate bench.
+    Forces CPU before anything touches jax — runs pre-preflight, so the
+    cluster-reuse evidence survives a wedged TPU tunnel."""
+    import asyncio
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = asyncio.run(_peer_bench())
+    print(json.dumps({"peer": result}), flush=True)
+
+
 def _phase_kvflow_main() -> None:
     """Subprocess entry for the CPU-only KV-flow telemetry bench. Forces
     CPU before anything touches jax — runs pre-preflight, so the flow
@@ -2662,6 +2968,8 @@ def main() -> None:
             _phase_kvflow_main()
         elif phase == "hydration":
             _phase_hydration_main()
+        elif phase == "peer":
+            _phase_peer_main()
         elif phase == "fleet":
             _phase_fleet_main()
         elif phase == "fleet_scale":
@@ -2729,6 +3037,14 @@ def main() -> None:
         timeout_s=540, key="hydration", min_needed_s=120.0,
     )
 
+    # -0.0117) peer-engine KV tier (docs/35-peer-kv-reuse.md): priced
+    # route-vs-migrate vs owner-affinity under skewed prefix popularity —
+    # CPU-only, pre-preflight (fake engines + real router, no chip)
+    peer = _run_phase(
+        "peer", ["bench.py", "--phase", "peer"],
+        timeout_s=300, key="peer", min_needed_s=60.0,
+    )
+
     # -0.0078125) fleet-coherence telemetry (docs/32-fleet-telemetry.md):
     # the ROADMAP-1 baselines — convergence lag across 3 router replicas
     # after a 10k-event storm, stickiness-violation detection, fleet
@@ -2773,6 +3089,7 @@ def main() -> None:
             "saturation": saturation,
             "kvflow": kvflow,
             "hydration": hydration,
+            "peer": peer,
             "fleet": fleet,
             "fleet_scale": fleet_scale,
             "total_elapsed_s": round(time.monotonic() - _t_start, 1),
@@ -2848,6 +3165,7 @@ def main() -> None:
         "saturation": saturation,
         "kvflow": kvflow,
         "hydration": hydration,
+        "peer": peer,
         "fleet": fleet,
         "fleet_scale": fleet_scale,
         "total_elapsed_s": round(time.monotonic() - _t_start, 1),

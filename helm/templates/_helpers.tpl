@@ -127,6 +127,14 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--kv-hydration-timeout-s"
 - {{ .kvHydrationTimeoutS | quote }}
 {{- end }}
+{{- if .kvPeerFetch }}
+- "--kv-peer-fetch"
+- "true"
+{{- end }}
+{{- if .kvPeerFetchTimeoutS }}
+- "--kv-peer-fetch-timeout-s"
+- {{ .kvPeerFetchTimeoutS | quote }}
+{{- end }}
 {{- if eq (.enablePrefixCaching | default true) false }}
 - "--no-enable-prefix-caching"
 {{- end }}

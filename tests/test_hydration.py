@@ -204,7 +204,7 @@ def test_hydration_signal_reports_measured_flags(tmp_path):
     eng = _engine(mode="sync", disk_dir=str(tmp_path))
     sig = eng.hydration_signal()
     assert set(sig["fetch_bandwidth_measured"]) == {
-        "host", "disk", "remote", "device"
+        "host", "disk", "remote", "device", "peer"
     }
     assert not any(sig["fetch_bandwidth_measured"].values())
     assert sig["attn_flops_per_token_ctx"] > 0

@@ -447,7 +447,7 @@ def test_hydration_signal_shape():
     eng = _engine()
     sig = eng.hydration_signal()
     assert set(sig["fetch_bandwidth_bytes_per_s"]) == {
-        "host", "disk", "remote", "device"
+        "host", "disk", "remote", "device", "peer"
     }
     assert sig["flops_per_token"] > 0
     assert sig["block_bytes"] > 0
@@ -493,10 +493,10 @@ def test_exporter_renders_kv_flow_series_with_bounded_cardinality():
             if ln.startswith(name + "{") or ln.startswith(name + " ")
         ]
 
-    assert len(series("tpu:kv_transfer_bytes_total")) == 8  # 4 tiers x 2
-    assert len(series("tpu:kv_transfer_blocks_total")) == 8
-    assert len(series("tpu:kv_tier_bandwidth_bytes_per_s")) == 8
-    assert len(series("tpu:request_prefix_tokens_total")) == 5
+    assert len(series("tpu:kv_transfer_bytes_total")) == 10  # 5 tiers x 2
+    assert len(series("tpu:kv_transfer_blocks_total")) == 10
+    assert len(series("tpu:kv_tier_bandwidth_bytes_per_s")) == 10
+    assert len(series("tpu:request_prefix_tokens_total")) == 6
     assert any(
         'tier="disk",direction="in"' in ln.replace("direction=", "direction=")
         or 'direction="in"' in ln and 'tier="disk"' in ln
@@ -519,7 +519,7 @@ def test_exporter_renders_kv_flow_series_with_bounded_cardinality():
         if any(f'tier="{t}"' in ln and f'direction="{d}"' in ln
                for ln in bucket_lines)
     }
-    assert len(combos) == 8
+    assert len(combos) == 10  # 5 tiers x 2 directions
     # delta-bump idempotence: rendering the same snapshot twice must not
     # double-count the cumulative counters
     text2 = m.render(snap).decode()

@@ -107,6 +107,9 @@ class AsyncEngine:
         hydrator = getattr(self.engine, "hydrator", None)
         if hydrator is not None:
             hydrator.close()  # stop the hydration fetcher thread
+        peer = getattr(self.engine, "peer_tier", None)
+        if peer is not None:
+            peer.close()  # idempotent; hydrator.close already closed it
         host_tier = getattr(self.engine, "host_tier", None)
         remote = getattr(self.engine, "remote_tier", None)
         if host_tier is not None:
@@ -193,23 +196,24 @@ class AsyncEngine:
             with self._pending_lock:
                 if not self._pending:
                     return
-                rid, token_ids, sampling, lora_name, deadline, tenant = (
-                    self._pending.popleft()
-                )
+                (rid, token_ids, sampling, lora_name, deadline, tenant,
+                 kv_owner_hint) = self._pending.popleft()
                 # popped but not yet in the scheduler: wait_idle must not
                 # read this window as "drained" (pending empty + scheduler
                 # empty) while the request is mid-admission
                 self._admitting += 1
             try:
                 self._admit_one(
-                    rid, token_ids, sampling, lora_name, deadline, tenant
+                    rid, token_ids, sampling, lora_name, deadline, tenant,
+                    kv_owner_hint,
                 )
             finally:
                 with self._pending_lock:
                     self._admitting -= 1
 
     def _admit_one(
-        self, rid, token_ids, sampling, lora_name, deadline, tenant=None
+        self, rid, token_ids, sampling, lora_name, deadline, tenant=None,
+        kv_owner_hint=None,
     ):
         """Move one popped submission into the engine (step thread, engine
         lock held). A failure fails that request's stream, never the loop."""
@@ -232,6 +236,7 @@ class AsyncEngine:
                 lora_name=lora_name,
                 deadline=deadline,
                 tenant=tenant,
+                kv_owner_hint=kv_owner_hint,
             )
         except Exception as e:
             logger.warning("deferred admission failed for %s: %s", rid, e)
@@ -332,7 +337,7 @@ class AsyncEngine:
     def _submit(
         self, request_id, prompt, prompt_token_ids, sampling, q,
         lora_name=None, deadline=None, admission_exclude_prefix=None,
-        tenant=None,
+        tenant=None, kv_owner_hint=None,
     ) -> str:
         """Runs in an executor. Deliberately LOCK-FREE: tokenization +
         validation need no engine state mutation, and admission is deferred
@@ -392,7 +397,8 @@ class AsyncEngine:
             rid = request_id or f"req-a{next(self._rid_counter)}"
             self._queues[rid] = q
             self._pending.append((rid, list(prompt_token_ids), sampling,
-                                  lora_name, deadline, tenant))
+                                  lora_name, deadline, tenant,
+                                  kv_owner_hint))
         self.loop_timing["submits"] += 1
         self.loop_timing["submit_s"] += time.perf_counter() - t0
         self._wake.set()
@@ -408,6 +414,7 @@ class AsyncEngine:
         deadline: float | None = None,
         admission_exclude_prefix: str | None = None,
         tenant=None,
+        kv_owner_hint: str | None = None,
     ) -> AsyncIterator[RequestOutput]:
         """Submit a request and yield its incremental outputs.
         admission_exclude_prefix (the parent request id of an n>1 fan-out)
@@ -422,6 +429,7 @@ class AsyncEngine:
         rid = await loop.run_in_executor(
             None, self._submit, request_id, prompt, prompt_token_ids, sampling,
             q, lora_name, deadline, admission_exclude_prefix, tenant,
+            kv_owner_hint,
         )
         finished = False
         try:
@@ -587,6 +595,31 @@ class AsyncEngine:
                 return self.engine.kv_export_lazy(
                     token_ids=ids, lora_name=lora_name
                 )
+
+        return await asyncio.get_running_loop().run_in_executor(None, work)
+
+    async def kv_peer_contains(self, hashes: list[int]) -> int:
+        # deliberately LOCK-FREE: the walk is pure GIL-atomic dict/set
+        # containment (pool map, host ring, disk index — each
+        # thread-safe or atomic on its own), and the answer is
+        # staleness-tolerant BY DESIGN (the asking planner re-validates
+        # at fetch/adoption). Taking the engine lock here would also
+        # let a mis-aimed self-probe (hint naming this engine under a
+        # URL scheme _advertised_url can't recognize) stall an
+        # admission for the full peer timeout: the step thread waits on
+        # this HTTP reply while holding the very lock this handler
+        # would need.
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.kv_peer_contains, hashes
+        )
+
+    async def kv_peer_export(self, hashes: list[int]):
+        """Lock held only for the residency walk + device fetch dispatch
+        (same discipline as kv_export_lazy); the per-block numpy / disk
+        resolution happens in the /kv/peer_fetch handler off the lock."""
+        def work():
+            with self._lock:
+                return self.engine.kv_peer_export(hashes)
 
         return await asyncio.get_running_loop().run_in_executor(None, work)
 

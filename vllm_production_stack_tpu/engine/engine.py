@@ -86,6 +86,10 @@ class EngineStatsSnapshot:
     # disk-tier block counters (the rung between HOST_KV_* and REMOTE_KV_*)
     disk_kv_stores: int = 0
     disk_kv_loads: int = 0
+    # peer-engine KV tier (docs/35-peer-kv-reuse.md): the migrate-pricing
+    # constant (analytic KV bytes per token of this pool) the router reads
+    # off /metrics as tpu:kv_bytes_per_token
+    kv_bytes_per_token: float = 0.0
 
 
 @dataclass
@@ -215,6 +219,19 @@ class LLMEngine:
                 flow=self.flow,
             )
             num_host_blocks = max(num_host_blocks, 16)
+        # peer-engine KV tier (docs/35-peer-kv-reuse.md): pull a prefix
+        # resident only in ANOTHER engine's HBM/host tiers instead of
+        # recomputing it. Opt-in (--kv-peer-fetch): the consuming side
+        # needs a host ring to stage adoptions through, so enabling it
+        # floors the ring like the remote tier does. The SERVING side
+        # (/kv/peer_contains, /kv/peer_fetch) is always mounted — an
+        # engine can be an owner without consuming the tier.
+        self.peer_tier = None
+        peer_enabled = (
+            config.kv_peer_fetch and config.cache.enable_prefix_caching
+        )
+        if peer_enabled:
+            num_host_blocks = max(num_host_blocks, 16)
         if num_host_blocks > 0:
             from .kv_host_tier import HostKVTier
 
@@ -227,16 +244,47 @@ class LLMEngine:
                 disk=disk_tier,
                 flow=self.flow,
             )
+        if peer_enabled:
+            # lookup/identity wiring mirrors the KV event publisher's
+            # (engine/server.py): POD_IP/ENGINE_PORT is this engine's
+            # cluster-visible identity, and the first KV_CONTROLLER_URL
+            # subscriber doubles as the cluster-lookup service for owner
+            # rediscovery (the router's x-kv-owner-hint skips it)
+            import os
+
+            from .kv_peer import PeerKVTier
+
+            pod_ip = os.environ.get("POD_IP") or ""
+            self_url = (
+                f"http://{pod_ip}:{os.environ.get('ENGINE_PORT', '8000')}"
+                if pod_ip else ""
+            )
+            subscribers = [
+                u.strip()
+                for u in (os.environ.get("KV_CONTROLLER_URL") or "").split(",")
+                if u.strip()
+            ]
+            self.peer_tier = PeerKVTier(
+                fingerprint=self.model_fingerprint,
+                self_url=self_url,
+                lookup_url=subscribers[0] if subscribers else "",
+                timeout=config.kv_peer_fetch_timeout_s,
+                flow=self.flow,
+            )
         # compute-or-load hydration planner (docs/31-hydration-planner.md):
-        # only engines with a rung BELOW the host ring (disk / remote) ever
-        # face the blocking-load-vs-recompute choice; everything else keeps
-        # the legacy admission path untouched.
+        # only engines with a rung BELOW the host ring (disk / remote /
+        # peer) ever face the blocking-load-vs-recompute choice; everything
+        # else keeps the legacy admission path untouched.
         self.hydrator = None
         if (
             config.kv_hydration != "sync"
             and config.cache.enable_prefix_caching
             and self.host_tier is not None
-            and (disk_tier is not None or self.remote_tier is not None)
+            and (
+                disk_tier is not None
+                or self.remote_tier is not None
+                or self.peer_tier is not None
+            )
         ):
             from .hydration import Hydrator
 
@@ -247,6 +295,7 @@ class LLMEngine:
                 flow=self.flow,
                 signal_fn=lambda: self.hydration_signal(),
                 host_tier=self.host_tier,
+                peer=self.peer_tier,
             )
         self.scheduler = Scheduler(
             config.model, config.cache, config.scheduler,
@@ -328,6 +377,7 @@ class LLMEngine:
         lora_name: str | None = None,
         deadline: float | None = None,
         tenant: TenantContext | None = None,
+        kv_owner_hint: str | None = None,
     ) -> str:
         request_id = request_id or f"req-{next(self._req_counter)}"
         if prompt_token_ids is None:
@@ -350,6 +400,7 @@ class LLMEngine:
             tenant_id=tenant.tenant_id,
             priority=tenant.priority,
             weight=tenant.weight,
+            kv_owner_hint=kv_owner_hint,
         )
         self.scheduler.add_request(req)
         self._states[request_id] = _RequestState(
@@ -722,6 +773,46 @@ class LLMEngine:
         return self.scheduler.pool.match_length(
             list(token_ids), parent=self._cache_root(lora_name)
         )
+
+    def kv_peer_contains(self, hashes: list[int]) -> int:
+        """Consecutive count of `hashes` this engine can serve from its
+        local tiers — the /kv/peer_contains probe another engine's
+        hydration planner issues before trusting the cluster index
+        (docs/35-peer-kv-reuse.md). Safe WITHOUT the engine lock: pure
+        GIL-atomic containment, and the probe is staleness-tolerant by
+        design (fetch/adoption re-validate)."""
+        from .kv_transfer import KVTransfer
+
+        return KVTransfer(self.scheduler.pool, self.runner).contains_hashes(
+            hashes
+        )
+
+    def kv_peer_export(self, hashes: list[int]):
+        """(served, entries) for the consecutive locally-resident prefix
+        of `hashes` — the /kv/peer_fetch sender half. Caller holds the
+        engine lock; entries resolve to numpy OFF the lock (see
+        KVTransfer.export_hashes)."""
+        from .kv_transfer import KVTransfer
+
+        return KVTransfer(self.scheduler.pool, self.runner).export_hashes(
+            hashes
+        )
+
+    def kv_bytes_per_token(self) -> float:
+        """Analytic KV bytes per token of this pool (block_bytes /
+        block_size) — the tpu:kv_bytes_per_token gauge the router's
+        route-vs-migrate scoring prices transfers with."""
+        from .memory import kv_block_bytes
+
+        return kv_block_bytes(
+            self.config.model,
+            self.config.cache.block_size,
+            self.config.parallel.tensor_parallel_size,
+            self.config.parallel.pipeline_parallel_size,
+            kv_dtype=self.config.cache.resolved_kv_dtype(
+                self.config.model.dtype
+            ),
+        ) / self.config.cache.block_size
 
     def has_request(self, request_id: str) -> bool:
         return request_id in self._states
@@ -1426,6 +1517,7 @@ class LLMEngine:
         prompts: list[str] | list[list[int]],
         sampling: SamplingParams,
         lora_name: str | None = None,
+        kv_owner_hint: str | None = None,
     ) -> list[dict]:
         """Blocking batch generation; returns [{request_id, token_ids, text}]."""
         ids = []
@@ -1433,14 +1525,15 @@ class LLMEngine:
             if isinstance(p, str):
                 ids.append(
                     self.add_request(
-                        prompt=p, sampling=sampling, lora_name=lora_name
+                        prompt=p, sampling=sampling, lora_name=lora_name,
+                        kv_owner_hint=kv_owner_hint,
                     )
                 )
             else:
                 ids.append(
                     self.add_request(
                         prompt_token_ids=p, sampling=sampling,
-                        lora_name=lora_name,
+                        lora_name=lora_name, kv_owner_hint=kv_owner_hint,
                     )
                 )
         done: dict[str, dict] = {
@@ -1471,6 +1564,7 @@ class LLMEngine:
             kv_flow=self.flow.snapshot(),
             disk_kv_stores=disk.stats.stores if disk is not None else 0,
             disk_kv_loads=disk.stats.loads if disk is not None else 0,
+            kv_bytes_per_token=self.kv_bytes_per_token(),
             num_requests_running=self.scheduler.num_running,
             num_requests_waiting=self.scheduler.num_waiting,
             kv_usage_perc=pool.usage_perc,

@@ -86,10 +86,12 @@ def plan_decisions(
 
     - no usable compute-rate estimate (no achieved FLOP/s AND no known
       chip peak — a cold engine), or
-    - ``forced=False`` (auto mode) and any resident tier in the run is
-      below the bandwidth sample floor — the caller falls back to the
-      legacy synchronous load, whose transfers are exactly what crosses
-      the floor.
+    - ``forced=False`` (auto mode) and any resident DISK/REMOTE tier in
+      the run is below the bandwidth sample floor — the caller falls back
+      to the legacy synchronous load, whose transfers are exactly what
+      crosses the floor. An unmeasured PEER tier never declines the plan
+      (no sync path fetches from peers); its chunks are priced recompute
+      until the Hydrator's bootstrap fetches cross the floor.
 
     With ``forced=True`` unmeasured-tier chunks are decided "recompute"
     (never trust an estimate built from a single tiny transfer — the
@@ -110,6 +112,13 @@ def plan_decisions(
     flops_per_token = float(signal.get("flops_per_token") or 0.0)
     if flops_per_s <= 0.0 or flops_per_token <= 0.0:
         return None  # cannot price compute — planner cannot engage
+    # an unmeasured DISK/REMOTE tier declines the whole plan in auto mode
+    # (the sync fallback load is what feeds the bandwidth floor); an
+    # unmeasured PEER tier must NOT — no sync path ever fetches from a
+    # peer, so declining would starve the estimator forever. Peer chunks
+    # below the floor are priced recompute instead, and the Hydrator's
+    # bootstrap fetch (measurement-only) crosses the floor out of band.
+    unmeasured_nonpeer = False
     # attention score/value coefficient (FLOPs per token × attended
     # position): at long context this term dominates the matmul term, and
     # pricing recompute without it biases the split toward compute
@@ -139,11 +148,13 @@ def plan_decisions(
             rate = float(bw.get(tier) or 0.0)
             if not measured.get(tier) or rate <= 0.0:
                 cost = inf  # below the sample floor: never trusted
+                if tier != "peer":
+                    unmeasured_nonpeer = True
                 break
             cost += block_bytes / rate
         fetch_s.append(cost)
 
-    if not forced and any(c == inf for c in fetch_s):
+    if not forced and unmeasured_nonpeer:
         return None  # auto mode: fall back to the sync path (it measures)
 
     n = len(chunk_tiers)
@@ -211,12 +222,16 @@ class HydrationPlan:
     def __init__(
         self, request_id: str, chunks: list[HydrationChunk],
         block_size: int, deadline: float, estimates: dict,
+        peer_owner: str = "",
     ):
         self.request_id = request_id
         self.chunks = chunks
         self.block_size = block_size
         self.deadline = deadline  # monotonic: pending past this → fallback
         self.estimates = estimates
+        # engine URL serving this plan's "peer"-tier blocks (one owner per
+        # plan: the probe's peer continuation is a single engine's run)
+        self.peer_owner = peer_owner
         self.lock = threading.Lock()
         self.cancelled = False
         self.cursor = 0  # first chunk not fully consumed (step thread)
@@ -278,6 +293,10 @@ class Hydrator:
 
     MODES = ("auto", "planner", "sync", "off")
 
+    # at most one measurement-only peer fetch per owner per this interval:
+    # the sample floor needs MIN_SAMPLES small fetches, not a storm
+    BOOTSTRAP_MIN_INTERVAL_S = 5.0
+
     def __init__(
         self,
         mode: str = "auto",
@@ -286,6 +305,7 @@ class Hydrator:
         flow=None,
         signal_fn=None,
         host_tier=None,
+        peer=None,
     ):
         if mode not in self.MODES:
             raise ValueError(
@@ -306,6 +326,10 @@ class Hydrator:
         self.flow = flow
         self.signal_fn = signal_fn
         self.host_tier = host_tier
+        # peer-engine KV tier client (engine/kv_peer.PeerKVTier, None when
+        # --kv-peer-fetch is off): "peer"-tier chunks fetch from the plan's
+        # owner engine over dedicated per-owner connections
+        self.peer = peer
         self._q: queue.Queue = queue.Queue()
         self._thread: threading.Thread | None = None
         self._closed = False
@@ -313,6 +337,11 @@ class Hydrator:
         # mgets can run for seconds and must never hold the shared fetch
         # lock the step thread's probes contend on (kvstore/client.py)
         self._remote_conn = None
+        # per-owner dedicated peer fetch connections (same rationale)
+        self._peer_conns: dict[str, object] = {}
+        # last measurement-only bootstrap per owner (step thread writes,
+        # monotonic clock) — rate-limits the sample-floor warmup
+        self._bootstrap_t: dict[str, float] = {}
 
     # -- planning (step thread) -------------------------------------------
 
@@ -323,17 +352,32 @@ class Hydrator:
         hashes: list[int],
         tiers: list[str],
         block_size: int,
+        peer_owner: str = "",
     ) -> HydrationPlan | None:
         """Plan the resident run [start_block, start_block + len(hashes))
-        or return None (caller falls back to the legacy sync path)."""
+        or return None (caller falls back to the legacy sync path).
+        `peer_owner` is the engine URL serving the run's "peer"-tier
+        blocks (probe_prefix's peer continuation)."""
         if self.mode in ("sync", "off") or not hashes:
             return None
         chunk_tiers: list[list[str]] = [
             tiers[i : i + self.chunk_blocks]
             for i in range(0, len(tiers), self.chunk_blocks)
         ]
+        signal = self.signal_fn()
+        if peer_owner and "peer" in tiers:
+            # sample-floor warmup: the peer tier has no sync fallback to
+            # measure it, so an unmeasured peer triggers a bounded
+            # measurement-only fetch on the fetcher thread (rate-limited
+            # per owner); until it crosses the floor, peer chunks price
+            # as recompute and the request loses nothing
+            self._maybe_bootstrap(
+                peer_owner,
+                [h for h, t in zip(hashes, tiers) if t == "peer"],
+                signal,
+            )
         planned = plan_decisions(
-            chunk_tiers, self.signal_fn(),
+            chunk_tiers, signal,
             forced=self.mode == "planner", start_block=start_block,
         )
         if planned is None:
@@ -358,7 +402,35 @@ class Hydrator:
         return HydrationPlan(
             request_id, chunks, block_size,
             deadline=time.monotonic() + timeout, estimates=est,
+            peer_owner=peer_owner,
         )
+
+    def _maybe_bootstrap(
+        self, owner: str, peer_hashes: list[int], signal: dict
+    ) -> None:
+        """Enqueue one measurement-only fetch against `owner` when its
+        bandwidth estimate is still below the sample floor (step thread;
+        the fetch itself runs on the fetcher thread and its payload is
+        DISCARDED — only the TierBandwidth samples matter)."""
+        if self.peer is None or not peer_hashes:
+            return
+        if (signal.get("fetch_bandwidth_measured") or {}).get("peer"):
+            return
+        now = time.monotonic()
+        if now - self._bootstrap_t.get(owner, -1e9) < (
+            self.BOOTSTRAP_MIN_INTERVAL_S
+        ):
+            return
+        self._bootstrap_t[owner] = now
+        # enough blocks to cross MIN_BYTES in two samples where possible
+        from .kv_flow import TierBandwidth
+
+        block_bytes = float(signal.get("block_bytes") or 0.0)
+        want = TierBandwidth.MIN_SAMPLES * max(
+            1, int(TierBandwidth.MIN_BYTES // block_bytes) + 1
+        ) if block_bytes > 0 else len(peer_hashes)
+        self._ensure_thread()
+        self._q.put(("bootstrap", owner, peer_hashes[:want]))
 
     def launch(self, plan: HydrationPlan) -> None:
         """Record the plan's decisions and enqueue its load chunks for the
@@ -392,6 +464,15 @@ class Hydrator:
             item = self._q.get()
             if item is None:
                 return
+            if item[0] == "bootstrap":
+                _, owner, hashes = item
+                try:
+                    self._bootstrap_fetch(owner, hashes)
+                except Exception:
+                    logger.exception(
+                        "peer bandwidth bootstrap against %s faulted", owner
+                    )
+                continue
             plan, chunk = item
             try:
                 self._fetch_chunk(plan, chunk)
@@ -403,6 +484,31 @@ class Hydrator:
                 with plan.lock:
                     if chunk.status == "pending":
                         chunk.status = "failed"
+
+    def _bootstrap_fetch(self, owner: str, hashes: list[int]) -> None:
+        """Measurement-only peer fetches (fetcher thread): split the hash
+        list into MIN_SAMPLES round trips so one warmup crosses both
+        halves of the sample floor; the payloads are discarded — adopting
+        them would need the step thread's pool, and the next admission
+        re-plans against the now-measured tier anyway."""
+        if self.peer is None or not hashes:
+            return
+        from .kv_flow import TierBandwidth
+
+        per = max(1, len(hashes) // TierBandwidth.MIN_SAMPLES)
+        conn = self._peer_conn(owner)
+        for i in range(0, len(hashes), per):
+            got = self.peer.fetch_run(
+                owner, hashes[i : i + per], conn=conn, bootstrap=True
+            )
+            if not got:
+                return  # owner unreachable/evicted: stop burning fetches
+
+    def _peer_conn(self, owner: str):
+        conn = self._peer_conns.get(owner)
+        if conn is None and self.peer is not None:
+            conn = self._peer_conns[owner] = self.peer.new_fetch_conn(owner)
+        return conn
 
     def _fetch_chunk(self, plan: HydrationPlan, chunk: HydrationChunk) -> None:
         with plan.lock:
@@ -452,6 +558,32 @@ class Hydrator:
                 if not ok:
                     break
                 i = j
+            elif (
+                tier == "peer"
+                and self.peer is not None
+                and plan.peer_owner
+            ):
+                # one batched /kv/peer_fetch per consecutive peer span,
+                # over this owner's dedicated connection — the owner
+                # serves the run straight out of its HBM/host tiers
+                j = i
+                while (
+                    j < len(chunk.hashes)
+                    and chunk.tiers[j] == "peer"
+                    and arrays[j] is None
+                ):
+                    j += 1
+                got = self.peer.fetch_run(
+                    plan.peer_owner, chunk.hashes[i:j],
+                    conn=self._peer_conn(plan.peer_owner),
+                )
+                if len(got) < j - i:
+                    ok = False  # owner evicted mid-run: partial is useless
+                for k, arr in enumerate(got):
+                    arrays[i + k] = arr
+                if not ok:
+                    break
+                i = j
             else:
                 # a "host" block whose ring entry vanished before launch
                 # could resolve it, or a tier with no backing object
@@ -481,12 +613,20 @@ class Hydrator:
         if self._remote_conn is not None:
             self._remote_conn.close()
             self._remote_conn = None
+        for conn in self._peer_conns.values():
+            conn.close()
+        self._peer_conns.clear()
+        if self.peer is not None:
+            self.peer.close()
 
     def snapshot(self) -> dict:
         """Operator view for GET /debug/hydration."""
-        return {
+        snap = {
             "mode": self.mode,
             "chunk_blocks": self.chunk_blocks,
             "timeout_s": self.timeout_s,
             "queued_fetch_jobs": self._q.qsize(),
         }
+        if self.peer is not None:
+            snap["peer"] = self.peer.snapshot()
+        return snap

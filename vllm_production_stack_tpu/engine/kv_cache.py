@@ -310,18 +310,30 @@ class KVBlockPool:
 
     def probe_prefix(
         self, token_ids: list[int], parent: int | None = None,
-        local_only: bool = False,
-    ) -> tuple[list[int], list[str]]:
-        """(hashes, tiers) of the longest consecutively-resident run of
-        full prompt blocks across EVERY tier, WITHOUT moving data, taking
-        references, or touching the hit counters — the residency map the
-        compute-or-load planner decides over. tiers[i] is "hbm" | "host"
-        | "disk" | "remote"; the remote continuation is one batched
-        contains round trip (no payload), same as match_length.
-        `local_only` skips that round trip entirely — the `off` kill
-        switch must not keep a sick remote store on the admission path."""
+        local_only: bool = False, peer=None, owner_hint: str | None = None,
+    ) -> tuple[list[int], list[str], str]:
+        """(hashes, tiers, peer_owner) of the longest consecutively-
+        resident run of full prompt blocks across EVERY tier, WITHOUT
+        moving data, taking references, or touching the hit counters —
+        the residency map the compute-or-load planner decides over.
+        tiers[i] is "hbm" | "host" | "disk" | "remote" | "peer"; the
+        remote continuation is one batched contains round trip (no
+        payload), same as match_length. `local_only` skips every round
+        trip — the `off` kill switch must not keep a sick remote store
+        (or peer) on the admission path.
+
+        Peer continuation (docs/35-peer-kv-reuse.md): when the local +
+        remote run ends short of the full chain and a `peer` client
+        (engine/kv_peer.PeerKVTier) is supplied, the probe continues into
+        ANOTHER ENGINE's tiers — the router's `owner_hint` names the
+        owner directly (priced route-vs-migrate stamped it upstream), else
+        one cluster-index lookup rediscovers it; either way one
+        /kv/peer_contains round trip confirms the owner's ACTUAL
+        consecutive residency (the index can be seconds stale).
+        peer_owner is the confirmed owner URL, "" when the run has no
+        peer tail."""
         if not self.enable_prefix_caching:
-            return [], []
+            return [], [], ""
         hashes = list(
             self._chain(token_ids, _ROOT_HASH if parent is None else parent)
         )
@@ -344,7 +356,30 @@ class KVBlockPool:
                     n = remote.contains_run(hashes[idx:])
                     tiers.extend(["remote"] * n)
             break
-        return hashes[: len(tiers)], tiers
+        peer_owner = ""
+        start = len(tiers)
+        # the cluster lookup is a synchronous round trip on the step
+        # thread: only rediscover when the non-resident remainder is big
+        # enough that a peer pull could plausibly beat recomputing it —
+        # tiny tails aren't worth an admission-path hop (an explicit
+        # router hint is trusted regardless: its round trip was already
+        # paid at the router)
+        MIN_LOOKUP_BLOCKS = 4
+        if peer is not None and not local_only and start < len(hashes):
+            owner = (owner_hint or "").rstrip("/")
+            if not owner and len(hashes) - start >= MIN_LOOKUP_BLOCKS:
+                owner, matched = peer.cluster_lookup(hashes, self.block_size)
+                # the index answers from the chain ROOT: an owner whose
+                # whole run is shorter than what this engine already has
+                # locally adds nothing beyond `start`
+                if owner and matched <= start:
+                    owner = ""
+            if owner:
+                n = peer.contains_run(owner, hashes[start:])
+                if n > 0:
+                    tiers.extend(["peer"] * n)
+                    peer_owner = owner
+        return hashes[: len(tiers)], tiers, peer_owner
 
     def adopt_planned_run(
         self, hashes: list[int], arrays: list

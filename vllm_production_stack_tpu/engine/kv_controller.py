@@ -90,7 +90,12 @@ class KVController:
         )
         # counters for /metrics and the zero-probe guarantee tests
         self.probes_sent = 0
-        self.lookup_counts = {"indexed": 0, "fanout": 0, "mixed": 0}
+        # "peer" = /peer_lookup rediscovery calls (docs/35-peer-kv-reuse
+        # .md) — seeded like the routed-lookup modes so the series exists
+        # from the first scrape
+        self.lookup_counts = {
+            "indexed": 0, "fanout": 0, "mixed": 0, "peer": 0,
+        }
 
     async def _sess(self) -> aiohttp.ClientSession:
         return await self._http.get()
@@ -173,6 +178,7 @@ class KVController:
     def build_app(self) -> web.Application:
         app = web.Application(client_max_size=64 * 1024 * 1024)
         app.router.add_post("/lookup", self._handle_lookup)
+        app.router.add_post("/peer_lookup", self._handle_peer_lookup)
         app.router.add_post("/kv/events", self._handle_events)
         app.router.add_post("/register", self._handle_register)
         app.router.add_post("/deregister", self._handle_deregister)
@@ -203,6 +209,33 @@ class KVController:
             result.get("mode", "fanout"), time.perf_counter() - t0
         )
         return web.json_response(result)
+
+    async def _handle_peer_lookup(self, request: web.Request) -> web.Response:
+        """Peer-tier rediscovery (docs/35-peer-kv-reuse.md): which engine
+        holds the longest consecutively-resident run of an ALREADY-HASHED
+        chain. Pure index set walks — no tokenization, no fan-out (an
+        engine that doesn't publish events can't be a peer owner: nobody
+        would learn its residency in time to plan against it)."""
+        body = await request.json()
+        raw = body.get("hashes")
+        block_size = int(body.get("block_size") or 0)
+        if not isinstance(raw, list) or block_size <= 0:
+            return web.json_response(
+                {"error": "hashes (hex list) and block_size are required"},
+                status=400,
+            )
+        try:
+            hashes = [int(h, 16) for h in raw]
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "hashes must be hex strings"}, status=400
+            )
+        url, matched = self.index.lookup_hashes(
+            hashes, block_size, self.engines,
+            exclude=body.get("exclude") or None,
+        )
+        self.lookup_counts["peer"] += 1
+        return web.json_response({"url": url, "matched_blocks": matched})
 
     async def _handle_events(self, request: web.Request) -> web.Response:
         raw = await request.text()

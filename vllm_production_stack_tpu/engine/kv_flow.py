@@ -25,8 +25,8 @@ Two instruments, mirroring the PR 6 StepMeter/GoodputLedger split:
   prompt tokens are classified EXACTLY once by KV origin, and the
   partition is audited::
 
-      hbm_hit + host_reload + disk_load + remote_fetch + recomputed
-          == prompt_tokens
+      hbm_hit + host_reload + disk_load + remote_fetch + peer_fetch
+          + recomputed == prompt_tokens
 
 Direction semantics: ``"in"`` moves bytes toward the HBM pool
 (hydration — reload/load/fetch/PD-adopt), ``"out"`` moves them away
@@ -262,6 +262,15 @@ class KVFlowMeter:
                 },
                 "bandwidth_bytes_per_s": {
                     f"{t}/{d}": bw.bytes_per_s
+                    for (t, d), bw in self.bandwidth.items()
+                },
+                # sample-floor state per key: the exporter gates the
+                # bandwidth GAUGE on it (a sub-floor estimate is one tiny
+                # transfer's noise — rendering it would let scrapers, e.g.
+                # the router's migrate pricing, trust a number the planner
+                # itself refuses to)
+                "bandwidth_measured": {
+                    f"{t}/{d}": bw.measured
                     for (t, d), bw in self.bandwidth.items()
                 },
                 "hydration": dict(self.hydration),

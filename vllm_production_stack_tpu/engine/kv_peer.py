@@ -1,0 +1,343 @@
+"""Peer-engine KV tier: pull prefix KV from another engine's memory.
+
+A prefix resident only in engine A's HBM/host tiers used to be useless to
+engine B — B recomputed or pulled from disk/remote even though the cluster
+KV index knows A has it and the device-path KV transfer already ships
+blocks bit-identically between meshes. This module treats *other engines'
+tiers* as one more rung of the hydration hierarchy (the LMCache
+enterprise thesis + BanaServe's KV-migration half, PAPERS.md): the
+compute-or-load planner (engine/hydration.py) prices a peer fetch against
+recompute/disk/remote per chunk from the measured ``tier="peer"``
+bandwidth, and the router's priced route-vs-migrate policy
+(docs/35-peer-kv-reuse.md) decides when trading ICI/DCN bandwidth for
+seat availability beats chasing the prefix owner.
+
+:class:`PeerKVTier` is the CLIENT half, one per engine:
+
+- ``cluster_lookup`` asks the embedded/controller ``ClusterKVIndex``
+  (``POST {lookup_url}/peer_lookup``) which engine holds the longest run
+  of a hash chain — the rediscovery path when the router didn't stamp an
+  owner hint (``x-kv-owner-hint``) upstream.
+- ``contains_run`` confirms the owner's ACTUAL consecutive residency
+  (``POST {owner}/kv/peer_contains``) — the index can be seconds stale,
+  and planning chunks the owner already evicted would just burn fallback
+  recomputes.
+- ``fetch_run`` pulls block payloads (``POST {owner}/kv/peer_fetch``,
+  the kvstore framing — engine/kv_transfer.FrameParser) and records the
+  transfer under ``tier="peer", direction="in"`` — including failures at
+  0 bytes, so a dying peer reads as collapsing bandwidth, exactly what
+  flips the planner back to recompute.
+
+The serving half lives in engine/server.py (``/kv/peer_contains`` +
+``/kv/peer_fetch``, always mounted — an engine can be an owner without
+consuming the tier) and meters served bytes as ``peer/out``.
+
+Connection discipline mirrors the remote store client
+(kvstore/client.py): keep-alive :class:`_Conn` objects, a cooldown per
+unreachable target so a dead peer costs one timeout per ``cooldown_s``
+instead of one per prompt, and DEDICATED fetch connections for the
+hydration fetcher thread (``new_fetch_conn``) so multi-second chunk pulls
+never serialize behind the step thread's admission probes.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from dataclasses import dataclass
+from urllib.parse import urlsplit
+
+import numpy as np
+
+from ..utils.logging import init_logger
+from .kv_flow import NULL_FLOW
+
+logger = init_logger(__name__)
+
+# the router→engine owner hint (docs/35-peer-kv-reuse.md): stamped by the
+# KV-aware policy when its priced scoring routes a request AWAY from the
+# prefix owner, so the target engine's hydration planner skips cluster
+# rediscovery. Inbound copies are stripped by the router whenever it
+# stamps (same spoof rule as the tenant/fleet headers).
+KV_OWNER_HINT_HEADER = "x-kv-owner-hint"
+
+# bound one peer round trip's hash list: chunk plans fetch spans of
+# chunk_blocks (default 16); 1024 blocks is far above any real span and
+# far below anything that could balloon a request body or a reply
+MAX_PEER_RUN_BLOCKS = 1024
+
+
+def peer_hint_from_headers(headers) -> str | None:
+    """The validated x-kv-owner-hint value, or None. Only http(s) base
+    URLs are accepted — the hint is used as a fetch target, and anything
+    else (a spoofed garbage value that slipped past a hintless router)
+    must degrade to rediscovery, never to a malformed connect."""
+    raw = headers.get(KV_OWNER_HINT_HEADER)
+    if not raw:
+        return None
+    raw = raw.strip().rstrip("/")
+    parts = urlsplit(raw)
+    if parts.scheme not in ("http", "https") or not parts.hostname:
+        return None
+    return raw
+
+
+def _host_port(url: str) -> tuple[str, int]:
+    parts = urlsplit(url)
+    if not parts.hostname:
+        raise ValueError(f"invalid peer URL {url!r}")
+    return parts.hostname, parts.port or (443 if parts.scheme == "https" else 80)
+
+
+@dataclass
+class PeerTierStats:
+    lookups: int = 0  # cluster /peer_lookup round trips
+    lookup_hits: int = 0  # lookups that named an owner
+    contains_probes: int = 0  # owner /kv/peer_contains round trips
+    fetches: int = 0  # /kv/peer_fetch round trips
+    fetched_blocks: int = 0  # blocks pulled peer -> this engine
+    bootstrap_fetches: int = 0  # measurement-only fetches (sample floor)
+    errors: int = 0
+
+
+class PeerKVTier:
+    """Client half of the peer-engine KV tier, one per engine.
+
+    Thread model: ``cluster_lookup``/``contains_run`` run on the STEP
+    thread (admission probes — bounded timeout, cooldown on failure, one
+    shared keep-alive connection per purpose under a small lock);
+    ``fetch_run`` runs on the hydration FETCHER thread over dedicated
+    per-owner connections the :class:`~.hydration.Hydrator` manages via
+    ``new_fetch_conn``. All hashes travel as decimal strings (128-bit;
+    string form sidesteps any JSON integer-width trap, same as the
+    kvstore wire)."""
+
+    def __init__(
+        self,
+        fingerprint: str,
+        self_url: str = "",
+        lookup_url: str = "",
+        timeout: float = 5.0,
+        cooldown_s: float = 5.0,
+        flow=None,
+    ):
+        self.fingerprint = fingerprint
+        self.self_url = (self_url or "").rstrip("/")
+        self.lookup_url = (lookup_url or "").rstrip("/")
+        self.timeout = timeout
+        self.cooldown_s = cooldown_s
+        self.flow = flow if flow is not None else NULL_FLOW
+        self.stats = PeerTierStats()
+        # step-thread probe connections: one to the lookup host, one per
+        # owner — guarded by one lock (admission is single-threaded today;
+        # the lock keeps that an implementation detail, not a contract)
+        self._probe_mu = threading.Lock()
+        self._probe_conns: dict[str, object] = {}
+        # per-target cooldown: a dead lookup service / peer costs one
+        # timeout per cooldown_s, never one per admission
+        self._down_until: dict[str, float] = {}
+
+    # -- availability ------------------------------------------------------
+
+    def _available(self, target: str) -> bool:
+        return time.monotonic() >= self._down_until.get(target, 0.0)
+
+    def _trip(self, target: str, err: Exception) -> None:
+        self.stats.errors += 1
+        self._down_until[target] = time.monotonic() + self.cooldown_s
+        logger.warning(
+            "peer KV target %s unreachable (%s); cooling down %.0fs",
+            target, err, self.cooldown_s,
+        )
+
+    def _conn_for(self, url: str):
+        from ..kvstore.client import _Conn  # shared keep-alive idiom
+
+        conn = self._probe_conns.get(url)
+        if conn is None:
+            host, port = _host_port(url)
+            conn = self._probe_conns[url] = _Conn(host, port, self.timeout)
+        return conn
+
+    # -- discovery (step thread) -------------------------------------------
+
+    def cluster_lookup(
+        self, hashes: list[int], block_size: int
+    ) -> tuple[str, int]:
+        """(owner url, matched BLOCKS) of the engine holding the longest
+        locally-resident run of `hashes` per the cluster KV index — the
+        rediscovery path when no router owner hint arrived. ("", 0) when
+        no lookup service is configured, it is cooling down, or nothing
+        matched. The index excludes THIS engine server-side (its own
+        residency is what probe_prefix already walked)."""
+        if not self.lookup_url or not self._available(self.lookup_url):
+            return "", 0
+        self.stats.lookups += 1
+        body = json.dumps({
+            "hashes": [f"{h:x}" for h in hashes[:MAX_PEER_RUN_BLOCKS]],
+            "block_size": block_size,
+            "exclude": self.self_url,
+        }).encode()
+        try:
+            with self._probe_mu:
+                status, _, payload = self._conn_for(self.lookup_url).request(
+                    "POST", "/peer_lookup", body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+        except (OSError, http.client.HTTPException) as e:
+            # _Conn re-raises HTTPException (a malformed status line from a
+            # proxy, say) which is NOT an OSError — and this runs on the
+            # step thread, where an escape would abort every in-flight
+            # request instead of degrading this one probe
+            self._trip(self.lookup_url, e)
+            return "", 0
+        if status != 200:
+            return "", 0
+        try:
+            data = json.loads(payload)
+        except ValueError:
+            return "", 0
+        owner = (data.get("url") or "").rstrip("/")
+        matched = int(data.get("matched_blocks") or 0)
+        if not owner or matched <= 0 or owner == self.self_url:
+            return "", 0
+        self.stats.lookup_hits += 1
+        return owner, matched
+
+    def contains_run(self, owner: str, hashes: list[int]) -> int:
+        """How many of `hashes` (in order, consecutively) `owner` can serve
+        RIGHT NOW — the staleness guard between the index's view and the
+        owner's actual residency. 0 on any failure (the region simply
+        recomputes)."""
+        owner = owner.rstrip("/")
+        if not owner or not hashes or not self._available(owner):
+            return 0
+        if owner == self.self_url:
+            return 0  # self-fetch would deadlock on the engine lock
+        self.stats.contains_probes += 1
+        body = json.dumps({
+            "fingerprint": self.fingerprint,
+            "hashes": [str(h) for h in hashes[:MAX_PEER_RUN_BLOCKS]],
+        }).encode()
+        try:
+            with self._probe_mu:
+                status, _, payload = self._conn_for(owner).request(
+                    "POST", "/kv/peer_contains", body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+        except (OSError, http.client.HTTPException) as e:
+            self._trip(owner, e)  # same step-thread contract as above
+            return 0
+        if status != 200:
+            return 0
+        try:
+            return max(0, int(json.loads(payload).get("matched") or 0))
+        except ValueError:
+            return 0
+
+    # -- fetch (hydration fetcher thread) ----------------------------------
+
+    def new_fetch_conn(self, owner: str):
+        """A dedicated keep-alive connection to one owner for the hydration
+        fetcher thread — its multi-second chunk pulls must never hold the
+        probe lock the step thread's admissions contend on (the
+        kvstore new_fetch_conn idiom)."""
+        from ..kvstore.client import _Conn
+
+        host, port = _host_port(owner)
+        return _Conn(host, port, self.timeout)
+
+    def fetch_run(
+        self, owner: str, hashes: list[int], conn=None, bootstrap: bool = False,
+    ) -> list[np.ndarray]:
+        """The consecutive prefix of `hashes` the owner served, as arrays —
+        one batched round trip over `conn` (or a throwaway connection).
+        Every round trip records under (peer, in): payload bytes on
+        success, 0 bytes + real elapsed on failure, so the TierBandwidth
+        estimate the planner prices against tracks the truth. `bootstrap`
+        marks measurement-only fetches (docs/35-peer-kv-reuse.md — how the
+        peer tier crosses the sample floor with no sync fallback to feed
+        it)."""
+        owner = owner.rstrip("/")
+        if not owner or not hashes or not self._available(owner):
+            return []
+        from .kv_transfer import FrameParser
+
+        own_conn = conn is None
+        if own_conn:
+            conn = self.new_fetch_conn(owner)
+        t0 = time.perf_counter()
+        out: list[np.ndarray] = []
+
+        def _flow(nbytes: int) -> None:
+            self.flow.record(
+                "peer", "in", nbytes, len(out), time.perf_counter() - t0
+            )
+
+        body = json.dumps({
+            "fingerprint": self.fingerprint,
+            "hashes": [str(h) for h in hashes[:MAX_PEER_RUN_BLOCKS]],
+        }).encode()
+        try:
+            status, _, payload = conn.request(
+                "POST", "/kv/peer_fetch", body=body,
+                headers={"Content-Type": "application/json"},
+            )
+        except (OSError, http.client.HTTPException) as e:
+            _flow(0)  # a dead peer IS ~0 fetch bandwidth — record it
+            self._trip(owner, e)
+            return []
+        finally:
+            if own_conn:
+                conn.close()
+        if status != 200:
+            _flow(0)
+            return []
+        if bootstrap:
+            self.stats.bootstrap_fetches += 1
+        else:
+            self.stats.fetches += 1
+        parser = FrameParser()
+        for h, arr in parser.feed_partial(payload):
+            if len(out) >= len(hashes) or h != hashes[len(out)]:
+                break  # non-consecutive frame; stop clean
+            # copy: a frombuffer view would pin the whole multi-block
+            # response buffer for as long as any one block stays adopted
+            out.append(arr.copy())
+        self.stats.fetched_blocks += len(out)
+        _flow(sum(a.nbytes for a in out))
+        if parser.error is not None:
+            logger.warning(
+                "malformed peer_fetch response from %s after %d valid "
+                "frames: %s", owner, len(out), parser.error,
+            )
+            self.stats.errors += 1
+        return out
+
+    # -- lifecycle / introspection -----------------------------------------
+
+    def close(self) -> None:
+        with self._probe_mu:
+            for conn in self._probe_conns.values():
+                conn.close()
+            self._probe_conns.clear()
+
+    def snapshot(self) -> dict:
+        """Operator view for GET /debug/hydration's peer section."""
+        now = time.monotonic()
+        return {
+            "lookup_url": self.lookup_url,
+            "self_url": self.self_url,
+            "lookups": self.stats.lookups,
+            "lookup_hits": self.stats.lookup_hits,
+            "contains_probes": self.stats.contains_probes,
+            "fetches": self.stats.fetches,
+            "fetched_blocks": self.stats.fetched_blocks,
+            "bootstrap_fetches": self.stats.bootstrap_fetches,
+            "errors": self.stats.errors,
+            "cooling_down": sorted(
+                t for t, until in self._down_until.items() if until > now
+            ),
+        }

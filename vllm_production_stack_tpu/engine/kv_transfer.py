@@ -266,6 +266,59 @@ class KVTransfer:
             parts.append(self.runner.fetch_block(blk))
         return hashes, parts
 
+    def contains_hashes(self, hashes: list[int]) -> int:
+        """How many of `hashes` (in order, consecutively) this engine can
+        serve from its local tiers (HBM + host ring + disk) — the
+        /kv/peer_contains probe (docs/35-peer-kv-reuse.md). No data
+        moves; pure GIL-atomic dict/containment walks, callable with OR
+        without the engine lock (the probe is staleness-tolerant — the
+        fetch/adoption path re-validates everything)."""
+        host = self.pool.host_tier
+        n = 0
+        for h in hashes:
+            if h in self.pool._hash_to_block or (
+                host is not None and h in host
+            ):
+                n += 1
+            else:
+                break
+        return n
+
+    def export_hashes(self, hashes: list[int]):
+        """(served, entries) for the consecutive locally-resident prefix of
+        an ARBITRARY hash run — the /kv/peer_fetch sender half. Under the
+        engine lock this only dispatches device→host copies (HBM blocks)
+        and grabs ring references; entries resolve to numpy OFF the lock:
+
+        - ("dev", parts)  — HBM block, per-layer device slices in flight
+        - ("np", array)   — host-ring bytes, already resolved
+        - ("disk", hash)  — disk-resident; the caller loads the file off
+          the lock (DiskKVTier is fetch-thread-safe) so a multi-MB read
+          never stalls the step thread's admissions
+        """
+        host = self.pool.host_tier
+        served: list[int] = []
+        entries: list[tuple[str, object]] = []
+        for h in hashes:
+            blk = self.pool._hash_to_block.get(h)
+            if blk is not None:
+                entries.append(("dev", self.runner.fetch_block(blk)))
+            elif host is not None and len(host) and h in host._data:
+                arr = host.peek_bytes(h)
+                if arr is None:
+                    break
+                entries.append(("np", arr))
+            elif (
+                host is not None
+                and host.disk is not None
+                and h in host.disk
+            ):
+                entries.append(("disk", h))
+            else:
+                break
+            served.append(h)
+        return served, entries
+
     def import_blocks(self, hashes: list[int], blocks: np.ndarray) -> int:
         """Adopt shipped pages into this engine's pool as evictable cached
         blocks. Returns blocks actually adopted (already-resident and

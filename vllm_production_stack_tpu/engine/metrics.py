@@ -327,6 +327,13 @@ class EngineMetrics:
         self.disk_loads = counter(
             mc.DISK_KV_LOADS, "KV blocks loaded from the local-disk tier"
         )
+        self.kv_bytes_per_token = gauge(
+            mc.KV_BYTES_PER_TOKEN,
+            "Analytic KV bytes per token of this engine's pool "
+            "(block_bytes / block_size) — the constant the router's "
+            "priced route-vs-migrate scoring multiplies by matched prefix "
+            "tokens to price a peer migration (docs/35-peer-kv-reuse.md)",
+        )
         self.hydration_decisions = Counter(
             mc.KV_HYDRATION_DECISIONS[: -len("_total")],
             "Compute-or-load hydration planner chunk decisions (closed "
@@ -351,6 +358,7 @@ class EngineMetrics:
             self.hydration_decisions.labels(**self._labels, choice=choice)
         self.disk_stores.labels(**self._labels)
         self.disk_loads.labels(**self._labels)
+        self.kv_bytes_per_token.labels(**self._labels)
         self.registry.register(_KVFlowHistograms(self))
         # -- fleet-coherence telemetry (docs/32-fleet-telemetry.md) --------
         # session-stickiness audit (fleet.SessionStickinessAudit): closed
@@ -577,6 +585,7 @@ class EngineMetrics:
         fbytes = flow.get("bytes") or {}
         fblocks = flow.get("blocks") or {}
         fbw = flow.get("bandwidth_bytes_per_s") or {}
+        fmeas = flow.get("bandwidth_measured") or {}
         for tier in TRANSFER_TIERS:
             for direction in DIRECTIONS:
                 key = f"{tier}/{direction}"
@@ -589,7 +598,13 @@ class EngineMetrics:
                     self.kv_transfer_blocks, f"kvn:{key}",
                     int(fblocks.get(key, 0)), fl,
                 )
-                self.kv_tier_bandwidth.labels(**fl).set(fbw.get(key, 0.0))
+                # gauge gated on the TierBandwidth sample floor: below it
+                # the estimate is one tiny transfer's noise, and scrapers
+                # (the router's migrate pricing above all) must read 0 =
+                # "not measured", exactly what the planner trusts
+                self.kv_tier_bandwidth.labels(**fl).set(
+                    fbw.get(key, 0.0) if fmeas.get(key) else 0.0
+                )
         hyd = flow.get("hydration") or {}
         for source in HYDRATION_SOURCES:
             self._bump_labeled(
@@ -604,6 +619,7 @@ class EngineMetrics:
             )
         self._bump(self.disk_stores, "disk_store", s.disk_kv_stores)
         self._bump(self.disk_loads, "disk_load", s.disk_kv_loads)
+        self.kv_bytes_per_token.labels(**lb).set(s.kv_bytes_per_token)
 
     def update_fleet_health(
         self,

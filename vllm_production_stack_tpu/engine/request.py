@@ -138,6 +138,12 @@ class Request:
     tenant_id: str = "default"
     priority: int = 1
     weight: float = 1.0
+    # peer-engine KV tier (docs/35-peer-kv-reuse.md): the router's
+    # x-kv-owner-hint — the engine URL whose tiers hold this prompt's
+    # prefix, stamped when priced route-vs-migrate sent the request AWAY
+    # from the owner. The hydration planner's probe uses it to skip the
+    # cluster-index rediscovery hop. None = rediscover (or no peer tier).
+    kv_owner_hint: str | None = None
 
     @property
     def num_prompt_tokens(self) -> int:

@@ -850,11 +850,14 @@ class Scheduler:
         fallback, whose transfers are what feed the bandwidth estimator
         past its sample floor)."""
         # kill switch / bench compute-only arm: lower-tier residency is
-        # ignored and — crucially — the remote store is never probed (a
-        # sick store is exactly why an operator flips this off)
+        # ignored and — crucially — neither the remote store nor any peer
+        # is ever probed (a sick store/peer is exactly why an operator
+        # flips this off)
         off = self.hydrator.mode == "off"
-        hashes, tiers = self.pool.probe_prefix(
-            seq, parent=root, local_only=off
+        hashes, tiers, peer_owner = self.pool.probe_prefix(
+            seq, parent=root, local_only=off,
+            peer=None if off else self.hydrator.peer,
+            owner_hint=None if off else req.kv_owner_hint,
         )
         # keep-one-token rule applied to the whole resident run: the plan
         # region must end at least one token short of the prefill target
@@ -874,7 +877,7 @@ class Scheduler:
             return self.pool.match_prefix(seq, parent=root), None
         plan = self.hydrator.build_plan(
             req.request_id, n_sync, hashes[n_sync:], tiers[n_sync:],
-            self.block_size,
+            self.block_size, peer_owner=peer_owner,
         )
         if plan is None:
             return self.pool.match_prefix(seq, parent=root), None
@@ -1036,6 +1039,7 @@ class Scheduler:
         "host": "host_reload",
         "disk": "disk_load",
         "remote": "remote_fetch",
+        "peer": "peer_fetch",
     }
 
     def _attribute_hydration(
@@ -1049,8 +1053,8 @@ class Scheduler:
         prompt's head (trimmed below prefill_target == prompt tokens at
         first admission), so
 
-            hbm_hit + host_reload + disk_load + remote_fetch + recomputed
-                == prompt_tokens
+            hbm_hit + host_reload + disk_load + remote_fetch + peer_fetch
+                + recomputed == prompt_tokens
 
         with recomputed >= 1 (the keep-one-token-to-compute rule).
 

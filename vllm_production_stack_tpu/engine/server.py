@@ -40,6 +40,7 @@ from .engine import (
     LLMEngine,
 )
 from ..tracing import TraceStore, mono_to_epoch
+from .kv_peer import MAX_PEER_RUN_BLOCKS, peer_hint_from_headers
 from .metrics import EngineMetrics, OPENMETRICS_CONTENT_TYPE, wants_openmetrics
 from .protocol import (
     ChatCompletionRequest,
@@ -196,6 +197,8 @@ class EngineServer:
         r.add_post("/v1/load_lora_adapter", self.load_lora_adapter)
         r.add_post("/v1/unload_lora_adapter", self.unload_lora_adapter)
         r.add_post("/kv/lookup", self.kv_lookup)
+        r.add_post("/kv/peer_contains", self.kv_peer_contains)
+        r.add_post("/kv/peer_fetch", self.kv_peer_fetch)
         r.add_post("/kv/export", self.kv_export)
         r.add_post("/kv/export_stream", self.kv_export_stream)
         r.add_post("/kv/import", self.kv_import)
@@ -373,6 +376,17 @@ class EngineServer:
             return deadline, tenant, self._admission_error(e)
         return deadline, tenant, None
 
+    def _peer_hint(self, request: web.Request) -> str | None:
+        """The validated x-kv-owner-hint, with a hint naming THIS engine
+        dropped: a failover re-pick can deliver a migrate-stamped request
+        back to the owner itself, and probing oneself over HTTP from the
+        step thread (which holds the engine lock the handler needs) would
+        stall an admission for the full peer timeout."""
+        hint = peer_hint_from_headers(request.headers)
+        if hint and hint == self._advertised_url():
+            return None
+        return hint
+
     # -- request tracing (docs/28-request-tracing.md) ----------------------
 
     def _trace_start(self, request: web.Request, rid: str, **attrs):
@@ -507,16 +521,18 @@ class EngineServer:
         if tenant is not None:
             trace.set(tenant=tenant.tenant_id, priority=tenant.priority)
         trace.event("admitted")
+        kv_hint = self._peer_hint(request)
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=True,
                 lora_name=lora_name, parse_tools=use_tools, n=body.n,
                 deadline=deadline, tenant=tenant, trace=trace,
+                kv_owner_hint=kv_hint,
             )
         return await self._complete(
             rid, prompt, sampling, chat=True, lora_name=lora_name,
             parse_tools=use_tools, n=body.n, deadline=deadline,
-            tenant=tenant, trace=trace,
+            tenant=tenant, trace=trace, kv_owner_hint=kv_hint,
         )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
@@ -561,17 +577,19 @@ class EngineServer:
         if tenant is not None:
             trace.set(tenant=tenant.tenant_id, priority=tenant.priority)
         trace.event("admitted")
+        kv_hint = self._peer_hint(request)
         if body.stream:
             return await self._stream(
                 request, rid, prompt, sampling, body, chat=False,
                 prompt_ids=prompt_ids, lora_name=lora_name, n=body.n,
                 echo_text=echo_text, deadline=deadline, tenant=tenant,
-                trace=trace,
+                trace=trace, kv_owner_hint=kv_hint,
             )
         return await self._complete(
             rid, prompt, sampling, chat=False, prompt_ids=prompt_ids,
             lora_name=lora_name, n=body.n, echo_text=echo_text,
             deadline=deadline, tenant=tenant, trace=trace,
+            kv_owner_hint=kv_hint,
         )
 
     async def embeddings(self, request: web.Request) -> web.Response:
@@ -867,7 +885,7 @@ class EngineServer:
 
     async def _run_single(self, rid, prompt, sampling, prompt_ids, lora_name,
                           deadline=None, parent_rid=None, tenant=None,
-                          trace=None, choice=0):
+                          trace=None, choice=0, kv_owner_hint=None):
         """One full generation; returns the accumulated result dict.
         parent_rid (the HTTP request's base id) exempts sibling choices of
         the same n>1 request from this submission's admission count — a
@@ -881,7 +899,7 @@ class EngineServer:
             prompt=prompt, prompt_token_ids=prompt_ids,
             sampling=sampling, request_id=rid, lora_name=lora_name,
             deadline=deadline, admission_exclude_prefix=parent_rid,
-            tenant=tenant,
+            tenant=tenant, kv_owner_hint=kv_owner_hint,
         ):
             text += out.text_delta
             token_ids.extend(out.new_token_ids)
@@ -900,7 +918,7 @@ class EngineServer:
         self, rid, prompt, sampling, *, chat: bool, prompt_ids=None,
         lora_name=None, parse_tools: bool = False, n: int = 1,
         echo_text: str | None = None, deadline: float | None = None,
-        tenant=None, trace=None,
+        tenant=None, trace=None, kv_owner_hint=None,
     ) -> web.Response:
         if trace is None:
             trace = self.traces.start(rid, "engine.request")
@@ -918,7 +936,7 @@ class EngineServer:
                 crid, prompt,
                 self._nth_sampling(sampling, i), prompt_ids, lora_name,
                 deadline, parent_rid=rid, tenant=tenant,
-                trace=trace, choice=i,
+                trace=trace, choice=i, kv_owner_hint=kv_owner_hint,
             ))
             for i, crid in enumerate(self._choice_rids(rid, n))
         ]
@@ -1023,6 +1041,7 @@ class EngineServer:
         prompt_ids=None, lora_name=None, parse_tools: bool = False,
         n: int = 1, echo_text: str | None = None,
         deadline: float | None = None, tenant=None, trace=None,
+        kv_owner_hint=None,
     ) -> web.StreamResponse:
         """SSE streaming for 1..n choices — ONE implementation (n=1 is a
         single pump), so single- and parallel-sampling semantics can never
@@ -1066,7 +1085,7 @@ class EngineServer:
                     sampling=self._nth_sampling(sampling, i),
                     request_id=rids[i], lora_name=lora_name,
                     deadline=deadline, admission_exclude_prefix=rid,
-                    tenant=tenant,
+                    tenant=tenant, kv_owner_hint=kv_owner_hint,
                 ):
                     await queue.put((i, out))
             except Exception as e:
@@ -1525,6 +1544,92 @@ class EngineServer:
         )
         return web.json_response({"matched_tokens": n})
 
+    @staticmethod
+    def _parse_peer_hashes(body: dict) -> list[int] | None:
+        """Decimal-string hash list of one peer probe/fetch, bounded and
+        validated; None = malformed (caller 400s)."""
+        raw = body.get("hashes")
+        if not isinstance(raw, list):
+            return None
+        try:
+            return [int(h) for h in raw[:MAX_PEER_RUN_BLOCKS]]
+        except (TypeError, ValueError):
+            return None
+
+    async def kv_peer_contains(self, request: web.Request) -> web.Response:
+        """Peer-engine KV tier, probe half (docs/35-peer-kv-reuse.md):
+        how many of the requested hashes this engine can serve RIGHT NOW,
+        consecutively, from its local tiers — the staleness guard a
+        peer's hydration planner runs before planning chunks against the
+        cluster index's possibly-seconds-old view of this pool."""
+        body = await request.json()
+        if body.get("fingerprint") != self.engine.model_fingerprint:
+            return error(
+                409, "KV fingerprint mismatch — refusing foreign probe",
+                "conflict",
+            )
+        hashes = self._parse_peer_hashes(body)
+        if hashes is None:
+            return error(400, "hashes must be a list of decimal strings")
+        n = await self.async_engine.kv_peer_contains(hashes)
+        return web.json_response({"matched": n})
+
+    async def kv_peer_fetch(self, request: web.Request) -> web.Response:
+        """Peer-engine KV tier, sender half: the consecutive locally-
+        resident prefix of the requested hashes as kvstore-framed block
+        payloads (engine/kv_transfer raw_frame — the same wire the remote
+        store and the PD stream speak). The engine lock is held only for
+        the residency walk + device-copy dispatch; numpy resolution, disk
+        reads and framing run in an executor, and the served bytes meter
+        under (tier="peer", direction="out")."""
+        from .kv_transfer import block_frame
+
+        body = await request.json()
+        if body.get("fingerprint") != self.engine.model_fingerprint:
+            return error(
+                409, "KV fingerprint mismatch — refusing foreign fetch",
+                "conflict",
+            )
+        hashes = self._parse_peer_hashes(body)
+        if hashes is None:
+            return error(400, "hashes must be a list of decimal strings")
+        t0 = time.perf_counter()
+        served, entries = await self.async_engine.kv_peer_export(hashes)
+
+        def build() -> tuple[bytes, int, int]:
+            host = self.engine.host_tier
+            disk = getattr(host, "disk", None) if host is not None else None
+            frames: list[bytes] = []
+            nbytes = 0
+            for h, (kind, val) in zip(served, entries):
+                if kind == "dev":
+                    arr = np.stack([np.asarray(p) for p in val])
+                elif kind == "np":
+                    arr = val
+                else:  # "disk": file IO deferred off the engine lock
+                    arr = disk.load(val) if disk is not None else None
+                    if arr is None:
+                        break  # evicted since the walk: stop clean
+                frames.append(block_frame(h, arr))
+                nbytes += arr.nbytes
+            return b"".join(frames), len(frames), nbytes
+
+        payload, count, nbytes = await asyncio.get_running_loop(
+        ).run_in_executor(None, build)
+        # peer/out: bytes this engine SERVED to a peer (failure paths on
+        # the puller's side record their own 0-byte samples)
+        self.engine.flow.record(
+            "peer", "out", nbytes, count, time.perf_counter() - t0
+        )
+        return web.Response(
+            body=payload,
+            content_type="application/octet-stream",
+            headers={
+                "X-KV-Count": str(count),
+                "X-KV-Fingerprint": self.engine.model_fingerprint,
+            },
+        )
+
     async def kv_export(self, request: web.Request) -> web.Response:
         """Disaggregated prefill, sender side: the prompt's resident KV
         blocks as an npz payload (engine/kv_transfer.py wire format)."""
@@ -1861,6 +1966,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds a planned chunk fetch may run before the "
                         "chunk falls back to recompute; 0 = auto (3x the "
                         "plan's own fetch estimate, clamped to [0.5, 30])")
+    p.add_argument("--kv-peer-fetch", default=False, type=_parse_bool_flag,
+                   help="peer-engine KV tier (docs/35-peer-kv-reuse.md): "
+                        "let the hydration planner pull a prefix resident "
+                        "only in ANOTHER engine's HBM/host tiers "
+                        "(tier=peer, priced per chunk against recompute/"
+                        "disk/remote from measured bandwidth). Owner "
+                        "discovery: the router's x-kv-owner-hint stamp, "
+                        "else a cluster-index lookup against the first "
+                        "KV_CONTROLLER_URL subscriber. The serving "
+                        "endpoints (/kv/peer_contains, /kv/peer_fetch) "
+                        "are always mounted regardless")
+    p.add_argument("--kv-peer-fetch-timeout-s", type=float, default=2.0,
+                   help="per-round-trip timeout of peer lookups/probes/"
+                        "fetches (probes run on the step thread, so this "
+                        "bounds an admission's worst-case stall on a slow "
+                        "peer); the hydration plan deadline "
+                        "(--kv-hydration-timeout-s) still governs when a "
+                        "pending peer chunk falls back to recompute")
     p.add_argument("--prefill-buckets", default="",
                    help="comma-separated prefill chunk buckets (default: "
                         "pow2 ladder up to --max-num-batched-tokens). "
@@ -2040,6 +2163,10 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             args, "kv_hydration_chunk_blocks", 16
         ),
         kv_hydration_timeout_s=getattr(args, "kv_hydration_timeout_s", 0.0),
+        kv_peer_fetch=getattr(args, "kv_peer_fetch", False),
+        kv_peer_fetch_timeout_s=getattr(
+            args, "kv_peer_fetch_timeout_s", 2.0
+        ),
     )
 
 

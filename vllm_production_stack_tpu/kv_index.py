@@ -355,6 +355,38 @@ class ClusterKVIndex:
                 return None, 0
             return best_url, best_tokens
 
+    def lookup_hashes(
+        self, hashes: list[int], block_size: int,
+        urls: set[str] | None = None, exclude: str | None = None,
+    ) -> tuple[str | None, int]:
+        """(engine url with the longest consecutively-resident run of
+        `hashes`, matched BLOCKS) over the fresh engines whose block size
+        matches — the peer-tier rediscovery lookup (POST /peer_lookup,
+        docs/35-peer-kv-reuse.md). The caller already chained its prompt
+        (probe_prefix), so unlike lookup_token_ids nothing is hashed
+        here: pure set walks. `exclude` drops the asking engine itself —
+        its own residency is exactly what its probe already walked."""
+        candidates = self.fresh_engines(urls)
+        if exclude:
+            candidates = candidates - {exclude.rstrip("/")}
+        if not candidates or not hashes:
+            return None, 0
+        with self._lock:
+            best_url: str | None = None
+            best_blocks = 0
+            for u in sorted(candidates):  # url order for determinism
+                v = self._engines.get(u)
+                if v is None or v.block_size != block_size:
+                    continue
+                matched = 0
+                for h in hashes:
+                    if h not in v.hashes:
+                        break
+                    matched += 1
+                if matched > best_blocks:
+                    best_url, best_blocks = u, matched
+            return best_url, best_blocks
+
     def positions(self) -> dict[str, dict]:
         """Per-engine (epoch, seq) positions + slice sizes — the replica-
         coherence view /fleet and /debug/fleet expose, and the input to

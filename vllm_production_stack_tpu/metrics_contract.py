@@ -177,11 +177,14 @@ SATURATION_HISTOGRAMS = (
 # -- KV-hierarchy flow telemetry (docs/30-kv-flow-telemetry.md) -------------
 # Per-tier transfer meters (engine/kv_flow.KVFlowMeter): every tier move —
 # host-ring offload/reload, disk store/load, remote put/fetch, device-path
-# PD transfer — records bytes, blocks and wall latency. Labels are CLOSED
-# sets (cardinality bounded by construction, series seeded at zero):
-# tier= names the NON-HBM side of the hop, direction= is relative to HBM
-# ("in" = toward the device pool / hydration, "out" = away / offload).
-KV_TRANSFER_TIERS = ("host", "disk", "remote", "device")
+# PD transfer, peer-engine fetch — records bytes, blocks and wall latency.
+# Labels are CLOSED sets (cardinality bounded by construction, series
+# seeded at zero): tier= names the NON-HBM side of the hop, direction= is
+# relative to HBM ("in" = toward the device pool / hydration, "out" =
+# away / offload). "peer" is another engine's HBM/host tiers reached over
+# /kv/peer_fetch (docs/35-peer-kv-reuse.md): "in" = blocks pulled FROM a
+# peer, "out" = blocks this engine served TO a peer.
+KV_TRANSFER_TIERS = ("host", "disk", "remote", "device", "peer")
 KV_TRANSFER_DIRECTIONS = ("in", "out")
 KV_TRANSFER_BYTES = "tpu:kv_transfer_bytes_total"
 KV_TRANSFER_BLOCKS = "tpu:kv_transfer_blocks_total"
@@ -193,10 +196,14 @@ KV_TRANSFER_SECONDS = "tpu:kv_transfer_seconds"
 KV_TIER_BANDWIDTH = "tpu:kv_tier_bandwidth_bytes_per_s"
 # per-request hydration attribution: every admitted request's prompt
 # tokens classified EXACTLY once by where their KV came from —
-# hbm_hit + host_reload + disk_load + remote_fetch + recomputed ==
-# prompt_tokens (same audited-partition discipline as the goodput ledger)
+# hbm_hit + host_reload + disk_load + remote_fetch + peer_fetch +
+# recomputed == prompt_tokens (same audited-partition discipline as the
+# goodput ledger)
+# ("peer_fetch" = blocks pulled from another engine's tiers over the
+# peer-fetch path, docs/35-peer-kv-reuse.md)
 KV_HYDRATION_SOURCES = (
-    "hbm_hit", "host_reload", "disk_load", "remote_fetch", "recomputed",
+    "hbm_hit", "host_reload", "disk_load", "remote_fetch", "peer_fetch",
+    "recomputed",
 )
 REQUEST_PREFIX_TOKENS = "tpu:request_prefix_tokens_total"
 # disk-tier block counters (the host ring has HOST_KV_*, the remote store
@@ -215,6 +222,22 @@ DISK_KV_LOADS = "tpu:disk_kv_loaded_blocks_total"
 # denominator (tpu:kv_hydration_load_share:rate5m does).
 KV_HYDRATION_DECISIONS = "tpu:kv_hydration_decision_total"
 KV_HYDRATION_CHOICES = ("load", "recompute", "fallback_recompute")
+
+# -- peer-engine KV tier (docs/35-peer-kv-reuse.md) -------------------------
+# gauge: analytic KV bytes per token of this engine's pool (block_bytes /
+# block_size — a per-config constant). The router's priced route-vs-migrate
+# scoring multiplies it by the matched prefix length and divides by the
+# fleet-reported peer fetch bandwidth (tpu:kv_tier_bandwidth_bytes_per_s
+# {tier="peer",direction="in"}) to price a migration in seconds without
+# knowing the model.
+KV_BYTES_PER_TOKEN = "tpu:kv_bytes_per_token"
+# router counter labeled decision=: how the KV-aware policy resolved each
+# owner-found request under --kv-migrate-scoring priced. "owner" = follow
+# the prefix owner (affinity); "migrate" = route to the least-loaded engine
+# and stamp the owner hint upstream so the target's hydration planner pulls
+# the prefix over the peer tier instead of recomputing it.
+ROUTER_KV_MIGRATE_DECISIONS = "tpu:router_kv_migrate_decisions_total"
+KV_MIGRATE_DECISION_VALUES = ("owner", "migrate")
 
 # Closed label sets per metric, the single source of truth the exporters
 # seed from and tools/check_metrics_contract.py validates BOTH ways: the
@@ -237,6 +260,7 @@ METRIC_LABEL_VALUES: dict[str, dict[str, tuple[str, ...]]] = {
     },
     REQUEST_PREFIX_TOKENS: {"source": KV_HYDRATION_SOURCES},
     KV_HYDRATION_DECISIONS: {"choice": KV_HYDRATION_CHOICES},
+    ROUTER_KV_MIGRATE_DECISIONS: {"decision": KV_MIGRATE_DECISION_VALUES},
     ENGINE_KV_TIER_USAGE: {"tier": ("hbm", "host", "disk", "remote")},
     ENGINE_STEP_TOKENS: {"phase": ("prefill", "decode")},
     ENGINE_PADDED_TOKENS: {"phase": ("prefill", "decode")},
@@ -263,10 +287,12 @@ CLUSTER_KV_INDEX_STALE_ENGINES = "tpu:cluster_kv_index_stale_engines"
 CLUSTER_KV_EVENTS = "tpu:cluster_kv_events_total"
 CLUSTER_KV_RESYNCS = "tpu:cluster_kv_resyncs_total"
 # counter labeled mode=. The controller observes "indexed"|"fanout"|"mixed"
-# (mixed = index for fresh engines + fan-out for the rest in one lookup);
-# the router observes "indexed"|"controller"|"mixed" (controller = pure
-# controller hop, mixed = non-authoritative index attempt escalated to the
-# controller hop). Each routed request is counted under exactly one mode.
+# (mixed = index for fresh engines + fan-out for the rest in one lookup)
+# plus "peer" (/peer_lookup rediscovery calls from engines' peer tiers,
+# docs/35-peer-kv-reuse.md — not a routed request); the router observes
+# "indexed"|"controller"|"mixed" (controller = pure controller hop, mixed
+# = non-authoritative index attempt escalated to the controller hop).
+# Each ROUTED request is counted under exactly one of the routed modes.
 CLUSTER_KV_LOOKUPS = "tpu:cluster_kv_lookups_total"
 # histogram labeled mode= (kv_index.LookupLatency renders it)
 CLUSTER_KV_LOOKUP_LATENCY = "tpu:cluster_kv_lookup_latency_seconds"
@@ -389,6 +415,9 @@ ALL_GAUGES = (
     ENGINE_KV_TIER_USAGE,
     # KV flow telemetry (docs/30-kv-flow-telemetry.md)
     KV_TIER_BANDWIDTH,
+    # peer-engine KV tier (docs/35-peer-kv-reuse.md): the migrate-pricing
+    # constant the router reads off each engine's scrape
+    KV_BYTES_PER_TOKEN,
     # fleet-coherence telemetry (docs/32-fleet-telemetry.md): engine-side
     # KV event publisher backlog + fan-out subscriber count
     KV_EVENT_QUEUE_DEPTH,

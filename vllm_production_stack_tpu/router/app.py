@@ -215,6 +215,7 @@ def _policy_kwargs(d: dict) -> dict:
         "kv_aware_threshold": d.get("kv_aware_threshold", 256),
         "kv_index_mode": d.get("kv_index_mode") or "controller",
         "kv_index_tokenizer": d.get("kv_index_tokenizer") or "",
+        "kv_migrate_scoring": d.get("kv_migrate_scoring") or "off",
         "prefill_model_labels": split(d.get("prefill_model_labels")),
         "decode_model_labels": split(d.get("decode_model_labels")),
     }
@@ -474,6 +475,38 @@ async def handle_kv_events(request: web.Request) -> web.Response:
     return web.json_response(reply)
 
 
+async def handle_peer_lookup(request: web.Request) -> web.Response:
+    """Peer-tier rediscovery against the EMBEDDED index (the controller
+    serves the same shape, engine/kv_controller.py): which engine holds
+    the longest consecutively-resident run of an already-hashed chain
+    (docs/35-peer-kv-reuse.md). Engines whose KV_CONTROLLER_URL points at
+    this router resolve peer owners here with zero controller hops."""
+    state = _state(request)
+    index = getattr(state.policy, "index", None)
+    if index is None:
+        return web.json_response(
+            {"error": "router is not in embedded KV index mode"}, status=409
+        )
+    body = await request.json()
+    raw = body.get("hashes")
+    block_size = int(body.get("block_size") or 0)
+    if not isinstance(raw, list) or block_size <= 0:
+        return web.json_response(
+            {"error": "hashes (hex list) and block_size are required"},
+            status=400,
+        )
+    try:
+        hashes = [int(h, 16) for h in raw]
+    except (TypeError, ValueError):
+        return web.json_response(
+            {"error": "hashes must be hex strings"}, status=400
+        )
+    url, matched = index.lookup_hashes(
+        hashes, block_size, exclude=body.get("exclude") or None
+    )
+    return web.json_response({"url": url, "matched_blocks": matched})
+
+
 async def handle_kv_register(request: web.Request) -> web.Response:
     """Engines POST /register|/deregister to KV_CONTROLLER_URL on startup
     and shutdown — accept both when that URL points at this router. The
@@ -546,6 +579,7 @@ def build_app(args) -> web.Application:
     # hosts the index; registered unconditionally because dynamic config
     # can swap the policy after the route table froze)
     app.router.add_post("/kv/events", handle_kv_events)
+    app.router.add_post("/peer_lookup", handle_peer_lookup)
     app.router.add_post("/register", handle_kv_register)
     app.router.add_post("/deregister", handle_kv_register)
 

@@ -75,6 +75,21 @@ def build_parser() -> argparse.ArgumentParser:
              "here) — zero lookup hops on the request path",
     )
     r.add_argument(
+        "--kv-migrate-scoring",
+        choices=["off", "priced"],
+        default="off",
+        help="route-vs-migrate policy once the KV-aware lookup finds a "
+             "prefix owner (docs/35-peer-kv-reuse.md): 'off' always "
+             "follows the owner (historical behavior); 'priced' compares "
+             "the owner's queue wait against the least-loaded engine's "
+             "wait plus the KV migration cost (matched tokens x scraped "
+             "tpu:kv_bytes_per_token / measured peer fetch bandwidth) and "
+             "on migrate stamps x-kv-owner-hint upstream so the target "
+             "engine's hydration planner pulls the prefix from the owner "
+             "(engines need --kv-peer-fetch for the pull; without it the "
+             "target recomputes, which is still correct, just unpriced)",
+    )
+    r.add_argument(
         "--kv-index-tokenizer",
         default=None,
         help="embedded mode's shared tokenizer for hashing prompts the way "

@@ -29,6 +29,15 @@ class EngineStats:
     prefix_cache_hit_rate: float = 0.0
     prefix_cache_hits_total: float = 0
     prefix_cache_queries_total: float = 0
+    # peer-engine KV tier (docs/35-peer-kv-reuse.md): the two numbers the
+    # priced route-vs-migrate scoring needs per engine — this engine's
+    # measured peer-fetch bandwidth (tpu:kv_tier_bandwidth_bytes_per_s
+    # {tier="peer",direction="in"}; the exporter renders 0.0 until the
+    # TierBandwidth sample floor is crossed, so nonzero here really means
+    # MEASURED and scoring below it keeps owner affinity / the
+    # exploration rule) and its analytic KV bytes per token
+    kv_peer_bw_in_bytes_per_s: float = 0.0
+    kv_bytes_per_token: float = 0.0
 
     _FIELDS = {
         mc.NUM_REQUESTS_RUNNING: "num_running_requests",
@@ -37,7 +46,14 @@ class EngineStats:
         mc.PREFIX_CACHE_HIT_RATE: "prefix_cache_hit_rate",
         mc.PREFIX_CACHE_HITS: "prefix_cache_hits_total",
         mc.PREFIX_CACHE_QUERIES: "prefix_cache_queries_total",
+        mc.KV_BYTES_PER_TOKEN: "kv_bytes_per_token",
     }
+
+    @property
+    def load(self) -> float:
+        """Seat pressure the migrate scoring compares engines on:
+        running + queued requests."""
+        return self.num_running_requests + self.num_queuing_requests
 
     @classmethod
     def from_scrape(cls, text: str) -> "EngineStats":
@@ -48,6 +64,11 @@ class EngineStats:
                 field = cls._FIELDS.get(sample.name)
                 if field is not None:
                     setattr(stats, field, sample.value)
+                elif sample.name == mc.KV_TIER_BANDWIDTH and (
+                    sample.labels.get("tier") == "peer"
+                    and sample.labels.get("direction") == "in"
+                ):
+                    stats.kv_peer_bw_in_bytes_per_s = sample.value
         return stats
 
 

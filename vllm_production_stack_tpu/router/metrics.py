@@ -127,6 +127,21 @@ class RouterMetrics:
             buckets=LookupLatency.BUCKETS,
             registry=self.registry,
         )
+        # priced route-vs-migrate (docs/35-peer-kv-reuse.md): per-request
+        # verdicts once a prefix owner was found (closed decision set,
+        # seeded at zero) — the router half of the peer-tier loop
+        self.kv_migrate_decisions = Counter(
+            mc.ROUTER_KV_MIGRATE_DECISIONS[: -len("_total")],
+            "KV route-vs-migrate verdicts under --kv-migrate-scoring "
+            "priced (closed set: "
+            + ", ".join(mc.KV_MIGRATE_DECISION_VALUES)
+            + ") — migrate = routed to the least-loaded engine with the "
+            "owner hint stamped upstream for a peer pull",
+            ["decision"],
+            registry=self.registry,
+        )
+        for decision in mc.KV_MIGRATE_DECISION_VALUES:
+            self.kv_migrate_decisions.labels(decision=decision)
         # fleet-coherence telemetry (docs/32-fleet-telemetry.md) ----------
         # subscriber-vantage convergence lag of the EMBEDDED index (the
         # controller renders the same name for its own index); drained
@@ -282,6 +297,10 @@ class RouterMetrics:
             for mode, seconds in drain():
                 self.kv_lookups.labels(mode=mode).inc()
                 self.kv_lookup_latency.labels(mode=mode).observe(seconds)
+        drain_m = getattr(policy, "drain_migrate_log", None)
+        if drain_m is not None:
+            for decision in drain_m():
+                self.kv_migrate_decisions.labels(decision=decision).inc()
 
     def _render_fleet(self, state) -> None:
         """Fleet-coherence gauges (docs/32-fleet-telemetry.md): ring

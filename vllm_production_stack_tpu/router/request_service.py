@@ -19,6 +19,7 @@ import uuid
 import aiohttp
 from aiohttp import web
 
+from ..engine.kv_peer import KV_OWNER_HINT_HEADER
 from ..fleet import (
     REPLICA_HEADER,
     RING_HASH_HEADER,
@@ -47,6 +48,13 @@ SEVERED_KEY = "tpu_severed"
 # re-picks forward the ORIGINAL ring owner — a delivery that moved off it
 # is exactly the stickiness break the engine-side audit counts
 STICKY_KEY = "tpu_sticky"
+# the FIRST route() attempt's KV route-vs-migrate verdict ({"owner",
+# "matched_tokens", "decision"} from KvawarePolicy under
+# --kv-migrate-scoring priced): on "migrate" the owner hint is stamped
+# upstream (x-kv-owner-hint) so the target engine's hydration planner
+# pulls the prefix from the owner instead of rediscovering or recomputing
+# it (docs/35-peer-kv-reuse.md)
+KV_HINT_KEY = "tpu_kv_hint"
 
 
 class UpstreamConnectError(Exception):
@@ -393,6 +401,12 @@ class RequestService:
                 # the original owner stamp is what lets the engine see
                 # that delivery moved (docs/32-fleet-telemetry.md)
                 request[STICKY_KEY] = ctx.sticky
+            if ctx.kv_hint is not None and KV_HINT_KEY not in request:
+                # first pick only, like the sticky stamp: a failover
+                # re-pick may land anywhere, but the prefix OWNER doesn't
+                # change — whoever serves the request can still pull from
+                # it (the owner engine itself just finds the blocks local)
+                request[KV_HINT_KEY] = ctx.kv_hint
             logger.info(
                 "Routing request %s to %s at %f", request_id, url, time.time()
             )
@@ -652,6 +666,21 @@ class RequestService:
             headers[STICKY_SESSION_HEADER] = sticky["session_id"]
             headers[STICKY_OWNER_HEADER] = sticky["owner"]
             headers[RING_HASH_HEADER] = sticky["ring_hash"]
+        # peer-tier owner hint (docs/35-peer-kv-reuse.md): inbound copies
+        # are ALWAYS dropped, under EVERY policy — a client must never be
+        # able to point an engine's KV fetcher at an arbitrary "owner"
+        # (unlike the tenant stamps there is no trusted-upstream-gateway
+        # passthrough case: any gateway that can legitimately stamp this
+        # is itself a KV-aware router sitting closer to the engines) —
+        # and re-stamped only when this request's priced scoring actually
+        # chose migrate
+        headers = {
+            k: v for k, v in headers.items()
+            if k.lower() != KV_OWNER_HINT_HEADER
+        }
+        kv_hint = request.get(KV_HINT_KEY)
+        if kv_hint is not None and kv_hint.get("decision") == "migrate":
+            headers[KV_OWNER_HINT_HEADER] = kv_hint["owner"]
         qos = self.state.qos
         if qos is not None:
             # spoof-proofing: with QoS active, inbound x-tenant-id /

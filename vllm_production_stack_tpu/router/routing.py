@@ -56,6 +56,13 @@ class RoutingContext:
     # attempt's value is the affinity target; failover re-routes leave it
     # alone so a moved delivery is visible engine-side.
     sticky: dict | None = None
+    # filled by KvawarePolicy.route under --kv-migrate-scoring priced when
+    # a prefix owner was found: {"owner": <discovery url>,
+    # "matched_tokens", "decision": "owner"|"migrate"}. On "migrate" the
+    # proxy stamps x-kv-owner-hint upstream so the target engine's
+    # hydration planner pulls the prefix from the owner instead of
+    # recomputing it (docs/35-peer-kv-reuse.md).
+    kv_hint: dict | None = None
 
     def header(self, name: str) -> str | None:
         """Case-insensitive header lookup. HTTP header names are
@@ -276,17 +283,45 @@ class KvawarePolicy(RoutingPolicy):
 
     name = "kvaware"
 
+    # floor of the per-extra-queued-request wait estimate used when an
+    # engine has no measured TTFT yet (fresh fleet) — seconds of queueing
+    # one more in-flight request costs at a busy engine
+    SEAT_COST_S = 0.05
+    # exploration rule that breaks the measurement circularity: the
+    # target's peer bandwidth is only ever measured BY a peer pull, and a
+    # pull only happens after a migrate hint — so a fleet that never
+    # migrates never prices. When the owner is ahead of the target by at
+    # least this many requests, migrate even with the link unmeasured:
+    # worst case the (idle) target recomputes, which already beats
+    # queueing that deep at a drowning owner, and the pull that does
+    # happen is what crosses the bandwidth sample floor.
+    UNPRICED_MIGRATE_EXCESS = 8.0
+
     def __init__(self, controller_url: str = "", threshold_tokens: int = 256,
-                 index=None, tokenizer=None):
+                 index=None, tokenizer=None, migrate_scoring: str = "off"):
         self.controller_url = (controller_url or "").rstrip("/")
         self.threshold_tokens = threshold_tokens
         # embedded mode: a kv_index.ClusterKVIndex + something with
         # .encode(text) -> token ids (the shared engine tokenizer)
         self.index = index
         self.tokenizer = tokenizer
+        # priced route-vs-migrate (docs/35-peer-kv-reuse.md): "off" always
+        # follows the prefix owner (the historical behavior); "priced"
+        # scores route-to-owner vs route-to-least-loaded + peer-pull from
+        # the owner's load/TTFT and the target's fleet-reported peer
+        # bandwidth, stamping x-kv-owner-hint upstream on migrate
+        if migrate_scoring not in ("off", "priced"):
+            raise ValueError(
+                f"kv_migrate_scoring {migrate_scoring!r}; "
+                "expected 'off' or 'priced'"
+            )
+        self.migrate_scoring = migrate_scoring
         self._http = LazyClientSession(timeout=aiohttp.ClientTimeout(total=2))
         # (mode, seconds) lookup observations, drained by RouterMetrics
         self._lookup_log: list[tuple[str, float]] = []
+        # migrate decisions ("owner"|"migrate"), drained by RouterMetrics
+        # into tpu:router_kv_migrate_decisions_total
+        self._migrate_log: list[str] = []
         # rate limiter for the publish-url/discovery-url mismatch warning
         self._mismatch_warn_t = 0.0
 
@@ -305,6 +340,10 @@ class KvawarePolicy(RoutingPolicy):
 
     def drain_lookup_log(self) -> list[tuple[str, float]]:
         log, self._lookup_log = self._lookup_log, []
+        return log
+
+    def drain_migrate_log(self) -> list[str]:
+        log, self._migrate_log = self._migrate_log, []
         return log
 
     def _observe(self, mode: str, seconds: float) -> None:
@@ -347,6 +386,89 @@ class KvawarePolicy(RoutingPolicy):
         elapsed = time.perf_counter() - t0
         # route() pre-normalizes, so set equality is exact
         return url, matched, fresh == available, elapsed
+
+    def _resolve_owner(
+        self, ctx: RoutingContext, owner_url: str, matched: int
+    ) -> str:
+        """Final pick once a prefix owner with `matched` cached tokens is
+        known — the priced route-vs-migrate policy
+        (docs/35-peer-kv-reuse.md). "off" keeps the historical
+        follow-the-owner behavior. "priced" compares, in seconds:
+
+        - **route-to-owner**: the owner's measured avg TTFT (its queue
+          wait under current load), floored by a per-excess-request
+          heuristic (SEAT_COST_S) while TTFT is still unmeasured;
+        - **route-to-least-loaded + peer-pull**: the least-loaded
+          engine's measured TTFT plus the migration cost
+          ``matched_tokens × kv_bytes_per_token ÷ peer_bandwidth`` from
+          the target's scraped tpu:kv_bytes_per_token and its measured
+          tpu:kv_tier_bandwidth_bytes_per_s{tier="peer",direction="in"}.
+
+        Migration requires a strictly-less-loaded target and, normally, a
+        measured peer bandwidth (>0) — the router-side analogue of the
+        engine planner's sample-floor rule. The one exception is the
+        exploration rule (UNPRICED_MIGRATE_EXCESS): an owner ahead of
+        the target by that many requests migrates even unmeasured,
+        because the pull it triggers is the only thing that can ever
+        measure the link (and an idle target recomputing already beats
+        queueing that deep). On migrate the owner hint rides upstream
+        (ctx.kv_hint → x-kv-owner-hint) so the target's hydration
+        planner skips cluster rediscovery."""
+        if self.migrate_scoring != "priced":
+            return owner_url
+        decision = "owner"
+        pick = owner_url
+        stats = ctx.engine_stats
+        rstats = ctx.request_stats
+
+        def load(u: str) -> float:
+            st = stats.get(u)
+            return st.load if st is not None else 0.0
+
+        def ttft(u: str) -> float:
+            st = rstats.get(u)
+            return st.ttft if st is not None else 0.0
+
+        others = [e.url for e in ctx.endpoints if e.url != owner_url]
+        if others:
+            target = min(others, key=lambda u: (load(u), u))
+            tstat = stats.get(target)
+            owner_load, target_load = load(owner_url), load(target)
+            peer_bw = (
+                tstat.kv_peer_bw_in_bytes_per_s if tstat is not None else 0.0
+            )
+            bpt = tstat.kv_bytes_per_token if tstat is not None else 0.0
+            if bpt <= 0.0:
+                ostat = stats.get(owner_url)
+                bpt = ostat.kv_bytes_per_token if ostat is not None else 0.0
+            if target_load < owner_load and peer_bw > 0.0 and bpt > 0.0:
+                migrate_s = matched * bpt / peer_bw
+                owner_wait = max(
+                    ttft(owner_url),
+                    (owner_load - target_load) * self.SEAT_COST_S,
+                )
+                target_wait = ttft(target) + migrate_s
+                if target_wait < owner_wait:
+                    decision = "migrate"
+                    pick = target
+            elif (
+                target_load < owner_load
+                and owner_load - target_load >= self.UNPRICED_MIGRATE_EXCESS
+            ):
+                # unmeasured link, drowning owner: explore (see
+                # UNPRICED_MIGRATE_EXCESS) — the pull this triggers is
+                # what makes the NEXT decision priced
+                decision = "migrate"
+                pick = target
+        self._migrate_log.append(decision)
+        if len(self._migrate_log) > 10000:  # scrape stopped; stay bounded
+            del self._migrate_log[:5000]
+        ctx.kv_hint = {
+            "owner": owner_url,
+            "matched_tokens": matched,
+            "decision": decision,
+        }
+        return pick
 
     @staticmethod
     def _adapter_model(ctx: RoutingContext) -> str | None:
@@ -393,7 +515,7 @@ class KvawarePolicy(RoutingPolicy):
                 url, matched, authoritative, idx_elapsed = None, 0, False, None
             if url in by_norm and matched >= self.threshold_tokens:
                 self._observe("indexed", idx_elapsed or 0.0)
-                return by_norm[url]
+                return self._resolve_owner(ctx, by_norm[url], matched)
             if authoritative:
                 # the index answered for every available engine: a short
                 # match is a real "nothing cached" — go least-loaded, do
@@ -423,7 +545,10 @@ class KvawarePolicy(RoutingPolicy):
                     url in by_norm
                     and data.get("matched_tokens", 0) >= self.threshold_tokens
                 ):
-                    return by_norm[url]
+                    return self._resolve_owner(
+                        ctx, by_norm[url],
+                        int(data.get("matched_tokens", 0)),
+                    )
             except Exception as e:
                 logger.debug("kv controller lookup failed: %s", e)
                 # a failed hop still counts — during a controller outage the
@@ -501,6 +626,7 @@ def make_policy(name: str, **kw) -> RoutingPolicy:
             kw.get("kv_aware_threshold", 256),
             index=index,
             tokenizer=tokenizer,
+            migrate_scoring=kw.get("kv_migrate_scoring") or "off",
         )
     if name == "disaggregated_prefill":
         return DisaggregatedPrefillPolicy(

@@ -25,6 +25,11 @@ from ..fleet import SessionStickinessAudit
 
 
 class FakeEngine:
+    # prompt-prefix key length for the warm-prefix model: long enough to
+    # distinguish workloads sharing a short greeting, short enough that a
+    # shared system prompt + per-user tail maps to ONE key
+    WARM_KEY_CHARS = 128
+
     def __init__(
         self,
         model: str = "fake-model",
@@ -33,6 +38,10 @@ class FakeEngine:
         model_label: str = "",
         self_url: str = "",
         log_requests: bool = True,
+        seats: int = 0,
+        prefill_tps: float = 0.0,
+        peer_pull_tps: float = 0.0,
+        kv_bytes_per_token: float = 0.0,
     ):
         self.model = model
         self.tokens_per_sec = tokens_per_sec
@@ -43,6 +52,23 @@ class FakeEngine:
         self.prompt_tokens_total = 0
         self.generation_tokens_total = 0
         self.sleeping = False
+        # -- peer-tier bench model (docs/35-peer-kv-reuse.md) --------------
+        # seats > 0 bounds concurrent decodes (requests queue FIFO for a
+        # seat — the queue wait a hot owner accumulates); prefill_tps > 0
+        # charges len(prompt)/4/prefill_tps of prefill delay for COLD
+        # prompts (a warm prefix is free); peer_pull_tps > 0 makes an
+        # x-kv-owner-hint request pay the (much cheaper) pull delay once,
+        # after which the prefix is warm locally.
+        self.seats = seats
+        self._seat_sem = asyncio.Semaphore(seats) if seats > 0 else None
+        self.prefill_tps = prefill_tps
+        self.peer_pull_tps = peer_pull_tps
+        # exported so the router's priced scoring can price migrations
+        # against this engine exactly as it would a real one
+        self.kv_bytes_per_token = kv_bytes_per_token
+        self.warm_prefixes: set[str] = set()
+        self.peer_pulls = 0
+        self.cold_prefills = 0
         # the REAL engine-side stickiness audit (fleet.py) over the
         # router's sticky stamps, so multi-replica benches measure
         # violations through the same detector production uses; self_url
@@ -99,80 +125,117 @@ class FakeEngine:
 
         self.running += 1
         try:
-            if body.get("stream"):
-                resp = web.StreamResponse(
-                    headers={"Content-Type": "text/event-stream"}
+            # seat gate FIRST: queue wait at a saturated engine delays the
+            # first byte exactly like a real scheduler's waiting queue
+            # (self.running already counts this request, so the router's
+            # scraped load sees the backlog)
+            if self._seat_sem is not None:
+                await self._seat_sem.acquire()
+            try:
+                await self._prefill_delay(str(prompt), n_prompt, request)
+                return await self._emit(
+                    request, body, rid, created, is_chat, n, n_prompt, gap
                 )
-                await resp.prepare(request)
-                for i in range(n):
-                    await asyncio.sleep(gap)
-                    delta = (
-                        {"delta": {"content": f"tok{i} "}}
-                        if is_chat
-                        else {"text": f"tok{i} "}
-                    )
-                    chunk = {
-                        "id": rid,
-                        "object": (
-                            "chat.completion.chunk" if is_chat else "text_completion"
-                        ),
-                        "created": created,
-                        "model": body.get("model", self.model),
-                        "choices": [{"index": 0, **delta, "finish_reason": None}],
-                    }
-                    await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
-                opts = body.get("stream_options") or {}
-                if opts.get("include_usage"):
-                    usage_chunk = {
-                        "id": rid,
-                        "object": (
-                            "chat.completion.chunk" if is_chat
-                            else "text_completion"
-                        ),
-                        "created": created,
-                        "model": body.get("model", self.model),
-                        "choices": [],
-                        "usage": {
-                            "prompt_tokens": n_prompt,
-                            "completion_tokens": n,
-                            "total_tokens": n_prompt + n,
-                        },
-                    }
-                    await resp.write(
-                        f"data: {json.dumps(usage_chunk)}\n\n".encode()
-                    )
-                await resp.write(b"data: [DONE]\n\n")
-                await resp.write_eof()
-                self.generation_tokens_total += n
-                return resp
-            await asyncio.sleep(gap * n)
-            self.generation_tokens_total += n
-            text = " ".join(f"tok{i}" for i in range(n))
-            choice = (
-                {
-                    "index": 0,
-                    "message": {"role": "assistant", "content": text},
-                    "finish_reason": "length",
-                }
-                if is_chat
-                else {"index": 0, "text": text, "finish_reason": "length"}
+            finally:
+                if self._seat_sem is not None:
+                    self._seat_sem.release()
+        finally:
+            self.running -= 1
+
+    async def _prefill_delay(self, prompt: str, n_prompt: int,
+                             request: web.Request) -> None:
+        """Warm/cold/peer-pull prefill model (no-op unless prefill_tps is
+        configured): a warm prefix is free; an owner-hinted request pays
+        matched/peer_pull_tps then warms the prefix; a cold prompt pays
+        the full n_prompt/prefill_tps."""
+        if self.prefill_tps <= 0:
+            return
+        key = prompt[: self.WARM_KEY_CHARS]
+        if key in self.warm_prefixes:
+            return
+        hint = request.headers.get("x-kv-owner-hint")
+        if hint and self.peer_pull_tps > 0:
+            self.peer_pulls += 1
+            await asyncio.sleep(n_prompt / self.peer_pull_tps)
+        else:
+            self.cold_prefills += 1
+            await asyncio.sleep(n_prompt / self.prefill_tps)
+        self.warm_prefixes.add(key)
+
+    async def _emit(self, request, body, rid, created, is_chat, n,
+                    n_prompt, gap) -> web.StreamResponse:
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
             )
-            return web.json_response(
-                {
+            await resp.prepare(request)
+            for i in range(n):
+                await asyncio.sleep(gap)
+                delta = (
+                    {"delta": {"content": f"tok{i} "}}
+                    if is_chat
+                    else {"text": f"tok{i} "}
+                )
+                chunk = {
                     "id": rid,
-                    "object": "chat.completion" if is_chat else "text_completion",
+                    "object": (
+                        "chat.completion.chunk" if is_chat else "text_completion"
+                    ),
                     "created": created,
                     "model": body.get("model", self.model),
-                    "choices": [choice],
+                    "choices": [{"index": 0, **delta, "finish_reason": None}],
+                }
+                await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            opts = body.get("stream_options") or {}
+            if opts.get("include_usage"):
+                usage_chunk = {
+                    "id": rid,
+                    "object": (
+                        "chat.completion.chunk" if is_chat
+                        else "text_completion"
+                    ),
+                    "created": created,
+                    "model": body.get("model", self.model),
+                    "choices": [],
                     "usage": {
                         "prompt_tokens": n_prompt,
                         "completion_tokens": n,
                         "total_tokens": n_prompt + n,
                     },
                 }
-            )
-        finally:
-            self.running -= 1
+                await resp.write(
+                    f"data: {json.dumps(usage_chunk)}\n\n".encode()
+                )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            self.generation_tokens_total += n
+            return resp
+        await asyncio.sleep(gap * n)
+        self.generation_tokens_total += n
+        text = " ".join(f"tok{i}" for i in range(n))
+        choice = (
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": "length",
+            }
+            if is_chat
+            else {"index": 0, "text": text, "finish_reason": "length"}
+        )
+        return web.json_response(
+            {
+                "id": rid,
+                "object": "chat.completion" if is_chat else "text_completion",
+                "created": created,
+                "model": body.get("model", self.model),
+                "choices": [choice],
+                "usage": {
+                    "prompt_tokens": n_prompt,
+                    "completion_tokens": n,
+                    "total_tokens": n_prompt + n,
+                },
+            }
+        )
 
     async def h_transcription(self, request: web.Request) -> web.Response:
         """Echo the multipart upload back: proves the router relayed the file
@@ -239,6 +302,22 @@ class FakeEngine:
             f"{mc.PROMPT_TOKENS}{label} {self.prompt_tokens_total}",
             f"{mc.GENERATION_TOKENS}{label} {self.generation_tokens_total}",
         ]
+        if self.kv_bytes_per_token > 0:
+            # peer-tier pricing inputs (docs/35-peer-kv-reuse.md), shaped
+            # exactly like the real exporter so the router's priced
+            # route-vs-migrate scoring reads this fake the same way:
+            # bytes/token plus a "measured" peer-in bandwidth derived
+            # from the configured pull rate
+            lines.append(
+                f"{mc.KV_BYTES_PER_TOKEN}{label} "
+                f"{self.kv_bytes_per_token}"
+            )
+            if self.peer_pull_tps > 0:
+                bw = self.peer_pull_tps * self.kv_bytes_per_token
+                lines.append(
+                    f'{mc.KV_TIER_BANDWIDTH}{{model_name="{self.model}",'
+                    f'tier="peer",direction="in"}} {bw}'
+                )
         # stickiness-audit contract series (closed reason set), so the
         # multi-replica benches read violations the same way a scraper
         # would off a real engine
@@ -296,6 +375,20 @@ def main(argv=None) -> None:
     p.add_argument("--no-request-log", action="store_true",
                    help="disable the per-request log (open-loop load "
                         "benches would grow it unboundedly)")
+    p.add_argument("--seats", type=int, default=0,
+                   help="concurrent decode seats (0 = unbounded); excess "
+                        "requests queue FIFO — the load model behind the "
+                        "peer-tier route-vs-migrate bench")
+    p.add_argument("--prefill-tps", type=float, default=0.0,
+                   help="cold-prompt prefill rate (tokens/s; 0 disables "
+                        "the warm/cold prefill model)")
+    p.add_argument("--peer-pull-tps", type=float, default=0.0,
+                   help="owner-hinted peer-pull rate (tokens/s) — the "
+                        "cheap alternative to a cold prefill")
+    p.add_argument("--kv-bytes-per-token", type=float, default=0.0,
+                   help="tpu:kv_bytes_per_token exported on /metrics so "
+                        "priced route-vs-migrate can price migrations "
+                        "against this fake")
     args = p.parse_args(argv)
     from ..utils.system import raise_fd_limit
 
@@ -308,6 +401,10 @@ def main(argv=None) -> None:
         model_label=args.model_label,
         self_url=args.self_url,
         log_requests=not args.no_request_log,
+        seats=args.seats,
+        prefill_tps=args.prefill_tps,
+        peer_pull_tps=args.peer_pull_tps,
+        kv_bytes_per_token=args.kv_bytes_per_token,
     )
     web.run_app(engine.build_app(), host=args.host, port=args.port, print=None)
 
