@@ -287,6 +287,157 @@ def run_steploop_bench() -> dict:
         out["pipeline_speedup"] = round(
             out["pipelined"]["tok_s"] / out["sync"]["tok_s"], 3
         )
+    del engine
+    gc.collect()
+    try:
+        out["speculative"] = run_steploop_spec_arms()
+    except Exception as e:  # never lose the loop numbers to the rider
+        out["speculative"] = {"error": str(e)}
+    return out
+
+
+def run_steploop_spec_arms() -> dict:
+    """Speculative decoding × step loop (docs/36-speculative-decoding.md):
+    speculative+pipelined vs pipelined-only vs speculative-serial on a
+    repetition-friendly decode workload — decode tok/s, acceptance rate,
+    host-sync fraction. Asserts the composed arm strictly beats BOTH
+    baselines, that its streams are bitwise identical to the serial
+    speculative loop, and that the goodput-ledger partition is exact.
+
+    Workload: random prompts into a CYCLIC-decode fixture model — the
+    tiny-llama preset with attention-output and MLP-down projections
+    zeroed, so the hidden state is a function of the current token alone
+    and greedy decode iterates a fixed token→token map into a short cycle
+    (the same crafted-fixture idiom the identical-weights draft tests
+    lean on). Perfectly periodic output is the n-gram proposer's home
+    regime: acceptance approaches 1 and the verify dispatch (ONE forward
+    over k+1 positions) replaces a decode window's w sequential forwards,
+    which is exactly the economics speculation is supposed to buy. The
+    fixture keeps the cycle shorter than the proposer's lookback on any
+    vocab; STEPLOOP_SPEC_MODEL overrides the model on a chip big enough
+    to amortize a larger vocab's longer cycles."""
+    import gc
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig,
+        EngineConfig,
+        SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+    from vllm_production_stack_tpu.models.registry import resolve_model_config
+
+    n_seqs, prompt_len, gen_len, spec_k = 8, 32, 256, 8
+    model_cfg = resolve_model_config(
+        os.environ.get("STEPLOOP_SPEC_MODEL", "tiny-llama"),
+        max_model_len=512,
+    )
+
+    def fixture_params(params):
+        def zero_mixing(path, x):
+            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            if name.endswith("attn/wo") or name.endswith("mlp/down"):
+                return jnp.zeros_like(x)
+            return x
+
+        return jax.tree_util.tree_map_with_path(zero_mixing, params)
+
+    prompts = [
+        list(np.random.RandomState(900 + i).randint(
+            1, model_cfg.vocab_size, size=prompt_len))
+        for i in range(n_seqs)
+    ]
+    sampling = SamplingParams(max_tokens=gen_len, temperature=0.0,
+                              ignore_eos=True)
+    out: dict = {}
+    streams: dict[str, list[list[int]]] = {}
+    engine = None
+    for mode, async_on, k in (
+        ("pipelined_only", True, 0),
+        ("spec_serial", False, spec_k),
+        ("spec_pipelined", True, spec_k),
+    ):
+        del engine
+        gc.collect()
+        engine = LLMEngine(EngineConfig(
+            model=model_cfg,
+            cache=CacheConfig(block_size=16, num_blocks=1024),
+            scheduler=SchedulerConfig(
+                max_num_seqs=n_seqs,
+                max_num_batched_tokens=n_seqs * prompt_len,
+                decode_buckets=(n_seqs,),
+                prefill_buckets=(prompt_len, n_seqs * prompt_len),
+                decode_window=8,
+                width_floor_blocks=1,
+                num_speculative_tokens=k,
+            ),
+            async_scheduling=async_on,
+        ))
+        engine.runner.params = fixture_params(engine.runner.params)
+        engine.generate(prompts, sampling)  # warmup: compile the wave
+        sched = engine.scheduler
+        best = None
+        for _ in range(2):  # best of two: scheduler-noise tolerance
+            t_before = dict(engine.timing)
+            prop0 = sched.spec_proposed_tokens
+            acc0 = sched.spec_accepted_tokens
+            t0 = time.perf_counter()
+            outs = engine.generate(prompts, sampling)
+            wall = time.perf_counter() - t0
+            gen = sum(len(o["token_ids"]) for o in outs)
+            assert gen == n_seqs * gen_len, (gen, n_seqs * gen_len)
+            dt = {kk: engine.timing[kk] - t_before[kk] for kk in t_before}
+            proposed = sched.spec_proposed_tokens - prop0
+            accepted = sched.spec_accepted_tokens - acc0
+            balance = engine.goodput_balance()
+            streams[mode] = [o["token_ids"] for o in outs]
+            wave = {
+                "tok_s": round(gen / wall, 1),
+                "acceptance_rate": round(accepted / proposed, 3)
+                if proposed else 0.0,
+                "proposed": proposed,
+                "sync_frac": round(dt["sync_s"] / wall, 3),
+                "overlap_frac": round(
+                    dt["overlap_s"] / dt["step_wall_s"], 3
+                ) if dt["step_wall_s"] else 0.0,
+                "rollbacks": dt["rollback_n"],
+                "ledger_balanced": bool(balance["balanced"]),
+                "wall_s": round(wall, 3),
+            }
+            if best is None or wave["tok_s"] > best["tok_s"]:
+                best = wave
+        out[mode] = best
+        engine.runner.shutdown(wait=True)
+    # the PR 1 equivalence bar, speculation active: composing with the
+    # pipeline must not move a single token
+    out["streams_bitwise_equal"] = (
+        streams["spec_serial"] == streams["spec_pipelined"]
+    )
+    out["composed_beats_pipelined_only"] = (
+        out["spec_pipelined"]["tok_s"] > out["pipelined_only"]["tok_s"]
+    )
+    out["composed_beats_spec_serial"] = (
+        out["spec_pipelined"]["tok_s"] > out["spec_serial"]["tok_s"]
+    )
+    assert out["streams_bitwise_equal"], "spec streams diverged across loops"
+    assert all(out[m]["ledger_balanced"] for m in streams), out
+    assert out["composed_beats_pipelined_only"], out
+    # the composed-beats-serial claim is the PIPELINE's contribution —
+    # host work hidden behind device compute. On the cpu backend the
+    # "device" IS the host (same cores execute both), so there is nothing
+    # to hide behind and chaining's extra dispatch shows up as pure
+    # overhead; `host_cores` rides the JSON (the fleet-bench honesty-note
+    # idiom) so a serialized CPU result reads as what it is. Asserted on
+    # a real accelerator, reported otherwise.
+    out["host_cores"] = os.cpu_count()
+    out["backend"] = jax.default_backend()
+    if out["backend"] != "cpu":
+        assert out["composed_beats_spec_serial"], out
     return out
 
 

@@ -83,6 +83,14 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--num-speculative-tokens"
 - {{ .numSpeculativeTokens | quote }}
 {{- end }}
+{{- if .speculativeConfig }}
+- "--speculative-config"
+- {{ .speculativeConfig | quote }}
+{{- end }}
+{{- if .draftModel }}
+- "--draft-model"
+- {{ .draftModel | quote }}
+{{- end }}
 {{- if .decodeWindow }}
 - "--decode-window"
 - {{ .decodeWindow | quote }}

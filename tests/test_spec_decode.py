@@ -1,8 +1,12 @@
-"""N-gram speculative decoding: the proposer, verification-path correctness
-(spec and non-spec engines must produce IDENTICAL greedy outputs), token
-accounting, and the acceptance counters."""
+"""Speculative decoding: the proposers (n-gram + draft model),
+verification-path correctness (spec and non-spec engines must produce
+IDENTICAL greedy outputs), composition with the pipelined step loop
+(bitwise serial↔pipelined equivalence, partial-acceptance chain trim),
+goodput-ledger exactness, draft KV-pool isolation, and the acceptance
+counters."""
 
 import numpy as np
+import pytest
 
 from vllm_production_stack_tpu.engine.config import (
     CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
@@ -27,18 +31,46 @@ def test_propose_ngram_basic():
     assert propose_ngram([1, 2, 3], k=0) is None
 
 
-def _build(spec_k):
+def _build(
+    spec_k, async_on=True, method="ngram", draft="", model=None, **cache_kw
+):
+    cache = dict(block_size=8, num_blocks=64)
+    cache.update(cache_kw)
     return LLMEngine(
         EngineConfig(
-            model=ModelConfig.tiny(),
-            cache=CacheConfig(block_size=8, num_blocks=64),
+            model=model or ModelConfig.tiny(),
+            cache=CacheConfig(**cache),
             scheduler=SchedulerConfig(
                 max_num_seqs=4, max_num_batched_tokens=32,
                 decode_buckets=(4,), prefill_buckets=(16, 32),
                 decode_window=4, num_speculative_tokens=spec_k,
+                speculative_method=method, draft_model=draft,
             ),
+            async_scheduling=async_on,
         )
     )
+
+
+def _shutdown(*engines):
+    """Cancel queued background compiles — leaked compile threads steal
+    CPU from whatever module runs next (the PR 2 deflake lesson), and the
+    draft proposer's runner compiles too."""
+    for e in engines:
+        e.runner.shutdown(wait=True)
+        if getattr(e, "draft_runner", None) is not None:
+            e.draft_runner.shutdown(wait=True)
+
+
+def _streams(engine, prompts, sampling):
+    ids = [
+        engine.add_request(prompt_token_ids=p, sampling=s)
+        for p, s in zip(prompts, sampling)
+    ]
+    got = {i: [] for i in ids}
+    while engine.has_unfinished():
+        for out in engine.step():
+            got[out.request_id].extend(out.new_token_ids)
+    return [got[i] for i in ids]
 
 
 def test_spec_engine_matches_plain_greedy():
@@ -146,3 +178,320 @@ def test_spec_sole_request_near_pool_exhaustion_finishes():
     )[0]
     assert len(out["token_ids"]) == 22
     assert engine.scheduler.total_preemptions < 50
+
+
+# -- composition with the pipelined step loop (docs/36) ----------------------
+
+
+def test_serial_pipelined_equivalence_with_speculation():
+    """The PR 1 equivalence bar, speculation active: greedy AND seeded
+    sampled rows in one batch must produce bitwise-identical streams on
+    the serial and pipelined loops — verify dispatches are in-flight
+    pipeline work now, and a partial acceptance is just another rollback."""
+    rng = np.random.RandomState(7)
+    base = list(rng.randint(1, 500, size=6))
+    prompts = [
+        base * 3,  # repetitive: proposals fire
+        list(rng.randint(1, 500, size=9)),
+        base * 2 + list(rng.randint(1, 500, size=4)),
+    ]
+    sampling = [
+        SamplingParams(max_tokens=18, temperature=0.0, ignore_eos=True),
+        SamplingParams(
+            max_tokens=14, temperature=0.8, seed=99, ignore_eos=True
+        ),
+        SamplingParams(max_tokens=18, temperature=0.0, ignore_eos=True),
+    ]
+    serial = _build(3, async_on=False)
+    pipe = _build(3, async_on=True)
+    try:
+        s = _streams(serial, prompts, sampling)
+        p = _streams(pipe, prompts, sampling)
+        assert p == s
+        # the pipeline actually pipelined (overlap accrued) and the spec
+        # path actually fired on both loops
+        assert pipe.timing["overlap_s"] > 0
+        assert serial.scheduler.spec_proposed_tokens > 0
+        assert pipe.scheduler.spec_proposed_tokens > 0
+    finally:
+        _shutdown(serial, pipe)
+
+
+def test_partial_acceptance_trims_inflight_chain():
+    """A decode window chained on top of an in-flight verify speculates
+    full acceptance; a partial acceptance at resolve time must discard it
+    (rollback_n) and re-dispatch — with the stream still bitwise equal to
+    the serial speculative loop. Random tiny-model weights make partial
+    acceptance the common case; scan a few prompt seeds for one that
+    provably hit it."""
+    greedy = [SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True)]
+    hit = False
+    for seed in range(4):
+        rng = np.random.RandomState(100 + seed)
+        base = list(rng.randint(1, 500, size=5))
+        prompt = [base * 4]
+        serial = _build(4, async_on=False)
+        pipe = _build(4, async_on=True)
+        try:
+            want = _streams(serial, prompt, greedy)
+            got = _streams(pipe, prompt, greedy)
+            assert got == want
+            partial = (
+                serial.scheduler.spec_proposed_tokens
+                > serial.scheduler.spec_accepted_tokens
+            )
+            if partial and pipe.timing["rollback_n"] > 0:
+                hit = True
+        finally:
+            _shutdown(serial, pipe)
+        if hit:
+            break
+    assert hit, "no prompt produced a partial acceptance with a chained step"
+
+
+def test_ledger_exact_with_rejections_on_both_loops():
+    """GoodputLedger partition exactness at quiescence with speculative
+    rejections charged as wasted{rollback} — on the serial AND pipelined
+    loops, n-gram and draft proposers both."""
+    rng = np.random.RandomState(11)
+    base = list(rng.randint(1, 500, size=6))
+    prompts = [base * 3, list(rng.randint(1, 500, size=8))]
+    greedy = SamplingParams(max_tokens=16, temperature=0.0, ignore_eos=True)
+    # the draft deliberately DIFFERS from the target (3 layers vs 2) so
+    # draft rejections actually occur
+    target = ModelConfig.tiny(num_layers=3)
+    for async_on in (False, True):
+        for method, draft, model in (
+            ("ngram", "", None),
+            ("draft", "tiny-llama", target),
+        ):
+            eng = _build(
+                3, async_on=async_on, method=method, draft=draft, model=model
+            )
+            try:
+                eng.generate(prompts, greedy)
+                bal = eng.goodput_balance()
+                assert bal["balanced"], (method, async_on, bal)
+                assert bal["pending"] == 0
+                if eng.scheduler.spec_proposed_tokens > (
+                    eng.scheduler.spec_accepted_tokens
+                ):
+                    assert bal["wasted"]["rollback"] > 0
+            finally:
+                _shutdown(eng)
+
+
+# -- draft-model proposer ----------------------------------------------------
+
+
+def test_draft_proposer_matches_plain_greedy_and_attributes():
+    """Draft-model speculation is lossless for greedy, and acceptance
+    attributes under proposer=draft. An identical-weights draft (same
+    tiny config + same seed) must be accepted at ~full rate — the proof
+    that the draft's catch-up/KV state machine tracks the target."""
+    rng = np.random.RandomState(5)
+    prompts = [
+        list(rng.randint(1, 500, size=9)),
+        list(rng.randint(1, 500, size=12)),
+    ]
+    greedy = SamplingParams(max_tokens=16, temperature=0.0, ignore_eos=True)
+    plain = _build(0)
+    eng = _build(3, method="draft", draft="tiny-llama")
+    try:
+        ref = [r["token_ids"] for r in plain.generate(prompts, greedy)]
+        got = [r["token_ids"] for r in eng.generate(prompts, greedy)]
+        assert got == ref
+        sch = eng.scheduler
+        assert sch.spec_proposed_by["draft"] > 0
+        assert sch.spec_proposed_by["ngram"] == 0
+        # identical weights → the draft predicts the target's argmax:
+        # near-total acceptance (ties/clipping allow a little slack)
+        assert (
+            sch.spec_accepted_by["draft"]
+            >= 0.8 * sch.spec_proposed_by["draft"]
+        )
+    finally:
+        _shutdown(plain, eng)
+
+
+def test_draft_blocks_never_content_addressed():
+    """KV-pool isolation: draft scratch blocks share the allocator but
+    must never become matchable — no prefix match, /kv/lookup walk, or
+    peer residency check can ever return one (they are never registered,
+    so no hash chain points at them)."""
+    rng = np.random.RandomState(6)
+    prompt = list(rng.randint(1, 500, size=10))
+    greedy = SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+    eng = _build(3, method="draft", draft="tiny-llama")
+    try:
+        rid = eng.add_request(prompt_token_ids=prompt, sampling=greedy)
+        pool = eng.scheduler.pool
+        proposer = eng.scheduler.draft_proposer
+        saw_scratch = False
+        while eng.has_unfinished():
+            eng.step()
+            scratch = {
+                blk
+                for st in proposer._states.values()
+                for blk in st.block_table
+            }
+            if scratch:
+                saw_scratch = True
+                # never registered: no content hash maps to a draft block
+                assert not scratch & set(pool._hash_to_block.values())
+                assert not scratch & set(pool._block_to_hash)
+                # the cluster/peer-visible hash set can't name them either
+                assert scratch.isdisjoint(
+                    pool._hash_to_block.get(h)
+                    for h in pool.published_hashes()
+                )
+        assert saw_scratch, "draft proposer never held scratch blocks"
+        del rid
+        # a fresh identical prompt's prefix match returns only REGISTERED
+        # (target-computed) blocks; all draft scratch was released
+        assert proposer._states == {}  # released at finish
+        assert pool.scratch_blocks == 0
+        matched = pool.match_prefix(list(prompt), parent=pool.root_hash())
+        for blk in matched:
+            assert blk in pool._block_to_hash
+            pool.free_block(blk)
+    finally:
+        _shutdown(eng)
+
+
+def test_preempt_and_abort_mid_draft():
+    """A request leaving the scheduler mid-draft (preemption or abort)
+    must release its draft scratch blocks, keep the ledger partition
+    exact, and — for preemption — still finish with the exact greedy
+    stream (the draft state rebuilds via catch-up at re-admission)."""
+    rng = np.random.RandomState(8)
+    prompts = [
+        list(rng.randint(1, 500, size=9)),
+        list(rng.randint(1, 500, size=9)),
+    ]
+    greedy = SamplingParams(max_tokens=14, temperature=0.0, ignore_eos=True)
+    plain = _build(0, async_on=False)
+    eng = _build(3, async_on=False, method="draft", draft="tiny-llama")
+    try:
+        ref = [r["token_ids"] for r in plain.generate(prompts, greedy)]
+        ids = [
+            eng.add_request(prompt_token_ids=p, sampling=greedy)
+            for p in prompts
+        ]
+        got = {i: [] for i in ids}
+        preempted = aborted = False
+        while eng.has_unfinished():
+            for out in eng.step():
+                got[out.request_id].extend(out.new_token_ids)
+            states = eng.scheduler.draft_proposer._states
+            if not preempted and ids[0] in states:
+                victim = next(
+                    (
+                        r
+                        for r in eng.scheduler.running
+                        if r.request_id == ids[0] and r.prefill_done
+                    ),
+                    None,
+                )
+                if victim is not None:
+                    eng.scheduler._preempt(victim)
+                    # the seat's draft state died with it
+                    assert ids[0] not in states
+                    preempted = True
+            if preempted and not aborted and ids[1] in states:
+                assert eng.abort_request(ids[1])
+                assert ids[1] not in states  # released by the abort finish
+                aborted = True
+        assert preempted and aborted
+        # the preempted request recomputed to the exact same greedy stream
+        assert got[ids[0]] == ref[0]
+        # the aborted one delivered a strict prefix
+        assert ref[1][: len(got[ids[1]])] == got[ids[1]]
+        assert eng.scheduler.pool.scratch_blocks == 0
+        bal = eng.goodput_balance()
+        assert bal["balanced"] and bal["pending"] == 0
+    finally:
+        _shutdown(plain, eng)
+
+
+def test_draft_config_validation():
+    from dataclasses import replace
+
+    cfg = EngineConfig.tiny()
+    with pytest.raises(ValueError, match="--draft-model"):
+        replace(
+            cfg.scheduler, num_speculative_tokens=2,
+            speculative_method="draft",
+        )
+    with pytest.raises(ValueError, match="speculative_method"):
+        replace(cfg.scheduler, speculative_method="nope")
+
+
+def test_spec_counters_and_exporter_labels():
+    """The per-proposer counters ride the metric contract: closed label
+    set, exporter-seeded at zero, rendered from the snapshot."""
+    from vllm_production_stack_tpu import metrics_contract as mc
+    from vllm_production_stack_tpu.engine.metrics import EngineMetrics
+
+    rng = np.random.RandomState(9)
+    base = list(rng.randint(1, 500, size=6))
+    eng = _build(3)
+    try:
+        eng.generate(
+            [base * 3],
+            SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True),
+        )
+        snap = eng.stats()
+        assert snap.spec_proposed_by["ngram"] == (
+            eng.scheduler.spec_proposed_by["ngram"]
+        )
+        text = EngineMetrics("tiny-llama").render(snap).decode()
+        for proposer in mc.SPEC_PROPOSER_VALUES:
+            assert f'proposer="{proposer}"' in text
+        base_name = mc.SPEC_PROPOSED_TOKENS[: -len("_total")]
+        assert base_name in text
+    finally:
+        _shutdown(eng)
+
+
+def test_draft_vocab_must_match_target():
+    """The proposer contract is a SHARED tokenizer: a draft whose vocab
+    differs from the target's is rejected at engine construction in BOTH
+    directions — a larger draft vocab can propose ids the target's
+    embedding gather silently clamps (garbage KV, not an error), a
+    smaller one cannot ingest every target id at catch-up."""
+    with pytest.raises(ValueError, match="vocab"):
+        _build(2, method="draft", draft="llama-1b")
+
+
+def test_draft_proposal_memo_skips_redundant_dispatch():
+    """The scheduler's verify/decode alternation can discard a whole
+    propose_batch after the draft model already ran (the plain group won
+    the turn); the proposer's memo answers the next identical ask without
+    re-dispatching, and invalidates as soon as the sequence advances."""
+    eng = _build(3, method="draft", draft="tiny-llama")
+    try:
+        proposer = eng.scheduler.draft_proposer
+        calls = []
+        real = proposer.runner.execute
+        proposer.runner.execute = lambda w: (calls.append(w) or real(w))
+
+        class _Row:
+            request_id = "memo-row"
+            all_token_ids = [3, 5, 7, 9, 11]
+
+        first = proposer.propose_batch([_Row()], k=3)
+        n = len(calls)
+        assert n > 0 and len(first["memo-row"]) == 3
+        again = proposer.propose_batch([_Row()], k=3)
+        assert again == first
+        assert len(calls) == n  # memo hit: zero draft dispatches
+        # the sequence advancing (a verify resolved) invalidates the memo
+        _Row.all_token_ids = _Row.all_token_ids + first["memo-row"][:1]
+        moved = proposer.propose_batch([_Row()], k=3)
+        assert len(moved["memo-row"]) == 3
+        assert len(calls) > n
+        proposer.release("memo-row")
+        assert eng.scheduler.pool.scratch_blocks == 0
+    finally:
+        _shutdown(eng)

@@ -85,12 +85,18 @@ class AsyncEngine:
         self._stop = False
         # background program compiles defer to traffic (model_runner
         # _bg_compile_job): compile only when nothing is queued or running
-        runner = getattr(self.engine, "runner", None)
-        if runner is not None:
-            runner.idle_check = lambda: (
+        def idle() -> bool:
+            return (
                 not self.engine.scheduler.has_unfinished()
                 and not self._pending
             )
+
+        runner = getattr(self.engine, "runner", None)
+        if runner is not None:
+            runner.idle_check = idle
+        draft = getattr(self.engine, "draft_runner", None)
+        if draft is not None:
+            draft.idle_check = idle
         self._thread = threading.Thread(
             target=self._step_loop, name="engine-step", daemon=True
         )
@@ -104,6 +110,9 @@ class AsyncEngine:
         runner = getattr(self.engine, "runner", None)
         if runner is not None and hasattr(runner, "shutdown"):
             runner.shutdown()  # cancel queued background compiles
+        draft = getattr(self.engine, "draft_runner", None)
+        if draft is not None and hasattr(draft, "shutdown"):
+            draft.shutdown()  # the draft proposer's runner compiles too
         hydrator = getattr(self.engine, "hydrator", None)
         if hydrator is not None:
             hydrator.close()  # stop the hydration fetcher thread

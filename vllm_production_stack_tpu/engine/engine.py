@@ -64,6 +64,10 @@ class EngineStatsSnapshot:
     remote_kv_fetched_blocks: int = 0
     spec_draft_tokens: int = 0
     spec_accepted_tokens: int = 0
+    # per-proposer split (closed set ngram|draft) behind the labeled
+    # tpu:spec_decode_proposed/accepted_tokens_total contract counters
+    spec_proposed_by: dict = field(default_factory=dict)
+    spec_accepted_by: dict = field(default_factory=dict)
     # request-lifecycle robustness (metrics_contract REQUESTS_SHED /
     # REQUESTS_DEADLINE_EXPIRED / ENGINE_DRAINING)
     requests_shed: int = 0
@@ -102,11 +106,11 @@ class _RequestState:
 
 @dataclass
 class _InflightStep:
-    """A decode step dispatched to the device but not yet resolved — the
-    unit the pipelined step loop keeps in flight while the host schedules
-    and postprocesses around it."""
+    """A decode (or speculative-verify) step dispatched to the device but
+    not yet resolved — the unit the pipelined step loop keeps in flight
+    while the host schedules and postprocesses around it."""
 
-    work: DecodeWork
+    work: DecodeWork | VerifyWork
     handle: StepHandle
     # set once the handle's results were synced to the host — a step that
     # faults before this must be restored as the in-flight step
@@ -312,6 +316,62 @@ class LLMEngine:
             self.scheduler.pool.expected_block_shape = engine_block_shape(
                 self.runner
             )
+        # draft-model proposer (--speculative-config draft, docs/36): a
+        # second, small ModelRunner whose paged KV lives in its OWN device
+        # arrays but whose block ids come from the SHARED KVBlockPool via
+        # the scratch namespace — one allocator, one byte budget, and a
+        # draft page can never satisfy a prefix match or peer lookup
+        # (never content-addressed). N-gram stays the zero-weight fallback.
+        self.draft_runner = None
+        sch = config.scheduler
+        if sch.num_speculative_tokens > 0 and sch.speculative_method == "draft":
+            import dataclasses as _dc
+
+            from ..models.registry import resolve_model_config
+            from .spec_decode import DraftModelProposer
+
+            draft_model = resolve_model_config(
+                sch.draft_model, max_model_len=config.model.max_model_len
+            )
+            if draft_model.vocab_size != config.model.vocab_size:
+                raise ValueError(
+                    f"draft model {sch.draft_model!r} vocab "
+                    f"({draft_model.vocab_size}) differs from the target "
+                    f"model's ({config.model.vocab_size}) — the proposer "
+                    "contract is a shared tokenizer: a larger draft vocab "
+                    "can propose ids the target's embedding cannot gather "
+                    "(XLA clamps out-of-range gathers SILENTLY — garbage "
+                    "KV, not an error), a smaller one cannot ingest every "
+                    "target id at catch-up"
+                )
+            draft_cfg = EngineConfig(
+                model=draft_model,
+                # same block geometry so pool block ids map 1:1 onto the
+                # draft arrays' page axis; no lower tiers — the draft's KV
+                # is recompute-cheap scratch, never offloaded
+                cache=_dc.replace(
+                    config.cache, num_host_blocks=0, host_kv_gib=0.0,
+                    disk_kv_dir="", disk_kv_gib=0.0, remote_kv_url="",
+                ),
+                # same bucket ladders: draft batches pad up through the
+                # identical program cache, so draft-batch shapes can't
+                # retrigger compilation mid-traffic. The draft itself never
+                # runs a verify program.
+                scheduler=_dc.replace(
+                    config.scheduler, num_speculative_tokens=0,
+                    draft_model="",
+                ),
+                # same seed: a random-weight draft that happens to share
+                # the target's exact config reproduces its weights — the
+                # acceptance≈1 fixture tests and benches lean on
+                seed=config.seed,
+            )
+            self.draft_runner = ModelRunner(draft_cfg)
+            self.scheduler.draft_proposer = DraftModelProposer(
+                self.draft_runner,
+                self.scheduler.pool,
+                max_model_len=config.model.max_model_len,
+            )
         self._states: dict[str, _RequestState] = {}
         self._lora_slots: dict[str, int] = {}  # adapter name -> slot index
         self._lora_paths: dict[str, str] = {}  # adapter name -> source path
@@ -340,13 +400,13 @@ class LLMEngine:
         }
         # two-deep pipelined step loop (config.async_scheduling): dispatch
         # step N+1 against speculatively-advanced scheduler state before
-        # step N's tokens reach the host. Speculative n-gram decoding needs
-        # resolved token VALUES for its proposer, so it forces the serial
-        # path.
-        self._pipeline = (
-            config.async_scheduling
-            and config.scheduler.num_speculative_tokens == 0
-        )
+        # step N's tokens reach the host. Speculative decoding COMPOSES
+        # with it (docs/36-speculative-decoding.md): verify dispatches are
+        # in-flight work like decode windows, a verify CHAINS on an
+        # in-flight verify (its fed proposals are host-known under full
+        # acceptance; only the bonus token is spliced device-side), and a
+        # partial acceptance is just another rollback.
+        self._pipeline = config.async_scheduling
         self._inflight: _InflightStep | None = None
         # saturation telemetry (docs/29-saturation-slo.md): per-resolved-
         # step occupancy / padding / MFU accounting. The goodput LEDGER
@@ -1052,8 +1112,11 @@ class LLMEngine:
             )
         nxt: _InflightStep | None = None
         pre_handle: StepHandle | None = None
-        sync_work = None
-        if isinstance(work, DecodeWork):
+        if isinstance(work, (DecodeWork, VerifyWork)):
+            # a verify dispatch pipelines exactly like a decode window: its
+            # rows advance speculatively by their fed length (full
+            # acceptance) and the next step can chain a decode window off
+            # the handle's on-device bonus-token vector
             handle = self.runner.execute_async(
                 work, prev=inflight.handle if inflight else None
             )
@@ -1066,8 +1129,6 @@ class LLMEngine:
             # this same call (prefill outputs are never speculated on)
             pre_handle = self.runner.execute_async(work)
             self.timing["dispatch_s"] += time.perf_counter() - t1
-        elif work is not None:
-            sync_work = work  # verify — unreachable (spec forces serial)
         if inflight is not None:
             # everything since step entry ran while the previous step was
             # still executing on device — the overlap the pipeline buys
@@ -1139,8 +1200,6 @@ class LLMEngine:
             self._meter_prefill(work)
             self._emit_results(results, pre_handle.logprob_rows, outputs)
             self.timing["post_s"] += time.perf_counter() - t3
-        elif sync_work is not None:
-            self._execute_sync(sync_work, outputs, time.perf_counter())
         self._inflight = nxt
         self.timing["step_wall_s"] += time.perf_counter() - t_enter
         self._drop_finished(outputs)
@@ -1169,8 +1228,7 @@ class LLMEngine:
 
     def _step_sync(self) -> list[RequestOutput]:
         """The serial fallback loop: schedule → execute → sync →
-        postprocess, one step per call (async_scheduling=False, or
-        speculative decoding enabled)."""
+        postprocess, one step per call (async_scheduling=False)."""
         t0 = time.perf_counter()
         work = self.scheduler.schedule()
         t1 = time.perf_counter()
@@ -1224,13 +1282,16 @@ class LLMEngine:
 
     # -- saturation & goodput telemetry (docs/29-saturation-slo.md) --------
 
-    def _ledger_rollback(self, work: DecodeWork) -> None:
+    def _ledger_rollback(self, work: DecodeWork | VerifyWork) -> None:
         """A dispatched pipeline step was discarded: the device still
-        executed it, sampling window × rows tokens nobody will consume —
-        sampled AND wasted in one motion (they never reach postprocess)."""
-        n = work.window * len(work.requests)
-        self.scheduler.ledger.sampled(n)
-        self.scheduler.ledger.waste("rollback", n)
+        executed it, sampling window × rows (or every verify row's fed
+        positions) nobody will consume — sampled AND wasted in one motion
+        (they never reach postprocess)."""
+        if isinstance(work, VerifyWork):
+            n = sum(len(t) for t in work.token_ids)
+        else:
+            n = work.window * len(work.requests)
+        self.scheduler.ledger.rollback(n)
 
     def _meter_decode(self, work, accepted: int) -> None:
         """Record one resolved decode/verify dispatch with the meter. The
@@ -1448,6 +1509,11 @@ class LLMEngine:
             num_cached_prompt_tokens=req.num_cached_prompt_tokens,
         )
         out.text_delta = text
+        if req.spec_window is not None:
+            # this step resolved a verify window: hand its (proposed,
+            # accepted, proposer) to the tracing spine's decode_window
+            # event and clear the stamp (one window, one event)
+            out.spec_window, req.spec_window = req.spec_window, None
         if out.finished:
             # lifecycle stamps for the tracing spine's phase attribution —
             # carried on the terminal output because the request state is
@@ -1588,6 +1654,8 @@ class LLMEngine:
             ),
             spec_draft_tokens=self.scheduler.spec_proposed_tokens,
             spec_accepted_tokens=self.scheduler.spec_accepted_tokens,
+            spec_proposed_by=dict(self.scheduler.spec_proposed_by),
+            spec_accepted_by=dict(self.scheduler.spec_accepted_by),
             generation_tokens=self._generation_tokens,
             prompt_tokens=self._prompt_tokens,
             host_kv_usage_perc=(

@@ -122,6 +122,9 @@ class KVBlockPool:
         # | "disk" | "remote", parallel to its return) — consumed by the
         # scheduler's hydration attribution before the next match runs
         self.last_match_sources: list[str] = []
+        # blocks currently held by the scratch (non-content-addressed)
+        # namespace — the draft proposer's pool share, for observability
+        self.scratch_blocks = 0
         if enable_prefix_caching:
             # warm the native batch hasher NOW (pool construction = engine
             # init, where XLA compiles already dominate) — never lazily from
@@ -149,6 +152,23 @@ class KVBlockPool:
         return 1.0 - self.num_free / self.num_usable
 
     # -- allocation --------------------------------------------------------
+
+    def allocate_scratch(self) -> int | None:
+        """Allocate a block OUTSIDE the content-addressed namespace — the
+        draft-model proposer's block-table rung (docs/36-speculative-
+        decoding.md). Scratch blocks share the allocator and byte budget
+        but are never registered: no hash chain ever points at one, so a
+        draft page can never satisfy a prefix match, a /kv/lookup probe, a
+        peer /kv/peer_contains walk, or a KV export — isolation is
+        structural, not filtered. Freed via free_scratch."""
+        blk = self.allocate()
+        if blk is not None:
+            self.scratch_blocks += 1
+        return blk
+
+    def free_scratch(self, blk: int) -> None:
+        self.scratch_blocks -= 1
+        self.free_block(blk)
 
     def allocate(self) -> int | None:
         if self._free:

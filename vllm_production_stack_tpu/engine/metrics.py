@@ -200,11 +200,30 @@ class EngineMetrics:
             mc.REMOTE_KV_FETCHES, "KV blocks fetched from the remote store"
         )
         self.spec_draft = counter(
-            mc.SPEC_DRAFT_TOKENS, "Speculative tokens proposed (ngram)"
+            mc.SPEC_DRAFT_TOKENS, "Speculative tokens proposed (all proposers)"
         )
         self.spec_accepted = counter(
             mc.SPEC_ACCEPTED_TOKENS, "Speculative tokens accepted"
         )
+        # per-proposer acceptance accounting (docs/36): proposer= is the
+        # closed ngram|draft set, seeded below so the acceptance-rate rule
+        # has both series from the first scrape
+        self.spec_proposed_by = Counter(
+            mc.SPEC_PROPOSED_TOKENS[: -len("_total")],
+            "Speculative tokens proposed, by proposer (closed label set: "
+            + ", ".join(mc.SPEC_PROPOSER_VALUES) + ")",
+            [*names, "proposer"],
+            registry=self.registry,
+        )
+        self.spec_accepted_by = Counter(
+            mc.SPEC_ACCEPTED_BY_PROPOSER[: -len("_total")],
+            "Speculative tokens accepted at verification, by proposer",
+            [*names, "proposer"],
+            registry=self.registry,
+        )
+        for proposer in mc.SPEC_PROPOSER_VALUES:
+            self.spec_proposed_by.labels(**self._labels, proposer=proposer)
+            self.spec_accepted_by.labels(**self._labels, proposer=proposer)
         self.prompt_tokens = counter(mc.PROMPT_TOKENS, "Prompt tokens processed")
         self.generation_tokens = counter(mc.GENERATION_TOKENS, "Tokens generated")
         self.requests_shed = counter(
@@ -512,6 +531,16 @@ class EngineMetrics:
         )
         self._bump(self.spec_draft, "spec_draft", s.spec_draft_tokens)
         self._bump(self.spec_accepted, "spec_acc", s.spec_accepted_tokens)
+        for proposer in mc.SPEC_PROPOSER_VALUES:
+            pl = {**lb, "proposer": proposer}
+            self._bump_labeled(
+                self.spec_proposed_by, f"spec_prop:{proposer}",
+                int((s.spec_proposed_by or {}).get(proposer, 0)), pl,
+            )
+            self._bump_labeled(
+                self.spec_accepted_by, f"spec_accby:{proposer}",
+                int((s.spec_accepted_by or {}).get(proposer, 0)), pl,
+            )
         self._bump(self.prompt_tokens, "prompt", s.prompt_tokens)
         self._bump(self.generation_tokens, "gen", s.generation_tokens)
         self._bump(self.requests_shed, "shed", s.requests_shed)

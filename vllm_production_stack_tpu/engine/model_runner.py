@@ -38,7 +38,9 @@ from ..parallel.sharding import (
     lora_param_specs,
 )
 from .config import EngineConfig
-from .sampling import SUPPRESS_IDS, sample, suppress_stop_tokens
+from .sampling import (
+    SUPPRESS_IDS, greedy_argmax, sample, suppress_stop_tokens,
+)
 from .scheduler import DecodeWork, PrefillWork, ScheduleOutput, VerifyWork
 
 logger = init_logger(__name__)
@@ -125,6 +127,11 @@ class StepHandle:
         self.logprob_rows: list | None = None
         self.sync_s = 0.0  # host time blocked in the D2H sync
         self._rows: list[list[int]] | None = None
+        # verify handles: (B_pad,) device vector of each row's full-
+        # acceptance bonus token — the chain source for a decode window
+        # dispatched on top of this still-in-flight verify step (decode
+        # handles chain from tokens[:, -1] instead; see _chain_fn)
+        self.chain_vec = None
 
     def resolve(self) -> list[list[int]]:
         """Sync the step's results to the host — exactly ONE jax.device_get
@@ -320,6 +327,34 @@ class ModelRunner:
                 host_toks,
             ),
             out_shardings=NamedSharding(self.mesh, P(mesh_lib.DP_AXIS)),
+        )
+        # chain variant for a previous VERIFY step: its per-row next-input
+        # token is position-dependent (each row's last real fed column), so
+        # the handle carries a precomputed (B_pad,) vector instead of a
+        # matrix column
+        self._chain_vec_fn = jax.jit(
+            lambda prev_vec, host_toks, idx: jnp.where(
+                idx >= 0,
+                jnp.take(prev_vec, jnp.clip(idx, 0, prev_vec.shape[0] - 1)),
+                host_toks,
+            ),
+            out_shardings=NamedSharding(self.mesh, P(mesh_lib.DP_AXIS)),
+        )
+        # verify-on-verify chaining (docs/36): a chained verify row's FIRST
+        # fed token is the in-flight verify's bonus token (chain_vec) —
+        # spliced into column 0 of the fed-token matrix device-side, since
+        # its value exists nowhere on the host yet
+        self._chain_verify_fn = jax.jit(
+            lambda prev_vec, toks, idx: toks.at[:, 0].set(
+                jnp.where(
+                    idx >= 0,
+                    jnp.take(
+                        prev_vec, jnp.clip(idx, 0, prev_vec.shape[0] - 1)
+                    ),
+                    toks[:, 0],
+                )
+            ),
+            out_shardings=NamedSharding(self.mesh, P(mesh_lib.DP_AXIS, None)),
         )
         self._zero_stop_arrays: dict[int, tuple] = {}
         self._sleeping_params_host: Any | None = None
@@ -762,9 +797,14 @@ class ModelRunner:
     def _build_verify_fn(self):
         """Speculative-verification program (engine/spec_decode.py): a
         chunked-prefill-shaped forward over [current token + proposals] with
-        GREEDY argmax at EVERY position — m[j] confirms or replaces the
-        proposal for position j+1, so one dispatch yields 1..k+1 tokens per
-        row. Same paged attention + blockwise KV commit as prefill."""
+        GREEDY argmax at EVERY position (sampling.greedy_argmax — the same
+        pick the decode window's temperature-0 branch makes) — m[j]
+        confirms or replaces the proposal for position j+1, so one dispatch
+        yields 1..k+1 tokens per row. Same paged attention + blockwise KV
+        commit as prefill. Also returns each row's LAST usable prediction
+        (the full-acceptance bonus token) as a (B,) vector so the pipelined
+        loop can chain the next decode window's input from this still-in-
+        flight step without a host round trip."""
         cfg = self.config.model
 
         @functools.partial(jax.jit, donate_argnames=("kv_caches",))
@@ -794,12 +834,29 @@ class ModelRunner:
             logits = llama.compute_logits(
                 cfg, params, hidden.reshape(-1, hidden.shape[-1])
             )
-            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return kv_caches, toks.reshape(hidden.shape[0], hidden.shape[1])
+            toks = greedy_argmax(logits)
+            mat = toks.reshape(hidden.shape[0], hidden.shape[1])
+            # row i's bonus token under full acceptance sits at its last
+            # real fed column — the chain source for a dispatched-on-top
+            # decode window
+            nxt = jnp.take_along_axis(
+                mat, jnp.maximum(chunk_lens - 1, 0)[:, None], axis=1
+            )[:, 0]
+            return kv_caches, mat, nxt
 
         return verify_fn
 
-    def _execute_verify(self, work: VerifyWork) -> list[list[int]]:
+    def _dispatch_verify(
+        self, work: VerifyWork, prev: StepHandle | None = None
+    ) -> StepHandle:
+        """Dispatch one speculative-verify step WITHOUT syncing results —
+        the pipelined loop's verify entry point (the serial path resolves
+        the returned handle immediately). The handle carries `chain_vec`,
+        the on-device per-row bonus-token vector a chained next step
+        (decode window OR another verify) gathers its input from. Rows
+        whose work.chain_rows entry is >= 0 take their FIRST fed token
+        from `prev`'s chain_vec — the still-unresolved bonus token of the
+        in-flight verify they stack on."""
         # logprobs requests are routed away from the verify path
         # (scheduler._schedule_decode_or_verify)
         self.last_logprobs = None
@@ -835,11 +892,26 @@ class ModelRunner:
         )
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
-        self.kv_caches, toks = self._verify_fn(
+        # verify draws no RNG (pure argmax): rng_before == rng after, so a
+        # discard()'s rewind is a no-op — recorded anyway for uniformity
+        rng_before = self._rng
+        toks_dev = self._put(token_ids, self._batch2)
+        if work.chain_rows and any(c >= 0 for c in work.chain_rows):
+            if prev is None or prev.chain_vec is None:
+                raise RuntimeError(
+                    "chained verify rows need an in-flight verify handle "
+                    "(chain_vec) to splice their first fed token from"
+                )
+            idx = np.full(b_pad, -1, np.int32)
+            idx[: len(work.chain_rows)] = work.chain_rows
+            toks_dev = self._chain_verify_fn(
+                prev.chain_vec, toks_dev, self._put(idx, self._batch1)
+            )
+        self.kv_caches, toks, nxt = self._verify_fn(
             self.params,
             self.lora_params,
             self.kv_caches,
-            self._put(token_ids, self._batch2),
+            toks_dev,
             self._put(positions, self._batch2),
             self._put(block_tables, self._batch2),
             self._put(context_lens, self._batch1),
@@ -848,12 +920,29 @@ class ModelRunner:
             self._put(start_off, self._batch1),
             self._put(lora_idx, self._batch1) if self._use_lora else None,
         )
-        mat = np.asarray(jax.device_get(toks))
-        # row i's usable predictions are its first chunk_lens[i] positions
+        handle = StepHandle(
+            runner=self, work=work, tokens=toks, lp_arrays=None,
+            rng_before=rng_before,
+            postproc=functools.partial(self._verify_rows, work, b),
+        )
+        handle.chain_vec = nxt
+        return handle
+
+    @staticmethod
+    def _verify_rows(work: VerifyWork, b: int, mat, lp):
+        """Host-side row building for a resolved verify handle: row i's
+        usable predictions are its first len(fed) positions."""
+        del lp
         return [
             list(map(int, mat[i, : len(work.token_ids[i])]))
             for i in range(b)
-        ]
+        ], None
+
+    def _execute_verify(self, work: VerifyWork) -> list[list[int]]:
+        handle = self._dispatch_verify(work)
+        rows = handle.resolve()
+        self.last_sync_s = handle.sync_s
+        return rows
 
     # -- public API --------------------------------------------------------
 
@@ -863,7 +952,6 @@ class ModelRunner:
         candidate tokens per request; verify: argmax at every fed
         position)."""
         if isinstance(work, VerifyWork):
-            self.last_sync_s = 0.0  # verify syncs inside _execute_verify
             return self._execute_verify(work)
         handle = self.execute_async(work)
         rows = handle.resolve()
@@ -876,13 +964,19 @@ class ModelRunner:
     ) -> StepHandle:
         """Dispatch one step WITHOUT syncing its results — the async
         pipeline's entry point. `prev` is the still-unresolved previous
-        decode step; rows whose work.chain_rows entry is >= 0 take their
-        input token from its device-resident output matrix (no host round
-        trip). Resolve the returned handle to get the token rows."""
+        decode/verify step; rows whose work.chain_rows entry is >= 0 take
+        their input token from its device-resident output (no host round
+        trip) — a decode row chains its single input token, a chained
+        verify row chains its FIRST fed token (the in-flight verify's
+        bonus token; its remaining fed tokens are the host-proposed
+        continuation). Resolve the returned handle to get the token
+        rows."""
         if isinstance(work, PrefillWork):
             return self._dispatch_prefill(work)
         if isinstance(work, DecodeWork):
             return self._dispatch_decode(work, prev)
+        if isinstance(work, VerifyWork):
+            return self._dispatch_verify(work, prev)
         raise TypeError(
             f"cannot dispatch {type(work).__name__} asynchronously"
         )
@@ -1035,9 +1129,11 @@ class ModelRunner:
                 )
             idx = np.full(b_pad, -1, np.int32)
             idx[: len(chain)] = chain
-            ft = self._chain_fn(
-                prev.tokens, ft, self._put(idx, self._batch1)
-            )
+            idx_dev = self._put(idx, self._batch1)
+            if prev.chain_vec is not None:  # previous step was a verify
+                ft = self._chain_vec_fn(prev.chain_vec, ft, idx_dev)
+            else:
+                ft = self._chain_fn(prev.tokens, ft, idx_dev)
         positions0 = np.zeros(b_pad, np.int32)
         positions0[:b] = work.positions
         block_tables = self._block_table_array(

@@ -144,6 +144,16 @@ class Request:
     # from the owner. The hydration planner's probe uses it to skip the
     # cluster-index rediscovery hop. None = rediscover (or no peer tier).
     kv_owner_hint: str | None = None
+    # speculative decoding (docs/36-speculative-decoding.md): the LAST
+    # resolved verify window's (proposed, accepted, proposer) — stamped by
+    # postprocess, moved onto that step's RequestOutput by _make_output
+    # (and cleared), so the tracing spine's decode_window event carries
+    # per-window acceptance
+    spec_window: tuple | None = None
+    # pipelined spec-decode retry budget (scheduler.SPEC_RETRY_WINDOWS):
+    # chained decode windows left to ride after a failed propose attempt
+    # before the row sits one step out to re-propose on resolved values
+    spec_retry_in: int = 0
 
     @property
     def num_prompt_tokens(self) -> int:
@@ -209,3 +219,7 @@ class RequestOutput:
     # (Request.hydration_outcomes) — the kv_hydration trace event's
     # "plan" attribute (docs/31-hydration-planner.md)
     hydration_chunks: list | None = None
+    # set when this step resolved a speculative-verify window for the
+    # request: (proposed, accepted, proposer) — the tracing spine adds it
+    # to the decode_window event (docs/36-speculative-decoding.md)
+    spec_window: tuple | None = None

@@ -77,6 +77,15 @@ def _row_keys(
     return jax.vmap(one)(seeds, has_seed, counts, jnp.arange(b, dtype=jnp.int32))
 
 
+def greedy_argmax(logits: jax.Array) -> jax.Array:
+    """THE greedy pick (ties break lowest-id, jnp.argmax semantics) —
+    shared by sample()'s temperature-0 branch and the speculative-verify
+    program (model_runner._build_verify_fn), so a verified greedy token can
+    never diverge from what a plain decode window would have sampled (the
+    bitwise serial↔pipelined↔speculative equivalence bar rests on it)."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
 def sample(
     logits: jax.Array,  # (B, V) float32
     temperature: jax.Array,  # (B,) 0.0 = greedy
@@ -89,7 +98,7 @@ def sample(
 ) -> jax.Array:
     """Returns sampled token ids (B,) int32."""
     b, v = logits.shape
-    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    greedy_tok = greedy_argmax(logits)
 
     def sampled(_):
         scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]

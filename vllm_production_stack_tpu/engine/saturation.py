@@ -98,6 +98,14 @@ class GoodputLedger:
             # an unknown reason is a programming error — fail loud in tests
             self.wasted[reason] += n
 
+    def rollback(self, n: int) -> None:
+        """Sampled AND wasted{rollback} in one motion — the shape every
+        discard site shares (pipeline rollback, void rows for requests
+        that finished in flight, rejected draft positions past a verify
+        mismatch), so no site can count one half and drift the partition."""
+        self.sampled(n)
+        self.waste("rollback", n)
+
     def classify_finish(self, status_name: str, n: int) -> None:
         """Classify a finished request's pending tokens by its terminal
         status (FINISH_REASONS). Unknown statuses count as severed — a

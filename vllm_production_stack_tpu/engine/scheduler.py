@@ -88,19 +88,42 @@ class DecodeWork:
 @dataclass
 class VerifyWork:
     """One speculative-verification dispatch (engine/spec_decode.py): each
-    row feeds [current token] + its n-gram proposal; the model's argmax at
-    every position confirms or replaces proposals, yielding 1..k+1 tokens
-    per row in one dispatch. Rows without a proposal feed just their
-    current token (a plain greedy decode step)."""
+    row feeds [current token] + its proposal; the model's argmax at every
+    position confirms or replaces proposals, yielding 1..k+1 tokens per
+    row in one dispatch. Rows without a proposal feed just their current
+    token (a plain greedy decode step). On the pipelined step loop a
+    verify dispatch is in-flight work like a decode window: its rows
+    advance speculatively by their fed length (full acceptance), and a
+    partial acceptance at resolve time invalidates whatever was chained on
+    top — the same rollback a mid-window stop takes. A verify can itself
+    be CHAINED on an in-flight verify (chain_rows): the in-flight step's
+    fed proposals are host-known values under the full-acceptance
+    speculation, so the proposer extends them, and only the in-flight
+    bonus token — this row's first fed token — is spliced device-side."""
 
     requests: list[Request] = field(default_factory=list)
     token_ids: list[list[int]] = field(default_factory=list)  # fed tokens
     positions: list[list[int]] = field(default_factory=list)
     proposals: list[list[int]] = field(default_factory=list)
     context_lens: list[int] = field(default_factory=list)  # resident after
+    # which proposer drafted each row ("ngram" | "draft") — acceptance
+    # counters attribute per proposer (tpu:spec_decode_*_tokens_total)
+    proposers: list[str] = field(default_factory=list)
+    # async pipeline: row index into the IN-FLIGHT VerifyWork whose
+    # device-resident bonus token is this row's first fed token, or -1
+    # when token_ids[i][0] carries a host-known token (non-chained row /
+    # sync path). Empty = all host.
+    chain_rows: list[int] = field(default_factory=list)
 
 
 ScheduleOutput = PrefillWork | DecodeWork | VerifyWork
+
+# chained decode windows a verify-capable row rides after a failed propose
+# attempt before it sits one step out to re-propose with resolved values —
+# without the sit-out the steady two-deep pipeline never hands such a row a
+# proposal window again (its tokens are perpetually in flight at schedule
+# time), and speculation would silently disengage after the first window
+SPEC_RETRY_WINDOWS = 1
 
 
 class Scheduler:
@@ -136,9 +159,17 @@ class Scheduler:
         self._last_was_verify = False
         self.total_preemptions = 0
         # speculative-decoding counters (vLLM metric parity:
-        # spec_decode_num_draft_tokens / num_accepted_tokens)
+        # spec_decode_num_draft_tokens / num_accepted_tokens), plus the
+        # per-proposer split behind tpu:spec_decode_proposed_tokens_total /
+        # accepted_tokens_total{proposer=} (closed label set)
         self.spec_proposed_tokens = 0
         self.spec_accepted_tokens = 0
+        self.spec_proposed_by = {"ngram": 0, "draft": 0}
+        self.spec_accepted_by = {"ngram": 0, "draft": 0}
+        # draft-model proposer (engine/spec_decode.DraftModelProposer) —
+        # the engine attaches it when --speculative-config draft; n-gram
+        # stays the zero-weight fallback for rows it declines
+        self.draft_proposer = None
         # requests whose deadline expired while queued or decoding (the
         # admission-time "would queue past deadline" rejections are counted
         # by the engine — they never reach the scheduler)
@@ -374,12 +405,16 @@ class Scheduler:
         return max(cands, key=lambda r: r.priority)  # first maximal = newest
 
     def schedule(
-        self, inflight: DecodeWork | None = None
+        self, inflight: DecodeWork | VerifyWork | None = None
     ) -> ScheduleOutput | None:
         """Build the next work item. `inflight` (async pipeline) is the
-        decode step currently executing on device: rows carried by it are
-        planned at their speculatively-advanced positions and chain their
-        input token from its device-resident output matrix (chain_rows)."""
+        decode or verify step currently executing on device: rows carried
+        by it are planned at their speculatively-advanced positions and
+        chain their input token from its device-resident output
+        (chain_rows) — a verify in flight additionally lets its rows
+        PROPOSE again (its fed proposals are host-known values under full
+        acceptance), so verify steps chain on verify steps and speculation
+        stays engaged in the steady two-deep pipeline."""
         self.expire_deadlines()
         self.apply_evictions()
         if (
@@ -430,36 +465,132 @@ class Scheduler:
         return None
 
     def _schedule_decode_or_verify(
-        self, ready: list[Request], inflight: DecodeWork | None = None
+        self, ready: list[Request], inflight=None
     ) -> ScheduleOutput | None:
         """With speculative decoding on, greedy rows route through the
         verify program (which subsumes plain decode: no proposal -> 1 bonus
         token); sampled rows keep the fused decode window. When both kinds
-        are ready the two dispatch types alternate."""
+        are ready the two dispatch types alternate.
+
+        Composition with the pipeline (docs/36-speculative-decoding.md):
+        a row whose in-flight step is a VERIFY can propose AGAIN — under
+        the full-acceptance speculation, that step's fed proposals are
+        already host-known token values, so the proposer extends the
+        speculatively-advanced sequence and only the step's bonus token
+        (this row's next input) is unknown; the chained verify splices it
+        device-side (VerifyWork.chain_rows). Rows riding a DECODE window
+        can't propose (every window token's value is unresolved) — they
+        chain decode windows for SPEC_RETRY_WINDOWS steps after a failed
+        attempt, then sit one step out so the next schedule() sees their
+        resolved values. The greedy tokens are identical on every path
+        (sampling.greedy_argmax is the one greedy pick, and acceptance
+        only ever emits the model's own argmax chain), so the streams
+        stay bitwise equal to the serial speculative loop."""
         k = self.config.num_speculative_tokens
         if k <= 0:
             return self._schedule_decode(ready, inflight)
-        # only greedy rows whose proposer actually fires go through verify;
-        # proposal-less greedy rows keep the fused decode window (1 token
-        # per verify dispatch would re-expose the per-token round-trip the
-        # window amortizes), as do sampled rows
-        proposals: dict[str, list[int]] = {}
-        for r in ready:
+        vrow: dict[str, int] = (
+            {r.request_id: i for i, r in enumerate(inflight.requests)}
+            if isinstance(inflight, VerifyWork)
+            else {}
+        )
+
+        def capable(r: Request) -> bool:
             # logprobs and min_tokens requests stay on the decode-window
             # path (the verify program returns raw argmax ids — no logprob
             # collection, no min_tokens stop suppression)
-            if (
+            return (
                 r.sampling.temperature == 0.0
                 and r.sampling.logprobs is None
                 and r.sampling.min_tokens <= 0
+            )
+
+        # candidates: rows with nothing in flight propose from resolved
+        # values; rows whose in-flight step is a verify propose from the
+        # speculatively-advanced sequence (its fed proposals, `tails`)
+        cands: list[Request] = []
+        tails: dict[str, list[int]] = {}
+        chain_idx: dict[str, int] = {}
+        for r in ready:
+            if not capable(r):
+                continue
+            if r.num_inflight_tokens == 0:
+                cands.append(r)
+                continue
+            i = vrow.get(r.request_id)
+            if i is None or r.num_inflight_tokens != len(
+                inflight.token_ids[i]
             ):
+                continue
+            # a chained step for a row whose full acceptance would already
+            # finish it (max_tokens / model length) is guaranteed waste —
+            # it sits out until the in-flight verify resolves it
+            eff_out = len(r.output_token_ids) + r.num_inflight_tokens
+            eff_pos = r.num_computed_tokens + r.num_inflight_tokens
+            if (
+                r.sampling.max_tokens - eff_out <= 0
+                or eff_pos + 1 >= self.model_config.max_model_len
+            ):
+                continue
+            cands.append(r)
+            tails[r.request_id] = list(inflight.proposals[i])
+            chain_idx[r.request_id] = i
+        proposals: dict[str, list[int]] = {}
+        proposers: dict[str, str] = {}
+        if self.draft_proposer is not None and cands:
+            for rid, p in self.draft_proposer.propose_batch(
+                cands, k, spec_tails=tails
+            ).items():
+                if p:
+                    proposals[rid] = p
+                    proposers[rid] = "draft"
+        for r in cands:
+            rid = r.request_id
+            if rid in proposals:
+                continue
+            if rid in tails:
+                # chained: match against seq + in-flight proposals, ask one
+                # extra token and drop it — cont[0] predicts the unknown
+                # bonus position the device-chained first fed token covers
+                cont = propose_ngram(
+                    r.all_token_ids + tails[rid], k + 1,
+                    self.config.speculative_min_ngram,
+                )
+                p = cont[1:] if cont else None
+            else:
                 p = propose_ngram(
                     r.all_token_ids, k, self.config.speculative_min_ngram
                 )
-                if p:
-                    proposals[r.request_id] = p
+            if p:
+                proposals[rid] = p
+                proposers[rid] = "ngram"
+            else:
+                r.spec_retry_in = SPEC_RETRY_WINDOWS
+        # only rows whose proposer actually fires go through verify;
+        # proposal-less greedy rows keep the fused decode window (1 token
+        # per verify dispatch would re-expose the per-token round-trip the
+        # window amortizes), as do sampled rows
         spec = [r for r in ready if r.request_id in proposals]
-        plain = [r for r in ready if r.request_id not in proposals]
+        plain = []
+        retry_riders: set[str] = set()
+        for r in ready:
+            if r.request_id in proposals:
+                continue
+            if (
+                capable(r)
+                and r.num_inflight_tokens > 0
+                and r.request_id not in vrow
+            ):
+                # verify-capable row riding a chained decode window: burn
+                # its retry budget, then sit out one step so it can
+                # propose against resolved values next schedule(). The
+                # budget counts windows actually RIDDEN — it is debited
+                # below only for rows the dispatched decode work carries
+                # (the verify group may win this turn instead).
+                if r.spec_retry_in <= 0:
+                    continue
+                retry_riders.add(r.request_id)
+            plain.append(r)
         first, second = (
             (spec, plain) if not self._last_was_verify else (plain, spec)
         )
@@ -467,22 +598,38 @@ class Scheduler:
             if not group:
                 continue
             if group is spec:
-                work = self._schedule_verify(group, proposals)
+                work = self._schedule_verify(
+                    group, proposals, proposers, chain_idx
+                )
             else:
                 work = self._schedule_decode(group, inflight)
+                if work is not None and retry_riders:
+                    for r in work.requests:
+                        if r.request_id in retry_riders:
+                            r.spec_retry_in -= 1
             if work is not None:
                 self._last_was_verify = group is spec
                 return work
         return None
 
     def _schedule_verify(
-        self, ready: list[Request], proposals: dict[str, list[int]]
+        self,
+        ready: list[Request],
+        proposals: dict[str, list[int]],
+        proposers: dict[str, str],
+        chain_idx: dict[str, int] | None = None,
     ) -> VerifyWork | None:
+        chain_idx = chain_idx or {}
         work = VerifyWork()
         for req in ready[: self.config.max_num_seqs]:
             if req not in self.running:
                 continue
-            start = req.num_computed_tokens
+            # chained rows plan from their speculatively-advanced position
+            # (num_inflight_tokens is the in-flight verify's fed length);
+            # non-chained rows have nothing in flight and reduce to the
+            # plain num_computed_tokens
+            start = req.num_computed_tokens + req.num_inflight_tokens
+            src = chain_idx.get(req.request_id, -1)
             proposal = list(proposals.get(req.request_id, []))
             # bound by remaining model length (the fed chunk itself must fit)
             room = self.model_config.max_model_len - start - 1
@@ -500,12 +647,17 @@ class Scheduler:
                 proposal.pop()
             if not self._ensure_blocks(req, start + 1 + len(proposal)):
                 continue  # req preempted itself; others may still verify
-            fed = [req.token_at(start), *proposal]
+            # a chained row's first fed token is the in-flight verify's
+            # bonus token — device-resident only; the runner splices it
+            # (placeholder 0 here, chain_rows carries the source row)
+            fed = [0 if src >= 0 else req.token_at(start), *proposal]
             work.requests.append(req)
             work.token_ids.append(fed)
             work.positions.append(list(range(start, start + len(fed))))
             work.proposals.append(proposal)
             work.context_lens.append(start + len(fed))
+            work.proposers.append(proposers.get(req.request_id, "ngram"))
+            work.chain_rows.append(src)
         # a later _ensure_blocks may have preempted an earlier row's request
         if any(r not in self.running for r in work.requests):
             keep = [
@@ -513,7 +665,7 @@ class Scheduler:
             ]
             for name in (
                 "requests", "token_ids", "positions", "proposals",
-                "context_lens",
+                "context_lens", "proposers", "chain_rows",
             ):
                 setattr(work, name, [getattr(work, name)[i] for i in keep])
         return work if work.requests else None
@@ -1130,6 +1282,11 @@ class Scheduler:
         # re-admission runs the legacy match, which will find whatever
         # the fetches already promoted into the ring
         self._settle_hydration_plan(req)
+        # preemption mid-draft: the draft's scratch blocks go back to the
+        # pool with the seat (the draft KV recomputes from scratch at
+        # re-admission via the catch-up feed — cheap, it's a small model)
+        if self.draft_proposer is not None:
+            self.draft_proposer.release(req.request_id)
         self._release_blocks(req)
         # goodput ledger: nothing to classify here — the preempted
         # request's pending tokens keep their unknown fate (the VALUES
@@ -1156,59 +1313,71 @@ class Scheduler:
 
     # -- async pipeline bookkeeping (engine/engine.py pipelined step loop) --
     #
-    # A dispatched-but-unresolved decode step advances its rows
-    # SPECULATIVELY: num_inflight_tokens carries the window so the next
-    # step can be scheduled (and dispatched, chaining its input tokens
+    # A dispatched-but-unresolved decode OR verify step advances its rows
+    # SPECULATIVELY: num_inflight_tokens carries the row's window (the
+    # decode window, or a verify row's fed length — full acceptance) so the
+    # next step can be scheduled (and dispatched, chaining its input tokens
     # device-side) before the sampled tokens ever reach the host. The
-    # speculation is exactly "every row accepts its whole window" — any
-    # deviation (mid-window stop token, max-tokens/model-len finish, abort,
-    # stop-string hit) FINISHES the request in postprocess, which is what
-    # speculation_valid detects and the engine answers with
-    # rollback_speculative on the step dispatched on top of it.
+    # speculation is "every row accepts its whole window" — any deviation
+    # (mid-window stop token, PARTIAL draft acceptance, max-tokens/
+    # model-len finish, abort, stop-string hit) moves the reconciled base,
+    # which is what speculation_valid detects and the engine answers with
+    # rollback_speculative on the step dispatched on top of it. A
+    # mispredicted draft is therefore just another rollback — the unifying
+    # move that lets speculation ride the pipeline (docs/36).
 
-    def begin_speculative(self, work: DecodeWork) -> None:
+    @staticmethod
+    def _row_windows(work: DecodeWork | VerifyWork) -> list[int]:
+        """Per-row speculative advance of a dispatched step: the uniform
+        decode window, or each verify row's fed length (current token +
+        proposals == the tokens a full acceptance would resolve)."""
+        if isinstance(work, VerifyWork):
+            return [len(t) for t in work.token_ids]
+        return [work.window] * len(work.requests)
+
+    @staticmethod
+    def _row_positions(work: DecodeWork | VerifyWork) -> list[int]:
+        if isinstance(work, VerifyWork):
+            return [p[0] for p in work.positions]
+        return list(work.positions)
+
+    def begin_speculative(self, work: DecodeWork | VerifyWork) -> None:
         """Mark `work`'s window as in flight on its rows (called right
         after the engine dispatches the step to the device)."""
-        for req in work.requests:
-            req.num_inflight_tokens += work.window
+        for req, w in zip(work.requests, self._row_windows(work)):
+            req.num_inflight_tokens += w
 
-    def end_speculative(self, work: DecodeWork) -> None:
+    def end_speculative(self, work: DecodeWork | VerifyWork) -> None:
         """Clear `work`'s window from its rows — the step has resolved and
         postprocess() is about to apply its real results."""
-        for req in work.requests:
-            req.num_inflight_tokens = max(
-                0, req.num_inflight_tokens - work.window
-            )
+        for req, w in zip(work.requests, self._row_windows(work)):
+            req.num_inflight_tokens = max(0, req.num_inflight_tokens - w)
 
-    def speculation_valid(self, work: DecodeWork) -> bool:
+    def speculation_valid(self, work: DecodeWork | VerifyWork) -> bool:
         """After the PREVIOUS step resolved, is the speculatively dispatched
         `work` still consistent? Every row must still be running with its
         reconciled base position exactly where the dispatch assumed — a
-        mid-window stop, max-tokens finish, stop-string hit, or abort moves
-        (or removes) it."""
-        for req, pos in zip(work.requests, work.positions):
+        mid-window stop, a partial draft acceptance, max-tokens finish,
+        stop-string hit, or abort moves (or removes) it."""
+        for req, pos, w in zip(
+            work.requests, self._row_positions(work), self._row_windows(work)
+        ):
             if req.status.finished or req not in self.running:
                 return False
-            base = (
-                req.num_computed_tokens
-                + req.num_inflight_tokens
-                - work.window
-            )
+            base = req.num_computed_tokens + req.num_inflight_tokens - w
             if base != pos:
                 return False
         return True
 
-    def rollback_speculative(self, work: DecodeWork) -> None:
-        """Discard a dispatched-but-invalidated decode step: clear its
-        in-flight window and free the blocks allocated beyond each row's
-        real residency. The device still executes the discarded step, but
-        its writes land only at positions >= the speculative base — beyond
-        every registered prefix-cache block, and fully overwritten (in
-        device order) by whichever dispatch next owns those slots."""
-        for req in work.requests:
-            req.num_inflight_tokens = max(
-                0, req.num_inflight_tokens - work.window
-            )
+    def rollback_speculative(self, work: DecodeWork | VerifyWork) -> None:
+        """Discard a dispatched-but-invalidated decode/verify step: clear
+        its in-flight window and free the blocks allocated beyond each
+        row's real residency. The device still executes the discarded step,
+        but its writes land only at positions >= the speculative base —
+        beyond every registered prefix-cache block, and fully overwritten
+        (in device order) by whichever dispatch next owns those slots."""
+        for req, w in zip(work.requests, self._row_windows(work)):
+            req.num_inflight_tokens = max(0, req.num_inflight_tokens - w)
             if req.status.finished or req not in self.running:
                 continue  # blocks already released by its finish
             keep = self._blocks_needed(
@@ -1229,6 +1398,7 @@ class Scheduler:
         are discarded."""
         results: list[tuple[Request, list[int]]] = []
         proposal_lens: list[int] | None = None
+        row_proposers: list[str] | None = None
         if isinstance(work, VerifyWork):
             # acceptance: the model's argmax m[j] at fed position j is valid
             # output iff every earlier proposal matched; the first mismatch
@@ -1239,6 +1409,9 @@ class Scheduler:
             # acceptance-rate metric never counts tokens that were clipped
             # before emission.
             proposal_lens = [len(p) for p in work.proposals]
+            row_proposers = list(work.proposers) or ["ngram"] * len(
+                work.requests
+            )
             accepted_rows: list[list[int]] = []
             for i, req in enumerate(work.requests):
                 m = sampled[i]
@@ -1253,9 +1426,7 @@ class Scheduler:
                 # positions; everything past the first mismatch is a
                 # mispredicted draft — just another rollback (the accepted
                 # prefix is ledgered by the decode loop below)
-                rejected = len(p) + 1 - len(accepted)
-                self.ledger.sampled(rejected)
-                self.ledger.waste("rollback", rejected)
+                self.ledger.rollback(len(p) + 1 - len(accepted))
             work = DecodeWork(requests=work.requests)  # shared accounting
             sampled = accepted_rows
         if isinstance(work, PrefillWork):
@@ -1293,8 +1464,7 @@ class Scheduler:
                     # its stream is closed — the sampled row is void.
                     # Ledger: the device executed the row for a request
                     # nobody is waiting on — pipeline machinery waste
-                    self.ledger.sampled(len(row))
-                    self.ledger.waste("rollback", len(row))
+                    self.ledger.rollback(len(row))
                     results.append((req, []))
                     continue
                 # bulk accept: a decode window hands up to `window` candidate
@@ -1329,8 +1499,16 @@ class Scheduler:
                 if proposal_lens is not None:
                     # every emitted token past the first rode a matched
                     # proposal; the first is the plain greedy/bonus token
+                    n_acc = max(0, len(accepted) - 1)
                     self.spec_proposed_tokens += proposal_lens[i]
-                    self.spec_accepted_tokens += max(0, len(accepted) - 1)
+                    self.spec_accepted_tokens += n_acc
+                    by = row_proposers[i]
+                    self.spec_proposed_by[by] += proposal_lens[i]
+                    self.spec_accepted_by[by] += n_acc
+                    # per-window acceptance for the tracing spine's
+                    # decode_window event — consumed (and cleared) by
+                    # LLMEngine._make_output on this step's output
+                    req.spec_window = (proposal_lens[i], n_acc, by)
                 if accepted:
                     # outputs FIRST: _register_full_blocks hashes block
                     # contents via token_at over positions that include the
@@ -1402,6 +1580,11 @@ class Scheduler:
         # settles its plan first: deferred tokens classify as recomputed,
         # in-flight fetches drop their results
         self._settle_hydration_plan(req)
+        # draft scratch blocks die with the request (abort mid-draft
+        # included) — they were never content-addressed, so nothing to
+        # unpublish
+        if self.draft_proposer is not None:
+            self.draft_proposer.release(req.request_id)
         # goodput ledger: the request's fate is sealed — classify its
         # pending tokens (delivered for stop/length; deadline_expired /
         # shed_evicted / severed for the rest, saturation.FINISH_REASONS)

@@ -428,9 +428,21 @@ class EngineServer:
         if out.new_token_ids:
             if out.num_output_tokens == len(out.new_token_ids):
                 trace.event("first_token", choice=choice)
-            trace.event(
-                "decode_window", tokens=len(out.new_token_ids), choice=choice
-            )
+            sw = getattr(out, "spec_window", None)
+            if sw is not None:
+                # speculative-verify window (docs/36-speculative-decoding
+                # .md): per-window acceptance rides the event, so a
+                # timeline shows exactly where drafts paid off (or didn't)
+                trace.event(
+                    "decode_window", tokens=len(out.new_token_ids),
+                    choice=choice, proposed=sw[0], accepted=sw[1],
+                    proposer=sw[2],
+                )
+            else:
+                trace.event(
+                    "decode_window", tokens=len(out.new_token_ids),
+                    choice=choice,
+                )
         if not out.finished:
             return
         # getattr: error outputs (and RequestOutput-shaped test doubles)
@@ -1450,9 +1462,23 @@ class EngineServer:
         in-place reset would race the step thread's unlocked accumulates
         and could be silently lost)."""
         eng = self.async_engine.engine
+        sched = eng.scheduler
+        spec: dict = {
+            "proposed": dict(sched.spec_proposed_by),
+            "accepted": dict(sched.spec_accepted_by),
+        }
+        if sched.draft_proposer is not None:
+            # draft-proposer pool discipline (docs/36): rows that fell
+            # back to n-gram under pool pressure, and the scratch share
+            # the draft currently holds out of the shared block pool
+            spec["draft"] = {
+                "declined_rows": sched.draft_proposer.declined_rows,
+                "scratch_blocks": sched.pool.scratch_blocks,
+            }
         return web.json_response({
             "engine": dict(eng.timing),
             "loop": dict(self.async_engine.loop_timing),
+            "spec": spec,
             "programs": {
                 "compile_fallbacks": eng.runner.compile_fallbacks,
                 "bg_compiles": eng.runner.bg_compiles,
@@ -2016,10 +2042,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="MoE expert parallelism: shard Mixtral-family "
                         "expert FFNs over an ep mesh axis")
     p.add_argument("--num-speculative-tokens", type=int, default=0,
-                   help="n-gram speculative decoding: propose up to this "
-                        "many tokens by prompt lookup and verify them in "
-                        "one dispatch (greedy requests only; 0 disables)")
+                   help="speculative decoding: propose up to this many "
+                        "tokens and verify them in one dispatch (greedy "
+                        "requests only; 0 disables). Composes with the "
+                        "pipelined step loop (docs/36)")
     p.add_argument("--speculative-min-ngram", type=int, default=2)
+    p.add_argument("--speculative-config", default="ngram",
+                   choices=["ngram", "draft"],
+                   help="proposer: 'ngram' (prompt lookup, zero extra "
+                        "weights) or 'draft' (a small draft model drafts "
+                        "the k tokens, sharing the paged KV pool through a "
+                        "scratch block namespace; n-gram stays the "
+                        "fallback). Requires --num-speculative-tokens > 0")
+    p.add_argument("--draft-model", default="",
+                   help="registry name / checkpoint dir of the draft model "
+                        "(--speculative-config draft); must share the "
+                        "target model's tokenizer/vocabulary")
     p.add_argument("--quantization", default=None,
                    choices=[None, "int8"],
                    help="weight-only quantization: int8 stores every linear "
@@ -2137,6 +2175,8 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             width_floor_blocks=args.width_floor_blocks,
             num_speculative_tokens=args.num_speculative_tokens,
             speculative_min_ngram=args.speculative_min_ngram,
+            speculative_method=getattr(args, "speculative_config", "ngram"),
+            draft_model=getattr(args, "draft_model", ""),
             max_waiting_requests=getattr(args, "max_waiting_requests", 0),
             max_queued_tokens=getattr(args, "max_queued_tokens", 0),
         ),

@@ -33,9 +33,17 @@ HOST_KV_RELOADS = "tpu:host_kv_reloaded_blocks_total"
 # remote KV store tier (LMCache remote-server equivalent, kvstore/)
 REMOTE_KV_STORES = "tpu:remote_kv_stored_blocks_total"
 REMOTE_KV_FETCHES = "tpu:remote_kv_fetched_blocks_total"
-# n-gram speculative decoding (vLLM parity: vllm:spec_decode_num_*_tokens)
+# speculative decoding (vLLM parity: vllm:spec_decode_num_*_tokens) —
+# aggregate totals across proposers
 SPEC_DRAFT_TOKENS = "tpu:spec_decode_num_draft_tokens_total"
 SPEC_ACCEPTED_TOKENS = "tpu:spec_decode_num_accepted_tokens_total"
+# per-proposer acceptance accounting (docs/36-speculative-decoding.md):
+# proposer= is a CLOSED label set (ngram = prompt lookup, draft = the
+# draft-model proposer), exporter-seeded at zero. The acceptance-rate
+# recording rule tpu:spec_decode_acceptance:rate5m divides these.
+SPEC_PROPOSED_TOKENS = "tpu:spec_decode_proposed_tokens_total"
+SPEC_ACCEPTED_BY_PROPOSER = "tpu:spec_decode_accepted_tokens_total"
+SPEC_PROPOSER_VALUES = ("ngram", "draft")
 
 # -- request-lifecycle robustness (docs/26-robustness.md) --------------------
 # admission control: requests refused with 429 + Retry-After because the
@@ -266,6 +274,8 @@ METRIC_LABEL_VALUES: dict[str, dict[str, tuple[str, ...]]] = {
     ENGINE_PADDED_TOKENS: {"phase": ("prefill", "decode")},
     ENGINE_STEP_WALL: {"phase": ("prefill", "decode")},
     WASTED_TOKENS: {"reason": WASTE_REASON_VALUES},
+    SPEC_PROPOSED_TOKENS: {"proposer": SPEC_PROPOSER_VALUES},
+    SPEC_ACCEPTED_BY_PROPOSER: {"proposer": SPEC_PROPOSER_VALUES},
 }
 
 KV_FLOW_COUNTERS = (
@@ -435,6 +445,9 @@ ALL_COUNTERS = (
     REMOTE_KV_FETCHES,
     SPEC_DRAFT_TOKENS,
     SPEC_ACCEPTED_TOKENS,
+    # per-proposer split (proposer= closed set, docs/36)
+    SPEC_PROPOSED_TOKENS,
+    SPEC_ACCEPTED_BY_PROPOSER,
     REQUESTS_SHED,
     REQUESTS_DEADLINE_EXPIRED,
     # tenant-labeled (cardinality bounded by the tenant table); rendered

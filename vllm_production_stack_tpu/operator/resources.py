@@ -71,6 +71,10 @@ def engine_args(spec: dict) -> list[str]:
         args += ["--kv-cache-dtype", str(tpu["kvCacheDtype"])]
     if tpu.get("numSpeculativeTokens"):
         args += ["--num-speculative-tokens", str(tpu["numSpeculativeTokens"])]
+    if tpu.get("speculativeConfig"):
+        args += ["--speculative-config", str(tpu["speculativeConfig"])]
+    if tpu.get("draftModel"):
+        args += ["--draft-model", str(tpu["draftModel"])]
     if tpu.get("decodeWindow"):
         args += ["--decode-window", str(tpu["decodeWindow"])]
     if tpu.get("enablePrefixCaching") is False:
