@@ -824,6 +824,310 @@ async def _tracing_bench() -> dict:
     }
 
 
+async def _blackbox_bench() -> dict:
+    """Flight recorder / watchdog / postmortem evidence (docs/37-flight-
+    recorder.md), CPU-only and pre-preflight — the phase exists precisely
+    because a wedged chip produces no request-vantage evidence, so its own
+    evidence must survive a wedged TPU tunnel.
+
+    Three DISTINCT wedges injected with the chaos harness
+    (testing/faults.py), each individually NAMED by the watchdog
+    (correct thread=/kind=) with a postmortem JSON written for each:
+
+    1. **stalled fetcher** — the hydration fetcher blocks under the
+       disk-tier lock (faults.hold_lock): stale_heartbeat,
+       thread=hydration_fetch;
+    2. **blackholed publisher** — the KV-event publisher's resync POST
+       lands in a black hole (faults.black_hole): stale_heartbeat,
+       thread=kv_event_publisher;
+    3. **frozen step loop** — engine.step blocks mid-request
+       (faults.frozen_step_loop) behind the REAL HTTP server:
+       stale_heartbeat, thread=step, /ready flips 503 while /health stays
+       green, and the stall counter + heartbeat age render on /metrics.
+
+    Plus the noise-floor bar: a recorder-on vs recorder-off decode-wave
+    flood (alternating reps, p50 of wave wall times — the saturation
+    phase's proven estimator) must show ≤2% p50 overhead — same bar as
+    the StepMeter.
+    """
+    import asyncio
+    import tempfile
+    from dataclasses import replace as _dc_replace
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vllm_production_stack_tpu.engine.config import EngineConfig
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.flightrec import (
+        PostmortemDumper,
+        ThreadRegistry,
+        Watchdog,
+    )
+    from vllm_production_stack_tpu.engine.server import EngineServer
+    from vllm_production_stack_tpu.testing import faults
+
+    pm_dir = tempfile.mkdtemp(prefix="tpu-blackbox-pm-")
+    wedges: dict = {}
+
+    async def _check_dump(dumper: PostmortemDumper, thread: str) -> dict:
+        # the watchdog sets `stalled` BEFORE its report/dump finishes —
+        # await the episode's dump WHILE the wedge is still held, so the
+        # captured heartbeat table shows the stall, not the recovery
+        deadline = time.monotonic() + 5.0
+        while dumper.dumps_written < 1 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert dumper.dumps_written >= 1, f"no postmortem for {thread}"
+        doc = json.loads(open(dumper.last_path, encoding="utf-8").read())
+        assert doc["trigger"] == "watchdog"
+        hb = doc["heartbeats"][thread]
+        assert hb["stale"] is True, hb
+        return {"path": dumper.last_path, "age_s": hb["age_s"]}
+
+    async def _await_stall(wd: Watchdog, timeout_s: float = 8.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if wd.stalled is not None:
+                return wd.stalled
+            await asyncio.sleep(0.05)
+        raise AssertionError("watchdog never named the stall")
+
+    # -- wedge 1: fetcher stalled under the disk-tier lock -----------------
+    async def wedge_fetcher() -> dict:
+        from vllm_production_stack_tpu.engine.hydration import (
+            HydrationChunk,
+            HydrationPlan,
+        )
+
+        cfg = EngineConfig.tiny()
+        cfg = cfg.replace(cache=_dc_replace(
+            cfg.cache, disk_kv_dir=tempfile.mkdtemp(prefix="bb-disk-"),
+            disk_kv_gib=0.05,
+        ))
+        engine = LLMEngine(cfg)
+        engine.threads.register("hydration_fetch", stall_after_s=0.3)
+        dumper = PostmortemDumper(
+            pm_dir, recorder=engine.flightrec, registry=engine.threads
+        )
+        wd = Watchdog(
+            engine.threads, recorder=engine.flightrec, interval_s=0.05,
+            on_stall=lambda r: dumper.dump("watchdog", "fetcher wedge"),
+        )
+        wd.start()
+        chunk = HydrationChunk(index=0, start_block=0, hashes=[7],
+                               tiers=["disk"], decision="load")
+        plan = HydrationPlan("bb-req", [chunk], block_size=8,
+                             deadline=time.monotonic() + 60.0, estimates={})
+        t0 = time.monotonic()
+        with faults.hold_lock(engine.host_tier.disk._mu):
+            engine.hydrator._ensure_thread()
+            engine.hydrator._q.put((plan, chunk))
+            stall = await _await_stall(wd)
+            detect_s = time.monotonic() - t0
+            pm = await _check_dump(dumper, "hydration_fetch")
+        threads = {f["thread"] for f in stall["findings"]}
+        kinds = {f["kind"] for f in stall["findings"]}
+        assert threads == {"hydration_fetch"}, stall
+        assert kinds == {"stale_heartbeat"}, stall
+        out = {"named": sorted(threads), "kinds": sorted(kinds),
+               "detect_s": round(detect_s, 2), "postmortem": pm}
+        wd.stop()
+        engine.hydrator.close()
+        return out
+
+    # -- wedge 2: publisher blackholed mid-resync --------------------------
+    async def wedge_publisher() -> dict:
+        import aiohttp
+
+        from vllm_production_stack_tpu.engine.kv_events import (
+            KVEventLog,
+            KVEventPublisher,
+        )
+
+        server, port = await faults.black_hole()
+        reg = ThreadRegistry()
+        hb = reg.register("kv_event_publisher", stall_after_s=0.3)
+        dumper = PostmortemDumper(pm_dir, registry=reg)
+        wd = Watchdog(
+            reg, interval_s=0.05,
+            on_stall=lambda r: dumper.dump("watchdog", "publisher wedge"),
+        )
+        wd.start()
+        log = KVEventLog()
+        log.emit_admit(1, 0)
+
+        async def snapshot():
+            return log.epoch, log.snapshot_mark(), [1]
+
+        session = aiohttp.ClientSession()
+        pub = KVEventPublisher(
+            [f"http://127.0.0.1:{port}"], "http://bb:8000", log, snapshot,
+            16, lambda: session, interval_s=0.05, send_timeout_s=30.0,
+            heartbeat=hb,
+        )
+        t0 = time.monotonic()
+        pub.start()
+        try:
+            stall = await _await_stall(wd)
+            detect_s = time.monotonic() - t0
+            pm = await _check_dump(dumper, "kv_event_publisher")
+            threads = {f["thread"] for f in stall["findings"]}
+            kinds = {f["kind"] for f in stall["findings"]}
+            assert threads == {"kv_event_publisher"}, stall
+            assert kinds == {"stale_heartbeat"}, stall
+            return {"named": sorted(threads), "kinds": sorted(kinds),
+                    "detect_s": round(detect_s, 2), "postmortem": pm}
+        finally:
+            wd.stop()
+            await pub.stop()
+            await session.close()
+            server.close()
+            await server.wait_closed()
+
+    # -- wedge 3: frozen step loop, through the real HTTP server -----------
+    async def wedge_step(engine: LLMEngine) -> dict:
+        srv = EngineServer(
+            engine, served_model_name="tiny",
+            watchdog_interval_s=0.05, watchdog_stall_s=0.4,
+            postmortem_dir=pm_dir,
+        )
+        client = TestClient(TestServer(srv.build_app()))
+        await client.start_server()
+        try:
+            t0 = time.monotonic()
+            with faults.frozen_step_loop(engine):
+                resp = await client.post("/v1/completions", json={
+                    "model": "tiny", "prompt": [3, 4, 5],
+                    "max_tokens": 64, "temperature": 0.0, "stream": True,
+                })
+                assert resp.status == 200
+                stall = None
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    ready = await client.get("/ready")
+                    if ready.status == 503:
+                        body = await ready.json()
+                        if body.get("reason") == "stalled":
+                            stall = body["stall"]
+                            break
+                    await asyncio.sleep(0.05)
+                assert stall is not None, "/ready never flipped on stall"
+                detect_s = time.monotonic() - t0
+                health = await client.get("/health")
+                assert health.status == 200  # liveness never flips
+                metrics = await (await client.get("/debug/flight")).json()
+                resp.close()
+            # recovery: the wedge released, /ready must come back
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if (await client.get("/ready")).status == 200:
+                    break
+                await asyncio.sleep(0.05)
+            scrape = await (await client.get("/metrics")).text()
+            threads = {f["thread"] for f in stall["findings"]}
+            kinds = {f["kind"] for f in stall["findings"]}
+            assert "step" in threads, stall
+            assert "stale_heartbeat" in kinds, stall
+            from vllm_production_stack_tpu import metrics_contract as mc
+
+            stall_line = [
+                line for line in scrape.splitlines()
+                if line.startswith(mc.ENGINE_STEP_STALLS)
+                and 'kind="stale_heartbeat"' in line
+            ]
+            assert stall_line and float(stall_line[0].split()[-1]) >= 1, \
+                stall_line
+            assert metrics["postmortems"]["written"] >= 1
+            return {
+                "named": sorted(threads), "kinds": sorted(kinds),
+                "detect_s": round(detect_s, 2),
+                "ready_flipped": True, "health_stayed_green": True,
+                "postmortem": {"path": metrics["postmortems"]["last_path"]},
+            }
+        finally:
+            await client.close()
+
+    # -- noise floor: recorder-on vs recorder-off flood --------------------
+    def overhead(engine: LLMEngine) -> dict:
+        """Decode-wave flood on ONE warm engine, flight recording off vs
+        on (the flag gates every ring append), 12 alternating reps, p50
+        of wave wall times — the saturation phase's proven estimator.
+        The recorder's cost lives entirely in the step loop, so driving
+        step() directly measures it without aiohttp scheduling jitter
+        (which was measured to swing an HTTP flood's p50 ±10% on a
+        shared CPU box — two orders of magnitude above the signal)."""
+        import numpy as np
+
+        from vllm_production_stack_tpu.engine.request import SamplingParams
+
+        rng = np.random.RandomState(11)
+        vocab = engine.config.model.vocab_size
+        prompts = [
+            [int(t) for t in rng.randint(1, vocab, size=16)]
+            for _ in range(8)
+        ]
+        wave_sampling = SamplingParams(
+            max_tokens=24, temperature=0.0, ignore_eos=True
+        )
+        for _ in range(3):  # pay every XLA compile before measuring
+            engine.generate(prompts, wave_sampling)
+        REPS = 12
+        times: dict[bool, list[float]] = {False: [], True: []}
+        for rep in range(REPS):
+            # alternate within-pair order too: a monotone box-level
+            # drift must not always land on the same mode's slot
+            order = (False, True) if rep % 2 == 0 else (True, False)
+            for recording in order:
+                engine.flightrec.enabled = recording
+                t0 = time.perf_counter()
+                outs = engine.generate(prompts, wave_sampling)
+                times[recording].append(time.perf_counter() - t0)
+                assert sum(len(o["token_ids"]) for o in outs) == 8 * 24
+        engine.flightrec.enabled = True
+
+        def p50(xs):
+            return sorted(xs)[len(xs) // 2]
+
+        off_p50, on_p50 = p50(times[False]), p50(times[True])
+        result = {
+            "reps": REPS,
+            "wave_requests": 8,
+            "off_p50_ms": round(off_p50 * 1e3, 2),
+            "on_p50_ms": round(on_p50 * 1e3, 2),
+            "off_min_ms": round(min(times[False]) * 1e3, 2),
+            "on_min_ms": round(min(times[True]) * 1e3, 2),
+            "p50_overhead_pct": round(
+                (on_p50 / off_p50 - 1.0) * 100.0, 2
+            ),
+            "min_overhead_pct": round(
+                (min(times[True]) / min(times[False]) - 1.0) * 100.0, 2
+            ),
+        }
+        # the acceptance bar: same ≤2% p50 ceiling as the StepMeter
+        assert result["p50_overhead_pct"] <= 2.0, result
+        result["overhead_ok"] = True
+        return result
+
+    wedges["fetcher_disk_lock"] = await wedge_fetcher()
+    wedges["publisher_blackholed"] = await wedge_publisher()
+    engine = LLMEngine(EngineConfig.tiny())
+    wedges["step_loop_frozen"] = await wedge_step(engine)
+    flood_overhead = overhead(engine)
+    named = {w["named"][0] if len(w["named"]) == 1 else tuple(w["named"])
+             for w in wedges.values()}
+    return {
+        "wedges": wedges,
+        "all_three_named": len(wedges) == 3 and all(
+            w.get("postmortem") for w in wedges.values()
+        ),
+        "distinct_threads_named": sorted(
+            t for w in wedges.values() for t in w["named"]
+        ),
+        "postmortem_dir": pm_dir,
+        "overhead": flood_overhead,
+        "_named_set_size": len(named),
+    }
+
+
 async def _fairness_bench() -> dict:
     """Multi-tenant QoS numbers (docs/27-multitenancy.md), on a CPU tiny
     engine behind its real HTTP server (stamped headers, the engines' own
@@ -3033,6 +3337,19 @@ def _phase_tracing_main() -> None:
     print(json.dumps({"tracing": result}), flush=True)
 
 
+def _phase_blackbox_main() -> None:
+    """Subprocess entry for the CPU-only flight-recorder/watchdog bench
+    (three named wedges + recorder noise floor, docs/37-flight-recorder
+    .md). Forces CPU before anything touches jax — this phase diagnoses
+    wedges, so its own evidence must survive one."""
+    import asyncio
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = asyncio.run(_blackbox_bench())
+    print(json.dumps({"blackbox": result}), flush=True)
+
+
 def _phase_micro_main() -> None:
     """Subprocess entry: enable the persistent compile cache, run the
     microbench (+ the step-loop attribution bench), print its JSON."""
@@ -3056,11 +3373,15 @@ def _phase_preflight_main() -> None:
 
     Watchdog (r04 timed out, r05 wedged with no TPU dispatch): a daemon
     timer hard-kills this subprocess after PREFLIGHT_HARD_TIMEOUT_S
-    (default 300 s, below the parent's kill window) having FIRST printed a
-    structured diagnostic — which init stage wedged (import / devices /
-    dispatch), elapsed time, env — plus the thread stacks. The parent then
-    reports a named failure mode instead of a bare timeout, and the chip
-    frees minutes sooner for nothing-else-to-lose retries."""
+    (default 300 s, below the parent's kill window) having FIRST written
+    the ENGINE-NATIVE postmortem artifact (flightrec.write_postmortem:
+    thread stacks + redacted env + the wedged init stage — the same JSON
+    black box a stalled serving engine dumps, docs/37-flight-recorder.md)
+    and printed a structured diagnostic naming that file plus which init
+    stage wedged (import / devices / dispatch), elapsed time, and the jax
+    platform env. The parent then reports a named failure mode instead of
+    a bare timeout, the r04/r05 wedge finally leaves a FILE behind, and
+    the chip frees minutes sooner for nothing-else-to-lose retries."""
     import faulthandler
     import threading
 
@@ -3069,7 +3390,7 @@ def _phase_preflight_main() -> None:
     hard_s = float(os.environ.get("PREFLIGHT_HARD_TIMEOUT_S", "300"))
 
     def watchdog() -> None:
-        print(json.dumps({"preflight": {
+        diag = {
             "error": f"watchdog: preflight wedged after {hard_s:.0f}s",
             "stage": stage["name"],
             "elapsed_s": round(time.monotonic() - t0, 1),
@@ -3077,8 +3398,35 @@ def _phase_preflight_main() -> None:
             "tpu_library": os.environ.get("TPU_LIBRARY_PATH", ""),
             "hint": "tunnel grant hang — kill stale jax processes / "
                     "re-establish the TPU tunnel before retrying",
-        }}), flush=True)
+        }
+        # named failure mode FIRST, hard-exit ARMED second, postmortem
+        # file last: the dump targets a filesystem that may itself be
+        # wedged (hung PVC/NFS), and a blocked write must never suppress
+        # the diagnostic or the kill this watchdog exists for
+        print(json.dumps({"preflight": diag}), flush=True)
         faulthandler.dump_traceback()  # stderr merges into the phase log
+        backstop = threading.Timer(20.0, lambda: os._exit(3))
+        backstop.daemon = True
+        backstop.start()
+        try:
+            from vllm_production_stack_tpu.engine.flightrec import (
+                write_postmortem,
+            )
+
+            path, _doc = write_postmortem(
+                os.environ.get("POSTMORTEM_DIR", "/tmp/tpu-postmortem"),
+                "bench_preflight",
+                f"preflight wedged at stage {stage['name']} after "
+                f"{hard_s:.0f}s",
+                sections={"preflight": dict(diag)},
+            )
+            diag["postmortem"] = path
+        except Exception as e:
+            diag["postmortem_error"] = f"{type(e).__name__}: {e}"
+        # re-print WITH the artifact path — the phase parser keeps the
+        # LAST JSON line, so a successful dump names its file and a hung
+        # one still left the first diagnostic (+ the backstop exit)
+        print(json.dumps({"preflight": diag}), flush=True)
         os._exit(3)
 
     timer = threading.Timer(hard_s, watchdog)
@@ -3113,6 +3461,8 @@ def main() -> None:
             _phase_fairness_main()
         elif phase == "tracing":
             _phase_tracing_main()
+        elif phase == "blackbox":
+            _phase_blackbox_main()
         elif phase == "saturation":
             _phase_saturation_main()
         elif phase == "kvflow":
@@ -3161,6 +3511,16 @@ def main() -> None:
     tracing = _run_phase(
         "tracing", ["bench.py", "--phase", "tracing"],
         timeout_s=300, key="tracing", min_needed_s=60.0,
+    )
+
+    # -0.1) flight recorder / watchdog / postmortems (docs/37-flight-
+    # recorder.md): three injected wedges each NAMED by the watchdog with
+    # a postmortem file, plus the recorder's ≤2% p50 noise floor —
+    # CPU-only, pre-preflight BY DESIGN: this phase exists because the
+    # chip wedge produces no other evidence
+    blackbox = _run_phase(
+        "blackbox", ["bench.py", "--phase", "blackbox"],
+        timeout_s=420, key="blackbox", min_needed_s=90.0,
     )
 
     # -0.0625) saturation & goodput (docs/29-saturation-slo.md): ledger
@@ -3237,6 +3597,7 @@ def main() -> None:
             "robustness": robustness,
             "fairness": fairness,
             "tracing": tracing,
+            "blackbox": blackbox,
             "saturation": saturation,
             "kvflow": kvflow,
             "hydration": hydration,
@@ -3313,6 +3674,7 @@ def main() -> None:
         "robustness": robustness,
         "fairness": fairness,
         "tracing": tracing,
+        "blackbox": blackbox,
         "saturation": saturation,
         "kvflow": kvflow,
         "hydration": hydration,

@@ -143,6 +143,14 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--kv-peer-fetch-timeout-s"
 - {{ .kvPeerFetchTimeoutS | quote }}
 {{- end }}
+{{- if .postmortemDir }}
+- "--postmortem-dir"
+- {{ .postmortemDir | quote }}
+{{- end }}
+{{- if .watchdogStallS }}
+- "--watchdog-stall-s"
+- {{ .watchdogStallS | quote }}
+{{- end }}
 {{- if eq (.enablePrefixCaching | default true) false }}
 - "--no-enable-prefix-caching"
 {{- end }}

@@ -55,6 +55,7 @@ FIXTURE_EXPECT = {
     "response_truthiness": ("response-truthiness", 2),
     "untracked_task": ("untracked-task", 3),
     "thread_lifecycle": ("thread-lifecycle", 2),
+    "thread_heartbeat": ("thread-heartbeat", 2),
     "metric_literal": ("metric-literal", 2),
 }
 
@@ -239,6 +240,61 @@ def test_thread_join_with_timeout_is_a_stop_path():
 
             def work(self):
                 pass
+    """)
+    assert analyze_source(src, "x.py") == []
+
+
+def test_thread_heartbeat_one_hop_delegation_counts():
+    src = textwrap.dedent("""\
+        import threading
+
+        class C:
+            def __init__(self, hb):
+                self._hb = hb
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _tick(self):
+                self._hb.beat()
+
+            def _run(self):
+                while True:
+                    self._tick()
+
+            def stop(self):
+                self._t.join(timeout=1)
+    """)
+    assert analyze_source(src, "x.py") == []
+
+
+def test_thread_heartbeat_unresolvable_target_is_skipped():
+    src = textwrap.dedent("""\
+        import threading
+
+        def start(fns):
+            t = threading.Thread(target=fns[0], daemon=True)
+            t.start()
+            t.join(timeout=1)
+    """)
+    assert analyze_source(src, "x.py") == []
+
+
+def test_thread_heartbeat_timer_is_out_of_scope():
+    # one-shot timers (the bench preflight watchdog shape) are
+    # thread-lifecycle's prey when leaked, never thread-heartbeat's
+    src = textwrap.dedent("""\
+        import threading
+
+        class C:
+            def arm(self):
+                self._timer = threading.Timer(5.0, self.fire)
+                self._timer.start()
+
+            def fire(self):
+                while self.pending():
+                    self.step()
+
+            def cancel(self):
+                self._timer.cancel()
     """)
     assert analyze_source(src, "x.py") == []
 

@@ -1,0 +1,64 @@
+"""thread-heartbeat negatives: beating loops, one-shot workers, helpers."""
+
+import threading
+
+
+class BeatingPublisher:
+    """The corrected SilentPublisher: the loop beats its registered
+    heartbeat, so the watchdog can name it."""
+
+    def __init__(self, registry):
+        self.heartbeat = registry.register("kv_event_publisher")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.wait(0.5):
+            self.heartbeat.beat()
+            self.flush()
+            self.heartbeat.idle()
+
+    def flush(self):
+        pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=1)
+
+
+class DelegatedBeat:
+    """The loop delegates the beat to a helper it calls (one hop)."""
+
+    def __init__(self, hb):
+        self._hb = hb
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _tick(self):
+        self._hb.beat()
+
+    def _run(self):
+        while not self._stop.wait(0.5):
+            self._tick()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=1)
+
+
+def run_once(fn):
+    """One-shot worker: no loop, bounded lifetime — not watchdog prey."""
+
+    def work():
+        fn()
+
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+
+
+def start_opaque(callables):
+    """Unresolvable target (expression) — nothing to prove either way."""
+    t = threading.Thread(target=callables[0], daemon=True)
+    t.start()
+    t.join(timeout=1)

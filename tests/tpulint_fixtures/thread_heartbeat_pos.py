@@ -1,0 +1,35 @@
+"""thread-heartbeat positives: long-lived loops invisible to the watchdog."""
+
+import threading
+
+
+class SilentPublisher:
+    """Loop thread with a stop path (thread-lifecycle is satisfied) but no
+    heartbeat — the watchdog can never name it when it wedges."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)  # finding
+
+    def _run(self):
+        while not self._stop.wait(0.5):
+            self.flush()
+
+    def flush(self):
+        pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=1)
+
+
+def start_worker(q):
+    def drain_loop():
+        while True:
+            item = q.get()
+            if item is None:
+                return
+
+    t = threading.Thread(target=drain_loop, daemon=True)  # finding
+    t.start()
+    t.join(timeout=1)

@@ -14,6 +14,7 @@ class Compiler:
 
     def _loop(self):
         while not self._stop.is_set():
+            self.heartbeat.beat()
             try:
                 self.compile_one()
             except Exception:

@@ -12,6 +12,7 @@ class Compiler:
 
     def _loop(self):
         while True:
+            self.heartbeat.beat()  # liveness is fine; the LIFECYCLE is not
             try:
                 self.compile_one()
             except:                            # finding: bare except swallows

@@ -10,6 +10,7 @@ from . import (
     lock_blocking,
     metric_literal,
     response_truthiness,
+    thread_heartbeat,
     thread_lifecycle,
     untracked_task,
 )
@@ -37,6 +38,7 @@ ALL_RULES: tuple[Rule, ...] = tuple(
         response_truthiness,
         untracked_task,
         thread_lifecycle,
+        thread_heartbeat,
         metric_literal,
     )
 )

@@ -1,0 +1,122 @@
+"""Rule `thread-heartbeat`: long-lived threads whose loop never beats the
+ThreadRegistry.
+
+Historical bug class (ROADMAP Trajectory / docs/37-flight-recorder.md):
+the on-chip bench sat wedged from r04 onward because a stuck loop — a
+fetcher blocked under a tier lock, a collective that never completed —
+produced no requests and therefore no telemetry; the only defense was a
+bench-side hard-kill timer. PR 15 made liveness a serving-stack feature:
+every long-lived loop beats a heartbeat into
+``engine/flightrec.ThreadRegistry`` (``beat()`` while busy, ``idle()``
+while parked) so the watchdog can NAME the stuck thread. This rule keeps
+the next background loop honest: a ``threading.Thread`` started inside
+the package whose target function loops (``while``) without ever
+touching a heartbeat is invisible to the watchdog — exactly the thread
+that will wedge silently.
+
+Findings fire on the Thread constructor. Resolvable targets only: when
+the ``target=`` is a name/attribute whose function definition lives in
+the same module AND that function contains a loop, the function (and the
+sync helpers it calls by simple name in the same module) must contain a
+heartbeat touch — a ``.beat()``/``.idle()`` call or any identifier
+mentioning ``heartbeat``. ``threading.Timer`` (one-shot) and
+unresolvable/loopless targets are out of scope. Reasoned suppressions
+(`# tpulint: allow(thread-heartbeat) — <why>`) cover deliberate
+exceptions (e.g. a process-lifetime test helper).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+from .common import dotted_name, import_aliases, resolve
+
+SLUG = "thread-heartbeat"
+
+_BEAT_ATTRS = {"beat", "idle"}
+
+
+def _is_thread_ctor(call: ast.Call, aliases: dict[str, str]) -> bool:
+    name = resolve(dotted_name(call.func), aliases)
+    return name == "threading.Thread"
+
+
+def _target_name(call: ast.Call) -> str | None:
+    """The simple name of the `target=` callable (`self._loop` -> "_loop",
+    `worker` -> "worker"); None for lambdas/partials/expressions."""
+    for kw in call.keywords:
+        if kw.arg != "target":
+            continue
+        node = kw.value
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Name):
+            return node.id
+    return None
+
+
+def _touches_heartbeat(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _BEAT_ATTRS:
+                return True
+        if isinstance(node, ast.Attribute) and "heartbeat" in node.attr.lower():
+            return True
+        if isinstance(node, ast.Name) and "heartbeat" in node.id.lower():
+            return True
+    return False
+
+
+def _called_names(fn: ast.AST) -> set[str]:
+    """Simple names the function calls (`self._helper()` -> "_helper",
+    `helper()` -> "helper") — one hop of indirection is enough for this
+    repo's loop-calls-worker idiom."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute):
+                out.add(node.func.attr)
+            elif isinstance(node.func, ast.Name):
+                out.add(node.func.id)
+    return out
+
+
+def check(tree: ast.Module, src: str, path: str) -> list[Finding]:
+    aliases = import_aliases(tree)
+    # every function/method definition in the module by simple name (the
+    # target resolver and the one-hop helper walk both use it)
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_thread_ctor(node, aliases)):
+            continue
+        tname = _target_name(node)
+        fn = defs.get(tname) if tname else None
+        if fn is None:
+            continue  # unresolvable target: nothing to prove either way
+        has_loop = any(
+            isinstance(n, (ast.While, ast.For)) for n in ast.walk(fn)
+        )
+        if not has_loop:
+            continue  # one-shot worker: bounded lifetime, not watchdog prey
+        if _touches_heartbeat(fn):
+            continue
+        # one hop: the loop may delegate the beat to a helper it calls
+        if any(
+            h in defs and _touches_heartbeat(defs[h])
+            for h in _called_names(fn)
+        ):
+            continue
+        findings.append(Finding(
+            rule=SLUG, path=path, line=node.lineno,
+            message=f"long-lived thread target {tname!r} loops without "
+                    "beating a ThreadRegistry heartbeat — the watchdog "
+                    "cannot name it when it wedges; register it "
+                    "(engine.threads.register(...)) and beat()/idle() in "
+                    "the loop, or suppress with a reason",
+        ))
+    return findings

@@ -68,6 +68,18 @@ class AsyncEngine:
         self._admitting = 0
         self._thread: threading.Thread | None = None
         self._step_error: Exception | None = None
+        # thread-liveness heartbeat (docs/37-flight-recorder.md): the step
+        # loop beats every iteration — including the idle wait — so a beat
+        # older than its threshold means the loop is WEDGED inside a step
+        # (collective stall, runaway compile under the engine lock), not
+        # merely quiet. Registered in start() so restartable servers
+        # refresh rather than duplicate it.
+        self._heartbeat = None
+        # fatal-wedge hook: called ONCE with the exception when the step
+        # loop marks the engine dead (the server points this at the
+        # postmortem dumper — the dying step thread writes its own black
+        # box before the /health flip is even scraped)
+        self.on_fatal = None
         # served-stack profiling (exposed via /debug/timing): where the step
         # thread's wall time goes, and how long submissions wait on the
         # engine lock behind it
@@ -97,6 +109,9 @@ class AsyncEngine:
         draft = getattr(self.engine, "draft_runner", None)
         if draft is not None:
             draft.idle_check = idle
+        threads = getattr(self.engine, "threads", None)
+        if threads is not None:
+            self._heartbeat = threads.register("step")
         self._thread = threading.Thread(
             target=self._step_loop, name="engine-step", daemon=True
         )
@@ -107,6 +122,11 @@ class AsyncEngine:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        threads = getattr(self.engine, "threads", None)
+        if threads is not None:
+            # a deliberate stop must not read as a wedge at the next check
+            threads.unregister("step")
+            self._heartbeat = None
         runner = getattr(self.engine, "runner", None)
         if runner is not None and hasattr(runner, "shutdown"):
             runner.shutdown()  # cancel queued background compiles
@@ -146,9 +166,14 @@ class AsyncEngine:
 
     def _step_loop(self) -> None:
         lt = self.loop_timing
+        hb = self._heartbeat
         failures = 0
         while not self._stop:
             t0 = time.perf_counter()
+            if hb is not None:
+                # every iteration, idle path included: staleness then means
+                # "wedged inside a step", never "no traffic"
+                hb.beat()
             try:
                 with self._lock:
                     self._drain_pending()
@@ -159,6 +184,9 @@ class AsyncEngine:
                 failures = 0
             except Exception as e:
                 failures += 1
+                fr = getattr(self.engine, "flightrec", None)
+                if fr is not None:
+                    fr.fault(str(e))
                 if failures >= self.MAX_CONSECUTIVE_STEP_FAILURES:
                     # persistent fault: surface to /health, fail everything
                     logger.exception(
@@ -166,6 +194,7 @@ class AsyncEngine:
                         "marking engine dead", failures,
                     )
                     self._step_error = e
+                    self._notify_fatal(e)
                     self._fail_all(e)
                     return
                 # transient fault: the failed step may have left requests
@@ -182,6 +211,7 @@ class AsyncEngine:
                 except Exception:
                     logger.exception("in-flight abort failed; engine dead")
                     self._step_error = e
+                    self._notify_fatal(e)
                     self._fail_all(e)
                     return
                 continue
@@ -250,6 +280,18 @@ class AsyncEngine:
         except Exception as e:
             logger.warning("deferred admission failed for %s: %s", rid, e)
             self._fail_stream(rid, str(e))
+
+    def _notify_fatal(self, exc: Exception) -> None:
+        """Fire the fatal-wedge hook exactly where the engine dies (the
+        step thread) — the postmortem must capture the dying stacks, not
+        whatever the event loop looks like at the next scrape."""
+        hook = self.on_fatal
+        if hook is None:
+            return
+        try:
+            hook(exc)
+        except Exception:
+            logger.exception("on_fatal hook failed")
 
     def _fail_stream(self, rid: str, message: str) -> None:
         """Deliver a terminal error output to a request's stream queue."""

@@ -115,6 +115,10 @@ class _InflightStep:
     # set once the handle's results were synced to the host — a step that
     # faults before this must be restored as the in-flight step
     resolved: bool = False
+    # flight-recorder dispatch seq (docs/37-flight-recorder.md): the
+    # resolve/discard record names the dispatch it closes, and the
+    # watchdog's unresolved-step detection keys off the open cursor
+    rec_seq: int = 0
 
 
 class LLMEngine:
@@ -185,6 +189,20 @@ class LLMEngine:
         from .kv_flow import KVFlowMeter
 
         self.flow = KVFlowMeter(enabled=config.kv_flow_metering)
+        # flight recorder + thread-liveness registry (docs/37-flight-
+        # recorder.md): created BEFORE every tier/thread owner so each
+        # long-lived loop (remote writer, hydration fetcher, step thread,
+        # bg compiles) can register its heartbeat at construction
+        from .flightrec import (
+            DEFAULT_BG_COMPILE_STALL_S,
+            FlightRecorder,
+            ThreadRegistry,
+        )
+
+        self.flightrec = FlightRecorder(
+            capacity=config.flight_records, enabled=config.flight_recording
+        )
+        self.threads = ThreadRegistry()
         self.host_tier = None
         self.remote_tier = None
         num_host_blocks = config.cache.num_host_blocks
@@ -208,6 +226,7 @@ class LLMEngine:
             self.remote_tier = RemoteKVTier(
                 config.cache.remote_kv_url, self.model_fingerprint,
                 flow=self.flow,
+                heartbeat=self.threads.register("kv_writer"),
             )
             # the remote tier stages through the host ring; give it a
             # minimal ring even when CPU offload wasn't asked for
@@ -300,6 +319,7 @@ class LLMEngine:
                 signal_fn=lambda: self.hydration_signal(),
                 host_tier=self.host_tier,
                 peer=self.peer_tier,
+                heartbeat=self.threads.register("hydration_fetch"),
             )
         self.scheduler = Scheduler(
             config.model, config.cache, config.scheduler,
@@ -372,6 +392,16 @@ class LLMEngine:
                 self.scheduler.pool,
                 max_model_len=config.model.max_model_len,
             )
+        # background-compile liveness: both runners' bg compile jobs beat
+        # ONE "bg_compile" heartbeat (busy only while a compile runs — a
+        # beat older than the generous threshold while busy is the "XLA
+        # compiles forever" wedge, docs/37-flight-recorder.md)
+        bg_hb = self.threads.register(
+            "bg_compile", stall_after_s=DEFAULT_BG_COMPILE_STALL_S
+        )
+        self.runner.heartbeat = bg_hb
+        if self.draft_runner is not None:
+            self.draft_runner.heartbeat = bg_hb
         self._states: dict[str, _RequestState] = {}
         self._lora_slots: dict[str, int] = {}  # adapter name -> slot index
         self._lora_paths: dict[str, str] = {}  # adapter name -> source path
@@ -1112,6 +1142,7 @@ class LLMEngine:
             )
         nxt: _InflightStep | None = None
         pre_handle: StepHandle | None = None
+        pre_seq = 0
         if isinstance(work, (DecodeWork, VerifyWork)):
             # a verify dispatch pipelines exactly like a decode window: its
             # rows advance speculatively by their fed length (full
@@ -1122,13 +1153,16 @@ class LLMEngine:
             )
             self.scheduler.begin_speculative(work)
             self.timing["dispatch_s"] += time.perf_counter() - t1
-            nxt = _InflightStep(work=work, handle=handle)
+            nxt = _InflightStep(
+                work=work, handle=handle, rec_seq=self._rec_dispatch(work)
+            )
         elif isinstance(work, PrefillWork):
             # dispatched before resolving the in-flight decode so the host
             # array building overlaps device execution; resolved below in
             # this same call (prefill outputs are never speculated on)
             pre_handle = self.runner.execute_async(work)
             self.timing["dispatch_s"] += time.perf_counter() - t1
+            pre_seq = self._rec_dispatch(work)
         if inflight is not None:
             # everything since step entry ran while the previous step was
             # still executing on device — the overlap the pipeline buys
@@ -1143,6 +1177,7 @@ class LLMEngine:
                 if nxt is not None:
                     self.scheduler.rollback_speculative(nxt.work)
                     nxt.handle.discard()
+                    self.flightrec.discard(nxt.rec_seq)
                     self._ledger_rollback(nxt.work)
                 raise
             if nxt is not None and not self.scheduler.speculation_valid(
@@ -1154,6 +1189,7 @@ class LLMEngine:
                 # rewound by discard()).
                 self.scheduler.rollback_speculative(nxt.work)
                 nxt.handle.discard()
+                self.flightrec.discard(nxt.rec_seq)
                 self.timing["rollback_n"] += 1
                 self._ledger_rollback(nxt.work)
                 nxt = None
@@ -1189,6 +1225,7 @@ class LLMEngine:
         if pre_handle is not None:
             t2 = time.perf_counter()
             rows = pre_handle.resolve()
+            self.flightrec.resolve(pre_seq)
             t3 = time.perf_counter()
             self.timing["sync_s"] += pre_handle.sync_s
             self.timing["prefill_s"] += t3 - t2
@@ -1214,6 +1251,7 @@ class LLMEngine:
         t0 = time.perf_counter()
         rows = handle.resolve()
         inflight.resolved = True
+        self.flightrec.resolve(inflight.rec_seq)
         t1 = time.perf_counter()
         self.timing["sync_s"] += handle.sync_s
         self.timing["decode_s"] += t1 - t0
@@ -1253,7 +1291,9 @@ class LLMEngine:
         return outputs
 
     def _execute_sync(self, work, outputs: list[RequestOutput], t1: float):
+        seq = self._rec_dispatch(work)
         sampled = self.runner.execute(work)
+        self.flightrec.resolve(seq)
         t2 = time.perf_counter()
         kind = "prefill" if isinstance(work, PrefillWork) else "decode"
         self.timing[kind + "_s"] += t2 - t1
@@ -1279,6 +1319,39 @@ class LLMEngine:
             self._meter_decode(work, sum(len(toks) for _, toks in results))
         self._emit_results(results, lp_rows, outputs)
         self.timing["post_s"] += time.perf_counter() - t2
+
+    # -- flight recorder (docs/37-flight-recorder.md) ----------------------
+
+    def _rec_dispatch(self, work) -> int:
+        """One black-box record per device dispatch: batch shape + phase,
+        the scheduler's decision summary, and queue/pool depths — what the
+        engine was doing right before it (maybe) stopped doing anything.
+        Also opens the dispatch/resolve cursor the watchdog's
+        unresolved-step detection keys off (tracked even with recording
+        disabled)."""
+        sched = self.scheduler
+        if isinstance(work, PrefillWork):
+            kind, window = "prefill", 0
+            tokens = sum(len(t) for t in work.token_ids)
+        elif isinstance(work, VerifyWork):
+            kind, window = "verify", 0
+            tokens = sum(len(t) for t in work.token_ids)
+        else:
+            kind, window = "decode", work.window
+            tokens = work.window * len(work.requests)
+        if self.flightrec.enabled:
+            # the O(batch) queue/pool summary is only worth computing
+            # when a record will actually be written; the liveness
+            # cursor needs none of it
+            waiting, running = sched.num_waiting, sched.num_running
+            pool_usage = sched.pool.usage_perc
+        else:
+            waiting = running = 0
+            pool_usage = 0.0
+        return self.flightrec.dispatch(
+            kind, rows=len(work.requests), tokens=tokens, window=window,
+            waiting=waiting, running=running, pool_usage=pool_usage,
+        )
 
     # -- saturation & goodput telemetry (docs/29-saturation-slo.md) --------
 

@@ -1,0 +1,700 @@
+"""Flight recorder, thread-liveness watchdog, and crash postmortems
+(docs/37-flight-recorder.md).
+
+Every telemetry layer before this one observes *requests that make
+progress*: the tracing spine explains one slow request, the goodput
+ledger explains wasted tokens, the saturation meter explains an
+under-full chip. A WEDGED engine — a collective that never completes, a
+fetcher deadlocked under a tier lock, an XLA compile that never returns
+— produces no requests and therefore no evidence. Production engines
+(RTP-LLM, PAPERS.md) treat hang diagnosis as a serving-stack feature:
+when the process stalls, the process itself should name the stuck thread
+and dump what it was doing. Four pieces:
+
+- :class:`FlightRecorder` — a bounded, lock-light ring of structured
+  step records appended from the step loop (dispatch/resolve sequence,
+  batch shape and phase, scheduler decision summary, queue/pool depths,
+  rollback and fault markers). Same noise-floor bar as the StepMeter
+  (≤~2% p50, measured by the bench's ``blackbox`` phase). The last N
+  records are the black box: what the engine was doing right before it
+  stopped doing anything.
+
+- :class:`ThreadRegistry` / :class:`Heartbeat` — every long-lived loop
+  in the process beats a heartbeat: the step thread, the hydration
+  fetcher, the KV-event publisher, the remote-KV writer, background
+  compile jobs. ``beat()`` marks the loop alive-and-busy; ``idle()``
+  marks it parked waiting for work (an idle loop is never stale). Ages
+  are computed by READERS (exporter, watchdog) from the beat stamps, so
+  a dead loop cannot fake freshness.
+
+- :class:`Watchdog` — one daemon thread that turns silence into signal:
+  a busy heartbeat older than its threshold, or a device step dispatched
+  and never resolved, starts a stall EPISODE — one structured report
+  (thread stacks + the last flight records), one counter bump per kind
+  (``tpu:engine_step_stalls_total``), one postmortem dump, and /ready
+  flips 503 (never /health: restarting a wedged engine is an operator
+  decision, not a kubelet reflex) until the stall clears.
+
+- :func:`write_postmortem` / :class:`PostmortemDumper` — a redacted JSON
+  black box (flight ring, heartbeat table, thread stacks, config
+  fingerprint, timing/hydration snapshots, env) written to
+  ``--postmortem-dir`` on watchdog trip, SIGQUIT, and fatal step-thread
+  exceptions; served live at ``GET /debug/flight`` and on demand via
+  ``POST /debug/postmortem``. bench.py's preflight watchdog writes the
+  same artifact, so the r04/r05 chip wedge finally leaves a file behind.
+
+:class:`EventLoopLagProbe` rides along for the asyncio processes (router
+and KV controller): a starved event loop serves nothing while every
+request-vantage metric just goes quiet —
+``tpu:router_event_loop_lag_seconds`` is the decaying peak of how far a
+short sleep overshot its deadline.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import math
+import os
+import re
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+from .. import metrics_contract as mc
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+# closed label sets — the contract owns them; this module records against
+# them (a thread name outside THREAD_NAMES raises at registration, so the
+# exporter's seeded cardinality can never be exceeded)
+THREAD_NAMES = mc.THREAD_NAME_VALUES
+STALL_KINDS = mc.STALL_KIND_VALUES
+
+DEFAULT_CAPACITY = 512
+DEFAULT_WATCH_INTERVAL_S = 1.0
+DEFAULT_STALL_AFTER_S = 120.0
+# background XLA compiles legitimately run for minutes (plus up to the
+# 10-minute idle gate); only a compile past this is the "compiles
+# forever" wedge
+DEFAULT_BG_COMPILE_STALL_S = 900.0
+
+
+class FlightRecorder:
+    """Bounded ring of structured step records (the black box).
+
+    Appended from the step thread; snapshotted by the watchdog, the
+    postmortem dumper, and GET /debug/flight. One small lock guards the
+    ring (an append is a dict build + deque append — microseconds against
+    a millisecond-scale step). The dispatch/resolve cursor is tracked
+    even when recording is disabled: the watchdog's unresolved-step
+    detection must survive ``--flight-recording false``.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, enabled: bool = True):
+        self.enabled = enabled
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=capacity)
+        self._seq = 0
+        # the ONE outstanding dispatched-but-unresolved device step (the
+        # pipeline is two-deep: at most one step is in flight between
+        # step() calls). (seq, monotonic dispatch time, kind) or None.
+        self._outstanding: tuple[int, float, str] | None = None
+        self.records_total = 0
+
+    # -- step-loop recording (step thread) ---------------------------------
+
+    def _append(self, event: str, fields: dict) -> None:
+        if not self.enabled:
+            return
+        fields["event"] = event
+        fields["t"] = time.time()
+        with self._lock:
+            self._ring.append(fields)
+            self.records_total += 1
+
+    def dispatch(
+        self, kind: str, rows: int, tokens: int,
+        waiting: int = 0, running: int = 0, pool_usage: float = 0.0,
+        window: int = 0,
+    ) -> int:
+        """One device dispatch (decode window / verify / prefill chunk).
+        Returns the dispatch seq the matching resolve()/discard() names."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._outstanding = (seq, time.monotonic(), kind)
+        self._append("dispatch", {
+            "seq": seq, "kind": kind, "rows": rows, "tokens": tokens,
+            "window": window, "waiting": waiting, "running": running,
+            "pool_usage": round(pool_usage, 4),
+        })
+        return seq
+
+    def resolve(self, seq: int, accepted: int = 0) -> None:
+        """The dispatch's results were synced to the host — the step is no
+        longer a stall candidate."""
+        with self._lock:
+            if self._outstanding is not None and self._outstanding[0] <= seq:
+                self._outstanding = None
+        self._append("resolve", {"seq": seq, "accepted": accepted})
+
+    def discard(self, seq: int) -> None:
+        """A dispatched pipeline step was rolled back (speculation
+        invalidated) — discarded work is resolved work for liveness."""
+        with self._lock:
+            if self._outstanding is not None and self._outstanding[0] <= seq:
+                self._outstanding = None
+        self._append("rollback", {"seq": seq})
+
+    def fault(self, message: str) -> None:
+        """A step-loop exception (transient or fatal)."""
+        with self._lock:
+            self._outstanding = None  # the step loop abandoned it
+        self._append("fault", {"message": str(message)[:500]})
+
+    def note(self, event: str, **fields) -> None:
+        """Off-hot-path markers (watchdog stall/recovery, drain, ...)."""
+        self._append(event, dict(fields))
+
+    # -- reading (watchdog / exporter / debug) -----------------------------
+
+    def outstanding_age_s(self) -> tuple[float, str] | None:
+        """(seconds since dispatch, kind) of the unresolved device step,
+        or None when nothing is in flight."""
+        with self._lock:
+            out = self._outstanding
+        if out is None:
+            return None
+        return time.monotonic() - out[1], out[2]
+
+    def snapshot(self, last: int | None = None) -> list[dict]:
+        with self._lock:
+            records = list(self._ring)
+        if last is not None:
+            records = records[-last:]
+        return records
+
+
+class Heartbeat:
+    """One long-lived loop's liveness stamp. ``beat()`` = alive and busy;
+    ``idle()`` = parked waiting for work (never stale). Readers compute
+    age from the stamps — the loop itself never reports an age."""
+
+    __slots__ = ("name", "stall_after_s", "explicit_threshold",
+                 "_last_beat", "_busy", "beats")
+
+    def __init__(self, name: str, stall_after_s: float,
+                 explicit_threshold: bool = True):
+        self.name = name
+        self.stall_after_s = stall_after_s
+        # loops registered WITHOUT their own threshold follow the
+        # registry default (the --watchdog-stall-s knob); explicit ones
+        # (bg_compile's generous compile budget, the publisher's
+        # interval-derived bound) keep theirs
+        self.explicit_threshold = explicit_threshold
+        self._last_beat = time.monotonic()
+        self._busy = False
+        self.beats = 0
+
+    def beat(self) -> None:
+        self._last_beat = time.monotonic()
+        self._busy = True
+        self.beats += 1
+
+    def idle(self) -> None:
+        self._last_beat = time.monotonic()
+        self._busy = False
+
+    def age_s(self) -> float:
+        return time.monotonic() - self._last_beat
+
+    @property
+    def busy(self) -> bool:
+        return self._busy
+
+    def stale(self) -> bool:
+        return self._busy and self.age_s() > self.stall_after_s
+
+    def snapshot(self) -> dict:
+        return {
+            "thread": self.name,
+            "age_s": round(self.age_s(), 3),
+            "busy": self._busy,
+            "stall_after_s": self.stall_after_s,
+            "beats": self.beats,
+            "stale": self.stale(),
+        }
+
+
+class ThreadRegistry:
+    """Where every long-lived loop registers and beats. Names come from
+    the CLOSED contract set (metrics_contract.THREAD_NAME_VALUES) so the
+    heartbeat-age gauge's cardinality is bounded by construction — an
+    unknown name raises at registration, not at scrape."""
+
+    def __init__(self, default_stall_after_s: float = DEFAULT_STALL_AFTER_S):
+        self._lock = threading.Lock()
+        self._beats: dict[str, Heartbeat] = {}
+        self.default_stall_after_s = default_stall_after_s
+
+    def register(
+        self, name: str, stall_after_s: float | None = None
+    ) -> Heartbeat:
+        """Idempotent: re-registering a name (restartable loops) refreshes
+        the existing heartbeat rather than minting a second one.
+        ``stall_after_s=None`` follows the registry default (the
+        --watchdog-stall-s knob, adjustable after registration via
+        :meth:`set_default_stall_after_s`)."""
+        if name not in THREAD_NAMES:
+            raise ValueError(
+                f"thread name {name!r} is not in the closed contract set "
+                f"{THREAD_NAMES}"
+            )
+        explicit = stall_after_s is not None
+        threshold = (
+            stall_after_s if explicit else self.default_stall_after_s
+        )
+        with self._lock:
+            hb = self._beats.get(name)
+            if hb is None:
+                hb = self._beats[name] = Heartbeat(
+                    name, threshold, explicit_threshold=explicit
+                )
+            else:
+                hb.stall_after_s = threshold
+                hb.explicit_threshold = explicit
+                hb.idle()  # a restarting loop starts fresh, not stale
+            return hb
+
+    def set_default_stall_after_s(self, stall_after_s: float) -> None:
+        """Apply a new default threshold (the --watchdog-stall-s knob,
+        parsed AFTER the engine registered its loops) to every heartbeat
+        that did not pick its own."""
+        with self._lock:
+            self.default_stall_after_s = stall_after_s
+            for hb in self._beats.values():
+                if not hb.explicit_threshold:
+                    hb.stall_after_s = stall_after_s
+
+    def unregister(self, name: str) -> None:
+        """A loop that stops ON PURPOSE (drain stopped the publisher)
+        leaves the table — a deliberate stop must not read as a wedge."""
+        with self._lock:
+            self._beats.pop(name, None)
+
+    def ages(self) -> dict[str, float]:
+        """thread → seconds since last beat, for every registered loop."""
+        with self._lock:
+            beats = list(self._beats.values())
+        return {hb.name: hb.age_s() for hb in beats}
+
+    def stale(self) -> list[Heartbeat]:
+        with self._lock:
+            beats = list(self._beats.values())
+        return [hb for hb in beats if hb.stale()]
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            beats = list(self._beats.values())
+        return {hb.name: hb.snapshot() for hb in beats}
+
+
+def thread_stacks() -> dict[str, list[str]]:
+    """Current stack of every live thread, keyed by thread name — the
+    faulthandler view as capturable strings (faulthandler itself can only
+    write to a real file descriptor)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks: dict[str, list[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        name = names.get(ident, f"thread-{ident}")
+        stacks[name] = [
+            line.rstrip("\n")
+            for line in traceback.format_stack(frame)
+        ]
+    return stacks
+
+
+# -- postmortem dumps --------------------------------------------------------
+
+# keys whose VALUES are secrets wherever they appear in a postmortem doc
+# (tenant api keys, subscriber bearer keys, HF tokens, auth headers)
+_REDACT_KEY_RE = re.compile(
+    r"(api[-_]?key|authorization|auth|token|secret|password|bearer|"
+    r"credential)", re.IGNORECASE,
+)
+# env vars worth carrying in a wedge postmortem (values of matching
+# _REDACT_KEY_RE names are redacted even here)
+_ENV_PREFIXES = (
+    "JAX_", "TPU_", "XLA_", "LIBTPU", "KV_", "POD_", "ENGINE_",
+    "PREFLIGHT_",
+)
+
+
+def redact(obj):
+    """Recursively mask values under secret-shaped keys. Applied to the
+    WHOLE postmortem doc right before serialization, so no section can
+    leak a tenant key by forgetting to scrub its own fields."""
+    if isinstance(obj, dict):
+        return {
+            k: ("[redacted]" if isinstance(k, str) and _REDACT_KEY_RE.search(k)
+                else redact(v))
+            for k, v in obj.items()
+        }
+    if isinstance(obj, (list, tuple)):
+        return [redact(v) for v in obj]
+    return obj
+
+
+# disambiguates same-second dump filenames within one process
+_DUMP_COUNTER = itertools.count()
+
+
+def _captured_env() -> dict[str, str]:
+    return {
+        k: v for k, v in sorted(os.environ.items())
+        if k.startswith(_ENV_PREFIXES)
+    }
+
+
+def build_postmortem(
+    trigger: str,
+    reason: str,
+    recorder: FlightRecorder | None = None,
+    registry: ThreadRegistry | None = None,
+    sections: dict | None = None,
+) -> dict:
+    """The redacted black-box document. `sections` carries caller-provided
+    context (config fingerprint, /debug/timing + /debug/hydration
+    snapshots, watchdog state) — everything is redacted together."""
+    doc: dict = {
+        "postmortem": True,
+        "trigger": trigger,
+        "reason": reason,
+        "written_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "pid": os.getpid(),
+        "threads": thread_stacks(),
+        "env": _captured_env(),
+    }
+    if recorder is not None:
+        doc["flight"] = recorder.snapshot()
+        out = recorder.outstanding_age_s()
+        if out is not None:
+            doc["outstanding_step"] = {
+                "age_s": round(out[0], 3), "kind": out[1],
+            }
+    if registry is not None:
+        doc["heartbeats"] = registry.snapshot()
+    for key, value in (sections or {}).items():
+        doc[key] = value
+    return redact(doc)
+
+
+def write_postmortem(
+    out_dir: str,
+    trigger: str,
+    reason: str,
+    recorder: FlightRecorder | None = None,
+    registry: ThreadRegistry | None = None,
+    sections: dict | None = None,
+) -> tuple[str, dict]:
+    """Build + write one postmortem JSON file; returns (path, doc).
+    Filenames carry the trigger and a wall timestamp so repeated wedges
+    never overwrite each other."""
+    doc = build_postmortem(trigger, reason, recorder, registry, sections)
+    os.makedirs(out_dir, exist_ok=True)
+    # pid + a process-wide monotonic counter: two dumps landing in the
+    # same SECOND (a watchdog episode racing a SIGQUIT, two wedges in one
+    # bench run) must not overwrite each other's evidence
+    fname = "postmortem-{}-{}-{}-{}.json".format(
+        trigger, time.strftime("%Y%m%dT%H%M%S"), os.getpid(),
+        next(_DUMP_COUNTER),
+    )
+    path = os.path.join(out_dir, fname)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)  # a reader never sees a torn dump
+    return path, doc
+
+
+class PostmortemDumper:
+    """The engine server's dump trigger: one place that knows the output
+    dir, the recorder/registry, and the extra context callables (config,
+    timing, hydration). ``out_dir=''`` disables files — build() still
+    serves POST /debug/postmortem inline."""
+
+    def __init__(
+        self,
+        out_dir: str = "",
+        recorder: FlightRecorder | None = None,
+        registry: ThreadRegistry | None = None,
+        context_fn=None,
+    ):
+        self.out_dir = out_dir
+        self.recorder = recorder
+        self.registry = registry
+        # zero-arg callable -> dict of extra sections, evaluated at dump
+        # time (config fingerprint, timing, hydration snapshots)
+        self.context_fn = context_fn
+        self.dumps_written = 0
+        self.last_path: str | None = None
+
+    def _sections(self) -> dict:
+        if self.context_fn is None:
+            return {}
+        try:
+            return dict(self.context_fn())
+        except Exception as e:  # a broken context must not lose the dump
+            return {"context_error": f"{type(e).__name__}: {e}"}
+
+    def build(self, trigger: str, reason: str) -> dict:
+        return build_postmortem(
+            trigger, reason, self.recorder, self.registry, self._sections()
+        )
+
+    def dump(self, trigger: str, reason: str) -> tuple[str | None, dict]:
+        """Write (when a dir is configured) and return (path, doc). Never
+        raises: the dumper runs on dying threads and signal handlers."""
+        try:
+            if not self.out_dir:
+                return None, self.build(trigger, reason)
+            path, doc = write_postmortem(
+                self.out_dir, trigger, reason,
+                self.recorder, self.registry, self._sections(),
+            )
+            self.dumps_written += 1
+            self.last_path = path
+            logger.error("postmortem (%s) written to %s", trigger, path)
+            return path, doc
+        except Exception:
+            logger.exception("postmortem dump (%s) failed", trigger)
+            return None, {"postmortem": False, "trigger": trigger}
+
+
+class Watchdog:
+    """The thread that turns silence into signal.
+
+    Every ``interval_s`` it checks (a) each registered heartbeat's
+    staleness and (b) the flight recorder's outstanding device step. A
+    transition from clear to stalled starts one EPISODE: one structured
+    stall report in the log (stacks + last flight records), one counter
+    bump per kind, one ``on_stall`` callback (the server hooks the
+    postmortem dumper there). While stalled, ``stalled`` is the live
+    report the /ready handler 503s with — liveness (/health) is NEVER
+    flipped: k8s restarting a wedged engine destroys the evidence this
+    module exists to capture, and the operator may prefer a /debug/flight
+    look first.
+    """
+
+    def __init__(
+        self,
+        registry: ThreadRegistry,
+        recorder: FlightRecorder | None = None,
+        interval_s: float = DEFAULT_WATCH_INTERVAL_S,
+        stall_after_s: float = DEFAULT_STALL_AFTER_S,
+        on_stall=None,
+    ):
+        self.registry = registry
+        self.recorder = recorder
+        self.interval_s = interval_s
+        # default threshold for the unresolved-step check (heartbeats
+        # carry their own per-loop thresholds)
+        self.stall_after_s = stall_after_s
+        self.on_stall = on_stall  # callable(report: dict), once per episode
+        self.stall_counts: dict[str, int] = {k: 0 for k in STALL_KINDS}
+        self.stall_episodes = 0
+        self.stalled: dict | None = None  # live report while stalled
+        self._hb = registry.register(
+            "watchdog", stall_after_s=max(10.0, 10 * interval_s)
+        )
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # threads/kinds already counted in the CURRENT episode — a wedge
+        # that persists for minutes is one trip per (kind, thread), not
+        # one per check round
+        self._episode_keys: set[tuple[str, str]] = set()
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="engine-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(2.0, 2 * self.interval_s))
+            self._thread = None
+        self.registry.unregister("watchdog")
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._hb.beat()
+                self.check()
+            except Exception:  # the monitor must outlive its own bugs
+                logger.exception("watchdog check failed")
+
+    def check(self) -> dict | None:
+        """One detection round (factored out so tests drive it without
+        the thread). Returns the current stall report or None."""
+        findings: list[dict] = []
+        for hb in self.registry.stale():
+            if hb.name == "watchdog":
+                continue  # self-staleness is for the exporter to surface
+            findings.append({
+                "kind": "stale_heartbeat",
+                "thread": hb.name,
+                "age_s": round(hb.age_s(), 3),
+                "stall_after_s": hb.stall_after_s,
+            })
+        if self.recorder is not None:
+            out = self.recorder.outstanding_age_s()
+            if out is not None and out[0] > self.stall_after_s:
+                findings.append({
+                    "kind": "unresolved_step",
+                    "thread": "step",
+                    "age_s": round(out[0], 3),
+                    "dispatch_kind": out[1],
+                    "stall_after_s": self.stall_after_s,
+                })
+        if not findings:
+            if self.stalled is not None:
+                logger.warning(
+                    "watchdog: stall cleared after %d finding(s)",
+                    len(self._episode_keys),
+                )
+                if self.recorder is not None:
+                    self.recorder.note("stall_cleared")
+            self.stalled = None
+            self._episode_keys.clear()
+            return None
+        new = [
+            f for f in findings
+            if (f["kind"], f["thread"]) not in self._episode_keys
+        ]
+        self.stalled = {
+            "since": self.stalled["since"] if self.stalled else time.time(),
+            "findings": findings,
+        }
+        if new:
+            if not self._episode_keys:
+                self.stall_episodes += 1
+            for f in new:
+                self._episode_keys.add((f["kind"], f["thread"]))
+                self.stall_counts[f["kind"]] += 1
+            self._report(new)
+        return self.stalled
+
+    def _report(self, findings: list[dict]) -> None:
+        """ONE structured stall report per new finding set: the named
+        threads, their stacks, and the last flight records — the log line
+        an operator greps for when the bench goes dark."""
+        names = ", ".join(
+            f"{f['thread']} ({f['kind']}, {f['age_s']:.1f}s)"
+            for f in findings
+        )
+        stacks = thread_stacks()
+        tail = (
+            self.recorder.snapshot(last=16)
+            if self.recorder is not None else []
+        )
+        logger.error(
+            "watchdog: engine stalled — %s\nstall report: %s",
+            names,
+            json.dumps(redact({
+                "findings": findings,
+                "heartbeats": self.registry.snapshot(),
+                "threads": stacks,
+                "flight_tail": tail,
+            }), indent=1),
+        )
+        if self.recorder is not None:
+            self.recorder.note("stall", findings=findings)
+        if self.on_stall is not None:
+            try:
+                self.on_stall({"findings": findings})
+            except Exception:
+                logger.exception("watchdog on_stall callback failed")
+
+    def snapshot(self) -> dict:
+        return {
+            "interval_s": self.interval_s,
+            "stall_after_s": self.stall_after_s,
+            "stalled": self.stalled,
+            "episodes": self.stall_episodes,
+            "counts": dict(self.stall_counts),
+        }
+
+
+class EventLoopLagProbe:
+    """Asyncio event-loop starvation probe (router / KV controller).
+
+    Sleeps ``interval_s`` in a loop and measures how far each wakeup
+    overshot its deadline; ``lag_s`` is a decaying peak (τ ~30s), so a
+    scrape every 15s still sees a one-off 2s stall instead of whatever
+    the last healthy tick read. A loop blocked OUTSIDE await (sync I/O,
+    a giant json.loads — the tpulint async-blocking bug class, live) is
+    exactly what inflates it."""
+
+    _DECAY_TAU_S = 30.0
+
+    def __init__(self, interval_s: float = 0.5):
+        self.interval_s = interval_s
+        self.last_lag_s = 0.0
+        self.lag_s = 0.0  # decaying peak — the exported gauge
+        self.ticks = 0
+        self._task = None
+        self._peak_t = time.monotonic()
+
+    def _observe(self, lag: float) -> None:
+        now = time.monotonic()
+        decayed = self.lag_s * math.exp(
+            -(now - self._peak_t) / self._DECAY_TAU_S
+        )
+        self.last_lag_s = lag
+        self.lag_s = max(lag, decayed)
+        self._peak_t = now
+        self.ticks += 1
+
+    async def _run(self) -> None:
+        import asyncio
+
+        while True:
+            t0 = time.monotonic()
+            await asyncio.sleep(self.interval_s)
+            self._observe(max(0.0, time.monotonic() - t0 - self.interval_s))
+
+    def start(self) -> None:
+        import asyncio
+
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        import asyncio
+
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def snapshot(self) -> dict:
+        return {
+            "interval_s": self.interval_s,
+            "lag_s": round(self.lag_s, 6),
+            "last_lag_s": round(self.last_lag_s, 6),
+            "ticks": self.ticks,
+        }

@@ -306,6 +306,7 @@ class Hydrator:
         signal_fn=None,
         host_tier=None,
         peer=None,
+        heartbeat=None,
     ):
         if mode not in self.MODES:
             raise ValueError(
@@ -333,6 +334,11 @@ class Hydrator:
         self._q: queue.Queue = queue.Queue()
         self._thread: threading.Thread | None = None
         self._closed = False
+        # thread-liveness heartbeat (docs/37-flight-recorder.md,
+        # flightrec.ThreadRegistry "hydration_fetch"): beaten per fetch
+        # job, idle while blocked on the empty queue — a stale-while-busy
+        # beat is the fetcher-deadlocked-under-a-tier-lock wedge
+        self.heartbeat = heartbeat
         # dedicated remote connection for the fetcher thread: its chunk
         # mgets can run for seconds and must never hold the shared fetch
         # lock the step thread's probes contend on (kvstore/client.py)
@@ -460,9 +466,16 @@ class Hydrator:
             self._thread.start()
 
     def _fetch_loop(self) -> None:
+        hb = self.heartbeat
         while True:
+            if hb is not None:
+                hb.idle()  # parked on an empty queue is not a stall
             item = self._q.get()
+            if hb is not None:
+                hb.beat()  # busy: silence from here on IS a stall signal
             if item is None:
+                if hb is not None:
+                    hb.idle()
                 return
             if item[0] == "bootstrap":
                 _, owner, hashes = item

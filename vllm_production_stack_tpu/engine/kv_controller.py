@@ -88,6 +88,14 @@ class KVController:
         self._http = LazyClientSession(
             timeout=aiohttp.ClientTimeout(total=timeout_s)
         )
+        # event-loop starvation probe (docs/37-flight-recorder.md): the
+        # controller is pure asyncio — a starved loop stalls every
+        # lookup/event-apply while its request metrics just go quiet.
+        # Started on app startup, rendered as
+        # tpu:router_event_loop_lag_seconds like the other shared names.
+        from .flightrec import EventLoopLagProbe
+
+        self.loop_lag_probe = EventLoopLagProbe()
         # counters for /metrics and the zero-probe guarantee tests
         self.probes_sent = 0
         # "peer" = /peer_lookup rediscovery calls (docs/35-peer-kv-reuse
@@ -187,10 +195,15 @@ class KVController:
         app.router.add_get("/engines", self._handle_engines)
         app.router.add_get("/health", self._handle_health)
         app.router.add_get("/metrics", self._handle_metrics)
+        app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
 
+    async def _on_startup(self, app: web.Application) -> None:
+        self.loop_lag_probe.start()
+
     async def _on_cleanup(self, app: web.Application) -> None:
+        await self.loop_lag_probe.stop()
         await self._http.close()
 
     async def _handle_lookup(self, request: web.Request) -> web.Response:
@@ -327,6 +340,13 @@ class KVController:
         for mode, n in sorted(self.lookup_counts.items()):
             lines.append(f'{mc.CLUSTER_KV_LOOKUPS}{{mode="{mode}"}} {n}')
         lines += self.index.lookups.render(mc.CLUSTER_KV_LOOKUP_LATENCY)
+        # event-loop starvation (docs/37-flight-recorder.md): same name
+        # wherever an asyncio control-plane loop lives (router replicas
+        # export it from their registry)
+        lines.append(f"# TYPE {mc.ROUTER_EVENT_LOOP_LAG} gauge")
+        lines.append(
+            f"{mc.ROUTER_EVENT_LOOP_LAG} {self.loop_lag_probe.lag_s:.6f}"
+        )
         # fleet-coherence telemetry (docs/32-fleet-telemetry.md): the
         # controller-vantage convergence lag, per-engine applied seq
         # positions, per-replica index divergence, and the fleet-wide

@@ -219,6 +219,7 @@ class KVEventPublisher:
         headers: dict | None = None,
         jitter_frac: float = DEFAULT_JITTER_FRAC,
         send_timeout_s: float = DEFAULT_SEND_TIMEOUT_S,
+        heartbeat=None,
     ):
         """subscriber_urls: one base URL, a comma-separated string, or a
         list — every subscriber gets every batch, each with its own resync
@@ -249,6 +250,12 @@ class KVEventPublisher:
         self.jitter_frac = jitter_frac
         self.send_timeout_s = send_timeout_s
         self._task: asyncio.Task | None = None
+        # thread-liveness heartbeat (docs/37-flight-recorder.md,
+        # flightrec.ThreadRegistry "kv_event_publisher"): beaten once per
+        # publish round — a round stuck behind a blackholed subscriber
+        # (or a starved event loop) stops beating and the watchdog names
+        # this loop instead of the symptom (controller-side resync storms)
+        self.heartbeat = heartbeat
         # flush-loop faults not attributable to one subscriber (e.g. the
         # snapshot_fn itself); per-subscriber transport faults land on the
         # subscriber's own counter and both roll up in publish_failures
@@ -297,8 +304,11 @@ class KVEventPublisher:
         return jittered_interval(self.interval_s, self.jitter_frac)
 
     async def _run(self) -> None:
+        hb = self.heartbeat
         while True:
             try:
+                if hb is not None:
+                    hb.beat()  # a hung flush round stops the beats
                 await self.flush()
             except asyncio.CancelledError:
                 raise
@@ -308,6 +318,8 @@ class KVEventPublisher:
                 # shared-path faults) loses no subscriber-attributed events
                 self._loop_failures += 1
                 logger.debug("kv event flush failed: %s", e)
+            if hb is not None:
+                hb.idle()  # the inter-round sleep is parked, not stalled
             await asyncio.sleep(self._next_interval())
 
     async def _post(self, sub: _SubscriberState, payload: dict) -> dict:

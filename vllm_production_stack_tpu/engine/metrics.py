@@ -420,6 +420,32 @@ class EngineMetrics:
         self.kv_event_failures.labels(**self._labels)
         self.kv_event_queue_depth.labels(**self._labels).set(0)
         self.kv_event_subscribers.labels(**self._labels).set(0)
+        # -- flight recorder & thread-liveness watchdog (docs/37-flight-
+        # recorder.md): per-loop heartbeat age (thread= closed set; 0 for
+        # loops not running in this deployment) and stall episodes by kind
+        self.thread_heartbeat_age = Gauge(
+            mc.THREAD_HEARTBEAT_AGE,
+            "Seconds since each long-lived loop's last liveness beat "
+            "(closed thread set: " + ", ".join(mc.THREAD_NAME_VALUES)
+            + "; 0 = loop not running in this deployment) — a busy loop "
+            "whose age passes its threshold is a named wedge",
+            [*names, "thread"],
+            registry=self.registry,
+        )
+        self.step_stalls = Counter(
+            mc.ENGINE_STEP_STALLS[: -len("_total")],
+            "Watchdog stall episodes by kind (closed set: "
+            + ", ".join(mc.STALL_KIND_VALUES)
+            + ") — counted once per episode, not per check round",
+            [*names, "kind"],
+            registry=self.registry,
+        )
+        for thread in mc.THREAD_NAME_VALUES:
+            self.thread_heartbeat_age.labels(
+                **self._labels, thread=thread
+            ).set(0)
+        for kind in mc.STALL_KIND_VALUES:
+            self.step_stalls.labels(**self._labels, kind=kind)
         # -- multi-tenant QoS (docs/27-multitenancy.md): tenant-labeled
         # series; cardinality bounded by qos.TenantAccounting.MAX_TENANTS
         tlabels = [*names, "tenant"]
@@ -672,6 +698,28 @@ class EngineMetrics:
                 self._bump_labeled(
                     self.stickiness_violations, f"sticky:{reason}",
                     int(total), {**self._labels, "reason": reason},
+                )
+
+    def update_liveness(
+        self,
+        ages: dict[str, float] | None = None,
+        stall_counts: dict[str, int] | None = None,
+    ) -> None:
+        """Thread-liveness series (docs/37-flight-recorder.md), computed by
+        the EXPORTER from the registry's beat stamps at scrape time — a
+        dead watchdog cannot freeze its own age gauge. Unregistered loops
+        read 0 (not running here); stall counts bump delta-style from the
+        watchdog's monotonic episode counters."""
+        ages = ages or {}
+        for thread in mc.THREAD_NAME_VALUES:
+            self.thread_heartbeat_age.labels(
+                **self._labels, thread=thread
+            ).set(ages.get(thread, 0.0))
+        for kind, total in (stall_counts or {}).items():
+            if kind in mc.STALL_KIND_VALUES:
+                self._bump_labeled(
+                    self.step_stalls, f"stall:{kind}", int(total),
+                    {**self._labels, "kind": kind},
                 )
 
     def _bump(self, counter: Counter, key: str, total: int) -> None:

@@ -380,6 +380,12 @@ class ModelRunner:
         self.bg_compiles = 0  # profiling: programs compiled off the hot path
         # warmup disables this so every wave compiles its EXACT program
         self.fallback_enabled = True
+        # thread-liveness heartbeat (docs/37-flight-recorder.md,
+        # flightrec.ThreadRegistry "bg_compile"; the engine wires it):
+        # busy only while a background compile actually runs — a beat
+        # older than its generous threshold while busy is the
+        # "XLA compiles forever" wedge the watchdog names
+        self.heartbeat = None
         # when set (AsyncEngine wires it), background compiles WAIT for the
         # engine to go idle: on remote-device links the compile service
         # contends with dispatch, so compiling during traffic steals the
@@ -1461,6 +1467,9 @@ class ModelRunner:
                     _time.sleep(0.25)
             if self._bg_stop.is_set():
                 return
+            hb = self.heartbeat
+            if hb is not None:
+                hb.beat()  # busy from here: the compile itself can wedge
             if self._compile_key_now(key):
                 self.bg_compiles += 1
                 logger.info(
@@ -1469,6 +1478,9 @@ class ModelRunner:
         except Exception:
             logger.exception("background compile failed for %s", key)
         finally:
+            hb = self.heartbeat
+            if hb is not None:
+                hb.idle()
             with self._bg_lock:
                 self._bg_inflight.discard(key)
 

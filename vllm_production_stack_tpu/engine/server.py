@@ -40,6 +40,7 @@ from .engine import (
     LLMEngine,
 )
 from ..tracing import TraceStore, mono_to_epoch
+from .flightrec import PostmortemDumper, Watchdog
 from .kv_peer import MAX_PEER_RUN_BLOCKS, peer_hint_from_headers
 from .metrics import EngineMetrics, OPENMETRICS_CONTENT_TYPE, wants_openmetrics
 from .protocol import (
@@ -112,7 +113,9 @@ class _StreamUnsupported(Exception):
 class EngineServer:
     def __init__(self, engine: LLMEngine, served_model_name: str | None = None,
                  drain_timeout_s: float = 30.0, request_tracing: bool = True,
-                 trace_buffer: int = 256):
+                 trace_buffer: int = 256, watchdog: bool = True,
+                 watchdog_interval_s: float = 1.0,
+                 watchdog_stall_s: float = 120.0, postmortem_dir: str = ""):
         self.engine = engine
         self.async_engine = AsyncEngine(engine)
         self.model_name = served_model_name or engine.config.model.model
@@ -152,6 +155,38 @@ class EngineServer:
         self.stickiness = SessionStickinessAudit(
             self_url=self._advertised_url()
         )
+        # flight recorder / watchdog / postmortems (docs/37-flight-
+        # recorder.md): the dumper writes the redacted black box on
+        # watchdog trip, SIGQUIT, fatal step-thread death, and POST
+        # /debug/postmortem; the watchdog turns heartbeat silence and
+        # never-resolved dispatches into a named stall that flips /ready
+        # (never /health — restarting a wedged engine destroys the
+        # evidence this layer exists to capture)
+        # the knob is parsed after the engine registered its loops —
+        # non-explicit heartbeats (step, fetcher, writer) follow it
+        engine.threads.set_default_stall_after_s(watchdog_stall_s)
+        self.postmortems = PostmortemDumper(
+            out_dir=postmortem_dir,
+            recorder=engine.flightrec,
+            registry=engine.threads,
+            context_fn=self._postmortem_context,
+        )
+        self.watchdog: Watchdog | None = None
+        if watchdog:
+            self.watchdog = Watchdog(
+                engine.threads,
+                recorder=engine.flightrec,
+                interval_s=watchdog_interval_s,
+                stall_after_s=watchdog_stall_s,
+                on_stall=lambda report: self.postmortems.dump(
+                    "watchdog", json.dumps(report.get("findings", []))
+                ),
+            )
+        # a fatally wedged step loop dumps its own black box on the way
+        # out — the dying thread's stack is the one that matters
+        self.async_engine.on_fatal = lambda e: self.postmortems.dump(
+            "fatal_step_error", f"{type(e).__name__}: {e}"
+        )
 
     @staticmethod
     def _advertised_url() -> str | None:
@@ -186,9 +221,12 @@ class EngineServer:
         r.add_get("/ready", self.ready)
         r.add_post("/drain", self.drain)
         r.add_get("/metrics", self.metrics_endpoint)
+        r.add_get("/debug", self.debug_index)
         r.add_get("/debug/timing", self.debug_timing)
         r.add_get("/debug/hydration", self.debug_hydration)
         r.add_get("/debug/requests", self.debug_requests)
+        r.add_get("/debug/flight", self.debug_flight)
+        r.add_post("/debug/postmortem", self.debug_postmortem)
         r.add_post("/debug/profile/start", self.debug_profile_start)
         r.add_post("/debug/profile/stop", self.debug_profile_stop)
         r.add_post("/sleep", self.sleep)
@@ -215,6 +253,8 @@ class EngineServer:
         await self._register_with_kv_controller("/register")
         self._start_kv_event_publisher()
         self._install_signal_handlers()
+        if self.watchdog is not None:
+            self.watchdog.start()
 
     def _install_signal_handlers(self) -> None:
         """SIGTERM = graceful drain, then exit (k8s pod termination: preStop
@@ -230,6 +270,24 @@ class EngineServer:
                 signal.SIGTERM, self._begin_drain, True
             )
         except (NotImplementedError, RuntimeError, ValueError):
+            pass
+        # SIGQUIT = dump a postmortem and KEEP RUNNING (replacing the
+        # default core-dump-and-die): the operator's "what is this engine
+        # doing right now" signal, file-shaped instead of stderr-shaped.
+        # The dump walks every thread stack and writes a file, so it runs
+        # in the executor — blocking the event loop with it would stall
+        # every in-flight stream and inflate the very liveness signals
+        # being debugged (same discipline as POST /debug/postmortem).
+        try:
+            loop = asyncio.get_running_loop()
+            loop.add_signal_handler(
+                signal.SIGQUIT,
+                lambda: loop.run_in_executor(
+                    None, self.postmortems.dump, "sigquit",
+                    "operator SIGQUIT",
+                ),
+            )
+        except (NotImplementedError, RuntimeError, ValueError, AttributeError):
             pass
 
     def _start_kv_event_publisher(self) -> None:
@@ -250,6 +308,9 @@ class EngineServer:
         from .kv_events import DEFAULT_FLUSH_INTERVAL_S, KVEventPublisher
 
         port = os.environ.get("ENGINE_PORT", "8000")
+        interval_s = float(
+            os.environ.get("KV_EVENTS_FLUSH_S", DEFAULT_FLUSH_INTERVAL_S)
+        )
         self.kv_event_publisher = KVEventPublisher(
             subscribers,
             f"http://{pod_ip}:{port}",
@@ -257,10 +318,15 @@ class EngineServer:
             self.async_engine.kv_events_snapshot,
             pool.block_size,
             self._client_session,
-            interval_s=float(
-                os.environ.get("KV_EVENTS_FLUSH_S", DEFAULT_FLUSH_INTERVAL_S)
-            ),
+            interval_s=interval_s,
             headers=self._kv_controller_headers(),
+            # liveness: one beat per publish round; the threshold rides
+            # well above the per-POST send timeout so one slow subscriber
+            # round isn't a wedge, a HELD one is
+            heartbeat=self.engine.threads.register(
+                "kv_event_publisher",
+                stall_after_s=max(30.0, 20 * interval_s),
+            ),
         )
         self.kv_event_publisher.start()
         logger.info("KV event publisher -> %s (flush every %.2fs)",
@@ -310,8 +376,11 @@ class EngineServer:
         await asyncio.gather(*(post_one(c) for c in subscribers))
 
     async def _on_cleanup(self, app: web.Application) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self.kv_event_publisher is not None:
             await self.kv_event_publisher.stop()
+            self.engine.threads.unregister("kv_event_publisher")
         await self._register_with_kv_controller("/deregister")
         self.async_engine.shutdown()
         if self._session is not None and not self._session.closed:
@@ -1300,6 +1369,7 @@ class EngineServer:
                 logger.warning("KV event flush during drain failed: %s", e)
             await self.kv_event_publisher.stop()
             self.kv_event_publisher = None
+            self.engine.threads.unregister("kv_event_publisher")
         await self._register_with_kv_controller("/deregister")
         self._drained.set()
         logger.info(
@@ -1357,10 +1427,22 @@ class EngineServer:
         })
 
     async def ready(self, request: web.Request) -> web.Response:
-        """Readiness: 503 while dead, draining, or shedding — flips the
-        pod out of the Service before the engine collapses under backlog."""
+        """Readiness: 503 while dead, draining, shedding, or STALLED (the
+        watchdog's verdict — a wedged engine leaves the Service so traffic
+        fails over, while /health liveness stays green: restarting it
+        would destroy the very evidence /debug/flight and the postmortem
+        exist to capture)."""
         if not self.async_engine.is_healthy:
             return web.json_response({"status": "dead"}, status=503)
+        if self.watchdog is not None and self.watchdog.stalled is not None:
+            return web.json_response(
+                {
+                    "status": "not_ready",
+                    "reason": "stalled",
+                    "stall": self.watchdog.stalled,
+                },
+                status=503,
+            )
         reason = self._overload_state()
         if reason is not None:
             return web.json_response(
@@ -1391,6 +1473,16 @@ class EngineServer:
             subscribers=len(pub.subscribers) if pub is not None else 0,
             stickiness=self.stickiness.counts(),
         )
+        # thread-liveness series (docs/37-flight-recorder.md): ages are
+        # computed HERE from the registry's beat stamps — a dead watchdog
+        # cannot freeze its own gauge
+        self.metrics.update_liveness(
+            ages=self.engine.threads.ages(),
+            stall_counts=(
+                self.watchdog.stall_counts
+                if self.watchdog is not None else None
+            ),
+        )
         payload = self.metrics.render(
             await self.async_engine.stats_async(), openmetrics=om
         )
@@ -1403,12 +1495,107 @@ class EngineServer:
             )
         return web.Response(body=payload, content_type="text/plain")
 
+    # one-liner per mounted debug endpoint — the GET /debug index (they
+    # number six+ now and were discoverable only by reading this file)
+    DEBUG_ENDPOINTS = {
+        "GET /debug": "this index",
+        "GET /debug/timing": "step-thread wall-time decomposition, "
+                             "submit-lock waits, program-cache state",
+        "GET /debug/hydration": "compute-or-load planner live inputs + "
+                                "decision counters (docs/31)",
+        "GET /debug/requests": "tracing-spine timelines; ?rid= one full "
+                               "trace (docs/28)",
+        "GET /debug/flight": "flight-recorder ring + heartbeat table + "
+                             "watchdog state (docs/37)",
+        "POST /debug/postmortem": "write (or return) a redacted postmortem "
+                                  "JSON black box now (docs/37)",
+        "POST /debug/profile/start": "start an xprof device capture "
+                                     "({\"dir\": ...})",
+        "POST /debug/profile/stop": "stop the capture and flush the dump",
+    }
+
+    async def debug_index(self, request: web.Request) -> web.Response:
+        """GET /debug: every mounted debug endpoint with a one-liner."""
+        return web.json_response({"endpoints": self.DEBUG_ENDPOINTS})
+
     async def debug_requests(self, request: web.Request) -> web.Response:
         """Tracing spine introspection (docs/28-request-tracing.md):
         recent / slowest / in-flight request timelines; ?rid= returns one
         full trace (every span + event) as JSON."""
         payload, status = self.traces.debug_response(request.query)
         return web.json_response(payload, status=status)
+
+    def _postmortem_context(self) -> dict:
+        """Extra postmortem sections (flightrec.PostmortemDumper calls
+        this at dump time, possibly from a dying thread or a signal
+        handler — everything here is lock-light reads)."""
+        eng = self.engine
+        ctx: dict = {
+            "config": {
+                "model": self.model_name,
+                "fingerprint": eng.model_fingerprint,
+                "async_scheduling": eng.config.async_scheduling,
+                "kv_hydration": eng.config.kv_hydration,
+            },
+            "timing": dict(eng.timing),
+            "loop_timing": dict(self.async_engine.loop_timing),
+        }
+        if self.watchdog is not None:
+            ctx["watchdog"] = self.watchdog.snapshot()
+        try:
+            snap = eng.flow.snapshot()
+            ctx["hydration"] = {
+                "signal": eng.hydration_signal(),
+                "decisions": snap.get("decisions", {}),
+                "sources": snap.get("hydration", {}),
+            }
+        except Exception as e:  # a half-built engine still gets a dump
+            ctx["hydration"] = {"error": f"{type(e).__name__}: {e}"}
+        return ctx
+
+    async def debug_flight(self, request: web.Request) -> web.Response:
+        """GET /debug/flight: the live black box — last flight records
+        (?last= bounds them), the heartbeat table, and the watchdog's
+        state/counters (docs/37-flight-recorder.md)."""
+        try:
+            last = int(request.query.get("last", "128"))
+        except ValueError:
+            return error(400, "last must be an integer")
+        eng = self.engine
+        body = {
+            "recording": eng.flightrec.enabled,
+            "records_total": eng.flightrec.records_total,
+            "flight": eng.flightrec.snapshot(last=max(1, last)),
+            "heartbeats": eng.threads.snapshot(),
+            "watchdog": (
+                self.watchdog.snapshot()
+                if self.watchdog is not None else None
+            ),
+            "postmortems": {
+                "dir": self.postmortems.out_dir or None,
+                "written": self.postmortems.dumps_written,
+                "last_path": self.postmortems.last_path,
+            },
+        }
+        out = eng.flightrec.outstanding_age_s()
+        if out is not None:
+            body["outstanding_step"] = {
+                "age_s": round(out[0], 3), "kind": out[1],
+            }
+        return web.json_response(body)
+
+    async def debug_postmortem(self, request: web.Request) -> web.Response:
+        """POST /debug/postmortem: dump the black box NOW. With
+        --postmortem-dir configured the file is written (path in the
+        reply); without it the full redacted document comes back inline —
+        the operator's escape hatch on an ephemeral filesystem. The dump
+        walks every thread stack, so it runs off the event loop."""
+        path, doc = await asyncio.get_running_loop().run_in_executor(
+            None, self.postmortems.dump, "manual", "POST /debug/postmortem"
+        )
+        if path is not None:
+            return web.json_response({"status": "written", "path": path})
+        return web.json_response({"status": "inline", "postmortem": doc})
 
     async def debug_profile_start(self, request: web.Request) -> web.Response:
         """On-demand xprof capture on a live engine: wraps
@@ -1954,6 +2141,39 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-buffer", type=int, default=256,
                    help="finished request timelines kept in the in-process "
                         "ring buffer behind /debug/requests")
+    p.add_argument("--flight-recording", default=True, type=_parse_bool_flag,
+                   help="flight recorder (docs/37-flight-recorder.md): "
+                        "bounded ring of structured step records "
+                        "(dispatch/resolve seq, batch shape, queue/pool "
+                        "depths, rollback/fault markers) served by "
+                        "/debug/flight and carried in stall reports and "
+                        "postmortems. 'false' disables the ring; the "
+                        "watchdog's unresolved-step liveness cursor stays "
+                        "on either way")
+    p.add_argument("--flight-records", type=int, default=512,
+                   help="flight-recorder ring capacity (the last-N step "
+                        "records a stall report / postmortem carries)")
+    p.add_argument("--watchdog", default=True, type=_parse_bool_flag,
+                   help="thread-liveness watchdog (docs/37): stale "
+                        "heartbeats and dispatched-but-never-resolved "
+                        "steps become a named stall — one structured "
+                        "report, tpu:engine_step_stalls_total, a "
+                        "postmortem dump, and /ready flips 503 (liveness "
+                        "/health never flips) until the stall clears")
+    p.add_argument("--watchdog-interval-s", type=float, default=1.0,
+                   help="seconds between watchdog liveness checks")
+    p.add_argument("--watchdog-stall-s", type=float, default=120.0,
+                   help="step-thread / unresolved-dispatch stall threshold "
+                        "in seconds (keep above the longest legitimate "
+                        "lazy-compile stall; per-loop thresholds for the "
+                        "fetcher/publisher/bg-compile ride their own "
+                        "registrations)")
+    p.add_argument("--postmortem-dir", default="",
+                   help="directory for redacted postmortem JSON dumps "
+                        "(watchdog trip, SIGQUIT, fatal step-thread "
+                        "death, POST /debug/postmortem). Empty = no files "
+                        "(/debug/postmortem then returns the document "
+                        "inline)")
     p.add_argument("--step-metering", default=True, type=_parse_bool_flag,
                    help="per-step saturation accounting (docs/29-"
                         "saturation-slo.md): decode-seat occupancy, "
@@ -2207,6 +2427,8 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         kv_peer_fetch_timeout_s=getattr(
             args, "kv_peer_fetch_timeout_s", 2.0
         ),
+        flight_recording=getattr(args, "flight_recording", True),
+        flight_records=getattr(args, "flight_records", 512),
     )
 
 
@@ -2246,6 +2468,10 @@ def main(argv: list[str] | None = None) -> None:
         drain_timeout_s=args.drain_timeout_s,
         request_tracing=args.request_tracing,
         trace_buffer=args.trace_buffer,
+        watchdog=args.watchdog,
+        watchdog_interval_s=args.watchdog_interval_s,
+        watchdog_stall_s=args.watchdog_stall_s,
+        postmortem_dir=args.postmortem_dir,
     )
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)

@@ -100,6 +100,7 @@ class RemoteKVTier:
         dedupe_capacity: int = 65536,
         cooldown_s: float = 5.0,
         flow=None,
+        heartbeat=None,
     ):
         self.host, self.port = parse_store_url(url)
         self.fingerprint = fingerprint
@@ -131,6 +132,12 @@ class RemoteKVTier:
         self._dedupe_capacity = dedupe_capacity
         self._q: queue.Queue = queue.Queue(maxsize=max_pending)
         self._enqueued = 0  # accepted into the queue (drain() accounting)
+        # thread-liveness heartbeat (docs/37-flight-recorder.md,
+        # flightrec.ThreadRegistry "kv_writer"): beaten per PUT, idle
+        # while blocked on the empty queue — a writer wedged mid-PUT
+        # (half-open store connection) is named instead of silently
+        # parking the offload path
+        self.heartbeat = heartbeat
         self._writer = threading.Thread(
             target=self._writer_loop, daemon=True, name="kv-remote-writer"
         )
@@ -173,9 +180,16 @@ class RemoteKVTier:
             self.stats.overflow += 1
 
     def _writer_loop(self) -> None:
+        hb = self.heartbeat
         while True:
+            if hb is not None:
+                hb.idle()  # parked on an empty queue is not a stall
             item = self._q.get()
+            if hb is not None:
+                hb.beat()
             if item is None:
+                if hb is not None:
+                    hb.idle()
                 return
             h, arr = item
             if not self._available():

@@ -384,6 +384,46 @@ METRIC_LABEL_VALUES[SESSION_STICKINESS_VIOLATIONS] = {
     "reason": STICKINESS_REASON_VALUES,
 }
 
+# -- flight recorder & thread-liveness watchdog (docs/37-flight-recorder.md)
+# A wedged engine produces no requests and therefore no request-vantage
+# telemetry; these names turn SILENCE into signal. Every long-lived loop in
+# the engine process beats a heartbeat into engine/flightrec.ThreadRegistry;
+# the gauge below is each loop's seconds-since-last-beat at scrape time
+# (0 for loops not running in this deployment — e.g. no hydration fetcher
+# without a disk/remote tier). thread= is a CLOSED set:
+#   step               the AsyncEngine step-loop thread (drives the device)
+#   hydration_fetch    the compute-or-load planner's chunk fetcher thread
+#   kv_event_publisher the cluster-KV-index event publisher task
+#   kv_writer          the remote KV store's async PUT writer thread
+#   bg_compile         background XLA compile jobs (busy only mid-compile —
+#                      a beat older than its threshold while busy is the
+#                      "XLA compiles forever" wedge)
+#   watchdog           the watchdog thread itself (its age is computed by
+#                      the exporter, not the watchdog, so a dead watchdog
+#                      is visible here rather than self-reported)
+THREAD_HEARTBEAT_AGE = "tpu:thread_heartbeat_age_seconds"
+THREAD_NAME_VALUES = (
+    "step", "hydration_fetch", "kv_event_publisher", "kv_writer",
+    "bg_compile", "watchdog",
+)
+# watchdog trips, by kind (closed set):
+#   stale_heartbeat  a registered loop stopped beating while busy
+#   unresolved_step  a device step was dispatched and never resolved (the
+#                    collective-stall / wedged-tunnel shape: the host is
+#                    alive, the device work never comes back)
+# Counted once per stall EPISODE (a 10-minute wedge is one trip, not 600).
+ENGINE_STEP_STALLS = "tpu:engine_step_stalls_total"
+STALL_KIND_VALUES = ("stale_heartbeat", "unresolved_step")
+METRIC_LABEL_VALUES[THREAD_HEARTBEAT_AGE] = {"thread": THREAD_NAME_VALUES}
+METRIC_LABEL_VALUES[ENGINE_STEP_STALLS] = {"kind": STALL_KIND_VALUES}
+# router/controller asyncio event-loop lag (engine/flightrec.
+# EventLoopLagProbe): decaying peak of how far a short sleep overshot its
+# deadline — a router whose loop is starved (blocking call, CPU overload)
+# serves nothing while every request-vantage metric just goes quiet.
+# Exported by the router registry AND hand-rendered by the KV controller,
+# like the CLUSTER_KV_* names.
+ROUTER_EVENT_LOOP_LAG = "tpu:router_event_loop_lag_seconds"
+
 CLUSTER_KV_GAUGES = (
     CLUSTER_KV_INDEX_HASHES,
     CLUSTER_KV_INDEX_ENGINES,
@@ -432,6 +472,10 @@ ALL_GAUGES = (
     # KV event publisher backlog + fan-out subscriber count
     KV_EVENT_QUEUE_DEPTH,
     KV_EVENT_SUBSCRIBERS,
+    # thread-liveness watchdog (docs/37-flight-recorder.md): per-loop
+    # heartbeat age (thread= closed set) — the signal a wedged engine
+    # still emits when it serves nothing
+    THREAD_HEARTBEAT_AGE,
 )
 ALL_COUNTERS = (
     PREFIX_CACHE_HITS,
@@ -476,4 +520,7 @@ ALL_COUNTERS = (
     SESSION_STICKINESS_VIOLATIONS,
     KV_EVENT_PUBLISH_BATCHES,
     KV_EVENT_PUBLISH_FAILURES,
+    # thread-liveness watchdog (docs/37-flight-recorder.md): stall
+    # episodes by kind (closed STALL_KIND_VALUES set)
+    ENGINE_STEP_STALLS,
 )

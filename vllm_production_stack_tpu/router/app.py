@@ -103,6 +103,10 @@ class RouterState:
         # on_startup when --fleet-report-url (or --kv-controller-url) and
         # a non-zero interval are configured
         self.fleet_reporter = None
+        # event-loop starvation probe (docs/37-flight-recorder.md):
+        # started in on_startup, exported as
+        # tpu:router_event_loop_lag_seconds by RouterMetrics
+        self.loop_lag_probe = None
         self.semantic_cache = None
         self.pii_middleware = None
         self.batch_service = None
@@ -432,6 +436,32 @@ async def handle_metrics(request: web.Request) -> web.Response:
     )
 
 
+# one-liner per mounted debug endpoint — the GET /debug index (the
+# engine serves the same shape; docs/37-flight-recorder.md)
+ROUTER_DEBUG_ENDPOINTS = {
+    "GET /debug": "this index",
+    "GET /debug/requests": "tracing-spine timelines; ?rid= one full trace "
+                           "(docs/28)",
+    "GET /debug/fleet": "ring membership, embedded index positions, "
+                        "breakers, budget scale, last fleet reply "
+                        "(docs/32/34)",
+    "GET /debug/loop": "asyncio event-loop lag probe state (docs/37)",
+}
+
+
+async def handle_debug_index(request: web.Request) -> web.Response:
+    """GET /debug: every mounted debug endpoint with a one-liner."""
+    return web.json_response({"endpoints": ROUTER_DEBUG_ENDPOINTS})
+
+
+async def handle_debug_loop(request: web.Request) -> web.Response:
+    """Event-loop lag probe introspection (docs/37-flight-recorder.md)."""
+    probe = _state(request).loop_lag_probe
+    return web.json_response(
+        probe.snapshot() if probe is not None else {"enabled": False}
+    )
+
+
 async def handle_debug_requests(request: web.Request) -> web.Response:
     """Tracing-spine introspection (docs/28-request-tracing.md): recent /
     slowest / in-flight request timelines; ?rid= returns one full trace."""
@@ -569,8 +599,10 @@ def build_app(args) -> web.Application:
     app.router.add_get("/engines", handle_engines)
     app.router.add_get("/health", handle_health)
     app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/debug", handle_debug_index)
     app.router.add_get("/debug/requests", handle_debug_requests)
     app.router.add_get("/debug/fleet", handle_debug_fleet)
+    app.router.add_get("/debug/loop", handle_debug_loop)
     app.router.add_get("/version", handle_version)
     app.router.add_post("/sleep", handle_sleep)
     app.router.add_post("/wake_up", handle_wake)
@@ -610,6 +642,12 @@ def build_app(args) -> web.Application:
         await state.request_service.start()
         await state.discovery.start()
         await state.engine_scraper.start()
+        lag_interval = getattr(args, "event_loop_lag_interval_s", 0.5)
+        if lag_interval and lag_interval > 0:
+            from ..engine.flightrec import EventLoopLagProbe
+
+            state.loop_lag_probe = EventLoopLagProbe(lag_interval)
+            state.loop_lag_probe.start()
         fleet_url = getattr(args, "fleet_report_url", None) or getattr(
             args, "kv_controller_url", None
         )
@@ -647,6 +685,8 @@ def build_app(args) -> web.Application:
         task = app.get("log_stats_task")
         if task:
             task.cancel()
+        if state.loop_lag_probe is not None:
+            await state.loop_lag_probe.stop()
         if state.fleet_reporter is not None:
             await state.fleet_reporter.stop()
         if state.dynamic_config is not None:

@@ -234,6 +234,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="finished request timelines kept in the in-process ring "
              "buffer behind /debug/requests",
     )
+    x.add_argument(
+        "--event-loop-lag-interval-s", type=float, default=0.5,
+        help="asyncio event-loop starvation probe interval (docs/37-"
+             "flight-recorder.md): a short repeating sleep whose overshoot "
+             "is exported as tpu:router_event_loop_lag_seconds (decaying "
+             "peak) — a starved loop serves nothing while every "
+             "request-vantage metric just goes quiet. 0 disables",
+    )
     x.add_argument("--enable-batch-api", action="store_true")
     x.add_argument("--files-dir", default="/tmp/tpu_router_files")
     x.add_argument("--batch-db", default="/tmp/tpu_router_batch.sqlite")

@@ -220,6 +220,17 @@ class RouterMetrics:
             registry=self.registry,
         )
         self.tenant_budget_scale.set(1.0)
+        # event-loop starvation (docs/37-flight-recorder.md): decaying
+        # peak of how far the lag probe's short sleep overshot its
+        # deadline — a starved asyncio loop serves nothing while every
+        # request-vantage metric just goes quiet
+        self.event_loop_lag = Gauge(
+            mc.ROUTER_EVENT_LOOP_LAG,
+            "Decaying peak of asyncio event-loop scheduling lag at this "
+            "replica (engine/flightrec.EventLoopLagProbe)",
+            registry=self.registry,
+        )
+        self.event_loop_lag.set(0.0)
         # multi-tenant QoS (docs/27-multitenancy.md): the router's half of
         # the tpu:tenant_* contract — admitted traffic and per-tenant
         # throttles (429s that never reached an engine). Label cardinality
@@ -364,6 +375,9 @@ class RouterMetrics:
     def render(self, state, openmetrics: bool = False) -> bytes:
         self._render_kv_index(state.policy)
         self._render_fleet(state)
+        probe = getattr(state, "loop_lag_probe", None)
+        if probe is not None:
+            self.event_loop_lag.set(probe.lag_s)
         qos = getattr(state, "qos", None)
         if qos is not None:
             self.tenant_budget_scale.set(qos.budget_scale)

@@ -144,6 +144,57 @@ def _replay_body(request: web.Request, body: dict) -> web.Request:
     return _ReplayRequest(request, body)  # type: ignore[return-value]
 
 
+class hold_lock:
+    """Context manager that HOLDS a threading lock for its whole scope —
+    the deterministic deadlock-shape wedge (docs/37-flight-recorder.md):
+    any loop that needs the lock (the hydration fetcher under the
+    disk-tier lock, classically) blocks busy until the scope exits, and
+    the thread-liveness watchdog must name it."""
+
+    def __init__(self, lock):
+        self.lock = lock
+
+    def __enter__(self):
+        self.lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.lock.release()
+        return False
+
+
+class frozen_step_loop:
+    """Context manager that freezes an engine's step loop: ``engine.step``
+    is wrapped to block on an event until the scope exits — the
+    whole-engine wedge shape (collective stall / runaway compile under
+    the engine lock). The step thread stops beating its heartbeat while
+    frozen, so the watchdog must name thread=step."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._release = None
+
+    def __enter__(self):
+        import threading
+
+        release = threading.Event()
+        real_step = self.engine.step
+
+        def frozen(*a, **kw):
+            release.wait()
+            return real_step(*a, **kw)
+
+        self.engine.step = frozen
+        self._release = (release, real_step)
+        return self
+
+    def __exit__(self, *exc):
+        release, real_step = self._release
+        self.engine.step = real_step
+        release.set()  # unblock a step thread parked inside the wrapper
+        return False
+
+
 async def black_hole() -> tuple[asyncio.AbstractServer, int]:
     """A listener that accepts connections and never responds — the
     network-partition shape (connect succeeds; the request vanishes).
