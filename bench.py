@@ -1947,6 +1947,173 @@ def _hydration_bench() -> dict:
     }
 
 
+def _kvquant_bench() -> dict:
+    """At-rest KV quantization proof (docs/38-kv-quantization.md),
+    CPU-only so it survives a wedged TPU tunnel. Two arms, identical
+    except for ``--kv-at-rest-codec``: a pool-precision-at-rest baseline
+    and int4+per-group-scales. Each arm seeds a shared remote kvstore
+    with a cold 4k-token prefix under its OWN codec fingerprint (the
+    mixed-fleet namespace rule — the arms can share one store because
+    they can never adopt each other's bytes), then a FRESH engine per
+    arm reloads the prefix over a bandwidth-throttled link with sync
+    hydration (the blocking whole-prefix reload: TTFT ~ wire bytes /
+    link bandwidth, no planner cleverness to confound the codec's
+    contribution — the throttle sleeps on the WIRE payload, so smaller
+    frames are faster automatically, exactly like a real WAN link).
+
+    Acceptance shape: the int4 arm moves >=3.5x fewer wire bytes per
+    KVFlowMeter (the logical/wire quotient is the
+    tpu:kv_tier_compression_ratio gauge), beats the baseline's TTFT,
+    keeps the per-request hydration partition EXACT, and greedy decode
+    tokens agree with the compute-from-scratch reference (int4's
+    per-element dequant error is bounded by scale/2 — docs/38 — far
+    below the argmax margin)."""
+    import time as _t
+    from dataclasses import replace
+
+    import numpy as np
+
+    from vllm_production_stack_tpu.engine.config import EngineConfig
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+    from vllm_production_stack_tpu.kvstore.server import run_in_thread
+
+    BS = 16
+    PROMPT_TOKENS = 4096
+    url, stop_store, _server = run_in_thread(capacity_bytes=1 << 30)
+
+    def make_engine(codec: str) -> LLMEngine:
+        cfg = EngineConfig.tiny(max_model_len=PROMPT_TOKENS + 256)
+        return LLMEngine(cfg.replace(
+            cache=replace(
+                cfg.cache, block_size=BS, num_blocks=352,
+                num_host_blocks=16, remote_kv_url=url,
+                kv_at_rest_codec=codec,
+            ),
+            scheduler=replace(
+                cfg.scheduler, max_num_seqs=2,
+                max_num_batched_tokens=512, decode_buckets=(2,),
+                prefill_buckets=(64, 512), decode_window=4,
+                # ONE block-table width program (hydration-bench idiom):
+                # the phase measures the codec, not the compile ladder
+                width_floor_blocks=300,
+            ),
+            kv_hydration="sync",
+        ))
+
+    def prompt(seed: int, n: int) -> list[int]:
+        return [int(t) for t in
+                np.random.RandomState(seed).randint(1, 500, size=n)]
+
+    GREEDY = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    target = prompt(1, PROMPT_TOKENS)
+    junk_small = prompt(98, 1024)  # compile warmup (width floor: same keys)
+    # churn: enough distinct blocks that EVERY target block is evicted
+    # through the ring (pool 320 blocks; target 256 + churn 512 >> 320)
+    churn = [prompt(99 + i, PROMPT_TOKENS) for i in range(2)]
+
+    def seed_store(codec: str):
+        """Engine A computes the target from scratch (the greedy token
+        reference) and churns junk through the pool so ALL the target's
+        blocks spill through the ring, whose evictions write through to
+        the remote store under `codec`'s at-rest form + fingerprint."""
+        eng = make_engine(codec)
+        ref = eng.generate([target], GREEDY)[0]["token_ids"]
+        for c in churn:
+            eng.generate([c], GREEDY)
+        eng.host_tier.flush()
+        assert eng.remote_tier.drain(timeout=120), "remote store drain hung"
+        stores = eng.remote_tier.stats.stores
+        eng.runner.shutdown(wait=True)
+        return ref, stores
+
+    ref_base, seeded_base = seed_store("none")
+    ref_int4, seeded_int4 = seed_store("int4")
+
+    # throttle AFTER seeding: every /v1/mget connection (fetch side only)
+    # sleeps proportional to its WIRE payload — the link the baseline arm
+    # crawls over is byte-for-byte the link the int4 arm flies over
+    region_blocks = PROMPT_TOKENS // BS - 1
+    from vllm_production_stack_tpu.engine.memory import kv_block_bytes
+
+    tiny = EngineConfig.tiny(max_model_len=PROMPT_TOKENS + 256)
+    pool_dtype = tiny.cache.resolved_kv_dtype(tiny.model.dtype)
+    blk_bytes = kv_block_bytes(tiny.model, BS, 1, 1, kv_dtype=pool_dtype)
+    region_bytes = region_blocks * (blk_bytes + 160)  # + frame header
+    bw = region_bytes / 4.0  # baseline reload ~4s of pure link time
+
+    from vllm_production_stack_tpu.kvstore import client as kvclient
+
+    inner = kvclient._Conn.request
+
+    def slowed(self, method, path, body=None, headers=None):
+        status, hdrs, payload = inner(
+            self, method, path, body=body, headers=headers
+        )
+        if path == "/v1/mget":
+            _t.sleep(len(payload) / bw)
+        return status, hdrs, payload
+
+    kvclient._Conn.request = slowed
+
+    def run_arm(codec: str) -> dict:
+        eng = make_engine(codec)
+        eng.generate([junk_small], GREEDY)  # XLA compiles (not resident)
+        t0 = _t.perf_counter()
+        rid = eng.add_request(prompt_token_ids=target, sampling=GREEDY)
+        ttft = None
+        tokens: list[int] = []
+        while eng.has_unfinished():
+            for out in eng.step():
+                if out.request_id != rid:
+                    continue
+                if out.new_token_ids and ttft is None:
+                    ttft = _t.perf_counter() - t0
+                tokens.extend(out.new_token_ids)
+        snap = eng.flow.snapshot()
+        hyd = snap["hydration"]
+        details = {
+            "ttft_s": round(ttft, 3),
+            "tokens": tokens,
+            "wire_bytes_in": snap["bytes"]["remote/in"],
+            "logical_bytes_in": snap["logical_bytes"]["remote/in"],
+            "compression_ratio": round(
+                snap["compression_ratio"]["remote/in"], 3
+            ),
+            "remote_fetch_tokens": hyd["remote_fetch"],
+            "partition_exact": sum(hyd.values()) == eng._prompt_tokens,
+        }
+        eng.runner.shutdown(wait=True)
+        return details
+
+    base = run_arm("none")
+    quant = run_arm("int4")
+    stop_store()
+
+    reduction = base["wire_bytes_in"] / max(quant["wire_bytes_in"], 1)
+    return {
+        "workload": {
+            "prompt_tokens": PROMPT_TOKENS,
+            "block_size": BS,
+            "pool_dtype": str(pool_dtype),
+            "seeded_blocks": {"base": seeded_base, "int4": seeded_int4},
+            "throttle_bytes_per_s": round(bw, 1),
+        },
+        "base_at_rest": base,
+        "int4_at_rest": quant,
+        "wire_reduction_x": round(reduction, 3),
+        "wire_reduction_ge_3p5": bool(reduction >= 3.5),
+        "int4_beats_base_ttft": bool(quant["ttft_s"] < base["ttft_s"]),
+        "ttft_speedup": round(base["ttft_s"] / max(quant["ttft_s"], 1e-9), 3),
+        "partition_exact_all": bool(
+            base["partition_exact"] and quant["partition_exact"]
+        ),
+        "tokens_identical": bool(
+            ref_base == ref_int4 == base["tokens"] == quant["tokens"]
+        ),
+    }
+
+
 async def _fleet_bench() -> dict:
     """Fleet-coherence telemetry baselines (docs/32-fleet-telemetry.md),
     CPU-only pre-preflight: M=3 REAL router apps × N=4 fake engines, the
@@ -2974,6 +3141,17 @@ def _phase_hydration_main() -> None:
     print(json.dumps({"hydration": result}), flush=True)
 
 
+def _phase_kvquant_main() -> None:
+    """Subprocess entry for the CPU-only at-rest KV quantization bench.
+    Forces CPU before anything touches jax — runs pre-preflight, so the
+    codec evidence survives a wedged TPU tunnel."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = _kvquant_bench()
+    print(json.dumps({"kvquant": result}), flush=True)
+
+
 async def _peer_bench() -> dict:
     """Peer-engine KV tier: priced route-vs-migrate vs owner-affinity
     under skewed prefix popularity (docs/35-peer-kv-reuse.md). CPU-only,
@@ -3469,6 +3647,8 @@ def main() -> None:
             _phase_kvflow_main()
         elif phase == "hydration":
             _phase_hydration_main()
+        elif phase == "kvquant":
+            _phase_kvquant_main()
         elif phase == "peer":
             _phase_peer_main()
         elif phase == "fleet":
@@ -3548,6 +3728,15 @@ def main() -> None:
         timeout_s=540, key="hydration", min_needed_s=120.0,
     )
 
+    # -0.013) at-rest KV quantization (docs/38-kv-quantization.md):
+    # int4+scales vs pool-precision at rest on a throttled remote link —
+    # wire-byte reduction, TTFT, exact hydration partition, greedy token
+    # identity — CPU-only, pre-preflight, same wedge-proofing
+    kvquant = _run_phase(
+        "kvquant", ["bench.py", "--phase", "kvquant"],
+        timeout_s=480, key="kvquant", min_needed_s=120.0,
+    )
+
     # -0.0117) peer-engine KV tier (docs/35-peer-kv-reuse.md): priced
     # route-vs-migrate vs owner-affinity under skewed prefix popularity —
     # CPU-only, pre-preflight (fake engines + real router, no chip)
@@ -3583,7 +3772,8 @@ def main() -> None:
         timeout_s=420, key="preflight", min_needed_s=60.0,
     )
     if preflight.get("error"):
-        for section in ("microbench", "livestack", "northstar", "int8_8b"):
+        for section in ("microbench", "livestack", "northstar", "int8_8b",
+                        "int8_8b_kvauto"):
             _emit(section, {"skipped": "chip preflight failed "
                                        "(tunnel wedged or no device)"})
         print(json.dumps({
@@ -3601,6 +3791,7 @@ def main() -> None:
             "saturation": saturation,
             "kvflow": kvflow,
             "hydration": hydration,
+            "kvquant": kvquant,
             "peer": peer,
             "fleet": fleet,
             "fleet_scale": fleet_scale,
@@ -3644,8 +3835,23 @@ def main() -> None:
         ["bench_northstar.py", "--model", "llama-3-8b",
          "--quantization", "int8", "--users", "8", "--rounds", "3",
          "--block-size", "32", "--attention-backend", "pallas",
-         "--prefill-attention-backend", "xla",
+         "--prefill-attention-backend", "xla", "--kv-cache-dtype", "fp8",
          "--num-blocks", "1600", "--max-model-len", "6144"],
+        timeout_s=1000, key="northstar", min_needed_s=300.0,
+    )
+
+    # 3b) the fp8-KV-pool arm's `auto` (bf16-pool) counterpart — the
+    # ROADMAP item-4 datapoint: same 8B workload, pool at bf16, so only
+    # HALF the blocks fit in the same HBM slice (800 x 32 = 25.6k tokens
+    # vs fp8's 51.2k). Reported next to int8_8b: decode tok/s, effective
+    # KV token capacity, and prefix hit rate quantify what fp8 KV buys
+    int8_8b_kvauto = _run_phase(
+        "int8_8b_kvauto",
+        ["bench_northstar.py", "--model", "llama-3-8b",
+         "--quantization", "int8", "--users", "8", "--rounds", "3",
+         "--block-size", "32", "--attention-backend", "pallas",
+         "--prefill-attention-backend", "xla", "--kv-cache-dtype", "auto",
+         "--num-blocks", "800", "--max-model-len", "6144"],
         timeout_s=1000, key="northstar", min_needed_s=300.0,
     )
 
@@ -3669,6 +3875,7 @@ def main() -> None:
         "livestack": livestack,
         "northstar": northstar,
         "int8_8b": int8_8b,
+        "int8_8b_kvauto": int8_8b_kvauto,
         "microbench": micro,
         "routing": routing,
         "robustness": robustness,
@@ -3678,6 +3885,7 @@ def main() -> None:
         "saturation": saturation,
         "kvflow": kvflow,
         "hydration": hydration,
+        "kvquant": kvquant,
         "peer": peer,
         "fleet": fleet,
         "fleet_scale": fleet_scale,

@@ -259,6 +259,9 @@ def run_northstar(
             ), 3,
         ),
         "kv_blocks": kv_blocks,
+        # effective pool capacity in tokens: the fp8-vs-auto KV arm's
+        # headline — same HBM slice, 2x the resident history at fp8
+        "kv_token_capacity": kv_blocks * block_size,
         "kv_dtype": kv_cache_dtype,
         "quantization": quantization,
     }

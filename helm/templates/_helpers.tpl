@@ -135,6 +135,18 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--kv-hydration-timeout-s"
 - {{ .kvHydrationTimeoutS | quote }}
 {{- end }}
+{{- if .kvAtRestCodec }}
+- "--kv-at-rest-codec"
+- {{ .kvAtRestCodec | quote }}
+{{- end }}
+{{- if .kvAtRestGroupSize }}
+- "--kv-at-rest-group-size"
+- {{ .kvAtRestGroupSize | quote }}
+{{- end }}
+{{- if .kvAtRestHostRing }}
+- "--kv-at-rest-host-ring"
+- "true"
+{{- end }}
 {{- if .kvPeerFetch }}
 - "--kv-peer-fetch"
 - "true"

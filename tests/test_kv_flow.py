@@ -272,7 +272,11 @@ def test_disk_tier_records_exact_bytes(tmp_path):
     np.testing.assert_array_equal(got, arr)
     snap = flow.snapshot()
     assert snap["blocks"]["disk/in"] == 1
-    assert snap["bytes"]["disk/in"] == arr.nbytes
+    # WIRE bytes = the whole frame actually read back (symmetric with
+    # store's whole-file accounting); the decoded array is the LOGICAL
+    # side — with no at-rest codec the ratio is pure header overhead
+    assert snap["bytes"]["disk/in"] == tier.total_bytes
+    assert snap["logical_bytes"]["disk/in"] == arr.nbytes
     assert snap["seconds_hist"]["disk/in"]["count"] == 1
 
 

@@ -171,14 +171,25 @@ class LLMEngine:
         # model_fingerprint comment below
         import hashlib
 
+        # at-rest KV codec (docs/38-kv-quantization.md): blocks leaving
+        # the pool for disk/remote/peer (and optionally the host ring)
+        # travel as int4+scales or fp8, dequantized on adopt
+        from .kv_codec import KVAtRestCodec
+
+        self.kv_codec = KVAtRestCodec.from_config(config.cache)
+        fp_parts = (
+            config.model,
+            config.seed,
+            config.cache.resolved_kv_dtype(config.model.dtype),
+        )
+        if self.kv_codec.enabled:
+            # the codec joins the fingerprint so a mixed-precision fleet
+            # can never adopt bytes it would misdecode — appended only
+            # when enabled, keeping existing codec-less disk caches and
+            # remote namespaces valid across the upgrade
+            fp_parts = (*fp_parts, self.kv_codec.spec)
         self.model_fingerprint = hashlib.sha256(
-            repr(
-                (
-                    config.model,
-                    config.seed,
-                    config.cache.resolved_kv_dtype(config.model.dtype),
-                )
-            ).encode()
+            repr(fp_parts).encode()
         ).hexdigest()[:16]
         # KV flow meter (docs/30-kv-flow-telemetry.md): ONE instance shared
         # by every tier object — host ring, disk tier, remote client,
@@ -227,6 +238,7 @@ class LLMEngine:
                 config.cache.remote_kv_url, self.model_fingerprint,
                 flow=self.flow,
                 heartbeat=self.threads.register("kv_writer"),
+                codec=self.kv_codec if self.kv_codec.enabled else None,
             )
             # the remote tier stages through the host ring; give it a
             # minimal ring even when CPU offload wasn't asked for
@@ -240,6 +252,7 @@ class LLMEngine:
                 int(config.cache.disk_kv_gib * 2**30),
                 fingerprint=self.model_fingerprint,
                 flow=self.flow,
+                codec=self.kv_codec if self.kv_codec.enabled else None,
             )
             num_host_blocks = max(num_host_blocks, 16)
         # peer-engine KV tier (docs/35-peer-kv-reuse.md): pull a prefix
@@ -255,6 +268,17 @@ class LLMEngine:
         )
         if peer_enabled:
             num_host_blocks = max(num_host_blocks, 16)
+        encode_ring = (
+            self.kv_codec.enabled and config.cache.kv_at_rest_host_ring
+        )
+        if encode_ring and num_host_blocks > 0:
+            # ring entries are held in wire form, so the same host-RAM
+            # budget buys wire-ratio× more blocks — effective CPU-offload
+            # capacity scales with the codec's compression
+            ratio = self.kv_codec.wire_ratio(
+                config.cache.resolved_kv_dtype(config.model.dtype)
+            )
+            num_host_blocks = int(num_host_blocks * ratio)
         if num_host_blocks > 0:
             from .kv_host_tier import HostKVTier
 
@@ -266,6 +290,8 @@ class LLMEngine:
                 upload_blocks=self.runner.upload_blocks,
                 disk=disk_tier,
                 flow=self.flow,
+                codec=self.kv_codec if self.kv_codec.enabled else None,
+                encode_ring=encode_ring,
             )
         if peer_enabled:
             # lookup/identity wiring mirrors the KV event publisher's
@@ -889,20 +915,26 @@ class LLMEngine:
         )
 
     def kv_bytes_per_token(self) -> float:
-        """Analytic KV bytes per token of this pool (block_bytes /
-        block_size) — the tpu:kv_bytes_per_token gauge the router's
-        route-vs-migrate scoring prices transfers with."""
+        """Analytic KV bytes per token as they'd cross a migration link
+        (block_bytes / block_size, divided by the at-rest codec's wire
+        ratio when one is configured) — the tpu:kv_bytes_per_token gauge
+        the router's route-vs-migrate scoring prices transfers with. WIRE
+        bytes on purpose: a migrate under int4-at-rest moves codec
+        payloads, so pricing logical bytes would overstate its cost and
+        bias the router toward routing."""
         from .memory import kv_block_bytes
 
-        return kv_block_bytes(
+        dtype_name = self.config.cache.resolved_kv_dtype(
+            self.config.model.dtype
+        )
+        logical = kv_block_bytes(
             self.config.model,
             self.config.cache.block_size,
             self.config.parallel.tensor_parallel_size,
             self.config.parallel.pipeline_parallel_size,
-            kv_dtype=self.config.cache.resolved_kv_dtype(
-                self.config.model.dtype
-            ),
+            kv_dtype=dtype_name,
         ) / self.config.cache.block_size
+        return logical / self.kv_codec.wire_ratio(dtype_name)
 
     def has_request(self, request_id: str) -> bool:
         return request_id in self._states
@@ -1461,6 +1493,29 @@ class LLMEngine:
         bw = self.flow.bandwidth_bytes_per_s()
         meas = self.flow.bandwidth_measured()
         sat = self.meter.snapshot()
+        block_bytes = kv_block_bytes(
+            self.config.model,
+            self.config.cache.block_size,
+            self.config.parallel.tensor_parallel_size,
+            self.config.parallel.pipeline_parallel_size,
+            kv_dtype=self.config.cache.resolved_kv_dtype(
+                self.config.model.dtype
+            ),
+        )
+        # per-tier WIRE bytes per block: the encoded tiers (disk/remote/
+        # peer — and the host ring under kv_at_rest_host_ring) move codec
+        # payloads, so the planner must price fetches at compressed size;
+        # this is what shifts recompute→load crossovers under int4
+        wire = block_bytes
+        if self.kv_codec.enabled:
+            wire = self.kv_codec.wire_block_bytes(
+                block_bytes,
+                self.config.cache.resolved_kv_dtype(self.config.model.dtype),
+            )
+        ring_encoded = (
+            self.host_tier is not None
+            and getattr(self.host_tier, "encode_ring", False)
+        )
         return {
             "fetch_bandwidth_bytes_per_s": {
                 tier: bw[(tier, "in")] for tier in TRANSFER_TIERS
@@ -1486,15 +1541,14 @@ class LLMEngine:
             "attn_flops_per_token_ctx": (
                 4.0 * cfg.num_heads * cfg.head_dim * cfg.num_layers
             ),
-            "block_bytes": kv_block_bytes(
-                self.config.model,
-                self.config.cache.block_size,
-                self.config.parallel.tensor_parallel_size,
-                self.config.parallel.pipeline_parallel_size,
-                kv_dtype=self.config.cache.resolved_kv_dtype(
-                    self.config.model.dtype
-                ),
-            ),
+            "block_bytes": block_bytes,
+            "wire_block_bytes": {
+                "hbm": block_bytes,
+                "host": wire if ring_encoded else block_bytes,
+                "disk": wire,
+                "remote": wire,
+                "peer": wire,
+            },
             "block_size_tokens": self.config.cache.block_size,
         }
 

@@ -124,6 +124,12 @@ def plan_decisions(
     # pricing recompute without it biases the split toward compute
     attn_coeff = float(signal.get("attn_flops_per_token_ctx") or 0.0)
     block_bytes = float(signal.get("block_bytes") or 0.0)
+    # per-tier WIRE bytes per block (engine.hydration_signal): with an
+    # at-rest codec the disk/remote/peer hops move int4+scales or fp8
+    # payloads, so a fetch costs codec-compressed bytes — this is exactly
+    # what shifts load/recompute crossovers in the codec's favor. Tiers
+    # absent from the map price at the logical block_bytes.
+    wire_bytes = signal.get("wire_block_bytes") or {}
     block_tokens = int(signal.get("block_size_tokens") or 1)
     bw = signal.get("fetch_bandwidth_bytes_per_s") or {}
     measured = signal.get("fetch_bandwidth_measured") or {}
@@ -151,7 +157,7 @@ def plan_decisions(
                 if tier != "peer":
                     unmeasured_nonpeer = True
                 break
-            cost += block_bytes / rate
+            cost += float(wire_bytes.get(tier) or block_bytes) / rate
         fetch_s.append(cost)
 
     if not forced and unmeasured_nonpeer:

@@ -24,6 +24,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from .kv_codec import decode_block, logical_shape
+
 logger = logging.getLogger(__name__)
 
 _ROOT_HASH = 0x9E3779B97F4A7C15
@@ -288,11 +290,14 @@ class KVBlockPool:
         for i, (h, data) in enumerate(zip(hashes, remote.fetch_run(hashes))):
             if i > 0:
                 self.stats.queries += 1
-            if want is not None and tuple(np.shape(data)) != tuple(want):
+            # geometry check on the LOGICAL shape: at-rest-encoded fetches
+            # arrive as EncodedKVBlock (wire form) and carry their decoded
+            # shape in metadata
+            if want is not None and logical_shape(data) != tuple(want):
                 logger.warning(
                     "remote KV block %x has shape %s, engine needs %s — "
                     "dropping the fetched run (version-skewed store?)",
-                    h, np.shape(data), want,
+                    h, logical_shape(data), want,
                 )
                 break
             blk = self.allocate()  # may evict (offload+write-through) others
@@ -303,9 +308,12 @@ class KVBlockPool:
             return []
         try:
             # one dispatch for the whole fetched run — per-block uploads
-            # cost a device round trip each on high-RTT links
+            # cost a device round trip each on high-RTT links. THIS is the
+            # dequant-on-adopt boundary: encoded blocks decode here, right
+            # before the device upload.
             self.host_tier.upload_many(
-                [blk for _, blk, _ in staged], [d for _, _, d in staged]
+                [blk for _, blk, _ in staged],
+                [decode_block(d) for _, _, d in staged],
             )
         except Exception:
             logger.exception(
@@ -424,7 +432,7 @@ class KVBlockPool:
                 continue
             if data is None or (
                 want is not None
-                and tuple(np.shape(data)) != tuple(want)
+                and logical_shape(data) != tuple(want)
             ):
                 # missing bytes (evicted hbm-tier block) or a version-
                 # skewed remote payload: the chunk cannot adopt
@@ -440,8 +448,12 @@ class KVBlockPool:
         uploads = [(blk, d) for _, blk, d in staged if d is not None]
         if uploads:
             try:
+                # dequant-on-adopt: hydration chunks fetched from remote/
+                # peer tiers land in wire form and decode only here, at
+                # the device-upload boundary
                 self.host_tier.upload_many(
-                    [blk for blk, _ in uploads], [d for _, d in uploads]
+                    [blk for blk, _ in uploads],
+                    [decode_block(d) for _, d in uploads],
                 )
             except Exception:
                 logger.exception(

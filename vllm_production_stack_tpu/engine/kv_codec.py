@@ -1,0 +1,297 @@
+"""At-rest KV codec: int4 + per-group scales / fp8 passthrough.
+
+ROADMAP item 4's byte-path lever: KV blocks leave the HBM pool at pool
+precision today, so every cold-tier hop — disk file, remote kvstore PUT,
+peer `/kv/peer_fetch` — moves full-width bytes, and the hydration planner
+prices those transfers on every compute-or-load crossover. RTP-LLM
+(PAPERS.md) ships quantized KV end-to-end for exactly this reason: offload
+tiers are bandwidth-bound, so shrinking bytes ~3.5-4x shifts planner
+crossovers toward load and multiplies effective tier capacity.
+
+Two codecs, chosen per deployment (`--kv-at-rest-codec`):
+
+- **int4**: symmetric per-group quantization over the flattened block.
+  Each group of `group_size` elements stores one float16 scale
+  (max|x|/7) and packed 4-bit signed codes (two per byte). At the
+  default group of 32 against a 2-byte pool element the wire ratio is
+  2 / (0.5 + 2/32) = ~3.55x. Error is bounded per element by scale/2.
+- **fp8**: cast to float8_e4m3fn (2x vs bf16 pools; a free passthrough
+  when the pool itself is fp8). Cheaper to encode/decode than int4 —
+  the middle setting.
+
+Encoding happens when a block leaves the pool for an at-rest tier
+(disk store, remote writer, peer serving; optionally the host ring).
+Decoding happens at the compute boundary — `pool.adopt_planned_run` /
+`pool._match_remote` dequantize `EncodedKVBlock`s right before the
+device upload — so fetch threads move and land WIRE bytes, never
+logical ones.
+
+The codec is part of the engine's model fingerprint
+(`engine.py` fingerprint tuple): engines with different at-rest codecs
+produce incompatible at-rest bytes, and the fingerprint namespace is
+what keeps a mixed-precision fleet from adopting bytes it would corrupt.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+KV_AT_REST_CODECS = ("none", "fp8", "int4")
+
+# int4 per-group scales travel as float16: 2 bytes per group, enough
+# dynamic range for KV activations and half the overhead of float32
+_SCALE_DTYPE = np.float16
+_SCALE_ITEMSIZE = 2
+
+
+def np_dtype_from_name(name: str) -> np.dtype:
+    """Resolve a dtype NAME from the wire (frame headers, kvstore meta)
+    to a numpy dtype — including the ml_dtypes names (bfloat16,
+    float8_e4m3fn) jax pools use. A name this host cannot resolve raises
+    KVDtypeError (a ValueError): every consumer of tier bytes treats a
+    parse failure as a degraded MISS, so an fp8-tagged frame landing on
+    a host without ml_dtypes becomes a clean cache miss instead of an
+    unhandled TypeError on the step thread."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes  # bfloat16 / float8_e4m3fn (jax dep)
+    except ImportError as e:
+        raise KVDtypeError(
+            f"KV frame dtype {name!r} needs ml_dtypes, which is not "
+            f"importable on this host — degrading to a tier miss"
+        ) from e
+    try:
+        return np.dtype(getattr(ml_dtypes, name))
+    except (AttributeError, TypeError) as e:
+        raise KVDtypeError(
+            f"KV frame carries unknown dtype {name!r} (not a numpy or "
+            f"ml_dtypes name) — degrading to a tier miss"
+        ) from e
+
+
+class KVDtypeError(ValueError):
+    """A tier frame's dtype/codec tag cannot be decoded on this host.
+
+    Subclasses ValueError so every existing degrade-to-miss handler
+    (disk load's broad except, FrameParser.feed_partial's dead-parser
+    error, kv_import's 400 path) already catches it — the point is the
+    MESSAGE names the dtype and the remedy instead of surfacing a bare
+    TypeError from np.dtype()."""
+
+
+@dataclass(frozen=True)
+class EncodedKVBlock:
+    """One KV block in at-rest form: wire payload + enough metadata to
+    reconstruct the logical array. Travels through tier plumbing (disk
+    files, kvstore bodies, peer frames, hydration chunk landings) in
+    place of the logical ndarray — RAM and wire cost is `nbytes`, not
+    `logical_nbytes` — and is decoded at the adopt boundary."""
+
+    codec: str            # "int4" | "fp8"
+    group: int            # int4 group size (0 for fp8)
+    dtype: str            # LOGICAL element dtype name (e.g. "bfloat16")
+    shape: tuple          # LOGICAL shape
+    payload: bytes        # scales || packed codes (int4) / fp8 bytes
+    scale_nbytes: int     # leading payload bytes that are scales
+
+    @property
+    def nbytes(self) -> int:
+        """Wire bytes — what this block costs to store or move."""
+        return len(self.payload)
+
+    @property
+    def logical_nbytes(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        return n * np_dtype_from_name(self.dtype).itemsize
+
+
+def _encode_int4(arr: np.ndarray, group: int) -> tuple[bytes, int]:
+    """(payload, scale_nbytes): float16 per-group scales followed by
+    packed nibbles. The block flattens to 1-D; the last group may be
+    ragged (padded with zeros for the pack, truncated on decode)."""
+    flat = np.ascontiguousarray(arr).astype(np.float32).reshape(-1)
+    ngroups = -(-flat.size // group)
+    pad = ngroups * group - flat.size
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    grouped = flat.reshape(ngroups, group)
+    amax = np.max(np.abs(grouped), axis=1)
+    scale = np.maximum(amax, 1e-8) / 7.0
+    q = np.clip(np.rint(grouped / scale[:, None]), -7, 7).astype(np.int8)
+    nib = (q.reshape(-1) + 8).astype(np.uint8)  # 1..15, unsigned for pack
+    if nib.size % 2:
+        nib = np.append(nib, np.uint8(8))  # dead nibble (code 0)
+    packed = (nib[0::2] << 4) | nib[1::2]
+    scales = scale.astype(_SCALE_DTYPE)
+    return scales.tobytes() + packed.tobytes(), scales.nbytes
+
+
+def _decode_int4(
+    payload: bytes, scale_nbytes: int, group: int,
+    dtype: str, shape: tuple,
+) -> np.ndarray:
+    scales = np.frombuffer(payload[:scale_nbytes], dtype=_SCALE_DTYPE)
+    packed = np.frombuffer(payload[scale_nbytes:], dtype=np.uint8)
+    nib = np.empty(packed.size * 2, dtype=np.uint8)
+    nib[0::2] = packed >> 4
+    nib[1::2] = packed & 0x0F
+    q = nib.astype(np.int8) - 8
+    n = 1
+    for d in shape:
+        n *= int(d)
+    ngroups = len(scales)
+    total = ngroups * group
+    if q.size < total or total < n:
+        raise ValueError(
+            f"int4 payload holds {q.size} codes for {ngroups} groups of "
+            f"{group} covering {n} elements — corrupt at-rest block"
+        )
+    vals = (
+        q[:total].astype(np.float32).reshape(ngroups, group)
+        * scales.astype(np.float32)[:, None]
+    ).reshape(-1)[:n]
+    return vals.astype(np_dtype_from_name(dtype)).reshape(shape)
+
+
+def _encode_fp8(arr: np.ndarray) -> bytes:
+    import ml_dtypes
+
+    return (
+        np.ascontiguousarray(arr)
+        .astype(ml_dtypes.float8_e4m3fn)
+        .tobytes()
+    )
+
+
+def _decode_fp8(payload: bytes, dtype: str, shape: tuple) -> np.ndarray:
+    fp8 = np_dtype_from_name("float8_e4m3fn")
+    arr = np.frombuffer(payload, dtype=fp8)
+    return arr.astype(np_dtype_from_name(dtype)).reshape(shape)
+
+
+def decode_payload(
+    codec: str, group: int, dtype: str, shape, payload: bytes,
+    scale_nbytes: int = 0,
+) -> np.ndarray:
+    """Decode wire payload bytes back to the logical array — the shared
+    primitive behind FrameParser (frames tagged with codec metadata) and
+    decode_block. Any host can decode any codec; only np_dtype_from_name
+    can fail (KVDtypeError → degraded miss)."""
+    shape = tuple(int(d) for d in shape)
+    if codec == "int4":
+        return _decode_int4(payload, scale_nbytes, group, dtype, shape)
+    if codec == "fp8":
+        return _decode_fp8(payload, dtype, shape)
+    raise KVDtypeError(
+        f"KV frame carries unknown at-rest codec {codec!r} "
+        f"(known: {KV_AT_REST_CODECS[1:]}) — degrading to a tier miss"
+    )
+
+
+def decode_block(obj) -> np.ndarray:
+    """Logical array out of an at-rest object: EncodedKVBlock → decode,
+    ndarray → passthrough. The adopt-boundary call."""
+    if isinstance(obj, EncodedKVBlock):
+        return decode_payload(
+            obj.codec, obj.group, obj.dtype, obj.shape, obj.payload,
+            obj.scale_nbytes,
+        )
+    return obj
+
+
+def logical_shape(obj) -> tuple:
+    """Geometry of the DECODED block — what pool shape validation must
+    compare against, whether the tier handed back wire or logical form."""
+    if isinstance(obj, EncodedKVBlock):
+        return tuple(obj.shape)
+    return tuple(np.shape(obj))
+
+
+def wire_nbytes(obj) -> int:
+    return obj.nbytes
+
+
+def logical_nbytes(obj) -> int:
+    if isinstance(obj, EncodedKVBlock):
+        return obj.logical_nbytes
+    return obj.nbytes
+
+
+class KVAtRestCodec:
+    """The per-engine at-rest codec, built once from CacheConfig and
+    handed to every tier that writes pool bytes out (disk store, remote
+    writer, peer serving, host ring when enabled)."""
+
+    def __init__(self, kind: str = "none", group_size: int = 32):
+        if kind not in KV_AT_REST_CODECS:
+            raise ValueError(
+                f"unknown kv_at_rest_codec {kind!r} "
+                f"(choices: {KV_AT_REST_CODECS})"
+            )
+        if kind == "int4" and group_size < 1:
+            raise ValueError(
+                f"kv_at_rest_group_size must be >= 1, got {group_size}"
+            )
+        self.kind = kind
+        self.group = int(group_size) if kind == "int4" else 0
+
+    @classmethod
+    def from_config(cls, cache_cfg) -> "KVAtRestCodec":
+        return cls(
+            getattr(cache_cfg, "kv_at_rest_codec", "none"),
+            getattr(cache_cfg, "kv_at_rest_group_size", 32),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.kind != "none"
+
+    @property
+    def spec(self) -> str:
+        """Fingerprint component: engines whose at-rest bytes are not
+        interchangeable MUST produce different specs (group size changes
+        the scale layout, so it is part of the spec)."""
+        if self.kind == "int4":
+            return f"int4g{self.group}"
+        return self.kind
+
+    def encode(self, arr: np.ndarray):
+        """ndarray → EncodedKVBlock (or passthrough when disabled)."""
+        if self.kind == "none":
+            return arr
+        shape = tuple(int(d) for d in arr.shape)
+        if self.kind == "int4":
+            payload, scale_nbytes = _encode_int4(arr, self.group)
+            return EncodedKVBlock(
+                "int4", self.group, arr.dtype.name, shape, payload,
+                scale_nbytes,
+            )
+        return EncodedKVBlock(
+            "fp8", 0, arr.dtype.name, shape, _encode_fp8(arr), 0
+        )
+
+    def wire_ratio(self, dtype_name: str) -> float:
+        """Analytic logical/wire compression ratio for a pool element
+        dtype — the hydration planner and kv_bytes_per_token price
+        transfers with this BEFORE any block has moved (measured ratios
+        then show up in the tpu:kv_tier_compression_ratio gauge)."""
+        itemsize = np_dtype_from_name(dtype_name).itemsize
+        if self.kind == "fp8":
+            return float(itemsize)  # 1 byte/elem at rest
+        if self.kind == "int4":
+            # 0.5 byte/elem of codes + one 2-byte scale per group
+            return itemsize / (0.5 + _SCALE_ITEMSIZE / self.group)
+        return 1.0
+
+    def wire_block_bytes(self, logical_bytes: int, dtype_name: str) -> int:
+        return max(1, round(logical_bytes / self.wire_ratio(dtype_name)))
+
+
+NO_CODEC = KVAtRestCodec("none")

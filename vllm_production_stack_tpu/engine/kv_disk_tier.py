@@ -54,10 +54,15 @@ class DiskKVTier:
     SUFFIX = ".kvb"
 
     def __init__(self, directory: str, max_bytes: int, fingerprint: str = "",
-                 flow=None):
+                 flow=None, codec=None):
         self.dir = os.path.join(directory, fingerprint or "default")
         os.makedirs(self.dir, exist_ok=True)
         self.max_bytes = max_bytes
+        # at-rest codec (engine/kv_codec.KVAtRestCodec): files persist in
+        # wire form (int4+scales / fp8) and decode on load. The
+        # fingerprint the directory is namespaced by includes the codec
+        # spec, so a codec change never reads incompatible bytes.
+        self.codec = codec
         self.stats = DiskTierStats()
         # loads may run on the hydration fetcher thread concurrently with
         # step-thread stores/evictions (docs/31-hydration-planner.md) —
@@ -113,14 +118,22 @@ class DiskKVTier:
         with self._mu:
             if self.max_bytes <= 0 or h in self._index:
                 return
-        from .kv_transfer import raw_frame
+        from .kv_codec import EncodedKVBlock, logical_nbytes
+        from .kv_transfer import encoded_frame
 
         path = self._path(h)
         tmp = f"{path}.tmp{os.getpid()}"
-        payload = raw_frame(
-            h, np.ascontiguousarray(arr).tobytes(), arr.dtype.name,
-            list(arr.shape),
-        )
+        # encode to at-rest form unless the caller already did (a ring-
+        # encoded eviction flows through without a decode+re-encode)
+        obj = arr
+        if (
+            self.codec is not None
+            and self.codec.enabled
+            and not isinstance(arr, EncodedKVBlock)
+        ):
+            obj = self.codec.encode(arr)
+        payload = encoded_frame(h, obj)
+        logical = logical_nbytes(obj)
         t0 = time.perf_counter()
         try:
             with open(tmp, "wb") as f:
@@ -139,7 +152,8 @@ class DiskKVTier:
                 pass
             return
         self.flow.record(
-            "disk", "out", len(payload), 1, time.perf_counter() - t0
+            "disk", "out", len(payload), 1, time.perf_counter() - t0,
+            logical_nbytes=logical,
         )
         with self._mu:
             self._index[h] = len(payload)
@@ -169,7 +183,11 @@ class DiskKVTier:
         t0 = time.perf_counter()
         try:
             with open(self._path(h), "rb") as f:
-                frames = FrameParser().feed(f.read())
+                data = f.read()
+            # the parser dequantizes codec-tagged frames (at-rest files
+            # land as logical arrays here — disk is a local hop, the RAM
+            # saving of deferred decode doesn't apply)
+            frames = FrameParser().feed(data)
             if not frames or frames[0][0] != h:
                 raise ValueError("truncated or mismatched block frame")
             arr = frames[0][1]
@@ -194,8 +212,11 @@ class DiskKVTier:
                 "disk", "in", 0, 0, time.perf_counter() - t0
             )
             return None
+        # wire bytes = the file that was actually read (mirrors store's
+        # whole-frame accounting); logical = the decoded array
         self.flow.record(
-            "disk", "in", arr.nbytes, 1, time.perf_counter() - t0
+            "disk", "in", len(data), 1, time.perf_counter() - t0,
+            logical_nbytes=arr.nbytes,
         )
         with self._mu:
             self.stats.loads += 1

@@ -149,6 +149,11 @@ class KVFlowMeter:
         self._null = _null
         self._lock = threading.Lock()
         self.bytes: dict[tuple[str, str], int] = {}
+        # LOGICAL (decoded) bytes per key: equals `bytes` on uncompressed
+        # tiers; under an at-rest codec (kv_codec) `bytes` holds WIRE
+        # bytes and logical/wire is the tier's measured compression ratio
+        # (tpu:kv_tier_compression_ratio)
+        self.logical_bytes: dict[tuple[str, str], int] = {}
         self.blocks: dict[tuple[str, str], int] = {}
         self.transfers: dict[tuple[str, str], int] = {}
         self.seconds: dict[tuple[str, str], _Hist] = {}
@@ -157,6 +162,7 @@ class KVFlowMeter:
             for direction in DIRECTIONS:
                 key = (tier, direction)
                 self.bytes[key] = 0
+                self.logical_bytes[key] = 0
                 self.blocks[key] = 0
                 self.transfers[key] = 0
                 self.seconds[key] = _Hist(TRANSFER_SECONDS_BUCKETS)
@@ -177,10 +183,16 @@ class KVFlowMeter:
 
     def record(
         self, tier: str, direction: str, nbytes: int, blocks: int,
-        seconds: float,
+        seconds: float, logical_nbytes: int | None = None,
     ) -> None:
         """One transfer batch: `blocks` KV blocks totalling `nbytes` moved
-        in `seconds` of wall time. A FAILED transfer should still be
+        in `seconds` of wall time. `nbytes` is always WIRE bytes — what
+        actually crossed the link or hit the disk — so the TierBandwidth
+        estimators (and therefore the hydration planner) price the tier
+        as it performs under the at-rest codec. `logical_nbytes` is the
+        decoded size of the same batch (defaults to `nbytes` for
+        uncompressed hops); the logical/wire quotient is the tier's
+        compression-ratio gauge. A FAILED transfer should still be
         recorded with whatever partial batch completed (possibly 0 bytes)
         — the elapsed time is real, and losing it would overstate the
         tier's bandwidth exactly when the planner most needs the truth.
@@ -201,6 +213,9 @@ class KVFlowMeter:
             if not self.enabled:
                 return
             self.bytes[key] += int(nbytes)
+            self.logical_bytes[key] += int(
+                nbytes if logical_nbytes is None else logical_nbytes
+            )
             self.blocks[key] += int(blocks)
             self.transfers[key] += 1
             self.seconds[key].observe(seconds)
@@ -250,6 +265,19 @@ class KVFlowMeter:
             return {
                 "enabled": self.enabled,
                 "bytes": {f"{t}/{d}": v for (t, d), v in self.bytes.items()},
+                "logical_bytes": {
+                    f"{t}/{d}": v
+                    for (t, d), v in self.logical_bytes.items()
+                },
+                # measured logical/wire ratio per key (1.0 until bytes
+                # move — a ratio gauge that reads 0 would look like
+                # infinite compression on dashboards)
+                "compression_ratio": {
+                    f"{t}/{d}": (
+                        self.logical_bytes[(t, d)] / v if v > 0 else 1.0
+                    )
+                    for (t, d), v in self.bytes.items()
+                },
                 "blocks": {
                     f"{t}/{d}": v for (t, d), v in self.blocks.items()
                 },

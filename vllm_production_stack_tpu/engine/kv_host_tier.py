@@ -21,7 +21,15 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from .kv_codec import EncodedKVBlock, decode_block, wire_nbytes
 from .kv_flow import NULL_FLOW
+
+
+def _is_resolved(entry) -> bool:
+    """Ring entries are either resolved host bytes (ndarray, or
+    EncodedKVBlock when the ring itself is held at rest) or pending
+    device parts still in flight from the HBM→host copy."""
+    return isinstance(entry, (np.ndarray, EncodedKVBlock))
 
 
 @dataclass
@@ -38,8 +46,18 @@ class HostKVTier:
     `reload_into` from prefix matching."""
 
     def __init__(self, num_blocks: int, fetch_block, upload_block,
-                 remote=None, upload_blocks=None, disk=None, flow=None):
+                 remote=None, upload_blocks=None, disk=None, flow=None,
+                 codec=None, encode_ring=False):
         self.num_blocks = num_blocks
+        # at-rest codec (engine/kv_codec.KVAtRestCodec). encode_ring=True
+        # holds RING entries encoded too (cache.kv_at_rest_host_ring):
+        # resolved offloads encode once, and disk/remote write-through
+        # reuses the encoded form — the ring's block budget then buys
+        # wire-ratio-times more blocks (engine.py scales num_host_blocks)
+        self.codec = codec
+        self.encode_ring = bool(
+            encode_ring and codec is not None and codec.enabled
+        )
         # KV flow meter (engine/kv_flow.py): tier moves record bytes/
         # blocks/latency here; NULL_FLOW no-ops when metering is off or
         # the tier is constructed standalone
@@ -69,21 +87,29 @@ class HostKVTier:
         self.on_drop = None
         self.stats = HostTierStats()
 
-    def _resolve(self, h: int) -> np.ndarray | None:
+    def _resolve(self, h: int):
+        """The ring's RESOLVED entry for h (ndarray, or EncodedKVBlock
+        under encode_ring) — materializing the device→host copy and
+        encoding/writing-through on first touch."""
         entry = self._data.get(h)
         if entry is None:
             return None
-        if not isinstance(entry, np.ndarray):
+        if not _is_resolved(entry):
             # the HBM→host hop materializes HERE: np.asarray blocks until
             # the async device→host copy lands, then the stack builds the
             # block's host bytes — the honest wall cost of the offload
             t0 = time.perf_counter()
-            entry = np.stack([np.asarray(p) for p in entry])
+            arr = np.stack([np.asarray(p) for p in entry])
+            entry = self.codec.encode(arr) if self.encode_ring else arr
             self.flow.record(
-                "host", "out", entry.nbytes, 1, time.perf_counter() - t0
+                "host", "out", wire_nbytes(entry), 1,
+                time.perf_counter() - t0, logical_nbytes=arr.nbytes,
             )
             self._data[h] = entry
             if self.remote is not None:
+                # write through in whatever form the ring holds — the
+                # remote writer ships encoded entries as-is (no
+                # decode+re-encode round trip)
                 self.remote.put_async(h, entry)
         return entry
 
@@ -113,10 +139,13 @@ class HostKVTier:
         return ""
 
     def peek_bytes(self, h: int):
-        """Resolved host-RAM bytes for a ring-resident hash, or None.
-        STEP THREAD ONLY (mutates the ring's pending/entry state) — the
-        hydrator pre-resolves ring blocks here at plan launch so its
-        fetcher thread never touches the ring."""
+        """Resolved host-RAM bytes for a ring-resident hash, or None —
+        an ndarray, or EncodedKVBlock under encode_ring (both downstream
+        consumers cope: adopt_planned_run dequantizes on adopt, the peer
+        serving path frames the encoded form directly). STEP THREAD ONLY
+        (mutates the ring's pending/entry state) — the hydrator
+        pre-resolves ring blocks here at plan launch so its fetcher
+        thread never touches the ring."""
         return self._resolve(h) if h in self._data else None
 
     def __len__(self) -> int:
@@ -159,14 +188,16 @@ class HostKVTier:
             if evicted in self._pending:
                 self._pending.remove(evicted)
             need_bytes = self.disk is not None or (
-                self.remote is not None and not isinstance(entry, np.ndarray)
+                self.remote is not None and not _is_resolved(entry)
             )
-            if need_bytes and not isinstance(entry, np.ndarray):
-                entry = np.stack([np.asarray(p) for p in entry])
+            if need_bytes and not _is_resolved(entry):
+                arr = np.stack([np.asarray(p) for p in entry])
+                entry = self.codec.encode(arr) if self.encode_ring else arr
             if self.disk is not None:
                 # ring → disk: the evicted block stays reloadable locally
+                # (an encoded entry flows to disk in wire form as-is)
                 self.disk.store(evicted, entry)
-            if self.remote is not None and isinstance(entry, np.ndarray):
+            if self.remote is not None and _is_resolved(entry):
                 # an entry evicted before it was ever resolved hasn't been
                 # written through yet — push now, or the remote tier
                 # silently misses exactly the blocks that fell off (the
@@ -197,10 +228,15 @@ class HostKVTier:
             if h in self._pending:
                 self._pending.remove(h)
             self._data.move_to_end(h)
+        wire = wire_nbytes(data)
         t0 = time.perf_counter()
-        self._upload(device_block, data)
+        # dequant at the device boundary: a ring-encoded entry decodes
+        # here, right before the upload (the ring keeps the wire form)
+        arr = decode_block(data)
+        self._upload(device_block, arr)
         self.flow.record(
-            "host", "in", data.nbytes, 1, time.perf_counter() - t0
+            "host", "in", wire, 1, time.perf_counter() - t0,
+            logical_nbytes=arr.nbytes,
         )
         self.stats.reloads += 1
         return source
@@ -234,11 +270,19 @@ class HostKVTier:
             time.perf_counter() - t0,
         )
 
-    def insert_resolved(self, h: int, data: np.ndarray) -> None:
-        """Promote a remote-fetched block into the ring so the next match is
+    def insert_resolved(self, h: int, data) -> None:
+        """Promote a fetched block into the ring so the next match is
         local. Budget enforced; no write-through needed (the remote tier's
-        dedupe set already knows h)."""
+        dedupe set already knows h). Accepts either form and normalizes
+        to the ring's configured one: encode_ring rings hold wire form
+        (an already-encoded fetch inserts with NO transcode), plain rings
+        hold the logical array."""
         if self.num_blocks == 0 or h in self._data:
             return
+        if self.encode_ring:
+            if not isinstance(data, EncodedKVBlock):
+                data = self.codec.encode(data)
+        elif isinstance(data, EncodedKVBlock):
+            data = decode_block(data)
         self._data[h] = data
         self._evict_to_budget()

@@ -251,15 +251,17 @@ class PeerKVTier:
 
     def fetch_run(
         self, owner: str, hashes: list[int], conn=None, bootstrap: bool = False,
-    ) -> list[np.ndarray]:
-        """The consecutive prefix of `hashes` the owner served, as arrays —
-        one batched round trip over `conn` (or a throwaway connection).
-        Every round trip records under (peer, in): payload bytes on
+    ) -> list:
+        """The consecutive prefix of `hashes` the owner served — plain
+        frames as arrays, at-rest frames (the owner runs a kv_codec) as
+        EncodedKVBlock dequantized at the pool's adopt boundary — one
+        batched round trip over `conn` (or a throwaway connection).
+        Every round trip records under (peer, in): WIRE payload bytes on
         success, 0 bytes + real elapsed on failure, so the TierBandwidth
-        estimate the planner prices against tracks the truth. `bootstrap`
-        marks measurement-only fetches (docs/35-peer-kv-reuse.md — how the
-        peer tier crosses the sample floor with no sync fallback to feed
-        it)."""
+        estimate the planner prices against tracks the link as the codec
+        actually uses it. `bootstrap` marks measurement-only fetches
+        (docs/35-peer-kv-reuse.md — how the peer tier crosses the sample
+        floor with no sync fallback to feed it)."""
         owner = owner.rstrip("/")
         if not owner or not hashes or not self._available(owner):
             return []
@@ -269,11 +271,12 @@ class PeerKVTier:
         if own_conn:
             conn = self.new_fetch_conn(owner)
         t0 = time.perf_counter()
-        out: list[np.ndarray] = []
+        out: list = []
 
-        def _flow(nbytes: int) -> None:
+        def _flow(nbytes: int, logical: int | None = None) -> None:
             self.flow.record(
-                "peer", "in", nbytes, len(out), time.perf_counter() - t0
+                "peer", "in", nbytes, len(out), time.perf_counter() - t0,
+                logical_nbytes=logical,
             )
 
         body = json.dumps({
@@ -299,15 +302,19 @@ class PeerKVTier:
             self.stats.bootstrap_fetches += 1
         else:
             self.stats.fetches += 1
-        parser = FrameParser()
+        # decode_codec=False: dequant happens at the adopt boundary, and
+        # the fetcher holds wire-size RAM while chunks await adoption
+        parser = FrameParser(decode_codec=False)
         for h, arr in parser.feed_partial(payload):
             if len(out) >= len(hashes) or h != hashes[len(out)]:
                 break  # non-consecutive frame; stop clean
             # copy: a frombuffer view would pin the whole multi-block
             # response buffer for as long as any one block stays adopted
-            out.append(arr.copy())
+            # (EncodedKVBlock payloads are already-detached bytes)
+            out.append(arr.copy() if isinstance(arr, np.ndarray) else arr)
         self.stats.fetched_blocks += len(out)
-        _flow(sum(a.nbytes for a in out))
+        meta = parser.frame_meta[: len(out)]
+        _flow(sum(w for w, _ in meta), sum(lg for _, lg in meta))
         if parser.error is not None:
             logger.warning(
                 "malformed peer_fetch response from %s after %d valid "

@@ -9,9 +9,10 @@ pairs from the prefill engine's pool and adopting them into the decode
 engine's pool — after which the decode request is an ordinary 100% prefix
 hit.
 
-Transports: this module defines the wire format (npz: hashes as uint64
-hi/lo pairs + one stacked page tensor) served over the engines' HTTP
-surface (/kv/export, /kv/import, /kv/pull). On multi-slice TPU deployments
+Transports: this module defines the wire format (self-delimiting
+dtype-tagged frames — the same framing the kvstore and peer paths use)
+served over the engines' HTTP surface (/kv/export, /kv/import, /kv/pull).
+On multi-slice TPU deployments
 the same export/adopt protocol can ride jax device-to-device transfers over
 ICI instead of host-staged HTTP — the pool-side bookkeeping (this module)
 is transport-agnostic, exactly like the reference's NIXL sender/receiver
@@ -26,18 +27,15 @@ import struct
 
 import numpy as np
 
+from .kv_codec import (  # noqa: F401  (np_dtype_from_name re-exported)
+    EncodedKVBlock,
+    KVDtypeError,
+    decode_payload,
+    np_dtype_from_name,
+)
 from ..utils.logging import init_logger
 
 logger = init_logger(__name__)
-
-
-def np_dtype_from_name(name: str) -> np.dtype:
-    try:
-        return np.dtype(name)
-    except TypeError:
-        import ml_dtypes  # bfloat16 / float8_e4m3fn (jax dep, always present)
-
-        return np.dtype(getattr(ml_dtypes, name))
 
 
 # -- streaming wire format ---------------------------------------------------
@@ -51,16 +49,27 @@ def np_dtype_from_name(name: str) -> np.dtype:
 # ever materializing the full tensor.
 
 
-def raw_frame(h, raw: bytes, dtype_name: str, shape: list[int]) -> bytes:
+def raw_frame(
+    h, raw: bytes, dtype_name: str, shape: list[int],
+    codec: str = "", group: int = 0, scale_nbytes: int = 0,
+) -> bytes:
     """Frame pre-serialized block bytes (the kvstore server streams stored
-    payloads without reconstructing arrays)."""
-    head = json.dumps({
+    payloads without reconstructing arrays). `dtype`/`shape` are always the
+    LOGICAL geometry; when `codec` is set the payload is at-rest encoded
+    (int4 scales||codes or fp8 bytes) and the extra header fields carry
+    what FrameParser needs to dequantize it."""
+    head = {
         "hash": str(h),
         "dtype": dtype_name,
         "shape": list(shape),
         "nbytes": len(raw),
-    }).encode()
-    return struct.pack("<I", len(head)) + head + raw
+    }
+    if codec:
+        head["codec"] = codec
+        head["group"] = int(group)
+        head["scale_nbytes"] = int(scale_nbytes)
+    head_b = json.dumps(head).encode()
+    return struct.pack("<I", len(head_b)) + head_b + raw
 
 
 def block_frame(h: int, arr: np.ndarray) -> bytes:
@@ -68,6 +77,19 @@ def block_frame(h: int, arr: np.ndarray) -> bytes:
     tobytes copy — no npz container, no re-stacking)."""
     view = np.ascontiguousarray(arr)
     return raw_frame(h, view.tobytes(), arr.dtype.name, list(arr.shape))
+
+
+def encoded_frame(h: int, obj) -> bytes:
+    """One streamed at-rest block: EncodedKVBlock frames carry their codec
+    metadata, plain ndarrays degrade to block_frame — tier writers call
+    this with whatever form the block is in (a ring-encoded block flows to
+    disk/remote WITHOUT a decode+re-encode round trip)."""
+    if isinstance(obj, EncodedKVBlock):
+        return raw_frame(
+            h, obj.payload, obj.dtype, list(obj.shape),
+            codec=obj.codec, group=obj.group, scale_nbytes=obj.scale_nbytes,
+        )
+    return block_frame(h, obj)
 
 
 class FrameParser:
@@ -80,12 +102,23 @@ class FrameParser:
     a huge frame fails fast with ValueError instead of making the receiver
     buffer the entire remaining response as residual bytes."""
 
-    def __init__(self, max_frame_bytes: int = 256 << 20):
+    def __init__(
+        self, max_frame_bytes: int = 256 << 20, decode_codec: bool = True
+    ):
         self._buf = bytearray()
         self.max_frame_bytes = max_frame_bytes
         # first parse failure in partial mode (feed_partial); once set,
         # the parser is dead — further feeds return nothing
         self.error: Exception | None = None
+        # decode_codec=True (default): codec-tagged frames dequantize to
+        # logical arrays right here, so every legacy consumer keeps
+        # seeing ndarrays. False: they come back as EncodedKVBlock and
+        # the dequant is deferred to the pool's adopt boundary (the
+        # fetch paths use this — landed chunks hold WIRE bytes in RAM).
+        self.decode_codec = decode_codec
+        # (wire payload bytes, logical bytes) per yielded frame, in
+        # yield order — the flow meter's wire-vs-logical accounting
+        self.frame_meta: list[tuple[int, int]] = []
 
     def _next_frame(self) -> tuple[int, np.ndarray] | None:
         """Parse ONE complete frame off the buffer, None if the buffered
@@ -112,9 +145,27 @@ class FrameParser:
             return None
         raw = bytes(self._buf[4 + head_len : total])
         del self._buf[:total]
+        codec = head.get("codec", "")
+        if codec:
+            enc = EncodedKVBlock(
+                codec, int(head.get("group", 0)), head["dtype"],
+                tuple(int(d) for d in head["shape"]), raw,
+                int(head.get("scale_nbytes", 0)),
+            )
+            # resolve the logical dtype NOW even on the deferred path: a
+            # frame this host can't decode must die in the parser (clean
+            # degraded miss) rather than at adopt time on the step thread
+            self.frame_meta.append((len(raw), enc.logical_nbytes))
+            if self.decode_codec:
+                return (int(head["hash"]), decode_payload(
+                    codec, enc.group, enc.dtype, enc.shape, raw,
+                    enc.scale_nbytes,
+                ))
+            return (int(head["hash"]), enc)
         arr = np.frombuffer(
             raw, dtype=np_dtype_from_name(head["dtype"])
         ).reshape(head["shape"])
+        self.frame_meta.append((len(raw), arr.nbytes))
         return (int(head["hash"]), arr)
 
     def feed(self, data: bytes) -> list[tuple[int, np.ndarray]]:
@@ -154,42 +205,46 @@ class FrameParser:
 def serialize_blocks(
     hashes: list[int], blocks: np.ndarray, fingerprint: str = ""
 ) -> bytes:
-    """npz payload: N 128-bit chain hashes (as (N, 2) uint64 hi/lo), the
-    stacked page tensor (N, L, 2, block_size, kvH, D), and the sender's
-    model fingerprint."""
-    hi_lo = np.array(
-        [(h >> 64, h & 0xFFFFFFFFFFFFFFFF) for h in hashes], dtype=np.uint64
-    ).reshape(-1, 2)
-    buf = io.BytesIO()
-    # ml_dtypes (bf16, fp8 pools) aren't npz-portable everywhere; ship as
-    # same-width unsigned bit patterns and re-view on the other side
-    if blocks.dtype.name == "bfloat16":
-        view = blocks.view(np.uint16)
-    elif blocks.dtype.name == "float8_e4m3fn":
-        view = blocks.view(np.uint8)
-    else:
-        view = blocks
-    np.savez(
-        buf, hashes=hi_lo, blocks=view, dtype=np.array(blocks.dtype.name),
-        fingerprint=np.array(fingerprint),
-    )
-    return buf.getvalue()
+    """One-shot export payload (/kv/export → /kv/import): a JSON manifest
+    frame {fingerprint, count} followed by one dtype-tagged block frame
+    per hash — the SAME framing the kvstore/mget/peer paths speak, so
+    ml_dtypes pools (bf16, fp8) ship natively instead of through the old
+    npz detour's uint bit-pattern views (npz can't carry ml_dtypes)."""
+    manifest = json.dumps({
+        "fingerprint": fingerprint, "count": len(hashes),
+    }).encode()
+    frames = [struct.pack("<I", len(manifest)) + manifest]
+    frames.extend(block_frame(h, arr) for h, arr in zip(hashes, blocks))
+    return b"".join(frames)
 
 
 def deserialize_blocks(payload: bytes) -> tuple[list[int], np.ndarray, str]:
+    if payload[:2] == b"PK":  # legacy npz export from a pre-frame sender
+        return _deserialize_blocks_npz(payload)
+    head_len = struct.unpack_from("<I", payload)[0]
+    manifest = json.loads(payload[4 : 4 + head_len])
+    frames = FrameParser().feed(payload[4 + head_len:])
+    if len(frames) != int(manifest.get("count", len(frames))):
+        raise ValueError(
+            f"KV export payload truncated: manifest promises "
+            f"{manifest.get('count')} blocks, parsed {len(frames)}"
+        )
+    hashes = [h for h, _ in frames]
+    if not frames:
+        return [], np.empty((0,)), str(manifest.get("fingerprint", ""))
+    blocks = np.stack([arr for _, arr in frames])
+    return hashes, blocks, str(manifest.get("fingerprint", ""))
+
+
+def _deserialize_blocks_npz(payload: bytes):
+    """Read the pre-frame npz export format (rolling-upgrade peers)."""
     with np.load(io.BytesIO(payload)) as z:
         hi_lo = z["hashes"]
         blocks = z["blocks"]
         dtype = str(z["dtype"])
         fingerprint = str(z["fingerprint"]) if "fingerprint" in z else ""
-    if dtype == "bfloat16":
-        import ml_dtypes
-
-        blocks = blocks.view(ml_dtypes.bfloat16)
-    elif dtype == "float8_e4m3fn":
-        import ml_dtypes
-
-        blocks = blocks.view(ml_dtypes.float8_e4m3fn)
+    if dtype in ("bfloat16", "float8_e4m3fn"):
+        blocks = blocks.view(np_dtype_from_name(dtype))
     hashes = [int(hi) << 64 | int(lo) for hi, lo in hi_lo]
     return hashes, blocks, fingerprint
 

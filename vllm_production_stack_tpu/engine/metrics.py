@@ -323,6 +323,20 @@ class EngineMetrics:
             mc.KV_TRANSFER_BLOCKS,
             "KV blocks moved between tiers, by tier and direction",
         )
+        self.kv_transfer_logical_bytes = fcounter(
+            mc.KV_TRANSFER_LOGICAL_BYTES,
+            "Logical (decoded) bytes the tier transfers represent — "
+            "kv_transfer_bytes counts WIRE bytes, so with an at-rest KV "
+            "codec (docs/38-kv-quantization.md) this series is larger by "
+            "the compression ratio; identical without one",
+        )
+        self.kv_tier_compression = Gauge(
+            mc.KV_TIER_COMPRESSION_RATIO,
+            "At-rest KV codec effectiveness per (tier, direction): "
+            "logical bytes / wire bytes moved (1.0 with no codec)",
+            flabels,
+            registry=self.registry,
+        )
         self.kv_tier_bandwidth = Gauge(
             mc.KV_TIER_BANDWIDTH,
             "Recent-mean transfer bandwidth per (tier, direction) — the "
@@ -370,6 +384,8 @@ class EngineMetrics:
                 fl = {**self._labels, "tier": tier, "direction": direction}
                 self.kv_transfer_bytes.labels(**fl)
                 self.kv_transfer_blocks.labels(**fl)
+                self.kv_transfer_logical_bytes.labels(**fl)
+                self.kv_tier_compression.labels(**fl).set(1.0)
                 self.kv_tier_bandwidth.labels(**fl)
         for source in HYDRATION_SOURCES:
             self.prefix_tokens.labels(**self._labels, source=source)
@@ -639,6 +655,8 @@ class EngineMetrics:
         self.kv_flow = flow  # histogram collector reads this at scrape
         fbytes = flow.get("bytes") or {}
         fblocks = flow.get("blocks") or {}
+        flogical = flow.get("logical_bytes") or {}
+        fratio = flow.get("compression_ratio") or {}
         fbw = flow.get("bandwidth_bytes_per_s") or {}
         fmeas = flow.get("bandwidth_measured") or {}
         for tier in TRANSFER_TIERS:
@@ -652,6 +670,15 @@ class EngineMetrics:
                 self._bump_labeled(
                     self.kv_transfer_blocks, f"kvn:{key}",
                     int(fblocks.get(key, 0)), fl,
+                )
+                self._bump_labeled(
+                    self.kv_transfer_logical_bytes, f"kvl:{key}",
+                    int(flogical.get(key, 0)), fl,
+                )
+                # logical/wire over the whole run (1.0 with no codec or no
+                # bytes) — the at-rest codec's effectiveness gauge
+                self.kv_tier_compression.labels(**fl).set(
+                    fratio.get(key, 1.0)
                 )
                 # gauge gated on the TierBandwidth sample floor: below it
                 # the estimate is one tiny transfer's noise, and scrapers

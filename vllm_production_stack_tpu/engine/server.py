@@ -1794,8 +1794,12 @@ class EngineServer:
         store and the PD stream speak). The engine lock is held only for
         the residency walk + device-copy dispatch; numpy resolution, disk
         reads and framing run in an executor, and the served bytes meter
-        under (tier="peer", direction="out")."""
-        from .kv_transfer import block_frame
+        under (tier="peer", direction="out"). With an at-rest codec the
+        peer link ships WIRE form (int4+scales / fp8): ring entries held
+        encoded frame as-is, logical arrays encode here — the puller
+        dequantizes at its pool's adopt boundary."""
+        from .kv_codec import logical_nbytes, wire_nbytes
+        from .kv_transfer import encoded_frame
 
         body = await request.json()
         if body.get("fingerprint") != self.engine.model_fingerprint:
@@ -1809,30 +1813,36 @@ class EngineServer:
         t0 = time.perf_counter()
         served, entries = await self.async_engine.kv_peer_export(hashes)
 
-        def build() -> tuple[bytes, int, int]:
+        def build() -> tuple[bytes, int, int, int]:
             host = self.engine.host_tier
             disk = getattr(host, "disk", None) if host is not None else None
+            codec = self.engine.kv_codec
             frames: list[bytes] = []
             nbytes = 0
+            logical = 0
             for h, (kind, val) in zip(served, entries):
                 if kind == "dev":
-                    arr = np.stack([np.asarray(p) for p in val])
+                    obj = np.stack([np.asarray(p) for p in val])
                 elif kind == "np":
-                    arr = val
+                    obj = val  # ndarray, or EncodedKVBlock (encode_ring)
                 else:  # "disk": file IO deferred off the engine lock
-                    arr = disk.load(val) if disk is not None else None
-                    if arr is None:
+                    obj = disk.load(val) if disk is not None else None
+                    if obj is None:
                         break  # evicted since the walk: stop clean
-                frames.append(block_frame(h, arr))
-                nbytes += arr.nbytes
-            return b"".join(frames), len(frames), nbytes
+                if codec.enabled and isinstance(obj, np.ndarray):
+                    obj = codec.encode(obj)
+                frames.append(encoded_frame(h, obj))
+                nbytes += wire_nbytes(obj)
+                logical += logical_nbytes(obj)
+            return b"".join(frames), len(frames), nbytes, logical
 
-        payload, count, nbytes = await asyncio.get_running_loop(
+        payload, count, nbytes, logical = await asyncio.get_running_loop(
         ).run_in_executor(None, build)
-        # peer/out: bytes this engine SERVED to a peer (failure paths on
-        # the puller's side record their own 0-byte samples)
+        # peer/out: WIRE bytes this engine SERVED to a peer (failure paths
+        # on the puller's side record their own 0-byte samples)
         self.engine.flow.record(
-            "peer", "out", nbytes, count, time.perf_counter() - t0
+            "peer", "out", nbytes, count, time.perf_counter() - t0,
+            logical_nbytes=logical,
         )
         return web.Response(
             body=payload,
@@ -2300,6 +2310,23 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto", "fp8"],
                    help="KV pool storage dtype: fp8 (float8_e4m3fn) halves "
                         "KV HBM traffic and doubles pool capacity")
+    p.add_argument("--kv-at-rest-codec", default="none",
+                   choices=["none", "fp8", "int4"],
+                   help="at-rest KV codec for blocks leaving the pool "
+                        "(disk/remote/peer tiers): int4+per-group-scales "
+                        "(~3.5x wire reduction) or fp8 passthrough; "
+                        "dequantized on adopt. Joins the KV fingerprint "
+                        "so mixed-precision fleets never cross-serve "
+                        "(docs/38-kv-quantization.md)")
+    p.add_argument("--kv-at-rest-group-size", type=int, default=32,
+                   help="int4 codec quantization group size (elements per "
+                        "shared scale); smaller = tighter error bound, "
+                        "more scale overhead")
+    p.add_argument("--kv-at-rest-host-ring", default=False,
+                   type=_parse_bool_flag,
+                   help="hold host-ring entries in at-rest wire form too: "
+                        "the same host-RAM budget buys wire-ratio x more "
+                        "blocks, at a dequant on every ring reload")
     p.add_argument("--async-scheduling", default=True,
                    type=_parse_bool_flag,
                    help="two-deep pipelined step loop: dispatch step N+1 "
@@ -2385,6 +2412,9 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
             disk_kv_gib=args.disk_kv_gib,
             remote_kv_url=args.remote_kv_url,
             enable_prefix_caching=args.enable_prefix_caching,
+            kv_at_rest_codec=args.kv_at_rest_codec,
+            kv_at_rest_group_size=args.kv_at_rest_group_size,
+            kv_at_rest_host_ring=args.kv_at_rest_host_ring,
         ),
         scheduler=SchedulerConfig(
             max_num_seqs=args.max_num_seqs,

@@ -26,6 +26,7 @@ from urllib.parse import urlsplit
 
 import numpy as np
 
+from ..engine.kv_codec import EncodedKVBlock
 from ..engine.kv_flow import NULL_FLOW
 from ..utils.logging import init_logger
 
@@ -101,10 +102,17 @@ class RemoteKVTier:
         cooldown_s: float = 5.0,
         flow=None,
         heartbeat=None,
+        codec=None,
     ):
         self.host, self.port = parse_store_url(url)
         self.fingerprint = fingerprint
         self.cooldown_s = cooldown_s
+        # at-rest codec (engine/kv_codec.KVAtRestCodec): PUT bodies ship
+        # wire-encoded with codec headers; the server stores payloads
+        # byte-agnostically and mget frames carry the codec metadata
+        # back. The fingerprint namespace includes the codec spec, so a
+        # store shared by a mixed-precision fleet never cross-serves.
+        self.codec = codec
         self.stats = RemoteTierStats()
         # KV flow meter (engine/kv_flow.py): PUTs and fetches record
         # bytes/blocks/latency under tier="remote" — including failed
@@ -197,19 +205,42 @@ class RemoteKVTier:
                     self._inflight.discard(h)
                 self.stats.dropped += 1
                 continue
-            body = np.ascontiguousarray(arr).tobytes()
+            # encode to at-rest form unless the ring already did
+            obj = arr
+            if (
+                self.codec is not None
+                and self.codec.enabled
+                and not isinstance(arr, EncodedKVBlock)
+            ):
+                obj = self.codec.encode(arr)
+            headers = {
+                "X-KV-Fingerprint": self.fingerprint,
+                "Content-Type": "application/octet-stream",
+            }
+            if isinstance(obj, EncodedKVBlock):
+                body = obj.payload
+                logical = obj.logical_nbytes
+                headers["X-KV-Shape"] = ",".join(
+                    str(d) for d in obj.shape
+                )
+                headers["X-KV-Dtype"] = obj.dtype
+                headers["X-KV-Codec"] = obj.codec
+                headers["X-KV-Group"] = str(obj.group)
+                headers["X-KV-Scale-Bytes"] = str(obj.scale_nbytes)
+            else:
+                body = np.ascontiguousarray(obj).tobytes()
+                logical = len(body)
+                headers["X-KV-Shape"] = ",".join(
+                    str(d) for d in obj.shape
+                )
+                headers["X-KV-Dtype"] = obj.dtype.name
             t0 = time.perf_counter()
             try:
                 status, resp_headers, _ = self._store_conn.request(
                     "PUT",
                     f"/v1/blocks/{h}",
                     body=body,
-                    headers={
-                        "X-KV-Fingerprint": self.fingerprint,
-                        "X-KV-Shape": ",".join(str(d) for d in arr.shape),
-                        "X-KV-Dtype": arr.dtype.name,
-                        "Content-Type": "application/octet-stream",
-                    },
+                    headers=headers,
                 )
             except OSError as e:
                 self.flow.record(
@@ -225,6 +256,7 @@ class RemoteKVTier:
                 len(body) if status == 200 else 0,
                 1 if status == 200 else 0,
                 time.perf_counter() - t0,
+                logical_nbytes=logical if status == 200 else 0,
             )
             if status == 200:
                 self.stats.stores += 1
@@ -285,10 +317,12 @@ class RemoteKVTier:
 
     def fetch_run(
         self, hashes: list[int], conn: _Conn | None = None
-    ) -> list[np.ndarray]:
-        """The consecutive present prefix of `hashes` as arrays, one batched
-        mget round trip. `conn` routes the round trip over a dedicated
-        connection (new_fetch_conn) instead of the shared, locked one.
+    ) -> list:
+        """The consecutive present prefix of `hashes`, one batched mget
+        round trip — plain frames as arrays, at-rest frames as
+        EncodedKVBlock (the pool dequantizes on adopt). `conn` routes the
+        round trip over a dedicated connection (new_fetch_conn) instead
+        of the shared, locked one.
 
         Partial failures degrade to partial SUCCESS: when the response
         stream goes corrupt mid-run (foreign-version store, truncated
@@ -303,12 +337,12 @@ class RemoteKVTier:
         from ..engine.kv_transfer import FrameParser
 
         t0 = time.perf_counter()
-        out: list[np.ndarray] = []
+        out: list = []
 
-        def _flow(nbytes: int) -> None:
+        def _flow(nbytes: int, logical: int | None = None) -> None:
             self.flow.record(
                 "remote", "in", nbytes, len(out),
-                time.perf_counter() - t0,
+                time.perf_counter() - t0, logical_nbytes=logical,
             )
 
         body = json.dumps({
@@ -335,14 +369,18 @@ class RemoteKVTier:
             _flow(0)
             return []
         self.stats.fetches += 1
-        parser = FrameParser()
+        # decode_codec=False: at-rest frames come back as EncodedKVBlock
+        # and dequantize at the pool's adopt boundary (_match_remote /
+        # adopt_planned_run) — the fetch path holds wire-size RAM only
+        parser = FrameParser(decode_codec=False)
         for h, arr in parser.feed_partial(payload):
             if len(out) >= len(hashes) or h != hashes[len(out)]:
                 break  # non-consecutive frame; stop clean
             # copy: a frombuffer view would pin the ENTIRE multi-block
             # response buffer for as long as any one block stays referenced
-            # (the host ring retains these)
-            out.append(arr.copy())
+            # (the host ring retains these). EncodedKVBlock payloads are
+            # immutable bytes already detached from the response buffer.
+            out.append(arr.copy() if isinstance(arr, np.ndarray) else arr)
             # it exists remotely — teach the dedupe set so eviction of the
             # promoted copy doesn't push it straight back
             with self._stored_lock:
@@ -350,7 +388,10 @@ class RemoteKVTier:
                 while len(self._stored) > self._dedupe_capacity:
                     self._stored.popitem(last=False)
         self.stats.fetched_blocks += len(out)
-        _flow(sum(a.nbytes for a in out))
+        # wire vs logical from the parser's per-frame meta (frames past
+        # the consecutive prefix were parsed but not adopted — exclude)
+        meta = parser.frame_meta[: len(out)]
+        _flow(sum(w for w, _ in meta), sum(lg for _, lg in meta))
         if parser.error is not None:
             # a malformed/foreign-version response must degrade to a cache
             # miss (here: the valid prefix) like every other remote-tier

@@ -87,11 +87,18 @@ class BlockStore:
 def _meta_frame(h: str, payload: bytes, meta: dict) -> bytes:
     """One mget frame in the shared streaming wire format
     (engine/kv_transfer.py: raw_frame / FrameParser) — the PD transport and
-    the remote store speak the same framing."""
+    the remote store speak the same framing. At-rest-encoded payloads
+    (engine/kv_codec) pass their codec metadata straight through: the
+    store never decodes, the fetching engine dequantizes on adopt."""
     from ..engine.kv_transfer import raw_frame
 
     shape = [int(d) for d in meta["shape"].split(",") if d]
-    return raw_frame(h, payload, meta["dtype"], shape)
+    return raw_frame(
+        h, payload, meta["dtype"], shape,
+        codec=meta.get("codec", ""),
+        group=int(meta.get("group") or 0),
+        scale_nbytes=int(meta.get("scale_nbytes") or 0),
+    )
 
 
 class KVStoreServer:
@@ -104,6 +111,11 @@ class KVStoreServer:
         meta = {
             "shape": request.headers.get("X-KV-Shape", ""),
             "dtype": request.headers.get("X-KV-Dtype", ""),
+            # at-rest codec metadata (engine/kv_codec): stored opaquely,
+            # echoed on GET headers and mget frames
+            "codec": request.headers.get("X-KV-Codec", ""),
+            "group": request.headers.get("X-KV-Group", "0"),
+            "scale_nbytes": request.headers.get("X-KV-Scale-Bytes", "0"),
         }
         payload = await request.read()
         if not payload:
@@ -131,12 +143,17 @@ class KVStoreServer:
         if entry is None:
             return web.json_response({"error": "not found"}, status=404)
         payload, meta = entry
+        headers = {
+            "X-KV-Shape": meta["shape"],
+            "X-KV-Dtype": meta["dtype"],
+        }
+        if meta.get("codec"):
+            headers["X-KV-Codec"] = meta["codec"]
+            headers["X-KV-Group"] = str(meta.get("group", "0"))
+            headers["X-KV-Scale-Bytes"] = str(meta.get("scale_nbytes", "0"))
         return web.Response(
             body=payload,
-            headers={
-                "X-KV-Shape": meta["shape"],
-                "X-KV-Dtype": meta["dtype"],
-            },
+            headers=headers,
             content_type="application/octet-stream",
         )
 

@@ -196,6 +196,14 @@ KV_TRANSFER_TIERS = ("host", "disk", "remote", "device", "peer")
 KV_TRANSFER_DIRECTIONS = ("in", "out")
 KV_TRANSFER_BYTES = "tpu:kv_transfer_bytes_total"
 KV_TRANSFER_BLOCKS = "tpu:kv_transfer_blocks_total"
+# at-rest KV quantization (docs/38-kv-quantization.md): KV_TRANSFER_BYTES
+# counts WIRE bytes (what actually crossed the hop — int4+scales / fp8
+# payloads under --kv-at-rest-codec); this pair exposes the logical side.
+# logical_bytes = the decoded fp16/bf16 bytes those transfers represent;
+# the gauge is their time-decayed ratio logical/wire per (tier, direction)
+# (1.0 with no codec — the exporter seeds every combination).
+KV_TRANSFER_LOGICAL_BYTES = "tpu:kv_transfer_logical_bytes_total"
+KV_TIER_COMPRESSION_RATIO = "tpu:kv_tier_compression_ratio"
 # histogram: wall seconds per transfer batch, labeled tier=/direction=
 KV_TRANSFER_SECONDS = "tpu:kv_transfer_seconds"
 # gauge: time-decayed recent-mean transfer bandwidth per (tier, direction)
@@ -260,6 +268,12 @@ METRIC_LABEL_VALUES: dict[str, dict[str, tuple[str, ...]]] = {
     KV_TRANSFER_BLOCKS: {
         "tier": KV_TRANSFER_TIERS, "direction": KV_TRANSFER_DIRECTIONS,
     },
+    KV_TRANSFER_LOGICAL_BYTES: {
+        "tier": KV_TRANSFER_TIERS, "direction": KV_TRANSFER_DIRECTIONS,
+    },
+    KV_TIER_COMPRESSION_RATIO: {
+        "tier": KV_TRANSFER_TIERS, "direction": KV_TRANSFER_DIRECTIONS,
+    },
     KV_TRANSFER_SECONDS: {
         "tier": KV_TRANSFER_TIERS, "direction": KV_TRANSFER_DIRECTIONS,
     },
@@ -281,6 +295,7 @@ METRIC_LABEL_VALUES: dict[str, dict[str, tuple[str, ...]]] = {
 KV_FLOW_COUNTERS = (
     KV_TRANSFER_BYTES,
     KV_TRANSFER_BLOCKS,
+    KV_TRANSFER_LOGICAL_BYTES,
     REQUEST_PREFIX_TOKENS,
     DISK_KV_STORES,
     DISK_KV_LOADS,
@@ -465,6 +480,9 @@ ALL_GAUGES = (
     ENGINE_KV_TIER_USAGE,
     # KV flow telemetry (docs/30-kv-flow-telemetry.md)
     KV_TIER_BANDWIDTH,
+    # at-rest codec effectiveness (docs/38-kv-quantization.md):
+    # logical/wire per (tier, direction), 1.0 with no codec
+    KV_TIER_COMPRESSION_RATIO,
     # peer-engine KV tier (docs/35-peer-kv-reuse.md): the migrate-pricing
     # constant the router reads off each engine's scrape
     KV_BYTES_PER_TOKEN,
@@ -510,6 +528,7 @@ ALL_COUNTERS = (
     # source= labels are closed sets (METRIC_LABEL_VALUES)
     KV_TRANSFER_BYTES,
     KV_TRANSFER_BLOCKS,
+    KV_TRANSFER_LOGICAL_BYTES,
     REQUEST_PREFIX_TOKENS,
     DISK_KV_STORES,
     DISK_KV_LOADS,
