@@ -3388,18 +3388,23 @@ async def _peer_bench() -> dict:
             eng_b.flow.record("peer", "in", TierBandwidth.MIN_BYTES, 32, 0.01)
             eng_b.flow.record("peer", "in", TierBandwidth.MIN_BYTES, 32, 0.01)
             eng_b.generate([[7] * bs], greedy)
+            t0 = time.perf_counter()
             got = await loop.run_in_executor(
                 None,
                 lambda: eng_b.generate(
                     [prompt], greedy, kv_owner_hint=a_url
                 )[0]["token_ids"],
             )
-            hyd = eng_b.flow.snapshot()["hydration"]
+            http_latency = time.perf_counter() - t0
+            snap = eng_b.flow.snapshot()
+            hyd = snap["hydration"]
             partition_exact = sum(hyd.values()) == eng_b._prompt_tokens
             result = {
                 "tokens_equal": got == ref,
                 "peer_fetch_tokens": hyd.get("peer_fetch", 0),
                 "partition_exact": partition_exact,
+                "latency_s": round(http_latency, 3),
+                "wire_bytes": snap["bytes"].get("peer/in", 0),
             }
             assert result["tokens_equal"], (got, ref)
             assert result["peer_fetch_tokens"] > 0, hyd
@@ -3412,10 +3417,34 @@ async def _peer_bench() -> dict:
         eng_a.runner.shutdown(wait=True)
         return result
 
+    def device_arm() -> dict:
+        """Device-transport half of the acceptance bar (docs/39): two REAL
+        OS processes sharing a mesh group — the puller's hydration fetch
+        lane moves the owner's pages over the shard-flip collective
+        instead of HTTP, and the worker itself asserts token-identity vs
+        a from-scratch oracle plus (device, in)-only metering. Reported
+        side by side with the HTTP arm's latency/bytes above."""
+        from vllm_production_stack_tpu.parallel.distributed import (
+            run_multiprocess_device_peer_dryrun,
+        )
+
+        outs = run_multiprocess_device_peer_dryrun(timeout_s=240)
+        result = {"ok": True}
+        for line in "\n".join(outs).splitlines():
+            if "DEVPEER_DRYRUN_OK" not in line:
+                continue
+            for tok in line.split():
+                for key in ("pulled_bytes", "latency_s", "served_bytes"):
+                    if tok.startswith(key + "="):
+                        result[key] = float(tok.split("=", 1)[1])
+        return result
+
     try:
         affinity = await run_arm("off")
         priced = await run_arm("priced")
         bit_identical = await bit_identical_check()
+        loop = asyncio.get_running_loop()
+        device = await loop.run_in_executor(None, device_arm)
     finally:
         for runner in runners:
             await runner.cleanup()
@@ -3428,6 +3457,9 @@ async def _peer_bench() -> dict:
         "affinity": affinity,
         "priced": priced,
         "bit_identical": bit_identical,
+        # HTTP arm (bit_identical.latency_s/wire_bytes) vs device arm
+        # (device.latency_s/pulled_bytes): the same pull over both wires
+        "device": device,
         "speedup_tok_per_s": (
             round(priced["agg_tok_per_s"] / affinity["agg_tok_per_s"], 2)
             if affinity["agg_tok_per_s"] else None
@@ -3742,7 +3774,9 @@ def main() -> None:
     # CPU-only, pre-preflight (fake engines + real router, no chip)
     peer = _run_phase(
         "peer", ["bench.py", "--phase", "peer"],
-        timeout_s=300, key="peer", min_needed_s=60.0,
+        # the device arm spawns a 2-process jax.distributed dryrun that
+        # cold-compiles the shard-flip program — budget for it
+        timeout_s=480, key="peer", min_needed_s=60.0,
     )
 
     # -0.0078125) fleet-coherence telemetry (docs/32-fleet-telemetry.md):

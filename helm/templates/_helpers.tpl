@@ -155,6 +155,10 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--kv-peer-fetch-timeout-s"
 - {{ .kvPeerFetchTimeoutS | quote }}
 {{- end }}
+{{- if .kvPeerTransport }}
+- "--kv-peer-transport"
+- {{ .kvPeerTransport | quote }}
+{{- end }}
 {{- if .postmortemDir }}
 - "--postmortem-dir"
 - {{ .postmortemDir | quote }}

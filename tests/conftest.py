@@ -42,6 +42,7 @@ SLOW_TESTS = {
     "test_engine.py::test_coarse_warmup_precompiles_dominating_lattice",
     "test_distributed.py::test_multiprocess_pd_dryrun_ships_kv_across_processes",
     "test_distributed.py::test_multiprocess_pd_dryrun_tp2_roles",
+    "test_distributed.py::test_multiprocess_device_peer_dryrun_pulls_over_collectives",
     "test_spec_decode.py::test_spec_engine_matches_plain_greedy",
     "test_sharding.py::test_engine_e2e_on_pp_mesh",
     "test_sharding.py::test_qwen3_qk_norm_engine_tp2_matches_tp1",

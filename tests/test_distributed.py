@@ -85,3 +85,19 @@ def test_multiprocess_pd_dryrun_tp2_roles():
     outs = dist.run_multiprocess_pd_dryrun(timeout_s=600, tp=2)
     joined = "\n".join(outs)
     assert "PD_DRYRUN_OK adopted=" in joined
+
+
+def test_multiprocess_device_peer_dryrun_pulls_over_collectives():
+    """Device-path peer KV (docs/39): two engines in DIFFERENT
+    jax.distributed processes sharing KV_MESH_GROUP; the cold puller's
+    Hydrator negotiates the device transport against the owner's live
+    /kv/peer_contains echo and pulls the prefix over the pairwise
+    shard-flip collective — with the owner's AsyncEngine step loop
+    serving. The worker itself asserts device/in bytes moved, NO HTTP
+    peer fallback, peer_fetch attribution, and token-identical output
+    vs a from-scratch oracle engine (both step loops live)."""
+    outs = dist.run_multiprocess_device_peer_dryrun(timeout_s=600)
+    assert len(outs) == 2
+    joined = "\n".join(outs)
+    assert "DEVPEER_DRYRUN_OK role=owner" in joined
+    assert "DEVPEER_DRYRUN_OK pulled_bytes=" in joined

@@ -46,7 +46,12 @@ def _same_request(rid: str, parent: str) -> bool:
 class AsyncEngine:
     def __init__(self, engine: LLMEngine):
         self.engine = engine
-        self._lock = threading.Lock()
+        # ONE quiescence lock shared with the engine (engine.step_lock):
+        # the Hydrator's device-collective peer pull takes it on the
+        # fetcher thread, so "holding it" must mean "no step in flight"
+        # for the step loop here too. getattr keeps pre-step_lock test
+        # doubles working.
+        self._lock = getattr(engine, "step_lock", None) or threading.Lock()
         self._queues: dict[str, asyncio.Queue[RequestOutput]] = {}
         # deferred admissions: (rid, token_ids, sampling, lora_name).
         # Submissions NEVER take the engine lock — on a busy engine the step
@@ -671,6 +676,42 @@ class AsyncEngine:
         def work():
             with self._lock:
                 return self.engine.kv_peer_export(hashes)
+
+        return await asyncio.get_running_loop().run_in_executor(None, work)
+
+    async def kv_peer_device_serve(self, hashes: list[int]) -> int:
+        """Owner half of a device-collective peer pull (docs/39): join the
+        cooperative transfer program as the source. Holds the engine lock
+        for the whole collective — the gather reads kv_caches, and the
+        puller's side donates its own; both step loops must be quiesced.
+        Returns 0 (the source never adopts)."""
+        def work():
+            from .kv_device_transfer import pull_kv_device_crossproc
+
+            with self._lock:
+                return pull_kv_device_crossproc(
+                    self.engine, True, list(hashes)
+                )
+
+        return await asyncio.get_running_loop().run_in_executor(None, work)
+
+    async def kv_peer_replicate(self, owner: str, hashes: list[int]) -> int:
+        """Proactive flash-crowd replication target half (docs/39): fetch
+        `hashes` from `owner` over the HTTP peer path and adopt them
+        parked. The fetch runs OFF the lock (seconds of wire time);
+        only the adoption quiesces the step loop."""
+        def work():
+            return self.engine.kv_peer_replicate(owner, hashes)
+
+        return await asyncio.get_running_loop().run_in_executor(None, work)
+
+    async def kv_mark_replicated(self, hashes: list[int]) -> int:
+        """Record that a peer now holds copies of `hashes` — the owner's
+        eviction policies prefer replicated blocks as victims from here
+        on (pool + host ring, docs/39)."""
+        def work():
+            with self._lock:
+                return self.engine.scheduler.pool.mark_replicated(hashes)
 
         return await asyncio.get_running_loop().run_in_executor(None, work)
 

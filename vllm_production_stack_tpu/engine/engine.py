@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -214,6 +215,12 @@ class LLMEngine:
             capacity=config.flight_records, enabled=config.flight_recording
         )
         self.threads = ThreadRegistry()
+        # step quiescence lock: anything that mutates runner.kv_caches or
+        # pool bookkeeping off the step thread (the device-collective peer
+        # pull donates + reassigns the cache arrays) takes this around the
+        # mutation. AsyncEngine adopts it as ITS step-loop lock, and sync
+        # generate() steps under it, so holding it == no step in flight.
+        self.step_lock = threading.Lock()
         self.host_tier = None
         self.remote_tier = None
         num_host_blocks = config.cache.num_host_blocks
@@ -320,6 +327,22 @@ class LLMEngine:
                 timeout=config.kv_peer_fetch_timeout_s,
                 flow=self.flow,
             )
+            if config.kv_peer_transport in ("auto", "device"):
+                # mesh-peer transport (docs/39-device-peer-kv.md): attach
+                # this process's mesh identity so lookups/registrations
+                # advertise it and /peer_lookup replies can negotiate the
+                # device path. No identity (no KV_MESH_GROUP, or
+                # jax.distributed uninitialized) degrades to HTTP.
+                from .kv_device_transfer import device_transport_identity
+
+                identity = device_transport_identity()
+                if identity is None and config.kv_peer_transport == "device":
+                    logger.warning(
+                        "kv_peer_transport=device but no mesh identity "
+                        "(KV_MESH_GROUP unset or jax.distributed not "
+                        "initialized); peer pulls stay on HTTP"
+                    )
+                self.peer_tier.transport_identity = identity
         # compute-or-load hydration planner (docs/31-hydration-planner.md):
         # only engines with a rung BELOW the host ring (disk / remote /
         # peer) ever face the blocking-load-vs-recompute choice; everything
@@ -337,6 +360,12 @@ class LLMEngine:
         ):
             from .hydration import Hydrator
 
+            device_pull_fn = None
+            if (
+                self.peer_tier is not None
+                and self.peer_tier.transport_identity is not None
+            ):
+                device_pull_fn = self._device_peer_pull
             self.hydrator = Hydrator(
                 mode=config.kv_hydration,
                 chunk_blocks=config.kv_hydration_chunk_blocks,
@@ -346,6 +375,7 @@ class LLMEngine:
                 host_tier=self.host_tier,
                 peer=self.peer_tier,
                 heartbeat=self.threads.register("hydration_fetch"),
+                device_pull_fn=device_pull_fn,
             )
         self.scheduler = Scheduler(
             config.model, config.cache, config.scheduler,
@@ -875,6 +905,28 @@ class LLMEngine:
         return KVTransfer(self.scheduler.pool, self.runner).import_blocks(
             hashes, blocks
         )
+
+    def kv_peer_replicate(self, owner: str, hashes: list[int]) -> int:
+        """Flash-crowd replication target half (docs/39-device-peer-kv.md):
+        fetch `hashes` from `owner` over the HTTP peer path and adopt them
+        as parked evictable blocks. The wire fetch runs on the caller's
+        thread OFF the step lock (seconds of wire time must not stall
+        decode); only the adoption quiesces the step loop."""
+        import numpy as np
+
+        if self.peer_tier is None:
+            return 0
+        got = self.peer_tier.fetch_run(owner, list(hashes))
+        if not got:
+            return 0
+        from .kv_codec import decode_block
+        from .kv_transfer import KVTransfer
+
+        blocks = np.stack([decode_block(g) for g in got])
+        with self.step_lock:
+            return KVTransfer(
+                self.scheduler.pool, self.runner
+            ).import_blocks(list(hashes)[: len(got)], blocks)
 
     def kv_lookup(self, text: str | None = None,
                   token_ids: list[int] | None = None,
@@ -1548,9 +1600,89 @@ class LLMEngine:
                 "disk": wire,
                 "remote": wire,
                 "peer": wire,
+                # the device path moves pool-precision pages over ICI/DCN
+                # collectives — the at-rest codec never touches it, so a
+                # device fetch prices at full logical block bytes
+                # (compression ratio pinned at 1.0, docs/39)
+                "device": block_bytes,
             },
             "block_size_tokens": self.config.cache.block_size,
         }
+
+    def _device_peer_pull(self, owner: str, hashes: list[int]) -> int:
+        """Pull a hash run from `owner` over device collectives (the
+        Hydrator's device fetch lane, docs/39-device-peer-kv.md). Runs on
+        the FETCHER thread: the HTTP trigger (POST /kv/peer_device_pull,
+        split send/read so both sides join the collective concurrently)
+        happens OUTSIDE the step lock — a stalled owner stalls only this
+        thread, named "hydration_fetch" by the watchdog — and only the
+        collective itself quiesces the step loop under `step_lock`.
+        Returns run hashes resident after the pull (parked at refcount 0
+        for adoption), 0 on any failure — which records a 0-byte
+        device/in sample so the fault is visible in
+        tpu:kv_transfer_seconds{tier="device"}."""
+        import http.client
+        import json as _json
+        from urllib.parse import urlsplit
+
+        from .kv_device_transfer import pull_kv_device_crossproc
+
+        t0 = time.perf_counter()
+        conn = None
+        try:
+            u = urlsplit(owner)
+            body = _json.dumps(
+                {"hashes": [int(h) for h in hashes]}
+            ).encode()
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port or 80,
+                timeout=max(5.0, self.config.kv_peer_fetch_timeout_s),
+            )
+            # split trigger: send the full request, DON'T read the reply
+            # yet — the owner parses and enters the collective while we
+            # enter ours below; the reply lands after both sides finish
+            conn.putrequest("POST", "/kv/peer_device_pull")
+            conn.putheader("Content-Type", "application/json")
+            conn.putheader("Content-Length", str(len(body)))
+            conn.endheaders()
+            conn.send(body)
+        except Exception:
+            # trigger never reached the owner: no collective exists on
+            # either side — record the honest 0-byte failure sample
+            logger.exception("device peer pull trigger to %s failed", owner)
+            self.flow.record(
+                "device", "in", 0, 0, time.perf_counter() - t0
+            )
+            if conn is not None:
+                conn.close()
+            return 0
+        try:
+            with self.step_lock:
+                n = pull_kv_device_crossproc(self, False, list(hashes))
+        except Exception:
+            # the cooperative program aborts BOTH sides (fingerprint
+            # allgather / go-no-go barrier); post-barrier failures
+            # metered inside the transfer, pre-barrier ones here
+            logger.exception("device peer pull from %s faulted", owner)
+            self.flow.record(
+                "device", "in", 0, 0, time.perf_counter() - t0
+            )
+            n = 0
+        try:
+            resp = conn.getresponse()
+            resp.read()
+            if n and resp.status != 200:
+                logger.warning(
+                    "device peer pull: owner %s answered %d after a "
+                    "locally-successful transfer", owner, resp.status,
+                )
+        except Exception:  # noqa: BLE001 — the bytes already landed
+            logger.warning(
+                "device peer pull: reply read from %s failed", owner
+            )
+        finally:
+            conn.close()
+        return n
 
     def _emit_results(
         self, results, lp_rows, outputs: list[RequestOutput]
@@ -1733,7 +1865,18 @@ class LLMEngine:
             i: {"request_id": i, "token_ids": [], "text": ""} for i in ids
         }
         while self.has_unfinished():
-            for out in self.step():
+            # step under the quiescence lock so a concurrent device-path
+            # peer pull (Hydrator fetcher thread) never races the step's
+            # kv_caches donation — same discipline as AsyncEngine._lock
+            with self.step_lock:
+                outs = self.step()
+            if not outs:
+                # nothing progressed (every request parked on hydration):
+                # yield the lock for real — a tight reacquire loop can
+                # starve the fetcher thread whose device-path pull needs
+                # the same lock to run its collective
+                time.sleep(0.001)
+            for out in outs:
                 d = done.get(out.request_id)
                 if d is None:
                     continue

@@ -89,9 +89,10 @@ def plan_decisions(
     - ``forced=False`` (auto mode) and any resident DISK/REMOTE tier in
       the run is below the bandwidth sample floor — the caller falls back
       to the legacy synchronous load, whose transfers are exactly what
-      crosses the floor. An unmeasured PEER tier never declines the plan
-      (no sync path fetches from peers); its chunks are priced recompute
-      until the Hydrator's bootstrap fetches cross the floor.
+      crosses the floor. An unmeasured PEER or DEVICE tier never declines
+      the plan (no sync path fetches from peers, over HTTP or over the
+      device link); its chunks are priced recompute until the Hydrator's
+      bootstrap fetches cross the floor.
 
     With ``forced=True`` unmeasured-tier chunks are decided "recompute"
     (never trust an estimate built from a single tiny transfer — the
@@ -114,10 +115,11 @@ def plan_decisions(
         return None  # cannot price compute — planner cannot engage
     # an unmeasured DISK/REMOTE tier declines the whole plan in auto mode
     # (the sync fallback load is what feeds the bandwidth floor); an
-    # unmeasured PEER tier must NOT — no sync path ever fetches from a
-    # peer, so declining would starve the estimator forever. Peer chunks
-    # below the floor are priced recompute instead, and the Hydrator's
-    # bootstrap fetch (measurement-only) crosses the floor out of band.
+    # unmeasured PEER or DEVICE tier must NOT — no sync path ever fetches
+    # from a peer over either transport, so declining would starve the
+    # estimator forever. Peer/device chunks below the floor are priced
+    # recompute instead, and the Hydrator's bootstrap fetch
+    # (measurement-only) crosses the floor out of band.
     unmeasured_nonpeer = False
     # attention score/value coefficient (FLOPs per token × attended
     # position): at long context this term dominates the matmul term, and
@@ -154,7 +156,7 @@ def plan_decisions(
             rate = float(bw.get(tier) or 0.0)
             if not measured.get(tier) or rate <= 0.0:
                 cost = inf  # below the sample floor: never trusted
-                if tier != "peer":
+                if tier not in ("peer", "device"):
                     unmeasured_nonpeer = True
                 break
             cost += float(wire_bytes.get(tier) or block_bytes) / rate
@@ -313,6 +315,7 @@ class Hydrator:
         host_tier=None,
         peer=None,
         heartbeat=None,
+        device_pull_fn=None,
     ):
         if mode not in self.MODES:
             raise ValueError(
@@ -337,6 +340,11 @@ class Hydrator:
         # --kv-peer-fetch is off): "peer"-tier chunks fetch from the plan's
         # owner engine over dedicated per-owner connections
         self.peer = peer
+        # device-collective peer pull (engine._device_peer_pull, None when
+        # no mesh identity): "device"-tier chunks land straight in THIS
+        # engine's HBM pool via ICI/DCN collectives — (owner_url, hashes)
+        # -> resident block count, parked at refcount 0 for adoption
+        self.device_pull_fn = device_pull_fn
         self._q: queue.Queue = queue.Queue()
         self._thread: threading.Thread | None = None
         self._closed = False
@@ -377,17 +385,20 @@ class Hydrator:
             for i in range(0, len(tiers), self.chunk_blocks)
         ]
         signal = self.signal_fn()
-        if peer_owner and "peer" in tiers:
-            # sample-floor warmup: the peer tier has no sync fallback to
-            # measure it, so an unmeasured peer triggers a bounded
-            # measurement-only fetch on the fetcher thread (rate-limited
-            # per owner); until it crosses the floor, peer chunks price
-            # as recompute and the request loses nothing
-            self._maybe_bootstrap(
-                peer_owner,
-                [h for h, t in zip(hashes, tiers) if t == "peer"],
-                signal,
-            )
+        if peer_owner:
+            # sample-floor warmup: the peer/device tiers have no sync
+            # fallback to measure them, so an unmeasured one triggers a
+            # bounded measurement-only fetch on the fetcher thread
+            # (rate-limited per owner); until it crosses the floor, its
+            # chunks price as recompute and the request loses nothing
+            for wire_tier in ("peer", "device"):
+                if wire_tier in tiers:
+                    self._maybe_bootstrap(
+                        peer_owner,
+                        [h for h, t in zip(hashes, tiers)
+                         if t == wire_tier],
+                        signal, tier=wire_tier,
+                    )
         planned = plan_decisions(
             chunk_tiers, signal,
             forced=self.mode == "planner", start_block=start_block,
@@ -418,22 +429,31 @@ class Hydrator:
         )
 
     def _maybe_bootstrap(
-        self, owner: str, peer_hashes: list[int], signal: dict
+        self, owner: str, peer_hashes: list[int], signal: dict,
+        tier: str = "peer",
     ) -> None:
-        """Enqueue one measurement-only fetch against `owner` when its
-        bandwidth estimate is still below the sample floor (step thread;
-        the fetch itself runs on the fetcher thread and its payload is
-        DISCARDED — only the TierBandwidth samples matter)."""
-        if self.peer is None or not peer_hashes:
+        """Enqueue one measurement-only fetch against `owner` when the
+        wire tier's bandwidth estimate is still below the sample floor
+        (step thread; the fetch itself runs on the fetcher thread). For
+        ``tier="peer"`` the payload is DISCARDED — only the TierBandwidth
+        samples matter; for ``tier="device"`` the pulled blocks land
+        parked in the pool (a collective has no discard path) and the
+        next admission re-plans against both the measured link and the
+        now-HBM-resident run."""
+        if tier == "device":
+            if self.device_pull_fn is None or not peer_hashes:
+                return
+        elif self.peer is None or not peer_hashes:
             return
-        if (signal.get("fetch_bandwidth_measured") or {}).get("peer"):
+        if (signal.get("fetch_bandwidth_measured") or {}).get(tier):
             return
         now = time.monotonic()
-        if now - self._bootstrap_t.get(owner, -1e9) < (
+        key = (owner, tier)
+        if now - self._bootstrap_t.get(key, -1e9) < (
             self.BOOTSTRAP_MIN_INTERVAL_S
         ):
             return
-        self._bootstrap_t[owner] = now
+        self._bootstrap_t[key] = now
         # enough blocks to cross MIN_BYTES in two samples where possible
         from .kv_flow import TierBandwidth
 
@@ -442,7 +462,7 @@ class Hydrator:
             1, int(TierBandwidth.MIN_BYTES // block_bytes) + 1
         ) if block_bytes > 0 else len(peer_hashes)
         self._ensure_thread()
-        self._q.put(("bootstrap", owner, peer_hashes[:want]))
+        self._q.put(("bootstrap", owner, peer_hashes[:want], tier))
 
     def launch(self, plan: HydrationPlan) -> None:
         """Record the plan's decisions and enqueue its load chunks for the
@@ -484,12 +504,13 @@ class Hydrator:
                     hb.idle()
                 return
             if item[0] == "bootstrap":
-                _, owner, hashes = item
+                _, owner, hashes, tier = item
                 try:
-                    self._bootstrap_fetch(owner, hashes)
+                    self._bootstrap_fetch(owner, hashes, tier)
                 except Exception:
                     logger.exception(
-                        "peer bandwidth bootstrap against %s faulted", owner
+                        "%s bandwidth bootstrap against %s faulted",
+                        tier, owner,
                     )
                 continue
             plan, chunk = item
@@ -504,16 +525,29 @@ class Hydrator:
                     if chunk.status == "pending":
                         chunk.status = "failed"
 
-    def _bootstrap_fetch(self, owner: str, hashes: list[int]) -> None:
+    def _bootstrap_fetch(
+        self, owner: str, hashes: list[int], tier: str = "peer"
+    ) -> None:
         """Measurement-only peer fetches (fetcher thread): split the hash
         list into MIN_SAMPLES round trips so one warmup crosses both
-        halves of the sample floor; the payloads are discarded — adopting
+        halves of the sample floor. HTTP payloads are discarded — adopting
         them would need the step thread's pool, and the next admission
-        re-plans against the now-measured tier anyway."""
-        if self.peer is None or not hashes:
-            return
+        re-plans against the now-measured tier anyway. Device pulls land
+        parked blocks instead (the collective IS the adoption); the pull
+        records its own flow samples under tier="device"."""
         from .kv_flow import TierBandwidth
 
+        if tier == "device":
+            if self.device_pull_fn is None or not hashes:
+                return
+            per = max(1, len(hashes) // TierBandwidth.MIN_SAMPLES)
+            for i in range(0, len(hashes), per):
+                got = self.device_pull_fn(owner, hashes[i : i + per])
+                if not got:
+                    return  # owner refused/evicted: stop burning pulls
+            return
+        if self.peer is None or not hashes:
+            return
         per = max(1, len(hashes) // TierBandwidth.MIN_SAMPLES)
         conn = self._peer_conn(owner)
         for i in range(0, len(hashes), per):
@@ -603,6 +637,33 @@ class Hydrator:
                 if not ok:
                     break
                 i = j
+            elif (
+                tier == "device"
+                and self.device_pull_fn is not None
+                and plan.peer_owner
+            ):
+                # one collective pull per consecutive device span: the
+                # owner's blocks land straight in THIS engine's HBM pool
+                # (parked at refcount 0), so arrays stay None and
+                # adoption re-acquires them by hash — no host-RAM bytes
+                # ever exist on this path
+                j = i
+                while (
+                    j < len(chunk.hashes)
+                    and chunk.tiers[j] == "device"
+                    and arrays[j] is None
+                ):
+                    j += 1
+                got = self.device_pull_fn(
+                    plan.peer_owner, chunk.hashes[i:j]
+                )
+                if int(got or 0) < j - i:
+                    # owner refused (fingerprint/geometry), evicted
+                    # mid-run, or the trigger faulted: partial coverage
+                    # is useless, the chunk falls back to recompute
+                    ok = False
+                    break
+                i = j
             else:
                 # a "host" block whose ring entry vanished before launch
                 # could resolve it, or a tier with no backing object
@@ -645,6 +706,7 @@ class Hydrator:
             "chunk_blocks": self.chunk_blocks,
             "timeout_s": self.timeout_s,
             "queued_fetch_jobs": self._q.qsize(),
+            "device_pull": self.device_pull_fn is not None,
         }
         if self.peer is not None:
             snap["peer"] = self.peer.snapshot()

@@ -107,6 +107,11 @@ class KVBlockPool:
             host_tier.on_drop = self._on_host_drop
             if getattr(host_tier, "disk", None) is not None:
                 host_tier.disk.on_drop = self._on_host_drop
+            # migration-aware ring eviction shares the pool's replica set
+            # (bound method of the set object — survives in-place updates)
+            host_tier.is_replicated = (
+                lambda h: h in self._replicated
+            )
         # page geometry remote fetches are validated against; the engine
         # sets this once the runner's pool exists (None = skip validation,
         # e.g. unit tests with no device pool)
@@ -119,6 +124,12 @@ class KVBlockPool:
         self._block_to_hash: dict[int, int] = {}
         # refcount-0 cached blocks, LRU order (oldest first -> evicted first)
         self._evictable: OrderedDict[int, None] = OrderedDict()
+        # migration-aware eviction (docs/39-device-peer-kv.md, ROADMAP 2b):
+        # hashes the cluster's replication controller confirmed resident on
+        # ≥1 PEER engine. Eviction prefers these — losing a replicated
+        # block costs a peer pull, losing the cluster's last copy costs a
+        # recompute. Advisory only: a stale entry just mis-orders victims.
+        self._replicated: set[int] = set()
         self.stats = CacheStats()
         # per-block KV origin of the LAST match_prefix call ("hbm" | "host"
         # | "disk" | "remote", parallel to its return) — consumed by the
@@ -172,11 +183,44 @@ class KVBlockPool:
         self.scratch_blocks -= 1
         self.free_block(blk)
 
+    # oldest-end window scanned for a peer-replicated victim before the
+    # plain LRU-oldest falls: bounds the preference at O(32) dict probes
+    # per eviction, preserving allocate()'s hot-path cost
+    _VICTIM_SCAN = 32
+
+    def mark_replicated(self, hashes: list[int]) -> int:
+        """Record that `hashes` are resident on ≥1 peer engine (the
+        replication controller confirmed a copy landed — docs/39), making
+        their blocks PREFERRED eviction victims here: the cluster keeps
+        the copy either way, so this engine should shed them first and
+        keep blocks whose only copy it holds. Returns how many are
+        currently block-resident (diagnostic)."""
+        if len(self._replicated) > 4 * self.num_blocks:
+            # advisory set, bounded: replica hints outliving the pool many
+            # times over carry no ordering signal worth the memory
+            self._replicated.clear()
+        self._replicated.update(hashes)
+        return sum(1 for h in hashes if h in self._hash_to_block)
+
+    def _pick_victim(self) -> int:
+        """Next eviction victim: a peer-replicated block from the oldest
+        _VICTIM_SCAN evictable entries when one exists, else LRU-oldest
+        (migration-aware eviction, docs/39-device-peer-kv.md)."""
+        if self._replicated:
+            for i, b in enumerate(self._evictable):
+                if i >= self._VICTIM_SCAN:
+                    break
+                if self._block_to_hash.get(b) in self._replicated:
+                    del self._evictable[b]
+                    return b
+        blk, _ = self._evictable.popitem(last=False)
+        return blk
+
     def allocate(self) -> int | None:
         if self._free:
             blk = self._free.popleft()
         elif self._evictable:
-            blk, _ = self._evictable.popitem(last=False)
+            blk = self._pick_victim()
             h = self._block_to_hash.pop(blk)
             self._hash_to_block.pop(h, None)
             if self.host_tier is not None:
@@ -344,7 +388,8 @@ class KVBlockPool:
         resident run of full prompt blocks across EVERY tier, WITHOUT
         moving data, taking references, or touching the hit counters —
         the residency map the compute-or-load planner decides over.
-        tiers[i] is "hbm" | "host" | "disk" | "remote" | "peer"; the
+        tiers[i] is "hbm" | "host" | "disk" | "remote" | "peer" |
+        "device" (a peer continuation on a shared-mesh owner); the
         remote continuation is one batched contains round trip (no
         payload), same as match_length. `local_only` skips every round
         trip — the `off` kill switch must not keep a sick remote store
@@ -405,7 +450,11 @@ class KVBlockPool:
             if owner:
                 n = peer.contains_run(owner, hashes[start:])
                 if n > 0:
-                    tiers.extend(["peer"] * n)
+                    # "device" when the owner negotiated the device-path
+                    # transport (shared mesh — docs/39-device-peer-kv.md):
+                    # same peer continuation, collective-priced tier label
+                    tf = getattr(peer, "transport_for", None)
+                    tiers.extend([tf(owner) if tf else "peer"] * n)
                     peer_owner = owner
         return hashes[: len(tiers)], tiers, peer_owner
 

@@ -62,7 +62,11 @@ class KVController:
     def __init__(self, engine_urls: list[str] | None = None,
                  timeout_s: float = 2.0, mode: str = "indexed",
                  tokenizer=None, base_models: list[str] | None = None,
-                 tenant_table=None, fleet_rate_window_s: float = 30.0):
+                 tenant_table=None, fleet_rate_window_s: float = 30.0,
+                 replicate_threshold: int = 0,
+                 replicate_window_s: float = 10.0,
+                 replicate_max_blocks: int = 16,
+                 replicate_cooldown_s: float = 30.0):
         if mode not in LOOKUP_MODES:
             raise ValueError(f"unknown KV lookup mode: {mode}")
         self.engines: set[str] = {u.rstrip("/") for u in engine_urls or []}
@@ -104,6 +108,19 @@ class KVController:
         self.lookup_counts = {
             "indexed": 0, "fanout": 0, "mixed": 0, "peer": 0,
         }
+        # proactive flash-crowd replication (docs/39-device-peer-kv.md,
+        # the BanaServe half): /peer_lookup hits per prefix are counted
+        # over a sliding window; a prefix crossing replicate_threshold
+        # lookups/window gets push-replicated to the least-loaded fresh
+        # non-holder, and the owner is told so its eviction can prefer
+        # the now-replicated blocks as victims. threshold 0 = off.
+        self.replicate_threshold = int(replicate_threshold)
+        self.replicate_window_s = float(replicate_window_s)
+        self.replicate_max_blocks = int(replicate_max_blocks)
+        self.replicate_cooldown_s = float(replicate_cooldown_s)
+        self._crowd: dict[int, object] = {}  # head hash -> deque[monotonic]
+        self._replicated_at: dict[int, float] = {}
+        self.replications_ordered = 0
 
     async def _sess(self) -> aiohttp.ClientSession:
         return await self._http.get()
@@ -248,7 +265,100 @@ class KVController:
             exclude=body.get("exclude") or None,
         )
         self.lookup_counts["peer"] += 1
-        return web.json_response({"url": url, "matched_blocks": matched})
+        reply: dict = {"url": url, "matched_blocks": matched}
+        if url:
+            # per-pair transport hint (docs/39): negotiate the requester's
+            # advertised mesh identity against the owner's registered one.
+            # Attached only when the answer is "device" — absent means
+            # HTTP, which keeps pre-39 askers (and their reply-shape
+            # expectations) untouched. The asking engine still
+            # re-validates against the owner's /kv/peer_contains echo
+            # before any collective.
+            from ..kv_index import negotiate_transport
+
+            hint = negotiate_transport(
+                body.get("transport"), self.index.get_transport(url)
+            )
+            if hint == "device":
+                reply["transport"] = hint
+            if matched and self.replicate_threshold > 0:
+                self._note_crowd(hashes[:matched], block_size, url)
+        return web.json_response(reply)
+
+    def _note_crowd(
+        self, hashes: list[int], block_size: int, owner: str
+    ) -> None:
+        """Count a /peer_lookup hit against its prefix (keyed by the run's
+        head hash) and order replication when the window rate crosses the
+        threshold — fire-and-forget, never blocking the lookup reply."""
+        from collections import deque
+
+        key = hashes[0]
+        now = time.monotonic()
+        if len(self._crowd) > 4096:  # bound: crowd tracking is best-effort
+            self._crowd.clear()
+        dq = self._crowd.setdefault(key, deque())
+        dq.append(now)
+        while dq and now - dq[0] > self.replicate_window_s:
+            dq.popleft()
+        if len(dq) < self.replicate_threshold:
+            return
+        if now - self._replicated_at.get(key, -1e9) < (
+            self.replicate_cooldown_s
+        ):
+            return
+        self._replicated_at[key] = now
+        dq.clear()
+        asyncio.get_running_loop().create_task(
+            self._replicate_prefix(list(hashes), block_size, owner)
+        )
+
+    async def _replicate_prefix(
+        self, hashes: list[int], block_size: int, owner: str
+    ) -> None:
+        """Push-replicate a flash-crowd prefix (docs/39): pick the least-
+        loaded fresh engine not already holding the run, order it to pull
+        from the owner (POST target /kv/peer_replicate), and on success
+        tell the owner (POST owner /kv/replicated) so migration-aware
+        eviction prefers those blocks as victims. Every failure is soft —
+        replication is an optimization, never a correctness dependency."""
+        try:
+            run = hashes[: self.replicate_max_blocks]
+            holders = set(self.index.holders(run, block_size, self.engines))
+            positions = self.index.positions()
+            candidates = [
+                u for u in self.index.fresh_engines(self.engines)
+                if u not in holders and u != owner
+                and positions.get(u, {}).get("block_size") == block_size
+            ]
+            if not candidates:
+                return
+            # least-loaded proxy: the smallest index slice has the least
+            # KV resident, hence the most room to host a replica
+            target = min(
+                candidates, key=lambda u: (positions[u]["hashes"], u)
+            )
+            sess = await self._sess()
+            wire = [str(h) for h in run]
+            async with sess.post(
+                target + "/kv/peer_replicate",
+                json={"owner": owner, "hashes": wire},
+            ) as resp:
+                data = await resp.json()
+            adopted = int(data.get("adopted") or 0)
+            if not adopted:
+                return
+            self.replications_ordered += 1
+            logger.info(
+                "replicated %d-block crowd prefix %s -> %s",
+                adopted, owner, target,
+            )
+            async with sess.post(
+                owner + "/kv/replicated", json={"hashes": wire[:adopted]}
+            ) as resp:
+                await resp.read()
+        except Exception as e:
+            logger.debug("crowd-prefix replication failed: %s", e)
 
     async def _handle_events(self, request: web.Request) -> web.Response:
         raw = await request.text()
@@ -273,6 +383,10 @@ class KVController:
         if not url:
             return web.json_response({"error": "url is required"}, status=400)
         self.engines.add(url)
+        # mesh identity rides the registration (docs/39): a falsy value
+        # CLEARS a previous identity — a pod restarted without
+        # KV_MESH_GROUP must stop negotiating "device"
+        self.index.set_transport(url, body.get("transport"))
         return web.json_response({"status": "ok", "engines": sorted(self.engines)})
 
     async def _handle_deregister(self, request: web.Request) -> web.Response:
@@ -339,6 +453,8 @@ class KVController:
         ]
         for mode, n in sorted(self.lookup_counts.items()):
             lines.append(f'{mc.CLUSTER_KV_LOOKUPS}{{mode="{mode}"}} {n}')
+        lines.append(f"# TYPE {mc.CLUSTER_KV_REPLICATIONS} counter")
+        lines.append(f"{mc.CLUSTER_KV_REPLICATIONS} {self.replications_ordered}")
         lines += self.index.lookups.render(mc.CLUSTER_KV_LOOKUP_LATENCY)
         # event-loop starvation (docs/37-flight-recorder.md): same name
         # wherever an asyncio control-plane loop lives (router replicas
@@ -411,6 +527,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "reports against (tpu:fleet_tenant_* on /metrics "
                         "and GET /fleet). Unset = fleet reports are still "
                         "aggregated, utilization gauges are absent")
+    p.add_argument("--replicate-threshold", type=int, default=0,
+                   help="proactive flash-crowd replication (docs/39-device-"
+                        "peer-kv.md): /peer_lookup hits per prefix per "
+                        "--replicate-window crossing this order a push "
+                        "replica of the hot run onto the least-loaded "
+                        "fresh non-holder. 0 (default) disables the loop")
+    p.add_argument("--replicate-window", type=float, default=10.0,
+                   help="seconds of /peer_lookup history the flash-crowd "
+                        "rate is measured over")
+    p.add_argument("--replicate-max-blocks", type=int, default=16,
+                   help="longest run (KV blocks) one replication order "
+                        "ships — bounds the target's adoption burst")
+    p.add_argument("--replicate-cooldown", type=float, default=30.0,
+                   help="seconds before the same prefix may be replicated "
+                        "again (lets the index catch up with the new "
+                        "holder before re-evaluating the crowd)")
     p.add_argument("--fleet-rate-window", type=float, default=30.0,
                    help="seconds of router-report history the fleet-wide "
                         "per-tenant admission RATE is measured over "
@@ -435,6 +567,10 @@ def main(argv: list[str] | None = None) -> None:
         base_models=[m for m in args.base_models.split(",") if m],
         tenant_table=tenant_table,
         fleet_rate_window_s=args.fleet_rate_window,
+        replicate_threshold=args.replicate_threshold,
+        replicate_window_s=args.replicate_window,
+        replicate_max_blocks=args.replicate_max_blocks,
+        replicate_cooldown_s=args.replicate_cooldown,
     )
     logger.info("KV controller on %s:%d over %d engines (mode=%s)",
                 args.host, args.port, len(urls), args.mode)

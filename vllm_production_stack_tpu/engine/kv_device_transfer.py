@@ -31,6 +31,7 @@ remains the cross-cluster / cross-runtime fallback.
 from __future__ import annotations
 
 import functools
+import os
 import time
 
 import jax
@@ -42,6 +43,35 @@ from ..utils.logging import init_logger
 from .kv_flow import NULL_FLOW
 
 logger = init_logger(__name__)
+
+# operator-assigned mesh-group name (helm: modelSpec.kvMeshGroup / the
+# multihost StatefulSet sets it to the slice identity): engines sharing a
+# value AND a 2-process jax.distributed runtime negotiate the device-path
+# peer transport (docs/39-device-peer-kv.md)
+ENV_MESH_GROUP = "KV_MESH_GROUP"
+
+
+def device_transport_identity() -> dict | None:
+    """This engine's mesh/process-group identity, advertised through KV
+    registration so /peer_lookup replies (and /kv/peer_contains replies on
+    the owner-hint path) can negotiate a per-pair transport. None when the
+    engine cannot take part in device-path pulls: no mesh group assigned,
+    or not running inside a multi-process jax.distributed program."""
+    group = os.environ.get(ENV_MESH_GROUP, "")
+    if not group:
+        return None
+    try:
+        n = jax.process_count()
+        i = jax.process_index()
+    except Exception:  # noqa: BLE001 — uninitialized runtime ⇒ no identity
+        return None
+    if n < 2:
+        return None
+    return {
+        "mesh_group": group,
+        "process_index": int(i),
+        "process_count": int(n),
+    }
 
 
 def _pow2(n: int) -> int:
@@ -245,6 +275,46 @@ def ship_kv_device_crossproc(
 
     Same degradation contract as ship_kv_device: nothing resident or a
     full destination pool → 0 adopted, decode recomputes."""
+    if role not in ("prefill", "decode"):
+        raise ValueError(f"role must be prefill|decode, got {role!r}")
+    pool = engine.scheduler.pool
+    root = engine._cache_root(lora_name)
+    chain = list(pool._chain(list(token_ids), root))
+    return kv_device_crossproc_transfer(engine, role == "prefill", chain)
+
+
+def pull_kv_device_crossproc(
+    engine, is_src: bool, hashes: list[int]
+) -> int:
+    """Peer-hydration device pull: the mesh-peer generalization of the PD
+    ship above (docs/39-device-peer-kv.md). Both processes call this with
+    the SAME explicit hash run — the puller's Hydrator hands the owner the
+    run over HTTP (/kv/peer_device_pull) and then both sides meet inside
+    the identical cooperative program: fingerprint allgather, residency
+    publish, staging, go/no-go, pairwise shard flips. No token ids or
+    chain derivation: hydration chunks start mid-chain, where only the
+    hashes identify the blocks.
+
+    Returns the number of run hashes resident on the puller after the
+    transfer (freshly shipped + already-resident members, all parked at
+    refcount 0 for the step thread's adopt_planned_run to re-acquire);
+    always 0 on the owner. Degradation contract unchanged: nothing
+    resident, a full pool, or a one-sided preparation failure → 0 / a
+    raise, and the puller's chunk falls back to recompute."""
+    return kv_device_crossproc_transfer(
+        engine, is_src, list(hashes), kind="peer pull"
+    )
+
+
+def kv_device_crossproc_transfer(
+    engine,
+    is_src: bool,
+    chain: list[int],
+    kind: str = "prefill→decode",
+) -> int:
+    """The shared 2-process cooperative transfer program (see the public
+    wrappers above for the two call shapes). `chain` must be identical on
+    both sides; `is_src` must be True on exactly one."""
     import hashlib
 
     import jax.numpy as jnp
@@ -252,18 +322,14 @@ def ship_kv_device_crossproc(
     from jax.sharding import Mesh
 
     pool = engine.scheduler.pool
-    root = engine._cache_root(lora_name)
-    is_src = role == "prefill"
-    if role not in ("prefill", "decode"):
-        raise ValueError(f"role must be prefill|decode, got {role!r}")
     if jax.process_count() != 2:
         # >2 processes (e.g. several decode hosts) needs a pairwise
         # rendezvous so only ONE destination stages/joins the transfer —
         # raising here beats deadlocking the distributed runtime
         # mid-collective with every decode host staged at once
         raise NotImplementedError(
-            "ship_kv_device_crossproc is a 2-process (one prefill, one "
-            f"decode) shape; got {jax.process_count()} processes"
+            "cross-process device KV transfer is a 2-process (one source, "
+            f"one destination) shape; got {jax.process_count()} processes"
         )
 
     # fingerprint gate across processes: publish a fixed-size digest
@@ -278,8 +344,7 @@ def ship_kv_device_crossproc(
             "foreign KV"
         )
 
-    # both sides derive the identical chain; the source counts residency
-    chain = list(pool._chain(list(token_ids), root))
+    # the source counts its consecutive residency of the (shared) chain
     n_src = 0
     if is_src:
         for h in chain:
@@ -510,8 +575,11 @@ def ship_kv_device_crossproc(
     if not is_src:
         pool.commit_adoption(staged, pinned)
         logger.info(
-            "cross-process device-shipped %d KV blocks (%d offered) "
-            "prefill→decode", len(staged), n_avail,
+            "cross-process device-shipped %d KV blocks (%d offered, %d "
+            "already resident) %s", len(staged), n_avail, len(pinned), kind,
         )
-        return len(staged)
+        # pinned members count: for a peer pull the caller needs "how much
+        # of the run is resident NOW", and already-resident chain members
+        # satisfy the run exactly like freshly shipped ones
+        return len(staged) + len(pinned)
     return 0

@@ -85,6 +85,10 @@ class HostKVTier:
         # is still locally reloadable (HBM / this ring / disk) before
         # emitting a cluster evict event
         self.on_drop = None
+        # migration-aware victim ordering (set by KVBlockPool, docs/39):
+        # hash -> bool "a peer engine holds a copy"; budget evictions
+        # prefer replicated entries from the oldest end of the ring
+        self.is_replicated = None
         self.stats = HostTierStats()
 
     def _resolve(self, h: int):
@@ -182,9 +186,23 @@ class HostKVTier:
         self.stats.offloads += 1
         self._evict_to_budget()
 
+    # oldest-end window scanned for a peer-replicated victim (mirrors
+    # KVBlockPool._VICTIM_SCAN — same migration-aware ordering, ring rung)
+    _VICTIM_SCAN = 32
+
+    def _pick_evict(self) -> tuple[int, object]:
+        isrep = self.is_replicated
+        if isrep is not None:
+            for i, h in enumerate(self._data):
+                if i >= self._VICTIM_SCAN:
+                    break
+                if isrep(h):
+                    return h, self._data.pop(h)
+        return self._data.popitem(last=False)
+
     def _evict_to_budget(self) -> None:
         while len(self._data) > self.num_blocks:
-            evicted, entry = self._data.popitem(last=False)
+            evicted, entry = self._pick_evict()
             if evicted in self._pending:
                 self._pending.remove(evicted)
             need_bytes = self.disk is not None or (

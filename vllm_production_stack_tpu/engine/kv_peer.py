@@ -130,6 +130,13 @@ class PeerKVTier:
         self.cooldown_s = cooldown_s
         self.flow = flow if flow is not None else NULL_FLOW
         self.stats = PeerTierStats()
+        # device-transport negotiation (docs/39-device-peer-kv.md): this
+        # engine's mesh/process-group identity (None = HTTP only), plus the
+        # per-owner transport learned from /peer_lookup hints and
+        # /kv/peer_contains replies. probe_prefix reads transport_for() to
+        # label the continuation "device" vs "peer".
+        self.transport_identity: dict | None = None
+        self._owner_transport: dict[str, str] = {}
         # step-thread probe connections: one to the lookup host, one per
         # owner — guarded by one lock (admission is single-threaded today;
         # the lock keeps that an implementation detail, not a contract)
@@ -175,11 +182,16 @@ class PeerKVTier:
         if not self.lookup_url or not self._available(self.lookup_url):
             return "", 0
         self.stats.lookups += 1
-        body = json.dumps({
+        req = {
             "hashes": [f"{h:x}" for h in hashes[:MAX_PEER_RUN_BLOCKS]],
             "block_size": block_size,
             "exclude": self.self_url,
-        }).encode()
+        }
+        if self.transport_identity:
+            # the index negotiates a per-pair transport hint from this
+            # identity and the owner's registered one (docs/39)
+            req["transport"] = self.transport_identity
+        body = json.dumps(req).encode()
         try:
             with self._probe_mu:
                 status, _, payload = self._conn_for(self.lookup_url).request(
@@ -203,6 +215,11 @@ class PeerKVTier:
         matched = int(data.get("matched_blocks") or 0)
         if not owner or matched <= 0 or owner == self.self_url:
             return "", 0
+        # transport hint from the lookup reply ("device"|"http"; absent on
+        # pre-39 services = http) — remembered per owner for probe_prefix
+        self._owner_transport[owner] = (
+            "device" if data.get("transport") == "device" else "peer"
+        )
         self.stats.lookup_hits += 1
         return owner, matched
 
@@ -233,9 +250,29 @@ class PeerKVTier:
         if status != 200:
             return 0
         try:
-            return max(0, int(json.loads(payload).get("matched") or 0))
+            data = json.loads(payload)
         except ValueError:
             return 0
+        # the owner echoes its mesh identity; negotiating HERE (not just at
+        # /peer_lookup) covers the router's owner-hint path — which never
+        # touches the lookup service — and re-validates a possibly-stale
+        # index-side hint against the owner's live identity
+        from ..kv_index import negotiate_transport
+
+        self._owner_transport[owner] = (
+            "device"
+            if negotiate_transport(
+                self.transport_identity, data.get("transport")
+            ) == "device"
+            else "peer"
+        )
+        return max(0, int(data.get("matched") or 0))
+
+    def transport_for(self, owner: str) -> str:
+        """Tier label for a confirmed continuation on `owner`: "device"
+        when the last lookup/contains negotiation agreed on the device
+        path, else "peer" (host-staged HTTP)."""
+        return self._owner_transport.get(owner.rstrip("/"), "peer")
 
     # -- fetch (hydration fetcher thread) ----------------------------------
 
@@ -344,6 +381,8 @@ class PeerKVTier:
             "fetched_blocks": self.stats.fetched_blocks,
             "bootstrap_fetches": self.stats.bootstrap_fetches,
             "errors": self.stats.errors,
+            "transport_identity": self.transport_identity,
+            "owner_transports": dict(self._owner_transport),
             "cooling_down": sorted(
                 t for t, until in self._down_until.items() if until > now
             ),

@@ -1192,6 +1192,10 @@ class Scheduler:
         "disk": "disk_load",
         "remote": "remote_fetch",
         "peer": "peer_fetch",
+        # device-collective peer pulls attribute as peer_fetch too — the
+        # SOURCE (a peer engine) is the same, only the wire differs, and
+        # KV_HYDRATION_SOURCES is a closed contract set (docs/39)
+        "device": "peer_fetch",
     }
 
     def _attribute_hydration(

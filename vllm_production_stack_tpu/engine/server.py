@@ -237,6 +237,9 @@ class EngineServer:
         r.add_post("/kv/lookup", self.kv_lookup)
         r.add_post("/kv/peer_contains", self.kv_peer_contains)
         r.add_post("/kv/peer_fetch", self.kv_peer_fetch)
+        r.add_post("/kv/peer_device_pull", self.kv_peer_device_pull)
+        r.add_post("/kv/peer_replicate", self.kv_peer_replicate)
+        r.add_post("/kv/replicated", self.kv_replicated)
         r.add_post("/kv/export", self.kv_export)
         r.add_post("/kv/export_stream", self.kv_export_stream)
         r.add_post("/kv/import", self.kv_import)
@@ -357,10 +360,18 @@ class EngineServer:
         port = os.environ.get("ENGINE_PORT", "8000")
         my_url = f"http://{pod_ip}:{port}"
 
+        body: dict = {"url": my_url}
+        identity = self._device_identity()
+        if identity is not None:
+            # mesh/process-group identity rides the registration so
+            # /peer_lookup replies can negotiate the device-path peer
+            # transport per (requester, owner) pair (docs/39)
+            body["transport"] = identity
+
         async def post_one(controller: str) -> None:
             try:
                 async with self._client_session().post(
-                    controller.rstrip("/") + endpoint, json={"url": my_url},
+                    controller.rstrip("/") + endpoint, json=body,
                     headers=self._kv_controller_headers(),
                 ) as resp:
                     logger.info(
@@ -1757,6 +1768,24 @@ class EngineServer:
         )
         return web.json_response({"matched_tokens": n})
 
+    def _device_identity(self) -> dict | None:
+        """This engine's advertised mesh identity, or None: transport
+        opt-in (kv_peer_transport auto|device) AND a live 2+-process
+        jax.distributed runtime with KV_MESH_GROUP assigned. An engine
+        can be a device-pull OWNER without consuming the peer tier, so
+        this doesn't require peer_tier."""
+        cfg = getattr(self.engine, "config", None)
+        if cfg is None or getattr(
+            cfg, "kv_peer_transport", "http"
+        ) not in ("auto", "device"):
+            return None
+        peer = getattr(self.engine, "peer_tier", None)
+        if peer is not None and peer.transport_identity is not None:
+            return peer.transport_identity
+        from .kv_device_transfer import device_transport_identity
+
+        return device_transport_identity()
+
     @staticmethod
     def _parse_peer_hashes(body: dict) -> list[int] | None:
         """Decimal-string hash list of one peer probe/fetch, bounded and
@@ -1785,7 +1814,15 @@ class EngineServer:
         if hashes is None:
             return error(400, "hashes must be a list of decimal strings")
         n = await self.async_engine.kv_peer_contains(hashes)
-        return web.json_response({"matched": n})
+        reply: dict = {"matched": n}
+        identity = self._device_identity()
+        if identity is not None:
+            # echo this owner's mesh identity so the probing peer can
+            # (re-)negotiate the transport against a FRESH view — the
+            # owner-hint path never consults the controller, and a stale
+            # index hint must re-validate here before any collective
+            reply["transport"] = identity
+        return web.json_response(reply)
 
     async def kv_peer_fetch(self, request: web.Request) -> web.Response:
         """Peer-engine KV tier, sender half: the consecutive locally-
@@ -1852,6 +1889,55 @@ class EngineServer:
                 "X-KV-Fingerprint": self.engine.model_fingerprint,
             },
         )
+
+    async def kv_peer_device_pull(self, request: web.Request) -> web.Response:
+        """Owner trigger of a device-collective peer pull (docs/39): the
+        puller POSTs the hash run, then BOTH processes meet inside the
+        same cooperative transfer program (kv_device_transfer.pull_kv_
+        device_crossproc). The handler always enters the collective once
+        the run parses — the program's own fingerprint allgather and
+        go/no-go barrier abort both sides cooperatively, so a refusal
+        can never leave the puller wedged mid-collective. The reply
+        lands only after the owner's half completes (the puller reads
+        it AFTER its own half — split send/read)."""
+        body = await request.json()
+        hashes = self._parse_peer_hashes(body)
+        if hashes is None:
+            return error(400, "hashes must be a list of decimal strings")
+        try:
+            await self.async_engine.kv_peer_device_serve(hashes)
+        except Exception as e:
+            # aborted cooperatively (fingerprint gate, peer prep failure,
+            # unsupported mesh shape) — the puller already degraded its
+            # chunk to fallback_recompute; this status is informational
+            logger.warning("device peer pull serve aborted: %s", e)
+            return error(409, f"device pull aborted: {e}", "conflict")
+        return web.json_response({"ok": True})
+
+    async def kv_peer_replicate(self, request: web.Request) -> web.Response:
+        """Proactive flash-crowd replication, target half (docs/39): the
+        controller orders THIS engine to fetch a hot prefix from its
+        owner (HTTP peer path) and adopt it parked — after which the
+        cluster index shows a second holder and the router can fan the
+        crowd out. The wire fetch runs off the step lock."""
+        body = await request.json()
+        owner = str(body.get("owner") or "").rstrip("/")
+        hashes = self._parse_peer_hashes(body)
+        if not owner or not owner.startswith("http") or hashes is None:
+            return error(400, "need owner url and a hash list")
+        n = await self.async_engine.kv_peer_replicate(owner, hashes)
+        return web.json_response({"adopted": n})
+
+    async def kv_replicated(self, request: web.Request) -> web.Response:
+        """Replication notification to the OWNER: a peer now holds copies
+        of these hashes, so migration-aware eviction prefers them as
+        victims from here on (pool + host ring, docs/39)."""
+        body = await request.json()
+        hashes = self._parse_peer_hashes(body)
+        if hashes is None:
+            return error(400, "hashes must be a list of decimal strings")
+        n = await self.async_engine.kv_mark_replicated(hashes)
+        return web.json_response({"resident": n})
 
     async def kv_export(self, request: web.Request) -> web.Response:
         """Disaggregated prefill, sender side: the prompt's resident KV
@@ -2233,6 +2319,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "KV_CONTROLLER_URL subscriber. The serving "
                         "endpoints (/kv/peer_contains, /kv/peer_fetch) "
                         "are always mounted regardless")
+    p.add_argument("--kv-peer-transport", default="http",
+                   choices=["http", "device", "auto"],
+                   help="wire of the peer KV tier (docs/39-device-peer-kv"
+                        ".md): http always pulls over /kv/peer_fetch; "
+                        "auto/device advertise this engine's mesh identity "
+                        "(KV_MESH_GROUP + jax.distributed shape) through "
+                        "KV registration and pull over ICI/DCN device "
+                        "collectives when the owner shares the mesh, "
+                        "falling back to HTTP otherwise; device "
+                        "additionally warns when no identity is available")
     p.add_argument("--kv-peer-fetch-timeout-s", type=float, default=2.0,
                    help="per-round-trip timeout of peer lookups/probes/"
                         "fetches (probes run on the step thread, so this "
@@ -2454,6 +2550,7 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         ),
         kv_hydration_timeout_s=getattr(args, "kv_hydration_timeout_s", 0.0),
         kv_peer_fetch=getattr(args, "kv_peer_fetch", False),
+        kv_peer_transport=getattr(args, "kv_peer_transport", "http"),
         kv_peer_fetch_timeout_s=getattr(
             args, "kv_peer_fetch_timeout_s", 2.0
         ),

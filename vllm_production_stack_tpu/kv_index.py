@@ -80,6 +80,27 @@ def chain_hashes(
     )
 
 
+def negotiate_transport(a: dict | None, b: dict | None) -> str:
+    """Pick the peer-KV transport two engines can actually use (docs/39).
+
+    "device" only when both advertise the SAME named mesh group AND form
+    the exactly-supported collective shape: a 2-process jax.distributed
+    program with the two engines on different process indices (the pairwise
+    shard-flip program in kv_device_transfer handles exactly this shape).
+    Anything else — either side silent, group mismatch, >2 processes, or
+    the same process twice — is "http", the always-correct fallback."""
+    if not a or not b:
+        return "http"
+    group = a.get("mesh_group") or ""
+    if not group or group != (b.get("mesh_group") or ""):
+        return "http"
+    if a.get("process_count") != 2 or b.get("process_count") != 2:
+        return "http"
+    if a.get("process_index") == b.get("process_index"):
+        return "http"
+    return "device"
+
+
 class LookupLatency:
     """Tiny fixed-bucket latency histogram, rendered in Prometheus text
     exposition. Shared by the controller's /metrics and the router's — both
@@ -172,6 +193,26 @@ class ClusterKVIndex:
         # oldest event's emit wall-time on each POST (kv_events.py "ts");
         # heartbeats apply nothing and are not observed.
         self.convergence = ConvergenceMeter()
+        # device-transport identities (docs/39-device-peer-kv.md): engines
+        # advertising a mesh group via /register land here; /peer_lookup
+        # replies negotiate a per-pair transport hint from these. Kept
+        # beside the event slices (not on _EngineView) — registration and
+        # event publishing have independent lifecycles.
+        self._transports: dict[str, dict] = {}
+
+    # -- device-transport identities ---------------------------------------
+
+    def set_transport(self, url: str, identity: dict | None) -> None:
+        url = url.rstrip("/")
+        with self._lock:
+            if identity:
+                self._transports[url] = dict(identity)
+            else:
+                self._transports.pop(url, None)
+
+    def get_transport(self, url: str) -> dict | None:
+        with self._lock:
+            return self._transports.get(url.rstrip("/"))
 
     # -- event ingestion ---------------------------------------------------
 
@@ -286,6 +327,7 @@ class ClusterKVIndex:
         _purge_dead_locked reclaims the memory of truly-gone engines."""
         with self._lock:
             self._engines.pop(url.rstrip("/"), None)
+            self._transports.pop(url.rstrip("/"), None)
 
     # -- queries -----------------------------------------------------------
 
@@ -386,6 +428,27 @@ class ClusterKVIndex:
                 if matched > best_blocks:
                     best_url, best_blocks = u, matched
             return best_url, best_blocks
+
+    def holders(
+        self, hashes: list[int], block_size: int,
+        urls: set[str] | None = None,
+    ) -> list[str]:
+        """Fresh engines (block size matching) whose slice shows the ENTIRE
+        hash run resident — the replica count the proactive-replication
+        loop and migration-aware eviction key off (docs/39). Sorted for
+        determinism."""
+        candidates = self.fresh_engines(urls)
+        if not candidates or not hashes:
+            return []
+        out: list[str] = []
+        with self._lock:
+            for u in sorted(candidates):
+                v = self._engines.get(u)
+                if v is None or v.block_size != block_size:
+                    continue
+                if all(h in v.hashes for h in hashes):
+                    out.append(u)
+        return out
 
     def positions(self) -> dict[str, dict]:
         """Per-engine (epoch, seq) positions + slice sizes — the replica-

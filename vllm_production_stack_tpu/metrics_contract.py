@@ -321,6 +321,10 @@ CLUSTER_KV_RESYNCS = "tpu:cluster_kv_resyncs_total"
 CLUSTER_KV_LOOKUPS = "tpu:cluster_kv_lookups_total"
 # histogram labeled mode= (kv_index.LookupLatency renders it)
 CLUSTER_KV_LOOKUP_LATENCY = "tpu:cluster_kv_lookup_latency_seconds"
+# counter: proactive flash-crowd replications the controller ordered AND
+# saw adopted (docs/39-device-peer-kv.md — the BanaServe push-replication
+# half; 0 while --replicate-threshold is unset/0)
+CLUSTER_KV_REPLICATIONS = "tpu:cluster_kv_replications_total"
 
 # -- fleet-coherence telemetry (docs/32-fleet-telemetry.md) ------------------
 # The measurement layer ROADMAP 1's multi-replica router refactor builds
@@ -448,6 +452,7 @@ CLUSTER_KV_COUNTERS = (
     CLUSTER_KV_EVENTS,
     CLUSTER_KV_RESYNCS,
     CLUSTER_KV_LOOKUPS,
+    CLUSTER_KV_REPLICATIONS,
 )
 
 # -- router-side robustness (NOT part of the per-engine scrape contract:

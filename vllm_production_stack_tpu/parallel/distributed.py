@@ -77,6 +77,18 @@ def maybe_initialize(mode: str = "auto") -> bool:
     addr, n, pid = env
     import jax
 
+    # the CPU PJRT client ships without cross-process collectives
+    # ("Multiprocess computations aren't implemented on the CPU
+    # backend"); gloo turns them on. Every cross-process transfer
+    # program in this repo (PD ship, device-path peer pulls) and the
+    # multiprocess dryruns need this on the cpu backend; it's a no-op
+    # for TPU (the option only shapes the CPU client). Must land before
+    # the first backend touch, which is why it lives here.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — jax without the knob: keep going
+        pass
+
     logger.info(
         "initializing jax.distributed: coordinator=%s processes=%d "
         "process_id=%d", addr, n, pid,
@@ -263,6 +275,210 @@ def _pd_worker() -> None:
     multihost_utils.sync_global_devices("pd-done")
 
 
+def _device_peer_worker() -> None:
+    """One process of the device-path PEER KV dryrun (docs/39): process 0
+    is an OWNER engine serving the real HTTP app (EngineServer — its
+    AsyncEngine step loop shares the quiescence lock the device serve
+    takes); process 1 is a PULLER whose hydration planner labels the
+    prompt's continuation tier "device" through the owner-hint contains
+    probe, and whose fetcher thread pulls the pages over the cooperative
+    shard-flip collective instead of HTTP. The puller asserts the bytes
+    moved on (device, in) — NOT (peer, in) — that the admitted prompt's
+    tokens attribute to peer_fetch, and that the continuation is
+    token-identical to a from-scratch oracle engine (bit-identical pages
+    ⇒ identical greedy tokens)."""
+    import json
+    import threading
+    import time
+
+    import numpy as np
+
+    ok = maybe_initialize("on")
+    assert ok
+    import jax
+
+    n = jax.process_count()
+    pid = jax.process_index()
+    assert n == 2, f"device-peer dryrun is a 2-process shape, got {n}"
+    assert os.environ.get("KV_MESH_GROUP"), (
+        "spawner must export KV_MESH_GROUP — it is the transport identity"
+    )
+
+    from ..engine.config import (
+        CacheConfig, EngineConfig, ModelConfig, ParallelConfig,
+        SchedulerConfig,
+    )
+    from ..engine.engine import LLMEngine
+    from ..engine.request import SamplingParams
+    from . import mesh as mesh_lib
+
+    local_mesh = mesh_lib.make_mesh(
+        tensor_parallel_size=1, devices=jax.local_devices()[:1]
+    )
+    config = EngineConfig(
+        model=ModelConfig(
+            model="dryrun-devpeer-llama", vocab_size=128, hidden_size=32,
+            intermediate_size=64, num_layers=2, num_heads=2, num_kv_heads=2,
+            head_dim=16, max_model_len=64, dtype="float32",
+        ),
+        cache=CacheConfig(
+            block_size=8, num_blocks=32, num_host_blocks=16,
+        ),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, max_num_batched_tokens=32,
+            prefill_buckets=(32,), decode_buckets=(2,), decode_window=4,
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=1),
+        kv_peer_fetch=True,
+        kv_peer_transport="device",
+        # generous plan deadline: the first pull cold-compiles the
+        # shard-flip program, which would blow the 0.5s auto floor and
+        # flip the chunk to fallback_recompute before the bytes land
+        kv_hydration_timeout_s=120.0,
+    )
+    engine = LLMEngine(config, mesh=local_mesh)
+    assert engine.peer_tier is not None
+    assert engine.peer_tier.transport_identity is not None, (
+        "no mesh identity — KV_MESH_GROUP + jax.distributed should have "
+        "produced one"
+    )
+    rng = np.random.RandomState(7)
+    prompt = [int(x) for x in rng.randint(1, 128, size=24)]
+    sampling = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+
+    if pid == 0:
+        # ---- owner: compute the prompt's KV, then serve the real app.
+        # Prefill BEFORE the server starts: once AsyncEngine's step loop
+        # owns the engine, a second sync generate loop would race it for
+        # this request's outputs.
+        engine.generate([prompt], SamplingParams(
+            max_tokens=1, temperature=0.0, ignore_eos=True,
+        ))
+        import asyncio
+        import http.client
+
+        from aiohttp import web
+
+        from ..engine.server import EngineServer
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        srv = EngineServer(engine, watchdog=False)
+        done = threading.Event()
+        loop = asyncio.new_event_loop()
+
+        def _serve() -> None:
+            asyncio.set_event_loop(loop)
+
+            async def _done(request):
+                done.set()
+                return web.json_response({"ok": True})
+
+            app = srv.build_app()
+            app.router.add_post("/dryrun_done", _done)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            loop.run_until_complete(
+                web.TCPSite(runner, "127.0.0.1", port).start()
+            )
+            loop.run_forever()
+
+        # tpulint: allow(thread-lifecycle) — dryrun-subprocess serve loop;
+        # the worker OS process exits (and reclaims the daemon thread)
+        # right after the puller signals /dryrun_done
+        threading.Thread(
+            target=_serve, daemon=True, name="devpeer-serve"
+        ).start()
+        deadline = time.monotonic() + 60.0
+        while True:  # publish the port only once the app answers
+            try:
+                c = http.client.HTTPConnection("127.0.0.1", port, timeout=1)
+                c.request("GET", "/health")
+                c.getresponse().read()
+                c.close()
+                break
+            except OSError:
+                assert time.monotonic() < deadline, "engine app never came up"
+                time.sleep(0.05)
+        # publish the port through the coordination service's KV store —
+        # NOT an XLA collective (the CPU backend refuses whole-mesh
+        # multiprocess computations; the pairwise shard-flip transfer
+        # program is the only collective this dryrun should run)
+        from jax._src.distributed import global_state
+
+        global_state.client.key_value_set("devpeer_dryrun/port", str(port))
+        assert done.wait(timeout=240.0), "puller never signalled completion"
+        served = engine.flow.bytes[("device", "out")]
+        assert served > 0, "owner served no device-path bytes"
+        time.sleep(0.2)  # let the /dryrun_done reply flush before exit
+        print(f"DEVPEER_DRYRUN_OK role=owner served_bytes={served}",
+              flush=True)
+    else:
+        from jax._src.distributed import global_state
+
+        port = int(global_state.client.blocking_key_value_get(
+            "devpeer_dryrun/port", 120_000
+        ))
+        owner_url = f"http://127.0.0.1:{port}"
+        # warm the compute estimator: plan_decisions cannot engage on a
+        # cold engine (no achieved FLOP/s and no chip peak on CPU), and a
+        # declined plan recomputes everything — the device lane would
+        # never fire. A throwaway generate (disjoint tokens, no prefix
+        # collision with the real prompt) gives the StepMeter its
+        # sample, exactly like the peer tests' _warm helper. It must run
+        # several dispatches: the meter's wall clock starts at the FIRST
+        # record call (which reads wall=0 and cannot update the EWMA), so
+        # only the decode steps after the prefill feed achieved-FLOP/s.
+        engine.generate([[9] * 8], SamplingParams(
+            max_tokens=4, temperature=0.0, ignore_eos=True,
+        ))
+        # seed the device estimator past the TierBandwidth sample floor:
+        # an unmeasured device tier prices recompute (never declines, but
+        # never loads either) — in production the Hydrator's bootstrap
+        # pulls cross the floor; here we pin a fast estimate so the plan
+        # deterministically decides "load" and the pull happens
+        now = time.perf_counter()
+        est = engine.flow.bandwidth[("device", "in")]
+        est.record(1 << 20, 1e-3, now)
+        est.record(1 << 20, 1e-3, now + 1e-3)
+        base_peer = engine.flow.bytes[("peer", "in")]
+        t0 = time.perf_counter()
+        out = engine.generate(
+            [prompt], sampling, kv_owner_hint=owner_url
+        )[0]["token_ids"]
+        latency = time.perf_counter() - t0
+        dev_bytes = engine.flow.bytes[("device", "in")]
+        assert dev_bytes > 0, "no bytes moved on the device path"
+        assert engine.flow.transfers[("device", "in")] >= 1
+        assert engine.flow.bytes[("peer", "in")] == base_peer, (
+            "puller fell back to HTTP peer fetch"
+        )
+        assert engine.flow.hydration["peer_fetch"] > 0, (
+            "admitted prompt attributed no tokens to peer_fetch"
+        )
+        # oracle: a fresh same-seed engine computing every token itself —
+        # identical continuation proves the pulled pages carry the exact
+        # bytes the owner's prefill produced
+        oracle = LLMEngine(config, mesh=local_mesh)
+        want = oracle.generate([prompt], sampling)[0]["token_ids"]
+        assert out == want, (out, want)
+        import http.client
+
+        try:
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            c.request("POST", "/dryrun_done", body=json.dumps({}))
+            c.getresponse().read()
+            c.close()
+        except OSError:
+            pass  # owner may already be tearing down
+        print(
+            f"DEVPEER_DRYRUN_OK pulled_bytes={dev_bytes} "
+            f"latency_s={latency:.3f} continuation={out[:4]}...",
+            flush=True,
+        )
+
+
 def _spawn_workers(
     n_processes: int, flag: str, timeout_s: float, ok_marker: str,
     devices_per_proc: int = 1, extra_env: dict | None = None,
@@ -344,6 +560,18 @@ def run_multiprocess_pd_dryrun(timeout_s: float = 300.0, tp: int = 1):
     )
 
 
+def run_multiprocess_device_peer_dryrun(timeout_s: float = 300.0):
+    """2 processes: an owner engine serving the real HTTP app and a puller
+    whose hydration fetch lane pulls the prompt's KV over the device
+    collective path (docs/39-device-peer-kv.md) — transport negotiated
+    through the owner-hint contains probe, bytes metered under
+    (device, in), continuation bit-identical to a from-scratch oracle."""
+    return _spawn_workers(
+        2, "--device-peer-worker", timeout_s, "DEVPEER_DRYRUN_OK",
+        extra_env={"KV_MESH_GROUP": "devpeer-dryrun"},
+    )
+
+
 def main() -> None:
     import argparse
 
@@ -352,16 +580,23 @@ def main() -> None:
                    help="run as one process of the multi-process dryrun")
     p.add_argument("--pd-worker", action="store_true",
                    help="run as one process of the cross-process PD dryrun")
+    p.add_argument("--device-peer-worker", action="store_true",
+                   help="run as one process of the device-path peer KV "
+                        "dryrun")
     p.add_argument("--processes", type=int, default=2)
     args = p.parse_args()
     if args.worker:
         _worker()
     elif args.pd_worker:
         _pd_worker()
+    elif args.device_peer_worker:
+        _device_peer_worker()
     else:
         run_multiprocess_dryrun(args.processes)
         run_multiprocess_pd_dryrun()
-        print(f"multi-process dryrun OK ({args.processes} processes + PD)")
+        run_multiprocess_device_peer_dryrun()
+        print(f"multi-process dryrun OK ({args.processes} processes + PD "
+              "+ device-peer)")
 
 
 if __name__ == "__main__":

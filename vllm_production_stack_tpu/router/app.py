@@ -534,7 +534,20 @@ async def handle_peer_lookup(request: web.Request) -> web.Response:
     url, matched = index.lookup_hashes(
         hashes, block_size, exclude=body.get("exclude") or None
     )
-    return web.json_response({"url": url, "matched_blocks": matched})
+    reply: dict = {"url": url, "matched_blocks": matched}
+    if url:
+        # transport hint (docs/39-device-peer-kv.md): same negotiation the
+        # controller runs — "device" only when asker and owner advertised
+        # the same mesh group at registration; omitted otherwise (absent
+        # means HTTP, keeping pre-39 reply shapes byte-stable)
+        from ..kv_index import negotiate_transport
+
+        hint = negotiate_transport(
+            body.get("transport"), index.get_transport(url)
+        )
+        if hint == "device":
+            reply["transport"] = hint
+    return web.json_response(reply)
 
 
 async def handle_kv_register(request: web.Request) -> web.Response:
@@ -552,6 +565,12 @@ async def handle_kv_register(request: web.Request) -> web.Response:
     url = (body.get("url") or "").rstrip("/")
     if request.path == "/deregister" and url:
         index.remove_engine(url)
+    elif url:
+        # remember the engine's device-transport identity (mesh group +
+        # process coords) so /peer_lookup replies can carry the hint;
+        # falsy/absent clears — an engine restarted without a mesh must
+        # not keep a stale "device" advertisement
+        index.set_transport(url, body.get("transport"))
     return web.json_response({"status": "ok"})
 
 

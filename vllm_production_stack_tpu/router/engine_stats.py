@@ -37,6 +37,11 @@ class EngineStats:
     # MEASURED and scoring below it keeps owner affinity / the
     # exploration rule) and its analytic KV bytes per token
     kv_peer_bw_in_bytes_per_s: float = 0.0
+    # device-path peer pulls (docs/39-device-peer-kv.md): measured ICI/DCN
+    # collective bandwidth {tier="device",direction="in"} — once nonzero
+    # the migrate pricing uses max(peer, device), so the scoring shifts
+    # toward migration automatically as the faster link gets measured
+    kv_device_bw_in_bytes_per_s: float = 0.0
     kv_bytes_per_token: float = 0.0
 
     _FIELDS = {
@@ -64,11 +69,15 @@ class EngineStats:
                 field = cls._FIELDS.get(sample.name)
                 if field is not None:
                     setattr(stats, field, sample.value)
-                elif sample.name == mc.KV_TIER_BANDWIDTH and (
-                    sample.labels.get("tier") == "peer"
+                elif (
+                    sample.name == mc.KV_TIER_BANDWIDTH
                     and sample.labels.get("direction") == "in"
                 ):
-                    stats.kv_peer_bw_in_bytes_per_s = sample.value
+                    tier = sample.labels.get("tier")
+                    if tier == "peer":
+                        stats.kv_peer_bw_in_bytes_per_s = sample.value
+                    elif tier == "device":
+                        stats.kv_device_bw_in_bytes_per_s = sample.value
         return stats
 
 

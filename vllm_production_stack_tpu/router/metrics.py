@@ -127,6 +127,16 @@ class RouterMetrics:
             buckets=LookupLatency.BUCKETS,
             registry=self.registry,
         )
+        self.kv_replications = Counter(
+            # the flash-crowd replication loop (docs/39-device-peer-kv.md)
+            # lives in the KV controller, which renders the live series on
+            # its /metrics; the embedded index has no replication loop, so
+            # this stays 0 here — exported anyway so the name keeps one
+            # home per deployment shape, like the rest of CLUSTER_KV_*
+            mc.CLUSTER_KV_REPLICATIONS,
+            "Flash-crowd prefix replications ordered by the cluster index",
+            registry=self.registry,
+        )
         # priced route-vs-migrate (docs/35-peer-kv-reuse.md): per-request
         # verdicts once a prefix owner was found (closed decision set,
         # seeded at zero) — the router half of the peer-tier loop

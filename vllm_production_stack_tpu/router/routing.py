@@ -400,9 +400,10 @@ class KvawarePolicy(RoutingPolicy):
           heuristic (SEAT_COST_S) while TTFT is still unmeasured;
         - **route-to-least-loaded + peer-pull**: the least-loaded
           engine's measured TTFT plus the migration cost
-          ``matched_tokens × kv_bytes_per_token ÷ peer_bandwidth`` from
-          the target's scraped tpu:kv_bytes_per_token and its measured
-          tpu:kv_tier_bandwidth_bytes_per_s{tier="peer",direction="in"}.
+          ``matched_tokens × kv_bytes_per_token ÷ wire_bandwidth`` from
+          the target's scraped tpu:kv_bytes_per_token and the faster of
+          its measured tpu:kv_tier_bandwidth_bytes_per_s
+          {tier="peer"|"device",direction="in"} links.
 
         Migration requires a strictly-less-loaded target and, normally, a
         measured peer bandwidth (>0) — the router-side analogue of the
@@ -434,8 +435,16 @@ class KvawarePolicy(RoutingPolicy):
             target = min(others, key=lambda u: (load(u), u))
             tstat = stats.get(target)
             owner_load, target_load = load(owner_url), load(target)
-            peer_bw = (
-                tstat.kv_peer_bw_in_bytes_per_s if tstat is not None else 0.0
+            # fastest measured wire into the target wins: HTTP peer pulls
+            # vs device-path collectives (docs/39-device-peer-kv.md) — a
+            # measured device link reprices migration without any config
+            peer_bw = max(
+                tstat.kv_peer_bw_in_bytes_per_s if tstat is not None else 0.0,
+                (
+                    tstat.kv_device_bw_in_bytes_per_s
+                    if tstat is not None
+                    else 0.0
+                ),
             )
             bpt = tstat.kv_bytes_per_token if tstat is not None else 0.0
             if bpt <= 0.0:
