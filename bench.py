@@ -3490,6 +3490,293 @@ def _phase_peer_main() -> None:
     print(json.dumps({"peer": result}), flush=True)
 
 
+async def _rebalance_bench() -> dict:
+    """Dynamic P/D pool rebalancing (docs/40-pool-rebalancing.md):
+    a decode-heavy workload shift against a statically partitioned
+    4-engine fleet (3 prefill + 1 decode, the wrong split for the
+    traffic). CPU-only, pre-preflight — fake engines + real router +
+    real KV controller hosting the real rebalancer, everything over
+    actual aiohttp wire.
+
+    - **static** (rebalancer off): decode queue-wait p95 blows through
+      the TpuSeatStarvation trigger (>1s queued while most of the
+      fleet's seats sit idle) and STAYS there — the imbalance needs a
+      human;
+    - **rebalance** (rebalancer on): the controller diagnoses the
+      decode-starved pool from the routers' fleet reports, drains the
+      least-loaded prefill engine, flips it via POST /role, and the
+      starvation condition clears — with ZERO failed requests and ZERO
+      severed streams (asserted; the 2-phase router path re-picks around
+      the drain refusals mid-flip);
+    - **chaos arms**: the flip target killed mid-drain (episode must
+      abandon, traffic must keep flowing) and a black-holed controller
+      (engines + routers fail open — the actuator's death must never
+      take the data plane with it)."""
+    import asyncio
+    import tempfile
+
+    import aiohttp
+    from aiohttp import web
+
+    from vllm_production_stack_tpu.engine.kv_controller import KVController
+    from vllm_production_stack_tpu.engine.rebalancer import RebalanceConfig
+    from vllm_production_stack_tpu.router.app import build_app
+    from vllm_production_stack_tpu.router.args import parse_args
+    from vllm_production_stack_tpu.testing.fake_engine import FakeEngine
+
+    N_PREFILL, N_DECODE, SEATS = 3, 1, 2
+    CLIENTS, GEN_TOKENS, TOKENS_PER_SEC = 12, 10, 40.0
+    TRIGGER_S = 1.0  # TpuSeatStarvation's queue-wait threshold
+
+    async def serve(app) -> tuple[web.AppRunner, str]:
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        return runner, f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+    async def run_arm(rebalance_on: bool, duration_s: float,
+                      chaos: str = "") -> dict:
+        runners: list[web.AppRunner] = []
+        state_dir = tempfile.mkdtemp(prefix="rebalance-bench-")
+        ctrl_url = ""
+        controller = None
+        if chaos == "blackhole_controller":
+            from vllm_production_stack_tpu.testing.faults import black_hole
+            hole, port = await black_hole()
+            ctrl_url = f"http://127.0.0.1:{port}"
+        else:
+            controller = KVController([], rebalance=RebalanceConfig(
+                enabled=rebalance_on, interval_s=0.2, observe_s=1.0,
+                cooldown_s=3.0, verify_window_s=1.0,
+                min_prefill=1, min_decode=1,
+                queue_wait_trigger_s=TRIGGER_S, occupancy_rich_max=0.5,
+                drain_timeout_s=10.0, unreachable_limit=3,
+                episode_timeout_s=60.0, engine_cooldown_s=5.0,
+                state_file=state_dir + "/rebalancer.json",
+            ))
+            ctrl_runner, ctrl_url = await serve(controller.build_app())
+            runners.append(ctrl_runner)
+
+        engines: list[FakeEngine] = []
+        urls: list[str] = []
+        labels: list[str] = []
+        url_runner: dict[str, web.AppRunner] = {}
+        for i in range(N_PREFILL + N_DECODE):
+            role = "prefill" if i < N_PREFILL else "decode"
+            eng = FakeEngine(
+                model="fake-model", tokens_per_sec=TOKENS_PER_SEC,
+                default_tokens=GEN_TOKENS, log_requests=False,
+                seats=SEATS, prefill_tps=4000.0, role=role,
+                kv_controller_url=ctrl_url,
+            )
+            runner, url = await serve(eng.build_app())
+            runners.append(runner)
+            eng.self_url = url
+            await eng._register()  # startup ran before self_url was known
+            engines.append(eng)
+            urls.append(url)
+            labels.append(role)
+            url_runner[url] = runner
+
+        router_runner, router_url = await serve(build_app(parse_args([
+            "--static-backends", ",".join(urls),
+            "--static-models", ";".join(["fake-model"] * len(urls)),
+            "--static-model-labels", ",".join(labels),
+            "--routing-logic", "disaggregated_prefill",
+            "--prefill-model-labels", "prefill",
+            "--decode-model-labels", "decode",
+            "--engine-stats-interval", "0.5",
+            "--fleet-report-url", ctrl_url,
+            "--fleet-report-interval", "0.3",
+            "--breaker-failure-threshold", "0",
+        ])))
+        runners.append(router_runner)
+
+        t_end = time.monotonic() + duration_s
+        t0_arm = time.monotonic()
+        ttfts: list[float] = []
+        completed = [0]
+        failures = [0]  # non-200 / transport errors — must stay 0
+        dropped = [0]   # 200 streams that never saw a clean [DONE]
+        killed = {"url": None}
+
+        async def client(i: int, sess: aiohttp.ClientSession) -> None:
+            r = 0
+            while time.monotonic() < t_end:
+                r += 1
+                prompt = f"pool shift load {i}-{r} " * 8
+                t0 = time.monotonic()
+                try:
+                    async with sess.post(
+                        router_url + "/v1/completions",
+                        json={"model": "fake-model", "prompt": prompt,
+                              "max_tokens": GEN_TOKENS, "stream": True},
+                    ) as resp:
+                        if resp.status != 200:
+                            failures[0] += 1
+                            continue
+                        first, clean = True, False
+                        async for line in resp.content:
+                            if first:
+                                ttfts.append(time.monotonic() - t0)
+                                first = False
+                            if line.decode().strip() == "data: [DONE]":
+                                clean = True
+                        if clean:
+                            completed[0] += 1
+                        else:
+                            dropped[0] += 1
+                except aiohttp.ClientError:
+                    failures[0] += 1
+
+        # starvation timeline off the controller's own /rebalance view:
+        # the TpuSeatStarvation shape — queued work past the trigger
+        # while most of the fleet's decode seats sit empty
+        samples: list[dict] = []
+
+        def starved_now(pools: dict) -> tuple[bool, float, float]:
+            members = [p for pool in pools.values() for p in pool.values()]
+            if not members:
+                return False, 0.0, 0.0
+            decode_qw = [p["queue_wait_p95"]
+                         for p in pools.get("decode", {}).values()]
+            mean_occ = (sum(p["seat_occupancy"] for p in members)
+                        / len(members))
+            max_qw = max(decode_qw) if decode_qw else 0.0
+            return (bool(decode_qw and max_qw > TRIGGER_S
+                         and mean_occ < 0.5), max_qw, mean_occ)
+
+        async def sampler(sess: aiohttp.ClientSession) -> None:
+            if controller is None:
+                return  # black-holed controller has no view to sample
+            while time.monotonic() < t_end:
+                try:
+                    async with sess.get(ctrl_url + "/rebalance") as resp:
+                        snap = await resp.json()
+                    starved, max_qw, mean_occ = starved_now(
+                        snap.get("pools") or {})
+                    samples.append({
+                        "t": round(time.monotonic() - t0_arm, 2),
+                        "starved": starved,
+                        "decode_qw_max_s": round(max_qw, 2),
+                        "mean_occupancy": round(mean_occ, 2),
+                        "phase": snap.get("phase"),
+                    })
+                    if (chaos == "kill_mid_drain"
+                            and killed["url"] is None
+                            and snap.get("episode")):
+                        victim = snap["episode"]["engine"]
+                        await url_runner[victim].cleanup()
+                        runners.remove(url_runner[victim])
+                        killed["url"] = victim
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.2)
+
+        try:
+            async with aiohttp.ClientSession() as sess:
+                await asyncio.gather(
+                    sampler(sess),
+                    *(client(i, sess) for i in range(CLIENTS)),
+                )
+                snap = {}
+                if controller is not None:
+                    async with sess.get(ctrl_url + "/rebalance") as resp:
+                        snap = await resp.json()
+        finally:
+            for runner in runners:
+                await runner.cleanup()
+            if chaos == "blackhole_controller":
+                hole.close()
+
+        elapsed = time.monotonic() - t0_arm
+        ttfts.sort()
+
+        def pct(p: float) -> float:
+            if not ttfts:
+                return 0.0
+            return round(ttfts[min(len(ttfts) - 1, int(p * len(ttfts)))], 3)
+
+        tail = [s for s in samples if s["t"] > duration_s - 1.5]
+        # run-length compress the starvation timeline: transitions only
+        transitions = [s for i, s in enumerate(samples)
+                       if i == 0 or s["starved"] != samples[i - 1]["starved"]]
+        return {
+            "timeline": transitions,
+            "tail": tail,
+            "rebalancer": "on" if rebalance_on else "off",
+            "chaos": chaos or None,
+            "completed": completed[0],
+            "req_per_s": round(completed[0] / elapsed, 1),
+            "failures": failures[0],
+            "dropped_streams": dropped[0],
+            "ttft_p50_s": pct(0.50),
+            "ttft_p99_s": pct(0.99),
+            "starvation_tripped": any(s["starved"] for s in samples),
+            "starved_at_end": (bool(tail) and all(s["starved"]
+                                                  for s in tail)),
+            "cleared_at_end": (bool(tail) and not any(s["starved"]
+                                                      for s in tail)),
+            "flips": (snap.get("flips") if snap else None),
+            "final_roles": {u: e.role for u, e in zip(urls, engines)},
+            "role_flips": sum(e.role_flips for e in engines),
+            "killed_engine": killed["url"],
+        }
+
+    static = await run_arm(rebalance_on=False, duration_s=7.0)
+    dynamic = await run_arm(rebalance_on=True, duration_s=14.0)
+    kill = await run_arm(rebalance_on=True, duration_s=10.0,
+                         chaos="kill_mid_drain")
+    blackhole = await run_arm(rebalance_on=False, duration_s=5.0,
+                              chaos="blackhole_controller")
+
+    # the acceptance bar (ISSUE 18): the static pool trips the
+    # starvation condition and stays starved; the rebalancer flips a
+    # role and CLEARS it with zero failed requests and zero severed
+    # streams; both chaos arms finish with traffic still flowing
+    assert static["starvation_tripped"], static
+    assert static["starved_at_end"], static
+    assert dynamic["starvation_tripped"], dynamic
+    assert dynamic["flips"] and dynamic["flips"]["completed"] >= 1, dynamic
+    assert dynamic["cleared_at_end"], dynamic
+    for arm in (static, dynamic, kill, blackhole):
+        assert arm["failures"] == 0, arm
+        assert arm["dropped_streams"] == 0, arm
+        assert arm["completed"] > 0, arm
+    assert kill["killed_engine"] is not None, kill
+    assert kill["flips"] and kill["flips"]["abandoned"] >= 1, kill
+
+    return {
+        "engines": N_PREFILL + N_DECODE,
+        "initial_split": {"prefill": N_PREFILL, "decode": N_DECODE},
+        "clients": CLIENTS,
+        "static": static,
+        "rebalance": dynamic,
+        "chaos_kill_mid_drain": kill,
+        "chaos_blackhole_controller": blackhole,
+        "starvation_cleared_by_flip": bool(
+            dynamic["starvation_tripped"] and dynamic["cleared_at_end"]
+        ),
+        "zero_dropped_streams": all(
+            a["failures"] == 0 and a["dropped_streams"] == 0
+            for a in (static, dynamic, kill, blackhole)
+        ),
+    }
+
+
+def _phase_rebalance_main() -> None:
+    """Subprocess entry for the CPU-only P/D pool-rebalancing bench.
+    Forces CPU before anything touches jax — runs pre-preflight, so the
+    role-flip evidence survives a wedged TPU tunnel."""
+    import asyncio
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = asyncio.run(_rebalance_bench())
+    print(json.dumps({"rebalance": result}), flush=True)
+
+
 def _phase_kvflow_main() -> None:
     """Subprocess entry for the CPU-only KV-flow telemetry bench. Forces
     CPU before anything touches jax — runs pre-preflight, so the flow
@@ -3683,6 +3970,8 @@ def main() -> None:
             _phase_kvquant_main()
         elif phase == "peer":
             _phase_peer_main()
+        elif phase == "rebalance":
+            _phase_rebalance_main()
         elif phase == "fleet":
             _phase_fleet_main()
         elif phase == "fleet_scale":
@@ -3779,6 +4068,17 @@ def main() -> None:
         timeout_s=480, key="peer", min_needed_s=60.0,
     )
 
+    # -0.009) dynamic P/D pool rebalancing (docs/40-pool-rebalancing.md):
+    # a decode-heavy shift against a mislabeled 4-engine fleet — static
+    # pools trip and HOLD the seat-starvation condition; the rebalancer
+    # flips a role and clears it with zero failed/severed streams, and
+    # both chaos arms (target killed mid-drain, black-holed controller)
+    # finish with traffic flowing — CPU-only, pre-preflight
+    rebalance = _run_phase(
+        "rebalance", ["bench.py", "--phase", "rebalance"],
+        timeout_s=300, key="rebalance", min_needed_s=90.0,
+    )
+
     # -0.0078125) fleet-coherence telemetry (docs/32-fleet-telemetry.md):
     # the ROADMAP-1 baselines — convergence lag across 3 router replicas
     # after a 10k-event storm, stickiness-violation detection, fleet
@@ -3827,6 +4127,7 @@ def main() -> None:
             "hydration": hydration,
             "kvquant": kvquant,
             "peer": peer,
+            "rebalance": rebalance,
             "fleet": fleet,
             "fleet_scale": fleet_scale,
             "total_elapsed_s": round(time.monotonic() - _t_start, 1),
@@ -3921,6 +4222,7 @@ def main() -> None:
         "hydration": hydration,
         "kvquant": kvquant,
         "peer": peer,
+        "rebalance": rebalance,
         "fleet": fleet,
         "fleet_scale": fleet_scale,
         "total_elapsed_s": round(time.monotonic() - _t_start, 1),

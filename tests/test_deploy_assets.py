@@ -102,3 +102,81 @@ def test_dashboard_metrics_exist_in_contract():
     adapter = (REPO / "observability/prom-adapter.yaml").read_text()
     for m in re.findall(r"tpu:[a-z0-9_]+", adapter):
         assert m in contract, m
+
+
+def test_rebalance_flags_render_only_behind_the_enable_gate():
+    """The rebalancer drains live engines, so its helm surface must be
+    all-or-nothing: every --rebalance* arg on the kv-controller container
+    sits inside the {{- if .Values.cacheserverSpec.rebalanceEnabled }}
+    block (a disabled chart renders NONE of them), the gate passes the
+    bare --rebalance switch, and the knob values map 1:1 to real flags."""
+    import jsonschema
+
+    tpl = (REPO / "helm/templates/services-rbac-storage.yaml").read_text()
+    gate = "{{- if .Values.cacheserverSpec.rebalanceEnabled }}"
+    assert gate in tpl
+    # the gated block runs from the if to its matching end: flag args and
+    # {{- with }} wrappers only, so the first {{- end }} that follows a
+    # line NOT opened by a with closes the if — find it by depth count
+    start = tpl.index(gate)
+    depth, pos = 1, start + len(gate)
+    for m in re.finditer(r"\{\{-\s*(if|with|range|end)\b", tpl[start + len(gate):]):
+        depth += -1 if m.group(1) == "end" else 1
+        if depth == 0:
+            pos = start + len(gate) + m.end()
+            break
+    assert depth == 0, "unclosed rebalanceEnabled block"
+    block = tpl[start:pos]
+    rebalance_flags = set(re.findall(r'"(--rebalance[a-z-]*)"', tpl))
+    assert rebalance_flags == set(re.findall(r'"(--rebalance[a-z-]*)"', block)), \
+        "--rebalance* args leak outside the rebalanceEnabled gate"
+    assert {"--rebalance", "--rebalance-cooldown", "--rebalance-min-prefill",
+            "--rebalance-min-decode", "--rebalance-verify-window"} <= rebalance_flags
+    # knobs referenced by the block exist in values.yaml with the loop OFF
+    values = yaml.safe_load((REPO / "helm/values.yaml").read_text())
+    cs = values["cacheserverSpec"]
+    assert cs["rebalanceEnabled"] is False
+    for key in re.findall(r"\.Values\.cacheserverSpec\.(rebalance\w+)", block):
+        assert key in cs, f"template references undeclared value {key}"
+    # the schema bites: the shipped example validates, a mistyped enable
+    # flag does not
+    schema = json.loads((REPO / "helm/values.schema.json").read_text())
+    example = yaml.safe_load(
+        (REPO / "helm/examples/values-40-rebalance.yaml").read_text())
+    assert example["cacheserverSpec"]["rebalanceEnabled"] is True
+    jsonschema.validate(example, schema)
+    bad = dict(example, cacheserverSpec=dict(
+        example["cacheserverSpec"], rebalanceEnabled="yes"))
+    try:
+        jsonschema.validate(bad, schema)
+    except jsonschema.ValidationError:
+        pass
+    else:
+        raise AssertionError("schema accepted rebalanceEnabled as a string")
+
+
+def test_observability_assets_do_not_pin_model_names(tmp_path, monkeypatch):
+    """Static observability assets must stay model-agnostic: the shipped
+    KEDA example once pinned model_name="llama-3-8b" in its queries, so
+    any deploy under a different model name scaled on empty results.
+    check_metrics_contract's pin check guards all such assets — verify
+    the shipped files are clean AND that the check actually bites."""
+    sys.path.insert(0, str(REPO))
+    from tools import check_metrics_contract as cmc
+
+    assert cmc.check_model_name_pins() == []
+
+    # synthetic repo with a pinned query: the check must flag it, while
+    # model_name!="" / model_name="" / regex matchers stay allowed
+    obs = tmp_path / "observability"
+    obs.mkdir()
+    (obs / "keda-scaledobject.yaml").write_text(
+        'query: sum(tpu:num_requests_waiting{model_name="llama-3-8b"})\n'
+        'query: sum(tpu:num_requests_waiting{model_name!=""})\n'
+        'query: sum(tpu:request_e2e_seconds_count{model_name=""})\n'
+        'query: sum(tpu:num_requests_waiting{model_name=~"llama.*"})\n'
+    )
+    monkeypatch.setattr(cmc, "REPO", str(tmp_path))
+    monkeypatch.setattr(cmc, "RULES_DIR", str(tmp_path / "observability" / "rules"))
+    problems = cmc.check_model_name_pins()
+    assert len(problems) == 1 and "llama-3-8b" in problems[0], problems

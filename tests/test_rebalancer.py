@@ -1,0 +1,771 @@
+"""Pool-rebalancer state machine (docs/40-pool-rebalancing.md).
+
+Unit tier: every phase transition of the flip episode driven tick-by-tick
+with an injected clock and a scripted HTTP session — diagnosis directions,
+hysteresis, min-pool floors, drain/flip/rejoin/verify, rollback-on-worse,
+unreachable abandonment, episode timeout, and crash-resume from EVERY
+persisted phase (the crash-safety claim is per-phase, so the test is too).
+
+Wire tier (chaos-marked): the same actuator against real FakeEngines over
+real aiohttp — a full flip lands and re-registers with a real KV
+controller, an engine killed mid-drain abandons cleanly while traffic
+keeps flowing, a black-holed controller never blocks serving (fail open),
+and a flip under a live stream drops zero streams.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestServer
+
+from vllm_production_stack_tpu import metrics_contract as mc
+from vllm_production_stack_tpu.engine.rebalancer import (
+    Episode,
+    PoolRebalancer,
+    RebalanceConfig,
+)
+
+# -- unit-test rig -----------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _Resp:
+    def __init__(self, status=200, body=None):
+        self.status = status
+        self._body = body if body is not None else {}
+
+    async def read(self):
+        return b""
+
+    async def json(self):
+        return self._body
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        return False
+
+
+class _Raise:
+    """A scripted connection failure: raises on context entry, exactly
+    where aiohttp surfaces a refused/parted connection."""
+
+    def __init__(self, exc=None):
+        self.exc = exc or ConnectionError("scripted connection failure")
+
+    async def __aenter__(self):
+        raise self.exc
+
+    async def __aexit__(self, *exc):
+        return False
+
+
+class _Session:
+    """Scripted aiohttp session: queue responses per (METHOD, url-suffix);
+    every call is recorded for assertion."""
+
+    def __init__(self):
+        self.calls = []
+        self.queues = {}
+
+    def script(self, method, suffix, *items):
+        self.queues.setdefault((method, suffix), []).extend(items)
+
+    def _issue(self, method, url, kw):
+        self.calls.append((method, url, kw))
+        for (m, suffix), q in self.queues.items():
+            if m == method and url.endswith(suffix) and q:
+                return q.pop(0)
+        return _Resp(200)
+
+    def post(self, url, **kw):
+        return self._issue("POST", url, kw)
+
+    def get(self, url, **kw):
+        return self._issue("GET", url, kw)
+
+
+def _pools(prefill_qw=0.0, decode_qw=0.0, decode_occ=0.0,
+           n_prefill=1, n_decode=2):
+    stats = {}
+    for i in range(n_prefill):
+        stats[f"http://p{i}"] = {
+            "role": "prefill", "queue_wait_p95": prefill_qw,
+            "seat_occupancy": 0.0, "load": float(i),
+        }
+    for i in range(n_decode):
+        stats[f"http://d{i}"] = {
+            "role": "decode", "queue_wait_p95": decode_qw,
+            "seat_occupancy": decode_occ, "load": float(i),
+        }
+    return stats
+
+
+def _make(stats_box, sess, clock, state_file="", **cfg_kw):
+    cfg_kw.setdefault("enabled", True)
+    cfg_kw.setdefault("observe_s", 10.0)
+    cfg_kw.setdefault("cooldown_s", 60.0)
+    cfg_kw.setdefault("verify_window_s", 30.0)
+    cfg = RebalanceConfig(state_file=state_file, **cfg_kw)
+
+    async def sess_fn():
+        return sess
+
+    return PoolRebalancer(
+        cfg,
+        pool_stats_fn=lambda: stats_box["stats"],
+        session_fn=sess_fn,
+        registered_roles_fn=lambda: stats_box.get("roles", {}),
+        now_fn=clock,
+    )
+
+
+def _tick(rb, n=1):
+    async def go():
+        for _ in range(n):
+            await rb.tick()
+    asyncio.run(go())
+
+
+def _start_episode(rb, clock, box, starved="prefill"):
+    """Drive observe → hysteresis → episode creation."""
+    if starved == "prefill":
+        box["stats"] = _pools(prefill_qw=5.0, decode_occ=0.1)
+    else:
+        box["stats"] = _pools(decode_qw=5.0, decode_occ=0.9, n_prefill=2)
+    _tick(rb)  # arms _imbalance_since
+    clock.advance(rb.config.observe_s + 0.1)
+    _tick(rb)
+    assert rb.episode is not None, "episode should have started"
+    return rb.episode
+
+
+# -- diagnosis ---------------------------------------------------------------
+
+
+def test_diagnose_both_directions_and_balanced():
+    box = {"stats": _pools(prefill_qw=5.0, decode_occ=0.1)}
+    clock = _Clock()
+    rb = _make(box, _Session(), clock)
+    assert rb._diagnose(rb._pool_view()) == "prefill"
+    box["stats"] = _pools(decode_qw=5.0, decode_occ=0.9)
+    assert rb._diagnose(rb._pool_view()) == "decode"
+    # decode queue wait high but prefill ALSO backed up: no flip direction
+    box["stats"] = _pools(prefill_qw=2.0, decode_qw=5.0, decode_occ=0.9)
+    assert rb._diagnose(rb._pool_view()) is None
+    box["stats"] = _pools()
+    assert rb._diagnose(rb._pool_view()) is None
+    # an incomplete disaggregated deployment never diagnoses
+    box["stats"] = _pools(prefill_qw=5.0, n_decode=0)
+    assert rb._diagnose(rb._pool_view()) is None
+
+
+def test_registration_advertised_role_wins_over_scrape():
+    """Right after a flip the engine's registered role is fresher than
+    the scrape — the pool view must follow the registration."""
+    box = {"stats": _pools(), "roles": {"http://d0": "prefill"}}
+    rb = _make(box, _Session(), _Clock())
+    view = rb._pool_view()
+    assert "http://d0" in view.prefill and "http://d0" not in view.decode
+
+
+# -- hysteresis + floors -----------------------------------------------------
+
+
+def test_hysteresis_requires_sustained_imbalance():
+    box = {"stats": _pools(prefill_qw=5.0, decode_occ=0.1)}
+    clock = _Clock()
+    rb = _make(box, _Session(), clock)
+    _tick(rb)
+    clock.advance(5.0)  # < observe_s
+    _tick(rb)
+    assert rb.episode is None
+    # a direction change resets the hysteresis clock
+    box["stats"] = _pools(decode_qw=5.0, decode_occ=0.9, n_prefill=2)
+    _tick(rb)
+    clock.advance(6.0)  # 6s in the NEW direction; 11s total
+    _tick(rb)
+    assert rb.episode is None
+    # balanced clears the tracker entirely
+    box["stats"] = _pools()
+    _tick(rb)
+    assert rb._imbalance_since is None
+
+
+def test_floor_refuses_to_drain_last_rich_engine():
+    box = {"stats": _pools(prefill_qw=5.0, decode_occ=0.1, n_decode=1)}
+    clock = _Clock()
+    rb = _make(box, _Session(), clock, min_decode=1)
+    _tick(rb)
+    clock.advance(60.0)
+    _tick(rb, 3)
+    assert rb.episode is None and rb.episodes_started == 0
+
+
+def test_engine_cooldown_excludes_rolled_back_target():
+    box = {"stats": _pools(prefill_qw=5.0, decode_occ=0.1)}
+    clock = _Clock()
+    rb = _make(box, _Session(), clock)
+    # d0 (least loaded) is on post-rollback cooldown: d1 is picked
+    rb.engine_cooldown_until["http://d0"] = clock() + 1000.0
+    ep = _start_episode(rb, clock, box)
+    assert ep.engine == "http://d1"
+
+
+# -- the happy-path episode --------------------------------------------------
+
+
+def test_full_episode_completes_and_cools_down():
+    box = {}
+    clock = _Clock()
+    sess = _Session()
+    rb = _make(box, sess, clock)
+    ep = _start_episode(rb, clock, box)
+    assert (ep.engine, ep.from_role, ep.to_role) == (
+        "http://d0", "decode", "prefill")
+    assert ep.baseline_queue_wait == 5.0 and rb.phase == "drain"
+
+    sess.script("POST", "/drain", _Resp(200))
+    _tick(rb)
+    assert rb.phase == "flip"
+    sess.script("POST", "/role", _Resp(200))
+    _tick(rb)
+    assert rb.phase == "rejoin"
+    assert sess.calls[-1][2]["json"] == {"role": "prefill"}
+    sess.script("GET", "/health",
+                _Resp(200, {"role": "prefill", "draining": False}))
+    _tick(rb)
+    assert rb.phase == "verify"
+    # starvation cleared: within the window nothing happens, after it the
+    # episode completes
+    box["stats"] = _pools(prefill_qw=0.2, decode_occ=0.4)
+    _tick(rb)
+    assert rb.phase == "verify"
+    clock.advance(rb.config.verify_window_s + 0.1)
+    _tick(rb)
+    assert rb.episode is None
+    assert rb.flips["completed"] == 1
+    assert rb.phase == "cooldown"
+    clock.advance(rb.config.cooldown_s + 0.1)
+    assert rb.phase == "observe"
+
+
+def test_drain_202_retries_until_barrier_passes():
+    box = {}
+    clock = _Clock()
+    sess = _Session()
+    rb = _make(box, sess, clock)
+    _start_episode(rb, clock, box)
+    sess.script("POST", "/drain", _Resp(202), _Resp(202), _Resp(200))
+    _tick(rb, 2)
+    assert rb.phase == "drain"  # still waiting on in-flight streams
+    _tick(rb)
+    assert rb.phase == "flip"
+    assert len([c for c in sess.calls if c[1].endswith("/drain")]) == 3
+
+
+def test_flip_409_abandons_exiting_engine():
+    box = {}
+    clock = _Clock()
+    sess = _Session()
+    rb = _make(box, sess, clock)
+    _start_episode(rb, clock, box)
+    sess.script("POST", "/drain", _Resp(200))
+    _tick(rb)
+    sess.script("POST", "/role", _Resp(409))
+    _tick(rb)
+    assert rb.episode is None and rb.flips["abandoned"] == 1
+
+
+def test_rejoin_wrong_role_reenters_flip():
+    """An engine that restarted mid-episode serves its static role — the
+    rejoin gate must send the episode back to flip, not verify a fiction."""
+    box = {}
+    clock = _Clock()
+    sess = _Session()
+    rb = _make(box, sess, clock)
+    _start_episode(rb, clock, box)
+    sess.script("POST", "/drain", _Resp(200))
+    sess.script("POST", "/role", _Resp(200))
+    _tick(rb, 2)
+    sess.script("GET", "/health",
+                _Resp(200, {"role": "decode", "draining": False}))
+    _tick(rb)
+    assert rb.phase == "flip"
+
+
+def test_unreachable_limit_abandons():
+    box = {}
+    clock = _Clock()
+    sess = _Session()
+    rb = _make(box, sess, clock, unreachable_limit=3)
+    _start_episode(rb, clock, box)
+    sess.script("POST", "/drain", _Raise(), _Raise(), _Raise())
+    _tick(rb, 2)
+    assert rb.episode is not None and rb.episode.unreachable == 2
+    _tick(rb)
+    assert rb.episode is None and rb.flips["abandoned"] == 1
+
+
+def test_episode_timeout_abandons():
+    box = {}
+    clock = _Clock()
+    sess = _Session()
+    rb = _make(box, sess, clock, episode_timeout_s=600.0)
+    _start_episode(rb, clock, box)
+    clock.advance(600.1)
+    _tick(rb)
+    assert rb.episode is None and rb.flips["abandoned"] == 1
+
+
+# -- rollback ----------------------------------------------------------------
+
+
+def test_verify_worse_rolls_back_exactly_once_and_cools_engine():
+    box = {}
+    clock = _Clock()
+    sess = _Session()
+    rb = _make(box, sess, clock, engine_cooldown_s=300.0)
+    _start_episode(rb, clock, box)
+    sess.script("POST", "/drain", _Resp(200))
+    sess.script("POST", "/role", _Resp(200))
+    sess.script("GET", "/health",
+                _Resp(200, {"role": "prefill", "draining": False}))
+    _tick(rb, 3)
+    assert rb.phase == "verify"
+    # the flip HURT: starved pool now waits longer than the 5.0s baseline
+    box["stats"] = _pools(prefill_qw=8.0, decode_occ=0.6)
+    clock.advance(rb.config.verify_window_s + 0.1)
+    _tick(rb)
+    ep = rb.episode
+    assert ep is not None and ep.rolled_back
+    assert (ep.from_role, ep.to_role) == ("prefill", "decode")
+    assert rb.phase == "drain"
+    # drive the rollback leg home — it closes as rolled_back, never loops
+    sess.script("POST", "/drain", _Resp(200))
+    sess.script("POST", "/role", _Resp(200))
+    sess.script("GET", "/health",
+                _Resp(200, {"role": "decode", "draining": False}))
+    _tick(rb, 3)
+    assert rb.phase == "verify"
+    clock.advance(rb.config.verify_window_s + 0.1)
+    box["stats"] = _pools(prefill_qw=9.0, decode_occ=0.6)  # still bad
+    _tick(rb)
+    assert rb.episode is None
+    assert rb.flips["rolled_back"] == 1 and rb.flips["completed"] == 0
+    assert rb.engine_cooldown_until["http://d0"] > clock()
+
+
+# -- crash-safety ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("phase", ["drain", "flip", "rejoin", "verify"])
+def test_crash_resume_from_every_persisted_phase(tmp_path, phase):
+    """A controller crash mid-episode resumes the episode from its
+    persisted phase — with the unreachable count reset (the crash may
+    have been ours, not the engine's)."""
+    state = str(tmp_path / "rebalance.json")
+    box = {"stats": _pools()}
+    clock = _Clock()
+    rb = _make(box, _Session(), clock, state_file=state)
+    rb.episode = Episode(
+        seq=7, engine="http://d0", from_role="decode", to_role="prefill",
+        phase=phase, started_ts=clock(), phase_ts=clock(),
+        starved_role="prefill", baseline_queue_wait=5.0, unreachable=3,
+    )
+    rb.flips["completed"] = 2
+    rb._save_state()
+
+    rb2 = _make(box, _Session(), clock, state_file=state)
+    assert rb2.episode is not None
+    assert rb2.episode.phase == phase and rb2.episode.seq == 7
+    assert rb2.episode.unreachable == 0  # reset on resume
+    assert rb2.flips["completed"] == 2
+    assert rb2.phase == phase
+
+
+def test_resumed_stale_episode_abandons_instead_of_replaying(tmp_path):
+    state = str(tmp_path / "rebalance.json")
+    box = {"stats": _pools()}
+    clock = _Clock()
+    rb = _make(box, _Session(), clock, state_file=state,
+               episode_timeout_s=600.0)
+    rb.episode = Episode(
+        seq=1, engine="http://d0", from_role="decode", to_role="prefill",
+        phase="flip", started_ts=clock() - 700.0, phase_ts=clock() - 700.0,
+        starved_role="prefill", baseline_queue_wait=5.0,
+    )
+    rb._save_state()
+    rb2 = _make(box, _Session(), clock, state_file=state,
+                episode_timeout_s=600.0)
+    _tick(rb2)
+    assert rb2.episode is None and rb2.flips["abandoned"] == 1
+
+
+def test_unreadable_state_file_starts_fresh(tmp_path):
+    state = tmp_path / "rebalance.json"
+    state.write_text("{not json")
+    rb = _make({"stats": _pools()}, _Session(), _Clock(),
+               state_file=str(state))
+    assert rb.episode is None and rb.phase == "observe"
+
+
+def test_state_file_round_trips_atomically(tmp_path):
+    state = str(tmp_path / "rebalance.json")
+    box = {}
+    clock = _Clock()
+    rb = _make(box, _Session(), clock, state_file=state)
+    _start_episode(rb, clock, box)
+    with open(state, encoding="utf-8") as f:
+        on_disk = json.load(f)
+    assert on_disk["episode"]["phase"] == "drain"
+    assert on_disk["episodes_started"] == 1
+
+
+# -- exporter surface --------------------------------------------------------
+
+
+def test_metrics_lines_render_one_hot_phase_and_outcomes():
+    box = {"stats": _pools()}
+    rb = _make(box, _Session(), _Clock())
+    rb.flips["completed"] = 3
+    text = "\n".join(rb.metrics_lines())
+    assert f'{mc.POOL_REBALANCE_FLIPS}{{outcome="completed"}} 3' in text
+    assert f'{mc.POOL_REBALANCE_FLIPS}{{outcome="rolled_back"}} 0' in text
+    assert f'{mc.POOL_REBALANCE_PHASE}{{phase="observe"}} 1' in text
+    # exactly one phase at 1
+    ones = [ln for ln in text.splitlines()
+            if ln.startswith(mc.POOL_REBALANCE_PHASE) and ln.endswith(" 1")]
+    assert len(ones) == 1
+
+
+# -- signal path: scrape → role/occupancy/queue-wait p95 ---------------------
+
+
+def test_engine_stats_parse_role_occupancy_and_buckets():
+    from vllm_production_stack_tpu.router.engine_stats import EngineStats
+
+    text = "\n".join([
+        f'{mc.POOL_ROLE}{{model_name="m",role="prefill"}} 0',
+        f'{mc.POOL_ROLE}{{model_name="m",role="decode"}} 1',
+        f'{mc.ENGINE_DECODE_SEAT_OCCUPANCY}{{model_name="m"}} 0.75',
+        f"# TYPE {mc.REQUEST_QUEUE_WAIT} histogram",
+        f'{mc.REQUEST_QUEUE_WAIT}_bucket{{le="0.5"}} 10',
+        f'{mc.REQUEST_QUEUE_WAIT}_bucket{{le="1.0"}} 12',
+        f'{mc.REQUEST_QUEUE_WAIT}_bucket{{le="+Inf"}} 12',
+        f"{mc.REQUEST_QUEUE_WAIT}_sum 3.5",
+        f"{mc.REQUEST_QUEUE_WAIT}_count 12",
+    ]) + "\n"
+    s = EngineStats.from_scrape(text)
+    assert s.role == "decode"
+    assert s.seat_occupancy == 0.75
+    assert s.queue_wait_buckets[0.5] == 10
+    assert s.queue_wait_buckets[float("inf")] == 12
+
+
+def test_delta_p95_windows_cleared_starvation():
+    """The scrape-to-scrape delta p95 must DECAY once starvation clears —
+    a cumulative-histogram quantile never would."""
+    from vllm_production_stack_tpu.router.engine_stats import _delta_p95
+
+    starved = {0.5: 0.0, 5.0: 1.0, float("inf"): 100.0}
+    assert _delta_p95(starved, {}) == 5.0
+    # next window: 50 new fast requests, no new slow ones
+    cleared = {0.5: 50.0, 5.0: 51.0, float("inf"): 150.0}
+    assert _delta_p95(cleared, starved) == 0.5
+    # no new observations → 0, and an engine-restart counter reset reads
+    # as an empty window (clamped at 0), never a negative spike
+    assert _delta_p95(cleared, cleared) == 0.0
+    assert _delta_p95({0.5: 1.0, float("inf"): 1.0}, cleared) == 0.0
+
+
+# -- wire tier (chaos): the actuator against real engines --------------------
+
+
+def _run(coro, timeout=30.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+@pytest.mark.chaos
+def test_wire_flip_lands_and_reregisters_with_controller():
+    """Full drain→flip→rejoin over real HTTP against a FakeEngine: the
+    engine ends up serving the new role and re-advertises it to a real
+    KV controller before any scrape could."""
+    import aiohttp
+
+    from vllm_production_stack_tpu.engine.kv_controller import KVController
+    from vllm_production_stack_tpu.testing.fake_engine import FakeEngine
+
+    async def go():
+        controller = KVController([])
+        ctrl_srv = TestServer(controller.build_app())
+        await ctrl_srv.start_server()
+        ctrl_url = f"http://127.0.0.1:{ctrl_srv.port}"
+        eng = FakeEngine(role="decode", seats=2,
+                         kv_controller_url=ctrl_url)
+        srv = TestServer(eng.build_app())
+        await srv.start_server()
+        url = f"http://127.0.0.1:{srv.port}"
+        eng.self_url = url
+        await eng._register()
+        assert controller.roles[url] == "decode"
+        box = {"stats": {
+            "http://p0": {"role": "prefill", "queue_wait_p95": 5.0,
+                          "seat_occupancy": 0.0, "load": 0.0},
+            url: {"role": "decode", "queue_wait_p95": 0.0,
+                  "seat_occupancy": 0.1, "load": 0.0},
+        }}
+        async with aiohttp.ClientSession() as sess:
+            async def sess_fn():
+                return sess
+
+            rb = PoolRebalancer(
+                RebalanceConfig(enabled=True, observe_s=0.0,
+                                verify_window_s=0.0, min_decode=0),
+                pool_stats_fn=lambda: box["stats"],
+                session_fn=sess_fn,
+                registered_roles_fn=lambda: controller.roles,
+            )
+            await rb.tick()  # arm hysteresis
+            await rb.tick()  # start episode
+            assert rb.episode is not None and rb.episode.engine == url
+            for _ in range(6):
+                if rb.episode is None:
+                    break
+                await rb.tick()
+            assert rb.episode is None, f"stuck in phase {rb.phase}"
+            assert rb.flips["completed"] == 1
+        assert eng.role == "prefill" and not eng.draining
+        assert controller.roles[url] == "prefill"
+        await srv.close()
+        await ctrl_srv.close()
+
+    _run(go())
+
+
+@pytest.mark.chaos
+def test_wire_engine_killed_mid_drain_abandons_and_traffic_flows():
+    """The target engine dies mid-drain: the episode must abandon after
+    the unreachable limit — and the OTHER engine keeps serving the whole
+    time (the actuator never blocks the data plane)."""
+    import aiohttp
+
+    from vllm_production_stack_tpu.testing.fake_engine import FakeEngine
+
+    async def go():
+        victim = FakeEngine(role="decode", seats=2)
+        survivor = FakeEngine(role="decode", seats=2, tokens_per_sec=2000.0)
+        vs, ss = TestServer(victim.build_app()), TestServer(
+            survivor.build_app())
+        await vs.start_server()
+        await ss.start_server()
+        v_url = f"http://127.0.0.1:{vs.port}"
+        s_url = f"http://127.0.0.1:{ss.port}"
+        box = {"stats": {
+            "http://p0": {"role": "prefill", "queue_wait_p95": 5.0,
+                          "seat_occupancy": 0.0, "load": 0.0},
+            v_url: {"role": "decode", "queue_wait_p95": 0.0,
+                    "seat_occupancy": 0.1, "load": 0.0},
+            s_url: {"role": "decode", "queue_wait_p95": 0.0,
+                    "seat_occupancy": 0.1, "load": 5.0},
+        }}
+        async with aiohttp.ClientSession() as sess:
+            async def sess_fn():
+                return sess
+
+            rb = PoolRebalancer(
+                RebalanceConfig(enabled=True, observe_s=0.0,
+                                unreachable_limit=2, drain_timeout_s=1.0),
+                pool_stats_fn=lambda: box["stats"],
+                session_fn=sess_fn,
+            )
+            await rb.tick()
+            await rb.tick()
+            assert rb.episode is not None and rb.episode.engine == v_url
+            await vs.close()  # kill mid-drain
+            while rb.episode is not None:
+                await rb.tick()
+            assert rb.flips["abandoned"] == 1
+            # data plane alive throughout
+            async with sess.post(
+                s_url + "/v1/completions",
+                json={"model": "fake-model", "prompt": "hi",
+                      "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 200
+        await ss.close()
+
+    _run(go())
+
+
+@pytest.mark.chaos
+def test_wire_blackholed_controller_fails_open():
+    """An engine whose controller is a black hole (accepts TCP, never
+    answers) must keep serving — registration is best-effort with a
+    bounded timeout, never on the request path."""
+    import time as _time
+
+    from vllm_production_stack_tpu.testing.fake_engine import FakeEngine
+    from vllm_production_stack_tpu.testing.faults import black_hole
+
+    async def go():
+        hole, port = await black_hole()
+        eng = FakeEngine(role="decode", seats=2, self_url="http://e1",
+                         kv_controller_url=f"http://127.0.0.1:{port}")
+        srv = TestServer(eng.build_app())
+        # start_server runs on_startup → _register against the black hole;
+        # the 5s client timeout bounds it, then serving proceeds
+        await srv.start_server()
+        url = f"http://127.0.0.1:{srv.port}"
+        import aiohttp
+
+        async with aiohttp.ClientSession() as sess:
+            t0 = _time.monotonic()
+            async with sess.post(
+                url + "/v1/completions",
+                json={"model": "fake-model", "prompt": "hi",
+                      "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 200
+            assert _time.monotonic() - t0 < 5.0  # not serialized behind it
+        await srv.close()
+        hole.close()
+
+    _run(go())
+
+
+@pytest.mark.chaos
+def test_wire_flip_under_live_stream_drops_nothing():
+    """A role flip against an engine with an in-flight SSE stream: the
+    drain barrier waits the stream out (clean [DONE], never severed),
+    then the flip lands and new requests serve under the new role."""
+    import aiohttp
+
+    from vllm_production_stack_tpu.testing.fake_engine import FakeEngine
+
+    async def go():
+        eng = FakeEngine(role="decode", seats=2, tokens_per_sec=100.0)
+        srv = TestServer(eng.build_app())
+        await srv.start_server()
+        url = f"http://127.0.0.1:{srv.port}"
+        async with aiohttp.ClientSession() as sess:
+            async def stream():
+                chunks, clean = 0, False
+                async with sess.post(
+                    url + "/v1/completions",
+                    json={"model": "fake-model", "prompt": "hi",
+                          "max_tokens": 20, "stream": True},
+                ) as resp:
+                    assert resp.status == 200
+                    async for line in resp.content:
+                        line = line.decode().strip()
+                        if line == "data: [DONE]":
+                            clean = True
+                        elif line.startswith("data: "):
+                            chunks += 1
+                return chunks, clean
+
+            task = asyncio.create_task(stream())
+            await asyncio.sleep(0.05)  # stream is in flight
+            async with sess.post(
+                url + "/drain", params={"wait": "true"}
+            ) as resp:
+                assert resp.status == 200  # barrier waited the stream out
+            chunks, clean = await task
+            assert clean and chunks >= 20, "stream severed by drain"
+            async with sess.post(
+                url + "/role", json={"role": "prefill"}
+            ) as resp:
+                assert resp.status == 200
+            async with sess.post(
+                url + "/v1/completions",
+                json={"model": "fake-model", "prompt": "hi",
+                      "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 200
+            async with sess.get(url + "/health") as resp:
+                body = await resp.json()
+                assert body["role"] == "prefill" and not body["draining"]
+        await srv.close()
+
+    _run(go())
+
+
+@pytest.mark.chaos
+def test_wire_disagg_router_fails_over_draining_pool_members():
+    """Mid-flip, the drain target still carries its old role — the
+    2-phase disaggregated path must re-pick around its 503 +
+    X-Engine-Draining on BOTH hops instead of surfacing a 502."""
+    import aiohttp
+    from aiohttp.test_utils import TestServer as TS
+
+    from vllm_production_stack_tpu.router.app import build_app
+    from vllm_production_stack_tpu.router.args import parse_args
+    from vllm_production_stack_tpu.testing.fake_engine import FakeEngine
+
+    async def go():
+        engines = [
+            FakeEngine(role="prefill", prefill_tps=5000.0),   # draining
+            FakeEngine(role="prefill", prefill_tps=5000.0),
+            FakeEngine(role="decode", seats=2),               # draining
+            FakeEngine(role="decode", seats=2),
+        ]
+        servers, urls = [], []
+        for eng in engines:
+            srv = TS(eng.build_app())
+            await srv.start_server()
+            servers.append(srv)
+            urls.append(f"http://127.0.0.1:{srv.port}")
+        engines[0].draining = True
+        engines[2].draining = True
+        router = TS(build_app(parse_args([
+            "--static-backends", ",".join(urls),
+            "--static-models", ";".join(["fake-model"] * 4),
+            "--static-model-labels",
+            "prefill,prefill,decode,decode",
+            "--routing-logic", "disaggregated_prefill",
+            "--prefill-model-labels", "prefill",
+            "--decode-model-labels", "decode",
+            "--breaker-failure-threshold", "0",
+        ])))
+        await router.start_server()
+        router_url = f"http://127.0.0.1:{router.port}"
+        async with aiohttp.ClientSession() as sess:
+            for _ in range(4):
+                async with sess.post(
+                    router_url + "/v1/completions",
+                    json={"model": "fake-model", "prompt": "hi there",
+                          "max_tokens": 4},
+                ) as resp:
+                    assert resp.status == 200, await resp.text()
+            # both healthy pool members served; the draining ones did not
+            assert engines[1].total_requests >= 4
+            assert engines[3].total_requests >= 4
+            assert engines[0].total_requests == 0
+            assert engines[2].total_requests == 0
+            # every member draining -> one clean 503 + Retry-After
+            engines[1].draining = True
+            async with sess.post(
+                router_url + "/v1/completions",
+                json={"model": "fake-model", "prompt": "hi",
+                      "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 503
+                assert resp.headers.get("Retry-After")
+        await router.close()
+        for srv in servers:
+            await srv.close()
+
+    _run(go())

@@ -277,6 +277,38 @@ def check_reference_label_values() -> list[str]:
     return problems
 
 
+def check_model_name_pins() -> list[str]:
+    """(g): no observability asset may pin a literal model name. These
+    assets ship model-agnostic; a `model_name="llama-3-8b"` matcher
+    silently selects NOTHING the moment the fleet serves a different
+    model — the KEDA example shipped that way and would have scaled on
+    empty queries. `model_name=""` (the router-vantage series) and
+    regex / negative matchers (`=~`, `!=`, `!~`) are deliberate and
+    allowed; only a NON-EMPTY literal equality is a pin."""
+    assets = [
+        os.path.join(REPO, "observability", "tpu-dashboard.json"),
+        os.path.join(REPO, "observability", "prom-adapter.yaml"),
+        os.path.join(REPO, "observability", "keda-scaledobject.yaml"),
+        *rule_files(),
+    ]
+    problems: list[str] = []
+    for path in assets:
+        if not os.path.isfile(path):
+            continue
+        fname = os.path.basename(path)
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        for m in _SELECTOR_RE.finditer(text):
+            for lab, op, value in _MATCHER_RE.findall(m.group(2)):
+                if lab == "model_name" and op == "=" and value:
+                    problems.append(
+                        f"{fname}: {m.group(1)} pins model_name={value!r} — "
+                        "observability assets must stay model-agnostic "
+                        '(use model_name!="" or drop the matcher)'
+                    )
+    return problems
+
+
 def check_source_metric_literals() -> list[str]:
     """(f): no `tpu:` metric-name literal may be minted in *source*
     outside metrics_contract.py — tpulint's metric-literal rule, run here
@@ -320,6 +352,7 @@ def check() -> list[str]:
     problems.extend(check_rules())
     problems.extend(check_exported_label_sets())
     problems.extend(check_reference_label_values())
+    problems.extend(check_model_name_pins())
     problems.extend(check_source_metric_literals())
     return problems
 

@@ -537,6 +537,12 @@ class AsyncEngine:
         Idempotent — the /drain handler and the SIGTERM path may both fire."""
         self.accepting = False
 
+    def end_drain(self) -> None:
+        """Reopen admissions after a rebalance drain (POST /role re-admits
+        the engine under its new pool role — docs/40-pool-rebalancing.md).
+        Idempotent; never called on the SIGTERM exit path."""
+        self.accepting = True
+
     async def wait_idle(self, timeout_s: float) -> bool:
         """Wait (bounded) until every in-flight request has finished — the
         drain barrier between 'admissions stopped' and 'safe to exit'.

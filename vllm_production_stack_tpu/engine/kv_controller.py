@@ -66,7 +66,8 @@ class KVController:
                  replicate_threshold: int = 0,
                  replicate_window_s: float = 10.0,
                  replicate_max_blocks: int = 16,
-                 replicate_cooldown_s: float = 30.0):
+                 replicate_cooldown_s: float = 30.0,
+                 rebalance=None):
         if mode not in LOOKUP_MODES:
             raise ValueError(f"unknown KV lookup mode: {mode}")
         self.engines: set[str] = {u.rstrip("/") for u in engine_urls or []}
@@ -121,6 +122,30 @@ class KVController:
         self._crowd: dict[int, object] = {}  # head hash -> deque[monotonic]
         self._replicated_at: dict[int, float] = {}
         self.replications_ordered = 0
+        # pool rebalancing (docs/40-pool-rebalancing.md): the role-flip
+        # state machine. Constructed even when disabled so /rebalance and
+        # the contract series render; the tick loop only starts when
+        # rebalance.enabled. Roles engines advertise at registration are
+        # tracked here — fresher than the scrape-lagged fleet view right
+        # after a flip.
+        from .flightrec import ThreadRegistry
+        from .rebalancer import PoolRebalancer, RebalanceConfig
+
+        self.roles: dict[str, str] = {}
+        self.threads = ThreadRegistry()
+        cfg = rebalance or RebalanceConfig()
+        self.rebalancer = PoolRebalancer(
+            cfg,
+            pool_stats_fn=self.fleet.pool_stats,
+            session_fn=self._sess,
+            registered_roles_fn=lambda: self.roles,
+            # liveness: a wedged rebalancer must be a NAMED stall, not a
+            # quietly persisting starvation (PR 15 watchdog discipline)
+            heartbeat=self.threads.register(
+                "rebalancer",
+                stall_after_s=max(60.0, 10 * cfg.interval_s),
+            ),
+        )
 
     async def _sess(self) -> aiohttp.ClientSession:
         return await self._http.get()
@@ -210,6 +235,7 @@ class KVController:
         app.router.add_post("/fleet/report", self._handle_fleet_report)
         app.router.add_get("/fleet", self._handle_fleet)
         app.router.add_get("/engines", self._handle_engines)
+        app.router.add_get("/rebalance", self._handle_rebalance)
         app.router.add_get("/health", self._handle_health)
         app.router.add_get("/metrics", self._handle_metrics)
         app.on_startup.append(self._on_startup)
@@ -218,8 +244,10 @@ class KVController:
 
     async def _on_startup(self, app: web.Application) -> None:
         self.loop_lag_probe.start()
+        self.rebalancer.start()
 
     async def _on_cleanup(self, app: web.Application) -> None:
+        await self.rebalancer.stop()
         await self.loop_lag_probe.stop()
         await self._http.close()
 
@@ -387,6 +415,13 @@ class KVController:
         # CLEARS a previous identity — a pod restarted without
         # KV_MESH_GROUP must stop negotiating "device"
         self.index.set_transport(url, body.get("transport"))
+        # live pool role (docs/40-pool-rebalancing.md): set when valid,
+        # untouched otherwise — a roleless re-registration (an engine
+        # outside any disaggregated pool) must not erase what the fleet
+        # view knows from scrapes
+        role = body.get("role")
+        if role in mc.POOL_ROLE_VALUES:
+            self.roles[url] = role
         return web.json_response({"status": "ok", "engines": sorted(self.engines)})
 
     async def _handle_deregister(self, request: web.Request) -> web.Response:
@@ -394,6 +429,7 @@ class KVController:
         url = (body.get("url") or "").rstrip("/")
         self.engines.discard(url)
         self.index.remove_engine(url)
+        self.roles.pop(url, None)
         return web.json_response({"status": "ok", "engines": sorted(self.engines)})
 
     async def _handle_fleet_report(self, request: web.Request) -> web.Response:
@@ -436,6 +472,26 @@ class KVController:
             "mode": self.mode,
         })
 
+    async def _handle_rebalance(self, request: web.Request) -> web.Response:
+        """Operator view of the pool-rebalancer state machine: current
+        phase, active episode (if any), outcome totals, cooldowns, and
+        the per-pool signals it is acting on."""
+        from .rebalancer import _PoolView  # the same split the ticker uses
+
+        view = _PoolView()
+        for url, p in (self.fleet.pool_stats() or {}).items():
+            role = self.roles.get(url) or p.get("role") or ""
+            if role in mc.POOL_ROLE_VALUES:
+                view.pool(role)[url] = p
+        return web.json_response({
+            **self.rebalancer.snapshot(),
+            "pools": {
+                "prefill": view.prefill,
+                "decode": view.decode,
+            },
+            "registered_roles": dict(self.roles),
+        })
+
     async def _handle_health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok", "engines": len(self.engines)})
 
@@ -455,6 +511,21 @@ class KVController:
             lines.append(f'{mc.CLUSTER_KV_LOOKUPS}{{mode="{mode}"}} {n}')
         lines.append(f"# TYPE {mc.CLUSTER_KV_REPLICATIONS} counter")
         lines.append(f"{mc.CLUSTER_KV_REPLICATIONS} {self.replications_ordered}")
+        # pool rebalancing (docs/40-pool-rebalancing.md): outcome totals +
+        # phase one-hot, plus the tick loop's liveness age under the same
+        # closed thread name the engine exporter seeds
+        lines += self.rebalancer.metrics_lines()
+        # 0 when the loop isn't running (rebalancing disabled) — the same
+        # "loop not running in this deployment" convention the engine
+        # exporter applies to unregistered loops
+        rb_age = (
+            self.threads.ages().get("rebalancer", 0.0)
+            if self.rebalancer.config.enabled else 0.0
+        )
+        lines.append(f"# TYPE {mc.THREAD_HEARTBEAT_AGE} gauge")
+        lines.append(
+            f'{mc.THREAD_HEARTBEAT_AGE}{{thread="rebalancer"}} {rb_age:.3f}'
+        )
         lines += self.index.lookups.render(mc.CLUSTER_KV_LOOKUP_LATENCY)
         # event-loop starvation (docs/37-flight-recorder.md): same name
         # wherever an asyncio control-plane loop lives (router replicas
@@ -549,6 +620,50 @@ def build_parser() -> argparse.ArgumentParser:
                         "(utilization/over-admission smooth over this "
                         "window; shorter reacts faster, longer dampens "
                         "report jitter)")
+    p.add_argument("--rebalance", action="store_true", default=False,
+                   help="enable the prefill/decode pool rebalancer "
+                        "(docs/40-pool-rebalancing.md): on sustained "
+                        "seat starvation, drain the least-loaded engine "
+                        "of the rich pool and flip its role via POST "
+                        "/role. Off = observe-only (/rebalance and the "
+                        "tpu:pool_rebalance_* series still render)")
+    p.add_argument("--rebalance-interval", type=float, default=2.0,
+                   help="rebalancer tick cadence in seconds (each phase "
+                        "advances at most once per tick)")
+    p.add_argument("--rebalance-observe", type=float, default=10.0,
+                   help="hysteresis: seconds one imbalance direction must "
+                        "hold before an episode starts")
+    p.add_argument("--rebalance-cooldown", type=float, default=60.0,
+                   help="seconds after any finished episode before the "
+                        "next may start")
+    p.add_argument("--rebalance-verify-window", type=float, default=30.0,
+                   help="seconds a completed flip gets to prove itself; "
+                        "a starved-pool queue wait worse than the "
+                        "episode baseline inside it is rolled back once")
+    p.add_argument("--rebalance-min-prefill", type=int, default=1,
+                   help="floor on the prefill pool: an episode never "
+                        "starts if flipping would leave fewer prefill "
+                        "engines than this")
+    p.add_argument("--rebalance-min-decode", type=int, default=1,
+                   help="floor on the decode pool (see "
+                        "--rebalance-min-prefill)")
+    p.add_argument("--rebalance-queue-wait-trigger", type=float,
+                   default=1.0,
+                   help="queue-wait p95 seconds past which a pool counts "
+                        "as starved (mirrors the TpuSeatStarvation rule)")
+    p.add_argument("--rebalance-occupancy-rich-max", type=float,
+                   default=0.5,
+                   help="decode-seat occupancy below which the decode "
+                        "pool counts as rich (idle seats while prefill "
+                        "queues = the flip-to-prefill signal)")
+    p.add_argument("--rebalance-drain-timeout", type=float, default=30.0,
+                   help="bound on each POST /drain?wait=true barrier "
+                        "attempt during the drain phase")
+    p.add_argument("--rebalance-state-file", default="",
+                   help="path the episode phase + outcome counters are "
+                        "persisted to (atomic JSON): a controller crash "
+                        "mid-flip resumes or safely abandons the episode "
+                        "on restart. Empty = in-memory only")
     return p
 
 
@@ -562,6 +677,8 @@ def main(argv: list[str] | None = None) -> None:
         from ..qos import TenantTable
 
         tenant_table = TenantTable.load(args.tenant_table_file)
+    from .rebalancer import RebalanceConfig
+
     controller = KVController(
         urls, mode=args.mode, tokenizer=hashing_tokenizer(args.tokenizer),
         base_models=[m for m in args.base_models.split(",") if m],
@@ -571,6 +688,19 @@ def main(argv: list[str] | None = None) -> None:
         replicate_window_s=args.replicate_window,
         replicate_max_blocks=args.replicate_max_blocks,
         replicate_cooldown_s=args.replicate_cooldown,
+        rebalance=RebalanceConfig(
+            enabled=args.rebalance,
+            interval_s=args.rebalance_interval,
+            observe_s=args.rebalance_observe,
+            cooldown_s=args.rebalance_cooldown,
+            verify_window_s=args.rebalance_verify_window,
+            min_prefill=args.rebalance_min_prefill,
+            min_decode=args.rebalance_min_decode,
+            queue_wait_trigger_s=args.rebalance_queue_wait_trigger,
+            occupancy_rich_max=args.rebalance_occupancy_rich_max,
+            drain_timeout_s=args.rebalance_drain_timeout,
+            state_file=args.rebalance_state_file,
+        ),
     )
     logger.info("KV controller on %s:%d over %d engines (mode=%s)",
                 args.host, args.port, len(urls), args.mode)

@@ -462,6 +462,20 @@ class EngineMetrics:
             ).set(0)
         for kind in mc.STALL_KIND_VALUES:
             self.step_stalls.labels(**self._labels, kind=kind)
+        # -- pool rebalancing (docs/40-pool-rebalancing.md): the engine's
+        # live prefill/decode role — 1 on the current role, both 0 when
+        # the engine serves no disaggregated pool. The router's stats
+        # scraper follows this instead of the frozen helm model label.
+        self.pool_role = Gauge(
+            mc.POOL_ROLE,
+            "Live prefill/decode pool role (closed role set: "
+            + ", ".join(mc.POOL_ROLE_VALUES)
+            + "; 1 on the current role, both 0 without one)",
+            [*names, "role"],
+            registry=self.registry,
+        )
+        for role in mc.POOL_ROLE_VALUES:
+            self.pool_role.labels(**self._labels, role=role).set(0)
         # -- multi-tenant QoS (docs/27-multitenancy.md): tenant-labeled
         # series; cardinality bounded by qos.TenantAccounting.MAX_TENANTS
         tlabels = [*names, "tenant"]
@@ -748,6 +762,15 @@ class EngineMetrics:
                     self.step_stalls, f"stall:{kind}", int(total),
                     {**self._labels, "kind": kind},
                 )
+
+    def set_pool_role(self, role: str | None) -> None:
+        """Advertise the engine's live pool role (docs/40-pool-rebalancing
+        .md): 1 on `role`, 0 on the rest of the closed set; None clears
+        both (the engine serves no disaggregated pool)."""
+        for value in mc.POOL_ROLE_VALUES:
+            self.pool_role.labels(**self._labels, role=value).set(
+                1 if value == role else 0
+            )
 
     def _bump(self, counter: Counter, key: str, total: int) -> None:
         self._bump_labeled(counter, key, total, self._labels)

@@ -1,0 +1,593 @@
+"""Prefill/decode pool rebalancer (docs/40-pool-rebalancing.md).
+
+The role-flip actuator that closes the loop `TpuSeatStarvation` opens:
+disaggregated pools are born statically partitioned (helm modelLabel),
+so a workload shift strands one pool starved while the other idles.
+BanaServe (PAPERS.md) argues the P:D ratio must follow the workload;
+this module is the production-shaped version of that argument — hosted
+in the KV controller, fed by the per-pool signals router replicas
+already report (router/fleet.py `pools`), actuating through the
+engine's existing drain barrier plus the new POST /role endpoint.
+
+Robustness is the design center, not the happy path:
+
+- Every flip is an explicit EPISODE with a persisted phase
+  (`observe → cooldown → drain → flip → rejoin → verify`), written
+  atomically to `state_file` on every transition. A controller crash
+  mid-flip resumes the episode from its persisted phase on restart —
+  or abandons it when it has aged past `episode_timeout_s` (safe:
+  drain and flip are idempotent, and an engine restart restores its
+  static `--pool-role`).
+- A controller outage fails OPEN: engines only ever act on explicit
+  POSTs, so a dead controller leaves every engine serving under its
+  last role (the PR 12 fail-open idiom — coherence may degrade,
+  availability never does).
+- A flip that makes the starved pool WORSE within the verify window is
+  rolled back exactly once and the engine goes on cooldown, so a
+  mis-diagnosed imbalance cannot oscillate an engine between roles.
+- Hysteresis (`observe_s` of SUSTAINED imbalance before acting) plus
+  min-pool-size floors guarantee the actuator can never drain the last
+  engine of either role.
+
+The tick loop beats a liveness heartbeat ("rebalancer" in the
+THREAD_NAME_VALUES closed set) so the PR 15 watchdog machinery names a
+wedged rebalancer instead of letting starvation quietly persist.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+from .. import metrics_contract as mc
+from ..utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+# transitional phases belong to an active episode; observe/cooldown are
+# the idle phases (the TpuRebalanceStuck alert keys off the transitional
+# set staying pinned)
+TRANSITIONAL_PHASES = ("drain", "flip", "rejoin", "verify")
+
+
+@dataclass
+class RebalanceConfig:
+    enabled: bool = False
+    # tick cadence of the state machine; every phase advances at most
+    # once per tick, so drain/flip retries are naturally paced by it
+    interval_s: float = 2.0
+    # hysteresis: one imbalance DIRECTION must hold for this long before
+    # an episode starts (a single hot scrape must not flip an engine)
+    observe_s: float = 10.0
+    # global hold-off after any finished episode before the next may start
+    cooldown_s: float = 60.0
+    # how long a completed flip gets to prove itself before the verdict;
+    # a starved-pool queue wait WORSE than the episode baseline within
+    # this window triggers the single rollback
+    verify_window_s: float = 30.0
+    # min-pool-size floors: an episode never starts if flipping would
+    # leave the source pool below its floor — the actuator structurally
+    # cannot drain the last engine of either role
+    min_prefill: int = 1
+    min_decode: int = 1
+    # imbalance thresholds, mirroring the TpuSeatStarvation rule
+    # (queue-wait p95 > 1s while decode seats sit < 50% full)
+    queue_wait_trigger_s: float = 1.0
+    occupancy_rich_max: float = 0.5
+    # bound on the POST /drain?wait=true barrier per attempt
+    drain_timeout_s: float = 30.0
+    # consecutive unreachable-engine ticks before the episode is
+    # abandoned (the engine's restart restores its static role)
+    unreachable_limit: int = 5
+    # wall-clock bound on a whole episode — a resumed-from-crash episode
+    # older than this is abandoned instead of replayed
+    episode_timeout_s: float = 600.0
+    # per-engine hold-off after a rollback (the "engine pair on
+    # cooldown" rule: the flipped engine sits out this long)
+    engine_cooldown_s: float = 300.0
+    # persisted state (episode phase + outcome counters); "" = in-memory
+    # only (tests; a restart then starts from observe, which is safe)
+    state_file: str = ""
+
+
+@dataclass
+class Episode:
+    """One flip attempt, JSON-persisted field-for-field."""
+
+    seq: int
+    engine: str
+    from_role: str
+    to_role: str
+    phase: str  # drain | flip | rejoin | verify
+    started_ts: float  # wall clock — survives restarts
+    phase_ts: float
+    # the starved pool + its queue wait when the episode started: the
+    # verify verdict compares against this
+    starved_role: str
+    baseline_queue_wait: float
+    rolled_back: bool = False
+    unreachable: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "engine": self.engine,
+            "from_role": self.from_role,
+            "to_role": self.to_role,
+            "phase": self.phase,
+            "started_ts": self.started_ts,
+            "phase_ts": self.phase_ts,
+            "starved_role": self.starved_role,
+            "baseline_queue_wait": self.baseline_queue_wait,
+            "rolled_back": self.rolled_back,
+            "unreachable": self.unreachable,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Episode":
+        return cls(
+            seq=int(d.get("seq") or 0),
+            engine=str(d.get("engine") or ""),
+            from_role=str(d.get("from_role") or ""),
+            to_role=str(d.get("to_role") or ""),
+            phase=str(d.get("phase") or "drain"),
+            started_ts=float(d.get("started_ts") or 0.0),
+            phase_ts=float(d.get("phase_ts") or 0.0),
+            starved_role=str(d.get("starved_role") or ""),
+            baseline_queue_wait=float(d.get("baseline_queue_wait") or 0.0),
+            rolled_back=bool(d.get("rolled_back")),
+            unreachable=int(d.get("unreachable") or 0),
+        )
+
+
+@dataclass
+class _PoolView:
+    """One direction-evaluation input: both pools, split by live role."""
+
+    prefill: dict[str, dict] = field(default_factory=dict)
+    decode: dict[str, dict] = field(default_factory=dict)
+
+    def pool(self, role: str) -> dict[str, dict]:
+        return self.prefill if role == "prefill" else self.decode
+
+
+def _max_queue_wait(pool: dict[str, dict]) -> float:
+    return max(
+        (p.get("queue_wait_p95", 0.0) for p in pool.values()), default=0.0
+    )
+
+
+def _mean_occupancy(pool: dict[str, dict]) -> float:
+    if not pool:
+        return 0.0
+    return sum(p.get("seat_occupancy", 0.0) for p in pool.values()) / len(pool)
+
+
+class PoolRebalancer:
+    """Crash-safe role-flip state machine; one instance per controller.
+
+    `pool_stats_fn` returns the merged fleet view (url -> {role,
+    queue_wait_p95, seat_occupancy, load}); `session_fn` is an async
+    callable yielding the controller's shared aiohttp session;
+    `registered_roles_fn` returns the roles engines advertised at
+    registration (fresher than the scrape-lagged fleet view right after
+    a flip — the engine itself is the authority). `now_fn` is injectable
+    so tests drive the clock."""
+
+    def __init__(self, config: RebalanceConfig, pool_stats_fn,
+                 session_fn, registered_roles_fn=None, heartbeat=None,
+                 now_fn=time.time):
+        self.config = config
+        self.pool_stats_fn = pool_stats_fn
+        self.session_fn = session_fn
+        self.registered_roles_fn = registered_roles_fn or (lambda: {})
+        self.heartbeat = heartbeat
+        self.now_fn = now_fn
+        self.episode: Episode | None = None
+        self.flips: dict[str, int] = {o: 0 for o in
+                                      mc.POOL_REBALANCE_OUTCOME_VALUES}
+        self.episodes_started = 0
+        self.cooldown_until: float = 0.0
+        self.engine_cooldown_until: dict[str, float] = {}
+        # hysteresis tracker: (starved_role, first-seen wall clock)
+        self._imbalance_since: tuple[str, float] | None = None
+        self.last_error: str | None = None
+        self._task: asyncio.Task | None = None
+        self._load_state()
+
+    # -- persistence -------------------------------------------------------
+
+    def _load_state(self) -> None:
+        path = self.config.state_file
+        if not path or not os.path.exists(path):
+            return
+        try:
+            with open(path, encoding="utf-8") as f:
+                state = json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning("rebalancer state unreadable (%s); starting "
+                           "fresh", e)
+            return
+        for outcome, n in (state.get("flips") or {}).items():
+            if outcome in self.flips:
+                self.flips[outcome] = int(n)
+        self.episodes_started = int(state.get("episodes_started") or 0)
+        self.cooldown_until = float(state.get("cooldown_until") or 0.0)
+        raw = state.get("episode")
+        if raw:
+            ep = Episode.from_dict(raw)
+            # resume counts from zero unreachable ticks — the crash may
+            # have been ours, not the engine's
+            ep.unreachable = 0
+            self.episode = ep
+            logger.info(
+                "resuming rebalance episode %d (%s -> %s, phase=%s) "
+                "from persisted state",
+                ep.seq, ep.engine, ep.to_role, ep.phase,
+            )
+
+    def _save_state(self) -> None:
+        path = self.config.state_file
+        if not path:
+            return
+        state = {
+            "version": 1,
+            "flips": self.flips,
+            "episodes_started": self.episodes_started,
+            "cooldown_until": self.cooldown_until,
+            "episode": self.episode.to_dict() if self.episode else None,
+        }
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(state, f)
+            os.replace(tmp, path)  # atomic: a crash never half-writes
+        except OSError as e:
+            logger.warning("rebalancer state persist failed: %s", e)
+
+    # -- loop --------------------------------------------------------------
+
+    def start(self) -> None:
+        if self.config.enabled and self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # the actuator must outlive any fault
+                self.last_error = f"{type(e).__name__}: {e}"
+                logger.warning("rebalancer tick failed: %s", e)
+            await asyncio.sleep(self.config.interval_s)
+
+    async def tick(self) -> None:
+        """One state-machine step — also the unit tests' entry point."""
+        if self.heartbeat is not None:
+            self.heartbeat.beat()
+        if self.episode is not None:
+            await self._advance()
+        else:
+            self._evaluate()
+
+    # -- observation -------------------------------------------------------
+
+    def _pool_view(self) -> _PoolView:
+        """Both pools under LIVE roles: registration-advertised role wins
+        (the engine is the authority and reports it the moment a flip
+        lands), the router-scraped role covers engines that registered
+        before roles existed."""
+        view = _PoolView()
+        reg = dict(self.registered_roles_fn() or {})
+        for url, p in (self.pool_stats_fn() or {}).items():
+            role = reg.get(url) or p.get("role") or ""
+            if role in ("prefill", "decode"):
+                view.pool(role)[url] = p
+        return view
+
+    def _diagnose(self, view: _PoolView) -> str | None:
+        """Which pool is starved, or None. Mirrors TpuSeatStarvation:
+        work queues while the other pool's capacity sits idle.
+
+        - "prefill" starved: prefill queue wait past the trigger while
+          decode seats sit below occupancy_rich_max (decode is rich).
+        - "decode" starved: decode queue wait past the trigger, decode
+          seats ABOVE the rich ceiling (genuinely busy), prefill quiet
+          (prefill is rich)."""
+        cfg = self.config
+        if not view.prefill or not view.decode:
+            return None  # not a (complete) disaggregated deployment
+        prefill_qw = _max_queue_wait(view.prefill)
+        decode_qw = _max_queue_wait(view.decode)
+        decode_occ = _mean_occupancy(view.decode)
+        if (prefill_qw > cfg.queue_wait_trigger_s
+                and decode_occ < cfg.occupancy_rich_max):
+            return "prefill"
+        if (decode_qw > cfg.queue_wait_trigger_s
+                and decode_occ >= cfg.occupancy_rich_max
+                and prefill_qw <= cfg.queue_wait_trigger_s / 2):
+            return "decode"
+        return None
+
+    def _evaluate(self) -> None:
+        now = self.now_fn()
+        if now < self.cooldown_until:
+            return  # phase renders as "cooldown"
+        view = self._pool_view()
+        starved = self._diagnose(view)
+        if starved is None:
+            self._imbalance_since = None
+            return
+        # hysteresis: the SAME direction must hold for observe_s
+        if (self._imbalance_since is None
+                or self._imbalance_since[0] != starved):
+            self._imbalance_since = (starved, now)
+            return
+        if now - self._imbalance_since[1] < self.config.observe_s:
+            return
+        rich = "decode" if starved == "prefill" else "prefill"
+        rich_pool = view.pool(rich)
+        floor = (self.config.min_decode if rich == "decode"
+                 else self.config.min_prefill)
+        if len(rich_pool) - 1 < floor:
+            # flipping would drop the rich pool below its floor — the
+            # last-engine guarantee. Keep observing; scale-up is the
+            # operator's move here, not a flip.
+            return
+        candidates = {
+            url: p for url, p in rich_pool.items()
+            if now >= self.engine_cooldown_until.get(url, 0.0)
+        }
+        if not candidates:
+            return
+        # least-loaded engine in the rich pool pays the smallest drain
+        target = min(
+            candidates, key=lambda u: (candidates[u].get("load", 0.0), u)
+        )
+        self.episodes_started += 1
+        self.episode = Episode(
+            seq=self.episodes_started,
+            engine=target,
+            from_role=rich,
+            to_role=starved,
+            phase="drain",
+            started_ts=now,
+            phase_ts=now,
+            starved_role=starved,
+            baseline_queue_wait=_max_queue_wait(view.pool(starved)),
+        )
+        self._imbalance_since = None
+        self._save_state()
+        logger.info(
+            "rebalance episode %d: %s pool starved -> draining %s "
+            "(%s -> %s, baseline queue wait %.2fs)",
+            self.episode.seq, starved, target, rich, starved,
+            self.episode.baseline_queue_wait,
+        )
+
+    # -- actuation ---------------------------------------------------------
+
+    async def _advance(self) -> None:
+        ep = self.episode
+        assert ep is not None
+        now = self.now_fn()
+        if now - ep.started_ts > self.config.episode_timeout_s:
+            self._finish("abandoned", "episode timed out")
+            return
+        try:
+            if ep.phase == "drain":
+                await self._phase_drain(ep)
+            elif ep.phase == "flip":
+                await self._phase_flip(ep)
+            elif ep.phase == "rejoin":
+                await self._phase_rejoin(ep)
+            elif ep.phase == "verify":
+                self._phase_verify(ep)
+            else:  # unknown persisted phase (newer writer?) — bail safely
+                self._finish("abandoned", f"unknown phase {ep.phase!r}")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._note_unreachable(ep, e)
+
+    def _note_unreachable(self, ep: Episode, err: Exception) -> None:
+        ep.unreachable += 1
+        self.last_error = f"{type(err).__name__}: {err}"
+        logger.warning(
+            "rebalance episode %d: %s unreachable in phase %s (%d/%d): %s",
+            ep.seq, ep.engine, ep.phase, ep.unreachable,
+            self.config.unreachable_limit, err,
+        )
+        if ep.unreachable >= self.config.unreachable_limit:
+            # the engine died or partitioned mid-episode. Abandoning is
+            # safe: drain/flip are idempotent and its restart comes back
+            # under the static --pool-role
+            self._finish("abandoned",
+                         f"engine unreachable x{ep.unreachable}")
+        else:
+            self._save_state()
+
+    def _transition(self, ep: Episode, phase: str) -> None:
+        ep.phase = phase
+        ep.phase_ts = self.now_fn()
+        ep.unreachable = 0
+        self._save_state()
+        logger.info("rebalance episode %d: -> %s", ep.seq, phase)
+
+    async def _phase_drain(self, ep: Episode) -> None:
+        """POST /drain?wait=true — the existing barrier: admissions stop,
+        in-flight streams finish, the engine deregisters. Idempotent, so
+        crash-resume lands here harmlessly."""
+        import aiohttp
+
+        sess = await self.session_fn()
+        timeout = aiohttp.ClientTimeout(
+            total=self.config.drain_timeout_s + 10.0
+        )
+        async with sess.post(
+            ep.engine + "/drain", params={"wait": "true"}, timeout=timeout
+        ) as resp:
+            await resp.read()
+            if resp.status == 200:
+                self._transition(ep, "flip")
+            elif resp.status == 202:
+                # barrier not passed yet; re-POST next tick (idempotent)
+                ep.unreachable = 0
+                self._save_state()
+            else:
+                raise RuntimeError(f"drain returned HTTP {resp.status}")
+
+    async def _phase_flip(self, ep: Episode) -> None:
+        """POST /role — the engine re-opens admissions under the new role
+        and re-registers. Idempotent: re-POSTing the same role is a
+        no-op flip."""
+        sess = await self.session_fn()
+        async with sess.post(
+            ep.engine + "/role", json={"role": ep.to_role}
+        ) as resp:
+            await resp.read()
+            if resp.status == 200:
+                self._transition(ep, "rejoin")
+            elif resp.status == 409:
+                # the engine is on its SIGTERM way out — not coming back
+                self._finish("abandoned", "engine exiting (409 from /role)")
+            else:
+                raise RuntimeError(f"/role returned HTTP {resp.status}")
+
+    async def _phase_rejoin(self, ep: Episode) -> None:
+        """Confirm the engine serves under the new role (GET /health) —
+        the explicit re-admission gate before the verify clock starts."""
+        sess = await self.session_fn()
+        async with sess.get(ep.engine + "/health") as resp:
+            body = await resp.json()
+            if resp.status != 200:
+                # liveness 503 = step loop dead — counts toward the
+                # unreachable limit like a refused connection
+                raise RuntimeError(f"/health returned HTTP {resp.status}")
+            if (not body.get("draining")
+                    and body.get("role") == ep.to_role):
+                self._transition(ep, "verify")
+            elif body.get("role") not in (ep.to_role, None):
+                # serving the WRONG role: the flip never landed (engine
+                # restarted under its static role mid-episode) — go back
+                # one phase rather than verifying a fiction
+                self._transition(ep, "flip")
+            # else: still draining/settling; retry next tick
+
+    def _phase_verify(self, ep: Episode) -> None:
+        """After verify_window_s, judge the flip: a starved-pool queue
+        wait WORSE than the episode baseline means the flip hurt — roll
+        it back once (re-enter drain with the roles swapped); anything
+        else completes the episode."""
+        now = self.now_fn()
+        if now - ep.phase_ts < self.config.verify_window_s:
+            return
+        view = self._pool_view()
+        current = _max_queue_wait(view.pool(ep.starved_role))
+        worse = current > max(ep.baseline_queue_wait,
+                              self.config.queue_wait_trigger_s)
+        if worse and not ep.rolled_back:
+            logger.warning(
+                "rebalance episode %d: %s pool queue wait %.2fs > "
+                "baseline %.2fs after flip — rolling back",
+                ep.seq, ep.starved_role, current, ep.baseline_queue_wait,
+            )
+            ep.from_role, ep.to_role = ep.to_role, ep.from_role
+            ep.rolled_back = True
+            self._transition(ep, "drain")
+            return
+        if ep.rolled_back:
+            # the rollback's own verify pass: the engine is back under
+            # its original role — close the episode as rolled_back and
+            # keep this engine out of the next episodes
+            self.engine_cooldown_until[ep.engine] = (
+                now + self.config.engine_cooldown_s
+            )
+            self._finish("rolled_back", "flip made imbalance worse")
+        else:
+            self._finish("completed", None)
+
+    def _finish(self, outcome: str, reason: str | None) -> None:
+        ep = self.episode
+        assert ep is not None
+        self.flips[outcome] = self.flips.get(outcome, 0) + 1
+        self.cooldown_until = self.now_fn() + self.config.cooldown_s
+        self.episode = None
+        self._imbalance_since = None
+        self._save_state()
+        logger.info(
+            "rebalance episode %d finished: %s%s",
+            ep.seq, outcome, f" ({reason})" if reason else "",
+        )
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def phase(self) -> str:
+        """The phase gauge's current value: an active episode's phase,
+        else cooldown while the global hold-off runs, else observe."""
+        if self.episode is not None:
+            return self.episode.phase
+        if self.now_fn() < self.cooldown_until:
+            return "cooldown"
+        return "observe"
+
+    def snapshot(self) -> dict:
+        """GET /rebalance operator view."""
+        now = self.now_fn()
+        return {
+            "enabled": self.config.enabled,
+            "phase": self.phase,
+            "episode": self.episode.to_dict() if self.episode else None,
+            "episodes_started": self.episodes_started,
+            "flips": dict(self.flips),
+            "cooldown_remaining_s": max(0.0, self.cooldown_until - now),
+            "engine_cooldowns": {
+                url: round(until - now, 1)
+                for url, until in self.engine_cooldown_until.items()
+                if until > now
+            },
+            "last_error": self.last_error,
+            "config": {
+                "observe_s": self.config.observe_s,
+                "cooldown_s": self.config.cooldown_s,
+                "verify_window_s": self.config.verify_window_s,
+                "min_prefill": self.config.min_prefill,
+                "min_decode": self.config.min_decode,
+                "queue_wait_trigger_s": self.config.queue_wait_trigger_s,
+                "occupancy_rich_max": self.config.occupancy_rich_max,
+            },
+        }
+
+    def metrics_lines(self) -> list[str]:
+        """Hand-rendered Prometheus lines for the controller's /metrics
+        (the live home of these contract names; the router registry
+        zero-seeds the same names — check_metrics_contract's exporter
+        union)."""
+        lines = [f"# TYPE {mc.POOL_REBALANCE_FLIPS} counter"]
+        for outcome in mc.POOL_REBALANCE_OUTCOME_VALUES:
+            lines.append(
+                f'{mc.POOL_REBALANCE_FLIPS}{{outcome="{outcome}"}} '
+                f"{self.flips.get(outcome, 0)}"
+            )
+        lines.append(f"# TYPE {mc.POOL_REBALANCE_PHASE} gauge")
+        current = self.phase
+        for phase in mc.POOL_REBALANCE_PHASE_VALUES:
+            lines.append(
+                f'{mc.POOL_REBALANCE_PHASE}{{phase="{phase}"}} '
+                f"{1 if phase == current else 0}"
+            )
+        return lines

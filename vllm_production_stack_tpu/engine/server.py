@@ -115,11 +115,22 @@ class EngineServer:
                  drain_timeout_s: float = 30.0, request_tracing: bool = True,
                  trace_buffer: int = 256, watchdog: bool = True,
                  watchdog_interval_s: float = 1.0,
-                 watchdog_stall_s: float = 120.0, postmortem_dir: str = ""):
+                 watchdog_stall_s: float = 120.0, postmortem_dir: str = "",
+                 pool_role: str = ""):
         self.engine = engine
         self.async_engine = AsyncEngine(engine)
         self.model_name = served_model_name or engine.config.model.model
         self.metrics = EngineMetrics(self.model_name)
+        # disaggregated pool role (docs/40-pool-rebalancing.md): a RUNTIME
+        # property, seeded from --pool-role and flipped by POST /role. The
+        # engine is the authority — it advertises the role on /metrics
+        # (tpu:pool_role), /health, and controller registration; the
+        # router and rebalancer FOLLOW it. "" = not in a disaggregated
+        # deployment.
+        self.pool_role: str | None = pool_role or None
+        self.metrics.set_pool_role(self.pool_role)
+        # serializes POST /role flips against each other and the drain path
+        self._role_lock = asyncio.Lock()
         # request-tracing spine (docs/28-request-tracing.md): per-request
         # span timelines joined to the router's trace via the inbound
         # traceparent header, served by /debug/requests. Disabled
@@ -220,6 +231,7 @@ class EngineServer:
         r.add_get("/health", self.health)
         r.add_get("/ready", self.ready)
         r.add_post("/drain", self.drain)
+        r.add_post("/role", self.set_role)
         r.add_get("/metrics", self.metrics_endpoint)
         r.add_get("/debug", self.debug_index)
         r.add_get("/debug/timing", self.debug_timing)
@@ -361,6 +373,10 @@ class EngineServer:
         my_url = f"http://{pod_ip}:{port}"
 
         body: dict = {"url": my_url}
+        if self.pool_role:
+            # the live pool role rides registration so the controller's
+            # rebalancer sees membership per pool without a scrape hop
+            body["role"] = self.pool_role
         identity = self._device_identity()
         if identity is not None:
             # mesh/process-group identity rides the registration so
@@ -1409,6 +1425,70 @@ class EngineServer:
             status=200 if self._drained.is_set() else 202,
         )
 
+    async def set_role(self, request: web.Request) -> web.Response:
+        """POST /role {"role": "prefill"|"decode"}: flip the engine's
+        disaggregated pool role and RE-ADMIT it (docs/40-pool-rebalancing
+        .md). The rebalancer drains the engine first (POST /drain?wait=
+        true), so arriving here mid-drain means waiting out the barrier;
+        arriving with no drain at all is also legal (the flip phase
+        re-POSTs idempotently). Refused 409 on the SIGTERM exit path —
+        the process is going down, not changing jobs."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        role = body.get("role")
+        from .. import metrics_contract as mc
+
+        if role not in mc.POOL_ROLE_VALUES:
+            return error(
+                400,
+                f"role must be one of {list(mc.POOL_ROLE_VALUES)}",
+                "invalid_request_error",
+            )
+        if self._exit_task is not None and not self._exit_task.done():
+            return error(
+                409, "engine is exiting (SIGTERM drain in progress)",
+                "engine_exiting",
+            )
+        async with self._role_lock:
+            was_draining = self.draining
+            if self._drain_task is not None and not self._drain_task.done():
+                # let the in-flight drain finish its barrier + deregister
+                # before resurrecting the engine under the new identity
+                try:
+                    await asyncio.wait_for(
+                        self._drained.wait(),
+                        timeout=self.drain_timeout_s + 10.0,
+                    )
+                except asyncio.TimeoutError:
+                    return error(
+                        409, "drain barrier did not pass in time",
+                        "engine_draining",
+                    )
+            previous = self.pool_role
+            self.pool_role = role
+            self.metrics.set_pool_role(role)
+            # reopen admissions and reset the drain latch so a LATER
+            # drain/SIGTERM starts a fresh barrier
+            self.async_engine.end_drain()
+            self._drain_task = None
+            self._drained = asyncio.Event()
+            if self.kv_event_publisher is None:
+                self._start_kv_event_publisher()
+            # re-register under the new role; the controller re-adds the
+            # engine to its set and the router's next scrape follows the
+            # advertised tpu:pool_role
+            await self._register_with_kv_controller("/register")
+        logger.info("pool role flip: %s -> %s (was_draining=%s)",
+                    previous, role, was_draining)
+        return web.json_response({
+            "status": "ok",
+            "role": role,
+            "previous_role": previous,
+            "was_draining": was_draining,
+        })
+
     def _overload_state(self) -> str | None:
         """Reason the engine would currently shed a plain request, or None.
         Drives /ready so readiness flips BEFORE collapse. record=False:
@@ -1432,6 +1512,7 @@ class EngineServer:
         return web.json_response({
             "status": "draining" if self.draining else "ok",
             "draining": self.draining,
+            "role": self.pool_role,
             "waiting_requests": waiting,
             "queued_tokens": queued_tokens,
             "overloaded": self._overload_state(),
@@ -2227,6 +2308,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "in-flight streams get this long to finish before "
                         "the KV flush + deregister + exit proceed anyway — "
                         "keep below terminationGracePeriodSeconds")
+    p.add_argument("--pool-role", default="",
+                   choices=["", "prefill", "decode"],
+                   help="disaggregated pool role this engine BOOTS with "
+                        "(docs/40-pool-rebalancing.md). A runtime "
+                        "property: POST /role flips it live and the "
+                        "engine re-registers + advertises tpu:pool_role; "
+                        "empty = not in a disaggregated deployment")
     p.add_argument("--request-tracing", default=True, type=_parse_bool_flag,
                    help="per-request span timelines (docs/28-request-"
                         "tracing.md): admission, queue wait, prefill, "
@@ -2599,6 +2687,7 @@ def main(argv: list[str] | None = None) -> None:
         watchdog_interval_s=args.watchdog_interval_s,
         watchdog_stall_s=args.watchdog_stall_s,
         postmortem_dir=args.postmortem_dir,
+        pool_role=args.pool_role,
     )
     web.run_app(server.build_app(), host=args.host, port=args.port,
                 access_log=None)

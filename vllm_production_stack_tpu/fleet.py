@@ -304,7 +304,8 @@ class _ReplicaState:
 
     __slots__ = ("replica_id", "recv_t", "report_ts", "ring_hash",
                  "breakers", "has_index", "positions", "tenants", "history",
-                 "divergence_blocks", "enforcing", "report_interval")
+                 "divergence_blocks", "enforcing", "report_interval",
+                 "pools")
 
     def __init__(self, replica_id: str):
         self.replica_id = replica_id
@@ -331,6 +332,10 @@ class _ReplicaState:
         # replica enforces nothing, so counting it would make the
         # enforcing ones admit BELOW the global budget forever
         self.enforcing = False
+        # per-engine pool signals this replica scraped (url -> {role,
+        # queue_wait_p95, seat_occupancy, load}) — the rebalancer's
+        # imbalance input (docs/40-pool-rebalancing.md)
+        self.pools: dict[str, dict] = {}
 
 
 class FleetView:
@@ -385,6 +390,19 @@ class FleetView:
                 }
                 for t, c in dict(report.get("tenants") or {}).items()
             }
+            pools = {
+                str(url): {
+                    "role": str(dict(p or {}).get("role") or ""),
+                    "queue_wait_p95": float(
+                        dict(p or {}).get("queue_wait_p95") or 0.0
+                    ),
+                    "seat_occupancy": float(
+                        dict(p or {}).get("seat_occupancy") or 0.0
+                    ),
+                    "load": float(dict(p or {}).get("load") or 0.0),
+                }
+                for url, p in dict(report.get("pools") or {}).items()
+            }
         except (TypeError, ValueError) as e:
             return {"status": "error",
                     "error": f"malformed report field: {e}"}
@@ -403,6 +421,7 @@ class FleetView:
             st.enforcing = enforcing
             st.positions = positions
             st.tenants = tenants
+            st.pools = pools
             st.history.append((
                 now,
                 {t: c.get("requests", 0.0) for t, c in tenants.items()},
@@ -466,6 +485,27 @@ class FleetView:
                     self.live_within_s, 3 * st.report_interval
                 )
             )
+
+    def pool_stats(self, max_age_s: float | None = None) -> dict[str, dict]:
+        """Merged per-engine pool signals across replica reports (url ->
+        {role, queue_wait_p95, seat_occupancy, load}), freshest replica
+        wins per engine — the rebalancer's one input query
+        (docs/40-pool-rebalancing.md). `max_age_s` bounds how stale a
+        report may be (defaults to the live_within_s liveness window):
+        the rebalancer must not act on signals from before an outage."""
+        if max_age_s is None:
+            max_age_s = self.live_within_s
+        now = time.monotonic()
+        merged: dict[str, tuple[float, dict]] = {}
+        with self._lock:
+            for st in self._replicas.values():
+                if now - st.recv_t > max_age_s:
+                    continue
+                for url, p in st.pools.items():
+                    prev = merged.get(url)
+                    if prev is None or st.recv_t > prev[0]:
+                        merged[url] = (st.recv_t, p)
+        return {url: dict(p) for url, (_, p) in merged.items()}
 
     def tenant_rollup(self) -> dict[str, dict]:
         """Fleet-wide per-tenant accounting: admitted request rate summed

@@ -420,10 +420,14 @@ METRIC_LABEL_VALUES[SESSION_STICKINESS_VIOLATIONS] = {
 #   watchdog           the watchdog thread itself (its age is computed by
 #                      the exporter, not the watchdog, so a dead watchdog
 #                      is visible here rather than self-reported)
+#   rebalancer         the KV controller's pool-rebalancer tick loop
+#                      (docs/40-pool-rebalancing.md) — hand-rendered on
+#                      the CONTROLLER's /metrics; the engine exporter
+#                      seeds it 0 like every loop not running locally
 THREAD_HEARTBEAT_AGE = "tpu:thread_heartbeat_age_seconds"
 THREAD_NAME_VALUES = (
     "step", "hydration_fetch", "kv_event_publisher", "kv_writer",
-    "bg_compile", "watchdog",
+    "bg_compile", "watchdog", "rebalancer",
 )
 # watchdog trips, by kind (closed set):
 #   stale_heartbeat  a registered loop stopped beating while busy
@@ -442,6 +446,46 @@ METRIC_LABEL_VALUES[ENGINE_STEP_STALLS] = {"kind": STALL_KIND_VALUES}
 # Exported by the router registry AND hand-rendered by the KV controller,
 # like the CLUSTER_KV_* names.
 ROUTER_EVENT_LOOP_LAG = "tpu:router_event_loop_lag_seconds"
+
+# -- prefill/decode pool rebalancing (docs/40-pool-rebalancing.md) -----------
+# The role-flip actuator that closes the TpuSeatStarvation loop: the KV
+# controller watches per-pool queue-wait p95 vs decode-seat occupancy and
+# flips the least-loaded engine of the rich pool into the starved one.
+#
+# engine-side gauge labeled role= (closed set): 1 on the engine's CURRENT
+# pool role, 0 on the other. Both series render 0 on engines that are not
+# part of a disaggregated deployment — the absence of a 1 is itself the
+# "this engine has no pool role" signal, and keeps the closed set seeded.
+# The router's stats scraper reads this to follow live-advertised roles
+# instead of frozen helm labels.
+POOL_ROLE = "tpu:pool_role"
+POOL_ROLE_VALUES = ("prefill", "decode")
+# controller-side counter labeled outcome= (closed set): one increment per
+# finished rebalance EPISODE.  completed = flip verified and kept;
+# rolled_back = the verify window judged the imbalance worse and the flip
+# was undone once; abandoned = the target engine went unreachable mid-
+# episode (its restart restores the static role, so abandoning is safe).
+# Hand-rendered live by the controller's /metrics; the router registry
+# zero-seeds the same name so the contract check has one exporter home
+# (the CLUSTER_KV_REPLICATIONS convention).
+POOL_REBALANCE_FLIPS = "tpu:pool_rebalance_flips_total"
+POOL_REBALANCE_OUTCOME_VALUES = ("completed", "rolled_back", "abandoned")
+# controller-side gauge labeled phase= (closed set): 1 on the state
+# machine's current phase, 0 elsewhere. "observe" = idle/watching,
+# "cooldown" = post-episode hold-off; drain/flip/rejoin/verify are the
+# transitional phases of an active episode — a transitional phase pinned
+# at 1 for many minutes is the TpuRebalanceStuck alert.
+POOL_REBALANCE_PHASE = "tpu:pool_rebalance_phase"
+POOL_REBALANCE_PHASE_VALUES = (
+    "observe", "cooldown", "drain", "flip", "rejoin", "verify",
+)
+METRIC_LABEL_VALUES[POOL_ROLE] = {"role": POOL_ROLE_VALUES}
+METRIC_LABEL_VALUES[POOL_REBALANCE_FLIPS] = {
+    "outcome": POOL_REBALANCE_OUTCOME_VALUES,
+}
+METRIC_LABEL_VALUES[POOL_REBALANCE_PHASE] = {
+    "phase": POOL_REBALANCE_PHASE_VALUES,
+}
 
 CLUSTER_KV_GAUGES = (
     CLUSTER_KV_INDEX_HASHES,
@@ -499,6 +543,9 @@ ALL_GAUGES = (
     # heartbeat age (thread= closed set) — the signal a wedged engine
     # still emits when it serves nothing
     THREAD_HEARTBEAT_AGE,
+    # pool rebalancing (docs/40-pool-rebalancing.md): the engine's live
+    # pool role (role= closed set, 1 on the current role)
+    POOL_ROLE,
 )
 ALL_COUNTERS = (
     PREFIX_CACHE_HITS,

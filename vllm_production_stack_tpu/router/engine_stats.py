@@ -10,7 +10,7 @@ Runs as an asyncio task (the reference uses a daemon thread)."""
 from __future__ import annotations
 
 import asyncio
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import aiohttp
 from prometheus_client.parser import text_string_to_metric_families
@@ -43,6 +43,19 @@ class EngineStats:
     # toward migration automatically as the faster link gets measured
     kv_device_bw_in_bytes_per_s: float = 0.0
     kv_bytes_per_token: float = 0.0
+    # pool rebalancing (docs/40-pool-rebalancing.md): the engine's LIVE
+    # advertised role (tpu:pool_role sample at 1; "" = none advertised —
+    # the routing policy then falls back to the static helm label), its
+    # decode-seat occupancy EWMA, and the queue-wait p95 the scraper
+    # computes over the scrape-to-scrape histogram delta (a cumulative-
+    # histogram quantile would never decay, so cleared starvation would
+    # look permanent)
+    role: str = ""
+    seat_occupancy: float = 0.0
+    queue_wait_p95: float = 0.0
+    # raw cumulative tpu:request_queue_wait_seconds bucket counts
+    # (le -> count) — the scraper diffs consecutive scrapes
+    queue_wait_buckets: dict[float, float] = field(default_factory=dict)
 
     _FIELDS = {
         mc.NUM_REQUESTS_RUNNING: "num_running_requests",
@@ -52,6 +65,7 @@ class EngineStats:
         mc.PREFIX_CACHE_HITS: "prefix_cache_hits_total",
         mc.PREFIX_CACHE_QUERIES: "prefix_cache_queries_total",
         mc.KV_BYTES_PER_TOKEN: "kv_bytes_per_token",
+        mc.ENGINE_DECODE_SEAT_OCCUPANCY: "seat_occupancy",
     }
 
     @property
@@ -78,7 +92,44 @@ class EngineStats:
                         stats.kv_peer_bw_in_bytes_per_s = sample.value
                     elif tier == "device":
                         stats.kv_device_bw_in_bytes_per_s = sample.value
+                elif sample.name == mc.POOL_ROLE and sample.value >= 1:
+                    stats.role = sample.labels.get("role", "")
+                elif sample.name == mc.REQUEST_QUEUE_WAIT + "_bucket":
+                    try:
+                        le = float(sample.labels.get("le", ""))
+                    except ValueError:
+                        continue
+                    stats.queue_wait_buckets[le] = (
+                        stats.queue_wait_buckets.get(le, 0.0) + sample.value
+                    )
         return stats
+
+
+def _delta_p95(
+    now: dict[float, float], prev: dict[float, float]
+) -> float:
+    """Queue-wait p95 over the scrape-to-scrape bucket delta — the
+    router-side mirror of histogram_quantile(0.95, rate(...)). Returns
+    the upper bound of the bucket the 95th percentile lands in (the same
+    bound-not-interpolated estimate fleet.ConvergenceMeter uses); 0.0
+    when no new observations arrived since the previous scrape."""
+    if not now:
+        return 0.0
+    bounds = sorted(now)
+    deltas = [max(0.0, now[b] - prev.get(b, 0.0)) for b in bounds]
+    total = deltas[-1]  # cumulative buckets: +Inf carries the count
+    if total <= 0:
+        return 0.0
+    target = 0.95 * total
+    finite = [b for b in bounds if b != float("inf")]
+    for bound, cum in zip(bounds, deltas):
+        if cum >= target:
+            if bound == float("inf"):
+                # past every finite bucket: clamp to the largest finite
+                # bound (histogram_quantile does the same)
+                return finite[-1] if finite else 0.0
+            return bound
+    return 0.0
 
 
 class EngineStatsScraper:
@@ -86,6 +137,9 @@ class EngineStatsScraper:
         self.discovery = discovery
         self.interval = interval
         self._stats: dict[str, EngineStats] = {}
+        # previous scrape's cumulative queue-wait buckets per engine —
+        # the baseline the per-scrape p95 delta is computed against
+        self._prev_buckets: dict[str, dict[float, float]] = {}
         self._task: asyncio.Task | None = None
 
     def get_engine_stats(self) -> dict[str, EngineStats]:
@@ -121,7 +175,16 @@ class EngineStatsScraper:
                 *(self._scrape(sess, ep.url) for ep in eps)
             )
         fresh = {url: s for url, s in results if s is not None}
+        for url, s in fresh.items():
+            s.queue_wait_p95 = _delta_p95(
+                s.queue_wait_buckets, self._prev_buckets.get(url, {})
+            )
         # keep only live endpoints so dead engines don't pin stale stats
+        # (and a restarted engine's counter reset reads as delta 0, not
+        # a negative spike — _delta_p95 clamps at 0)
+        self._prev_buckets = {
+            url: s.queue_wait_buckets for url, s in fresh.items()
+        }
         self._stats = fresh
 
     async def _scrape(self, sess, url: str):

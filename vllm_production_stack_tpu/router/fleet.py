@@ -152,7 +152,47 @@ class FleetReporter:
             # only replica does not: counting it would starve tenants
             # below the global budget)
             report["enforcing"] = True
+        pools = self._pool_stats()
+        if pools:
+            report["pools"] = pools
         return report
+
+    def _pool_stats(self) -> dict:
+        """Per-engine pool signals for the controller's rebalancer
+        (docs/40-pool-rebalancing.md): live role (scraped tpu:pool_role,
+        falling back to the routing policy's static label mapping),
+        queue-wait p95 over the last scrape window, decode-seat occupancy,
+        and load. Empty when this router has no engine-stats scraper or
+        no disaggregated labels — the controller treats absence as "no
+        pool signal from this replica"."""
+        state = self.state
+        scraper = getattr(state, "engine_scraper", None)
+        stats = scraper.get_engine_stats() if scraper is not None else {}
+        policy = state.policy
+        prefill_labels = getattr(policy, "prefill_labels", set()) or set()
+        decode_labels = getattr(policy, "decode_labels", set()) or set()
+        pools: dict = {}
+        try:
+            endpoints = state.discovery.endpoints()
+        except Exception:
+            return pools
+        for ep in endpoints:
+            s = stats.get(ep.url)
+            role = (s.role if s is not None else "") or (
+                "prefill" if ep.model_label in prefill_labels
+                else "decode" if ep.model_label in decode_labels
+                else ""
+            )
+            if not role and s is None:
+                continue  # nothing to say about this engine
+            pools[ep.url] = {
+                "role": role,
+                "queue_wait_p95": s.queue_wait_p95 if s is not None else 0.0,
+                "seat_occupancy": s.seat_occupancy if s is not None else 0.0,
+                "load": s.load if s is not None else 0.0,
+                "model_label": ep.model_label,
+            }
+        return pools
 
     async def report_once(self) -> dict:
         """One report round; returns (and stores) the controller reply."""

@@ -137,6 +137,29 @@ class RouterMetrics:
             "Flash-crowd prefix replications ordered by the cluster index",
             registry=self.registry,
         )
+        # pool rebalancing (docs/40-pool-rebalancing.md): the flip state
+        # machine lives in the KV controller, which hand-renders the live
+        # series on its /metrics — these stay 0 here, exported so each
+        # name keeps one registry home (the kv_replications convention)
+        self.pool_rebalance_flips = Counter(
+            mc.POOL_REBALANCE_FLIPS[: -len("_total")],
+            "Finished pool-rebalance episodes by outcome (closed set: "
+            + ", ".join(mc.POOL_REBALANCE_OUTCOME_VALUES) + ")",
+            ["outcome"],
+            registry=self.registry,
+        )
+        for outcome in mc.POOL_REBALANCE_OUTCOME_VALUES:
+            self.pool_rebalance_flips.labels(outcome=outcome)
+        self.pool_rebalance_phase = Gauge(
+            mc.POOL_REBALANCE_PHASE,
+            "Rebalancer state-machine phase (closed set: "
+            + ", ".join(mc.POOL_REBALANCE_PHASE_VALUES)
+            + "; 1 on the current phase)",
+            ["phase"],
+            registry=self.registry,
+        )
+        for phase in mc.POOL_REBALANCE_PHASE_VALUES:
+            self.pool_rebalance_phase.labels(phase=phase).set(0)
         # priced route-vs-migrate (docs/35-peer-kv-reuse.md): per-request
         # verdicts once a prefix owner was found (closed decision set,
         # seeded at zero) — the router half of the peer-tier loop

@@ -863,7 +863,11 @@ class RequestService:
         KV pages ship to the decode peer), then stream the real request from
         a decode engine (reference request.py:339-431)."""
         policy: DisaggregatedPrefillPolicy = self.state.policy
-        prefill_eps, decode_eps = policy.pools(eps)
+        # live-advertised roles when the stats scraper has them, static
+        # labels otherwise (docs/40-pool-rebalancing.md)
+        prefill_eps, decode_eps = policy.pools(
+            eps, self.state.engine_scraper.get_engine_stats()
+        )
         if not prefill_eps or not decode_eps:
             await self._pair_callbacks(request)
             return web.json_response(
@@ -873,31 +877,62 @@ class RequestService:
         stats = self.state.request_monitor.get_request_stats()
         prefill_body = {**body, "max_tokens": 1, "stream": False}
         # pick within each pool directly: routing by body inspection would
-        # misfile a legitimate client max_tokens=1 request in the decode phase
-        prefill_url = qps_min_url(prefill_eps, stats)
+        # misfile a legitimate client max_tokens=1 request in the decode phase.
+        # Both hops treat a drain refusal (503 + X-Engine-Draining) as a
+        # re-pick, not a fault — during a pool role flip (docs/40) the
+        # target drains while still carrying its old role, and clients
+        # must never see the refusal.
+        prefill_url = None
+        prefill_candidates = list(prefill_eps)
+        last_draining = False
         t0 = time.time()
-        try:
-            async with self.session.post(
-                prefill_url + request.path,
-                json=prefill_body,
-                # _upstream_headers, not the raw forward: the prefill hop
-                # must strip inbound tenant/fleet stamp spoofs and carry
-                # the same rid/traceparent/deadline the decode hop gets —
-                # a client could otherwise fabricate stickiness violations
-                # through the prefill engine's audit
-                headers=self._upstream_headers(request),
-            ) as resp:
-                await resp.read()
-                if resp.status != 200:
-                    await self._pair_callbacks(request)
-                    return web.json_response(
-                        {"error": {"message": f"prefill engine returned {resp.status}"}},
-                        status=502,
-                    )
-        except aiohttp.ClientError as e:
+        while prefill_candidates:
+            url = qps_min_url(prefill_candidates, stats)
+            try:
+                async with self.session.post(
+                    url + request.path,
+                    json=prefill_body,
+                    # _upstream_headers, not the raw forward: the prefill hop
+                    # must strip inbound tenant/fleet stamp spoofs and carry
+                    # the same rid/traceparent/deadline the decode hop gets —
+                    # a client could otherwise fabricate stickiness violations
+                    # through the prefill engine's audit
+                    headers=self._upstream_headers(request),
+                ) as resp:
+                    await resp.read()
+                    if (resp.status == 503
+                            and resp.headers.get("X-Engine-Draining")):
+                        last_draining = True
+                        prefill_candidates = [
+                            c for c in prefill_candidates if c.url != url
+                        ]
+                        continue
+                    if resp.status != 200:
+                        await self._pair_callbacks(request)
+                        return web.json_response(
+                            {"error": {"message": f"prefill engine returned {resp.status}"}},
+                            status=502,
+                        )
+                    prefill_url = url
+                    break
+            except aiohttp.ClientError as e:
+                last_draining = False
+                last_err = e
+                prefill_candidates = [
+                    c for c in prefill_candidates if c.url != url
+                ]
+        if prefill_url is None:
             await self._pair_callbacks(request)
+            if last_draining:
+                return web.json_response(
+                    {"error": {"message": "all prefill engines are draining; "
+                                          "retry shortly",
+                               "type": "service_unavailable"}},
+                    status=503,
+                    headers={"Retry-After": "2"},
+                )
             return web.json_response(
-                {"error": {"message": f"prefill engine unreachable: {e}"}},
+                {"error": {"message": f"prefill engine unreachable: {last_err}"}},
                 status=502,
             )
         logger.info(
@@ -924,40 +959,61 @@ class RequestService:
                 pull_body["token_ids"] = p
             elif isinstance(p, list) and len(p) == 1 and isinstance(p[0], str):
                 pull_body["text"] = p[0]
-        try:
-            async with self.session.post(
-                decode_url + "/kv/pull", json=pull_body,
-                timeout=aiohttp.ClientTimeout(total=30),
-            ) as resp:
-                if resp.status == 200:
-                    logger.info(
-                        "PD KV transfer for %s: %s -> %s: %s",
-                        request_id, prefill_url, decode_url,
-                        await resp.json(),
+        decode_candidates = list(decode_eps)
+        while True:
+            try:
+                async with self.session.post(
+                    decode_url + "/kv/pull", json=pull_body,
+                    timeout=aiohttp.ClientTimeout(total=30),
+                ) as resp:
+                    if resp.status == 200:
+                        logger.info(
+                            "PD KV transfer for %s: %s -> %s: %s",
+                            request_id, prefill_url, decode_url,
+                            await resp.json(),
+                        )
+                    else:
+                        logger.warning(
+                            "PD KV transfer for %s returned %d (%s); decode "
+                            "will recompute",
+                            request_id, resp.status, await resp.text(),
+                        )
+            except Exception as e:  # ANY transfer fault degrades to recompute
+                logger.warning(
+                    "PD KV transfer failed (%s); decode will recompute", e
+                )
+            logger.info("Routing request %s to %s at %f", request_id, decode_url, time.time())
+            try:
+                return await self._proxy_stream(
+                    request, body, decode_url, request_id
+                )
+            except UpstreamConnectError as e:
+                if isinstance(e.cause, UpstreamDraining):
+                    # a drain refusal lands before any work starts, so a
+                    # re-pick is retry-safe; the shipped KV stays behind
+                    # and the new pick recomputes — a slower first token
+                    # beats a client-visible refusal mid-flip
+                    decode_candidates = [
+                        c for c in decode_candidates if c.url != decode_url
+                    ]
+                    if decode_candidates:
+                        decode_url = qps_min_url(decode_candidates, stats)
+                        continue
+                    await self._pair_callbacks(request)
+                    return web.json_response(
+                        {"error": {"message": "all decode engines are "
+                                              "draining; retry shortly",
+                                   "type": "service_unavailable"}},
+                        status=503,
+                        headers={"Retry-After": "2"},
                     )
-                else:
-                    logger.warning(
-                        "PD KV transfer for %s returned %d (%s); decode "
-                        "will recompute",
-                        request_id, resp.status, await resp.text(),
-                    )
-        except Exception as e:  # ANY transfer fault degrades to recompute
-            logger.warning(
-                "PD KV transfer failed (%s); decode will recompute", e
-            )
-        logger.info("Routing request %s to %s at %f", request_id, decode_url, time.time())
-        try:
-            return await self._proxy_stream(
-                request, body, decode_url, request_id
-            )
-        except UpstreamConnectError as e:
-            # the shipped KV lives on THIS decode engine — a blind retry
-            # elsewhere would silently recompute; surface the failure
-            await self._pair_callbacks(request)
-            return web.json_response(
-                {"error": {"message": f"decode engine unreachable: {e}"}},
-                status=502,
-            )
+                # the shipped KV lives on THIS decode engine — a blind retry
+                # elsewhere would silently recompute; surface the failure
+                await self._pair_callbacks(request)
+                return web.json_response(
+                    {"error": {"message": f"decode engine unreachable: {e}"}},
+                    status=502,
+                )
 
     # -- sleep / wake control ---------------------------------------------
 

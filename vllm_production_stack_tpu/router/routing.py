@@ -106,21 +106,27 @@ class RoutingContext:
 def qps_min_url(
     endpoints: list[Endpoint], request_stats: dict[str, RequestStats]
 ) -> str:
-    """Least-loaded fallback: an engine with no recorded requests wins
-    immediately, else lowest QPS (reference _qps_routing,
-    routing_logic.py:60-82). Raises LookupError on an empty candidate list
-    (the request service maps it to a clean 503) — returning None here used
-    to surface as an AttributeError deep inside the proxy."""
+    """Least-loaded fallback: lowest live in-flight count first, then
+    lowest QPS (reference _qps_routing, routing_logic.py:60-82). In-flight
+    is the instant signal — windowed QPS lags, and the old "an engine with
+    no recorded requests wins immediately" rule herded every concurrent
+    client onto whichever engine sat idle long enough for its stats entry
+    to expire, saturating engines one at a time while the rest idled. An
+    unknown engine now just sorts as (0 in-flight, 0 qps): still the most
+    attractive candidate, no longer an unconditional claim. Raises
+    LookupError on an empty candidate list (the request service maps it to
+    a clean 503) — returning None here used to surface as an
+    AttributeError deep inside the proxy."""
     if not endpoints:
         raise LookupError("no engines available")
-    best, best_qps = None, float("inf")
-    for ep in endpoints:
+
+    def load(ep: Endpoint) -> tuple[int, float]:
         st = request_stats.get(ep.url)
         if st is None:
-            return ep.url
-        if st.qps < best_qps:
-            best_qps, best = st.qps, ep.url
-    return best
+            return (0, 0.0)
+        return (st.in_prefill_requests + st.in_decoding_requests, st.qps)
+
+    return min(endpoints, key=load).url
 
 
 class RoutingPolicy:
@@ -574,10 +580,17 @@ class KvawarePolicy(RoutingPolicy):
 
 
 class DisaggregatedPrefillPolicy(RoutingPolicy):
-    """Partition engines into prefill/decode pools by model label; the proxy's
-    2-phase orchestration calls this twice per request (phase passed in the
-    body by the request service, matching the reference's max_tokens==1
-    prefill convention, routing_logic.py:426-466)."""
+    """Partition engines into prefill/decode pools; the proxy's 2-phase
+    orchestration calls this twice per request (phase passed in the body by
+    the request service, matching the reference's max_tokens==1 prefill
+    convention, routing_logic.py:426-466).
+
+    Pool membership is a RUNTIME property (docs/40-pool-rebalancing.md):
+    an engine advertising a live role via tpu:pool_role (scraped into
+    EngineStats.role — the rebalancer flips it through POST /role) routes
+    by THAT role; engines with no scraped role fall back to the frozen
+    helm model-label mapping, so the policy degrades to the static
+    partition when the scraper is cold or rebalancing is off."""
 
     name = "disaggregated_prefill"
 
@@ -587,13 +600,34 @@ class DisaggregatedPrefillPolicy(RoutingPolicy):
         self.prefill_labels = set(prefill_labels)
         self.decode_labels = set(decode_labels)
 
-    def pools(self, endpoints: list[Endpoint]) -> tuple[list[Endpoint], list[Endpoint]]:
-        prefill = [e for e in endpoints if e.model_label in self.prefill_labels]
-        decode = [e for e in endpoints if e.model_label in self.decode_labels]
+    def _role_of(
+        self, e: Endpoint, engine_stats: dict[str, EngineStats] | None
+    ) -> str:
+        stats = (engine_stats or {}).get(e.url)
+        if stats is not None and stats.role in ("prefill", "decode"):
+            return stats.role
+        if e.model_label in self.prefill_labels:
+            return "prefill"
+        if e.model_label in self.decode_labels:
+            return "decode"
+        return ""
+
+    def pools(
+        self,
+        endpoints: list[Endpoint],
+        engine_stats: dict[str, EngineStats] | None = None,
+    ) -> tuple[list[Endpoint], list[Endpoint]]:
+        prefill, decode = [], []
+        for e in endpoints:
+            role = self._role_of(e, engine_stats)
+            if role == "prefill":
+                prefill.append(e)
+            elif role == "decode":
+                decode.append(e)
         return prefill, decode
 
     async def route(self, ctx: RoutingContext) -> str:
-        prefill, decode = self.pools(ctx.endpoints)
+        prefill, decode = self.pools(ctx.endpoints, ctx.engine_stats)
         is_prefill = ctx.body.get("max_tokens", 0) == 1
         pool = prefill if is_prefill else decode
         if not pool:

@@ -21,7 +21,7 @@ import uuid
 from aiohttp import web
 
 from .. import metrics_contract as mc
-from ..fleet import SessionStickinessAudit
+from ..fleet import ConvergenceMeter, SessionStickinessAudit
 
 
 class FakeEngine:
@@ -42,6 +42,8 @@ class FakeEngine:
         prefill_tps: float = 0.0,
         peer_pull_tps: float = 0.0,
         kv_bytes_per_token: float = 0.0,
+        role: str = "",
+        kv_controller_url: str = "",
     ):
         self.model = model
         self.tokens_per_sec = tokens_per_sec
@@ -69,6 +71,22 @@ class FakeEngine:
         self.warm_prefixes: set[str] = set()
         self.peer_pulls = 0
         self.cold_prefills = 0
+        # -- pool-rebalancing surface (docs/40-pool-rebalancing.md) --------
+        # the role splits the load model: a "prefill" engine never takes a
+        # decode seat (its capacity is prefill_tps), a "decode"/roleless
+        # engine queues for seats. POST /role flips it live and
+        # re-registers with the KV controller, exactly the flow the
+        # rebalancer drives against real engines.
+        self.role = role
+        self.self_url = self_url
+        self.kv_controller_url = kv_controller_url.rstrip("/")
+        self.draining = False
+        self.seats_busy = 0
+        self.role_flips = 0
+        # seat queue wait, rendered as the contract histogram the router
+        # scraper computes its per-scrape p95 delta from (render-only:
+        # nothing drains into a prometheus registry here)
+        self.queue_wait = ConvergenceMeter(buffer_pending=False)
         # the REAL engine-side stickiness audit (fleet.py) over the
         # router's sticky stamps, so multi-replica benches measure
         # violations through the same detector production uses; self_url
@@ -102,6 +120,14 @@ class FakeEngine:
             return web.json_response(
                 {"error": {"message": "engine is asleep"}}, status=503
             )
+        if self.draining:
+            # the real engine's drain barrier answers 503 with this header
+            # so the router retries elsewhere instead of counting an error
+            return web.json_response(
+                {"error": {"message": "engine is draining"}},
+                status=503,
+                headers={"X-Engine-Draining": "1"},
+            )
         self.total_requests += 1
         self.stickiness.observe_headers(request.headers)
         if self.log_requests:
@@ -128,16 +154,27 @@ class FakeEngine:
             # seat gate FIRST: queue wait at a saturated engine delays the
             # first byte exactly like a real scheduler's waiting queue
             # (self.running already counts this request, so the router's
-            # scraped load sees the backlog)
-            if self._seat_sem is not None:
+            # scraped load sees the backlog). A "prefill"-role engine is
+            # NOT seat-gated — its capacity is prefill_tps, which is what
+            # makes a role flip actually move decode capacity.
+            gated = self._seat_sem is not None and self.role != "prefill"
+            t0 = time.monotonic()
+            if gated:
                 await self._seat_sem.acquire()
+            # every admitted request observes its seat wait (0 when
+            # un-gated) so the rendered queue-wait histogram carries the
+            # same signal the real scheduler's does
+            self.queue_wait.observe(time.monotonic() - t0)
+            if gated:
+                self.seats_busy += 1
             try:
                 await self._prefill_delay(str(prompt), n_prompt, request)
                 return await self._emit(
                     request, body, rid, created, is_chat, n, n_prompt, gap
                 )
             finally:
-                if self._seat_sem is not None:
+                if gated:
+                    self.seats_busy -= 1
                     self._seat_sem.release()
         finally:
             self.running -= 1
@@ -318,6 +355,24 @@ class FakeEngine:
                     f'{mc.KV_TIER_BANDWIDTH}{{model_name="{self.model}",'
                     f'tier="peer",direction="in"}} {bw}'
                 )
+        # pool-rebalancing signal surface (docs/40-pool-rebalancing.md):
+        # one-hot role, decode-seat occupancy, and the cumulative
+        # queue-wait histogram — the three series the router scraper
+        # derives role / seat_occupancy / per-scrape p95 from
+        for value in mc.POOL_ROLE_VALUES:
+            lines.append(
+                f'{mc.POOL_ROLE}{{model_name="{self.model}",'
+                f'role="{value}"}} {1 if value == self.role else 0}'
+            )
+        if self.seats > 0:
+            occ = (
+                self.seats_busy / self.seats if self.role != "prefill"
+                else 0.0
+            )
+            lines.append(
+                f"{mc.ENGINE_DECODE_SEAT_OCCUPANCY}{label} {occ:.3f}"
+            )
+        lines.extend(self.queue_wait.render(mc.REQUEST_QUEUE_WAIT))
         # stickiness-audit contract series (closed reason set), so the
         # multi-replica benches read violations the same way a scraper
         # would off a real engine
@@ -331,7 +386,92 @@ class FakeEngine:
         return web.json_response(self.stickiness.snapshot())
 
     async def h_health(self, request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        # role + draining ride along so the rebalancer's rejoin gate can
+        # confirm the engine serves under the role it was flipped to
+        return web.json_response(
+            {
+                "status": "ok",
+                "role": self.role or None,
+                "draining": self.draining,
+            }
+        )
+
+    async def h_drain(self, request: web.Request) -> web.Response:
+        """The drain barrier, fake-shaped: admissions stop (503 +
+        X-Engine-Draining on completions), in-flight work finishes,
+        the engine deregisters. ?wait=true blocks until idle — 200 once
+        drained, 202 while streams still run, the exact codes the
+        rebalancer's drain phase keys on. Idempotent."""
+        self.draining = True
+        if request.query.get("wait", "").lower() in ("1", "true", "yes"):
+            while self.running > 0:
+                await asyncio.sleep(0.02)
+        if self.running > 0:
+            return web.json_response(
+                {"status": "draining", "running": self.running}, status=202
+            )
+        await self._deregister()
+        return web.json_response({"status": "drained", "running": 0})
+
+    async def h_role(self, request: web.Request) -> web.Response:
+        """Live role flip (docs/40-pool-rebalancing.md): adopt the new
+        pool role, re-open admissions, re-register with the KV
+        controller so the new role is advertised before the next
+        scrape lands."""
+        body = await request.json()
+        role = body.get("role")
+        if role not in mc.POOL_ROLE_VALUES:
+            return web.json_response(
+                {"error": {"message": (
+                    f"role must be one of {sorted(mc.POOL_ROLE_VALUES)}"
+                )}},
+                status=400,
+            )
+        previous = self.role
+        self.role = role
+        self.draining = False
+        self.role_flips += 1
+        await self._register()
+        return web.json_response(
+            {"status": "ok", "role": role, "previous_role": previous or None}
+        )
+
+    async def _register(self) -> None:
+        """Advertise this engine (and its role) to the KV controller.
+        No-op without --kv-controller-url; failures are swallowed — a
+        dead controller must never block serving (fail open)."""
+        if not self.kv_controller_url or not self.self_url:
+            return
+        import aiohttp
+
+        body: dict = {"url": self.self_url, "model": self.model}
+        if self.role:
+            body["role"] = self.role
+        try:
+            timeout = aiohttp.ClientTimeout(total=5)
+            async with aiohttp.ClientSession(timeout=timeout) as sess:
+                async with sess.post(
+                    self.kv_controller_url + "/register", json=body
+                ) as resp:
+                    await resp.read()
+        except Exception:
+            pass
+
+    async def _deregister(self) -> None:
+        if not self.kv_controller_url or not self.self_url:
+            return
+        import aiohttp
+
+        try:
+            timeout = aiohttp.ClientTimeout(total=5)
+            async with aiohttp.ClientSession(timeout=timeout) as sess:
+                async with sess.post(
+                    self.kv_controller_url + "/deregister",
+                    json={"url": self.self_url},
+                ) as resp:
+                    await resp.read()
+        except Exception:
+            pass
 
     async def h_sleep(self, request: web.Request) -> web.Response:
         self.sleeping = True
@@ -356,9 +496,16 @@ class FakeEngine:
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_get("/debug/stickiness", self.h_debug_stickiness)
         app.router.add_get("/health", self.h_health)
+        app.router.add_post("/drain", self.h_drain)
+        app.router.add_post("/role", self.h_role)
         app.router.add_post("/sleep", self.h_sleep)
         app.router.add_post("/wake_up", self.h_wake)
         app.router.add_get("/is_sleeping", self.h_is_sleeping)
+
+        async def _startup(app: web.Application) -> None:
+            await self._register()
+
+        app.on_startup.append(_startup)
         return app
 
 
@@ -389,6 +536,14 @@ def main(argv=None) -> None:
                    help="tpu:kv_bytes_per_token exported on /metrics so "
                         "priced route-vs-migrate can price migrations "
                         "against this fake")
+    p.add_argument("--role", default="", choices=["", "prefill", "decode"],
+                   help="disaggregated pool role: prefill-role engines "
+                        "skip the seat gate (capacity = prefill_tps), "
+                        "decode-role engines queue for seats; POST /role "
+                        "flips it live")
+    p.add_argument("--kv-controller-url", default="",
+                   help="KV controller base URL — the engine registers "
+                        "its URL+role on startup and after every flip")
     args = p.parse_args(argv)
     from ..utils.system import raise_fd_limit
 
@@ -405,6 +560,8 @@ def main(argv=None) -> None:
         prefill_tps=args.prefill_tps,
         peer_pull_tps=args.peer_pull_tps,
         kv_bytes_per_token=args.kv_bytes_per_token,
+        role=args.role,
+        kv_controller_url=args.kv_controller_url,
     )
     web.run_app(engine.build_app(), host=args.host, port=args.port, print=None)
 
