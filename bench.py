@@ -824,6 +824,229 @@ async def _tracing_bench() -> dict:
     }
 
 
+async def _structured_bench() -> dict:
+    """Structured-output serving (docs/41-structured-output.md), CPU-only
+    and pre-preflight: an agent swarm — concurrent chat sessions sharing
+    ONE system+tools prefix — where every turn is a forced
+    schema-constrained tool call (`tool_choice: "required"`).
+
+    Evidence in the BENCH trajectory:
+    - valid tool-call rate 1.0 with enforcement vs ~0 without (same
+      model, same prompts — the grammar is the only difference)
+    - constrained-vs-unconstrained decode overhead at MATCHED decode
+      length (the unconstrained arm replays the constrained arm's median
+      completion length with ignore_eos, so both arms run the same
+      number of decode steps and the delta prices the mask alone)
+    - TTFT under the swarm (streamed probes)
+    - shared-prefix hit rate (every agent rides the same system+tools
+      prefill)
+    - ZERO new compiled programs after warmup — the mask is data, not
+      shape, so constrained traffic must never recompile
+    - bitwise serial-vs-pipelined identity of a constrained stream
+    """
+    import asyncio
+    import dataclasses
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+    from vllm_production_stack_tpu.engine.server import EngineServer
+
+    N_AGENTS = 16
+    REPS = 3
+    N_TTFT = 8
+
+    TOOLS = [{"type": "function", "function": {
+        "name": "record_result",
+        "description": "Record one benchmark observation.",
+        "parameters": {"type": "object", "properties": {
+            "status": {"enum": ["pass", "fail", "flaky"]},
+            "cached": {"type": "boolean"},
+            "tier": {"enum": [0, 1, 2]},
+        }},
+    }}]
+    SYSTEM = ("You are one recorder in a swarm of benchmark agents. "
+              "Observe the run named in the user turn and record exactly "
+              "one observation by calling the tool.")
+
+    # the tool-steering preamble alone outgrows the 256-token tiny
+    # context, so the swarm engine gets a longer one
+    engine = LLMEngine(EngineConfig(
+        model=ModelConfig.tiny(max_model_len=1024),
+        cache=CacheConfig(block_size=8, num_blocks=1536),
+        scheduler=SchedulerConfig(
+            max_num_seqs=8, max_num_batched_tokens=1024,
+            decode_buckets=(4, 8), prefill_buckets=(256, 512, 1024),
+        ),
+    ))
+    srv = EngineServer(engine, served_model_name="tiny")
+    client = TestClient(TestServer(srv.build_app()))
+    await client.start_server()
+
+    def body(i: int, constrained: bool, max_tokens: int) -> dict:
+        return {
+            "model": "tiny",
+            "messages": [
+                {"role": "system", "content": SYSTEM},
+                {"role": "user", "content": f"run #{i}: record it"},
+            ],
+            "tools": TOOLS,
+            "tool_choice": "required" if constrained else "auto",
+            # unconstrained replays the constrained arm's decode length
+            "ignore_eos": not constrained,
+            "temperature": 0.0, "max_tokens": max_tokens,
+        }
+
+    async def one(i: int, constrained: bool, max_tokens: int):
+        t0 = time.monotonic()
+        r = await client.post("/v1/chat/completions",
+                              json=body(i, constrained, max_tokens))
+        doc = await r.json()
+        lat = time.monotonic() - t0
+        assert r.status == 200, doc
+        calls = doc["choices"][0]["message"].get("tool_calls") or []
+        ok = False
+        if len(calls) == 1 and calls[0]["function"]["name"] == "record_result":
+            try:
+                json.loads(calls[0]["function"]["arguments"])
+                ok = True
+            except ValueError:
+                ok = False
+        n_out = (doc.get("usage") or {}).get("completion_tokens") or 0
+        return lat, ok, n_out
+
+    async def flood(constrained: bool, max_tokens: int):
+        return await asyncio.gather(
+            *[one(i, constrained, max_tokens) for i in range(N_AGENTS)]
+        )
+
+    async def settle_compiles(timeout_s=60.0):
+        t_end = time.monotonic() + timeout_s
+        while time.monotonic() < t_end:
+            with engine.runner._bg_lock:
+                if not engine.runner._bg_inflight:
+                    return
+            await asyncio.sleep(0.25)
+
+    def pct(lat, p):
+        if not lat:
+            return None
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3, 2)
+
+    try:
+        # untimed warmup: pay XLA compiles + the grammar build, and learn
+        # the constrained arm's decode length for the matched replay
+        warm = await flood(True, 192)
+        n_toks = sorted(n for _, _, n in warm)
+        matched = max(8, n_toks[len(n_toks) // 2])
+        await flood(False, matched)
+        await settle_compiles()
+        grammar_builds = list(engine.stats().grammar_build_times)
+        programs0 = len(engine.runner._aot_exec)
+        bg0 = engine.runner.bg_compiles
+
+        pools: dict[bool, list[float]] = {True: [], False: []}
+        valid = {True: 0, False: 0}
+        total = {True: 0, False: 0}
+        for _ in range(REPS):
+            for mode in (True, False):
+                # constrained stops naturally at its accepting EOS (cap is
+                # slack); unconstrained replays the matched median length
+                for lat, ok, _n in await flood(mode, 192 if mode else matched):
+                    pools[mode].append(lat)
+                    valid[mode] += int(ok)
+                    total[mode] += 1
+
+        # streamed TTFT probes, constrained: time to the first SSE chunk
+        async def ttft_one(i: int) -> float:
+            t0 = time.monotonic()
+            r = await client.post(
+                "/v1/chat/completions",
+                json=dict(body(i, True, 192), stream=True),
+            )
+            assert r.status == 200
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    t = time.monotonic() - t0
+                    r.close()
+                    return t
+            raise AssertionError("stream produced no chunk")
+
+        ttft = sorted(await asyncio.gather(
+            *[ttft_one(i) for i in range(N_TTFT)]
+        ))
+
+        snap = engine.stats()
+        con = sorted(pools[True])
+        unc = sorted(pools[False])
+        result = {
+            "agents": N_AGENTS,
+            "requests_per_arm": total[True],
+            "matched_decode_tokens": matched,
+            "valid_rate_constrained": round(valid[True] / total[True], 3),
+            "valid_rate_unconstrained": round(valid[False] / total[False], 3),
+            "constrained_p50_ms": pct(con, 0.50),
+            "constrained_p99_ms": pct(con, 0.99),
+            "unconstrained_p50_ms": pct(unc, 0.50),
+            "p50_overhead_pct": round(
+                (pct(con, 0.50) / pct(unc, 0.50) - 1.0) * 100.0, 2
+            ),
+            "ttft_p50_ms": pct(ttft, 0.50),
+            "ttft_p99_ms": pct(ttft, 0.99),
+            "prefix_cache_hit_rate": round(snap.prefix_cache_hit_rate, 3),
+            "grammar_builds": len(grammar_builds),
+            "grammar_build_ms": [round(s * 1e3, 1) for s in grammar_builds],
+            "new_programs_after_warmup":
+                len(engine.runner._aot_exec) - programs0,
+            "bg_compiles_after_warmup": engine.runner.bg_compiles - bg0,
+            "structured_outcomes": dict(snap.structured_outcomes or {}),
+        }
+    finally:
+        await client.close()
+        engine.runner.shutdown(wait=True)
+
+    # rider: serial vs pipelined constrained streams must be bitwise
+    # identical (the async step loop may not change one masked token)
+    spec = {"kind": "json_schema", "schema": {
+        "type": "object", "properties": {
+            "ok": {"type": "boolean"},
+            "mode": {"enum": ["fast", "slow"]},
+        },
+    }}
+    prompts = [list(range(5, 12)), list(range(40, 52))]
+    streams = []
+    for async_on in (True, False):
+        eng = LLMEngine(EngineConfig.tiny().replace(async_scheduling=async_on))
+        try:
+            sp = SamplingParams(max_tokens=48, temperature=0.0)
+            sp = dataclasses.replace(
+                sp, grammar=eng.grammar_cache.get(spec)[0]
+            )
+            outs = eng.generate(prompts, sp)
+            streams.append([o["token_ids"] for o in outs])
+        finally:
+            eng.runner.shutdown(wait=True)
+    result["bitwise_serial_eq_pipelined"] = streams[0] == streams[1]
+    return result
+
+
+def _phase_structured_main() -> None:
+    """Subprocess entry for the CPU-only structured-output bench. Forces
+    CPU before anything touches jax — runs pre-preflight, so the
+    grammar-enforcement evidence survives a wedged TPU tunnel."""
+    import asyncio
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = asyncio.run(_structured_bench())
+    print(json.dumps({"structured": result}), flush=True)
+
+
 async def _blackbox_bench() -> dict:
     """Flight recorder / watchdog / postmortem evidence (docs/37-flight-
     recorder.md), CPU-only and pre-preflight — the phase exists precisely
@@ -3960,6 +4183,8 @@ def main() -> None:
             _phase_tracing_main()
         elif phase == "blackbox":
             _phase_blackbox_main()
+        elif phase == "structured":
+            _phase_structured_main()
         elif phase == "saturation":
             _phase_saturation_main()
         elif phase == "kvflow":
@@ -4022,6 +4247,16 @@ def main() -> None:
     blackbox = _run_phase(
         "blackbox", ["bench.py", "--phase", "blackbox"],
         timeout_s=420, key="blackbox", min_needed_s=90.0,
+    )
+
+    # -0.08) structured output (docs/41-structured-output.md): agent
+    # swarm of forced schema-constrained tool calls — valid rate 1.0 on
+    # vs ~0 off, mask overhead at matched decode length, TTFT, shared-
+    # prefix hit rate, zero recompiles after warmup, serial==pipelined —
+    # CPU-only, pre-preflight, same wedge-proofing
+    structured = _run_phase(
+        "structured", ["bench.py", "--phase", "structured"],
+        timeout_s=420, key="structured", min_needed_s=90.0,
     )
 
     # -0.0625) saturation & goodput (docs/29-saturation-slo.md): ledger
@@ -4122,6 +4357,7 @@ def main() -> None:
             "fairness": fairness,
             "tracing": tracing,
             "blackbox": blackbox,
+            "structured": structured,
             "saturation": saturation,
             "kvflow": kvflow,
             "hydration": hydration,
@@ -4217,6 +4453,7 @@ def main() -> None:
         "fairness": fairness,
         "tracing": tracing,
         "blackbox": blackbox,
+        "structured": structured,
         "saturation": saturation,
         "kvflow": kvflow,
         "hydration": hydration,

@@ -159,6 +159,10 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--kv-peer-transport"
 - {{ .kvPeerTransport | quote }}
 {{- end }}
+{{- if .structuredOutput }}
+- "--structured-output"
+- {{ .structuredOutput | quote }}
+{{- end }}
 {{- if .postmortemDir }}
 - "--postmortem-dir"
 - {{ .postmortemDir | quote }}

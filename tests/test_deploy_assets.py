@@ -155,6 +155,44 @@ def test_rebalance_flags_render_only_behind_the_enable_gate():
         raise AssertionError("schema accepted rebalanceEnabled as a string")
 
 
+def test_structured_output_knob_maps_to_engine_flag():
+    """helm modelSpec.structuredOutput must reach the engine as
+    --structured-output with the exact mode set the server accepts — a
+    chart-side enum drifting from the argparse choices would deploy an
+    engine that dies at boot."""
+    import jsonschema
+
+    tpl = (REPO / "helm/templates/_helpers.tpl").read_text()
+    assert '"--structured-output"' in tpl
+    assert "{{- if .structuredOutput }}" in tpl
+    schema = json.loads((REPO / "helm/values.schema.json").read_text())
+    model_props = schema["properties"]["servingEngineSpec"]["properties"][
+        "modelSpec"]["items"]["properties"]
+    assert set(model_props["structuredOutput"]["enum"]) == {
+        "enforce", "fallback", "off",
+    }
+    # the argparse surface agrees (keep in lockstep with server.py)
+    from vllm_production_stack_tpu.engine.server import build_parser
+
+    action = next(a for a in build_parser()._actions
+                  if "--structured-output" in a.option_strings)
+    assert set(action.choices) == set(model_props["structuredOutput"]["enum"])
+    assert action.default == "enforce"
+    example = yaml.safe_load(
+        (REPO / "helm/examples/values-41-structured.yaml").read_text())
+    spec = example["servingEngineSpec"]["modelSpec"][0]
+    assert spec["structuredOutput"] == "enforce"
+    jsonschema.validate(example, schema)
+    bad = json.loads(json.dumps(example))
+    bad["servingEngineSpec"]["modelSpec"][0]["structuredOutput"] = "strict"
+    try:
+        jsonschema.validate(bad, schema)
+    except jsonschema.ValidationError:
+        pass
+    else:
+        raise AssertionError("schema accepted an unknown structuredOutput")
+
+
 def test_observability_assets_do_not_pin_model_names(tmp_path, monkeypatch):
     """Static observability assets must stay model-agnostic: the shipped
     KEDA example once pinned model_name="llama-3-8b" in its queries, so

@@ -396,10 +396,10 @@ def test_coarse_warmup_precompiles_dominating_lattice():
     b_top = engine.runner._batch_bucket(4)
     # every chunk bucket exists at full batch and TOP width
     for t in (32, 64):
-        assert ("prefill", b_top, t, top_w, False, False) in keys
+        assert ("prefill", b_top, t, top_w, False, False, False) in keys
     # every pow2 window exists at the top decode bucket and TOP width
     for w in (1, 2, 4):
-        assert ("decode", 4, top_w, w, False, False) in keys
+        assert ("decode", 4, top_w, w, False, False, None) in keys
     assert engine.scheduler.pool.stats.queries == 0  # no tokens generated
     # zero generation happened; pool is untouched and serving works
     before = engine.runner.compile_fallbacks

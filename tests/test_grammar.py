@@ -1,0 +1,317 @@
+"""Grammar compiler unit tests (docs/41-structured-output.md): the
+JSON-schema -> byte-DFA -> token-class pipeline, the per-request cursor
+semantics, the verify-path mask builder, the request-surface helpers, and
+the malformed-schema corpus (uncompilable input must raise the typed
+error — never wedge, never escape as a different exception). Pure
+numpy/stdlib: none of this needs jax or an engine."""
+
+import json
+
+import numpy as np
+import pytest
+
+from vllm_production_stack_tpu.engine.grammar import (
+    GrammarCache,
+    GrammarCompileError,
+    GrammarState,
+    TokenGrammar,
+    extract_spec,
+    schema_instance,
+    spec_key,
+    tool_choice_spec,
+    validate_spec,
+)
+
+EOS = 257
+
+
+class ByteTok:
+    """ByteTokenizer-shaped double: id < 256 IS the byte; 256/257/258 are
+    BOS/EOS/PAD (empty content)."""
+
+    bos_token_id = 256
+    eos_token_id = EOS
+    pad_token_id = 258
+
+
+def compile_spec(spec, vocab=300):
+    # vocab > 259 so the model-vocab padding rows (content b"") exist,
+    # exactly like ModelConfig.tiny's 512 vs the tokenizer's 259
+    return GrammarCache(ByteTok(), vocab).get(spec)[0]
+
+
+# byte preference for the smoke walk: closers first so generation
+# terminates instead of recursing into open-ended content
+_PREF = [b'"', b"}", b"]", b",", b":"]
+
+
+def walk(grammar, max_steps=400):
+    """Greedy admissible walk: EOS when accepting, else the most
+    'closing' admissible byte token. Returns (text, token_ids,
+    ended_with_eos)."""
+    st = GrammarState(grammar)
+    out = []
+    for _ in range(max_steps):
+        if st.accepting:
+            st.advance(EOS)
+            return b"".join(out).decode(), [], True
+        mask = st.mask()
+        tid = None
+        for pref in _PREF:
+            cand = pref[0]
+            if cand < len(mask) and mask[cand]:
+                tid = cand
+                break
+        if tid is None:
+            allowed = np.nonzero(mask)[0]
+            assert allowed.size, "non-accepting state with empty mask"
+            tid = int(allowed[0])
+        out.append(bytes([tid]))
+        assert st.advance(tid)
+    raise AssertionError("walk did not terminate")
+
+
+# -- compile + walk ----------------------------------------------------------
+
+
+def test_schema_walk_produces_valid_instance():
+    g = compile_spec({"kind": "json_schema", "schema": {
+        "type": "object",
+        "properties": {
+            "ok": {"type": "boolean"},
+            "mode": {"enum": ["a", "b"]},
+            "n": {"type": "integer"},
+        },
+    }})
+    text, _, eos = walk(g)
+    assert eos
+    doc = json.loads(text)
+    assert set(doc) <= {"ok", "mode", "n"}
+
+
+def test_json_object_walk_parses():
+    text, _, eos = walk(compile_spec({"kind": "json_object"}))
+    assert eos
+    json.loads(text)
+
+
+def test_table_invariants():
+    g = compile_spec({"kind": "json_object"})
+    assert g.token_class.shape == (300,)
+    assert g.class_dest.shape == (g.n_states, g.n_classes)
+    assert g.accepting.shape == (g.n_states,)
+    # empty-content tokens (BOS/EOS/PAD + model-vocab padding) are never
+    # admissible from any state — the device mask only re-adds EOS
+    for tid in (256, EOS, 258, 259, 299):
+        assert (g.class_dest[:, g.token_class[tid]] == -1).all()
+    # EOS allowed exactly in accepting states
+    assert not g.allows(0, EOS)
+
+
+def test_vocabulary_liveness_refuses_unspellable_grammar():
+    # a schema needing byte 'x' with a vocabulary that cannot produce it
+    spec = {"kind": "json_schema", "schema": {"enum": ["x"]}}
+    table = [b""] * 300  # no content tokens at all
+    with pytest.raises(GrammarCompileError, match="cannot spell"):
+        TokenGrammar(spec, table, EOS)
+
+
+# -- GrammarState cursor semantics -------------------------------------------
+
+
+def test_cursor_eos_is_terminator():
+    g = compile_spec({"kind": "json_schema", "schema": {"enum": [True]}})
+    st = GrammarState(g)
+    for b in b"true":
+        assert st.advance(b)
+    assert st.accepting
+    assert st.advance(EOS)  # terminator: state untouched, still accepting
+    assert st.accepting
+    assert st.consumed == 5
+
+
+def test_cursor_inadmissible_parks_dead_and_keeps_counting():
+    g = compile_spec({"kind": "json_schema", "schema": {"enum": [True]}})
+    st = GrammarState(g)
+    assert not st.advance(ord("x"))
+    assert st.state < 0 and st.consumed == 1
+    assert not st.accepting
+    assert not st.mask().any()  # dead: nothing admissible
+    assert not st.advance(ord("t"))  # stays dead
+    # sync replays from scratch when the cursor disagrees with the output
+    st.sync([ord(c) for c in "true"])
+    assert st.accepting and st.consumed == 4
+    # aligned cursor: sync is a no-op (no O(n) replay per call)
+    st.sync([ord(c) for c in "true"])
+    assert st.consumed == 4
+
+
+def test_verify_masks_matches_stepwise():
+    g = compile_spec({"kind": "json_object"})
+    text, _, _ = walk(g)
+    toks = [b for b in text.encode()]
+    state = 0
+    for t in toks[:3]:
+        state = g.advance(state, t)
+    proposal = toks[3:6]
+    vm = g.verify_masks(state, proposal, 4)
+    s = state
+    assert (vm[0] == g.mask_for(s)).all()
+    for j, t in enumerate(proposal):
+        s = g.advance(s, t)
+        assert s >= 0
+        assert (vm[j + 1] == g.mask_for(s)).all()
+    # an invalid proposal token leaves the remaining rows all-True
+    vm = g.verify_masks(state, [0], 3)  # NUL is never admissible here
+    assert vm[1].all() and vm[2].all()
+
+
+# -- cache + identity --------------------------------------------------------
+
+
+def test_cache_hit_and_build_time_drain():
+    cache = GrammarCache(ByteTok(), 300)
+    spec = {"kind": "json_object"}
+    g1, cached1 = cache.get(spec)
+    g2, cached2 = cache.get({"kind": "json_object"})
+    assert not cached1 and cached2 and g1 is g2
+    times = cache.drain_build_times()
+    assert len(times) == 1 and times[0] > 0
+    assert cache.drain_build_times() == []  # drained exactly once
+
+
+def test_spec_key_declaration_order_significant():
+    # property DECLARATION order is part of the grammar (objects emit
+    # properties in order), so reordering keys is a different cache key
+    a = spec_key({"kind": "json_schema", "schema": {"a": 1, "b": 2}})
+    b = spec_key({"kind": "json_schema", "schema": {"b": 2, "a": 1}})
+    assert a != b
+    assert a == spec_key({"kind": "json_schema", "schema": {"a": 1, "b": 2}})
+
+
+# -- request-surface helpers -------------------------------------------------
+
+
+def test_extract_spec_surfaces():
+    assert extract_spec(None, None) is None
+    assert extract_spec({"type": "text"}, None) is None
+    assert extract_spec({"type": "json_object"}, None) == {
+        "kind": "json_object"
+    }
+    got = extract_spec(
+        {"type": "json_schema", "json_schema": {"schema": {"type": "object"}}},
+        None,
+    )
+    assert got == {"kind": "json_schema", "schema": {"type": "object"}}
+    # guided_json (vLLM extension) wins over response_format
+    got = extract_spec({"type": "json_object"}, {"type": "integer"})
+    assert got == {"kind": "json_schema", "schema": {"type": "integer"}}
+    with pytest.raises(GrammarCompileError):
+        extract_spec({"type": "grammar_xml"}, None)
+    with pytest.raises(GrammarCompileError):
+        extract_spec({"type": "json_schema", "json_schema": {}}, None)
+    with pytest.raises(GrammarCompileError):
+        extract_spec(None, "{not json")
+
+
+def test_tool_choice_spec():
+    tools = [
+        {"type": "function", "function": {
+            "name": "get_weather",
+            "parameters": {"type": "object", "properties": {
+                "unit": {"enum": ["c", "f"]},
+            }},
+        }},
+        {"type": "function", "function": {"name": "noop"}},
+    ]
+    assert tool_choice_spec(tools, None) is None
+    assert tool_choice_spec(tools, "auto") is None
+    assert tool_choice_spec(None, "required") is None
+    req = tool_choice_spec(tools, "required")
+    assert req["kind"] == "tool_call" and len(req["tools"]) == 2
+    named = tool_choice_spec(
+        tools, {"type": "function", "function": {"name": "noop"}}
+    )
+    assert [t["name"] for t in named["tools"]] == ["noop"]
+    with pytest.raises(GrammarCompileError, match="unknown function"):
+        tool_choice_spec(
+            tools, {"type": "function", "function": {"name": "absent"}}
+        )
+
+
+def test_forced_tool_call_walk_parses_via_tool_parser():
+    """The forced-tool-call grammar emits exactly the surface
+    tool_calls.parse_tool_calls consumes — a forced call always parses."""
+    from vllm_production_stack_tpu.engine.tool_calls import parse_tool_calls
+
+    tools = [{"function": {"name": "f", "parameters": {
+        "type": "object", "properties": {"on": {"type": "boolean"}},
+    }}}]
+    g = compile_spec(tool_choice_spec(tools, "required"))
+    text, _, eos = walk(g)
+    assert eos
+    content, calls = parse_tool_calls(text)
+    assert content is None  # nothing outside the forced block
+    assert len(calls) == 1
+    assert calls[0]["function"]["name"] == "f"
+    json.loads(calls[0]["function"]["arguments"])
+
+
+def test_schema_instance_satisfies_simple_schemas():
+    schema = {
+        "type": "object",
+        "properties": {
+            "mode": {"enum": ["a", "b"]},
+            "on": {"type": "boolean"},
+            "xs": {"type": "array", "items": {"type": "integer"},
+                   "minItems": 1},
+        },
+    }
+    doc = schema_instance(schema)
+    assert doc["mode"] == "a" and doc["on"] is True and doc["xs"] == [1]
+
+
+# -- malformed-schema corpus (the 400/fallback path's input space) -----------
+
+MALFORMED = [
+    # unsupported constructs
+    {"type": "string", "pattern": "a+"},
+    {"patternProperties": {"^x": {}}},
+    {"$ref": "#/defs/x"},
+    {"allOf": [{"type": "object"}]},
+    # structurally broken
+    {"enum": []},
+    {"enum": "not-a-list"},
+    {"type": []},
+    {"type": "quaternion"},
+    {"properties": "not-an-object"},
+    {"anyOf": []},
+    # cap blowups
+    {"enum": list(range(10_000))},
+    {"type": "array", "items": {"type": "integer"}, "minItems": 500},
+    {"type": "array", "items": {}, "minItems": 5, "maxItems": 2},
+    # depth blowup: nest far past MAX_SCHEMA_DEPTH
+]
+_deep: dict = {"type": "integer"}
+for _ in range(64):
+    _deep = {"type": "object", "properties": {"a": _deep}}
+MALFORMED.append(_deep)
+
+
+@pytest.mark.parametrize("schema", MALFORMED, ids=range(len(MALFORMED)))
+def test_malformed_corpus_raises_typed_error(schema):
+    """Every pathological schema dies as GrammarCompileError — the ONLY
+    exception the router's 400 path and the engine's fallback path catch.
+    Anything else (KeyError, RecursionError, hang) would surface as a 500
+    or a wedged request."""
+    with pytest.raises(GrammarCompileError):
+        validate_spec({"kind": "json_schema", "schema": schema})
+
+
+def test_malformed_corpus_also_refused_with_tokenizer():
+    # same contract through the full tokenizer-bearing compile
+    cache = GrammarCache(ByteTok(), 300)
+    with pytest.raises(GrammarCompileError):
+        cache.get({"kind": "json_schema", "schema": {"enum": []}})
+    with pytest.raises(GrammarCompileError):
+        cache.get({"kind": "nope"})

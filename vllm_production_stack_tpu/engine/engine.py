@@ -95,6 +95,13 @@ class EngineStatsSnapshot:
     # constant (analytic KV bytes per token of this pool) the router reads
     # off /metrics as tpu:kv_bytes_per_token
     kv_bytes_per_token: float = 0.0
+    # structured output (docs/41-structured-output.md): cumulative
+    # {valid, invalid, fallback} terminal outcomes behind
+    # tpu:structured_requests_total, plus the grammar-compile durations
+    # drained for the exporter's build-time histogram (same drain pattern
+    # as tenant_queue_waits)
+    structured_outcomes: dict = field(default_factory=dict)
+    grammar_build_times: list = field(default_factory=list)
 
 
 @dataclass
@@ -503,6 +510,14 @@ class LLMEngine:
         self.meter = StepMeter(
             config.model, config.scheduler, enabled=config.step_metering
         )
+        # structured output (docs/41-structured-output.md): compiled-
+        # grammar LRU, lazily built on the first constrained request (the
+        # engine is the only layer holding both tokenizer and model vocab
+        # size — padding tokens past the tokenizer's range lift to "never
+        # admissible"), plus the terminal-outcome counters behind
+        # tpu:structured_requests_total
+        self._grammar_cache = None
+        self._structured_outcomes = {"valid": 0, "invalid": 0, "fallback": 0}
         # model_fingerprint (computed above, before the KV tiers): same
         # config + same checkpoint (or same random seed) => same KV bytes
         # for same tokens. KV adoption (disaggregated prefill) refuses
@@ -511,6 +526,31 @@ class LLMEngine:
         # attention. The pool storage dtype is part of the identity:
         # adopting e.g. fp8-quantized pages into an exact bf16 cache would
         # silently mark lossy KV as byte-identical to locally computed KV.
+
+    # -- structured output -------------------------------------------------
+
+    @property
+    def grammar_cache(self):
+        """The engine's compiled-grammar LRU (grammar.GrammarCache),
+        created on first use. The HTTP layer compiles specs through this
+        so concurrent agent sessions sharing a schema share ONE automaton
+        (and one set of device tables, keyed by grammar identity)."""
+        if self._grammar_cache is None:
+            from .grammar import GrammarCache
+
+            self._grammar_cache = GrammarCache(
+                self.tokenizer, self.config.model.vocab_size
+            )
+        return self._grammar_cache
+
+    def count_structured(self, outcome: str) -> None:
+        """Bump one tpu:structured_requests_total outcome — the engine
+        counts terminal outcomes itself; the API layer calls this for
+        requests that never reach the scheduler (compile-rejected =>
+        invalid, constraints declined => fallback)."""
+        self._structured_outcomes[outcome] = (
+            self._structured_outcomes.get(outcome, 0) + 1
+        )
 
     # -- request lifecycle -------------------------------------------------
 
@@ -548,6 +588,10 @@ class LLMEngine:
             weight=tenant.weight,
             kv_owner_hint=kv_owner_hint,
         )
+        if req.sampling.grammar is not None:
+            from .grammar import GrammarState
+
+            req.grammar = GrammarState(req.sampling.grammar)
         self.scheduler.add_request(req)
         self._states[request_id] = _RequestState(
             request=req, detok=IncrementalDetokenizer(self.tokenizer)
@@ -1797,6 +1841,17 @@ class LLMEngine:
             # planner per-chunk outcomes (docs/31-hydration-planner.md):
             # the kv_hydration event's "plan" view
             out.hydration_chunks = req.hydration_outcomes
+            # structured outcome (docs/41-structured-output.md), terminal
+            # only: valid iff the automaton sits in an accepting state (the
+            # body parses against the schema by construction); invalid when
+            # generation was cut mid-structure (length cap / abort /
+            # stop-string); counted once per constrained request
+            if req.sampling.grammar is not None and req.grammar is not None:
+                req.grammar.sync(req.output_token_ids)
+                out.structured_outcome = (
+                    "valid" if req.grammar.accepting else "invalid"
+                )
+                self.count_structured(out.structured_outcome)
         return out
 
     @staticmethod
@@ -1943,6 +1998,12 @@ class LLMEngine:
             remote_kv_fetched_blocks=(
                 self.remote_tier.stats.fetched_blocks
                 if self.remote_tier else 0
+            ),
+            structured_outcomes=dict(self._structured_outcomes),
+            grammar_build_times=(
+                self._grammar_cache.drain_build_times()
+                if self._grammar_cache is not None
+                else []
             ),
         )
 

@@ -1,0 +1,926 @@
+"""Grammar-constrained decoding: JSON-schema -> token-class automaton
+(docs/41-structured-output.md).
+
+Compilation pipeline, all off the hot path:
+
+    JSON schema / generic-JSON / forced-tool-call spec
+      -> regex-like AST (lit / charclass / seq / alt / star / opt)
+      -> Thompson NFA over the BYTE alphabet
+      -> subset-construction byte-DFA, dead-end states pruned
+      -> token lifting: run every vocab token's content bytes from every
+         DFA state -> dense dest matrix (S, V)
+      -> token-CLASS compression: vocab columns with identical cross-state
+         behaviour collapse to one class (np.unique over columns), leaving
+         token_class (V,), class_dest (S, C), accepting (S,)
+
+Per-step work is then pure table lookups: the (V,) logit mask for a state
+is `class_dest[state][token_class] >= 0` (memoized per state), and
+advancing on a sampled token is one (state, class) indexed read. The
+tables are plain numpy — small enough to ship to the device as DATA, so
+the jitted decode window advances the automaton on-device without the
+mask ever becoming a program shape (model_runner pads them up exactly
+like batch/width buckets).
+
+This module must stay importable WITHOUT jax: the router calls
+validate_spec() for its 400-on-uncompilable-schema path and must not pay
+(or even have) a jax import.
+
+EOS is not a grammar byte: it is allowed exactly in accepting states and
+is never consumed by the automaton. Tokens with no content bytes
+(BOS/PAD, model-vocab padding beyond the tokenizer) are never allowed.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = [
+    "GrammarCompileError",
+    "GrammarCache",
+    "GrammarState",
+    "TokenGrammar",
+    "extract_spec",
+    "tool_choice_spec",
+    "validate_spec",
+    "schema_instance",
+    "spec_key",
+]
+
+
+class GrammarCompileError(ValueError):
+    """Schema/grammar cannot be compiled (unsupported construct, caps
+    exceeded, or dead-end automaton). Routers/servers map this to 400 in
+    `enforce` mode and to an unconstrained `fallback` serve otherwise —
+    never to a 500."""
+
+
+# Compile-time caps: pathological inputs (deeply nested schemas, huge
+# enums, exponential alternations) must fail with GrammarCompileError
+# instead of wedging the process that compiles them.
+MAX_SCHEMA_DEPTH = 32
+MAX_ENUM_VALUES = 256
+MAX_LITERAL_BYTES = 16384  # total literal bytes across the AST
+MAX_NFA_STATES = 50_000
+MAX_DFA_STATES = 4096
+MAX_REPEAT = 64  # minItems/maxItems expansion bound
+JSON_OBJECT_DEPTH = 4  # nesting budget for {"type": "json_object"}
+
+
+def _canon(value) -> str:
+    """Canonical compact JSON — the exact bytes constrained output uses
+    (no optional whitespace; object keys in declaration order)."""
+    return json.dumps(
+        value, ensure_ascii=False, separators=(",", ":"), sort_keys=False
+    )
+
+
+def spec_key(spec: dict) -> str:
+    """Cache key for a grammar spec. Declaration order is significant
+    (objects emit properties in order), so no sort_keys."""
+    return _canon(spec)
+
+
+# ---------------------------------------------------------------------------
+# AST: nodes are plain tuples so construction stays allocation-cheap.
+#   ("lit", bytes)          exact byte string
+#   ("cls", frozenset[int]) one byte from the set
+#   ("seq", (nodes...))     concatenation (empty tuple = empty string)
+#   ("alt", (nodes...))     alternation (must be non-empty)
+#   ("star", node)          zero or more
+#   ("opt", node)           zero or one
+# ---------------------------------------------------------------------------
+
+_EMPTY = ("seq", ())
+
+
+def _lit(data: bytes):
+    return ("lit", data)
+
+
+def _cls(byteset):
+    return ("cls", frozenset(byteset))
+
+
+def _seq(*nodes):
+    flat = []
+    for n in nodes:
+        if n[0] == "seq":
+            flat.extend(n[1])
+        else:
+            flat.append(n)
+    return ("seq", tuple(flat))
+
+
+def _alt(*nodes):
+    if not nodes:
+        raise GrammarCompileError("empty alternation")
+    return nodes[0] if len(nodes) == 1 else ("alt", tuple(nodes))
+
+
+def _star(node):
+    return ("star", node)
+
+
+def _opt(node):
+    return ("opt", node)
+
+
+_DIGIT = frozenset(b"0123456789")
+_DIGIT19 = frozenset(b"123456789")
+_HEX = frozenset(b"0123456789abcdefABCDEF")
+_ESC_SINGLE = frozenset(b'"\\/bfnrt')
+# Any byte allowed raw inside a JSON string: 0x20..0xFF minus '"' and '\'.
+# Bytes >= 0x80 are permitted (UTF-8 content); byte-level validity of the
+# encoding itself is not enforced — the tokenizer's content bytes are.
+_STR_PLAIN = frozenset(range(0x20, 0x100)) - {0x22, 0x5C}
+
+_STRING_AST = _seq(
+    _lit(b'"'),
+    _star(
+        _alt(
+            _cls(_STR_PLAIN),
+            _seq(
+                _lit(b"\\"),
+                _alt(
+                    _cls(_ESC_SINGLE),
+                    _seq(_lit(b"u"), _cls(_HEX), _cls(_HEX), _cls(_HEX), _cls(_HEX)),
+                ),
+            ),
+        )
+    ),
+    _lit(b'"'),
+)
+
+# Canonical integer: no leading zeros, optional minus.
+_INT_AST = _seq(
+    _opt(_lit(b"-")),
+    _alt(_lit(b"0"), _seq(_cls(_DIGIT19), _star(_cls(_DIGIT)))),
+)
+
+_NUMBER_AST = _seq(
+    _INT_AST,
+    _opt(_seq(_lit(b"."), _cls(_DIGIT), _star(_cls(_DIGIT)))),
+    _opt(
+        _seq(
+            _cls(frozenset(b"eE")),
+            _opt(_cls(frozenset(b"+-"))),
+            _cls(_DIGIT),
+            _star(_cls(_DIGIT)),
+        )
+    ),
+)
+
+_BOOL_AST = _alt(_lit(b"true"), _lit(b"false"))
+_NULL_AST = _lit(b"null")
+
+# Constructs we refuse rather than silently mis-enforce.
+_UNSUPPORTED_KEYS = ("$ref", "allOf", "not", "if", "patternProperties")
+
+
+def _comma_items(item, between=b","):
+    """item ("," item)* — as a `loop` node, which reuses ONE copy of the
+    item fragment with a separator back-edge instead of duplicating it
+    (Thompson star would); keeps deeply-nested generic-JSON grammars from
+    exploding the NFA."""
+    return ("loop", (item, between))
+
+
+def _array_ast(item, min_items: int, max_items: int | None):
+    if min_items < 0 or min_items > MAX_REPEAT:
+        raise GrammarCompileError(f"minItems {min_items} out of range")
+    if max_items is not None:
+        if max_items > MAX_REPEAT:
+            raise GrammarCompileError(f"maxItems {max_items} exceeds cap {MAX_REPEAT}")
+        if max_items < min_items:
+            raise GrammarCompileError("maxItems < minItems")
+    comma_item = _seq(_lit(b","), item)
+    if max_items is None:
+        if min_items == 0:
+            body = _opt(_comma_items(item))
+        else:
+            body = _seq(item, *([comma_item] * (min_items - 1)), _star(comma_item))
+    elif max_items == 0:
+        body = _EMPTY
+    else:
+        tail = _EMPTY
+        for _ in range(max_items - max(min_items, 1)):
+            tail = _opt(_seq(comma_item, tail))
+        head = _seq(item, *([comma_item] * (min_items - 1)), tail)
+        body = head if min_items > 0 else _opt(head)
+    return _seq(_lit(b"["), body, _lit(b"]"))
+
+
+def _value_ast(depth: int):
+    """Generic JSON value with a bounded nesting budget (used for
+    {"type": "json_object"} and schema-less subtrees)."""
+    scalars = _alt(_STRING_AST, _NUMBER_AST, _BOOL_AST, _NULL_AST)
+    if depth <= 0:
+        return scalars
+    inner = _value_ast(depth - 1)
+    return _alt(scalars, _object_ast_generic(depth, inner), _array_ast(inner, 0, None))
+
+
+def _object_ast_generic(depth: int, inner=None):
+    """{ "k": v (, "k": v)* } with generic keys/values."""
+    if inner is None:
+        inner = _value_ast(depth - 1)
+    member = _seq(_STRING_AST, _lit(b":"), inner)
+    return _seq(_lit(b"{"), _opt(_comma_items(member)), _lit(b"}"))
+
+
+def _schema_ast(schema, depth: int):
+    if depth > MAX_SCHEMA_DEPTH:
+        raise GrammarCompileError(f"schema nesting exceeds cap {MAX_SCHEMA_DEPTH}")
+    if schema is True or schema == {}:
+        return _value_ast(min(JSON_OBJECT_DEPTH, MAX_SCHEMA_DEPTH - depth))
+    if not isinstance(schema, dict):
+        raise GrammarCompileError(f"schema must be an object, got {type(schema).__name__}")
+    for key in _UNSUPPORTED_KEYS:
+        if key in schema:
+            raise GrammarCompileError(f"unsupported schema construct {key!r}")
+    if "enum" in schema:
+        values = schema["enum"]
+        if not isinstance(values, list) or not values and values != []:
+            raise GrammarCompileError("enum must be a list")
+        if not values:
+            raise GrammarCompileError("empty enum matches nothing")
+        if len(values) > MAX_ENUM_VALUES:
+            raise GrammarCompileError(
+                f"enum with {len(values)} values exceeds cap {MAX_ENUM_VALUES}"
+            )
+        return _alt(*[_lit(_canon(v).encode("utf-8")) for v in values])
+    if "const" in schema:
+        return _lit(_canon(schema["const"]).encode("utf-8"))
+    for union_key in ("anyOf", "oneOf"):
+        if union_key in schema:
+            subs = schema[union_key]
+            if not isinstance(subs, list) or not subs:
+                raise GrammarCompileError(f"{union_key} must be a non-empty list")
+            return _alt(*[_schema_ast(s, depth + 1) for s in subs])
+    stype = schema.get("type")
+    if isinstance(stype, list):
+        if not stype:
+            raise GrammarCompileError("empty type list")
+        return _alt(
+            *[_schema_ast({**schema, "type": t}, depth + 1) for t in stype]
+        )
+    if stype is None:
+        if "properties" in schema:
+            stype = "object"
+        elif "items" in schema:
+            stype = "array"
+        else:
+            return _value_ast(min(JSON_OBJECT_DEPTH, MAX_SCHEMA_DEPTH - depth))
+    if stype == "object":
+        props = schema.get("properties")
+        if not props:
+            return _object_ast_generic(
+                min(JSON_OBJECT_DEPTH, MAX_SCHEMA_DEPTH - depth)
+            )
+        if not isinstance(props, dict):
+            raise GrammarCompileError("properties must be an object")
+        # Every declared property is emitted, in declaration order, in
+        # canonical compact form — `required` narrowing is not supported
+        # (documented limitation; keeps the automaton linear in schema
+        # size instead of exponential in optional-property subsets).
+        parts = [_lit(b"{")]
+        for i, (name, sub) in enumerate(props.items()):
+            prefix = ("," if i else "") + _canon(str(name)) + ":"
+            parts.append(_lit(prefix.encode("utf-8")))
+            parts.append(_schema_ast(sub, depth + 1))
+        parts.append(_lit(b"}"))
+        return _seq(*parts)
+    if stype == "array":
+        items = schema.get("items")
+        item_ast = (
+            _schema_ast(items, depth + 1)
+            if items is not None
+            else _value_ast(min(JSON_OBJECT_DEPTH, MAX_SCHEMA_DEPTH - depth))
+        )
+        return _array_ast(
+            item_ast,
+            int(schema.get("minItems", 0)),
+            None if schema.get("maxItems") is None else int(schema["maxItems"]),
+        )
+    if stype == "string":
+        if "pattern" in schema:
+            raise GrammarCompileError("unsupported schema construct 'pattern'")
+        return _STRING_AST
+    if stype == "integer":
+        return _INT_AST
+    if stype == "number":
+        return _NUMBER_AST
+    if stype == "boolean":
+        return _BOOL_AST
+    if stype == "null":
+        return _NULL_AST
+    raise GrammarCompileError(f"unsupported schema type {stype!r}")
+
+
+def _tool_call_ast(tools: list[dict]):
+    """Forced tool call: the exact surface `tool_calls.parse_tool_calls`
+    consumes — <tool_call>{"name":<fn>,"arguments":<schema>}</tool_call>
+    with canonical compact JSON, so a forced call ALWAYS parses."""
+    from .tool_calls import TOOL_CLOSE, TOOL_OPEN
+
+    options = []
+    for tool in tools:
+        name = tool.get("name")
+        if not isinstance(name, str) or not name:
+            raise GrammarCompileError("tool without a function name")
+        params = tool.get("parameters")
+        if params:
+            args_ast = _schema_ast(params, 1)
+        else:
+            args_ast = _object_ast_generic(JSON_OBJECT_DEPTH)
+        options.append(
+            _seq(
+                _lit(('{"name":' + _canon(name) + ',"arguments":').encode("utf-8")),
+                args_ast,
+                _lit(b"}"),
+            )
+        )
+    return _seq(_lit(TOOL_OPEN.encode()), _alt(*options), _lit(TOOL_CLOSE.encode()))
+
+
+def build_ast(spec: dict):
+    kind = spec.get("kind")
+    if kind == "json_schema":
+        return _schema_ast(spec.get("schema"), 0)
+    if kind == "json_object":
+        return _value_ast(JSON_OBJECT_DEPTH)
+    if kind == "tool_call":
+        return _tool_call_ast(spec.get("tools") or [])
+    raise GrammarCompileError(f"unknown grammar kind {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# AST -> NFA (Thompson) -> byte-DFA (subset construction) -> prune.
+# ---------------------------------------------------------------------------
+
+
+class _NFA:
+    def __init__(self):
+        self.eps: list[list[int]] = []
+        self.edges: list[list[tuple[frozenset, int]]] = []
+        self.lit_bytes = 0
+
+    def state(self) -> int:
+        if len(self.eps) >= MAX_NFA_STATES:
+            raise GrammarCompileError(
+                f"grammar NFA exceeds cap {MAX_NFA_STATES} states"
+            )
+        self.eps.append([])
+        self.edges.append([])
+        return len(self.eps) - 1
+
+    def build(self, node) -> tuple[int, int]:
+        """Return (start, end) of the fragment for `node`."""
+        kind, payload = node
+        if kind == "lit":
+            self.lit_bytes += len(payload)
+            if self.lit_bytes > MAX_LITERAL_BYTES:
+                raise GrammarCompileError(
+                    f"grammar literals exceed cap {MAX_LITERAL_BYTES} bytes"
+                )
+            start = cur = self.state()
+            for b in payload:
+                nxt = self.state()
+                self.edges[cur].append((frozenset((b,)), nxt))
+                cur = nxt
+            return start, cur
+        if kind == "cls":
+            start, end = self.state(), self.state()
+            self.edges[start].append((payload, end))
+            return start, end
+        if kind == "seq":
+            start = cur = self.state()
+            for sub in payload:
+                s, e = self.build(sub)
+                self.eps[cur].append(s)
+                cur = e
+            return start, cur
+        if kind == "alt":
+            start, end = self.state(), self.state()
+            for sub in payload:
+                s, e = self.build(sub)
+                self.eps[start].append(s)
+                self.eps[e].append(end)
+            return start, end
+        if kind == "star":
+            start, end = self.state(), self.state()
+            s, e = self.build(payload)
+            self.eps[start] += [s, end]
+            self.eps[e] += [s, end]
+            return start, end
+        if kind == "opt":
+            start, end = self.state(), self.state()
+            s, e = self.build(payload)
+            self.eps[start] += [s, end]
+            self.eps[e].append(end)
+            return start, end
+        if kind == "loop":  # item (sep item)*, single shared item fragment
+            item, sep = payload
+            s, e = self.build(item)
+            cur = e
+            for b in sep:
+                nxt = self.state()
+                self.edges[cur].append((frozenset((b,)), nxt))
+                cur = nxt
+            self.eps[cur].append(s)
+            return s, e
+        raise GrammarCompileError(f"bad AST node {kind!r}")
+
+
+def _ast_to_dfa(ast) -> tuple[np.ndarray, np.ndarray]:
+    """(table (S, 256) int32 with -1 = reject, accepting (S,) bool);
+    state 0 is the start state. Dead-end states (no path to acceptance)
+    are pruned so a mask never steers generation into a stuck state."""
+    nfa = _NFA()
+    start, end = nfa.build(ast)
+
+    eps_closure_memo: dict[int, frozenset] = {}
+
+    def closure(states) -> frozenset:
+        seen = set()
+        stack = list(states)
+        while stack:
+            s = stack.pop()
+            if s in seen:
+                continue
+            seen.add(s)
+            memo = eps_closure_memo.get(s)
+            if memo is not None:
+                seen |= memo
+                continue
+            stack.extend(nfa.eps[s])
+        return frozenset(seen)
+
+    start_set = closure((start,))
+    index: dict[frozenset, int] = {start_set: 0}
+    order = [start_set]
+    rows: list[dict[int, int]] = []
+    i = 0
+    while i < len(order):
+        current = order[i]
+        i += 1
+        moves: dict[int, set] = {}
+        for s in current:
+            for byteset, dst in nfa.edges[s]:
+                for b in byteset:
+                    moves.setdefault(b, set()).add(dst)
+        row: dict[int, int] = {}
+        for b, dsts in moves.items():
+            nxt = closure(dsts)
+            j = index.get(nxt)
+            if j is None:
+                if len(order) >= MAX_DFA_STATES:
+                    raise GrammarCompileError(
+                        f"grammar DFA exceeds cap {MAX_DFA_STATES} states"
+                    )
+                j = len(order)
+                index[nxt] = j
+                order.append(nxt)
+            row[b] = j
+        rows.append(row)
+
+    n = len(order)
+    table = np.full((n, 256), -1, dtype=np.int32)
+    for s, row in enumerate(rows):
+        for b, j in row.items():
+            table[s, b] = j
+    accepting = np.array([end in st for st in order], dtype=bool)
+
+    # Prune byte transitions into states that cannot reach acceptance.
+    live = accepting.copy()
+    changed = True
+    while changed:
+        changed = False
+        reach_live = (table >= 0) & live[np.maximum(table, 0)]
+        new_live = live | reach_live.any(axis=1)
+        if (new_live != live).any():
+            live = new_live
+            changed = True
+    if not live[0]:
+        raise GrammarCompileError("grammar matches no string")
+    table[(table >= 0) & ~live[np.maximum(table, 0)]] = -1
+    return _minimize(table, accepting)
+
+
+def _minimize(table: np.ndarray, accepting: np.ndarray):
+    """Moore partition refinement over the dense byte table. Subset
+    construction leaves many behaviourally-identical states (shared
+    sub-grammars reached through different contexts); merging them shrinks
+    every downstream per-state table — including the padded device tables
+    the decode window ships."""
+    labels = accepting.astype(np.int64)
+    n_classes = int(labels.max()) + 1
+    while True:
+        succ = np.where(table >= 0, labels[np.maximum(table, 0)], np.int64(-1))
+        sig = np.concatenate([labels[:, None], succ], axis=1)
+        _, labels = np.unique(sig, axis=0, return_inverse=True)
+        labels = labels.reshape(-1)
+        new_n = int(labels.max()) + 1
+        if new_n == n_classes:
+            break
+        n_classes = new_n
+    # Renumber so the start state's class is 0, then collapse.
+    start_cls = int(labels[0])
+    if start_cls != 0:
+        perm = np.arange(n_classes)
+        perm[start_cls], perm[0] = 0, start_cls
+        labels = perm[labels]
+    reps = np.zeros(n_classes, dtype=np.int64)
+    seen = np.zeros(n_classes, dtype=bool)
+    for s, c in enumerate(labels):
+        if not seen[c]:
+            seen[c] = True
+            reps[c] = s
+    rep_table = table[reps]
+    min_table = np.where(
+        rep_table >= 0, labels[np.maximum(rep_table, 0)], np.int64(-1)
+    ).astype(np.int32)
+    return min_table, accepting[reps]
+
+
+# ---------------------------------------------------------------------------
+# Token lifting + class compression.
+# ---------------------------------------------------------------------------
+
+_UID = itertools.count(1)
+
+
+class TokenGrammar:
+    """Compiled, immutable token-class automaton. Shared by every request
+    using the same spec (via GrammarCache); per-request cursor state lives
+    in GrammarState.
+
+    Tables (all numpy, device-shippable as data):
+      token_class (V,)  int32  vocab token -> behaviour class
+      class_dest  (S,C) int32  destination state, -1 = not allowed
+      accepting   (S,)  bool   EOS allowed here
+    """
+
+    def __init__(self, spec: dict, token_table: list[bytes], eos_token_id: int):
+        t0 = time.perf_counter()
+        self.spec = spec
+        self.eos_token_id = int(eos_token_id)
+        table, accepting = _ast_to_dfa(build_ast(spec))
+        n_states = table.shape[0]
+        vocab = len(token_table)
+        if n_states * vocab > 64_000_000:
+            raise GrammarCompileError(
+                f"grammar too large to lift: {n_states} states x {vocab} tokens"
+            )
+        # Walk each distinct byte string once across ALL states at once.
+        dest = np.full((n_states, vocab), -1, dtype=np.int32)
+        by_bytes: dict[bytes, list[int]] = {}
+        for tid, data in enumerate(token_table):
+            if data and tid != self.eos_token_id:
+                by_bytes.setdefault(bytes(data), []).append(tid)
+        all_states = np.arange(n_states, dtype=np.int32)
+        for data, tids in by_bytes.items():
+            states = all_states
+            for b in data:
+                states = np.where(
+                    states >= 0, table[np.maximum(states, 0), b], np.int32(-1)
+                )
+                if not (states >= 0).any():
+                    break
+            dest[:, tids] = states[:, None]
+        class_dest, token_class = np.unique(dest, axis=1, return_inverse=True)
+        self.token_class = np.ascontiguousarray(
+            token_class.reshape(-1), dtype=np.int32
+        )
+        self.class_dest = np.ascontiguousarray(class_dest, dtype=np.int32)
+        self.accepting = accepting
+        self.n_states = n_states
+        self.n_classes = self.class_dest.shape[1]
+        self.vocab_size = vocab
+        self.uid = next(_UID)
+        self._mask_memo: dict[int, np.ndarray] = {}
+        self._memo_lock = threading.Lock()
+        # Token-level liveness: every reachable state must admit at least
+        # one token (or EOS) — otherwise generation would wedge with an
+        # all-masked step. Byte-DFA pruning above isn't enough when the
+        # vocabulary can't spell a byte path.
+        has_token = (self.class_dest >= 0).any(axis=1)
+        reachable = np.zeros(n_states, dtype=bool)
+        stack = [0]
+        while stack:
+            s = stack.pop()
+            if reachable[s]:
+                continue
+            reachable[s] = True
+            for d in self.class_dest[s]:
+                if d >= 0 and not reachable[d]:
+                    stack.append(int(d))
+        stuck = reachable & ~has_token & ~accepting
+        if stuck.any():
+            raise GrammarCompileError(
+                "vocabulary cannot spell this grammar "
+                f"({int(stuck.sum())} reachable dead-end states)"
+            )
+        self.build_s = time.perf_counter() - t0
+
+    def mask_for(self, state: int) -> np.ndarray:
+        """(V,) bool allowed-token mask for `state` — memoized; treat as
+        read-only. Pure table lookups: this is the per-step hot path."""
+        mask = self._mask_memo.get(state)
+        if mask is None:
+            with self._memo_lock:
+                mask = self._mask_memo.get(state)
+                if mask is None:
+                    mask = self.class_dest[state][self.token_class] >= 0
+                    if self.accepting[state]:
+                        mask = mask.copy()
+                        mask[self.eos_token_id] = True
+                    mask.setflags(write=False)
+                    self._mask_memo[state] = mask
+        return mask
+
+    def advance(self, state: int, tid: int) -> int:
+        """Destination state for consuming `tid`, or -1 if not allowed.
+        EOS is never consumed (returns -1; check accepting instead)."""
+        if not 0 <= tid < self.vocab_size or tid == self.eos_token_id:
+            return -1
+        return int(self.class_dest[state, self.token_class[tid]])
+
+    def allows(self, state: int, tid: int) -> bool:
+        if tid == self.eos_token_id:
+            return bool(self.accepting[state])
+        return self.advance(state, tid) >= 0
+
+    def verify_masks(self, state: int, proposal, width: int) -> np.ndarray:
+        """(width, V) bool masks for a verify dispatch feeding
+        [current, *proposal]: row j constrains the token SAMPLED at fed
+        position j, i.e. the mask of the state after proposal[:j]. Once a
+        proposal token is itself invalid the remaining rows are all-True —
+        harmless, because the masked verifier's argmax at the violating
+        position necessarily mismatches the proposal, so acceptance cuts
+        there and later positions are discarded (the PR 14 rollback)."""
+        out = np.ones((width, self.vocab_size), dtype=bool)
+        out[0] = self.mask_for(state)
+        s = state
+        for j, tok in enumerate(proposal):
+            if j + 1 >= width:
+                break
+            s = self.advance(s, int(tok))
+            if s < 0:
+                break
+            out[j + 1] = self.mask_for(s)
+        return out
+
+
+class GrammarState:
+    """Per-request automaton cursor. Advanced ONLY on accepted tokens in
+    scheduler.postprocess, so it needs no speculative rollback of its own:
+    a discarded StepHandle simply never advanced it, and QoS preemption
+    (which preserves output_token_ids) carries it across preempt/resume
+    untouched. sync() is the defensive resynchronisation if the cursor
+    ever disagrees with the accepted-output length."""
+
+    __slots__ = ("grammar", "state", "consumed")
+
+    def __init__(self, grammar: TokenGrammar):
+        self.grammar = grammar
+        self.state = 0
+        self.consumed = 0
+
+    @property
+    def accepting(self) -> bool:
+        return self.state >= 0 and bool(self.grammar.accepting[self.state])
+
+    def mask(self) -> np.ndarray:
+        if self.state < 0:  # dead: nothing is admissible
+            return np.zeros(self.grammar.vocab_size, dtype=bool)
+        return self.grammar.mask_for(self.state)
+
+    def allows(self, tid: int) -> bool:
+        return self.state >= 0 and self.grammar.allows(self.state, tid)
+
+    def advance(self, tid: int) -> bool:
+        """Consume one ACCEPTED output token. EOS is a terminator, not a
+        grammar byte: it counts toward the cursor but leaves the state
+        alone, so accepting-at-finish still reflects the body. An
+        inadmissible token parks the cursor in the dead state (-1),
+        mirroring the device automaton's dead sink — the cursor keeps
+        counting so it stays aligned with output_token_ids."""
+        self.consumed += 1
+        if int(tid) == self.grammar.eos_token_id:
+            return True
+        if self.state >= 0:
+            nxt = self.grammar.advance(self.state, int(tid))
+            self.state = nxt if nxt >= 0 else -1
+        return self.state >= 0
+
+    def sync(self, output_token_ids) -> None:
+        if self.consumed == len(output_token_ids):
+            return
+        self.state = 0
+        self.consumed = 0
+        for tid in output_token_ids:
+            self.advance(int(tid))
+
+
+class GrammarCache:
+    """LRU of compiled TokenGrammars keyed by canonical spec JSON, owned
+    by the engine (the only place that has both tokenizer and model vocab
+    size). Records per-compile build times for the metrics histogram —
+    drained by the engine's stats() like tenant queue waits."""
+
+    def __init__(self, tokenizer, vocab_size: int, max_entries: int = 64):
+        self._tokenizer = tokenizer
+        self._vocab_size = int(vocab_size)
+        self._max_entries = max_entries
+        self._entries: OrderedDict[str, TokenGrammar] = OrderedDict()
+        self._token_table: list[bytes] | None = None
+        self._lock = threading.Lock()
+        self._build_times: list[float] = []
+        # single-flight: a swarm of concurrent first requests for one
+        # spec must pay ONE build, not one per request
+        self._building: dict[str, threading.Event] = {}
+
+    def _tokens(self) -> list[bytes]:
+        if self._token_table is None:
+            tok = self._tokenizer
+            specials = {
+                getattr(tok, name, None)
+                for name in ("bos_token_id", "eos_token_id", "pad_token_id")
+            }
+            repr_fn = getattr(tok, "token_repr", None)
+            table = []
+            for tid in range(self._vocab_size):
+                if tid in specials:
+                    table.append(b"")
+                elif repr_fn is not None:
+                    try:
+                        table.append(repr_fn(tid)[1])
+                    except Exception:
+                        table.append(b"")
+                elif tid < 256:  # bare ByteTokenizer: id IS the byte
+                    table.append(bytes([tid]))
+                else:
+                    table.append(b"")
+            self._token_table = table
+        return self._token_table
+
+    def get(self, spec: dict) -> tuple[TokenGrammar, bool]:
+        """(grammar, was_cached). Raises GrammarCompileError on failure."""
+        key = spec_key(spec)
+        while True:
+            with self._lock:
+                hit = self._entries.get(key)
+                if hit is not None:
+                    self._entries.move_to_end(key)
+                    return hit, True
+                building = self._building.get(key)
+                if building is None:
+                    building = self._building[key] = threading.Event()
+                    break
+            # another thread is building this exact spec: wait for it,
+            # then re-check (a hit counts as cached; a failed build makes
+            # this thread the next builder and it surfaces its own error)
+            building.wait()
+        try:
+            eos = getattr(self._tokenizer, "eos_token_id", None)
+            if eos is None:
+                raise GrammarCompileError("tokenizer has no EOS token")
+            grammar = TokenGrammar(spec, self._tokens(), eos)
+            with self._lock:
+                self._entries[key] = grammar
+                self._entries.move_to_end(key)
+                while len(self._entries) > self._max_entries:
+                    self._entries.popitem(last=False)
+                self._build_times.append(grammar.build_s)
+        finally:
+            with self._lock:
+                self._building.pop(key, None)
+            building.set()
+        return grammar, False
+
+    def drain_build_times(self) -> list[float]:
+        with self._lock:
+            out = self._build_times
+            self._build_times = []
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Request-surface helpers (jax-free; the router imports these).
+# ---------------------------------------------------------------------------
+
+
+def extract_spec(response_format, guided_json) -> dict | None:
+    """Grammar spec from the OpenAI request surface, or None when the
+    request is unconstrained. Raises GrammarCompileError on a malformed
+    surface (callers map to 400/fallback per the structured_output mode).
+    `guided_json` (vLLM extension) wins over `response_format`."""
+    if guided_json is not None:
+        schema = guided_json
+        if isinstance(schema, str):
+            try:
+                schema = json.loads(schema)
+            except (TypeError, ValueError) as exc:
+                raise GrammarCompileError(f"guided_json is not valid JSON: {exc}")
+        if not isinstance(schema, dict):
+            raise GrammarCompileError("guided_json must be a JSON schema object")
+        return {"kind": "json_schema", "schema": schema}
+    if response_format is None:
+        return None
+    if not isinstance(response_format, dict):
+        raise GrammarCompileError("response_format must be an object")
+    rtype = response_format.get("type")
+    if rtype in (None, "text"):
+        return None
+    if rtype == "json_object":
+        return {"kind": "json_object"}
+    if rtype == "json_schema":
+        wrapper = response_format.get("json_schema")
+        schema = wrapper.get("schema") if isinstance(wrapper, dict) else None
+        if not isinstance(schema, dict):
+            raise GrammarCompileError(
+                "response_format.json_schema.schema must be a schema object"
+            )
+        return {"kind": "json_schema", "schema": schema}
+    raise GrammarCompileError(f"unsupported response_format type {rtype!r}")
+
+
+def tool_choice_spec(tools, tool_choice) -> dict | None:
+    """Spec forcing a tool call when tool_choice is "required" or names a
+    function; None when tool choice stays model-decided ("auto"/None)."""
+    if not tools:
+        return None
+    if isinstance(tool_choice, dict):
+        name = (tool_choice.get("function") or {}).get("name")
+        chosen = [
+            t for t in tools if (t.get("function") or t).get("name") == name
+        ]
+        if not chosen:
+            raise GrammarCompileError(
+                f"tool_choice names unknown function {name!r}"
+            )
+    elif tool_choice == "required":
+        chosen = list(tools)
+    else:
+        return None
+    norm = []
+    for t in chosen:
+        fn = t.get("function") or t
+        norm.append(
+            {"name": fn.get("name"), "parameters": fn.get("parameters") or None}
+        )
+    return {"kind": "tool_call", "tools": norm}
+
+
+def validate_spec(spec: dict) -> None:
+    """Structural validation WITHOUT a tokenizer: AST + NFA + byte-DFA
+    with all caps enforced. The router's 400 path — catches unsupported
+    constructs, depth/enum/state blowups, and impossible grammars, so a
+    doomed request never reaches an engine."""
+    _ast_to_dfa(build_ast(spec))
+
+
+def schema_instance(schema, depth: int = 0):
+    """A minimal instance satisfying `schema` (best effort) — what
+    testing/fake_engine.py echoes for response_format requests."""
+    if depth > MAX_SCHEMA_DEPTH or not isinstance(schema, dict):
+        return {}
+    if "const" in schema:
+        return schema["const"]
+    if isinstance(schema.get("enum"), list) and schema["enum"]:
+        return schema["enum"][0]
+    for union_key in ("anyOf", "oneOf"):
+        if isinstance(schema.get(union_key), list) and schema[union_key]:
+            return schema_instance(schema[union_key][0], depth + 1)
+    stype = schema.get("type")
+    if isinstance(stype, list) and stype:
+        stype = stype[0]
+    if stype is None and "properties" in schema:
+        stype = "object"
+    if stype == "object":
+        props = schema.get("properties") or {}
+        return {
+            k: schema_instance(v, depth + 1) for k, v in props.items()
+        }
+    if stype == "array":
+        if int(schema.get("minItems", 0)) > 0:
+            return [schema_instance(schema.get("items") or {}, depth + 1)]
+        return []
+    if stype == "string":
+        return "x"
+    if stype == "integer":
+        return 1
+    if stype == "number":
+        return 1
+    if stype == "boolean":
+        return True
+    if stype == "null":
+        return None
+    return {}

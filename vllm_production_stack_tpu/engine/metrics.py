@@ -476,6 +476,30 @@ class EngineMetrics:
         )
         for role in mc.POOL_ROLE_VALUES:
             self.pool_role.labels(**self._labels, role=role).set(0)
+        # -- structured output (docs/41-structured-output.md): finished
+        # constrained requests by outcome (closed set) plus the grammar
+        # compile-time histogram (cache hits do not observe)
+        self.structured_requests = Counter(
+            mc.STRUCTURED_REQUESTS[: -len("_total")],
+            "Finished structured-output requests by outcome (closed set: "
+            + ", ".join(mc.STRUCTURED_OUTCOME_VALUES)
+            + ") — valid means the terminal automaton state was accepting",
+            [*names, "outcome"],
+            registry=self.registry,
+        )
+        for outcome in mc.STRUCTURED_OUTCOME_VALUES:
+            self.structured_requests.labels(**self._labels, outcome=outcome)
+        self.grammar_build_time = Histogram(
+            mc.GRAMMAR_BUILD_TIME,
+            "Wall seconds to compile one grammar into token-class tables "
+            "(schema -> byte-DFA -> token lift); grammar-cache hits skip "
+            "this entirely",
+            names,
+            buckets=(
+                0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+            ),
+            registry=self.registry,
+        )
         # -- multi-tenant QoS (docs/27-multitenancy.md): tenant-labeled
         # series; cardinality bounded by qos.TenantAccounting.MAX_TENANTS
         tlabels = [*names, "tenant"]
@@ -624,6 +648,17 @@ class EngineMetrics:
             self.tenant_queue_wait.labels(**lb, tenant=tenant).observe(
                 seconds
             )
+        # -- structured output (docs/41-structured-output.md) --------------
+        for outcome in mc.STRUCTURED_OUTCOME_VALUES:
+            self._bump_labeled(
+                self.structured_requests, f"structured:{outcome}",
+                int((s.structured_outcomes or {}).get(outcome, 0)),
+                {**lb, "outcome": outcome},
+            )
+        for seconds in (s.grammar_build_times or []):
+            # drained from the grammar cache by stats() — each compile
+            # lands in the histogram exactly once
+            self.grammar_build_time.labels(**lb).observe(seconds)
         # -- saturation & goodput (docs/29-saturation-slo.md) -------------
         sat = s.saturation or {}
         self.saturation = sat  # histogram collector reads this at scrape

@@ -39,7 +39,8 @@ from ..parallel.sharding import (
 )
 from .config import EngineConfig
 from .sampling import (
-    SUPPRESS_IDS, greedy_argmax, sample, suppress_stop_tokens,
+    SUPPRESS_IDS, apply_grammar_mask, greedy_argmax, sample,
+    suppress_stop_tokens,
 )
 from .scheduler import DecodeWork, PrefillWork, ScheduleOutput, VerifyWork
 
@@ -132,6 +133,10 @@ class StepHandle:
         # dispatched on top of this still-in-flight verify step (decode
         # handles chain from tokens[:, -1] instead; see _chain_fn)
         self.chain_vec = None
+        # grammar-enabled decode handles: (B_pad,) device vector of each
+        # row's automaton state AFTER the window — a chained next window
+        # gathers its gr_state0 from it the same way tokens chain
+        self.grammar_states = None
 
     def resolve(self) -> list[list[int]]:
         """Sync the step's results to the host — exactly ONE jax.device_get
@@ -357,6 +362,14 @@ class ModelRunner:
             out_shardings=NamedSharding(self.mesh, P(mesh_lib.DP_AXIS, None)),
         )
         self._zero_stop_arrays: dict[int, tuple] = {}
+        # structured output (docs/41-structured-output.md): device-resident
+        # automaton tables keyed by (grammar uids, pads) — a steady
+        # constrained batch re-dispatches with zero H2D table traffic —
+        # plus cached all-ones prefill masks (the identity rows a grammar-
+        # enabled program feeds its unconstrained batches)
+        self._grammar_tables_cache: dict[tuple, tuple] = {}
+        self._ones_mask_cache: dict[int, Any] = {}
+        self._gr_eos_dev: Any | None = None
         self._sleeping_params_host: Any | None = None
         self._sleeping_lora_host: Any | None = None
         self._upload_block_fn = None
@@ -568,7 +581,9 @@ class ModelRunner:
         @functools.partial(
             jax.jit,
             donate_argnames=("kv_caches",),
-            static_argnames=("want_logprobs", "want_min_tokens"),
+            static_argnames=(
+                "want_logprobs", "want_min_tokens", "want_grammar"
+            ),
         )
         def step_fn(
             params,
@@ -593,9 +608,14 @@ class ModelRunner:
             counts,  # (num_samples,) int32 output tokens so far
             min_toks,  # (num_samples,) min_tokens per row
             stop_ids,  # (num_samples, SUPPRESS_IDS) eos/stop ids, -1 pad
+            grammar_mask=None,  # (num_samples, V) bool — constrained rows'
+            #   allowed tokens, all-True for unconstrained rows (mask is
+            #   DATA; docs/41-structured-output.md)
             want_logprobs=False,  # static: also return chosen/top-N logprobs
             want_min_tokens=False,  # static: suppression costs a full-logits
             #   copy per dispatch, so it only compiles in when a row needs it
+            want_grammar=False,  # static: grammar masking compiles in only
+            #   when a batch row is constrained
         ):
             hidden, kv_caches = llama.forward(
                 cfg, params, token_ids, positions, kv_caches,
@@ -612,6 +632,10 @@ class ModelRunner:
             flat = hidden.reshape(-1, hidden.shape[-1])
             picked = flat[sample_rows]  # (num_samples, h)
             logits = llama.compute_logits(cfg, params, picked)
+            if want_grammar:
+                # masked logits flow into logprobs too: the reported
+                # distribution is the constrained one actually sampled from
+                logits = apply_grammar_mask(logits, grammar_mask)
             if want_min_tokens:
                 logits = suppress_stop_tokens(
                     logits, counts, min_toks, stop_ids
@@ -636,7 +660,9 @@ class ModelRunner:
         @functools.partial(
             jax.jit,
             donate_argnames=("kv_caches",),
-            static_argnames=("want_logprobs", "want_min_tokens"),
+            static_argnames=(
+                "want_logprobs", "want_min_tokens", "want_grammar"
+            ),
         )
         def sp_step_fn(
             params,
@@ -661,8 +687,10 @@ class ModelRunner:
             counts,
             min_toks,
             stop_ids,
+            grammar_mask=None,
             want_logprobs=False,
             want_min_tokens=False,
+            want_grammar=False,
         ):
             del write_ids, start_off
             hist_lens = context_lens - chunk_lens
@@ -674,6 +702,8 @@ class ModelRunner:
             flat = hidden.reshape(-1, hidden.shape[-1])
             picked = flat[sample_rows]
             logits = llama.compute_logits(cfg, params, picked)
+            if want_grammar:
+                logits = apply_grammar_mask(logits, grammar_mask)
             if want_min_tokens:
                 logits = suppress_stop_tokens(
                     logits, counts, min_toks, stop_ids
@@ -706,7 +736,9 @@ class ModelRunner:
 
         @functools.partial(
             jax.jit,
-            static_argnames=("window", "want_logprobs", "want_min_tokens"),
+            static_argnames=(
+                "window", "want_logprobs", "want_min_tokens", "want_grammar"
+            ),
             donate_argnames=("kv_caches",),
         )
         def decode_window_fn(
@@ -726,9 +758,21 @@ class ModelRunner:
             counts0,  # (B,) output tokens generated before this window
             min_toks,  # (B,) min_tokens per row
             stop_ids,  # (B, SUPPRESS_IDS) eos/stop ids, -1 pad
-            window: int,
+            # structured output (docs/41-structured-output.md): the token-
+            # class automaton runs ON DEVICE inside the window loop — the
+            # precomputed tables arrive as DATA padded to (G, S, C) buckets,
+            # so every iteration masks AND advances without a host hop and
+            # constrained rows keep full window throughput
+            gr_token_class=None,  # (G, V) int32 vocab token -> class
+            gr_class_dest=None,  # (G, S, C) int32 dest state, -1 = reject
+            gr_accepting=None,  # (G, S) bool — EOS allowed here
+            gr_idx=None,  # (B,) int32 row -> grammar index, -1 unconstrained
+            gr_state0=None,  # (B,) int32 automaton state entering the window
+            gr_eos=None,  # (1,) int32 EOS token id
+            window: int = 1,
             want_logprobs: bool = False,
             want_min_tokens: bool = False,
+            want_grammar: bool = False,
         ):
             b = first_tokens.shape[0]
             out = jnp.zeros((b, window), jnp.int32)
@@ -753,8 +797,22 @@ class ModelRunner:
                 else None
             )
 
+            if want_grammar:
+                has_gr = gr_idx >= 0  # (B,)
+                g = jnp.clip(gr_idx, 0, gr_token_class.shape[0] - 1)
+                tclass = gr_token_class[g]  # (B, V)
+                # dead sink: the LAST padded state row is all -1 by
+                # construction (_grammar_device_tables pads S to a bucket
+                # strictly above any real state count), so a rejected
+                # transition parks there and stays there
+                dead = gr_class_dest.shape[1] - 1
+
             def body(k, carry):
-                staged, cur, out, lp_out, top_lp_out, top_id_out = carry
+                if want_grammar:
+                    (staged, cur, out, lp_out, top_lp_out, top_id_out,
+                     gstate) = carry
+                else:
+                    staged, cur, out, lp_out, top_lp_out, top_id_out = carry
                 # pool history for row r is positions < positions0[r]; the
                 # window's own tokens live in `staged` until the final commit
                 hidden, staged = llama.decode_window_step(
@@ -765,6 +823,23 @@ class ModelRunner:
                     mesh=self.mesh,
                 )
                 logits = llama.compute_logits(cfg, params, hidden)
+                if want_grammar:
+                    # the automaton advances ON DEVICE: mask from the current
+                    # state's class row, sample, then step the state — so a
+                    # constrained row accepts the whole window like any other
+                    # row instead of bailing after one host-masked token
+                    dest_c = gr_class_dest[g, gstate]  # (B, C)
+                    allowed = jnp.take_along_axis(
+                        dest_c >= 0, tclass, axis=1
+                    )  # (B, V)
+                    # EOS is not a grammar byte: allowed exactly in
+                    # accepting states (empty-content tokens hold dest -1
+                    # everywhere, so BOS/PAD stay rejected)
+                    allowed = allowed.at[:, gr_eos[0]].set(
+                        gr_accepting[g, gstate]
+                    )
+                    allowed = allowed | ~has_gr[:, None]
+                    logits = apply_grammar_mask(logits, allowed)
                 if want_min_tokens:
                     logits = suppress_stop_tokens(
                         logits, counts0 + k, min_toks, stop_ids
@@ -779,24 +854,51 @@ class ModelRunner:
                     lp_out = lp_out.at[:, k].set(chosen)
                     top_lp_out = top_lp_out.at[:, k].set(top_lp)
                     top_id_out = top_id_out.at[:, k].set(top_id)
+                if want_grammar:
+                    tcls = jnp.take_along_axis(tclass, toks[:, None], axis=1)
+                    nxt = jnp.take_along_axis(dest_c, tcls, axis=1)[:, 0]
+                    gstate = jnp.where(
+                        has_gr, jnp.where(nxt >= 0, nxt, dead), gstate
+                    )
+                    return (
+                        staged, toks, out.at[:, k].set(toks),
+                        lp_out, top_lp_out, top_id_out, gstate,
+                    )
                 return (
                     staged, toks, out.at[:, k].set(toks),
                     lp_out, top_lp_out, top_id_out,
                 )
 
-            staged, _, out, lp_out, top_lp_out, top_id_out = jax.lax.fori_loop(
-                0, window, body,
-                (staged, first_tokens, out, lp_out, top_lp_out, top_id_out),
-            )
+            if want_grammar:
+                (staged, _, out, lp_out, top_lp_out, top_id_out,
+                 gstates) = jax.lax.fori_loop(
+                    0, window, body,
+                    (staged, first_tokens, out, lp_out, top_lp_out,
+                     top_id_out, gr_state0),
+                )
+            else:
+                gstates = None
+                (staged, _, out, lp_out,
+                 top_lp_out, top_id_out) = jax.lax.fori_loop(
+                    0, window, body,
+                    (staged, first_tokens, out, lp_out, top_lp_out,
+                     top_id_out),
+                )
             # commit the window's KV to the pool: slots for row r, step k are
             # position positions0[r] + k via the row's block table
             pos = positions0[:, None] + jnp.arange(window, dtype=jnp.int32)[None, :]
             blk = jnp.take_along_axis(block_tables, pos // block_size, axis=1)
             slots = (blk * block_size + pos % block_size).reshape(-1)
             kv_caches = llama.commit_staged_kv(kv_caches, staged, slots)
+            # grammar programs also return the final per-row automaton
+            # state — a chained next window gathers its gr_state0 from it
+            # on device (same pattern as chain_vec for tokens)
+            ret = (kv_caches, out)
             if want_logprobs:
-                return kv_caches, out, (lp_out, top_lp_out, top_id_out)
-            return kv_caches, out
+                ret = ret + ((lp_out, top_lp_out, top_id_out),)
+            if want_grammar:
+                ret = ret + (gstates,)
+            return ret
 
         return decode_window_fn
 
@@ -813,7 +915,11 @@ class ModelRunner:
         flight step without a host round trip."""
         cfg = self.config.model
 
-        @functools.partial(jax.jit, donate_argnames=("kv_caches",))
+        @functools.partial(
+            jax.jit,
+            static_argnames=("want_grammar",),
+            donate_argnames=("kv_caches",),
+        )
         def verify_fn(
             params,
             lora_params,
@@ -826,6 +932,13 @@ class ModelRunner:
             write_ids,  # (B, NBW)
             start_off,  # (B,)
             lora_idx,
+            # structured output: per-position admissibility, host-built from
+            # the host-known proposals (TokenGrammar.verify_masks) — "the
+            # verifier masks, the proposer need not": a grammar-violating
+            # draft token just loses the argmax match and gets cut by the
+            # normal acceptance scan, riding PR 14's rollback machinery
+            grammar_mask=None,  # (B, T, V) bool
+            want_grammar: bool = False,
         ):
             hidden, kv_caches = llama.forward(
                 cfg, params, token_ids, positions, kv_caches,
@@ -840,6 +953,10 @@ class ModelRunner:
             logits = llama.compute_logits(
                 cfg, params, hidden.reshape(-1, hidden.shape[-1])
             )
+            if want_grammar:
+                logits = apply_grammar_mask(
+                    logits, grammar_mask.reshape(-1, grammar_mask.shape[-1])
+                )
             toks = greedy_argmax(logits)
             mat = toks.reshape(hidden.shape[0], hidden.shape[1])
             # row i's bonus token under full acceptance sits at its last
@@ -896,6 +1013,33 @@ class ModelRunner:
         block_tables = self._block_table_array(
             [r.block_table for r in work.requests], pad_to=b_pad
         )
+        # structured output: per-position masks are host-buildable because a
+        # verify row's fed tokens are host-known (the scheduler never chains
+        # a constrained row's verify input from an in-flight step). Position
+        # j's logits predict the token after fed[0..j], and fed[0] is the
+        # last ACCEPTED token — already consumed by the host cursor — so
+        # verify_masks(state, proposals, width) lines up exactly.
+        want_gr = any(
+            r.sampling.grammar is not None and r.grammar is not None
+            for r in work.requests
+        )
+        grammar_mask = None
+        if want_gr:
+            v = self.config.model.vocab_size
+            grammar_mask = np.ones((b_pad, t_pad, v), dtype=bool)
+            for i, req in enumerate(work.requests):
+                if req.sampling.grammar is None or req.grammar is None:
+                    continue
+                req.grammar.sync(req.output_token_ids)
+                if req.grammar.state < 0:
+                    continue  # dead cursor (can't be live) — unconstrained
+                grammar_mask[i, : len(work.token_ids[i])] = (
+                    req.sampling.grammar.verify_masks(
+                        req.grammar.state,
+                        work.token_ids[i][1:],
+                        len(work.token_ids[i]),
+                    )
+                )
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
         # verify draws no RNG (pure argmax): rng_before == rng after, so a
@@ -925,6 +1069,10 @@ class ModelRunner:
             self._put(write_ids, self._batch2),
             self._put(start_off, self._batch1),
             self._put(lora_idx, self._batch1) if self._use_lora else None,
+            grammar_mask=(
+                self._put(grammar_mask, self._batch1) if want_gr else None
+            ),
+            want_grammar=want_gr,
         )
         handle = StepHandle(
             runner=self, work=work, tokens=toks, lp_arrays=None,
@@ -1002,15 +1150,23 @@ class ModelRunner:
             for i, req in enumerate(work.requests)
         )
         want_mt = any(r.sampling.min_tokens > 0 for r in work.requests)
+        # structured output: only SAMPLING rows constrain — a mid-prompt
+        # chunk produces no token, so its mask would be dead weight
+        want_gr = any(
+            work.sample[i]
+            and req.sampling.grammar is not None
+            and req.grammar is not None
+            for i, req in enumerate(work.requests)
+        )
         nb = self._width_bucket(
             max((len(r.block_table) for r in work.requests), default=1)
         )
         # a first-seen program key pads up to an already-compiled shape
         # instead of stalling serving on a synchronous XLA compile
         aot_key = self._pick_prefill_shape(
-            b_pad, t_pad, nb, want_lp, want_mt
+            b_pad, t_pad, nb, want_lp, want_mt, want_gr
         )
-        _, b_pad, t_pad, nb, _lp, use_mt = aot_key
+        _, b_pad, t_pad, nb, _lp, use_mt, use_gr = aot_key
 
         token_ids = np.zeros((b_pad, t_pad), np.int32)
         positions = np.zeros((b_pad, t_pad), np.int32)
@@ -1060,15 +1216,47 @@ class ModelRunner:
         for i, req in enumerate(work.requests):
             lora_idx[i] = req.lora_index
         min_toks, stop_ids_arr = self._stop_id_arrays(work.requests, b_pad)
+        # the prefill sample's admissible-token mask is host-built: the
+        # automaton state is host-known (sync replays accepted outputs, so
+        # resumed-after-preempt rows land on the right cursor too)
+        grammar_mask = None
+        if want_gr:
+            v = self.config.model.vocab_size
+            grammar_mask = np.ones((b_pad, v), dtype=bool)
+            for i, req in enumerate(work.requests):
+                if (
+                    work.sample[i]
+                    and req.sampling.grammar is not None
+                    and req.grammar is not None
+                ):
+                    req.grammar.sync(req.output_token_ids)
+                    grammar_mask[i] = req.grammar.mask()
+            grammar_mask = self._put(grammar_mask, self._batch2)
+        elif use_gr:
+            # a grammar-enabled program serving an unconstrained batch
+            # (shape dominance): all-ones mask is the identity, cached per
+            # batch bucket like the zero stop arrays
+            grammar_mask = self._ones_mask_cache.get(b_pad)
+            if grammar_mask is None:
+                grammar_mask = self._put(
+                    np.ones(
+                        (b_pad, self.config.model.vocab_size), dtype=bool
+                    ),
+                    self._batch2,
+                )
+                self._ones_mask_cache[b_pad] = grammar_mask
         tokens_dev, lp_dev, rng_before = self._run(
             token_ids, positions, block_tables,
             slots.reshape(-1) if slots is not None else np.zeros(1, np.int32),
             context_lens, chunk_lens, write_ids, start_off, lora_idx,
             sample_rows, temps, top_ps, top_ks, seeds=seeds, counts=counts,
             min_toks=min_toks, stop_ids_arr=stop_ids_arr,
+            grammar_mask=grammar_mask,
             # use_mt may exceed want_mt (an mt=True program serves mt=False
-            # batches: suppression is a no-op at min_toks=0)
+            # batches: suppression is a no-op at min_toks=0); likewise a
+            # gr=True program serves gr=False via the all-ones identity mask
             want_logprobs=want_lp, want_min_tokens=use_mt,
+            want_grammar=use_gr,
             aot_key=aot_key,
         )
         return StepHandle(
@@ -1111,19 +1299,47 @@ class ModelRunner:
             r.sampling.logprobs is not None for r in work.requests
         )
         want_mt = any(r.sampling.min_tokens > 0 for r in work.requests)
+        # structured output: distinct grammars in this batch, by identity
+        # (TokenGrammar.uid) — gr_idx maps each row to its table slot
+        grammars: list = []
+        g_uid_to_slot: dict[int, int] = {}
+        row_slots: list[tuple[int, int]] = []  # (row, table slot)
+        for i, req in enumerate(work.requests):
+            g = req.sampling.grammar
+            if g is None or req.grammar is None:
+                continue
+            slot = g_uid_to_slot.get(g.uid)
+            if slot is None:
+                slot = len(grammars)
+                g_uid_to_slot[g.uid] = slot
+                grammars.append(g)
+            row_slots.append((i, slot))
+        want_gr = bool(grammars)
+        gkey = None
+        if want_gr:
+            # pads are part of the PROGRAM KEY: bigger tables are the same
+            # program, so dominance pads tables up instead of recompiling.
+            # s_pad strictly exceeds every real state count, which makes
+            # row s_pad-1 all -1 — the dead sink rejected transitions park in
+            gkey = (
+                self._pow2(len(grammars)),
+                self._pow2(max(g.n_states for g in grammars) + 1),
+                self._pow2(max(g.n_classes for g in grammars)),
+            )
         nb = self._width_bucket(
             max((len(r.block_table) for r in work.requests), default=1)
         )
         # never stall a decode window on a first-seen program key
         aot_key = self._pick_decode_shape(
-            b_pad, nb, work.window, want_lp, want_mt
+            b_pad, nb, work.window, want_lp, want_mt, gkey
         )
-        _, b_pad, nb, _w, _lp, use_mt = aot_key
+        _, b_pad, nb, _w, _lp, use_mt, use_gkey = aot_key
 
         first_tokens = np.zeros(b_pad, np.int32)
         first_tokens[:b] = work.token_ids
         ft = self._put(first_tokens, self._batch1)
         chain = work.chain_rows
+        idx_dev = None
         if any(c >= 0 for c in chain):
             # chained rows read their input token straight from the
             # previous (still in-flight) step's device output — the
@@ -1180,6 +1396,56 @@ class ModelRunner:
             self._put(min_toks, self._batch1),
             self._put(stop_ids_arr, self._batch2),
         )
+        if want_gr:
+            tc_dev, cd_dev, acc_dev = self._grammar_device_tables(
+                grammars, use_gkey
+            )
+            gr_idx = np.full(b_pad, -1, np.int32)
+            gs0 = np.zeros(b_pad, np.int32)
+            grammar_chains = False
+            for i, slot in row_slots:
+                gr_idx[i] = slot
+                req = work.requests[i]
+                if chain[i] >= 0:
+                    # input token is still in flight: the row's entering
+                    # state rides the previous handle's device-side
+                    # grammar_states vector instead of the host cursor
+                    grammar_chains = True
+                else:
+                    req.grammar.sync(req.output_token_ids)
+                    # a dead host cursor (-1) maps to the device dead sink
+                    # (last padded state row) — never to a clamped index
+                    gs0[i] = (
+                        req.grammar.state
+                        if req.grammar.state >= 0
+                        else use_gkey[1] - 1
+                    )
+            gs_dev = self._put(gs0, self._batch1)
+            if grammar_chains:
+                if prev is None or prev.grammar_states is None:
+                    raise RuntimeError(
+                        "constrained decode rows chain on a step without "
+                        "grammar states (scheduler must not chain grammar "
+                        "rows onto verify or unconstrained steps)"
+                    )
+                # same gather as token chaining: rows with idx >= 0 read
+                # the in-flight step's post-window state, others keep the
+                # host value (non-grammar chained rows gather junk their
+                # gr_idx = -1 makes inert)
+                gs_dev = self._chain_vec_fn(
+                    prev.grammar_states, gs_dev, idx_dev
+                )
+            if self._gr_eos_dev is None:
+                self._gr_eos_dev = self._put(
+                    np.asarray([grammars[0].eos_token_id], np.int32),
+                    self._rep,
+                )
+            dyn_args = dyn_args + (
+                tc_dev, cd_dev, acc_dev,
+                self._put(gr_idx, self._batch1),
+                gs_dev,
+                self._gr_eos_dev,
+            )
         aot = self._aot_exec.get(aot_key)
         if aot is not None:
             result = aot(
@@ -1194,18 +1460,27 @@ class ModelRunner:
                 window=work.window,
                 want_logprobs=want_lp,
                 want_min_tokens=use_mt,
+                want_grammar=want_gr,
             )
             self._note_compiled(aot_key)
-        if want_lp:
+        gstates = None
+        if want_lp and want_gr:
+            self.kv_caches, tokens, lp_arrays, gstates = result
+        elif want_lp:
             self.kv_caches, tokens, lp_arrays = result
+        elif want_gr:
+            self.kv_caches, tokens, gstates = result
+            lp_arrays = None
         else:
             self.kv_caches, tokens = result
             lp_arrays = None
-        return StepHandle(
+        handle = StepHandle(
             runner=self, work=work, tokens=tokens, lp_arrays=lp_arrays,
             rng_before=rng_before,
             postproc=functools.partial(self._decode_rows, work, b),
         )
+        handle.grammar_states = gstates
+        return handle
 
     @staticmethod
     def _decode_rows(work: DecodeWork, b: int, mat, lp):
@@ -1238,7 +1513,9 @@ class ModelRunner:
         self, token_ids, positions, block_tables, slots, context_lens,
         chunk_lens, write_ids, start_off, lora_idx, sample_rows, temps,
         top_ps, top_ks, seeds, counts, min_toks, stop_ids_arr,
-        want_logprobs=False, want_min_tokens=False, aot_key=None,
+        grammar_mask=None,  # device (B, V) bool when want_grammar
+        want_logprobs=False, want_min_tokens=False, want_grammar=False,
+        aot_key=None,
     ):
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
@@ -1274,6 +1551,8 @@ class ModelRunner:
             self._put(min_toks, self._batch1),
             self._put(stop_ids_arr, self._batch2),
         )
+        if want_grammar:
+            dyn_args = dyn_args + (grammar_mask,)  # already device-resident
         aot = self._aot_exec.get(aot_key) if aot_key is not None else None
         if aot is not None:
             result = aot(
@@ -1287,6 +1566,7 @@ class ModelRunner:
                 *dyn_args,
                 want_logprobs=want_logprobs,
                 want_min_tokens=want_min_tokens,
+                want_grammar=want_grammar,
             )
             if aot_key is not None:
                 self._note_compiled(aot_key)
@@ -1298,6 +1578,44 @@ class ModelRunner:
         # NO host sync here: the caller wraps these in a StepHandle whose
         # resolve() performs the single batched D2H transfer
         return tokens, lp, rng_before
+
+    def _grammar_device_tables(self, grammars: list, gkey: tuple):
+        """Replicated device copies of the batch's automaton tables, padded
+        to the program key's (G, S, C) buckets: token_class (G, V) int32,
+        class_dest (G, S, C) int32 (-1 = reject; padding rows/cols all -1,
+        so state S-1 is the guaranteed dead sink), accepting (G, S) bool.
+        Cached by (grammar uids, pads) — a steady constrained batch
+        re-dispatches with zero table H2D traffic."""
+        g_pad, s_pad, c_pad = gkey
+        key = (tuple(g.uid for g in grammars), g_pad, s_pad, c_pad)
+        hit = self._grammar_tables_cache.get(key)
+        if hit is not None:
+            return hit
+        v = self.config.model.vocab_size
+        tc = np.zeros((g_pad, v), np.int32)
+        cd = np.full((g_pad, s_pad, c_pad), -1, np.int32)
+        acc = np.zeros((g_pad, s_pad), bool)
+        for j, g in enumerate(grammars):
+            if g.vocab_size != v:
+                raise RuntimeError(
+                    f"grammar lifted over vocab {g.vocab_size}, model has {v}"
+                )
+            tc[j] = g.token_class
+            cd[j, : g.n_states, : g.n_classes] = g.class_dest
+            acc[j, : g.n_states] = g.accepting
+        out = (
+            self._put(tc, self._rep),
+            self._put(cd, self._rep),
+            self._put(acc, self._rep),
+        )
+        # bounded: distinct (batch composition, pads) combos churn during
+        # warmup then stabilize; evict oldest past a small cap
+        if len(self._grammar_tables_cache) >= 32:
+            self._grammar_tables_cache.pop(
+                next(iter(self._grammar_tables_cache))
+            )
+        self._grammar_tables_cache[key] = out
+        return out
 
     def _stop_id_arrays(self, requests, pad_to: int):
         """(min_toks (B,), stop_ids (B, SUPPRESS_IDS)) for device-side
@@ -1364,7 +1682,8 @@ class ModelRunner:
         )
 
     def _pick_prefill_shape(
-        self, b_pad: int, t_pad: int, nb: int, want_lp: bool, want_mt: bool
+        self, b_pad: int, t_pad: int, nb: int, want_lp: bool, want_mt: bool,
+        want_gr: bool = False,
     ) -> tuple:
         """The program KEY to dispatch with: exact when that program is
         compiled (or nothing compiled dominates it — cold start compiles
@@ -1373,8 +1692,10 @@ class ModelRunner:
 
         Dominance: every shape axis >= needed; want_logprobs must match
         exactly (it changes the output structure); a want_min_tokens=True
-        program dominates False (suppression is a no-op at min_toks=0)."""
-        key = ("prefill", b_pad, t_pad, nb, want_lp, want_mt)
+        program dominates False (suppression is a no-op at min_toks=0); a
+        grammar-enabled program dominates a plain one (the all-ones mask is
+        the identity)."""
+        key = ("prefill", b_pad, t_pad, nb, want_lp, want_mt, want_gr)
         if not self._dynamic_programs_ok or not self.fallback_enabled:
             return key
         with self._bg_lock:
@@ -1383,22 +1704,35 @@ class ModelRunner:
             candidates = [
                 k for k in self._compiled_keys
                 if k[0] == "prefill" and k[4] == want_lp and k[5] >= want_mt
+                and k[6] >= want_gr
                 and k[1] >= b_pad and k[2] >= t_pad and k[3] >= nb
             ]
         if not candidates:
             return key
         self.compile_fallbacks += 1
         self._bg_compile(key)
-        return min(candidates, key=lambda k: (k[1] * k[2], k[3], k[5]))
+        return min(candidates, key=lambda k: (k[1] * k[2], k[3], k[5], k[6]))
+
+    @staticmethod
+    def _gkey_dominates(have, need) -> bool:
+        """Grammar-table pads (G, S, C) dominate componentwise; None (no
+        grammar path compiled in) serves only None — the output structures
+        differ (grammar programs return the state vector). Tables pad UP to
+        the candidate's buckets, so a bigger-table program is the same
+        program."""
+        if need is None:
+            return have is None
+        return have is not None and all(a >= b for a, b in zip(have, need))
 
     def _pick_decode_shape(
-        self, b_pad: int, nb: int, window: int, want_lp: bool, want_mt: bool
+        self, b_pad: int, nb: int, window: int, want_lp: bool, want_mt: bool,
+        gkey: tuple | None = None,
     ) -> tuple:
         """Like _pick_prefill_shape for the fused decode window. `window`
         is never substituted: it is semantic (tokens generated, pool blocks
         the scheduler reserved) — a larger window would scatter past the
         reserved blocks."""
-        key = ("decode", b_pad, nb, window, want_lp, want_mt)
+        key = ("decode", b_pad, nb, window, want_lp, want_mt, gkey)
         if not self._dynamic_programs_ok or not self.fallback_enabled:
             return key
         with self._bg_lock:
@@ -1408,6 +1742,7 @@ class ModelRunner:
                 k for k in self._compiled_keys
                 if k[0] == "decode" and k[3] == window
                 and k[4] == want_lp and k[5] >= want_mt
+                and self._gkey_dominates(k[6], gkey)
                 and k[1] >= b_pad and k[2] >= nb
             ]
         if not candidates:
@@ -1501,19 +1836,20 @@ class ModelRunner:
         )
         kv_av = self._aval_tree(self.kv_caches)
         if key[0] == "prefill":
-            _, b, t, nb, want_lp, want_mt = key
+            _, b, t, nb, want_lp, want_mt, want_gr = key
             lowered = self._step_fn.lower(
                 params_av, lora_av, kv_av,
-                *self._prefill_avals(b, t, nb),
+                *self._prefill_avals(b, t, nb, want_gr),
                 want_logprobs=want_lp, want_min_tokens=want_mt,
+                want_grammar=want_gr,
             )
         else:
-            _, b, nb, window, want_lp, want_mt = key
+            _, b, nb, window, want_lp, want_mt, gkey = key
             lowered = self._decode_window_fn.lower(
                 params_av, lora_av, kv_av,
-                *self._decode_avals(b, nb),
+                *self._decode_avals(b, nb, gkey),
                 window=window, want_logprobs=want_lp,
-                want_min_tokens=want_mt,
+                want_min_tokens=want_mt, want_grammar=gkey is not None,
             )
         compiled = lowered.compile()
         with self._bg_lock:
@@ -1538,7 +1874,7 @@ class ModelRunner:
         n = 0
         for t in sorted(set(sched.prefill_buckets)):
             if self._compile_key_now(("prefill", b_top, t, top_w,
-                                      False, False)):
+                                      False, False, False)):
                 n += 1
         # the pow2 ROWS ladder at (top chunk, top width): rows are the
         # expensive padding axis (each padded row computes t_pad tokens of
@@ -1548,7 +1884,7 @@ class ModelRunner:
         b = 1
         while b < b_top:
             if self._compile_key_now(("prefill", b, t_top, top_w,
-                                      False, False)):
+                                      False, False, False)):
                 n += 1
             b *= 2
         top_window = 1
@@ -1559,7 +1895,7 @@ class ModelRunner:
                 if d > sched.max_num_seqs:
                     continue  # unreachable batch bucket
                 if self._compile_key_now(("decode", d, top_w, w,
-                                          False, False)):
+                                          False, False, None)):
                     n += 1
             w *= 2
         # min_tokens variants at the top shapes: an mt=True program
@@ -1570,15 +1906,15 @@ class ModelRunner:
             default=min(sched.decode_buckets),
         )
         for key in (
-            ("prefill", b_top, t_top, top_w, False, True),
-            ("decode", d_top, top_w, top_window, False, True),
+            ("prefill", b_top, t_top, top_w, False, True, False),
+            ("decode", d_top, top_w, top_window, False, True, None),
         ):
             if self._compile_key_now(key):
                 n += 1
         logger.info("precompiled %d dominating programs", n)
         return n
 
-    def _prefill_avals(self, b: int, t: int, nb: int):
+    def _prefill_avals(self, b: int, t: int, nb: int, want_gr: bool = False):
         """ShapeDtypeStructs mirroring _run's dynamic args for one prefill
         shape — MUST stay in lockstep with the _step_fn call in _run."""
         bs = self.config.cache.block_size
@@ -1586,6 +1922,8 @@ class ModelRunner:
         i32, f32 = jnp.int32, jnp.float32
         b1, b2, rep = self._batch1, self._batch2, self._rep
         s = self._sds
+        v = self.config.model.vocab_size
+        gr = ((s((b, v), jnp.bool_, b2),) if want_gr else ())  # grammar_mask
         return (
             s((b, t), i32, b2),       # token_ids
             s((b, t), i32, b2),       # positions
@@ -1606,14 +1944,26 @@ class ModelRunner:
             s((b,), i32, b1),         # counts
             s((b,), i32, b1),         # min_toks
             s((b, SUPPRESS_IDS), i32, b2),  # stop_ids
-        )
+        ) + gr
 
-    def _decode_avals(self, b: int, nb: int):
+    def _decode_avals(self, b: int, nb: int, gkey: tuple | None = None):
         """ShapeDtypeStructs mirroring _dispatch_decode's dynamic args —
         MUST stay in lockstep with the _decode_window_fn call."""
         i32, f32 = jnp.int32, jnp.float32
         b1, b2, rep = self._batch1, self._batch2, self._rep
         s = self._sds
+        v = self.config.model.vocab_size
+        gr = ()
+        if gkey is not None:
+            g, sp, cp = gkey
+            gr = (
+                s((g, v), i32, rep),       # gr_token_class
+                s((g, sp, cp), i32, rep),  # gr_class_dest
+                s((g, sp), jnp.bool_, rep),  # gr_accepting
+                s((b,), i32, b1),          # gr_idx
+                s((b,), i32, b1),          # gr_state0
+                s((1,), i32, rep),         # gr_eos
+            )
         return (
             s((b,), i32, b1),         # first_tokens
             s((b,), i32, b1),         # positions0
@@ -1628,7 +1978,7 @@ class ModelRunner:
             s((b,), i32, b1),         # counts0
             s((b,), i32, b1),         # min_toks
             s((b, SUPPRESS_IDS), i32, b2),  # stop_ids
-        )
+        ) + gr
 
     @staticmethod
     def _pow2(n: int) -> int:

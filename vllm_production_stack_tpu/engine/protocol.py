@@ -43,6 +43,12 @@ class ChatCompletionRequest(OpenAIModel):
     # tool_choice: "auto" | "none" | "required" | {"type":"function",...})
     tools: list[dict] | None = None
     tool_choice: str | dict | None = None
+    # structured output (docs/41-structured-output.md):
+    # response_format: {"type": "json_object"} or
+    # {"type": "json_schema", "json_schema": {"name":..., "schema":...}}
+    # guided_json is the vLLM-compatible shorthand (the schema itself).
+    response_format: dict | None = None
+    guided_json: dict | bool | None = None  # extension (vLLM-compatible)
     max_tokens: int | None = None
     max_completion_tokens: int | None = None
     temperature: float = 1.0
@@ -86,6 +92,9 @@ class CompletionRequest(OpenAIModel):
     model: str
     prompt: str | list[str] | list[int] | list[list[int]]
     max_tokens: int | None = None
+    # structured output (docs/41-structured-output.md)
+    response_format: dict | None = None
+    guided_json: dict | bool | None = None  # extension (vLLM-compatible)
     temperature: float = 1.0
     top_p: float = 1.0
     top_k: int = 0

@@ -30,6 +30,12 @@ class SamplingParams:
     # plus the top-N alternatives per generated token (N <= runner
     # LOGPROBS_TOPN; 0 = chosen-only)
     logprobs: int | None = None
+    # structured output (docs/41-structured-output.md): the COMPILED
+    # grammar.TokenGrammar this request's generation must satisfy, or None
+    # for unconstrained. Compiled once at the API layer (GrammarCache) and
+    # shared across requests; compared by identity, which is exactly the
+    # sharing semantics the runner's device-table cache keys on.
+    grammar: object | None = None
 
     @property
     def greedy(self) -> bool:
@@ -154,6 +160,12 @@ class Request:
     # chained decode windows left to ride after a failed propose attempt
     # before the row sits one step out to re-propose on resolved values
     spec_retry_in: int = 0
+    # structured output (docs/41-structured-output.md): per-request
+    # automaton cursor (grammar.GrammarState), None when unconstrained.
+    # Advanced ONLY on accepted tokens in scheduler.postprocess — so it
+    # needs no rollback of its own (discarded speculative steps never
+    # touched it) and survives preemption with output_token_ids.
+    grammar: object | None = None
 
     @property
     def num_prompt_tokens(self) -> int:
@@ -223,3 +235,9 @@ class RequestOutput:
     # request: (proposed, accepted, proposer) — the tracing spine adds it
     # to the decode_window event (docs/36-speculative-decoding.md)
     spec_window: tuple | None = None
+    # terminal output only, constrained requests only: "valid" when the
+    # automaton finished in an accepting state (the body parses against
+    # the schema by construction), "invalid" when terminated mid-structure
+    # (length cut / abort), "fallback" when constraints were requested but
+    # not applied (docs/41-structured-output.md)
+    structured_outcome: str | None = None

@@ -57,6 +57,19 @@ def suppress_stop_tokens(
     return logits.at[jnp.arange(b)[:, None], ids].min(new)
 
 
+def apply_grammar_mask(
+    logits: jax.Array,  # (B, V) float32
+    allowed: jax.Array,  # (B, V) bool — True = token admissible here
+) -> jax.Array:
+    """Grammar-constrained decoding (docs/41-structured-output.md): zero
+    out the disallowed mass. Same SUPPRESS_NEG rationale as above — the
+    top-k/top-p binary search needs the masked logits to stay inside a
+    searchable range. The mask is DATA, not shape: an all-True row is the
+    identity, so unconstrained rows ride a grammar-enabled program
+    unchanged and program cache keys never depend on mask contents."""
+    return jnp.where(allowed, logits, SUPPRESS_NEG)
+
+
 def _row_keys(
     base_key: jax.Array,
     seeds: jax.Array,  # (B,) uint32, meaningful where has_seed

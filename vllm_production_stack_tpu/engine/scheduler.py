@@ -517,6 +517,12 @@ class Scheduler:
             if r.num_inflight_tokens == 0:
                 cands.append(r)
                 continue
+            if r.sampling.grammar is not None:
+                # constrained rows never chain verify-on-verify: the
+                # chained step's masks need the automaton state AFTER the
+                # in-flight step's bonus token, which is device-resident
+                # only — the row sits out until the verify resolves
+                continue
             i = vrow.get(r.request_id)
             if i is None or r.num_inflight_tokens != len(
                 inflight.token_ids[i]
@@ -817,6 +823,15 @@ class Scheduler:
             r for r in ready[: self.config.max_num_seqs]
             if r.sampling.max_tokens - eff_outputs(r) > 0
             and self.model_config.max_model_len - eff_computed(r) > 0
+            # constrained rows chain decode-on-decode (the window program
+            # hands the next window its post-window automaton states on
+            # device) but cannot chain onto a verify step, which carries no
+            # state vector — those sit one step out
+            and not (
+                r.sampling.grammar is not None
+                and r.num_inflight_tokens > 0
+                and not isinstance(inflight, DecodeWork)
+            )
         ]
         if not cand:
             return None
@@ -1451,6 +1466,9 @@ class Scheduler:
                 self.ledger.waste("preempted_recompute", recomputed)
                 if work.sample[i]:
                     tok = sampled[i][0]
+                    if req.grammar is not None and req.sampling.grammar is not None:
+                        req.grammar.sync(req.output_token_ids)
+                        req.grammar.advance(int(tok))
                     req.output_token_ids.append(tok)
                     # goodput ledger: one sampled first token, pending until
                     # the request's fate is known (finish / preemption)
@@ -1486,12 +1504,33 @@ class Scheduler:
                 )
                 cut = n
                 eos = None if s.ignore_eos else req.eos_token_id
-                if eos is not None or s.stop_token_ids:
+                # structured output: the host cursor advances ONLY here, on
+                # accepted tokens — discarded speculative steps never touch
+                # it, so it needs no rollback and survives preemption with
+                # output_token_ids. The admissibility check is belt-and-
+                # suspenders (the device mask already guarantees sampled
+                # tokens are admissible): a violating token cuts the row
+                # BEFORE itself and the tail lands in the same "overshoot"
+                # waste bucket as a stop cut, keeping the ledger partition
+                # exact.
+                gram = req.grammar if s.grammar is not None else None
+                if gram is not None:
+                    gram.sync(req.output_token_ids)
+                if eos is not None or s.stop_token_ids or gram is not None:
                     n_out0 = len(req.output_token_ids)
                     for j in range(n):
                         if _is_stop_token(row[j], s, eos, n_out0 + j + 1):
                             cut = j + 1
+                            if gram is not None:
+                                # EOS is a terminator (state untouched); a
+                                # non-EOS stop token is a real grammar byte
+                                gram.advance(int(row[j]))
                             break
+                        if gram is not None:
+                            if not gram.allows(int(row[j])):
+                                cut = j
+                                break
+                            gram.advance(int(row[j]))
                 accepted = [int(t) for t in row[:cut]]
                 # goodput ledger: every candidate in the row was sampled on
                 # device; the tail past the stop/length cut is discarded

@@ -15,9 +15,13 @@ the one vLLM's default parser family targets:
   through ANY chat template (HF template or the byte fallback — the
   rendering happens before apply_chat_template and uses plain content).
 
-Prompt-level steering only: `tool_choice="required"` / a named function
-instructs the model but cannot grammar-constrain sampling — same
-best-effort contract as vLLM without guided decoding.
+`tool_choice="required"` / a named function does both prompt-level
+steering (the system block announces the constraint) AND grammar-level
+enforcement: the server compiles a forced-tool-call grammar
+(engine/grammar.py `tool_choice_spec`) over this module's exact
+`<tool_call>{"name":...,"arguments":{...}}</tool_call>` surface, so a
+forced call always parses. `tool_choice="auto"` remains best-effort
+prompt steering, same as vLLM without guided decoding.
 
 The streaming parser holds back any text that could be the start of a
 `<tool_call>` tag so clients never see half-emitted markup, and releases

@@ -487,6 +487,28 @@ METRIC_LABEL_VALUES[POOL_REBALANCE_PHASE] = {
     "phase": POOL_REBALANCE_PHASE_VALUES,
 }
 
+# -- structured output (docs/41-structured-output.md) ------------------------
+# Grammar-constrained decoding: requests carrying response_format /
+# guided_json / a forced tool_choice decode under a token-class automaton
+# whose mask is applied on device inside the jitted sampling path.
+#
+# engine-side counter labeled outcome= (closed set): one increment per
+# FINISHED structured request. valid = the terminal automaton state was
+# accepting (the body parses under the declared schema); invalid = it was
+# not (length cap, client stop sequence, or a compile-rejected schema
+# counted at the API layer); fallback = the schema compiled but the engine
+# runs with structured_output=fallback, so constraints were declined and
+# the request decoded free-form.
+STRUCTURED_REQUESTS = "tpu:structured_requests_total"
+STRUCTURED_OUTCOME_VALUES = ("valid", "invalid", "fallback")
+# histogram: wall seconds to compile one grammar (schema -> byte-DFA ->
+# token-class tables). Cache hits do not observe; a hot p99 here means the
+# schema corpus is churning faster than the grammar cache can hold it.
+GRAMMAR_BUILD_TIME = "tpu:grammar_build_time_seconds"
+METRIC_LABEL_VALUES[STRUCTURED_REQUESTS] = {
+    "outcome": STRUCTURED_OUTCOME_VALUES,
+}
+
 CLUSTER_KV_GAUGES = (
     CLUSTER_KV_INDEX_HASHES,
     CLUSTER_KV_INDEX_ENGINES,
@@ -594,4 +616,7 @@ ALL_COUNTERS = (
     # thread-liveness watchdog (docs/37-flight-recorder.md): stall
     # episodes by kind (closed STALL_KIND_VALUES set)
     ENGINE_STEP_STALLS,
+    # structured output (docs/41-structured-output.md): finished
+    # constrained requests by outcome (closed STRUCTURED_OUTCOME_VALUES)
+    STRUCTURED_REQUESTS,
 )

@@ -11,6 +11,7 @@ to the decode peer), then the original body streams from a decode engine.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import math
 import time
@@ -318,6 +319,9 @@ class RequestService:
             if short is not None:
                 return short
         body = self.state.rewriter.rewrite(request.path, body)
+        refused = await self._check_structured(request.path, body)
+        if refused is not None:
+            return refused
 
         alias = body.get("model")
         model = self.resolve_alias(alias)
@@ -352,6 +356,51 @@ class RequestService:
             on_exhausted=on_exhausted,
         )
 
+
+    @staticmethod
+    async def _check_structured(path: str, body: dict):
+        """400 for an uncompilable structured-output surface BEFORE it
+        costs an engine round-trip (docs/41-structured-output.md). Runs
+        the jax-free structural compile (AST -> byte-DFA with every cap
+        enforced) off the event loop — a pathological schema costs real
+        milliseconds and must not stall concurrent streams. A schema that
+        passes here can still be refused by the engine (vocabulary
+        liveness needs the tokenizer), but the common garbage — unknown
+        response_format types, unsupported constructs, depth/enum/state
+        blowups — dies at the router with a clean client error, never a
+        500 and never a wedged stream."""
+        if not path.endswith(("/chat/completions", "/completions")):
+            return None
+        rf = body.get("response_format")
+        gj = body.get("guided_json")
+        tools = body.get("tools")
+        tc = body.get("tool_choice")
+        if rf is None and gj is None and not tools:
+            return None
+        from ..engine.grammar import (
+            GrammarCompileError,
+            extract_spec,
+            tool_choice_spec,
+            validate_spec,
+        )
+
+        try:
+            spec = tool_choice_spec(tools, tc) or extract_spec(rf, gj)
+            if spec is not None:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, validate_spec, spec
+                )
+        except GrammarCompileError as e:
+            return web.json_response(
+                {
+                    "error": {
+                        "message": f"structured output: {e}",
+                        "type": "invalid_request_error",
+                    }
+                },
+                status=400,
+            )
+        return None
 
     async def _with_failover(self, eps, request, request_id, ctx_body,
                              attempt, on_exhausted=None):

@@ -199,8 +199,44 @@ class FakeEngine:
             await asyncio.sleep(n_prompt / self.prefill_tps)
         self.warm_prefixes.add(key)
 
+    @staticmethod
+    def _structured_text(body: dict) -> str | None:
+        """Schema-valid body for a structured-output request
+        (docs/41-structured-output.md), or None for free-form requests.
+        Uses the real jax-free surface helpers so the fake honors exactly
+        the requests a real engine would constrain — router e2e tests can
+        assert the body parses under the declared schema."""
+        rf = body.get("response_format")
+        gj = body.get("guided_json")
+        if rf is None and gj is None:
+            return None
+        from ..engine.grammar import (
+            GrammarCompileError,
+            extract_spec,
+            schema_instance,
+        )
+
+        try:
+            spec = extract_spec(rf, gj)
+        except GrammarCompileError:
+            return None
+        if spec is None:
+            return None
+        if spec.get("kind") == "json_schema":
+            return json.dumps(
+                schema_instance(spec["schema"]), separators=(",", ":")
+            )
+        return "{}"
+
     async def _emit(self, request, body, rid, created, is_chat, n,
                     n_prompt, gap) -> web.StreamResponse:
+        structured = self._structured_text(body)
+        if structured is not None:
+            # the constrained body replaces the tokN filler; the emission
+            # pacing (gap per chunk) stays, so latency-model benches are
+            # undisturbed by WHAT is emitted
+            pieces = [structured[i:i + 8] for i in range(0, len(structured), 8)]
+            n = len(pieces)
         if body.get("stream"):
             resp = web.StreamResponse(
                 headers={"Content-Type": "text/event-stream"}
@@ -208,10 +244,11 @@ class FakeEngine:
             await resp.prepare(request)
             for i in range(n):
                 await asyncio.sleep(gap)
+                piece = pieces[i] if structured is not None else f"tok{i} "
                 delta = (
-                    {"delta": {"content": f"tok{i} "}}
+                    {"delta": {"content": piece}}
                     if is_chat
-                    else {"text": f"tok{i} "}
+                    else {"text": piece}
                 )
                 chunk = {
                     "id": rid,
@@ -249,15 +286,18 @@ class FakeEngine:
             return resp
         await asyncio.sleep(gap * n)
         self.generation_tokens_total += n
-        text = " ".join(f"tok{i}" for i in range(n))
+        if structured is not None:
+            text, finish = structured, "stop"
+        else:
+            text, finish = " ".join(f"tok{i}" for i in range(n)), "length"
         choice = (
             {
                 "index": 0,
                 "message": {"role": "assistant", "content": text},
-                "finish_reason": "length",
+                "finish_reason": finish,
             }
             if is_chat
-            else {"index": 0, "text": text, "finish_reason": "length"}
+            else {"index": 0, "text": text, "finish_reason": finish}
         )
         return web.json_response(
             {
