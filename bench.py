@@ -152,6 +152,111 @@ def _parse_phase_json(out: str, rc: int, key: str | None) -> dict:
     return result
 
 
+# -- bench regression gate ---------------------------------------------------
+# Every BENCH_r*.json the driver archives is a full phase tree; comparing
+# the current run against the newest USABLE one turns the trajectory into
+# a gate: a phase metric drifting past tolerance is named in the output
+# instead of waiting for a human to diff two JSON blobs. Advisory by
+# design — the gate never fails the run (a wedged-tunnel baseline like
+# r04/r05 would otherwise poison every later run).
+_REGRESSION_TOL_PCT = 15.0
+
+# direction heuristics by metric-name markers; HIGHER-better is checked
+# first because throughput names like req_per_s/tok_s also end in the
+# lower-better "_s" suffix. Unknown direction -> not compared (counts,
+# config echoes, booleans-as-ints).
+_HIGHER_BETTER = ("req_per_s", "tok_s", "per_s", "throughput", "rate",
+                  "qps", "goodput", "value", "hit")
+_LOWER_BETTER = ("ttft", "latency", "overhead_pct", "lag", "stall",
+                 "wait", "_ms", "_s")
+_NEVER_COMPARED = ("elapsed_s", "rc", "n", "timeout", "budget")
+
+
+def _metric_direction(path: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown (skip)."""
+    leaf = path.rsplit(".", 1)[-1]
+    if any(leaf == m or leaf.endswith(m) for m in _NEVER_COMPARED):
+        return 0
+    if any(m in leaf for m in _HIGHER_BETTER):
+        return 1
+    if any(m in leaf for m in _LOWER_BETTER):
+        return -1
+    return 0
+
+
+def _numeric_leaves(tree, prefix: str = "") -> dict[str, float]:
+    """Flatten a phase tree to dotted-path -> numeric leaf (bools are
+    NOT numbers here; lists are opaque — per-rep samples, not metrics)."""
+    out: dict[str, float] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_numeric_leaves(v, f"{prefix}.{k}" if prefix else k))
+    elif isinstance(tree, bool):
+        pass
+    elif isinstance(tree, (int, float)):
+        out[prefix] = float(tree)
+    return out
+
+
+def _load_bench_baseline() -> tuple[str | None, dict | None]:
+    """Newest BENCH_r*.json whose driver-parsed tree is usable. r04
+    archived parsed=None (inspection crash) — skipped, older history
+    still serves as the baseline."""
+    import glob
+
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")),
+                       reverse=True):
+        try:
+            doc = json.loads(open(path, encoding="utf-8").read())
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and parsed:
+            return os.path.basename(path), parsed
+    return None, None
+
+
+def _regression_gate(current: dict) -> dict:
+    """Compare every shared numeric leaf against the newest usable
+    baseline at ±_REGRESSION_TOL_PCT. Absent history -> baseline: none.
+    NEVER raises and never fails the run — the flagged list is evidence
+    in the trajectory, not a verdict."""
+    try:
+        fname, base = _load_bench_baseline()
+        if base is None:
+            return {"baseline": "none",
+                    "tolerance_pct": _REGRESSION_TOL_PCT}
+        b, c = _numeric_leaves(base), _numeric_leaves(current)
+        flagged: list[dict] = []
+        compared = 0
+        for path, bv in sorted(b.items()):
+            cv = c.get(path)
+            if cv is None or bv == 0.0:
+                continue  # metric absent this run / no baseline signal
+            direction = _metric_direction(path)
+            if direction == 0:
+                continue
+            compared += 1
+            delta_pct = (cv - bv) / abs(bv) * 100.0
+            if direction * delta_pct < -_REGRESSION_TOL_PCT:
+                flagged.append({
+                    "metric": path,
+                    "baseline": bv,
+                    "current": cv,
+                    "delta_pct": round(delta_pct, 1),
+                })
+        flagged.sort(key=lambda r: -abs(r["delta_pct"]))
+        return {
+            "baseline": fname,
+            "tolerance_pct": _REGRESSION_TOL_PCT,
+            "compared": compared,
+            "flagged": flagged[:40],
+        }
+    except Exception as e:  # noqa: BLE001 — advisory gate, never fatal
+        return {"baseline": "none",
+                "error": f"{type(e).__name__}: {e}"}
+
+
 def run_microbench() -> dict:
     """Offline throughput: 256 concurrent 128-token prompts, 128 greedy
     tokens each, continuous batching over the paged fp8-capable pool.
@@ -1045,6 +1150,263 @@ def _phase_structured_main() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     result = asyncio.run(_structured_bench())
     print(json.dumps({"structured": result}), flush=True)
+
+
+async def _compile_bench() -> dict:
+    """XLA compile telemetry (docs/42-compile-telemetry.md), CPU-only and
+    pre-preflight: proves the pad-up guarantee the CompileWatch exists to
+    police, on a mixed workload that walks every program-key dimension.
+
+    Evidence in the BENCH trajectory:
+    - ZERO mid-traffic compiles after coarse warmup across repeated mixed
+      waves (bucket-ladder sweep + three grammar schemas + ngram spec
+      decode) — the serving-path guarantee, now measured, not assumed
+    - GET /debug/programs serves a non-empty inventory with per-program
+      compile walls and dispatch counts
+    - the storm arm feeds a cold engine unpadded shapes with a threshold
+      of 3: the detector trips, and the ONE structured report names the
+      offending shapes
+    - watch-off vs watch-on p50 at the ≤2% noise floor (the dispatch-path
+      bookkeeping must be free)
+    """
+    import asyncio
+    import dataclasses
+
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vllm_production_stack_tpu.engine.config import (
+        CacheConfig, EngineConfig, ModelConfig, SchedulerConfig,
+    )
+    from vllm_production_stack_tpu.engine.engine import LLMEngine
+    from vllm_production_stack_tpu.engine.request import SamplingParams
+    from vllm_production_stack_tpu.engine.server import EngineServer
+
+    def make_config(**overrides) -> EngineConfig:
+        return EngineConfig(
+            model=ModelConfig.tiny(max_model_len=512),
+            cache=CacheConfig(block_size=8, num_blocks=320),
+            scheduler=SchedulerConfig(
+                max_num_seqs=4, max_num_batched_tokens=128,
+                decode_buckets=(2, 4), prefill_buckets=(32, 64, 128),
+                decode_window=4, num_speculative_tokens=2,
+            ),
+        ).replace(**overrides)
+
+    rng = np.random.RandomState(17)
+    # the spec-decode arm uses FIXED prompts, one row per call: verify
+    # program shapes derive from proposal lengths and batch composition,
+    # so fresh random tokens each wave would compile fresh verify
+    # programs forever and the steady-state assertion could never hold
+    spec_rng = np.random.RandomState(23)
+    SPEC_PROMPTS = [
+        [int(t) for t in spec_rng.randint(1, 500, size=6)] * 4,
+        [int(t) for t in spec_rng.randint(1, 500, size=6)] * 3,
+    ]
+    SCHEMAS = [
+        {"kind": "json_schema", "schema": {
+            "type": "object", "properties": {"ok": {"type": "boolean"}},
+        }},
+        {"kind": "json_schema", "schema": {
+            "type": "object",
+            "properties": {"mode": {"enum": ["fast", "slow"]}},
+        }},
+        {"kind": "json_schema", "schema": {
+            "type": "object", "properties": {
+                "tier": {"enum": [0, 1, 2]},
+                "cached": {"type": "boolean"},
+            },
+        }},
+    ]
+
+    def mixed_wave(engine: LLMEngine) -> None:
+        """One pass over every program-key dimension: prefill bucket
+        ladder, grammar-keyed decode programs, spec-decode verify."""
+        vocab = engine.config.model.vocab_size
+        greedy = SamplingParams(
+            max_tokens=6, temperature=0.0, ignore_eos=True
+        )
+        for plen in (20, 56, 120):  # pads to buckets 32 / 64 / 128
+            engine.generate(
+                [[int(t) for t in rng.randint(1, vocab, size=plen)]
+                 for _ in range(3)],
+                greedy,
+            )
+        for spec in SCHEMAS:
+            sp = dataclasses.replace(
+                SamplingParams(max_tokens=24, temperature=0.0),
+                grammar=engine.grammar_cache.get(spec)[0],
+            )
+            engine.generate(
+                [[int(t) for t in rng.randint(1, vocab, size=12)]], sp
+            )
+        # repeated tail -> the ngram proposer fires -> verify dispatches
+        for prompt in SPEC_PROMPTS:
+            engine.generate(
+                [prompt],
+                SamplingParams(max_tokens=12, temperature=0.0,
+                               ignore_eos=True),
+            )
+
+    async def settle(engine: LLMEngine, timeout_s: float = 60.0) -> None:
+        t_end = time.monotonic() + timeout_s
+        while time.monotonic() < t_end:
+            with engine.runner._bg_lock:
+                if not engine.runner._bg_inflight:
+                    return
+            await asyncio.sleep(0.25)
+
+    def by_trigger(compiles: dict) -> dict:
+        out: dict[str, int] = {}
+        for k, v in compiles.items():
+            trig = k.rsplit("/", 1)[-1]
+            out[trig] = out.get(trig, 0) + v
+        return out
+
+    def watch_overhead(engine: LLMEngine) -> dict:
+        """Watch-on vs watch-off decode-wave p50 — the blackbox/
+        saturation estimator (12 alternating reps, within-pair order
+        flipped, step loop driven directly to dodge aiohttp jitter)."""
+        vocab = engine.config.model.vocab_size
+        prompts = [
+            [int(t) for t in rng.randint(1, vocab, size=16)]
+            for _ in range(8)
+        ]
+        sp = SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True)
+        for _ in range(3):  # pay any straggler compile before measuring
+            engine.generate(prompts, sp)
+        REPS = 12
+        times: dict[bool, list[float]] = {False: [], True: []}
+        for rep in range(REPS):
+            order = (False, True) if rep % 2 == 0 else (True, False)
+            for watching in order:
+                engine.compile_watch.enabled = watching
+                t0 = time.perf_counter()
+                outs = engine.generate(prompts, sp)
+                times[watching].append(time.perf_counter() - t0)
+                lens = [len(o["token_ids"]) for o in outs]
+                assert sum(lens) == 8 * 24, lens
+        engine.compile_watch.enabled = True
+
+        def p50(xs):
+            return sorted(xs)[len(xs) // 2]
+
+        off_p50, on_p50 = p50(times[False]), p50(times[True])
+        result = {
+            "reps": REPS,
+            "off_p50_ms": round(off_p50 * 1e3, 2),
+            "on_p50_ms": round(on_p50 * 1e3, 2),
+            "p50_overhead_pct": round((on_p50 / off_p50 - 1.0) * 100.0, 2),
+        }
+        assert result["p50_overhead_pct"] <= 2.0, result
+        result["overhead_ok"] = True
+        return result
+
+    # -- main arm: warmed engine, mixed traffic, zero mid-traffic compiles
+    # storm threshold lifted way above the lazy shapes a tiny test engine
+    # legitimately compiles on its first wave (verify + grammar-keyed
+    # decode programs are not in the coarse lattice) — the storm DETECTOR
+    # is exercised by the dedicated cold arm below
+    engine = LLMEngine(make_config(compile_storm_threshold=50))
+    try:
+        t0 = time.monotonic()
+        warm_passes = engine.warmup(scope="coarse")
+        warm_s = time.monotonic() - t0
+        # two untimed waves: pay the lazy shapes coarse warmup leaves
+        # (grammar tables, grammar-keyed decode programs, verify) so the
+        # measured waves run against a fully-populated program cache
+        mixed_wave(engine)
+        mixed_wave(engine)
+        await settle(engine)
+        base_snap = engine.compile_watch.stats_snapshot()
+        mid0 = base_snap["mid_traffic"]
+
+        for _ in range(3):
+            mixed_wave(engine)
+        await settle(engine)
+        snap = engine.compile_watch.stats_snapshot()
+        mid_traffic_after = snap["mid_traffic"] - mid0
+        assert mid_traffic_after == 0, (
+            f"{mid_traffic_after} mid-traffic compiles in steady state "
+            f"(pad-up guarantee broken): {snap['compiles']}"
+        )
+        overhead = watch_overhead(engine)
+
+        # server starts AFTER all blocking-generate traffic: _on_startup
+        # spins the AsyncEngine step loop, which would co-drive step()
+        # and steal outputs from engine.generate's collector (the
+        # inventory read is the only thing that needs HTTP)
+        srv = EngineServer(engine, served_model_name="tiny")
+        client = TestClient(TestServer(srv.build_app()))
+        await client.start_server()
+        try:
+            r = await client.get("/debug/programs")
+            payload = await r.json()
+        finally:
+            await client.close()
+        assert r.status == 200, payload
+        assert payload["programs"], "empty inventory after mixed traffic"
+        hits, misses = snap["hits"], snap["misses"]
+        result = {
+            "coarse_warmup_programs": warm_passes,
+            "coarse_warmup_s": round(warm_s, 1),
+            "inventory_programs": len(payload["programs"]),
+            "compiles_by_trigger": by_trigger(snap["compiles"]),
+            "mid_traffic_compiles": mid_traffic_after,
+            "cache_hits": hits,
+            "cache_misses": misses,
+            "cache_hit_rate": round(hits / max(1, hits + misses), 3),
+            "grammar_builds": sum(
+                v for k, v in snap["compiles"].items()
+                if k.startswith("grammar/")
+            ),
+            "watch_overhead": overhead,
+        }
+    finally:
+        engine.runner.shutdown(wait=True)
+
+    # -- storm arm: a COLD engine fed unpadded shapes, threshold 3 — the
+    # detector must trip once and the report must name the shapes
+    storm_engine = LLMEngine(make_config(
+        compile_storm_threshold=3, compile_storm_window_s=60.0,
+    ))
+    try:
+        vocab = storm_engine.config.model.vocab_size
+        for plen in (20, 56, 120):  # three cold sync compiles, no warmup
+            storm_engine.generate(
+                [[int(t) for t in rng.randint(1, vocab, size=plen)]],
+                SamplingParams(max_tokens=4, temperature=0.0,
+                               ignore_eos=True),
+            )
+        watch = storm_engine.compile_watch
+        report = watch.last_storm_report
+        assert watch.storms_total >= 1, dict(watch.compiles)
+        assert report and report["shapes"], report
+        named = [s["key"] for s in report["shapes"]]
+        assert any("'prefill'" in k for k in named), named
+        result["storm"] = {
+            "storms": watch.storms_total,
+            "threshold": 3,
+            "window_s": 60.0,
+            "mid_traffic_compiles": report["mid_traffic_compiles"],
+            "shapes_named": named[:4],
+        }
+    finally:
+        storm_engine.runner.shutdown(wait=True)
+    return result
+
+
+def _phase_compile_main() -> None:
+    """Subprocess entry for the CPU-only compile-telemetry bench (pad-up
+    guarantee + storm detector + watch overhead, docs/42-compile-
+    telemetry.md). Forces CPU before anything touches jax — this phase
+    watches compiles, so its evidence must survive a wedged chip."""
+    import asyncio
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = asyncio.run(_compile_bench())
+    print(json.dumps({"compile": result}), flush=True)
 
 
 async def _blackbox_bench() -> dict:
@@ -4185,6 +4547,8 @@ def main() -> None:
             _phase_blackbox_main()
         elif phase == "structured":
             _phase_structured_main()
+        elif phase == "compile":
+            _phase_compile_main()
         elif phase == "saturation":
             _phase_saturation_main()
         elif phase == "kvflow":
@@ -4257,6 +4621,15 @@ def main() -> None:
     structured = _run_phase(
         "structured", ["bench.py", "--phase", "structured"],
         timeout_s=420, key="structured", min_needed_s=90.0,
+    )
+
+    # -0.07) XLA compile telemetry (docs/42-compile-telemetry.md): the
+    # pad-up zero-mid-traffic-compile guarantee measured on a mixed
+    # workload, the recompile-storm detector tripped on purpose, and the
+    # watch's own overhead at the noise floor — CPU-only, pre-preflight
+    compile_ph = _run_phase(
+        "compile", ["bench.py", "--phase", "compile"],
+        timeout_s=420, key="compile", min_needed_s=90.0,
     )
 
     # -0.0625) saturation & goodput (docs/29-saturation-slo.md): ledger
@@ -4345,7 +4718,7 @@ def main() -> None:
                         "int8_8b_kvauto"):
             _emit(section, {"skipped": "chip preflight failed "
                                        "(tunnel wedged or no device)"})
-        print(json.dumps({
+        out = {
             "metric": "served_northstar_throughput",
             "value": 0.0,
             "unit": "req/s",
@@ -4358,6 +4731,7 @@ def main() -> None:
             "tracing": tracing,
             "blackbox": blackbox,
             "structured": structured,
+            "compile": compile_ph,
             "saturation": saturation,
             "kvflow": kvflow,
             "hydration": hydration,
@@ -4367,7 +4741,9 @@ def main() -> None:
             "fleet": fleet,
             "fleet_scale": fleet_scale,
             "total_elapsed_s": round(time.monotonic() - _t_start, 1),
-        }), flush=True)
+        }
+        out["regressions"] = _regression_gate(out)
+        print(json.dumps(out), flush=True)
         return
 
     # 1) cheap + fast: guarantees the tail is never empty
@@ -4433,7 +4809,7 @@ def main() -> None:
 
     served = livestack.get("req_per_s") or 0.0
     open_loop = livestack.get("open_loop") or {}
-    print(json.dumps({
+    out = {
         "metric": "served_northstar_throughput",
         "value": served,
         "unit": "req/s",
@@ -4454,6 +4830,7 @@ def main() -> None:
         "tracing": tracing,
         "blackbox": blackbox,
         "structured": structured,
+        "compile": compile_ph,
         "saturation": saturation,
         "kvflow": kvflow,
         "hydration": hydration,
@@ -4463,7 +4840,9 @@ def main() -> None:
         "fleet": fleet,
         "fleet_scale": fleet_scale,
         "total_elapsed_s": round(time.monotonic() - _t_start, 1),
-    }), flush=True)
+    }
+    out["regressions"] = _regression_gate(out)
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

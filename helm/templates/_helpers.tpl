@@ -174,6 +174,18 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 {{- if eq (.enablePrefixCaching | default true) false }}
 - "--no-enable-prefix-caching"
 {{- end }}
+{{- if eq (.compileWatch | default true) false }}
+- "--compile-watch"
+- "false"
+{{- end }}
+{{- if .compileStormThreshold }}
+- "--compile-storm-threshold"
+- {{ .compileStormThreshold | quote }}
+{{- end }}
+{{- if .compileStormWindowS }}
+- "--compile-storm-window-s"
+- {{ .compileStormWindowS | quote }}
+{{- end }}
 {{- range .extraArgs }}
 - {{ . | quote }}
 {{- end }}

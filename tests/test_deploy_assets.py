@@ -193,6 +193,49 @@ def test_structured_output_knob_maps_to_engine_flag():
         raise AssertionError("schema accepted an unknown structuredOutput")
 
 
+def test_compile_watch_knobs_map_to_engine_flags():
+    """helm modelSpec.compileWatch/compileStormThreshold/compileStormWindowS
+    must reach the engine as the --compile-* flags the server actually
+    parses, with defaults matching the chart's documented ones (docs/42)."""
+    import jsonschema
+
+    tpl = (REPO / "helm/templates/_helpers.tpl").read_text()
+    # on-by-default bool knob renders only when explicitly disabled
+    assert "{{- if eq (.compileWatch | default true) false }}" in tpl
+    assert '"--compile-watch"' in tpl
+    assert "{{- if .compileStormThreshold }}" in tpl
+    assert '"--compile-storm-threshold"' in tpl
+    assert "{{- if .compileStormWindowS }}" in tpl
+    assert '"--compile-storm-window-s"' in tpl
+    schema = json.loads((REPO / "helm/values.schema.json").read_text())
+    model_props = schema["properties"]["servingEngineSpec"]["properties"][
+        "modelSpec"]["items"]["properties"]
+    assert model_props["compileWatch"] == {"type": "boolean"}
+    assert model_props["compileStormThreshold"]["type"] == "integer"
+    assert model_props["compileStormWindowS"]["type"] == "number"
+    # the argparse surface agrees (keep in lockstep with server.py)
+    from vllm_production_stack_tpu.engine.server import build_parser
+
+    actions = {s: a for a in build_parser()._actions for s in a.option_strings}
+    assert actions["--compile-watch"].default is True
+    assert actions["--compile-storm-threshold"].default == 6
+    assert actions["--compile-storm-window-s"].default == 300.0
+    example = yaml.safe_load(
+        (REPO / "helm/examples/values-42-compile-telemetry.yaml").read_text())
+    spec = example["servingEngineSpec"]["modelSpec"][0]
+    assert spec["compileWatch"] is True
+    assert spec["compileStormThreshold"] >= 1
+    jsonschema.validate(example, schema)
+    bad = json.loads(json.dumps(example))
+    bad["servingEngineSpec"]["modelSpec"][0]["compileStormThreshold"] = 0
+    try:
+        jsonschema.validate(bad, schema)
+    except jsonschema.ValidationError:
+        pass
+    else:
+        raise AssertionError("schema accepted compileStormThreshold=0")
+
+
 def test_observability_assets_do_not_pin_model_names(tmp_path, monkeypatch):
     """Static observability assets must stay model-agnostic: the shipped
     KEDA example once pinned model_name="llama-3-8b" in its queries, so

@@ -102,6 +102,11 @@ class EngineStatsSnapshot:
     # as tenant_queue_waits)
     structured_outcomes: dict = field(default_factory=dict)
     grammar_build_times: list = field(default_factory=list)
+    # XLA compile telemetry (docs/42-compile-telemetry.md): the
+    # CompileWatch snapshot — program-inventory size, per-(phase, trigger)
+    # compile counts, cache hits/misses, storm count, and the drained
+    # compile-wall observations — rendered by EngineMetrics
+    compile: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -465,6 +470,21 @@ class LLMEngine:
         self.runner.heartbeat = bg_hb
         if self.draft_runner is not None:
             self.draft_runner.heartbeat = bg_hb
+        # XLA compile telemetry (docs/42-compile-telemetry.md): ONE watch
+        # shared by both runners (the draft's program cache is the same
+        # failure axis) — entries carry role="target"/"draft"
+        from .compile_watch import CompileWatch
+
+        self.compile_watch = CompileWatch(
+            enabled=config.compile_watch,
+            storm_threshold=config.compile_storm_threshold,
+            storm_window_s=config.compile_storm_window_s,
+            recorder=self.flightrec,
+        )
+        self.runner.compile_watch = self.compile_watch
+        if self.draft_runner is not None:
+            self.draft_runner.compile_watch = self.compile_watch
+            self.draft_runner.compile_role = "draft"
         self._states: dict[str, _RequestState] = {}
         self._lora_slots: dict[str, int] = {}  # adapter name -> slot index
         self._lora_paths: dict[str, str] = {}  # adapter name -> source path
@@ -1852,6 +1872,9 @@ class LLMEngine:
                     "valid" if req.grammar.accepting else "invalid"
                 )
                 self.count_structured(out.structured_outcome)
+            # mid-traffic compile stalls this request blocked on, for the
+            # trace timeline (docs/42-compile-telemetry.md)
+            out.compile_stalls = req.compile_stalls
         return out
 
     @staticmethod
@@ -2005,6 +2028,7 @@ class LLMEngine:
                 if self._grammar_cache is not None
                 else []
             ),
+            compile=self.compile_watch.stats_snapshot(),
         )
 
     @property

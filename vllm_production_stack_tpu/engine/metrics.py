@@ -500,6 +500,64 @@ class EngineMetrics:
             ),
             registry=self.registry,
         )
+        # -- XLA compile telemetry (docs/42-compile-telemetry.md): program
+        # builds by (phase, trigger), compile walls, and the program-cache
+        # inventory/hit/miss view — every (phase, trigger) series seeded so
+        # rate() works from the first mid-traffic compile
+        self.engine_compiles = Counter(
+            mc.ENGINE_COMPILES[: -len("_total")],
+            "Program (and grammar-table) builds by phase and trigger "
+            "(closed sets: " + ", ".join(mc.COMPILE_PHASE_VALUES) + " x "
+            + ", ".join(mc.COMPILE_TRIGGER_VALUES)
+            + ") — trigger=mid_traffic is a dispatch-path stall",
+            [*names, "phase", "trigger"],
+            registry=self.registry,
+        )
+        for phase in mc.COMPILE_PHASE_VALUES:
+            for trigger in mc.COMPILE_TRIGGER_VALUES:
+                self.engine_compiles.labels(
+                    **self._labels, phase=phase, trigger=trigger
+                )
+        self.compile_seconds = Histogram(
+            mc.ENGINE_COMPILE_SECONDS,
+            "Wall seconds per program build (all triggers; real-model XLA "
+            "compiles run 30-60s)",
+            names,
+            buckets=mc.COMPILE_SECONDS_BUCKETS,
+            registry=self.registry,
+        )
+        self.compile_seconds.labels(**self._labels)
+        self.program_cache_programs = Gauge(
+            mc.ENGINE_PROGRAM_CACHE_PROGRAMS,
+            "Programs in the CompileWatch inventory (compiled and "
+            "retained)",
+            names,
+            registry=self.registry,
+        )
+        self.program_cache_programs.labels(**self._labels).set(0)
+        self.program_cache_hits = Counter(
+            mc.ENGINE_PROGRAM_CACHE_HITS[: -len("_total")],
+            "Dispatches whose exact program key was already compiled",
+            names,
+            registry=self.registry,
+        )
+        self.program_cache_misses = Counter(
+            mc.ENGINE_PROGRAM_CACHE_MISSES[: -len("_total")],
+            "Dispatches that padded up to a dominating program or "
+            "compiled synchronously",
+            names,
+            registry=self.registry,
+        )
+        self.compile_storms = Counter(
+            mc.ENGINE_COMPILE_STORMS[: -len("_total")],
+            "Recompile-storm episodes (threshold mid-traffic compiles "
+            "inside the sliding window; one bump per episode)",
+            names,
+            registry=self.registry,
+        )
+        for c in (self.program_cache_hits, self.program_cache_misses,
+                  self.compile_storms):
+            c.labels(**self._labels)
         # -- multi-tenant QoS (docs/27-multitenancy.md): tenant-labeled
         # series; cardinality bounded by qos.TenantAccounting.MAX_TENANTS
         tlabels = [*names, "tenant"]
@@ -659,6 +717,34 @@ class EngineMetrics:
             # drained from the grammar cache by stats() — each compile
             # lands in the histogram exactly once
             self.grammar_build_time.labels(**lb).observe(seconds)
+        # -- XLA compile telemetry (docs/42-compile-telemetry.md) ----------
+        comp = s.compile or {}
+        if comp.get("enabled"):
+            self.program_cache_programs.labels(**lb).set(
+                int(comp.get("programs", 0))
+            )
+            builds = comp.get("compiles") or {}
+            for phase in mc.COMPILE_PHASE_VALUES:
+                for trigger in mc.COMPILE_TRIGGER_VALUES:
+                    self._bump_labeled(
+                        self.engine_compiles, f"compile:{phase}/{trigger}",
+                        int(builds.get(f"{phase}/{trigger}", 0)),
+                        {**lb, "phase": phase, "trigger": trigger},
+                    )
+            for seconds in (comp.get("walls") or []):
+                # drained from the watch by stats() — one observation per
+                # build
+                self.compile_seconds.labels(**lb).observe(seconds)
+            self._bump(
+                self.program_cache_hits, "pc_hits", int(comp.get("hits", 0))
+            )
+            self._bump(
+                self.program_cache_misses, "pc_miss",
+                int(comp.get("misses", 0)),
+            )
+            self._bump(
+                self.compile_storms, "storms", int(comp.get("storms", 0))
+            )
         # -- saturation & goodput (docs/29-saturation-slo.md) -------------
         sat = s.saturation or {}
         self.saturation = sat  # histogram collector reads this at scrape

@@ -37,6 +37,7 @@ from ..parallel.sharding import (
     llama_param_specs,
     lora_param_specs,
 )
+from .compile_watch import CompileWatch, program_memory_bytes
 from .config import EngineConfig
 from .sampling import (
     SUPPRESS_IDS, apply_grammar_mask, greedy_argmax, sample,
@@ -405,6 +406,15 @@ class ModelRunner:
         # serving time the background thread exists to protect (measured:
         # ~10x prefill dispatch inflation with compiles in flight)
         self.idle_check = None  # Callable[[], bool] | None
+        # XLA compile telemetry (docs/42-compile-telemetry.md): the engine
+        # replaces this with its shared CompileWatch; the disabled default
+        # keeps a standalone runner importable at zero overhead. The draft
+        # runner shares the target's watch with role="draft".
+        self.compile_watch = CompileWatch(enabled=False)
+        self.compile_role = "target"
+        # verify programs have no pad-up fallback lattice (t_pad is the
+        # pow2 of the fed width) — tracked separately for telemetry only
+        self._verify_keys: set[tuple] = set()
 
     def _resolve_attention_backend(self) -> str:
         """'auto' → the measured winner for the pool's block size.
@@ -1042,6 +1052,14 @@ class ModelRunner:
                 )
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
+        # verify programs have no pad-up lattice: the synthetic key exists
+        # so compile telemetry covers this dispatch path too
+        vkey = ("verify", b_pad, t_pad, nbw, want_gr)
+        new_program = vkey not in self._verify_keys
+        self.compile_watch.record_dispatch(
+            vkey, not new_program, role=self.compile_role
+        )
+        t0 = time.perf_counter()
         # verify draws no RNG (pure argmax): rng_before == rng after, so a
         # discard()'s rewind is a no-op — recorded anyway for uniformity
         rng_before = self._rng
@@ -1074,6 +1092,11 @@ class ModelRunner:
             ),
             want_grammar=want_gr,
         )
+        if new_program:
+            self._verify_keys.add(vkey)
+            self._watch_sync_compile(
+                "verify", vkey, time.perf_counter() - t0, work.requests
+            )
         handle = StepHandle(
             runner=self, work=work, tokens=toks, lp_arrays=None,
             rng_before=rng_before,
@@ -1163,9 +1186,11 @@ class ModelRunner:
         )
         # a first-seen program key pads up to an already-compiled shape
         # instead of stalling serving on a synchronous XLA compile
+        exact_key = ("prefill", b_pad, t_pad, nb, want_lp, want_mt, want_gr)
         aot_key = self._pick_prefill_shape(
             b_pad, t_pad, nb, want_lp, want_mt, want_gr
         )
+        self._watch_dispatch(exact_key, aot_key)
         _, b_pad, t_pad, nb, _lp, use_mt, use_gr = aot_key
 
         token_ids = np.zeros((b_pad, t_pad), np.int32)
@@ -1257,7 +1282,7 @@ class ModelRunner:
             # gr=True program serves gr=False via the all-ones identity mask
             want_logprobs=want_lp, want_min_tokens=use_mt,
             want_grammar=use_gr,
-            aot_key=aot_key,
+            aot_key=aot_key, watch_reqs=work.requests,
         )
         return StepHandle(
             runner=self, work=work, tokens=tokens_dev, lp_arrays=lp_dev,
@@ -1330,9 +1355,11 @@ class ModelRunner:
             max((len(r.block_table) for r in work.requests), default=1)
         )
         # never stall a decode window on a first-seen program key
+        exact_key = ("decode", b_pad, nb, work.window, want_lp, want_mt, gkey)
         aot_key = self._pick_decode_shape(
             b_pad, nb, work.window, want_lp, want_mt, gkey
         )
+        self._watch_dispatch(exact_key, aot_key)
         _, b_pad, nb, _w, _lp, use_mt, use_gkey = aot_key
 
         first_tokens = np.zeros(b_pad, np.int32)
@@ -1452,6 +1479,9 @@ class ModelRunner:
                 self.params, self.lora_params, self.kv_caches, *dyn_args
             )
         else:
+            with self._bg_lock:
+                new_program = aot_key not in self._compiled_keys
+            t0 = time.perf_counter()
             result = self._decode_window_fn(
                 self.params,
                 self.lora_params,
@@ -1463,6 +1493,11 @@ class ModelRunner:
                 want_grammar=want_gr,
             )
             self._note_compiled(aot_key)
+            if new_program:
+                self._watch_sync_compile(
+                    "decode", aot_key, time.perf_counter() - t0,
+                    work.requests,
+                )
         gstates = None
         if want_lp and want_gr:
             self.kv_caches, tokens, lp_arrays, gstates = result
@@ -1515,7 +1550,7 @@ class ModelRunner:
         top_ps, top_ks, seeds, counts, min_toks, stop_ids_arr,
         grammar_mask=None,  # device (B, V) bool when want_grammar
         want_logprobs=False, want_min_tokens=False, want_grammar=False,
-        aot_key=None,
+        aot_key=None, watch_reqs=None,
     ):
         if self._sleeping_params_host is not None:
             raise RuntimeError("engine is sleeping; wake it before running")
@@ -1559,6 +1594,15 @@ class ModelRunner:
                 self.params, self.lora_params, self.kv_caches, *dyn_args
             )
         else:
+            # a first-ever key means this _step_fn call traces+compiles
+            # synchronously — the stall CompileWatch attributes to the
+            # batch that blocked on it (must check BEFORE the call:
+            # _note_compiled below adds the key)
+            new_program = False
+            if aot_key is not None:
+                with self._bg_lock:
+                    new_program = aot_key not in self._compiled_keys
+            t0 = time.perf_counter()
             result = self._step_fn(
                 self.params,
                 self.lora_params,
@@ -1570,6 +1614,11 @@ class ModelRunner:
             )
             if aot_key is not None:
                 self._note_compiled(aot_key)
+                if new_program:
+                    self._watch_sync_compile(
+                        "prefill", aot_key, time.perf_counter() - t0,
+                        watch_reqs,
+                    )
         if want_logprobs:
             self.kv_caches, tokens, lp = result
         else:
@@ -1591,6 +1640,7 @@ class ModelRunner:
         hit = self._grammar_tables_cache.get(key)
         if hit is not None:
             return hit
+        t0 = time.perf_counter()
         v = self.config.model.vocab_size
         tc = np.zeros((g_pad, v), np.int32)
         cd = np.full((g_pad, s_pad, c_pad), -1, np.int32)
@@ -1615,6 +1665,15 @@ class ModelRunner:
                 next(iter(self._grammar_tables_cache))
             )
         self._grammar_tables_cache[key] = out
+        # telemetry: table builds are numpy-side (no XLA program) but they
+        # sit on the dispatch path — the watch inventories them under
+        # phase="grammar", excluded from cache hit/miss and storm counting
+        self.compile_watch.record_build(
+            "grammar", ("grammar", key[0], g_pad, s_pad, c_pad),
+            time.perf_counter() - t0,
+            "mid_traffic" if self.fallback_enabled else "warmup",
+            role=self.compile_role,
+        )
         return out
 
     def _stop_id_arrays(self, requests, pad_to: int):
@@ -1755,6 +1814,49 @@ class ModelRunner:
         with self._bg_lock:
             self._compiled_keys.add(key)
 
+    def _watch_dispatch(self, exact_key: tuple, aot_key: tuple) -> None:
+        """Program-cache hit/miss accounting: a HIT is the exact requested
+        key already compiled (no pad-up, no sync compile). The dispatch is
+        charged to the key actually served."""
+        watch = self.compile_watch
+        if not watch.enabled:
+            return
+        with self._bg_lock:
+            hit = aot_key == exact_key and exact_key in self._compiled_keys
+        watch.record_dispatch(aot_key, hit, role=self.compile_role)
+
+    def _watch_sync_compile(
+        self, phase: str, key: tuple, wall_s: float, requests
+    ) -> None:
+        """A program compiled ON the dispatch path. During warmup
+        (fallback disabled, every wave compiles its exact program) that is
+        the plan; mid-traffic it is the stall the pad-up cache exists to
+        prevent — recorded against the requests whose step blocked, and
+        stamped onto each request for its trace timeline."""
+        watch = self.compile_watch
+        if not watch.enabled:
+            return
+        trigger = "mid_traffic" if self.fallback_enabled else "warmup"
+        rid = None
+        if requests:
+            rid = getattr(requests[0], "request_id", None)
+        watch.record_build(
+            phase, key, wall_s, trigger, rid=rid, role=self.compile_role,
+        )
+        if trigger != "mid_traffic" or not requests:
+            return
+        stall = {
+            "phase": phase,
+            "key": repr(tuple(key)),
+            "wall_ms": round(wall_s * 1000.0, 1),
+        }
+        for req in requests:
+            stalls = getattr(req, "compile_stalls", None)
+            if stalls is None:
+                req.compile_stalls = [dict(stall)]
+            else:
+                stalls.append(dict(stall))
+
     def _bg_compile(self, key: tuple) -> None:
         with self._bg_lock:
             if key in self._bg_inflight or key in self._compiled_keys:
@@ -1819,7 +1921,7 @@ class ModelRunner:
             with self._bg_lock:
                 self._bg_inflight.discard(key)
 
-    def _compile_key_now(self, key: tuple) -> bool:
+    def _compile_key_now(self, key: tuple, trigger: str = "bg") -> bool:
         """AOT-compile one program key (.lower().compile() — traces and
         compiles WITHOUT executing: no tokens, no pool writes, no pool
         capacity requirement). Returns True when a new executable landed."""
@@ -1851,10 +1953,16 @@ class ModelRunner:
                 window=window, want_logprobs=want_lp,
                 want_min_tokens=want_mt, want_grammar=gkey is not None,
             )
+        t0 = time.perf_counter()
         compiled = lowered.compile()
+        wall = time.perf_counter() - t0
         with self._bg_lock:
             self._aot_exec[key] = compiled
             self._compiled_keys.add(key)
+        self.compile_watch.record_build(
+            key[0], key, wall, trigger, role=self.compile_role,
+            memory_bytes=program_memory_bytes(compiled),
+        )
         return True
 
     def precompile_dominating(self) -> int:
@@ -1874,7 +1982,7 @@ class ModelRunner:
         n = 0
         for t in sorted(set(sched.prefill_buckets)):
             if self._compile_key_now(("prefill", b_top, t, top_w,
-                                      False, False, False)):
+                                      False, False, False), "warmup"):
                 n += 1
         # the pow2 ROWS ladder at (top chunk, top width): rows are the
         # expensive padding axis (each padded row computes t_pad tokens of
@@ -1884,7 +1992,7 @@ class ModelRunner:
         b = 1
         while b < b_top:
             if self._compile_key_now(("prefill", b, t_top, top_w,
-                                      False, False, False)):
+                                      False, False, False), "warmup"):
                 n += 1
             b *= 2
         top_window = 1
@@ -1895,7 +2003,7 @@ class ModelRunner:
                 if d > sched.max_num_seqs:
                     continue  # unreachable batch bucket
                 if self._compile_key_now(("decode", d, top_w, w,
-                                          False, False, None)):
+                                          False, False, None), "warmup"):
                     n += 1
             w *= 2
         # min_tokens variants at the top shapes: an mt=True program
@@ -1909,7 +2017,7 @@ class ModelRunner:
             ("prefill", b_top, t_top, top_w, False, True, False),
             ("decode", d_top, top_w, top_window, False, True, None),
         ):
-            if self._compile_key_now(key):
+            if self._compile_key_now(key, "warmup"):
                 n += 1
         logger.info("precompiled %d dominating programs", n)
         return n

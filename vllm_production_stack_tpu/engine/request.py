@@ -166,6 +166,12 @@ class Request:
     # needs no rollback of its own (discarded speculative steps never
     # touched it) and survives preemption with output_token_ids.
     grammar: object | None = None
+    # compile telemetry (docs/42-compile-telemetry.md): mid-traffic XLA
+    # compiles this request's dispatches blocked on — {phase, key,
+    # wall_ms} dicts stamped by the runner, moved onto the terminal
+    # output by _make_output for the trace timeline's compile_stall
+    # events. None (the steady state) = never stalled.
+    compile_stalls: list | None = None
 
     @property
     def num_prompt_tokens(self) -> int:
@@ -241,3 +247,8 @@ class RequestOutput:
     # (length cut / abort), "fallback" when constraints were requested but
     # not applied (docs/41-structured-output.md)
     structured_outcome: str | None = None
+    # terminal output only: mid-traffic compile stalls this request's
+    # dispatches blocked on (Request.compile_stalls) — each becomes a
+    # compile_stall event on the trace timeline
+    # (docs/42-compile-telemetry.md)
+    compile_stalls: list | None = None

@@ -238,6 +238,7 @@ class EngineServer:
         r.add_get("/debug/hydration", self.debug_hydration)
         r.add_get("/debug/requests", self.debug_requests)
         r.add_get("/debug/flight", self.debug_flight)
+        r.add_get("/debug/programs", self.debug_programs)
         r.add_post("/debug/postmortem", self.debug_postmortem)
         r.add_post("/debug/profile/start", self.debug_profile_start)
         r.add_post("/debug/profile/stop", self.debug_profile_stop)
@@ -547,6 +548,12 @@ class EngineServer:
         so = getattr(out, "structured_outcome", None)
         if so:
             trace.event("structured_outcome", outcome=so, choice=choice)
+        # XLA compile stalls this request's dispatches blocked on
+        # (docs/42-compile-telemetry.md): each names the program key and
+        # wall — the timeline's explanation of a seconds-scale hole in an
+        # otherwise steady decode cadence
+        for st in getattr(out, "compile_stalls", None) or []:
+            trace.event("compile_stall", choice=choice, **st)
         # getattr: error outputs (and RequestOutput-shaped test doubles)
         # carry no lifecycle to attribute
         pt = getattr(out, "phase_times", None)
@@ -1678,6 +1685,8 @@ class EngineServer:
                                "trace (docs/28)",
         "GET /debug/flight": "flight-recorder ring + heartbeat table + "
                              "watchdog state (docs/37)",
+        "GET /debug/programs": "XLA program inventory: compile walls, "
+                               "dispatch counts, storm state (docs/42)",
         "POST /debug/postmortem": "write (or return) a redacted postmortem "
                                   "JSON black box now (docs/37)",
         "POST /debug/profile/start": "start an xprof device capture "
@@ -1754,6 +1763,15 @@ class EngineServer:
                 "age_s": round(out[0], 3), "kind": out[1],
             }
         return web.json_response(body)
+
+    async def debug_programs(self, request: web.Request) -> web.Response:
+        """GET /debug/programs: the CompileWatch program inventory —
+        every recorded build's key, compile wall, dispatch count,
+        last-used age and HBM footprint, plus cache hit/miss totals and
+        the storm detector's state (docs/42-compile-telemetry.md). The
+        storm runbook starts here: find the mid_traffic entry, read its
+        key, fix the bucket ladder that let the shape through."""
+        return web.json_response(self.engine.compile_watch.debug_payload())
 
     async def debug_postmortem(self, request: web.Request) -> web.Response:
         """POST /debug/postmortem: dump the black box NOW. With
@@ -2431,6 +2449,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "lazy-compile stall; per-loop thresholds for the "
                         "fetcher/publisher/bg-compile ride their own "
                         "registrations)")
+    p.add_argument("--compile-watch", default=True, type=_parse_bool_flag,
+                   help="XLA compile telemetry (docs/42-compile-"
+                        "telemetry.md): record every program build "
+                        "(inventory at /debug/programs, "
+                        "tpu:engine_compiles_total{phase,trigger} / "
+                        "compile-seconds histogram / program-cache "
+                        "hit-miss counters, compile_stall trace events) "
+                        "plus the recompile-storm detector. 'false' "
+                        "disables the watch entirely")
+    p.add_argument("--compile-storm-threshold", type=int, default=6,
+                   help="mid-traffic compiles (sync compiles on the "
+                        "dispatch path after warmup — shapes the bucket "
+                        "ladder failed to absorb) inside the sliding "
+                        "window that trip a recompile storm: one "
+                        "structured report naming the offending shapes + "
+                        "tpu:engine_compile_storms_total (backs the "
+                        "TpuRecompileStorm alert)")
+    p.add_argument("--compile-storm-window-s", type=float, default=300.0,
+                   help="recompile-storm sliding window in seconds")
     p.add_argument("--postmortem-dir", default="",
                    help="directory for redacted postmortem JSON dumps "
                         "(watchdog trip, SIGQUIT, fatal step-thread "
@@ -2734,6 +2771,13 @@ def engine_config_from_args(args: argparse.Namespace) -> EngineConfig:
         flight_recording=getattr(args, "flight_recording", True),
         flight_records=getattr(args, "flight_records", 512),
         structured_output=getattr(args, "structured_output", "enforce"),
+        compile_watch=getattr(args, "compile_watch", True),
+        compile_storm_threshold=getattr(
+            args, "compile_storm_threshold", 6
+        ),
+        compile_storm_window_s=getattr(
+            args, "compile_storm_window_s", 300.0
+        ),
     )
 
 

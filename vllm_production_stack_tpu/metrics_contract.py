@@ -114,6 +114,12 @@ REQUEST_PHASE_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
 )
+# inter-token latency (TPOT), ROUTER vantage only: the gap between
+# consecutive streamed chunks as the client sees them — the client-visible
+# SLO the engine-side decode histogram cannot capture (proxy + network
+# included). NOT in REQUEST_PHASE_HISTOGRAMS: that tuple is the per-engine
+# scrape set; this one lives on the router like its TTFT/E2E.
+REQUEST_ITL = "tpu:request_itl_seconds"
 
 # -- saturation & goodput (docs/29-saturation-slo.md) -----------------------
 # Per-step utilization accounting from the engine step loop
@@ -509,6 +515,43 @@ METRIC_LABEL_VALUES[STRUCTURED_REQUESTS] = {
     "outcome": STRUCTURED_OUTCOME_VALUES,
 }
 
+# -- XLA compile telemetry (docs/42-compile-telemetry.md) --------------------
+# The TPU stack's third failure axis (after requests and pods): a shape
+# that escapes the pad-up bucket ladder stalls every stream for a
+# synchronous XLA compile. CompileWatch (engine/compile_watch.py) records
+# every program build; these series make compile hygiene a standing
+# production guarantee instead of a bench-time assertion.
+#
+# counter labeled (phase, trigger), both closed sets: one increment per
+# program (or grammar-table) build. trigger=warmup is planned (boot
+# waves / precompile_dominating), bg is the background AOT thread
+# absorbing a pad-up fallback, mid_traffic is a synchronous compile ON
+# the dispatch path after warmup — the stall the ladder exists to prevent.
+ENGINE_COMPILES = "tpu:engine_compiles_total"
+COMPILE_PHASE_VALUES = ("prefill", "decode", "verify", "grammar")
+COMPILE_TRIGGER_VALUES = ("warmup", "bg", "mid_traffic")
+METRIC_LABEL_VALUES[ENGINE_COMPILES] = {
+    "phase": COMPILE_PHASE_VALUES,
+    "trigger": COMPILE_TRIGGER_VALUES,
+}
+# histogram: wall seconds per program build (all triggers; the rules
+# group records its p95). XLA compiles run 30-60s on real models — the
+# boundaries stretch far past the request-phase buckets.
+ENGINE_COMPILE_SECONDS = "tpu:engine_compile_seconds"
+COMPILE_SECONDS_BUCKETS = (
+    0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 30.0,
+    60.0, 120.0, 300.0,
+)
+# gauge: programs in the CompileWatch inventory (compiled and retained);
+# counters: dispatches whose EXACT program key was already compiled (hit)
+# vs pad-up fallbacks and sync compiles (miss)
+ENGINE_PROGRAM_CACHE_PROGRAMS = "tpu:engine_program_cache_programs"
+ENGINE_PROGRAM_CACHE_HITS = "tpu:engine_program_cache_hits_total"
+ENGINE_PROGRAM_CACHE_MISSES = "tpu:engine_program_cache_misses_total"
+# recompile-storm episodes (--compile-storm-threshold/-window): counted
+# once per EPISODE like ENGINE_STEP_STALLS — the report names the shapes
+ENGINE_COMPILE_STORMS = "tpu:engine_compile_storms_total"
+
 CLUSTER_KV_GAUGES = (
     CLUSTER_KV_INDEX_HASHES,
     CLUSTER_KV_INDEX_ENGINES,
@@ -568,6 +611,9 @@ ALL_GAUGES = (
     # pool rebalancing (docs/40-pool-rebalancing.md): the engine's live
     # pool role (role= closed set, 1 on the current role)
     POOL_ROLE,
+    # compile telemetry (docs/42-compile-telemetry.md): programs in the
+    # CompileWatch inventory
+    ENGINE_PROGRAM_CACHE_PROGRAMS,
 )
 ALL_COUNTERS = (
     PREFIX_CACHE_HITS,
@@ -619,4 +665,11 @@ ALL_COUNTERS = (
     # structured output (docs/41-structured-output.md): finished
     # constrained requests by outcome (closed STRUCTURED_OUTCOME_VALUES)
     STRUCTURED_REQUESTS,
+    # compile telemetry (docs/42-compile-telemetry.md): program builds by
+    # (phase, trigger), program-cache hit/miss dispatches, and storm
+    # episodes (counted once per episode, like ENGINE_STEP_STALLS)
+    ENGINE_COMPILES,
+    ENGINE_PROGRAM_CACHE_HITS,
+    ENGINE_PROGRAM_CACHE_MISSES,
+    ENGINE_COMPILE_STORMS,
 )

@@ -305,6 +305,23 @@ class RouterMetrics:
             buckets=mc.REQUEST_PHASE_BUCKETS,
             registry=self.registry,
         )
+        # inter-token latency (TPOT, docs/42-compile-telemetry.md §ITL):
+        # the gap between consecutive streamed chunks as the client sees
+        # them — the one client-visible SLO TTFT/E2E cannot capture.
+        # Router-only: the engine's decode histogram excludes proxy +
+        # network, which is exactly what this one must include.
+        self.request_itl = Histogram(
+            mc.REQUEST_ITL,
+            "Gap between consecutive streamed chunks (client-visible "
+            "inter-token latency), observed per chunk on streaming "
+            "responses",
+            buckets=mc.REQUEST_PHASE_BUCKETS,
+            registry=self.registry,
+        )
+
+    def observe_itl(self, gap_s: float) -> None:
+        """One inter-chunk gap on a streaming response."""
+        self.request_itl.observe(max(0.0, gap_s))
 
     def observe_request(
         self,

@@ -851,13 +851,25 @@ class RequestService:
                 resp.headers["X-Request-Id"] = request_id
                 await resp.prepare(request)
                 first = True
+                # inter-token latency at the ROUTER vantage: the gap
+                # between consecutive streamed chunks is the client's TPOT
+                # (tpu:request_itl_seconds) — observed only on streaming
+                # requests, where one chunk ~= one token delta
+                observe_itl = bool(body.get("stream"))
+                last_chunk_t = 0.0
                 async for chunk in upstream.content.iter_any():
+                    now_mono = time.monotonic()
                     if first:
                         first = False
                         mon.on_first_token(backend_url, request_id, time.time())
                         if TTFB_KEY not in request:
-                            request[TTFB_KEY] = time.monotonic()
+                            request[TTFB_KEY] = now_mono
                             trace.event("first_byte", url=backend_url)
+                    elif observe_itl:
+                        self.state.metrics.observe_itl(
+                            now_mono - last_chunk_t
+                        )
+                    last_chunk_t = now_mono
                     if want_body:
                         full.extend(chunk)
                     await resp.write(chunk)
